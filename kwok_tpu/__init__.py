@@ -1,0 +1,21 @@
+"""kwok-tpu: a TPU-native cluster lifecycle simulation framework.
+
+Capability target: the KWOK toolkit (reference: /root/reference, a Go codebase)
+— simulate thousands-to-millions of fake Kubernetes nodes and pods against a
+real control plane — re-designed TPU-first:
+
+- Cluster state is a sharded struct-of-arrays tensor (`kwok_tpu.ops.state`).
+- Lifecycle rules (selector -> delay -> next status; the generalization of the
+  reference's status templates, pkg/kwok/controllers/templates/) compile to
+  dense rule tables (`kwok_tpu.models`) evaluated by a single jitted tick
+  kernel (`kwok_tpu.ops.tick`), vmapped over object rows and `shard_map`ped
+  over a `jax.sharding.Mesh` (`kwok_tpu.parallel`).
+- Only non-empty status-patch diffs cross back to the apiserver over the
+  list/watch/patch edge (`kwok_tpu.edge`).
+- `kwok_tpu.kwokctl` is the orchestration plane: it stands up a full local
+  control plane (etcd, kube-apiserver, kube-controller-manager,
+  kube-scheduler, the simulator, Prometheus), mirroring the reference's
+  pkg/kwokctl layer map (SURVEY.md section 1).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,19 @@
+"""The API edge: everything that talks JSON/HTTP to a kube-apiserver.
+
+The device never sees a string; this package converts between Kubernetes
+objects and engine rows:
+
+- ingest: watch/list events -> row writes (selector bits, phase, deletion)
+- render: dirty rows -> status documents (the reference's templates,
+  pkg/kwok/controllers/templates/, as plain dict builders)
+- merge: strategic-merge + no-op suppression semantics matching
+  configureNode / computePatchData (node_controller.go:356-391,
+  pod_controller.go:404-439)
+- kubeclient: list/watch/patch transport with re-watch backoff matching
+  node_controller.go:241-254
+"""
+
+from kwok_tpu.edge.selectors import LabelSelector, parse_selector
+from kwok_tpu.edge.ippool import IPPool
+
+__all__ = ["LabelSelector", "parse_selector", "IPPool"]

@@ -1,0 +1,82 @@
+"""Strategic-merge-patch semantics for status documents + no-op suppression.
+
+Mirrors the observable behavior of the reference's diff logic:
+- configureNode (node_controller.go:356-391): render -> strategic-merge into
+  current status -> **conditions excluded from the comparison** -> skip if
+  equal.
+- computePatchData (pod_controller.go:404-439): when phase != Pending,
+  render -> strategic-merge -> skip if equal; when Pending, always patch.
+
+Only the list merge strategies that occur in Node/Pod status are
+implemented: conditions (merge key `type`), addresses (merge key `type`);
+all other lists replace atomically (containerStatuses has no patch merge key
+in core/v1).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any
+
+# path (tuple of dict keys, "*" wildcard not needed here) -> merge key
+_MERGE_KEYS: dict[str, str] = {
+    "conditions": "type",
+    "addresses": "type",
+}
+
+
+def strategic_merge(original: Any, patch: Any, merge_keys: dict[str, str] | None = None) -> Any:
+    merge_keys = _MERGE_KEYS if merge_keys is None else merge_keys
+    return _merge_value(original, patch, merge_keys, field=None)
+
+
+def _merge_value(orig: Any, patch: Any, mk: dict[str, str], field: str | None) -> Any:
+    if isinstance(patch, dict) and isinstance(orig, dict):
+        out = dict(orig)
+        for k, v in patch.items():
+            if v is None:
+                out.pop(k, None)
+            elif k in out:
+                out[k] = _merge_value(out[k], v, mk, field=k)
+            else:
+                out[k] = copy.deepcopy(v)
+        return out
+    if isinstance(patch, list) and isinstance(orig, list) and field in mk:
+        key = mk[field]
+        out_list = [copy.deepcopy(x) for x in orig]
+        index = {x.get(key): i for i, x in enumerate(out_list) if isinstance(x, dict)}
+        for item in patch:
+            if isinstance(item, dict) and item.get(key) in index:
+                i = index[item[key]]
+                out_list[i] = _merge_value(out_list[i], item, mk, field=None)
+            else:
+                out_list.append(copy.deepcopy(item))
+        return out_list
+    return copy.deepcopy(patch)
+
+
+def _canonical(doc: Any) -> str:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+def node_status_patch_needed(current_status: dict, rendered: dict) -> bool:
+    """configureNode's check: merge, then compare with conditions pinned to
+    the current value (node_controller.go:377 `nodeStatus.Conditions =
+    node.Status.Conditions`) — heartbeat-only condition changes do not
+    count as drift."""
+    merged = strategic_merge(current_status, rendered)
+    merged = dict(merged)
+    if "conditions" in current_status:
+        merged["conditions"] = current_status["conditions"]
+    else:
+        merged.pop("conditions", None)
+    return _canonical(merged) != _canonical(current_status)
+
+
+def pod_status_patch_needed(current_status: dict, rendered: dict) -> bool:
+    """computePatchData's check: only suppress when phase != Pending."""
+    if current_status.get("phase", "Pending") == "Pending":
+        return True
+    merged = strategic_merge(current_status, rendered)
+    return _canonical(merged) != _canonical(current_status)

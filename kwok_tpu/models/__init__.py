@@ -1,0 +1,36 @@
+"""Lifecycle rule models.
+
+The reference drives lifecycle simulation with three embedded Go templates
+(pkg/kwok/controllers/templates/templates.go:24-33) selected by hard-coded
+controller logic. Here the same behavior is expressed as data: a list of
+`LifecycleRule`s (selector + delay + next-state), the generalization that
+Stage CRDs later became (SURVEY.md, "Snapshot vintage"). Rules compile to
+dense arrays (`compile_rules`) executed by the tick kernel in kwok_tpu.ops.
+"""
+
+from kwok_tpu.models.lifecycle import (
+    Delay,
+    LifecycleRule,
+    PhaseSpace,
+    ResourceKind,
+    StatusEffect,
+)
+from kwok_tpu.models.compiler import CompiledRules, compile_rules
+from kwok_tpu.models.defaults import (
+    default_node_rules,
+    default_pod_rules,
+    default_rules,
+)
+
+__all__ = [
+    "Delay",
+    "LifecycleRule",
+    "PhaseSpace",
+    "ResourceKind",
+    "StatusEffect",
+    "CompiledRules",
+    "compile_rules",
+    "default_node_rules",
+    "default_pod_rules",
+    "default_rules",
+]

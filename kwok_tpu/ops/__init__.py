@@ -1,0 +1,18 @@
+"""Device-side engine ops: SoA cluster state + the jitted tick kernel.
+
+This package replaces the reference's hot path — the per-object goroutine
+reconcile loops in pkg/kwok/controllers/{node,pod}_controller.go — with one
+batched state-transition kernel over struct-of-arrays tensors.
+"""
+
+from kwok_tpu.ops.state import RowState, TickOutputs, new_row_state
+from kwok_tpu.ops.tick import TickKernel
+from kwok_tpu.ops.reference import reference_tick
+
+__all__ = [
+    "RowState",
+    "TickOutputs",
+    "new_row_state",
+    "TickKernel",
+    "reference_tick",
+]

@@ -1,0 +1,120 @@
+"""Pure-numpy reference interpreter for the tick semantics.
+
+The oracle for property tests (SURVEY.md section 4: "property-test the tick
+kernel against a reference Python interpreter of the rules"). Implements the
+same three steps as kwok_tpu.ops.tick.tick_body — match / fire / heartbeat —
+in scalar-friendly numpy, reusing the single-row matcher
+kwok_tpu.models.compiler.match_rule_host.
+
+Randomness: the caller supplies the per-row uniform samples `u` so the oracle
+is deterministic; tests use constant delays (u irrelevant) for exact
+equivalence and statistical tests for the stochastic kinds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kwok_tpu.models.compiler import CompiledRules, match_rule_host
+from kwok_tpu.models.lifecycle import DelayKind
+from kwok_tpu.ops.state import RowState, TickOutputs
+
+
+def _sample_delay(table: CompiledRules, rule: int, u: float) -> float:
+    kind = int(table.delay_kind[rule])
+    a = float(table.delay_a[rule])
+    b = float(table.delay_b[rule])
+    if kind == DelayKind.CONSTANT:
+        return a
+    if kind == DelayKind.UNIFORM:
+        return a + (b - a) * u
+    d = -a * float(np.log(u))
+    if b > 0:
+        d = min(d, b)
+    return d
+
+
+def reference_tick(
+    state: RowState,
+    now: float,
+    table: CompiledRules,
+    hb_interval: float = 30.0,
+    hb_phase_mask: int = 0,
+    u: np.ndarray | None = None,
+) -> TickOutputs:
+    c = state.capacity
+    if u is None:
+        u = np.full(c, 0.5)
+
+    phase = np.array(state.phase, np.int32)
+    cond = np.array(state.cond_bits, np.uint32)
+    pending = np.array(state.pending_rule, np.int32)
+    fire_at = np.array(state.fire_at, np.float32)
+    hb_due = np.array(state.hb_due, np.float32)
+    gen = np.array(state.gen, np.int32)
+    dirty = np.zeros(c, bool)
+    deleted = np.zeros(c, bool)
+    hb_fired = np.zeros(c, bool)
+    transitions = 0
+
+    for i in range(c):
+        if not state.active[i]:
+            # Match the kernel's writes on inactive rows: pending/fire_at/
+            # hb_due are cleared (tick_body's where(active, ...) selects).
+            pending[i] = -1
+            fire_at[i] = np.inf
+            hb_due[i] = np.inf
+            continue
+        # 1. match / re-arm
+        best = match_rule_host(
+            table, int(phase[i]), int(state.sel_bits[i]), bool(state.has_deletion[i])
+        )
+        if best != int(pending[i]):
+            if best >= 0:
+                pending[i] = best
+                fire_at[i] = np.float32(now + _sample_delay(table, best, float(u[i])))
+            else:
+                pending[i] = -1
+                fire_at[i] = np.inf
+        # 2. fire
+        if pending[i] >= 0 and now >= fire_at[i]:
+            r = int(pending[i])
+            phase[i] = table.to_phase[r]
+            cond[i] = (cond[i] & ~table.cond_assign[r]) | table.cond_value[r]
+            gen[i] += 1
+            transitions += 1
+            if table.is_delete[r]:
+                deleted[i] = True
+            else:
+                dirty[i] = True
+            pending[i] = -1
+            fire_at[i] = np.inf
+        # 3. heartbeat
+        hb_on = ((hb_phase_mask >> int(phase[i])) & 1) == 1
+        if not hb_on:
+            hb_due[i] = np.inf
+        else:
+            if np.isinf(hb_due[i]):
+                hb_due[i] = np.float32(now + hb_interval)
+            elif now >= hb_due[i]:
+                hb_fired[i] = True
+                hb_due[i] = np.float32(now + hb_interval)
+
+    new_state = RowState(
+        active=np.array(state.active, bool),
+        phase=phase,
+        cond_bits=cond,
+        sel_bits=np.array(state.sel_bits, np.uint32),
+        has_deletion=np.array(state.has_deletion, bool),
+        pending_rule=pending,
+        fire_at=fire_at,
+        hb_due=hb_due,
+        gen=gen,
+    )
+    return TickOutputs(
+        state=new_state,
+        dirty=dirty,
+        deleted=deleted,
+        hb_fired=hb_fired,
+        transitions=np.int32(transitions),
+    )

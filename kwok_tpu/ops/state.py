@@ -1,0 +1,88 @@
+"""Struct-of-arrays cluster state for one resource kind.
+
+The reference keeps cluster state as Go objects spread across client-go
+caches, channels, and mutexed string sets (pkg/kwok/controllers/utils.go:163-205).
+Here a resource kind's rows live in fixed-capacity parallel arrays so the
+whole population is one tensor program:
+
+  active        bool[C]    row in use
+  phase         int32[C]   phase id (kwok_tpu.models.lifecycle.PhaseSpace)
+  cond_bits     uint32[C]  condition status bits
+  sel_bits      uint32[C]  host-computed selector-match bits
+  has_deletion  bool[C]    deletionTimestamp present
+  pending_rule  int32[C]   matched-but-not-fired rule id, -1 if unmatched
+  fire_at       f32[C]     engine-time the pending rule fires (+inf if none)
+  hb_due        f32[C]     next heartbeat time (+inf = no heartbeat)
+  gen           int32[C]   bumped on every transition (host patch dedup)
+
+Times are float32 seconds since the engine epoch (wall-clock captured once at
+startup); f32 keeps sub-10ms resolution for over a day of continuous run,
+and the host converts back to RFC3339 at the API boundary.
+
+Capacity is static (XLA wants static shapes); the host grows by doubling:
+allocate a bigger state and copy (kwok_tpu.engine handles the row pool and
+free-list — tombstoned rows are recycled, mirroring the reference's ipPool
+Put/Get recycling, utils.go:52-117).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+INF = np.float32(np.inf)
+
+
+class RowState(NamedTuple):
+    """One resource kind's rows. A pytree of arrays (jnp or np)."""
+
+    active: np.ndarray  # bool[C]
+    phase: np.ndarray  # int32[C]
+    cond_bits: np.ndarray  # uint32[C]
+    sel_bits: np.ndarray  # uint32[C]
+    has_deletion: np.ndarray  # bool[C]
+    pending_rule: np.ndarray  # int32[C]
+    fire_at: np.ndarray  # float32[C]
+    hb_due: np.ndarray  # float32[C]
+    gen: np.ndarray  # int32[C]
+
+    @property
+    def capacity(self) -> int:
+        return int(self.active.shape[0])
+
+
+class TickOutputs(NamedTuple):
+    """What one tick hands back to the host."""
+
+    state: RowState
+    dirty: np.ndarray  # bool[C] — transitioned this tick: needs status patch
+    deleted: np.ndarray  # bool[C] — fired a delete-effect rule: needs DELETE
+    hb_fired: np.ndarray  # bool[C] — heartbeat due: needs heartbeat patch
+    transitions: np.ndarray  # int32 scalar — transitions this tick
+
+
+def new_row_state(capacity: int, xp=np) -> RowState:
+    """Fresh empty state. `xp` may be numpy or jax.numpy."""
+    return RowState(
+        active=xp.zeros(capacity, bool),
+        phase=xp.zeros(capacity, np.int32),
+        cond_bits=xp.zeros(capacity, np.uint32),
+        sel_bits=xp.zeros(capacity, np.uint32),
+        has_deletion=xp.zeros(capacity, bool),
+        pending_rule=xp.full(capacity, -1, np.int32),
+        fire_at=xp.full(capacity, INF, np.float32),
+        hb_due=xp.full(capacity, INF, np.float32),
+        gen=xp.zeros(capacity, np.int32),
+    )
+
+
+def grow(state: RowState, new_capacity: int) -> RowState:
+    """Host-side capacity doubling (numpy arrays only)."""
+    old = state.capacity
+    if new_capacity <= old:
+        return state
+    out = new_row_state(new_capacity, np)
+    for name in RowState._fields:
+        getattr(out, name)[:old] = np.asarray(getattr(state, name))
+    return out

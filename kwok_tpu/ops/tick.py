@@ -1,0 +1,212 @@
+"""The jitted tick kernel.
+
+One call advances an entire resource population one step:
+
+  1. (re)match: every active row is matched against the compiled rule table
+     (first match wins). A row whose best rule CHANGED since last tick —
+     because ingest updated its phase / deletionTimestamp / selector bits —
+     is re-armed with a freshly sampled delay. This replaces the reference's
+     event-driven channels (watch event -> chan -> worker,
+     pkg/kwok/controllers/node_controller.go:301-354,
+     pod_controller.go:205-250): ingest only writes row fields; the next tick
+     notices.
+  2. fire: rows whose pending rule's fire-time has arrived transition: phase
+     and condition bits update, generation bumps, and the row lands in the
+     dirty mask (status patch needed) or deleted mask (API delete needed,
+     the analogue of pod_controller.go:155-183).
+  3. heartbeat: a vectorized timer wheel replaces KeepNodeHeartbeat's
+     snapshot-sort-fanout over a 16-worker pool
+     (node_controller.go:175-204): rows in heartbeat-enabled phases with
+     hb_due <= now land in the hb_fired mask and get hb_due += interval.
+
+Everything is branch-free jnp; the whole function jits to one XLA program.
+Matching broadcasts a [C, R] boolean — R (rule count) is tiny (<32), so this
+stays bandwidth-bound on the row arrays, which is the right regime for TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kwok_tpu.models.compiler import CompiledRules
+from kwok_tpu.models.lifecycle import DelayKind
+from kwok_tpu.ops.state import RowState, TickOutputs
+
+INF = jnp.float32(jnp.inf)
+
+
+def _rule_arrays(table: CompiledRules) -> dict[str, jnp.ndarray]:
+    return {
+        "from_mask": jnp.asarray(table.from_mask, jnp.uint32),
+        "deletion": jnp.asarray(table.deletion, jnp.int8),
+        "selector_bit": jnp.asarray(table.selector_bit, jnp.int32),
+        "delay_kind": jnp.asarray(table.delay_kind, jnp.int8),
+        "delay_a": jnp.asarray(table.delay_a, jnp.float32),
+        "delay_b": jnp.asarray(table.delay_b, jnp.float32),
+        "to_phase": jnp.asarray(table.to_phase, jnp.int32),
+        "cond_assign": jnp.asarray(table.cond_assign, jnp.uint32),
+        "cond_value": jnp.asarray(table.cond_value, jnp.uint32),
+        "is_delete": jnp.asarray(table.is_delete, bool),
+    }
+
+
+def tick_body(
+    state: RowState,
+    now: jnp.ndarray,
+    key: jax.Array,
+    rules: dict[str, jnp.ndarray],
+    hb_interval: float,
+    hb_phase_mask: int,
+) -> TickOutputs:
+    """Pure tick function — shared by the single-device jit and shard_map."""
+    capacity = state.active.shape[0]
+    num_rules = rules["from_mask"].shape[0]
+
+    active = state.active
+    phase = state.phase
+
+    if num_rules > 0:
+        # --- 1. match ------------------------------------------------------
+        phase_u = phase.astype(jnp.uint32)
+        phase_ok = ((rules["from_mask"][None, :] >> phase_u[:, None]) & 1) == 1
+        deletion = rules["deletion"][None, :].astype(jnp.int32)
+        del_ok = (deletion == -1) | (
+            (deletion == 1) == state.has_deletion[:, None]
+        )
+        sel_bit = rules["selector_bit"][None, :]
+        sel_ok = (sel_bit < 0) | (
+            ((state.sel_bits[:, None] >> jnp.maximum(sel_bit, 0).astype(jnp.uint32)) & 1) == 1
+        )
+        match = phase_ok & del_ok & sel_ok  # [C, R]
+        any_match = match.any(axis=1)
+        first = jnp.argmax(match, axis=1).astype(jnp.int32)  # first True
+        best = jnp.where(active & any_match, first, jnp.int32(-1))
+
+        # Re-arm rows whose best rule changed (covers newly matched rows and
+        # rows invalidated by ingest writes).
+        rearm = active & (best != state.pending_rule) & (best >= 0)
+        rid = jnp.maximum(best, 0)
+        dk = rules["delay_kind"][rid].astype(jnp.int32)
+        a = rules["delay_a"][rid]
+        b = rules["delay_b"][rid]
+        u = jax.random.uniform(
+            key, (capacity,), jnp.float32, minval=1e-7, maxval=1.0
+        )
+        d_uniform = a + (b - a) * u
+        d_exp = -a * jnp.log(u)
+        d_exp = jnp.where(b > 0, jnp.minimum(d_exp, b), d_exp)
+        delay = jnp.where(
+            dk == DelayKind.CONSTANT,
+            a,
+            jnp.where(dk == DelayKind.UNIFORM, d_uniform, d_exp),
+        )
+        pending = jnp.where(active, best, jnp.int32(-1))
+        fire_at = jnp.where(
+            rearm, now + delay, jnp.where(pending >= 0, state.fire_at, INF)
+        )
+
+        # --- 2. fire -------------------------------------------------------
+        can_fire = active & (pending >= 0) & (now >= fire_at)
+        frid = jnp.maximum(pending, 0)
+        fired_delete = can_fire & rules["is_delete"][frid]
+        new_phase = jnp.where(can_fire, rules["to_phase"][frid], phase)
+        assign = rules["cond_assign"][frid]
+        value = rules["cond_value"][frid]
+        new_cond = jnp.where(
+            can_fire, (state.cond_bits & ~assign) | value, state.cond_bits
+        )
+        pending = jnp.where(can_fire, jnp.int32(-1), pending)
+        fire_at = jnp.where(can_fire, INF, fire_at)
+        new_gen = state.gen + can_fire.astype(jnp.int32)
+        dirty = can_fire & ~fired_delete
+    else:
+        new_phase = phase
+        new_cond = state.cond_bits
+        pending = state.pending_rule
+        fire_at = state.fire_at
+        new_gen = state.gen
+        can_fire = jnp.zeros(capacity, bool)
+        dirty = can_fire
+        fired_delete = can_fire
+
+    # --- 3. heartbeat wheel ------------------------------------------------
+    hb_mask = jnp.uint32(hb_phase_mask)
+    hb_on = active & (((hb_mask >> new_phase.astype(jnp.uint32)) & 1) == 1)
+    entered = hb_on & jnp.isinf(state.hb_due)
+    hb_fired = hb_on & (now >= state.hb_due)
+    hb_due = jnp.where(
+        ~hb_on,
+        INF,
+        jnp.where(hb_fired | entered, now + jnp.float32(hb_interval), state.hb_due),
+    )
+
+    new_state = RowState(
+        active=active,
+        phase=new_phase,
+        cond_bits=new_cond,
+        sel_bits=state.sel_bits,
+        has_deletion=state.has_deletion,
+        pending_rule=pending,
+        fire_at=fire_at,
+        hb_due=hb_due,
+        gen=new_gen,
+    )
+    return TickOutputs(
+        state=new_state,
+        dirty=dirty,
+        deleted=fired_delete,
+        hb_fired=hb_fired,
+        transitions=can_fire.sum(dtype=jnp.int32),
+    )
+
+
+class TickKernel:
+    """Compiled tick for one resource kind on one device (or data-sharded).
+
+    Holds the rule table on device and a jitted, state-donating tick. The
+    sharded multi-device variant lives in kwok_tpu.parallel.sharded_tick and
+    reuses `tick_body`.
+    """
+
+    def __init__(
+        self,
+        table: CompiledRules,
+        hb_interval: float = 30.0,
+        hb_phases: tuple[str, ...] = (),
+    ) -> None:
+        self.table = table
+        self.hb_interval = float(hb_interval)
+        mask = 0
+        for p in hb_phases:
+            mask |= 1 << table.space.phase_id(p)
+        self.hb_phase_mask = mask
+        self._rules = _rule_arrays(table)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _tick(state: RowState, now: jnp.ndarray, key: jax.Array) -> TickOutputs:
+            return tick_body(
+                state, now, key, self._rules, self.hb_interval, self.hb_phase_mask
+            )
+
+        self._tick = _tick
+        self._key = jax.random.PRNGKey(0)
+        self._step = 0
+
+    def __call__(self, state: RowState, now: float) -> TickOutputs:
+        self._step += 1
+        key = jax.random.fold_in(self._key, self._step)
+        return self._tick(state, jnp.float32(now), key)
+
+
+def to_device(state: RowState) -> RowState:
+    return jax.tree_util.tree_map(jnp.asarray, state)
+
+
+def to_host(out: Any) -> Any:
+    """Copy a pytree of device arrays to mutable host numpy arrays."""
+    return jax.tree_util.tree_map(lambda a: np.array(a), out)

@@ -1,0 +1,14 @@
+"""Device-mesh parallelism for the tick engine.
+
+The scale axis of this domain is OBJECT COUNT (SURVEY.md section 5.7): the
+honest analogue of data parallelism is sharding the row axis of the cluster
+state across TPU cores. There is no TP/PP/EP analogue — rows are independent
+except for the host-resolved pod->node managed-set lookup, which is encoded
+into per-row selector bits at ingest, so the sharded tick needs no
+cross-device gathers; only the transition counters are psum'd over ICI.
+"""
+
+from kwok_tpu.parallel.mesh import make_mesh, row_sharding
+from kwok_tpu.parallel.sharded_tick import ShardedTickKernel
+
+__all__ = ["make_mesh", "row_sharding", "ShardedTickKernel"]

@@ -1,0 +1,36 @@
+"""Mesh construction + sharding specs for the row axis."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS_AXIS = "rows"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over all (or the first n) local devices.
+
+    Cluster-state rows are independent, so a flat data axis is the right
+    topology; on a multi-host pod slice the axis simply spans hosts and the
+    only collective (counter psum) rides ICI.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (ROWS_AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(ROWS_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, mesh: Mesh) -> int:
+    d = mesh.devices.size
+    return ((n + d - 1) // d) * d

@@ -1,0 +1,90 @@
+"""shard_map'd tick over a jax.sharding.Mesh.
+
+Rows shard across the mesh's data axis; the rule table replicates (it is a
+few hundred bytes). Inside the shard the body is identical to the
+single-device kernel (kwok_tpu.ops.tick.tick_body); the only collective is a
+psum of the transition counter so every host sees the global rate — the
+replacement for the reference's per-batch elapsed logging
+(node_controller.go:193-196).
+
+Per-shard RNG: the key is folded with the shard index so delay samples are
+independent across shards yet reproducible.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from kwok_tpu.models.compiler import CompiledRules
+from kwok_tpu.ops.state import RowState, TickOutputs
+from kwok_tpu.ops.tick import _rule_arrays, tick_body
+from kwok_tpu.parallel.mesh import ROWS_AXIS, make_mesh, row_sharding
+
+
+class ShardedTickKernel:
+    """Tick for one resource kind, row-sharded over a device mesh.
+
+    Capacity must be a multiple of the mesh size (use
+    kwok_tpu.parallel.mesh.pad_to_multiple; inactive padding rows are free —
+    they match no rules).
+    """
+
+    def __init__(
+        self,
+        table: CompiledRules,
+        mesh=None,
+        hb_interval: float = 30.0,
+        hb_phases: tuple[str, ...] = (),
+    ) -> None:
+        self.table = table
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.hb_interval = float(hb_interval)
+        mask = 0
+        for p in hb_phases:
+            mask |= 1 << table.space.phase_id(p)
+        self.hb_phase_mask = mask
+        self._rules = _rule_arrays(table)
+
+        state_spec = RowState(*([P(ROWS_AXIS)] * len(RowState._fields)))
+        out_spec = TickOutputs(
+            state=state_spec,
+            dirty=P(ROWS_AXIS),
+            deleted=P(ROWS_AXIS),
+            hb_fired=P(ROWS_AXIS),
+            transitions=P(),
+        )
+
+        def shard_fn(state: RowState, now: jnp.ndarray, key: jax.Array) -> TickOutputs:
+            idx = jax.lax.axis_index(ROWS_AXIS)
+            local_key = jax.random.fold_in(key, idx)
+            out = tick_body(
+                state, now, local_key, self._rules, self.hb_interval, self.hb_phase_mask
+            )
+            return out._replace(
+                transitions=jax.lax.psum(out.transitions, ROWS_AXIS)
+            )
+
+        sharded = shard_map(
+            shard_fn,
+            mesh=self.mesh,
+            in_specs=(state_spec, P(), P()),
+            out_specs=out_spec,
+        )
+        self._tick = jax.jit(sharded, donate_argnums=(0,))
+        self._key = jax.random.PRNGKey(0)
+        self._step = 0
+
+    def place(self, state: RowState) -> RowState:
+        """Device-put a host state with row sharding."""
+        sh = row_sharding(self.mesh)
+        return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), state)
+
+    def __call__(self, state: RowState, now: float) -> TickOutputs:
+        self._step += 1
+        key = jax.random.fold_in(self._key, self._step)
+        return self._tick(state, jnp.float32(now), key)

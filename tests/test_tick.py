@@ -1,0 +1,233 @@
+"""Tick-kernel unit + property tests against the numpy reference oracle.
+
+Replicates the role of the reference's controller unit tests
+(pkg/kwok/controllers/node_controller_test.go, pod_controller_test.go): nodes
+become Ready, pods become Running, deletion emits deletes, unmanaged rows are
+untouched — but at the kernel level, plus randomized state-machine property
+tests the reference lacks.
+"""
+
+import numpy as np
+import pytest
+
+from kwok_tpu.models import compile_rules, default_rules
+from kwok_tpu.models.defaults import chaos_pod_rules
+from kwok_tpu.models.lifecycle import (
+    NODE_PHASES,
+    POD_PHASES,
+    Delay,
+    LifecycleRule,
+    ResourceKind,
+    StatusEffect,
+)
+from kwok_tpu.ops import TickKernel, new_row_state, reference_tick
+from kwok_tpu.ops.tick import to_host
+
+
+def node_table():
+    return compile_rules(default_rules(), ResourceKind.NODE)
+
+
+def pod_table():
+    return compile_rules(default_rules(), ResourceKind.POD)
+
+
+def seed_rows(state, n, phase=0, sel=1, deletion=False):
+    state.active[:n] = True
+    state.phase[:n] = phase
+    state.sel_bits[:n] = sel
+    state.has_deletion[:n] = deletion
+    return state
+
+
+def test_node_becomes_ready_one_tick():
+    table = node_table()
+    state = seed_rows(new_row_state(8), 5)
+    # row 5: unmanaged (sel_bits=0) — must never transition, the analogue of
+    # the untouched "xxxx" node in node_controller_test.go.
+    state.active[5] = True
+    state.sel_bits[5] = 0
+
+    kern = TickKernel(table, hb_interval=30.0, hb_phases=("Ready",))
+    out = to_host(kern(state, now=0.0))
+
+    ready = NODE_PHASES.phase_id("Ready")
+    assert (out.state.phase[:5] == ready).all()
+    assert out.dirty[:5].all()
+    assert int(out.transitions) == 5
+    # conditions: Ready=True, others False
+    assert (out.state.cond_bits[:5] == 0b000001).all()
+    # unmanaged row untouched
+    assert out.state.phase[5] == 0 and not out.dirty[5]
+    # heartbeat armed at now+interval, not fired yet
+    assert np.allclose(out.state.hb_due[:5], 30.0)
+    assert not out.hb_fired.any()
+
+
+def test_heartbeat_fires_on_schedule():
+    table = node_table()
+    kern = TickKernel(table, hb_interval=30.0, hb_phases=("Ready",))
+    state = seed_rows(new_row_state(4), 4)
+    out = kern(state, 0.0)
+    out = to_host(kern(out.state, 29.0))
+    assert not out.hb_fired.any()
+    out = to_host(kern(to_host(out).state, 30.5))
+    assert out.hb_fired[:4].all()
+    assert np.allclose(out.state.hb_due[:4], 60.5)
+
+
+def test_pod_lifecycle_run_then_delete():
+    table = pod_table()
+    kern = TickKernel(table)
+    state = seed_rows(new_row_state(4), 4)
+    out = to_host(kern(state, 0.0))
+    running = POD_PHASES.phase_id("Running")
+    assert (out.state.phase[:4] == running).all()
+    assert out.dirty[:4].all()
+    # conditions Initialized|Ready|ContainersReady
+    assert (out.state.cond_bits[:4] == 0b0111).all()
+
+    # mark deletionTimestamp on rows 0,1 (host ingest write)
+    st = out.state
+    st.has_deletion[:2] = True
+    out = to_host(kern(st, 1.0))
+    assert out.deleted[:2].all()
+    assert not out.deleted[2:].any()
+    gone = POD_PHASES.phase_id("Gone")
+    assert (out.state.phase[:2] == gone).all()
+    # Gone is terminal: next tick, nothing happens
+    out = to_host(kern(out.state, 2.0))
+    assert int(out.transitions) == 0
+
+
+def test_delayed_rule_fires_at_time():
+    rules = [
+        LifecycleRule(
+            name="slow-ready",
+            resource=ResourceKind.NODE,
+            from_phases=("Observed",),
+            delay=Delay.constant(10.0),
+            effect=StatusEffect(to_phase="Ready", conditions={"Ready": True}),
+        )
+    ]
+    table = compile_rules(rules, ResourceKind.NODE)
+    kern = TickKernel(table)
+    state = seed_rows(new_row_state(2), 2)
+    out = to_host(kern(state, 0.0))
+    assert int(out.transitions) == 0
+    assert np.allclose(out.state.fire_at[:2], 10.0)
+    out = to_host(kern(out.state, 9.99))
+    assert int(out.transitions) == 0
+    out = to_host(kern(out.state, 10.0))
+    assert int(out.transitions) == 2
+
+
+def test_rearm_on_context_change():
+    """A pending slow rule is superseded when deletion arrives (the kernel
+    analogue of deleteChan preempting lockChan, pod_controller.go:306-316)."""
+    rules = [
+        LifecycleRule(
+            name="pod-delete",
+            resource=ResourceKind.POD,
+            from_phases=("Pending", "Running"),
+            deletion=1,
+            effect=StatusEffect(to_phase="Gone", delete=True),
+        ),
+        LifecycleRule(
+            name="pod-running-slow",
+            resource=ResourceKind.POD,
+            from_phases=("Pending",),
+            delay=Delay.constant(100.0),
+            effect=StatusEffect(to_phase="Running"),
+        ),
+    ]
+    table = compile_rules(rules, ResourceKind.POD)
+    kern = TickKernel(table)
+    state = seed_rows(new_row_state(1), 1)
+    out = to_host(kern(state, 0.0))
+    assert out.state.pending_rule[0] == 1  # armed on slow rule
+    st = out.state
+    st.has_deletion[0] = True
+    out = to_host(kern(st, 1.0))
+    assert out.deleted[0]
+
+
+def test_exponential_delay_distribution():
+    rules = chaos_pod_rules(mean_run_seconds=50.0)
+    table = compile_rules(rules, ResourceKind.POD)
+    kern = TickKernel(table)
+    n = 20_000
+    state = seed_rows(new_row_state(n), n, phase=POD_PHASES.phase_id("Running"))
+    out = to_host(kern(state, 0.0))
+    # all armed on pod-complete with Exp(50) fire times
+    delays = out.state.fire_at[:n]
+    assert np.isfinite(delays).all()
+    assert abs(delays.mean() - 50.0) < 2.0  # ~50 +- few % at n=20k
+    assert delays.std() == pytest.approx(50.0, rel=0.1)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_property_matches_reference_oracle(seed):
+    """Randomized states + constant-delay rule sets: kernel == numpy oracle."""
+    rng = np.random.default_rng(seed)
+    phases = ("A", "B", "C", "D")
+    from kwok_tpu.models.lifecycle import PhaseSpace
+
+    space = PhaseSpace(phases=phases, conditions=("X", "Y", "Z"))
+    rules = []
+    for i in range(rng.integers(1, 6)):
+        rules.append(
+            LifecycleRule(
+                name=f"r{i}",
+                resource=ResourceKind.NODE,
+                from_phases=tuple(
+                    p for p in phases if rng.random() < 0.5
+                ) or (phases[0],),
+                deletion=int(rng.integers(-1, 2)),
+                selector="s" if rng.random() < 0.5 else None,
+                delay=Delay.constant(float(rng.integers(0, 3))),
+                effect=StatusEffect(
+                    to_phase=phases[int(rng.integers(0, 4))],
+                    conditions={"X": bool(rng.integers(0, 2))},
+                ),
+            )
+        )
+    table = compile_rules(rules, ResourceKind.NODE, space)
+
+    c = 64
+    state = new_row_state(c)
+    state.active[:] = rng.random(c) < 0.9
+    state.phase[:] = rng.integers(0, 4, c)
+    state.sel_bits[:] = rng.integers(0, 2, c)
+    state.has_deletion[:] = rng.random(c) < 0.3
+    kern = TickKernel(table, hb_interval=5.0, hb_phases=("B",))
+
+    ref_state = state
+    dev_state = state
+    for step, now in enumerate([0.0, 1.0, 2.5, 4.0, 7.0, 12.0]):
+        ref = reference_tick(
+            ref_state, now, table, hb_interval=5.0,
+            hb_phase_mask=1 << space.phase_id("B"),
+        )
+        dev = to_host(kern(dev_state, now))
+        for field in ("phase", "cond_bits", "pending_rule", "gen"):
+            np.testing.assert_array_equal(
+                getattr(ref.state, field),
+                getattr(dev.state, field),
+                err_msg=f"step {step} field {field}",
+            )
+        act = np.asarray(ref.state.active)
+        np.testing.assert_allclose(
+            np.where(act, ref.state.fire_at, 0),
+            np.where(act, dev.state.fire_at, 0),
+            err_msg=f"step {step} fire_at",
+        )
+        np.testing.assert_allclose(
+            np.where(act, ref.state.hb_due, 0),
+            np.where(act, dev.state.hb_due, 0),
+            err_msg=f"step {step} hb_due",
+        )
+        np.testing.assert_array_equal(ref.dirty & act, dev.dirty & act)
+        np.testing.assert_array_equal(ref.deleted & act, dev.deleted & act)
+        np.testing.assert_array_equal(ref.hb_fired & act, dev.hb_fired & act)
+        ref_state, dev_state = ref.state, dev.state
