@@ -1,0 +1,135 @@
+"""Headline benchmark: pod-phase transitions/sec at 1M pods x 10k nodes.
+
+Measures the sustained device-side transition throughput of the lifecycle
+engine: 1,010,000 rows (1M pods + 10k nodes) with a cyclic chaos rule set so
+transitions keep flowing, ticked back-to-back with simulated time advancing
+dt per tick. This is the batched replacement for the reference's per-object
+reconcile loops, whose implied end-to-end rate is O(10-100) transitions/s
+(BASELINE.md: 1,000 pods inside a 120 s CI gate, 16-way fan-out). We use
+100/s as the baseline denominator (the generous end of that range).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "transitions/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_PODS = 1_000_000
+N_NODES = 10_000
+MEAN_SECONDS = 5.0  # per-phase dwell time; cycle = 2 phases
+DT = 0.5  # simulated seconds per tick
+TICKS = 120
+WARMUP = 5
+REFERENCE_RATE = 100.0  # transitions/s, implied reference throughput
+
+
+def make_cyclic_rules():
+    """Pods cycle Running <-> Succeeded forever on exponential delays —
+    a steady-state churn workload (BASELINE.json config 3: 'custom Stage
+    delay distributions (Poisson arrivals, pod-chaos)')."""
+    from kwok_tpu.models.defaults import SEL_MANAGED, default_pod_rules
+    from kwok_tpu.models.lifecycle import (
+        Delay,
+        LifecycleRule,
+        ResourceKind,
+        StatusEffect,
+    )
+
+    rules = default_pod_rules()
+    rules.append(
+        LifecycleRule(
+            name="pod-complete",
+            resource=ResourceKind.POD,
+            from_phases=("Running",),
+            selector=SEL_MANAGED,
+            delay=Delay.exponential(MEAN_SECONDS),
+            effect=StatusEffect(
+                to_phase="Succeeded",
+                conditions={"Ready": False, "ContainersReady": False},
+            ),
+        )
+    )
+    rules.append(
+        LifecycleRule(
+            name="pod-restart",
+            resource=ResourceKind.POD,
+            from_phases=("Succeeded",),
+            selector=SEL_MANAGED,
+            delay=Delay.exponential(MEAN_SECONDS),
+            effect=StatusEffect(
+                to_phase="Running",
+                conditions={"Ready": True, "ContainersReady": True},
+            ),
+        )
+    )
+    return rules
+
+
+def main() -> None:
+    import jax
+
+    from kwok_tpu.models import compile_rules, default_rules
+    from kwok_tpu.models.lifecycle import ResourceKind
+    from kwok_tpu.ops import TickKernel, new_row_state
+    from kwok_tpu.ops.tick import to_device
+
+    platform = jax.devices()[0].platform
+
+    ptab = compile_rules(make_cyclic_rules(), ResourceKind.POD)
+    ntab = compile_rules(default_rules(), ResourceKind.NODE)
+
+    pods = new_row_state(N_PODS)
+    pods.active[:] = True
+    pods.sel_bits[:] = 0b11
+    nodes = new_row_state(N_NODES)
+    nodes.active[:] = True
+    nodes.sel_bits[:] = 0b11
+
+    pkern = TickKernel(ptab)
+    nkern = TickKernel(ntab, hb_interval=30.0, hb_sel_bit=1)
+
+    pstate = to_device(pods)
+    nstate = to_device(nodes)
+
+    now = 0.0
+    # warmup: compile + initial Pending->Running wave
+    for _ in range(WARMUP):
+        pout = pkern(pstate, now)
+        nout = nkern(nstate, now)
+        pstate, nstate = pout.state, nout.state
+        now += DT
+    _ = int(pout.transitions)  # sync
+
+    total = 0
+    t0 = time.perf_counter()
+    for _ in range(TICKS):
+        pout = pkern(pstate, now)
+        nout = nkern(nstate, now)
+        pstate, nstate = pout.state, nout.state
+        total += int(pout.transitions) + int(nout.transitions)
+        now += DT
+    elapsed = time.perf_counter() - t0
+
+    rate = total / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"pod-phase transitions/sec at {N_PODS} pods x {N_NODES} "
+                    f"nodes (device tick engine, {platform})"
+                ),
+                "value": round(rate, 1),
+                "unit": "transitions/s",
+                "vs_baseline": round(rate / REFERENCE_RATE, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -9,15 +9,19 @@ network base address.
 from __future__ import annotations
 
 import ipaddress
+import threading
 
 
 class IPPool:
+    """Thread-safe: get/put/use are called from patch-executor workers."""
+
     def __init__(self, cidr: str) -> None:
         self.net = ipaddress.ip_network(cidr, strict=False)
         self._base = int(self.net.network_address)
         self._next = 1  # skip the network address, like addIP starting at offset
         self._free: list[str] = []
         self._used: set[str] = set()
+        self._lock = threading.Lock()
 
     def contains(self, ip: str) -> bool:
         try:
@@ -26,27 +30,32 @@ class IPPool:
             return False
 
     def get(self) -> str:
-        while self._free:
-            ip = self._free.pop()
-            if ip not in self._used:
-                self._used.add(ip)
-                return ip
-        while True:
-            ip = str(ipaddress.ip_address(self._base + self._next))
-            self._next += 1
-            if ip not in self._used:
-                self._used.add(ip)
-                return ip
+        with self._lock:
+            while self._free:
+                ip = self._free.pop()
+                if ip not in self._used:
+                    self._used.add(ip)
+                    return ip
+            while True:
+                ip = str(ipaddress.ip_address(self._base + self._next))
+                self._next += 1
+                if ip not in self._used:
+                    self._used.add(ip)
+                    return ip
 
     def put(self, ip: str) -> None:
         """Recycle an IP (pod Deleted event, pod_controller.go:334-337).
         Out-of-CIDR IPs are rejected like the reference's Put."""
-        if self.contains(ip) and ip in self._used:
-            self._used.discard(ip)
-            self._free.append(ip)
+        if not self.contains(ip):
+            return
+        with self._lock:
+            if ip in self._used:
+                self._used.discard(ip)
+                self._free.append(ip)
 
     def use(self, ip: str) -> None:
         """Pin an IP observed in a pre-existing pod status
         (pod_controller.go:381-385). Out-of-CIDR IPs are ignored."""
         if self.contains(ip):
-            self._used.add(ip)
+            with self._lock:
+                self._used.add(ip)

@@ -1,0 +1,108 @@
+"""KubeClient protocol: the exact API surface the engine needs.
+
+The reference consumes client-go's typed clientset; the contract it actually
+exercises is list / watch / get / patch-status / merge-patch-metadata /
+delete (SURVEY.md section 3). Implementations:
+
+- tests/fake_apiserver.FakeKube — in-memory, the unit-test fixture (the
+  analogue of fake.NewSimpleClientset in node_controller_test.go:38)
+- kwok_tpu.edge.httpclient.HttpKubeClient — real apiserver over HTTP(S)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator, Protocol
+
+# Watch event types (k8s wire values).
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchEvent:
+    type: str
+    object: dict
+
+
+class WatchHandle(Protocol):
+    def __iter__(self) -> Iterator[WatchEvent]: ...
+    def stop(self) -> None: ...
+
+
+class KubeClient(Protocol):
+    """kind is the lowercase plural resource name: "nodes" | "pods"."""
+
+    def list(
+        self,
+        kind: str,
+        *,
+        field_selector: str | None = None,
+        label_selector: str | None = None,
+    ) -> list[dict]: ...
+
+    def watch(
+        self,
+        kind: str,
+        *,
+        field_selector: str | None = None,
+        label_selector: str | None = None,
+    ) -> WatchHandle: ...
+
+    def get(self, kind: str, namespace: str | None, name: str) -> dict | None: ...
+
+    def patch_status(
+        self, kind: str, namespace: str | None, name: str, patch: dict
+    ) -> dict | None:
+        """Strategic-merge patch of the status subresource
+        (PatchStatus / Patch ..., "status" in the reference)."""
+        ...
+
+    def patch_meta(
+        self, kind: str, namespace: str | None, name: str, patch: dict
+    ) -> dict | None:
+        """JSON merge patch of the main resource (finalizer strip,
+        pod_controller.go:45)."""
+        ...
+
+    def delete(
+        self, kind: str, namespace: str | None, name: str, grace_seconds: int = 0
+    ) -> None: ...
+
+
+def obj_key(obj: dict) -> tuple[str, str]:
+    meta = obj.get("metadata") or {}
+    return (meta.get("namespace") or "", meta.get("name") or "")
+
+
+def match_field_selector(obj: dict, field_selector: str | None) -> bool:
+    """Minimal fieldSelector support: the forms the engine uses
+    (spec.nodeName!=VALUE / spec.nodeName=VALUE, comma-joined;
+    pod_controller.go:47, :373)."""
+    if not field_selector:
+        return True
+    for term in field_selector.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "!=" in term:
+            path, val = term.split("!=", 1)
+            if _field(obj, path) == val:
+                return False
+        elif "=" in term:
+            path, val = term.split("==" if "==" in term else "=", 1)
+            if _field(obj, path.rstrip("=")) != val:
+                return False
+    return True
+
+
+def _field(obj: dict, path: str) -> str:
+    cur: Any = obj
+    for part in path.strip().split("."):
+        if not isinstance(cur, dict):
+            return ""
+        cur = cur.get(part)
+    return "" if cur is None else str(cur)

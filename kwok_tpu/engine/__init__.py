@@ -1,0 +1,12 @@
+"""Host controller: wires watch-ingest -> device tick -> patch-egress.
+
+The replacement for pkg/kwok/controllers' Controller/NodeController/
+PodController goroutine machinery: one ingest queue, one tick thread owning
+all state mutation (SURVEY.md section 5.2: "host ingest queue needs one
+lock"), and a bounded-parallelism patch executor (the analogue of the
+reference's 16-way parallelTasks pools, controller.go:118-136).
+"""
+
+from kwok_tpu.engine.engine import ClusterEngine, EngineConfig
+
+__all__ = ["ClusterEngine", "EngineConfig"]

@@ -1,0 +1,685 @@
+"""ClusterEngine: the TPU-backed fake kubelet.
+
+Architecture (replaces pkg/kwok/controllers/controller.go + node_controller.go
++ pod_controller.go):
+
+  watch threads ──> ingest queue ──> tick thread ──> patch executor
+                                      │    ▲
+                                      ▼    │
+                               device RowState (resident)
+
+- Watch threads re-watch forever with 5s backoff on error
+  (node_controller.go:241-254 semantics).
+- The tick thread is the ONLY mutator of engine state: it drains the ingest
+  queue into staged row writes, flushes them to the device, runs the jitted
+  tick, and turns the dirty/deleted/heartbeat masks into patch jobs.
+- The executor bounds API fan-out (default 16, matching the reference's
+  parallelTasks pools, controller.go:118-136).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from kwok_tpu.edge.ippool import IPPool
+from kwok_tpu.edge.kubeclient import ADDED, DELETED, KubeClient
+from kwok_tpu.edge.merge import node_status_patch_needed, pod_status_patch_needed
+from kwok_tpu.edge.render import (
+    now_rfc3339,
+    render_node_heartbeat,
+    render_node_status,
+    render_pod_status,
+    rfc3339,
+)
+from kwok_tpu.edge.selectors import parse_selector
+from kwok_tpu.models import compile_rules, default_node_rules, default_pod_rules
+from kwok_tpu.models.defaults import SEL_HEARTBEAT, SEL_MANAGED, SEL_ON_MANAGED_NODE
+from kwok_tpu.models.lifecycle import (
+    NODE_PHASES,
+    POD_PHASES,
+    LifecycleRule,
+    ResourceKind,
+)
+from kwok_tpu.ops.state import RowState, grow as grow_state, new_row_state
+from kwok_tpu.ops.tick import TickKernel, to_host
+from kwok_tpu.ops.updates import UpdateBuffer
+from kwok_tpu.engine.rowpool import RowPool
+
+logger = logging.getLogger("kwok_tpu.engine")
+
+_NODE_READY_BITS = 1 << NODE_PHASES.condition_bit("Ready")
+_POD_PHASE_IDS = {name: i for i, name in enumerate(POD_PHASES.phases)}
+_PENDING = POD_PHASES.phase_id("Pending")
+_NODE_READY = NODE_PHASES.phase_id("Ready")
+_NODE_OBSERVED = NODE_PHASES.phase_id("Observed")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Mirrors KwokConfigurationOptions
+    (pkg/apis/v1alpha1/kwok_configuration_types.go:30-81)."""
+
+    manage_all_nodes: bool = False
+    manage_nodes_with_annotation_selector: str = ""
+    manage_nodes_with_label_selector: str = ""
+    disregard_status_with_annotation_selector: str = ""
+    disregard_status_with_label_selector: str = ""
+    cidr: str = "10.0.0.1/24"
+    node_ip: str = "196.168.0.1"
+    enable_cni: bool = False  # accepted for parity; real CNI is out of scope
+    tick_interval: float = 0.05
+    heartbeat_interval: float = 30.0
+    parallelism: int = 16
+    initial_capacity: int = 4096
+    node_rules: list[LifecycleRule] | None = None
+    pod_rules: list[LifecycleRule] | None = None
+    use_mesh: bool = False
+
+    def validate(self) -> None:
+        if not (
+            self.manage_all_nodes
+            or self.manage_nodes_with_annotation_selector
+            or self.manage_nodes_with_label_selector
+        ):
+            # controller.go:98 "no nodes are managed"
+            raise ValueError("no nodes are managed")
+
+
+def _selector_bits(table, extra: tuple[str, ...]) -> dict[str, int]:
+    names = list(table.selector_names)
+    for e in extra:
+        if e not in names:
+            names.append(e)
+    if len(names) > 32:
+        raise ValueError("too many selector bits")
+    return {n: i for i, n in enumerate(names)}
+
+
+class _Kind:
+    """Per-resource-kind engine state (device arrays + host bookkeeping)."""
+
+    def __init__(self, table, kernel_factory, capacity: int):
+        self.table = table
+        self.kernel_factory = kernel_factory
+        self.kernel = kernel_factory()
+        self.capacity = capacity
+        self.state: RowState = new_row_state(capacity)  # host until start()
+        self.pool = RowPool(capacity)
+        self.buffer = UpdateBuffer(capacity)
+        self.phase_h = np.zeros(capacity, np.int32)
+        self.cond_h = np.zeros(capacity, np.uint32)
+
+    def grow(self, new_capacity: int) -> None:
+        host = to_host(self.state)
+        host = grow_state(host, new_capacity)
+        self.state = host
+        self.capacity = new_capacity
+        self.pool.grow(new_capacity)
+        self.buffer.capacity = new_capacity
+        extra = new_capacity - self.phase_h.shape[0]
+        self.phase_h = np.concatenate([self.phase_h, np.zeros(extra, np.int32)])
+        self.cond_h = np.concatenate([self.cond_h, np.zeros(extra, np.uint32)])
+
+
+class ClusterEngine:
+    def __init__(self, client: KubeClient, config: EngineConfig) -> None:
+        config.validate()
+        self.client = client
+        self.config = config
+        self.ippool = IPPool(config.cidr)
+
+        self._manage_annotation = parse_selector(
+            config.manage_nodes_with_annotation_selector
+        )
+        self._disregard_annotation = parse_selector(
+            config.disregard_status_with_annotation_selector
+        )
+        self._disregard_label = parse_selector(
+            config.disregard_status_with_label_selector
+        )
+
+        node_rules = (
+            config.node_rules if config.node_rules is not None else default_node_rules()
+        )
+        pod_rules = (
+            config.pod_rules if config.pod_rules is not None else default_pod_rules()
+        )
+        ntab = compile_rules(node_rules, ResourceKind.NODE)
+        ptab = compile_rules(pod_rules, ResourceKind.POD)
+        self.node_bits = _selector_bits(ntab, (SEL_MANAGED, SEL_HEARTBEAT))
+        self.pod_bits = _selector_bits(ptab, (SEL_MANAGED, SEL_ON_MANAGED_NODE))
+
+        hb_bit = self.node_bits[SEL_HEARTBEAT]
+        if config.use_mesh:
+            from kwok_tpu.parallel import ShardedTickKernel, make_mesh
+            from kwok_tpu.parallel.mesh import pad_to_multiple
+
+            mesh = make_mesh()
+            cap = pad_to_multiple(config.initial_capacity, mesh)
+            node_kf = lambda: ShardedTickKernel(
+                ntab, mesh=mesh,
+                hb_interval=config.heartbeat_interval, hb_sel_bit=hb_bit,
+            )
+            pod_kf = lambda: ShardedTickKernel(ptab, mesh=mesh)
+        else:
+            cap = config.initial_capacity
+            node_kf = lambda: TickKernel(
+                ntab, hb_interval=config.heartbeat_interval, hb_sel_bit=hb_bit
+            )
+            pod_kf = lambda: TickKernel(ptab)
+
+        self.nodes = _Kind(ntab, node_kf, cap)
+        self.pods = _Kind(ptab, pod_kf, cap)
+
+        self.node_has: set[str] = set()  # nodesSets (need-heartbeat membership)
+        self.pods_by_node: dict[str, set[tuple[str, str]]] = {}
+
+        self._epoch = time.time()
+        self.start_time = rfc3339(None)
+        self._q: "queue.Queue" = queue.Queue()
+        self._watches: dict[str, object] = {}  # kind -> current watch handle
+        self._threads: list[threading.Thread] = []
+        self._running = False
+        self._executor: ThreadPoolExecutor | None = None
+        self._ip_lock = threading.Lock()
+        self._metrics_lock = threading.Lock()
+        self.metrics = {
+            "transitions_total": 0,
+            "status_patches_total": 0,
+            "heartbeats_total": 0,
+            "deletes_total": 0,
+            "watch_events_total": 0,
+            "ticks_total": 0,
+            "tick_seconds_sum": 0.0,
+            "nodes_managed": 0,
+            "pods_managed": 0,
+        }
+
+    def _inc(self, name: str, v=1) -> None:
+        with self._metrics_lock:
+            self.metrics[name] += v
+
+    # ------------------------------------------------------------------ time
+
+    def _now(self) -> float:
+        return time.time() - self._epoch
+
+    # ------------------------------------------------------- selector checks
+
+    def _node_need_heartbeat(self, node: dict) -> bool:
+        """needHeartbeat = nodeSelectorFunc (controller.go:81-101). Label
+        selector is pushed down into the watch, so anything we receive in
+        that mode already matches."""
+        if self.config.manage_all_nodes:
+            return True
+        if self._manage_annotation is not None:
+            annotations = (node.get("metadata") or {}).get("annotations") or {}
+            return self._manage_annotation.matches(annotations)
+        if self.config.manage_nodes_with_label_selector:
+            return True
+        return False
+
+    def _disregard(self, obj: dict) -> bool:
+        meta = obj.get("metadata") or {}
+        if self._disregard_annotation is not None and (meta.get("annotations") or {}):
+            if self._disregard_annotation.matches(meta["annotations"]):
+                return True
+        if self._disregard_label is not None and (meta.get("labels") or {}):
+            if self._disregard_label.matches(meta["labels"]):
+                return True
+        return False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._running = True
+        self._executor = ThreadPoolExecutor(
+            max_workers=self.config.parallelism, thread_name_prefix="kwok-patch"
+        )
+        # move state to device (sharded placement if the kernel supports it)
+        for k in (self.nodes, self.pods):
+            if hasattr(k.kernel, "place"):
+                k.state = k.kernel.place(k.state)
+
+        node_label_sel = self.config.manage_nodes_with_label_selector or None
+        # Each watch thread registers its watch FIRST, then lists and emits a
+        # resync marker — so events in the register/list gap are covered, and
+        # every re-watch after an error resyncs (the reference's watch-then-
+        # list ordering, node_controller.go:121-143, made gap-proof).
+        self._spawn_watch("nodes", label_selector=node_label_sel)
+        self._spawn_watch("pods", field_selector="spec.nodeName!=")
+
+        t = threading.Thread(target=self._tick_loop, name="kwok-tick", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False
+        for w in list(self._watches.values()):
+            try:
+                w.stop()
+            except Exception:
+                pass
+        self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        if self._executor:
+            self._executor.shutdown(wait=True)
+
+    def _spawn_watch(self, kind: str, **sel) -> None:
+        opts = {k: v for k, v in sel.items() if v}
+
+        def loop():
+            while self._running:
+                try:
+                    w = self.client.watch(kind, **opts)
+                    self._watches[kind] = w  # replaces any dead handle
+                    # list AFTER the watch registers: the snapshot + resync
+                    # marker covers anything missed before/while down
+                    objs = self.client.list(kind, **opts)
+                    for obj in objs:
+                        self._q.put((kind, ADDED, obj))
+                    self._q.put((kind, "RESYNC", objs))
+                    for ev in w:
+                        self._q.put((kind, ev.type, ev.object))
+                    if not self._running:
+                        return
+                except Exception as e:  # re-watch with backoff
+                    if not self._running:
+                        return
+                    logger.warning("watch %s failed: %s; retrying in 5s", kind, e)
+                    time.sleep(5)
+
+        t = threading.Thread(target=loop, name=f"kwok-watch-{kind}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # ---------------------------------------------------------------- ingest
+
+    def _ingest(self, kind: str, type_: str, obj) -> None:
+        self._inc("watch_events_total")
+        if type_ == "RESYNC":
+            self._resync(kind, obj)
+            return
+        if kind == "nodes":
+            if type_ == DELETED:
+                self._node_deleted(obj)
+            else:
+                self._node_upsert(obj)
+        else:
+            if type_ == DELETED:
+                self._pod_deleted(obj)
+            else:
+                self._pod_upsert(obj)
+
+    def _resync(self, kind: str, objs: list[dict]) -> None:
+        """Free rows for objects that vanished while the watch was down."""
+        if kind == "nodes":
+            seen = {(o.get("metadata") or {}).get("name") for o in objs}
+            k = self.nodes
+            stale = [key for key in k.pool.keys() if key not in seen]
+            for name in stale:
+                self._node_deleted({"metadata": {"name": name}})
+        else:
+            seen = {
+                (
+                    (o.get("metadata") or {}).get("namespace") or "default",
+                    (o.get("metadata") or {}).get("name"),
+                )
+                for o in objs
+            }
+            k = self.pods
+            stale = [key for key in k.pool.keys() if key not in seen]
+            for ns, name in stale:
+                self._pod_deleted({"metadata": {"namespace": ns, "name": name}})
+
+    def _node_upsert(self, node: dict) -> None:
+        meta = node.get("metadata") or {}
+        name = meta.get("name")
+        if not name:
+            return
+        # Once a node enters the managed set it stays until Deleted
+        # (nodesSets has no removal on Modified, node_controller.go:256-268).
+        need_hb = self._node_need_heartbeat(node) or name in self.node_has
+        k = self.nodes
+        idx = k.pool.lookup(name)
+        if not need_hb and idx is None:
+            return  # never entered the managed set (WatchNodes Added gate)
+        need_lock = not self._disregard(node)
+        bits = 0
+        if need_hb:
+            bits |= 1 << self.node_bits[SEL_HEARTBEAT]
+            if need_lock:
+                bits |= 1 << self.node_bits[SEL_MANAGED]
+        new_row = idx is None
+        if new_row:
+            if k.pool.full:
+                self._grow(k)
+            idx = k.pool.acquire(name)
+            phase = self._node_phase_from_status(node)
+            k.buffer.stage_init(
+                idx, True, phase=phase, cond_bits=_NODE_READY_BITS,
+                sel_bits=bits, has_deletion=False,
+            )
+            k.phase_h[idx] = phase
+            k.cond_h[idx] = _NODE_READY_BITS
+        else:
+            k.buffer.stage_update(idx, bits, False)
+        k.pool.meta[idx].update(name=name, obj=node)
+        if need_hb and name not in self.node_has:
+            self.node_has.add(name)
+            self._update_pods_on_node(name)
+        # repair: reference re-locks on every event with no-op suppression
+        # (LockNode from WatchNodes Added|Modified)
+        if need_hb and need_lock and k.phase_h[idx] == _NODE_READY:
+            current = node.get("status") or {}
+            rendered = render_node_status(
+                node, int(k.cond_h[idx]), self.config.node_ip,
+                now_rfc3339(), self.start_time,
+            )
+            if node_status_patch_needed(current, rendered):
+                self._submit(self._patch_node_status, name, idx)
+
+    def _node_deleted(self, node: dict) -> None:
+        name = (node.get("metadata") or {}).get("name")
+        k = self.nodes
+        idx = k.pool.release(name)
+        if idx is not None:
+            k.buffer.stage_init(idx, False)
+        if name in self.node_has:
+            self.node_has.discard(name)
+            self._update_pods_on_node(name)
+
+    def _node_phase_from_status(self, node: dict) -> int:
+        for cond in (node.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "Ready" and cond.get("status") == "True":
+                return _NODE_READY
+        return _NODE_OBSERVED
+
+    def _pod_bits(self, pod_meta: dict) -> int:
+        nh = pod_meta.get("node") in self.node_has
+        bits = 0
+        if nh:
+            bits |= 1 << self.pod_bits[SEL_ON_MANAGED_NODE]
+            if not pod_meta.get("disregard"):
+                bits |= 1 << self.pod_bits[SEL_MANAGED]
+        return bits
+
+    def _pod_upsert(self, pod: dict) -> None:
+        meta = pod.get("metadata") or {}
+        name = meta.get("name")
+        ns = meta.get("namespace") or "default"
+        if not name:
+            return
+        key = (ns, name)
+        node_name = (pod.get("spec") or {}).get("nodeName") or ""
+        if not node_name:
+            return
+        k = self.pods
+        idx = k.pool.lookup(key)
+        new_row = idx is None
+        if new_row:
+            if k.pool.full:
+                self._grow(k)
+            idx = k.pool.acquire(key)
+        m = k.pool.meta[idx]
+        m.update(
+            name=name,
+            namespace=ns,
+            node=node_name,
+            disregard=self._disregard(pod),
+            obj=pod,
+            finalizers=bool(meta.get("finalizers")),
+        )
+        status = pod.get("status") or {}
+        pod_ip = status.get("podIP")
+        if pod_ip and not self.config.enable_cni and self.ippool.contains(pod_ip):
+            self.ippool.use(pod_ip)
+            m["podIP"] = pod_ip
+        has_del = "deletionTimestamp" in meta
+        bits = self._pod_bits(m)
+        self.pods_by_node.setdefault(node_name, set()).add(key)
+        if new_row:
+            phase = _POD_PHASE_IDS.get(status.get("phase") or "Pending", _PENDING)
+            cond = 0
+            for c in status.get("conditions") or []:
+                t = c.get("type")
+                if t in POD_PHASES.conditions and c.get("status") == "True":
+                    cond |= 1 << POD_PHASES.condition_bit(t)
+            k.buffer.stage_init(
+                idx, True, phase=phase, cond_bits=cond, sel_bits=bits,
+                has_deletion=has_del,
+            )
+            k.phase_h[idx] = phase
+            k.cond_h[idx] = cond
+        else:
+            k.buffer.stage_update(idx, bits, has_del)
+        # repair path (LockPod on every event + computePatchData suppression)
+        managed = bool(bits >> self.pod_bits[SEL_MANAGED] & 1)
+        if managed and not has_del and k.phase_h[idx] != _PENDING:
+            rendered = self._render_pod(idx)
+            if rendered is not None and pod_status_patch_needed(status, rendered):
+                self._submit(self._patch_pod_status, key, idx)
+
+    def _pod_deleted(self, pod: dict) -> None:
+        meta = pod.get("metadata") or {}
+        key = (meta.get("namespace") or "default", meta.get("name"))
+        k = self.pods
+        idx = k.pool.lookup(key)
+        if idx is None:
+            return
+        m = k.pool.meta[idx]
+        ip = m.get("podIP") or (pod.get("status") or {}).get("podIP")
+        if ip and not self.config.enable_cni:
+            self.ippool.put(ip)  # recycle (pod_controller.go:334-337)
+        node_name = m.get("node")
+        if node_name and node_name in self.pods_by_node:
+            self.pods_by_node[node_name].discard(key)
+        k.pool.release(key)
+        k.buffer.stage_init(idx, False)
+
+    def _update_pods_on_node(self, node_name: str) -> None:
+        """Re-evaluate pods bound to a node whose managed-ness changed
+        (LockPodsOnNode wiring, controller.go:113-115)."""
+        k = self.pods
+        for key in self.pods_by_node.get(node_name, set()):
+            idx = k.pool.lookup(key)
+            if idx is None:
+                continue
+            m = k.pool.meta[idx]
+            has_del = "deletionTimestamp" in (m.get("obj", {}).get("metadata") or {})
+            k.buffer.stage_update(idx, self._pod_bits(m), has_del)
+
+    # ------------------------------------------------------------------ grow
+
+    def _grow(self, k: _Kind) -> None:
+        new_cap = max(k.capacity * 2, 1024)
+        if hasattr(k.kernel, "mesh"):
+            from kwok_tpu.parallel.mesh import pad_to_multiple
+
+            new_cap = pad_to_multiple(new_cap, k.kernel.mesh)
+        logger.info("growing row pool %d -> %d", k.capacity, new_cap)
+        k.grow(new_cap)
+        if hasattr(k.kernel, "place"):
+            k.state = k.kernel.place(k.state)
+
+    # ------------------------------------------------------------- tick loop
+
+    def _tick_loop(self) -> None:
+        interval = self.config.tick_interval
+        while self._running:
+            deadline = time.monotonic() + interval
+            # drain ingest until the next tick is due
+            while True:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    item = self._q.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if item is None:
+                    if not self._running:
+                        return
+                    continue
+                self._ingest_safe(*item)
+                # keep draining whatever is immediately available
+                while True:
+                    try:
+                        item = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        if not self._running:
+                            return
+                        continue
+                    self._ingest_safe(*item)
+            try:
+                self.tick_once()
+            except Exception:
+                logger.exception("tick failed")
+
+    def _ingest_safe(self, kind, type_, obj) -> None:
+        """One malformed event must not kill the tick thread."""
+        try:
+            self._ingest(kind, type_, obj)
+        except Exception:
+            logger.exception("ingest failed for %s %s", kind, type_)
+
+    def tick_once(self) -> None:
+        """One engine step: flush staged writes, run the kernel, emit."""
+        t0 = time.perf_counter()
+        now = self._now()
+        now_str = now_rfc3339()
+        for k, kind in ((self.nodes, "nodes"), (self.pods, "pods")):
+            if k.buffer.pending:
+                k.state = k.buffer.flush(k.state)
+            elif len(k.pool) == 0:
+                continue
+            out = k.kernel(k.state, now)
+            k.state = out.state
+            n_trans = int(out.transitions)
+            n_hb = int(out.heartbeats)
+            if n_trans:
+                self._inc("transitions_total", n_trans)
+            if n_trans or n_hb:
+                # D2H only when something actually fired: phase/cond change
+                # exclusively via transitions, so the mirrors stay valid on
+                # quiet ticks.
+                dirty = np.asarray(out.dirty)
+                deleted = np.asarray(out.deleted)
+                hb = np.asarray(out.hb_fired)
+                k.phase_h = np.array(out.state.phase)
+                k.cond_h = np.array(out.state.cond_bits)
+                self._emit(kind, k, dirty, deleted, hb, now_str)
+        with self._metrics_lock:
+            self.metrics["nodes_managed"] = len(self.nodes.pool)
+            self.metrics["pods_managed"] = len(self.pods.pool)
+            self.metrics["ticks_total"] += 1
+            self.metrics["tick_seconds_sum"] += time.perf_counter() - t0
+
+    # ------------------------------------------------------------------ emit
+
+    def _submit(self, fn, *args) -> None:
+        if self._executor is None:
+            fn(*args)  # synchronous mode (tests may call tick_once directly)
+        else:
+            self._executor.submit(self._safe, fn, *args)
+
+    @staticmethod
+    def _safe(fn, *args) -> None:
+        try:
+            fn(*args)
+        except Exception:
+            logger.exception("patch job failed")
+
+    def _emit(self, kind, k, dirty, deleted, hb, now_str) -> None:
+        if kind == "nodes":
+            for idx in np.nonzero(dirty)[0]:
+                name = k.pool.key_of(int(idx))
+                if name is not None:
+                    self._submit(self._patch_node_status, name, int(idx))
+            for idx in np.nonzero(hb)[0]:
+                name = k.pool.key_of(int(idx))
+                if name is not None:
+                    self._submit(self._heartbeat_node, name, int(idx), now_str)
+        else:
+            for idx in np.nonzero(dirty)[0]:
+                key = k.pool.key_of(int(idx))
+                if key is not None:
+                    self._submit(self._patch_pod_status, key, int(idx))
+            for idx in np.nonzero(deleted)[0]:
+                key = k.pool.key_of(int(idx))
+                if key is not None:
+                    self._submit(self._delete_pod, key, int(idx))
+
+    def _patch_node_status(self, name: str, idx: int) -> None:
+        k = self.nodes
+        m = k.pool.meta[idx]
+        if not m:
+            return
+        node = m.get("obj") or {}
+        current = node.get("status") or {}
+        rendered = render_node_status(
+            node, int(k.cond_h[idx]), self.config.node_ip,
+            now_rfc3339(), self.start_time,
+        )
+        if not node_status_patch_needed(current, rendered):
+            return
+        self.client.patch_status("nodes", None, name, {"status": rendered})
+        self._inc("status_patches_total")
+
+    def _heartbeat_node(self, name: str, idx: int, now_str: str) -> None:
+        k = self.nodes
+        rendered = render_node_heartbeat(int(k.cond_h[idx]), now_str, self.start_time)
+        self.client.patch_status("nodes", None, name, {"status": rendered})
+        self._inc("heartbeats_total")
+
+    def _render_pod(self, idx: int):
+        k = self.pods
+        m = k.pool.meta[idx]
+        if not m or "obj" not in m:
+            return None
+        phase_name = POD_PHASES.phases[int(k.phase_h[idx])]
+        if phase_name == "Gone":
+            return None
+        with self._ip_lock:  # check+allocate must be atomic across workers
+            ip = m.get("podIP")
+            if not ip:
+                ip = self.ippool.get()
+                m["podIP"] = ip
+        return render_pod_status(
+            m["obj"], phase_name, int(k.cond_h[idx]), self.config.node_ip, ip
+        )
+
+    def _patch_pod_status(self, key, idx: int) -> None:
+        k = self.pods
+        m = k.pool.meta[idx]
+        if not m:
+            return
+        rendered = self._render_pod(idx)
+        if rendered is None:
+            return
+        current = (m.get("obj") or {}).get("status") or {}
+        if not pod_status_patch_needed(current, rendered):
+            return
+        ns, name = key
+        self.client.patch_status("pods", ns, name, {"status": rendered})
+        self._inc("status_patches_total")
+
+    def _delete_pod(self, key, idx: int) -> None:
+        """Finalizer strip + grace-0 delete (DeletePod,
+        pod_controller.go:155-183)."""
+        ns, name = key
+        m = self.pods.pool.meta[idx]
+        if m and m.get("finalizers"):
+            self.client.patch_meta("pods", ns, name, {"metadata": {"finalizers": None}})
+        self.client.delete("pods", ns, name, grace_seconds=0)
+        self._inc("deletes_total")

@@ -1,0 +1,72 @@
+"""Host-side row bookkeeping: name <-> row index, metadata, free list.
+
+Dynamic strings never reach the device (SURVEY.md "Hard parts"): objects are
+interned to row indices at ingest; freed rows are recycled like the
+reference's ipPool (pkg/kwok/controllers/utils.go:52-117).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class RowPool:
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._by_key: dict[Any, int] = {}
+        self._key_by_idx: list[Any] = [None] * capacity
+        self.meta: list[dict | None] = [None] * capacity
+        self._free: list[int] = []
+        self._high = 0  # rows [0, high) have been used at least once
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    def lookup(self, key: Any) -> int | None:
+        return self._by_key.get(key)
+
+    @property
+    def full(self) -> bool:
+        return not self._free and self._high >= self.capacity
+
+    def acquire(self, key: Any) -> int:
+        existing = self._by_key.get(key)
+        if existing is not None:
+            return existing
+        if self._free:
+            idx = self._free.pop()
+        else:
+            if self._high >= self.capacity:
+                raise IndexError("row pool full; grow first")
+            idx = self._high
+            self._high += 1
+        self._by_key[key] = idx
+        self._key_by_idx[idx] = key
+        self.meta[idx] = {}
+        return idx
+
+    def release(self, key: Any) -> int | None:
+        idx = self._by_key.pop(key, None)
+        if idx is None:
+            return None
+        self._key_by_idx[idx] = None
+        self.meta[idx] = None
+        self._free.append(idx)
+        return idx
+
+    def key_of(self, idx: int) -> Any:
+        return self._key_by_idx[idx]
+
+    def grow(self, new_capacity: int) -> None:
+        if new_capacity <= self.capacity:
+            return
+        extra = new_capacity - self.capacity
+        self._key_by_idx.extend([None] * extra)
+        self.meta.extend([None] * extra)
+        self.capacity = new_capacity
+
+    def keys(self):
+        return self._by_key.keys()
+
+    def items(self):
+        return self._by_key.items()

@@ -25,10 +25,19 @@ from kwok_tpu.models.lifecycle import (
     StatusEffect,
 )
 
-# Selector names resolved by the host at ingest (kwok_tpu.engine): bit set
-# when the object passes the manage-selectors AND is not excluded by the
-# disregard-selectors (controller.go:81-111 semantics).
+# Selector names resolved by the host at ingest (kwok_tpu.engine):
+# - "managed": passes the manage-selectors AND is not excluded by the
+#   disregard-selectors (controller.go:81-111 + needLockNode/needLockPod).
+#   For pods this additionally requires the bound node to be managed
+#   (NodeHasFunc wiring, controller.go:137).
+# - "on-managed-node" (pods): the bound node is managed, regardless of the
+#   pod's own disregard annotations — the deletion path uses this
+#   (pod_controller.go:306-316 gates deleteChan on nodeHasFunc only).
+# - "heartbeat" (nodes): passes the manage-selectors (needHeartbeat,
+#   node_controller.go:205-207); heartbeats ignore disregard.
 SEL_MANAGED = "managed"
+SEL_ON_MANAGED_NODE = "on-managed-node"
+SEL_HEARTBEAT = "heartbeat"
 
 
 def default_node_rules(ready_delay: Delay | None = None) -> list[LifecycleRule]:
@@ -64,7 +73,7 @@ def default_pod_rules(running_delay: Delay | None = None) -> list[LifecycleRule]
             resource=ResourceKind.POD,
             from_phases=("Pending", "Running", "Succeeded", "Failed", "Terminating"),
             deletion=DELETION_PRESENT,
-            selector=SEL_MANAGED,
+            selector=SEL_ON_MANAGED_NODE,
             delay=Delay.constant(0.0),
             effect=StatusEffect(to_phase="Gone", delete=True),
         ),

@@ -40,6 +40,7 @@ def reference_tick(
     table: CompiledRules,
     hb_interval: float = 30.0,
     hb_phase_mask: int = 0,
+    hb_sel_bit: int = -1,
     u: np.ndarray | None = None,
 ) -> TickOutputs:
     c = state.capacity
@@ -89,8 +90,15 @@ def reference_tick(
                 dirty[i] = True
             pending[i] = -1
             fire_at[i] = np.inf
-        # 3. heartbeat
-        hb_on = ((hb_phase_mask >> int(phase[i])) & 1) == 1
+        # 3. heartbeat (same gating as tick_body)
+        if hb_phase_mask == 0 and hb_sel_bit < 0:
+            hb_on = False
+        else:
+            hb_on = True
+            if hb_phase_mask != 0:
+                hb_on = ((hb_phase_mask >> int(phase[i])) & 1) == 1
+            if hb_on and hb_sel_bit >= 0:
+                hb_on = ((int(state.sel_bits[i]) >> hb_sel_bit) & 1) == 1
         if not hb_on:
             hb_due[i] = np.inf
         else:
@@ -117,4 +125,5 @@ def reference_tick(
         deleted=deleted,
         hb_fired=hb_fired,
         transitions=np.int32(transitions),
+        heartbeats=np.int32(int(hb_fired.sum())),
     )

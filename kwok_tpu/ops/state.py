@@ -60,6 +60,7 @@ class TickOutputs(NamedTuple):
     deleted: np.ndarray  # bool[C] — fired a delete-effect rule: needs DELETE
     hb_fired: np.ndarray  # bool[C] — heartbeat due: needs heartbeat patch
     transitions: np.ndarray  # int32 scalar — transitions this tick
+    heartbeats: np.ndarray  # int32 scalar — heartbeat firings this tick
 
 
 def new_row_state(capacity: int, xp=np) -> RowState:

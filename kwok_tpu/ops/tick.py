@@ -62,6 +62,7 @@ def tick_body(
     rules: dict[str, jnp.ndarray],
     hb_interval: float,
     hb_phase_mask: int,
+    hb_sel_bit: int = -1,
 ) -> TickOutputs:
     """Pure tick function — shared by the single-device jit and shard_map."""
     capacity = state.active.shape[0]
@@ -135,8 +136,22 @@ def tick_body(
         fired_delete = can_fire
 
     # --- 3. heartbeat wheel ------------------------------------------------
-    hb_mask = jnp.uint32(hb_phase_mask)
-    hb_on = active & (((hb_mask >> new_phase.astype(jnp.uint32)) & 1) == 1)
+    # Gating: by phase set (hb_phase_mask; 0 = every phase) and/or by a
+    # selector bit (hb_sel_bit; reference semantics: every node passing the
+    # manage-selectors heartbeats, even disregarded ones —
+    # node_controller.go:205-207 needHeartbeat vs needLockNode). Disabled
+    # entirely when both are "match nothing" (mask 0 and bit -1).
+    if hb_phase_mask == 0 and hb_sel_bit < 0:
+        hb_on = jnp.zeros_like(active)
+    else:
+        hb_on = active
+        if hb_phase_mask != 0:
+            hb_mask = jnp.uint32(hb_phase_mask)
+            hb_on = hb_on & (((hb_mask >> new_phase.astype(jnp.uint32)) & 1) == 1)
+        if hb_sel_bit >= 0:
+            hb_on = hb_on & (
+                ((state.sel_bits >> jnp.uint32(hb_sel_bit)) & 1) == 1
+            )
     entered = hb_on & jnp.isinf(state.hb_due)
     hb_fired = hb_on & (now >= state.hb_due)
     hb_due = jnp.where(
@@ -162,6 +177,7 @@ def tick_body(
         deleted=fired_delete,
         hb_fired=hb_fired,
         transitions=can_fire.sum(dtype=jnp.int32),
+        heartbeats=hb_fired.sum(dtype=jnp.int32),
     )
 
 
@@ -178,6 +194,7 @@ class TickKernel:
         table: CompiledRules,
         hb_interval: float = 30.0,
         hb_phases: tuple[str, ...] = (),
+        hb_sel_bit: int = -1,
     ) -> None:
         self.table = table
         self.hb_interval = float(hb_interval)
@@ -185,12 +202,14 @@ class TickKernel:
         for p in hb_phases:
             mask |= 1 << table.space.phase_id(p)
         self.hb_phase_mask = mask
+        self.hb_sel_bit = int(hb_sel_bit)
         self._rules = _rule_arrays(table)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _tick(state: RowState, now: jnp.ndarray, key: jax.Array) -> TickOutputs:
             return tick_body(
-                state, now, key, self._rules, self.hb_interval, self.hb_phase_mask
+                state, now, key, self._rules, self.hb_interval,
+                self.hb_phase_mask, self.hb_sel_bit,
             )
 
         self._tick = _tick

@@ -1,0 +1,148 @@
+"""Jitted scatter ops: host ingest writes -> device-resident state.
+
+The cluster state stays resident on device between ticks (donated buffers);
+the host never round-trips the full arrays. Watch events accumulate into
+fixed-width padded batches (static shapes for XLA) and are scattered in:
+
+- init_rows: (re)initialize whole rows — object created, row freed/recycled
+- update_rows: modify the host-owned matching inputs of existing rows
+  (sel_bits / has_deletion) without touching device-owned phase/cond/timers;
+  the next tick's re-match logic notices any change (tick_body's
+  `best != pending_rule` re-arm).
+
+Padding uses idx = capacity (one past the end) with scatter mode='drop'.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kwok_tpu.ops.state import RowState
+
+# Fixed batch width: batches larger than this are applied in several calls;
+# smaller ones are padded (avoids one recompile per batch size).
+BATCH = 4096
+
+
+class InitBatch(NamedTuple):
+    idx: np.ndarray  # int32[BATCH], capacity = padding
+    active: np.ndarray  # bool
+    phase: np.ndarray  # int32
+    cond_bits: np.ndarray  # uint32
+    sel_bits: np.ndarray  # uint32
+    has_deletion: np.ndarray  # bool
+
+
+class UpdateBatch(NamedTuple):
+    idx: np.ndarray  # int32[BATCH], capacity = padding
+    sel_bits: np.ndarray  # uint32
+    has_deletion: np.ndarray  # bool
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def init_rows(state: RowState, b: InitBatch) -> RowState:
+    idx = b.idx
+    inf = jnp.float32(jnp.inf)
+    return RowState(
+        active=state.active.at[idx].set(b.active, mode="drop"),
+        phase=state.phase.at[idx].set(b.phase, mode="drop"),
+        cond_bits=state.cond_bits.at[idx].set(b.cond_bits, mode="drop"),
+        sel_bits=state.sel_bits.at[idx].set(b.sel_bits, mode="drop"),
+        has_deletion=state.has_deletion.at[idx].set(b.has_deletion, mode="drop"),
+        pending_rule=state.pending_rule.at[idx].set(-1, mode="drop"),
+        fire_at=state.fire_at.at[idx].set(inf, mode="drop"),
+        hb_due=state.hb_due.at[idx].set(inf, mode="drop"),
+        gen=state.gen.at[idx].set(0, mode="drop"),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update_rows(state: RowState, b: UpdateBatch) -> RowState:
+    idx = b.idx
+    return state._replace(
+        sel_bits=state.sel_bits.at[idx].set(b.sel_bits, mode="drop"),
+        has_deletion=state.has_deletion.at[idx].set(b.has_deletion, mode="drop"),
+    )
+
+
+class UpdateBuffer:
+    """Host-side accumulator that flushes padded batches to device."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._init: list[tuple[int, bool, int, int, int, bool]] = []
+        self._upd: list[tuple[int, int, bool]] = []
+
+    def stage_init(
+        self,
+        idx: int,
+        active: bool,
+        phase: int = 0,
+        cond_bits: int = 0,
+        sel_bits: int = 0,
+        has_deletion: bool = False,
+    ) -> None:
+        self._init.append((idx, active, phase, cond_bits, sel_bits, has_deletion))
+
+    def stage_update(self, idx: int, sel_bits: int, has_deletion: bool) -> None:
+        self._upd.append((idx, sel_bits, has_deletion))
+
+    @property
+    def pending(self) -> int:
+        return len(self._init) + len(self._upd)
+
+    def flush(self, state: RowState) -> RowState:
+        cap = self.capacity
+        while self._init:
+            chunk, self._init = self._init[:BATCH], self._init[BATCH:]
+            n = len(chunk)
+            pad = BATCH - n
+            b = InitBatch(
+                idx=np.concatenate(
+                    [np.fromiter((c[0] for c in chunk), np.int32, n),
+                     np.full(pad, cap, np.int32)]
+                ),
+                active=np.concatenate(
+                    [np.fromiter((c[1] for c in chunk), bool, n), np.zeros(pad, bool)]
+                ),
+                phase=np.concatenate(
+                    [np.fromiter((c[2] for c in chunk), np.int32, n),
+                     np.zeros(pad, np.int32)]
+                ),
+                cond_bits=np.concatenate(
+                    [np.fromiter((c[3] for c in chunk), np.uint32, n),
+                     np.zeros(pad, np.uint32)]
+                ),
+                sel_bits=np.concatenate(
+                    [np.fromiter((c[4] for c in chunk), np.uint32, n),
+                     np.zeros(pad, np.uint32)]
+                ),
+                has_deletion=np.concatenate(
+                    [np.fromiter((c[5] for c in chunk), bool, n), np.zeros(pad, bool)]
+                ),
+            )
+            state = init_rows(state, b)
+        while self._upd:
+            chunk, self._upd = self._upd[:BATCH], self._upd[BATCH:]
+            n = len(chunk)
+            pad = BATCH - n
+            b = UpdateBatch(
+                idx=np.concatenate(
+                    [np.fromiter((c[0] for c in chunk), np.int32, n),
+                     np.full(pad, cap, np.int32)]
+                ),
+                sel_bits=np.concatenate(
+                    [np.fromiter((c[1] for c in chunk), np.uint32, n),
+                     np.zeros(pad, np.uint32)]
+                ),
+                has_deletion=np.concatenate(
+                    [np.fromiter((c[2] for c in chunk), bool, n), np.zeros(pad, bool)]
+                ),
+            )
+            state = update_rows(state, b)
+        return state

@@ -40,6 +40,7 @@ class ShardedTickKernel:
         mesh=None,
         hb_interval: float = 30.0,
         hb_phases: tuple[str, ...] = (),
+        hb_sel_bit: int = -1,
     ) -> None:
         self.table = table
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -48,6 +49,7 @@ class ShardedTickKernel:
         for p in hb_phases:
             mask |= 1 << table.space.phase_id(p)
         self.hb_phase_mask = mask
+        self.hb_sel_bit = int(hb_sel_bit)
         self._rules = _rule_arrays(table)
 
         state_spec = RowState(*([P(ROWS_AXIS)] * len(RowState._fields)))
@@ -57,16 +59,19 @@ class ShardedTickKernel:
             deleted=P(ROWS_AXIS),
             hb_fired=P(ROWS_AXIS),
             transitions=P(),
+            heartbeats=P(),
         )
 
         def shard_fn(state: RowState, now: jnp.ndarray, key: jax.Array) -> TickOutputs:
             idx = jax.lax.axis_index(ROWS_AXIS)
             local_key = jax.random.fold_in(key, idx)
             out = tick_body(
-                state, now, local_key, self._rules, self.hb_interval, self.hb_phase_mask
+                state, now, local_key, self._rules, self.hb_interval,
+                self.hb_phase_mask, self.hb_sel_bit,
             )
             return out._replace(
-                transitions=jax.lax.psum(out.transitions, ROWS_AXIS)
+                transitions=jax.lax.psum(out.transitions, ROWS_AXIS),
+                heartbeats=jax.lax.psum(out.heartbeats, ROWS_AXIS),
             )
 
         sharded = shard_map(

@@ -1,0 +1,272 @@
+"""Engine-vs-fake-apiserver tests: the port of the reference's controller
+unit tests (pkg/kwok/controllers/node_controller_test.go:37-147,
+pod_controller_test.go:37-180) plus the disregard contract from
+test/kwok/kwok.test.sh:76-105.
+
+Synchronous mode: events are fed through the engine's ingest queue by
+calling `pump()` (drain + tick) instead of starting the background threads —
+deterministic and fast. One integration test exercises the threaded path.
+"""
+
+import time
+
+import pytest
+
+from kwok_tpu.engine import ClusterEngine, EngineConfig
+from tests.fake_apiserver import FakeKube
+
+
+def make_node(name, annotations=None, labels=None, status=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "annotations": annotations or {},
+            "labels": labels or {},
+        },
+        **({"status": status} if status else {}),
+    }
+
+
+def make_pod(name, node="node0", ns="default", annotations=None, finalizers=None):
+    meta = {"name": name, "namespace": ns, "annotations": annotations or {}}
+    if finalizers:
+        meta["finalizers"] = finalizers
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta,
+        "spec": {
+            "nodeName": node,
+            "containers": [{"name": "c", "image": "busybox"}],
+        },
+        "status": {"phase": "Pending"},
+    }
+
+
+class SyncEngine(ClusterEngine):
+    """Engine without threads: pump() drains the queue and ticks once."""
+
+    def pump(self, n=1):
+        for _ in range(n):
+            while not self._q.empty():
+                item = self._q.get_nowait()
+                if item:
+                    self._ingest(*item)
+            self.tick_once()
+
+    def feed_all(self, server):
+        for obj in server.list("nodes"):
+            self._q.put(("nodes", "ADDED", obj))
+        for obj in server.list("pods", field_selector="spec.nodeName!="):
+            self._q.put(("pods", "ADDED", obj))
+
+
+@pytest.fixture
+def rig():
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True))
+    # watch so our patches' MODIFIED events flow back in
+    for kind, sel in (("nodes", {}), ("pods", {"field_selector": "spec.nodeName!="})):
+        w = server.watch(kind, **sel)
+
+        def drain(w=w, kind=kind):
+            while not w.q.empty():
+                ev = w.q.get_nowait()
+                if ev:
+                    eng._q.put((kind, ev.type, ev.object))
+
+        eng.__dict__.setdefault("_drains", []).append(drain)
+    orig_pump = eng.pump
+
+    def pump(n=1):
+        for _ in range(n):
+            for d in eng._drains:
+                d()
+            orig_pump(1)
+
+    eng.pump = pump
+    return server, eng
+
+
+def test_node_becomes_ready(rig):
+    server, eng = rig
+    server.create("nodes", make_node("node0"))
+    eng.pump(2)
+    node = server.get("nodes", None, "node0")
+    conds = {c["type"]: c["status"] for c in node["status"]["conditions"]}
+    assert conds["Ready"] == "True"
+    assert node["status"]["capacity"]["pods"] == "1M"
+    assert node["status"]["allocatable"]["cpu"] == "1k"
+    assert node["status"]["addresses"][0]["address"] == "196.168.0.1"
+
+
+def test_unmanaged_node_untouched():
+    server = FakeKube()
+    eng = SyncEngine(
+        server,
+        EngineConfig(manage_nodes_with_annotation_selector="kwok=manage"),
+    )
+    server.create("nodes", make_node("managed", annotations={"kwok": "manage"}))
+    server.create("nodes", make_node("xxxx"))  # the untouched node
+    eng.feed_all(server)
+    eng.pump(2)
+    assert "status" in server.get("nodes", None, "managed")
+    assert (
+        server.get("nodes", None, "managed")["status"]["conditions"][0]["status"]
+        == "True"
+    )
+    assert "status" not in server.get("nodes", None, "xxxx")
+
+
+def test_pod_becomes_running_with_ip(rig):
+    server, eng = rig
+    server.create("nodes", make_node("node0"))
+    eng.pump(2)
+    server.create("pods", make_pod("pod0"))
+    eng.pump(2)
+    pod = server.get("pods", "default", "pod0")
+    st = pod["status"]
+    assert st["phase"] == "Running"
+    assert st["hostIP"] == "196.168.0.1"
+    assert st["podIP"].startswith("10.0.0.")
+    assert st["containerStatuses"][0]["ready"] is True
+    assert {c["type"]: c["status"] for c in st["conditions"]}["Ready"] == "True"
+
+
+def test_pod_on_unmanaged_node_untouched(rig):
+    server, eng = rig
+    server.create("pods", make_pod("orphan", node="no-such-node"))
+    eng.pump(2)
+    assert server.get("pods", "default", "orphan")["status"]["phase"] == "Pending"
+
+
+def test_pod_deletion_grace_and_finalizers(rig):
+    server, eng = rig
+    server.create("nodes", make_node("node0"))
+    server.create("pods", make_pod("pod0", finalizers=["kwok.dev/guard"]))
+    eng.pump(2)
+    assert server.get("pods", "default", "pod0")["status"]["phase"] == "Running"
+    server.delete("pods", "default", "pod0", grace_seconds=30)
+    eng.pump(3)
+    # engine stripped finalizers and force-deleted
+    assert server.get("pods", "default", "pod0") is None
+    assert server.delete_count == 1
+
+
+def test_pod_ip_recycled(rig):
+    server, eng = rig
+    server.create("nodes", make_node("node0"))
+    server.create("pods", make_pod("a"))
+    eng.pump(2)
+    ip_a = server.get("pods", "default", "a")["status"]["podIP"]
+    server.delete("pods", "default", "a", grace_seconds=1)
+    eng.pump(3)
+    assert server.get("pods", "default", "a") is None
+    server.create("pods", make_pod("b"))
+    eng.pump(2)
+    ip_b = server.get("pods", "default", "b")["status"]["podIP"]
+    assert ip_a == ip_b  # recycled
+
+
+def test_disregard_annotation_status_sticks():
+    """The disregard-selector contract (kwok.test.sh:76-105): manual status
+    patches on disregarded objects are not overwritten."""
+    server = FakeKube()
+    eng = SyncEngine(
+        server,
+        EngineConfig(
+            manage_all_nodes=True,
+            disregard_status_with_annotation_selector="kwok.x-k8s.io/status=custom",
+        ),
+    )
+    server.create(
+        "nodes",
+        make_node("weird", annotations={"kwok.x-k8s.io/status": "custom"}),
+    )
+    server.create("nodes", make_node("normal"))
+    server.create("pods", make_pod("weirdpod", node="normal",
+                                   annotations={"kwok.x-k8s.io/status": "custom"}))
+    eng.feed_all(server)
+    eng.pump(2)
+    # normal node locked; weird node not
+    assert "status" in server.get("nodes", None, "normal")
+    assert "status" not in server.get("nodes", None, "weird")
+    # user patches the disregarded pod manually; engine must not fight it
+    server.patch_status("pods", "default", "weirdpod", {"status": {"phase": "Failed"}})
+    eng.pump(3)
+    assert server.get("pods", "default", "weirdpod")["status"]["phase"] == "Failed"
+
+
+def test_heartbeat_refreshes_conditions():
+    server = FakeKube()
+    eng = SyncEngine(
+        server,
+        EngineConfig(manage_all_nodes=True, heartbeat_interval=0.0),
+    )
+    server.create("nodes", make_node("node0"))
+    eng.feed_all(server)
+    eng.pump(2)
+    hb1 = eng.metrics["heartbeats_total"]
+    eng.pump(2)
+    assert eng.metrics["heartbeats_total"] > hb1
+    n2 = server.get("nodes", None, "node0")
+    assert n2["status"]["conditions"][0]["type"] == "Ready"
+
+
+def test_node_delete_then_pod_stuck(rig):
+    server, eng = rig
+    server.create("nodes", make_node("node0"))
+    server.create("pods", make_pod("p"))
+    eng.pump(2)
+    server.delete("nodes", None, "node0")
+    eng.pump(2)
+    # node gone from managed set; pod deletion now ignored (reference
+    # behavior: deleteChan gated on nodeHas)
+    server.delete("pods", "default", "p", grace_seconds=30)
+    eng.pump(3)
+    pod = server.get("pods", "default", "p")
+    assert pod is not None and "deletionTimestamp" in pod["metadata"]
+
+
+def test_no_selector_config_rejected():
+    with pytest.raises(ValueError):
+        SyncEngine(FakeKube(), EngineConfig())
+
+
+def test_threaded_engine_end_to_end():
+    """Integration: real threads, watches, executor — poll like wait.Poll in
+    the reference tests."""
+    server = FakeKube()
+    eng = ClusterEngine(
+        server, EngineConfig(manage_all_nodes=True, tick_interval=0.02)
+    )
+    eng.start()
+    try:
+        server.create("nodes", make_node("n1"))
+        server.create("pods", make_pod("p1", node="n1"))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            pod = server.get("pods", "default", "p1")
+            node = server.get("nodes", None, "n1")
+            if (
+                pod.get("status", {}).get("phase") == "Running"
+                and node.get("status", {}).get("conditions")
+            ):
+                break
+            time.sleep(0.05)
+        assert server.get("pods", "default", "p1")["status"]["phase"] == "Running"
+        conds = {
+            c["type"]: c["status"]
+            for c in server.get("nodes", None, "n1")["status"]["conditions"]
+        }
+        assert conds["Ready"] == "True"
+        # deletion end-to-end
+        server.delete("pods", "default", "p1", grace_seconds=30)
+        deadline = time.time() + 10
+        while time.time() < deadline and server.get("pods", "default", "p1"):
+            time.sleep(0.05)
+        assert server.get("pods", "default", "p1") is None
+    finally:
+        eng.stop()

@@ -23,7 +23,7 @@ def test_sharded_tick_matches_single_device():
     rng = np.random.default_rng(0)
     state.active[:100] = True
     state.phase[:100] = rng.integers(0, 2, 100)
-    state.sel_bits[:100] = rng.integers(0, 2, 100)
+    state.sel_bits[:100] = rng.integers(0, 4, 100)
     state.has_deletion[:100] = rng.random(100) < 0.2
 
     single = TickKernel(table)

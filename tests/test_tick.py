@@ -32,7 +32,7 @@ def pod_table():
     return compile_rules(default_rules(), ResourceKind.POD)
 
 
-def seed_rows(state, n, phase=0, sel=1, deletion=False):
+def seed_rows(state, n, phase=0, sel=0b11, deletion=False):
     state.active[:n] = True
     state.phase[:n] = phase
     state.sel_bits[:n] = sel
