@@ -1,0 +1,27 @@
+"""Config system: YAML config file + KWOK_* env + CLI flags, three-layer
+precedence (file < env < flags), mirroring pkg/config
+(config.go:67-84, vars.go:100-445, flags.go:34-63).
+
+Wire format: multi-doc YAML with apiVersion kwok.x-k8s.io/v1alpha1 and kinds
+KwokConfiguration / KwokctlConfiguration / Stage; documents without a GVK are
+treated as a legacy KwokConfiguration options blob (compatibility.go:85).
+"""
+
+from kwok_tpu.config.types import (
+    GROUP_VERSION,
+    KwokConfiguration,
+    KwokConfigurationOptions,
+    load_documents,
+    save_documents,
+)
+from kwok_tpu.config.stages import Stage, stages_to_rules
+
+__all__ = [
+    "GROUP_VERSION",
+    "KwokConfiguration",
+    "KwokConfigurationOptions",
+    "Stage",
+    "stages_to_rules",
+    "load_documents",
+    "save_documents",
+]

@@ -1,0 +1,145 @@
+"""Versioned config types + multi-doc YAML load/save + env overrides.
+
+Mirrors pkg/apis/v1alpha1/kwok_configuration_types.go:30-81 and the loader in
+pkg/config/config.go (Load: multi-doc YAML -> TypeMeta dispatch :67-84; Save
+writes ---separated docs :138-192). Field names keep the reference's JSON
+wire names so existing kwok.yaml files load unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Iterable
+
+import yaml
+
+GROUP_VERSION = "kwok.x-k8s.io/v1alpha1"
+ENV_PREFIX = "KWOK_"
+
+
+@dataclasses.dataclass
+class KwokConfigurationOptions:
+    """The kwok engine's options (kwok_configuration_types.go:30-81).
+    Wire names in comments; defaults from the +default markers."""
+
+    cidr: str = "10.0.0.1/24"
+    nodeIP: str = "196.168.0.1"
+    manageAllNodes: bool = False
+    manageNodesWithAnnotationSelector: str = ""
+    manageNodesWithLabelSelector: str = ""
+    disregardStatusWithAnnotationSelector: str = ""
+    disregardStatusWithLabelSelector: str = ""
+    serverAddress: str = ""
+    enableCNI: bool = False
+    # TPU-native extensions (not in the reference):
+    tickInterval: float = 0.05
+    heartbeatInterval: float = 30.0
+    parallelism: int = 16
+    initialCapacity: int = 4096
+    useMesh: bool = False
+
+
+@dataclasses.dataclass
+class KwokConfiguration:
+    options: KwokConfigurationOptions = dataclasses.field(
+        default_factory=KwokConfigurationOptions
+    )
+
+    KIND = "KwokConfiguration"
+
+    def to_doc(self) -> dict:
+        return {
+            "apiVersion": GROUP_VERSION,
+            "kind": self.KIND,
+            "options": _prune(dataclasses.asdict(self.options)),
+        }
+
+
+def _prune(d: dict) -> dict:
+    return {k: v for k, v in d.items() if v not in ("", None)}
+
+
+def _coerce(value: str, target: Any) -> Any:
+    if isinstance(target, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(target, int) and not isinstance(target, bool):
+        return int(value)
+    if isinstance(target, float):
+        return float(value)
+    return value
+
+
+def apply_env_overrides(options: Any, environ=os.environ, prefix: str = ENV_PREFIX):
+    """KWOK_<UPPER_SNAKE(field)> env vars override file values
+    (vars.go GetEnvWithPrefix pattern)."""
+    for f in dataclasses.fields(options):
+        env_name = prefix + _upper_snake(f.name)
+        if env_name in environ:
+            setattr(
+                options, f.name, _coerce(environ[env_name], getattr(options, f.name))
+            )
+    return options
+
+
+def _upper_snake(camel: str) -> str:
+    out = []
+    for i, ch in enumerate(camel):
+        if ch.isupper() and i > 0 and not camel[i - 1].isupper():
+            out.append("_")
+        out.append(ch.upper())
+    return "".join(out)
+
+
+def _options_from_doc(doc: dict) -> KwokConfigurationOptions:
+    opts = KwokConfigurationOptions()
+    for k, v in (doc.get("options") or {}).items():
+        if hasattr(opts, k):
+            setattr(opts, k, v)
+    return opts
+
+
+def load_documents(path: str) -> list[Any]:
+    """Load a multi-doc YAML config file into typed objects.
+
+    Unknown kinds are returned as raw dicts; docs without a GVK are treated
+    as legacy KwokConfiguration options (compatibility.go:85)."""
+    from kwok_tpu.config.stages import Stage
+
+    out: list[Any] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for doc in yaml.safe_load_all(f):
+            if not doc:
+                continue
+            kind = doc.get("kind")
+            if kind == KwokConfiguration.KIND:
+                out.append(KwokConfiguration(options=_options_from_doc(doc)))
+            elif kind == Stage.KIND:
+                out.append(Stage.from_doc(doc))
+            elif kind is None and "apiVersion" not in doc:
+                # legacy untyped options blob
+                out.append(
+                    KwokConfiguration(options=_options_from_doc({"options": doc}))
+                )
+            else:
+                out.append(doc)
+    return out
+
+
+def save_documents(path: str, docs: Iterable[Any]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    rendered = []
+    for d in docs:
+        doc = d.to_doc() if hasattr(d, "to_doc") else d
+        rendered.append(yaml.safe_dump(doc, sort_keys=False))
+    with open(path, "w") as f:
+        f.write("---\n".join(rendered))
+
+
+def first_of(docs: list[Any], cls) -> Any | None:
+    for d in docs:
+        if isinstance(d, cls):
+            return d
+    return None

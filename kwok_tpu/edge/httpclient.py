@@ -1,0 +1,289 @@
+"""HttpKubeClient: the KubeClient protocol over a real kube-apiserver.
+
+Replaces the reference's client-go usage: paged LIST (pager.New,
+node_controller.go:282), streaming WATCH with resourceVersion resume,
+strategic-merge PATCH of /status (PatchStatus, node_controller.go:345),
+JSON merge-patch of metadata (removeFinalizers, pod_controller.go:45), and
+grace-0 DELETE. Auth comes from a kubeconfig file or in-cluster
+serviceaccount files (pkg/kwok/cmd/root.go:222-236 newClientset).
+"""
+
+from __future__ import annotations
+
+import atexit
+import base64
+import json
+import logging
+import os
+import socket
+import ssl
+import tempfile
+import threading
+import urllib.parse
+import urllib.request
+from typing import Iterator
+
+import yaml
+
+from kwok_tpu.edge.kubeclient import WatchEvent
+
+logger = logging.getLogger("kwok_tpu.edge.http")
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+LIST_PAGE_SIZE = 500
+
+
+def _b64_to_tmp(data: str, suffix: str) -> str:
+    f = tempfile.NamedTemporaryFile(suffix=suffix, delete=False)
+    f.write(base64.b64decode(data))
+    f.close()
+    # key material must not outlive the process
+    atexit.register(_unlink_quiet, f.name)
+    return f.name
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+class HttpKubeClient:
+    def __init__(
+        self,
+        server: str,
+        *,
+        token: str | None = None,
+        ca_file: str | None = None,
+        cert_file: str | None = None,
+        key_file: str | None = None,
+        insecure_skip_tls_verify: bool = False,
+        timeout: float = 30.0,
+    ) -> None:
+        self.server = server.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+        ctx: ssl.SSLContext | None = None
+        if self.server.startswith("https"):
+            ctx = ssl.create_default_context(cafile=ca_file)
+            if insecure_skip_tls_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if cert_file and key_file:
+                ctx.load_cert_chain(cert_file, key_file)
+        self._ctx = ctx
+
+    # ---------------------------------------------------------- construction
+
+    @classmethod
+    def from_kubeconfig(
+        cls, path: str | None = None, master: str | None = None
+    ) -> "HttpKubeClient":
+        """Load the current-context cluster+user from a kubeconfig; fall back
+        to in-cluster serviceaccount; `master` overrides the server URL."""
+        path = path or os.environ.get("KUBECONFIG") or os.path.expanduser(
+            "~/.kube/config"
+        )
+        if os.path.exists(path):
+            with open(path) as f:
+                cfg = yaml.safe_load(f) or {}
+            ctx_name = cfg.get("current-context")
+            contexts = {c["name"]: c["context"] for c in cfg.get("contexts") or []}
+            clusters = {c["name"]: c["cluster"] for c in cfg.get("clusters") or []}
+            users = {u["name"]: u["user"] for u in cfg.get("users") or []}
+            ctx = contexts.get(ctx_name) or (next(iter(contexts.values()), {}))
+            cluster = clusters.get(ctx.get("cluster"), {}) if ctx else {}
+            user = users.get(ctx.get("user"), {}) if ctx else {}
+            ca = cluster.get("certificate-authority")
+            if not ca and cluster.get("certificate-authority-data"):
+                ca = _b64_to_tmp(cluster["certificate-authority-data"], ".crt")
+            cert = user.get("client-certificate")
+            if not cert and user.get("client-certificate-data"):
+                cert = _b64_to_tmp(user["client-certificate-data"], ".crt")
+            key = user.get("client-key")
+            if not key and user.get("client-key-data"):
+                key = _b64_to_tmp(user["client-key-data"], ".key")
+            return cls(
+                master or cluster.get("server") or "http://127.0.0.1:8080",
+                token=user.get("token"),
+                ca_file=ca,
+                cert_file=cert,
+                key_file=key,
+                insecure_skip_tls_verify=bool(
+                    cluster.get("insecure-skip-tls-verify")
+                ),
+            )
+        if master:
+            return cls(master)
+        # in-cluster (root.go: rest.InClusterConfig path)
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if host:
+            token = ""
+            token_file = os.path.join(_SA_DIR, "token")
+            if os.path.exists(token_file):
+                token = open(token_file).read().strip()
+            return cls(
+                f"https://{host}:{port}",
+                token=token or None,
+                ca_file=os.path.join(_SA_DIR, "ca.crt"),
+            )
+        raise RuntimeError("no kubeconfig, --master, or in-cluster environment")
+
+    # -------------------------------------------------------------- plumbing
+
+    def _url(self, kind: str, namespace: str | None = None, name: str | None = None,
+             subresource: str | None = None, query: dict | None = None) -> str:
+        parts = ["/api/v1"]
+        if namespace:
+            parts.append(f"/namespaces/{namespace}")
+        parts.append(f"/{kind}")
+        if name:
+            parts.append(f"/{name}")
+        if subresource:
+            parts.append(f"/{subresource}")
+        url = self.server + "".join(parts)
+        if query:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in query.items() if v not in (None, "")}
+            )
+        return url
+
+    def _request(self, method: str, url: str, body: bytes | None = None,
+                 content_type: str | None = None, timeout: float | None = None):
+        req = urllib.request.Request(url, data=body, method=method)
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        return urllib.request.urlopen(
+            req, context=self._ctx, timeout=timeout or self.timeout
+        )
+
+    def _json(self, method: str, url: str, body: dict | None = None,
+              content_type: str = "application/json") -> dict | None:
+        data = json.dumps(body).encode() if body is not None else None
+        try:
+            with self._request(method, url, data, content_type) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    # ------------------------------------------------------------- KubeClient
+
+    def list(self, kind, *, field_selector=None, label_selector=None) -> list[dict]:
+        items: list[dict] = []
+        cont = None
+        while True:
+            doc = self._json(
+                "GET",
+                self._url(kind, query={
+                    "fieldSelector": field_selector,
+                    "labelSelector": label_selector,
+                    "limit": LIST_PAGE_SIZE,
+                    "continue": cont,
+                }),
+            ) or {}
+            for item in doc.get("items") or []:
+                item.setdefault("apiVersion", "v1")
+                items.append(item)
+            cont = (doc.get("metadata") or {}).get("continue")
+            if not cont:
+                return items
+
+    def watch(self, kind, *, field_selector=None, label_selector=None):
+        return _HttpWatch(self, kind, field_selector, label_selector)
+
+    def get(self, kind, namespace, name):
+        return self._json("GET", self._url(kind, namespace, name))
+
+    def patch_status(self, kind, namespace, name, patch):
+        return self._json(
+            "PATCH",
+            self._url(kind, namespace, name, "status"),
+            patch,
+            "application/strategic-merge-patch+json",
+        )
+
+    def patch_meta(self, kind, namespace, name, patch):
+        return self._json(
+            "PATCH",
+            self._url(kind, namespace, name),
+            patch,
+            "application/merge-patch+json",
+        )
+
+    def delete(self, kind, namespace, name, grace_seconds: int = 0):
+        self._json(
+            "DELETE",
+            self._url(kind, namespace, name),
+            {"gracePeriodSeconds": grace_seconds},
+        )
+
+    def healthz(self) -> bool:
+        try:
+            with self._request("GET", self.server + "/healthz") as resp:
+                return resp.status == 200
+        except Exception:
+            return False
+
+
+class _HttpWatch:
+    """One streaming watch connection; iterating yields WatchEvents until the
+    server closes the stream or stop() is called. The engine's watch loop
+    handles reconnect+resync."""
+
+    def __init__(self, client: HttpKubeClient, kind: str, field_selector, label_selector):
+        self.client = client
+        self._stopped = threading.Event()
+        url = client._url(kind, query={
+            "watch": "true",
+            "fieldSelector": field_selector,
+            "labelSelector": label_selector,
+            "allowWatchBookmarks": "false",
+        })
+        # no read timeout: watch connections idle legitimately
+        self._resp = client._request("GET", url, timeout=3600.0)
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        try:
+            for raw in self._resp:
+                if self._stopped.is_set():
+                    return
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    logger.warning("bad watch line: %.120r", line)
+                    continue
+                type_ = doc.get("type")
+                if type_ in ("ADDED", "MODIFIED", "DELETED"):
+                    yield WatchEvent(type_, doc.get("object") or {})
+                elif type_ == "ERROR":
+                    logger.warning("watch error event: %s", doc.get("object"))
+                    return
+        finally:
+            try:
+                self._resp.close()
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        # Closing the response would block on the buffer lock held by a
+        # reader mid-readline; shutting the socket down unblocks the reader
+        # with EOF instead.
+        try:
+            sock = self._resp.fp.raw._sock  # http.client internals
+            sock.shutdown(socket.SHUT_RDWR)
+        except Exception:
+            try:
+                self._resp.close()
+            except Exception:
+                pass

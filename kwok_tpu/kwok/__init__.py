@@ -1,0 +1,2 @@
+"""The kwok engine binary: CLI + healthz/metrics server around ClusterEngine
+(mirrors pkg/kwok/cmd + cmd/kwok)."""

@@ -1,0 +1,5 @@
+import sys
+
+from kwok_tpu.kwok.cli import main
+
+sys.exit(main())

@@ -1,0 +1,163 @@
+"""tpukwok: the engine CLI (mirrors pkg/kwok/cmd/root.go + cmd/kwok/main.go).
+
+Flag surface matches the reference (root.go:156-169); precedence is config
+file < KWOK_* env < flags (config/flags.go:34-63 pattern: file values seed
+the flag defaults, so unset flags inherit them).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+from kwok_tpu.config.stages import Stage, stages_to_rules
+from kwok_tpu.config.types import (
+    KwokConfiguration,
+    apply_env_overrides,
+    load_documents,
+)
+from kwok_tpu.models.lifecycle import ResourceKind
+
+logger = logging.getLogger("kwok_tpu.kwok")
+
+DEFAULT_CONFIG = os.path.expanduser("~/.kwok/kwok.yaml")
+
+
+def build_parser(defaults) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpukwok",
+        description="TPU-native fake kubelet: simulates node/pod lifecycle "
+        "against a kube-apiserver with a batched device tick engine.",
+    )
+    o = defaults
+    p.add_argument("--config", default=DEFAULT_CONFIG,
+                   help="config file (multi-doc YAML, kwok.x-k8s.io/v1alpha1)")
+    p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
+    p.add_argument("--master", default="",
+                   help="apiserver URL override (like kube --master)")
+    p.add_argument("--cidr", default=o.cidr)
+    p.add_argument("--node-ip", default=o.nodeIP)
+    p.add_argument("--manage-all-nodes", type=_bool, default=o.manageAllNodes)
+    p.add_argument("--manage-nodes-with-annotation-selector",
+                   default=o.manageNodesWithAnnotationSelector)
+    p.add_argument("--manage-nodes-with-label-selector",
+                   default=o.manageNodesWithLabelSelector)
+    p.add_argument("--disregard-status-with-annotation-selector",
+                   default=o.disregardStatusWithAnnotationSelector)
+    p.add_argument("--disregard-status-with-label-selector",
+                   default=o.disregardStatusWithLabelSelector)
+    p.add_argument("--server-address", default=o.serverAddress,
+                   help="healthz/metrics address, e.g. 0.0.0.0:10247")
+    p.add_argument("--enable-cni", type=_bool, default=o.enableCNI)
+    p.add_argument("--tick-interval", type=float, default=o.tickInterval)
+    p.add_argument("--heartbeat-interval", type=float, default=o.heartbeatInterval)
+    p.add_argument("--parallelism", type=int, default=o.parallelism)
+    p.add_argument("--initial-capacity", type=int, default=o.initialCapacity)
+    p.add_argument("--use-mesh", type=_bool, default=o.useMesh,
+                   help="shard cluster state across all local devices")
+    p.add_argument("-v", "--verbosity", type=int, default=0)
+    return p
+
+
+def _bool(v: str) -> bool:
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def _engine_config(args, stages: list[Stage]):
+    from kwok_tpu.engine import EngineConfig
+
+    return EngineConfig(
+        manage_all_nodes=args.manage_all_nodes,
+        manage_nodes_with_annotation_selector=args.manage_nodes_with_annotation_selector,
+        manage_nodes_with_label_selector=args.manage_nodes_with_label_selector,
+        disregard_status_with_annotation_selector=args.disregard_status_with_annotation_selector,
+        disregard_status_with_label_selector=args.disregard_status_with_label_selector,
+        cidr=args.cidr,
+        node_ip=args.node_ip,
+        enable_cni=args.enable_cni,
+        tick_interval=args.tick_interval,
+        heartbeat_interval=args.heartbeat_interval,
+        parallelism=args.parallelism,
+        initial_capacity=args.initial_capacity,
+        use_mesh=args.use_mesh,
+        node_rules=stages_to_rules(stages, ResourceKind.NODE),
+        pod_rules=stages_to_rules(stages, ResourceKind.POD),
+    )
+
+
+def wait_for_apiserver(client, deadline_seconds: float = 120.0) -> None:
+    """Exponential backoff until the apiserver answers (root.go:99-120)."""
+    delay = 0.5
+    deadline = time.time() + deadline_seconds
+    while True:
+        try:
+            client.list("nodes", field_selector=None, label_selector=None)
+            return
+        except Exception as e:
+            if time.time() > deadline:
+                raise RuntimeError(f"apiserver not reachable: {e}") from e
+            logger.info("waiting for apiserver: %s", e)
+            time.sleep(delay)
+            delay = min(delay * 2, 10)
+
+
+def main(argv=None, stop_event: threading.Event | None = None) -> int:
+    # pre-parse --config (flags.go:34-63: config parsed before cobra)
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--config", default=DEFAULT_CONFIG)
+    pre_args, _ = pre.parse_known_args(argv)
+    docs = load_documents(pre_args.config)
+    conf = next((d for d in docs if isinstance(d, KwokConfiguration)),
+                KwokConfiguration())
+    apply_env_overrides(conf.options)
+    stages = [d for d in docs if isinstance(d, Stage)]
+
+    args = build_parser(conf.options).parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity > 0 else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.engine import ClusterEngine
+    from kwok_tpu.kwok.server import EngineServer
+
+    client = HttpKubeClient.from_kubeconfig(
+        args.kubeconfig or None, args.master or None
+    )
+    wait_for_apiserver(client)
+
+    engine = ClusterEngine(client, _engine_config(args, stages))
+    server = None
+    if args.server_address:
+        server = EngineServer(engine, args.server_address)
+        server.start()
+        logger.info("serving healthz/metrics on %s", args.server_address)
+
+    engine.start()
+    logger.info("engine started (managing %s)",
+                "all nodes" if args.manage_all_nodes else "selected nodes")
+
+    stop = stop_event or threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # not main thread (tests)
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        engine.stop()
+        if server:
+            server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""HTTP server: /healthz /readyz /livez + Prometheus /metrics.
+
+Mirrors Serve in pkg/kwok/cmd/root.go:173-202, with real engine counters
+instead of only Go runtime collectors (SURVEY.md section 5.5: the counters
+that matter are transitions/sec, patches/sec, tick latency, watch lag).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_METRIC_HELP = {
+    "transitions_total": "Lifecycle phase transitions applied by the tick kernel",
+    "status_patches_total": "Status patches sent to the apiserver",
+    "heartbeats_total": "Node heartbeat patches sent",
+    "deletes_total": "Pod deletes issued",
+    "watch_events_total": "Watch events ingested",
+    "ticks_total": "Engine ticks executed",
+    "tick_seconds_sum": "Total seconds spent in tick_once",
+    "nodes_managed": "Nodes currently managed",
+    "pods_managed": "Pods currently tracked",
+}
+
+
+def render_metrics(metrics: dict) -> str:
+    lines = []
+    for name, value in sorted(metrics.items()):
+        full = f"kwok_{name}"
+        if name in _METRIC_HELP:
+            lines.append(f"# HELP {full} {_METRIC_HELP[name]}")
+        kind = "counter" if name.endswith(("_total", "_sum")) else "gauge"
+        lines.append(f"# TYPE {full} {kind}")
+        lines.append(f"{full} {value}")
+    return "\n".join(lines) + "\n"
+
+
+class EngineServer:
+    def __init__(self, engine, address: str) -> None:
+        host, _, port = address.rpartition(":")
+        handler = self._make_handler(engine)
+        self.httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def _make_handler(self, engine):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path in ("/healthz", "/readyz", "/livez"):
+                    body = b"ok"
+                    ctype = "text/plain"
+                elif self.path == "/metrics":
+                    body = render_metrics(dict(engine.metrics)).encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        return Handler
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="kwok-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

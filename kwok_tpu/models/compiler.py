@@ -93,9 +93,14 @@ def compile_rules(
     is_delete = np.zeros(n, bool)
 
     for i, r in enumerate(mine):
-        mask = 0
-        for p in r.from_phases:
-            mask |= 1 << space.phase_id(p)
+        if r.from_phases:
+            mask = 0
+            for p in r.from_phases:
+                mask |= 1 << space.phase_id(p)
+        else:
+            # empty from_phases = match any phase (upstream Stage semantics
+            # for an absent selector.matchPhases)
+            mask = 0xFFFFFFFF
         from_mask[i] = mask
         deletion[i] = np.int8(r.deletion)
         selector_bit[i] = selector_id(r.selector)

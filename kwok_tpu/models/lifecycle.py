@@ -106,6 +106,10 @@ class LifecycleRule:
     # Name of a host-computed selector; resolved to a bit index by the
     # compiler. None => matches every row of the resource.
     selector: str | None = None
+    # Relative weight for weighted-random choice among equally-ranked rules
+    # (the Stage CRD's spec.weight; currently first-match-wins, weight kept
+    # for wire compatibility).
+    weight: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,129 @@
+"""Config system tests: load/save round-trip (config_test.go analogue),
+env-var precedence, legacy no-GVK docs, Stage parsing."""
+
+import textwrap
+
+import pytest
+
+from kwok_tpu.config import (
+    KwokConfiguration,
+    Stage,
+    load_documents,
+    save_documents,
+    stages_to_rules,
+)
+from kwok_tpu.config.stages import parse_duration
+from kwok_tpu.config.types import apply_env_overrides
+from kwok_tpu.models.lifecycle import DELETION_PRESENT, DelayKind, ResourceKind
+
+
+def test_load_save_round_trip(tmp_path):
+    p = tmp_path / "kwok.yaml"
+    conf = KwokConfiguration()
+    conf.options.manageAllNodes = True
+    conf.options.cidr = "10.1.0.0/16"
+    save_documents(str(p), [conf])
+    docs = load_documents(str(p))
+    assert isinstance(docs[0], KwokConfiguration)
+    assert docs[0].options.manageAllNodes is True
+    assert docs[0].options.cidr == "10.1.0.0/16"
+    assert docs[0].options.nodeIP == "196.168.0.1"  # default preserved
+
+
+def test_legacy_untyped_doc(tmp_path):
+    p = tmp_path / "legacy.yaml"
+    p.write_text("manageAllNodes: true\ncidr: 10.9.0.0/24\n")
+    docs = load_documents(str(p))
+    assert isinstance(docs[0], KwokConfiguration)
+    assert docs[0].options.manageAllNodes is True
+
+
+def test_env_overrides(monkeypatch):
+    conf = KwokConfiguration()
+    monkeypatch.setenv("KWOK_MANAGE_ALL_NODES", "true")
+    monkeypatch.setenv("KWOK_CIDR", "10.8.0.0/24")
+    monkeypatch.setenv("KWOK_PARALLELISM", "32")
+    apply_env_overrides(conf.options)
+    assert conf.options.manageAllNodes is True
+    assert conf.options.cidr == "10.8.0.0/24"
+    assert conf.options.parallelism == 32
+
+
+def test_parse_duration():
+    assert parse_duration("5s") == 5.0
+    assert parse_duration("300ms") == pytest.approx(0.3)
+    assert parse_duration("1m30s") == 90.0
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration(7) == 7.0
+    assert parse_duration("2.5") == 2.5
+
+
+def test_stage_yaml_round_trip(tmp_path):
+    p = tmp_path / "stages.yaml"
+    p.write_text(textwrap.dedent("""
+        apiVersion: kwok.x-k8s.io/v1alpha1
+        kind: Stage
+        metadata: {name: pod-complete}
+        spec:
+          resourceRef: {apiGroup: v1, kind: Pod}
+          selector:
+            matchPhases: [Running]
+            matchDeletion: absent
+          delay:
+            exponential: {mean: 30s, cap: 5m}
+          next:
+            phase: Succeeded
+            conditions: {Ready: false}
+        ---
+        apiVersion: kwok.x-k8s.io/v1alpha1
+        kind: Stage
+        metadata: {name: pod-remove}
+        spec:
+          resourceRef: {kind: Pod}
+          selector:
+            matchPhases: [Running, Succeeded]
+            matchDeletion: present
+          next: {delete: true, phase: Gone}
+    """))
+    docs = load_documents(str(p))
+    stages = [d for d in docs if isinstance(d, Stage)]
+    assert len(stages) == 2
+    s = stages[0]
+    assert s.delay.kind == DelayKind.EXPONENTIAL
+    assert s.delay.a == 30.0 and s.delay.b == 300.0
+    rules = stages_to_rules(stages, ResourceKind.POD)
+    assert rules[0].effect.to_phase == "Succeeded"
+    assert rules[1].deletion == DELETION_PRESENT
+    assert rules[1].effect.delete is True
+    assert stages_to_rules(stages, ResourceKind.NODE) is None
+    # round-trip through to_doc
+    save_documents(str(tmp_path / "out.yaml"), stages)
+    docs2 = load_documents(str(tmp_path / "out.yaml"))
+    assert [d.name for d in docs2] == ["pod-complete", "pod-remove"]
+
+
+def test_stage_rules_drive_engine(tmp_path):
+    """Custom stages replace default pod rules end-to-end."""
+    from kwok_tpu.engine import EngineConfig
+    from tests.fake_apiserver import FakeKube
+    from tests.test_engine import SyncEngine, make_node, make_pod
+
+    stage = Stage.from_doc({
+        "kind": "Stage",
+        "metadata": {"name": "insta-fail"},
+        "spec": {
+            "resourceRef": {"kind": "Pod"},
+            "selector": {"matchPhases": ["Pending"]},
+            "next": {"phase": "Failed"},
+        },
+    })
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(
+        manage_all_nodes=True,
+        pod_rules=stages_to_rules([stage], ResourceKind.POD),
+    ))
+    server.create("nodes", make_node("n"))
+    server.create("pods", make_pod("p", node="n"))
+    eng.feed_all(server)
+    eng.pump(2)
+    assert server.get("pods", "default", "p")["status"]["phase"] == "Failed"

@@ -1,0 +1,113 @@
+"""HttpKubeClient against the HTTP fake apiserver, and the tpukwok CLI
+end-to-end over real sockets."""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tests.http_fake_apiserver import HttpFakeApiserver
+from tests.test_engine import make_node, make_pod
+
+
+@pytest.fixture
+def api():
+    srv = HttpFakeApiserver().start()
+    yield srv
+    srv.stop()
+
+
+def client_for(api):
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+
+    return HttpKubeClient(api.url)
+
+
+def test_list_get_patch_delete(api):
+    c = client_for(api)
+    api.store.create("nodes", make_node("n1"))
+    api.store.create("pods", make_pod("p1", node="n1"))
+    assert [n["metadata"]["name"] for n in c.list("nodes")] == ["n1"]
+    assert c.get("pods", "default", "p1")["spec"]["nodeName"] == "n1"
+    assert c.get("pods", "default", "nope") is None
+    c.patch_status("nodes", None, "n1", {"status": {"phase": "Running"}})
+    assert api.store.get("nodes", None, "n1")["status"]["phase"] == "Running"
+    c.patch_meta("pods", "default", "p1", {"metadata": {"labels": {"a": "b"}}})
+    assert api.store.get("pods", "default", "p1")["metadata"]["labels"] == {"a": "b"}
+    c.delete("pods", "default", "p1", grace_seconds=0)
+    assert api.store.get("pods", "default", "p1") is None
+    assert c.healthz()
+
+
+def test_field_selector_pushdown(api):
+    c = client_for(api)
+    api.store.create("pods", make_pod("bound", node="n1"))
+    unbound = make_pod("unbound")
+    unbound["spec"]["nodeName"] = ""
+    api.store.create("pods", unbound)
+    names = [p["metadata"]["name"] for p in c.list("pods", field_selector="spec.nodeName!=")]
+    assert names == ["bound"]
+
+
+def test_watch_stream(api):
+    c = client_for(api)
+    w = c.watch("nodes")
+    events = []
+    done = threading.Event()
+
+    def consume():
+        for ev in w:
+            events.append((ev.type, ev.object["metadata"]["name"]))
+            if len(events) >= 2:
+                done.set()
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)  # let the watch register
+    api.store.create("nodes", make_node("w1"))
+    api.store.delete("nodes", None, "w1")
+    assert done.wait(5), f"events: {events}"
+    assert events == [("ADDED", "w1"), ("DELETED", "w1")]
+    w.stop()
+
+
+def test_tpukwok_cli_end_to_end(api, tmp_path):
+    """The full binary path: tpukwok main() against an HTTP apiserver."""
+    from kwok_tpu.kwok.cli import main
+
+    api.store.create("nodes", make_node("cli-node"))
+    stop = threading.Event()
+    rc = []
+    t = threading.Thread(
+        target=lambda: rc.append(main([
+            "--master", api.url,
+            "--kubeconfig", str(tmp_path / "nope"),  # force master path
+            "--manage-all-nodes", "true",
+            "--tick-interval", "0.02",
+            "--server-address", "127.0.0.1:0",
+            "--config", str(tmp_path / "absent.yaml"),
+        ], stop_event=stop)),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        node = api.store.get("nodes", None, "cli-node")
+        if node.get("status", {}).get("conditions"):
+            break
+        time.sleep(0.05)
+    api.store.create("pods", make_pod("cli-pod", node="cli-node"))
+    while time.time() < deadline:
+        pod = api.store.get("pods", "default", "cli-pod")
+        if pod and pod.get("status", {}).get("phase") == "Running":
+            break
+        time.sleep(0.05)
+    stop.set()
+    t.join(timeout=15)
+    assert rc == [0]
+    node = api.store.get("nodes", None, "cli-node")
+    conds = {c["type"]: c["status"] for c in node["status"]["conditions"]}
+    assert conds["Ready"] == "True"
+    assert api.store.get("pods", "default", "cli-pod")["status"]["phase"] == "Running"
