@@ -32,7 +32,11 @@ from __future__ import annotations
 import dataclasses
 import re
 
-from kwok_tpu.models.defaults import SEL_MANAGED
+from kwok_tpu.models.defaults import (
+    SEL_HEARTBEAT,
+    SEL_MANAGED,
+    SEL_ON_MANAGED_NODE,
+)
 from kwok_tpu.models.lifecycle import (
     DELETION_ABSENT,
     DELETION_ANY,
@@ -51,6 +55,10 @@ _DELETION = {
     "any": DELETION_ANY,
 }
 _KIND_TO_RESOURCE = {"Pod": ResourceKind.POD, "Node": ResourceKind.NODE}
+# Selector bits the engine actually sets at ingest
+# (kwok_tpu/engine/engine.py row-ingest); anything else would compile to a
+# bit that never fires, so reject it at load time.
+_KNOWN_SELECTORS = frozenset({SEL_MANAGED, SEL_HEARTBEAT, SEL_ON_MANAGED_NODE})
 
 
 def parse_duration(s) -> float:
@@ -123,14 +131,26 @@ class Stage:
                     f"Stage {meta.get('name')!r}: spec.next.phase is required "
                     "unless next.delete is true"
                 )
+        name = meta.get("name") or "stage"
         # matchSelector: absent -> managed-only (safe default); explicit
         # null -> match every row
         selector = sel["matchSelector"] if "matchSelector" in sel else SEL_MANAGED
+        if selector is not None and selector not in _KNOWN_SELECTORS:
+            raise ValueError(
+                f"Stage {name!r}: unknown matchSelector {selector!r}; "
+                f"valid values: {sorted(_KNOWN_SELECTORS)} or null"
+            )
+        deletion_name = sel.get("matchDeletion", "absent")
+        if deletion_name not in _DELETION:
+            raise ValueError(
+                f"Stage {name!r}: bad matchDeletion {deletion_name!r}; "
+                f"valid values: {sorted(_DELETION)}"
+            )
         return cls(
-            name=meta.get("name") or "stage",
+            name=name,
             resource=_KIND_TO_RESOURCE[kind],
             from_phases=tuple(sel.get("matchPhases") or ()),
-            deletion=_DELETION[sel.get("matchDeletion", "absent")],
+            deletion=_DELETION[deletion_name],
             selector=selector,
             delay=_parse_delay(spec.get("delay")),
             to_phase=to_phase,

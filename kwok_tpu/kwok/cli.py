@@ -19,6 +19,7 @@ from kwok_tpu.config.stages import Stage, stages_to_rules
 from kwok_tpu.config.types import (
     KwokConfiguration,
     apply_env_overrides,
+    first_of,
     load_documents,
 )
 from kwok_tpu.models.lifecycle import ResourceKind
@@ -112,8 +113,7 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
     pre.add_argument("--config", default=DEFAULT_CONFIG)
     pre_args, _ = pre.parse_known_args(argv)
     docs = load_documents(pre_args.config)
-    conf = next((d for d in docs if isinstance(d, KwokConfiguration)),
-                KwokConfiguration())
+    conf = first_of(docs, KwokConfiguration) or KwokConfiguration()
     apply_env_overrides(conf.options)
     stages = [d for d in docs if isinstance(d, Stage)]
 

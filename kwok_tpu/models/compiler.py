@@ -93,21 +93,26 @@ def compile_rules(
     is_delete = np.zeros(n, bool)
 
     for i, r in enumerate(mine):
+        to_id = space.phase_id(r.effect.to_phase)
         if r.from_phases:
             mask = 0
             for p in r.from_phases:
                 mask |= 1 << space.phase_id(p)
         else:
             # empty from_phases = match any phase (upstream Stage semantics
-            # for an absent selector.matchPhases)
+            # for an absent selector.matchPhases), EXCEPT the rule's own
+            # target phase for non-delete rules — otherwise the rule re-fires
+            # from the phase it just wrote, patching the apiserver forever.
             mask = 0xFFFFFFFF
+            if not r.effect.delete:
+                mask &= ~(1 << to_id) & 0xFFFFFFFF
         from_mask[i] = mask
         deletion[i] = np.int8(r.deletion)
         selector_bit[i] = selector_id(r.selector)
         delay_kind[i] = int(r.delay.kind)
         delay_a[i] = r.delay.a
         delay_b[i] = r.delay.b
-        to_phase[i] = space.phase_id(r.effect.to_phase)
+        to_phase[i] = to_id
         ca = 0
         cv = 0
         for cond, val in r.effect.conditions.items():

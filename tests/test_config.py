@@ -127,3 +127,69 @@ def test_stage_rules_drive_engine(tmp_path):
     eng.feed_all(server)
     eng.pump(2)
     assert server.get("pods", "default", "p")["status"]["phase"] == "Failed"
+
+
+def test_stage_unknown_match_selector_rejected(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text(textwrap.dedent("""
+        apiVersion: kwok.x-k8s.io/v1alpha1
+        kind: Stage
+        metadata: {name: typo}
+        spec:
+          resourceRef: {kind: Pod}
+          selector: {matchSelector: Managed}
+          next: {phase: Running}
+    """))
+    with pytest.raises(ValueError, match="unknown matchSelector"):
+        load_documents(str(p))
+
+
+def test_stage_bad_match_deletion_rejected(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text(textwrap.dedent("""
+        apiVersion: kwok.x-k8s.io/v1alpha1
+        kind: Stage
+        metadata: {name: bad-del}
+        spec:
+          resourceRef: {kind: Pod}
+          selector: {matchDeletion: Present}
+          next: {phase: Running}
+    """))
+    with pytest.raises(ValueError, match="bad matchDeletion"):
+        load_documents(str(p))
+
+
+def test_implicit_all_phases_excludes_target_phase():
+    """A Stage with no matchPhases must not re-fire from its own target
+    phase (would patch-storm the apiserver forever)."""
+    from kwok_tpu.models.compiler import compile_rules, match_rule_host
+    from kwok_tpu.models.lifecycle import POD_PHASES
+
+    stage = Stage.from_doc({
+        "kind": "Stage",
+        "metadata": {"name": "any-to-succeeded"},
+        "spec": {
+            "resourceRef": {"kind": "Pod"},
+            "selector": {},
+            "next": {"phase": "Succeeded"},
+        },
+    })
+    table = compile_rules([stage.to_rule()], ResourceKind.POD)
+    succeeded = POD_PHASES.phase_id("Succeeded")
+    sel_bits = 1 << table.selector_bit[0]
+    # matches from every phase except its own target
+    for ph in range(len(POD_PHASES.phases)):
+        idx = match_rule_host(table, ph, int(sel_bits), False)
+        assert (idx == -1) == (ph == succeeded)
+    # delete rules keep full coverage: terminal "Gone" phases still match
+    rm = Stage.from_doc({
+        "kind": "Stage",
+        "metadata": {"name": "rm"},
+        "spec": {
+            "resourceRef": {"kind": "Pod"},
+            "selector": {"matchDeletion": "present"},
+            "next": {"delete": True},
+        },
+    })
+    table2 = compile_rules([rm.to_rule()], ResourceKind.POD)
+    assert int(table2.from_mask[0]) == 0xFFFFFFFF
