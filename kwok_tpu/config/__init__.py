@@ -11,16 +11,32 @@ from kwok_tpu.config.types import (
     GROUP_VERSION,
     KwokConfiguration,
     KwokConfigurationOptions,
+    first_of,
     load_documents,
     save_documents,
+)
+from kwok_tpu.config.ctl import (
+    Component,
+    Env,
+    KwokctlConfiguration,
+    KwokctlConfigurationOptions,
+    Port,
+    Volume,
 )
 from kwok_tpu.config.stages import Stage, stages_to_rules
 
 __all__ = [
     "GROUP_VERSION",
+    "Component",
+    "Env",
     "KwokConfiguration",
     "KwokConfigurationOptions",
+    "KwokctlConfiguration",
+    "KwokctlConfigurationOptions",
+    "Port",
     "Stage",
+    "Volume",
+    "first_of",
     "stages_to_rules",
     "load_documents",
     "save_documents",

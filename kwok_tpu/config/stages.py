@@ -55,10 +55,13 @@ _DELETION = {
     "any": DELETION_ANY,
 }
 _KIND_TO_RESOURCE = {"Pod": ResourceKind.POD, "Node": ResourceKind.NODE}
-# Selector bits the engine actually sets at ingest
-# (kwok_tpu/engine/engine.py row-ingest); anything else would compile to a
+# Selector bits the engine actually sets at ingest, per resource kind
+# (kwok_tpu/engine/engine.py:156-157); anything else would compile to a
 # bit that never fires, so reject it at load time.
-_KNOWN_SELECTORS = frozenset({SEL_MANAGED, SEL_HEARTBEAT, SEL_ON_MANAGED_NODE})
+_KNOWN_SELECTORS = {
+    ResourceKind.NODE: frozenset({SEL_MANAGED, SEL_HEARTBEAT}),
+    ResourceKind.POD: frozenset({SEL_MANAGED, SEL_ON_MANAGED_NODE}),
+}
 
 
 def parse_duration(s) -> float:
@@ -132,13 +135,15 @@ class Stage:
                     "unless next.delete is true"
                 )
         name = meta.get("name") or "stage"
+        resource = _KIND_TO_RESOURCE[kind]
         # matchSelector: absent -> managed-only (safe default); explicit
         # null -> match every row
         selector = sel["matchSelector"] if "matchSelector" in sel else SEL_MANAGED
-        if selector is not None and selector not in _KNOWN_SELECTORS:
+        known = _KNOWN_SELECTORS[resource]
+        if selector is not None and selector not in known:
             raise ValueError(
-                f"Stage {name!r}: unknown matchSelector {selector!r}; "
-                f"valid values: {sorted(_KNOWN_SELECTORS)} or null"
+                f"Stage {name!r}: unknown matchSelector {selector!r} for "
+                f"{kind}; valid values: {sorted(known)} or null"
             )
         deletion_name = sel.get("matchDeletion", "absent")
         if deletion_name not in _DELETION:
@@ -148,7 +153,7 @@ class Stage:
             )
         return cls(
             name=name,
-            resource=_KIND_TO_RESOURCE[kind],
+            resource=resource,
             from_phases=tuple(sel.get("matchPhases") or ()),
             deletion=_DELETION[deletion_name],
             selector=selector,

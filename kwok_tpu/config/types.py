@@ -60,9 +60,16 @@ def _prune(d: dict) -> dict:
     return {k: v for k, v in d.items() if v not in ("", None)}
 
 
+def parse_bool(value: Any) -> bool:
+    """The one truthy-string parser shared by every flag/env surface."""
+    if value is None or isinstance(value, bool):
+        return bool(value)
+    return str(value).lower() in ("1", "true", "yes", "on")
+
+
 def _coerce(value: str, target: Any) -> Any:
     if isinstance(target, bool):
-        return value.lower() in ("1", "true", "yes", "on")
+        return parse_bool(value)
     if isinstance(target, int) and not isinstance(target, bool):
         return int(value)
     if isinstance(target, float):
@@ -104,6 +111,7 @@ def load_documents(path: str) -> list[Any]:
 
     Unknown kinds are returned as raw dicts; docs without a GVK are treated
     as legacy KwokConfiguration options (compatibility.go:85)."""
+    from kwok_tpu.config.ctl import KwokctlConfiguration
     from kwok_tpu.config.stages import Stage
 
     out: list[Any] = []
@@ -116,6 +124,8 @@ def load_documents(path: str) -> list[Any]:
             kind = doc.get("kind")
             if kind == KwokConfiguration.KIND:
                 out.append(KwokConfiguration(options=_options_from_doc(doc)))
+            elif kind == KwokctlConfiguration.KIND:
+                out.append(KwokctlConfiguration.from_doc(doc))
             elif kind == Stage.KIND:
                 out.append(Stage.from_doc(doc))
             elif kind is None and "apiVersion" not in doc:

@@ -1,0 +1,367 @@
+"""In-memory mock kube-apiserver (library + standalone process).
+
+Two layers:
+- FakeKube: object store with resourceVersion bumps, watch fan-out,
+  strategic-merge status patches and kubelet-style graceful deletion --
+  the in-process analogue of client-go's fake clientset
+  (node_controller_test.go:38, pod_controller_test.go:38-71).
+- HttpFakeApiserver: an HTTP facade speaking the kube-apiserver wire
+  protocol (list/watch/get/patch/delete on /api/v1 paths, chunked watch
+  streams, /healthz) over real sockets.
+
+Used by the test suite and by the kwokctl `mock` runtime, whose generated
+kube-apiserver shim runs main() as a detached process in air-gapped
+environments where real control-plane binaries cannot be downloaded.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import queue
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterator
+
+from kwok_tpu.edge.kubeclient import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    WatchEvent,
+    match_field_selector,
+)
+from kwok_tpu.edge.merge import strategic_merge
+from kwok_tpu.edge.render import now_rfc3339
+from kwok_tpu.edge.selectors import parse_selector
+
+
+class _Watch:
+    def __init__(self, server: "FakeKube", kind: str, field_selector, label_selector):
+        self.server = server
+        self.kind = kind
+        self.field_selector = field_selector
+        self.label_selector = parse_selector(label_selector)
+        self.q: "queue.Queue[WatchEvent | None]" = queue.Queue()
+        self.stopped = False
+
+    def _matches(self, obj: dict) -> bool:
+        if not match_field_selector(obj, self.field_selector):
+            return False
+        if self.label_selector is not None:
+            labels = (obj.get("metadata") or {}).get("labels") or {}
+            if not self.label_selector.matches(labels):
+                return False
+        return True
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            ev = self.q.get()
+            if ev is None:
+                return
+            yield ev
+
+    def stop(self) -> None:
+        self.stopped = True
+        self.q.put(None)
+
+
+class FakeKube:
+    """kinds: "nodes" (cluster-scoped) and "pods" (namespaced)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._store: dict[str, dict[tuple[str, str], dict]] = {"nodes": {}, "pods": {}}
+        self._rv = 0
+        self._watches: list[_Watch] = []
+        # observability for tests
+        self.patch_count = 0
+        self.delete_count = 0
+
+    # -- helpers ------------------------------------------------------------
+
+    def _key(self, namespace, name):
+        return (namespace or "", name)
+
+    def _bump(self, obj: dict) -> None:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+
+    def _emit(self, kind: str, type_: str, obj: dict) -> None:
+        for w in list(self._watches):
+            if w.stopped or w.kind != kind:
+                continue
+            if w._matches(obj):
+                w.q.put(WatchEvent(type_, copy.deepcopy(obj)))
+
+    # -- test-side API ------------------------------------------------------
+
+    def create(self, kind: str, obj: dict) -> dict:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("creationTimestamp", now_rfc3339())
+            meta.setdefault("uid", f"uid-{self._rv + 1}")
+            key = self._key(meta.get("namespace"), meta["name"])
+            self._bump(obj)
+            self._store[kind][key] = obj
+            self._emit(kind, ADDED, obj)
+            return copy.deepcopy(obj)
+
+    def update(self, kind: str, obj: dict) -> dict:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            meta = obj.get("metadata") or {}
+            key = self._key(meta.get("namespace"), meta.get("name"))
+            if key not in self._store[kind]:
+                raise KeyError(key)
+            self._bump(obj)
+            self._store[kind][key] = obj
+            self._emit(kind, MODIFIED, obj)
+            return copy.deepcopy(obj)
+
+    # -- KubeClient protocol ------------------------------------------------
+
+    def list(self, kind, *, field_selector=None, label_selector=None):
+        sel = parse_selector(label_selector)
+        with self._lock:
+            out = []
+            for obj in self._store[kind].values():
+                if not match_field_selector(obj, field_selector):
+                    continue
+                if sel is not None:
+                    labels = (obj.get("metadata") or {}).get("labels") or {}
+                    if not sel.matches(labels):
+                        continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def watch(self, kind, *, field_selector=None, label_selector=None):
+        w = _Watch(self, kind, field_selector, label_selector)
+        with self._lock:
+            self._watches.append(w)
+        return w
+
+    def get(self, kind, namespace, name):
+        with self._lock:
+            obj = self._store[kind].get(self._key(namespace, name))
+            return copy.deepcopy(obj) if obj else None
+
+    def patch_status(self, kind, namespace, name, patch):
+        with self._lock:
+            key = self._key(namespace, name)
+            obj = self._store[kind].get(key)
+            if obj is None:
+                return None
+            status = obj.get("status") or {}
+            obj["status"] = strategic_merge(status, patch.get("status", patch))
+            self._bump(obj)
+            self.patch_count += 1
+            self._emit(kind, MODIFIED, obj)
+            return copy.deepcopy(obj)
+
+    def patch_meta(self, kind, namespace, name, patch):
+        with self._lock:
+            key = self._key(namespace, name)
+            obj = self._store[kind].get(key)
+            if obj is None:
+                return None
+            meta_patch = (patch or {}).get("metadata", {})
+            meta = obj.setdefault("metadata", {})
+            for k, v in meta_patch.items():
+                if v is None:
+                    meta.pop(k, None)
+                else:
+                    meta[k] = copy.deepcopy(v)
+            self._bump(obj)
+            self._emit(kind, MODIFIED, obj)
+            return copy.deepcopy(obj)
+
+    def delete(self, kind, namespace, name, grace_seconds: int = 0):
+        with self._lock:
+            key = self._key(namespace, name)
+            obj = self._store[kind].get(key)
+            if obj is None:
+                return
+            meta = obj.setdefault("metadata", {})
+            finalizers = meta.get("finalizers") or []
+            if kind == "pods" and (grace_seconds > 0 or finalizers):
+                # graceful: mark for deletion, wait for the kubelet (the
+                # engine) to force-delete / strip finalizers
+                if "deletionTimestamp" not in meta:
+                    meta["deletionTimestamp"] = now_rfc3339()
+                meta["deletionGracePeriodSeconds"] = grace_seconds
+                self._bump(obj)
+                self._emit(kind, MODIFIED, obj)
+                return
+            del self._store[kind][key]
+            self.delete_count += 1
+            self._bump(obj)
+            self._emit(kind, DELETED, obj)
+
+
+
+
+_PATHS = re.compile(
+    r"^/api/v1(?:/namespaces/(?P<ns>[^/]+))?/(?P<kind>nodes|pods)"
+    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status))?$"
+)
+
+
+class HttpFakeApiserver:
+    def __init__(self, store: FakeKube | None = None, port: int = 0) -> None:
+        self.store = store or FakeKube()
+        handler = self._make_handler()
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="fake-apiserver"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _make_handler(self):
+        store = self.store
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send_json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n) or b"null") if n else None
+
+            def do_GET(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path == "/healthz":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                    return
+                m = _PATHS.match(parsed.path)
+                if not m:
+                    self.send_error(404)
+                    return
+                q = urllib.parse.parse_qs(parsed.query)
+                kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
+                if name:
+                    obj = store.get(kind, ns, name)
+                    if obj is None:
+                        self._send_json({"kind": "Status", "code": 404}, 404)
+                    else:
+                        self._send_json(obj)
+                    return
+                fs = (q.get("fieldSelector") or [None])[0]
+                ls = (q.get("labelSelector") or [None])[0]
+                if (q.get("watch") or ["false"])[0] in ("true", "1"):
+                    self._stream_watch(kind, fs, ls)
+                    return
+                items = store.list(kind, field_selector=fs, label_selector=ls)
+                self._send_json({
+                    "kind": "List", "apiVersion": "v1",
+                    "metadata": {}, "items": items,
+                })
+
+            def _stream_watch(self, kind, fs, ls):
+                w = store.watch(kind, field_selector=fs, label_selector=ls)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for ev in w:
+                        line = json.dumps(
+                            {"type": ev.type, "object": ev.object}
+                        ).encode() + b"\n"
+                        self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    w.stop()
+
+            def do_PATCH(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                m = _PATHS.match(parsed.path)
+                if not m or not m.group("name"):
+                    self.send_error(404)
+                    return
+                kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
+                patch = self._body()
+                if m.group("sub") == "status":
+                    obj = store.patch_status(kind, ns, name, patch)
+                else:
+                    obj = store.patch_meta(kind, ns, name, patch)
+                if obj is None:
+                    self._send_json({"kind": "Status", "code": 404}, 404)
+                else:
+                    self._send_json(obj)
+
+            def do_DELETE(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                m = _PATHS.match(parsed.path)
+                if not m or not m.group("name"):
+                    self.send_error(404)
+                    return
+                body = self._body() or {}
+                store.delete(
+                    m.group("kind"), m.group("ns"), m.group("name"),
+                    grace_seconds=int(body.get("gracePeriodSeconds") or 0),
+                )
+                self._send_json({"kind": "Status", "status": "Success"})
+
+            def do_POST(self):  # noqa: N802 (test convenience: create)
+                parsed = urllib.parse.urlparse(self.path)
+                m = _PATHS.match(parsed.path)
+                if not m:
+                    self.send_error(404)
+                    return
+                obj = self._body()
+                if m.group("ns"):
+                    obj.setdefault("metadata", {})["namespace"] = m.group("ns")
+                self._send_json(store.create(m.group("kind"), obj), 201)
+
+        return Handler
+
+def main(argv=None) -> int:
+    """Standalone mock apiserver: `--port N` then serve forever."""
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(argv)
+    srv = HttpFakeApiserver(port=args.port)
+    print(f"mock apiserver listening on {srv.url}", flush=True)
+    signal.signal(signal.SIGTERM, lambda *a: srv.httpd.shutdown())
+    try:
+        srv.httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

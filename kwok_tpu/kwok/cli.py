@@ -21,6 +21,7 @@ from kwok_tpu.config.types import (
     apply_env_overrides,
     first_of,
     load_documents,
+    parse_bool,
 )
 from kwok_tpu.models.lifecycle import ResourceKind
 
@@ -65,8 +66,7 @@ def build_parser(defaults) -> argparse.ArgumentParser:
     return p
 
 
-def _bool(v: str) -> bool:
-    return str(v).lower() in ("1", "true", "yes", "on")
+_bool = parse_bool
 
 
 def _engine_config(args, stages: list[Stage]):
@@ -108,6 +108,14 @@ def wait_for_apiserver(client, deadline_seconds: float = 120.0) -> None:
 
 
 def main(argv=None, stop_event: threading.Event | None = None) -> int:
+    # KWOK_TPU_PLATFORM forces the jax platform (e.g. "cpu") — needed when
+    # the engine runs as a subprocess on machines where a TPU plugin
+    # overrides env-level platform selection and the chip is busy.
+    plat = os.environ.get("KWOK_TPU_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     # pre-parse --config (flags.go:34-63: config parsed before cobra)
     pre = argparse.ArgumentParser(add_help=False)
     pre.add_argument("--config", default=DEFAULT_CONFIG)

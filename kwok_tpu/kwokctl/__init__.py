@@ -1,0 +1,7 @@
+"""kwokctl: the orchestration plane (SURVEY.md layers 4-6).
+
+Stands up a full simulated control plane — etcd, kube-apiserver,
+kube-controller-manager, kube-scheduler, the TPU simulation engine, and
+optionally Prometheus — as supervised host processes (`binary` runtime) or
+generated shims (`mock` runtime, for air-gapped environments).
+"""

@@ -1,0 +1,241 @@
+"""kwokctl: stand up a whole simulated control plane in one command.
+
+Behavioral port of pkg/kwokctl/cmd (root.go:56-67 verb tree,
+create/cluster/cluster.go:115-230 create flow): create/delete/start/stop
+cluster, get clusters/kubeconfig/artifacts, logs, kubectl/etcdctl
+passthrough, snapshot save/restore. `--name` is persistent; per-cluster
+state lives in ~/.kwok/clusters/<name> exactly like the reference so the
+workdir layouts interoperate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+
+from kwok_tpu.config.ctl import KwokctlConfiguration, KwokctlConfigurationOptions
+from kwok_tpu.config.types import first_of, load_documents, parse_bool
+from kwok_tpu.kwokctl import runtime as runtime_registry
+from kwok_tpu.kwokctl import vars as ctlvars
+from kwok_tpu.kwokctl.runtime.base import IN_HOST_KUBECONFIG_NAME
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kwokctl", description="kwokctl is a tool to streamline the "
+        "creation and management of simulated clusters (TPU-native engine)."
+    )
+    p.add_argument("--name", default="kwok", help="cluster name")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    # create cluster
+    create = sub.add_parser("create", help="Creates one of [cluster]")
+    create_sub = create.add_subparsers(dest="noun", required=True)
+    cc = create_sub.add_parser("cluster", help="Create a cluster")
+    cc.add_argument("--config", default="", help="extra config file (Stages etc.)")
+    cc.add_argument("--wait", default="", help="wait for ready, e.g. 120s")
+    opts = KwokctlConfigurationOptions()
+    for f in dataclasses.fields(opts):
+        flag = "--" + _kebab(f.name)
+        default = getattr(opts, f.name)
+        if isinstance(default, bool) or default is None:
+            cc.add_argument(flag, dest=f.name, default=default, type=_bool_arg)
+        elif isinstance(default, int):
+            cc.add_argument(flag, dest=f.name, default=default, type=int)
+        elif isinstance(default, float):
+            cc.add_argument(flag, dest=f.name, default=default, type=float)
+        else:
+            cc.add_argument(flag, dest=f.name, default=default)
+
+    # delete cluster
+    delete = sub.add_parser("delete", help="Deletes one of [cluster]")
+    delete_sub = delete.add_subparsers(dest="noun", required=True)
+    delete_sub.add_parser("cluster", help="Delete a cluster")
+
+    # start/stop cluster
+    for verb, help_ in (("start", "Start a cluster"), ("stop", "Stop a cluster")):
+        v = sub.add_parser(verb, help=help_)
+        v_sub = v.add_subparsers(dest="noun", required=True)
+        v_sub.add_parser("cluster", help=help_)
+
+    # get
+    get = sub.add_parser("get", help="Gets one of [artifacts, clusters, kubeconfig]")
+    get_sub = get.add_subparsers(dest="noun", required=True)
+    get_sub.add_parser("clusters", help="List existing clusters")
+    get_sub.add_parser("kubeconfig", help="Print the cluster kubeconfig path")
+    ga = get_sub.add_parser("artifacts", help="List binaries or images used by the cluster")
+    ga.add_argument("--filter", default="", choices=["", "binary", "image"])
+
+    # logs
+    logs = sub.add_parser("logs", help="Logs one of [etcd, kube-apiserver, ...]")
+    logs.add_argument("component")
+    logs.add_argument("-f", "--follow", action="store_true")
+
+    # audit-logs (reference: logs audit)
+    audit = sub.add_parser("audit-logs", help="Audit logs of the apiserver")
+    audit.add_argument("-f", "--follow", action="store_true")
+
+    # kubectl / etcdctl passthrough
+    for tool in ("kubectl", "etcdctl"):
+        t = sub.add_parser(tool, help=f"{tool} in cluster", add_help=False)
+        t.add_argument("tool_args", nargs=argparse.REMAINDER)
+
+    # snapshot
+    snap = sub.add_parser("snapshot", help="Snapshot [save, restore] one of cluster")
+    snap_sub = snap.add_subparsers(dest="noun", required=True)
+    for action in ("save", "restore"):
+        sp = snap_sub.add_parser(action)
+        sp.add_argument("--path", required=True)
+        sp.add_argument("--format", default="etcd", choices=["etcd"])
+    return p
+
+
+def _kebab(camel: str) -> str:
+    out = []
+    for ch in camel:
+        if ch.isupper():
+            out.append("-")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _bool_arg(v):
+    if v is None or isinstance(v, bool):
+        return v
+    return parse_bool(v)
+
+
+def _parse_wait(s: str) -> float:
+    from kwok_tpu.config.stages import parse_duration
+
+    return parse_duration(s) if s else 0.0
+
+
+def cmd_create(args) -> int:
+    name = args.name
+    workdir = ctlvars.cluster_workdir(name)
+
+    # precedence: flags > config file > computed defaults. Merge flags over
+    # file options FIRST, then derive defaults once, so derived fields
+    # (binary URLs, etcdVersion, securePort) see the effective kubeVersion.
+    # "Set" means "differs from the dataclass default" — for both layers.
+    opts = KwokctlConfigurationOptions()
+    extra_docs = []
+    file_conf = None
+    if args.config:
+        docs = load_documents(args.config)
+        file_conf = first_of(docs, KwokctlConfiguration)
+        extra_docs = [d for d in docs if not isinstance(d, KwokctlConfiguration)]
+    for f in dataclasses.fields(opts):
+        flag_v = getattr(args, f.name)
+        if flag_v != f.default:
+            setattr(opts, f.name, flag_v)
+        elif file_conf is not None:
+            file_v = getattr(file_conf.options, f.name)
+            if file_v != f.default:
+                setattr(opts, f.name, file_v)
+    ctlvars.set_defaults(opts)
+
+    exists = os.path.exists(os.path.join(workdir, "kwok.yaml"))
+    if exists:
+        print(f"Cluster {name!r} already exists, reinstalling", file=sys.stderr)
+        rt = runtime_registry.load(name, workdir)
+        try:
+            rt.down()
+        except Exception:
+            pass
+    rt = runtime_registry.get(opts.runtime, name, workdir)
+    conf = KwokctlConfiguration(options=opts, name=name)
+    rt.set_config(conf)
+    os.makedirs(workdir, exist_ok=True)
+    rt.save(extra_docs)
+    print(f"Creating cluster {name!r} (runtime {opts.runtime})", file=sys.stderr)
+    rt.install()
+    rt.save(extra_docs)
+    rt.up()
+    wait = _parse_wait(args.wait)
+    if wait:
+        rt.wait_ready(wait)
+    kc = os.path.join(workdir, IN_HOST_KUBECONFIG_NAME)
+    print(f"Cluster {name!r} is ready; kubeconfig: {kc}", file=sys.stderr)
+    print(f'> kubectl --kubeconfig {kc} get nodes', file=sys.stderr)
+    return 0
+
+
+def _loaded(args):
+    return runtime_registry.load(args.name, ctlvars.cluster_workdir(args.name))
+
+
+def cmd_delete(args) -> int:
+    rt = _loaded(args)
+    try:
+        rt.down()
+    except Exception:
+        pass
+    rt.uninstall()
+    print(f"Cluster {args.name!r} deleted", file=sys.stderr)
+    return 0
+
+
+def cmd_get(args) -> int:
+    if args.noun == "clusters":
+        base_dir = ctlvars.clusters_dir()
+        if os.path.isdir(base_dir):
+            for entry in sorted(os.listdir(base_dir)):
+                if os.path.exists(os.path.join(base_dir, entry, "kwok.yaml")):
+                    print(entry)
+        return 0
+    if args.noun == "kubeconfig":
+        print(
+            os.path.join(ctlvars.cluster_workdir(args.name), IN_HOST_KUBECONFIG_NAME)
+        )
+        return 0
+    rt = _loaded(args)
+    arts = []
+    if args.filter in ("", "binary"):
+        arts += rt.list_binaries()
+    if args.filter in ("", "image"):
+        arts += rt.list_images()
+    for a in arts:
+        if a:
+            print(a)
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    verb = args.verb
+    if verb == "create":
+        return cmd_create(args)
+    if verb == "delete":
+        return cmd_delete(args)
+    if verb == "start":
+        _loaded(args).start()
+        return 0
+    if verb == "stop":
+        _loaded(args).stop()
+        return 0
+    if verb == "get":
+        return cmd_get(args)
+    if verb == "logs":
+        _loaded(args).logs(args.component, sys.stdout, follow=args.follow)
+        return 0
+    if verb == "audit-logs":
+        _loaded(args).audit_logs(sys.stdout, follow=args.follow)
+        return 0
+    if verb == "kubectl":
+        return _loaded(args).kubectl_in_cluster(list(args.tool_args))
+    if verb == "etcdctl":
+        return _loaded(args).etcdctl_in_cluster(list(args.tool_args))
+    if verb == "snapshot":
+        rt = _loaded(args)
+        if args.noun == "save":
+            rt.snapshot_save(args.path)
+        else:
+            rt.snapshot_restore(args.path)
+        return 0
+    raise AssertionError(verb)

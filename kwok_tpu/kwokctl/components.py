@@ -1,0 +1,337 @@
+"""Declarative component builders + start-order grouping.
+
+Behavioral port of pkg/kwokctl/components: each build_* function is a pure
+function from a config to a Component spec (binary path + argv + links);
+group_by_links is the reference's topological batching (utils.go:33-65) that
+yields waves of components safe to start concurrently.
+
+Arg matrices follow the reference builders (etcd.go:36-92,
+kube_apiserver.go:45-195, kube_controller_manager.go:40-160,
+kube_scheduler.go:39-140, kwok_controller.go:37-99, prometheus.go:38-133),
+host-process ("binary") flavor only — the container branches belong to the
+compose runtime.
+"""
+
+from __future__ import annotations
+
+from kwok_tpu.config.ctl import Component
+
+LOCAL_ADDRESS = "127.0.0.1"
+
+
+class BrokenLinksError(ValueError):
+    pass
+
+
+def group_by_links(components: list[Component]) -> list[list[Component]]:
+    """Batch components into start waves: a component joins the earliest wave
+    after all of its links (utils.go GroupByLinks)."""
+    placed: set[str] = set()
+    remaining = list(components)
+    groups: list[list[Component]] = []
+    while remaining:
+        wave = [c for c in remaining if all(l in placed for l in c.links)]
+        if not wave:
+            raise BrokenLinksError(
+                f"broken links dependency detected: {[c.name for c in remaining]}"
+            )
+        remaining = [c for c in remaining if c not in wave]
+        placed.update(c.name for c in wave)
+        groups.append(wave)
+    return groups
+
+
+def build_etcd(
+    binary: str,
+    data_path: str,
+    workdir: str,
+    version: str = "",
+    address: str = LOCAL_ADDRESS,
+    port: int = 2379,
+    peer_port: int = 2380,
+) -> Component:
+    return Component(
+        name="etcd",
+        version=version,
+        binary=binary,
+        command=["etcd"],
+        workDir=workdir,
+        args=[
+            "--name=node0",
+            f"--initial-advertise-peer-urls=http://{address}:{peer_port}",
+            f"--listen-peer-urls=http://{address}:{peer_port}",
+            f"--advertise-client-urls=http://{address}:{port}",
+            f"--listen-client-urls=http://{address}:{port}",
+            f"--initial-cluster=node0=http://{address}:{peer_port}",
+            "--auto-compaction-retention=1",
+            "--quota-backend-bytes=8589934592",
+            f"--data-dir={data_path}",
+        ],
+    )
+
+
+def build_kube_apiserver(
+    binary: str,
+    workdir: str,
+    port: int,
+    version: str = "",
+    address: str = LOCAL_ADDRESS,
+    etcd_address: str = LOCAL_ADDRESS,
+    etcd_port: int = 2379,
+    runtime_config: str = "",
+    feature_gates: str = "",
+    secure_port: bool = False,
+    authorization: bool = False,
+    audit_policy_path: str = "",
+    audit_log_path: str = "",
+    ca_cert_path: str = "",
+    admin_cert_path: str = "",
+    admin_key_path: str = "",
+) -> Component:
+    args = [
+        "--admission-control=",
+        f"--etcd-servers=http://{etcd_address}:{etcd_port}",
+        "--etcd-prefix=/registry",
+        "--allow-privileged=true",
+    ]
+    if runtime_config:
+        args.append(f"--runtime-config={runtime_config}")
+    if feature_gates:
+        args.append(f"--feature-gates={feature_gates}")
+    if secure_port:
+        if authorization:
+            args.append("--authorization-mode=Node,RBAC")
+        args += [
+            f"--bind-address={address}",
+            f"--secure-port={port}",
+            f"--tls-cert-file={admin_cert_path}",
+            f"--tls-private-key-file={admin_key_path}",
+            f"--client-ca-file={ca_cert_path}",
+            f"--service-account-key-file={admin_key_path}",
+            f"--service-account-signing-key-file={admin_key_path}",
+            "--service-account-issuer=https://kubernetes.default.svc.cluster.local",
+        ]
+    else:
+        args += [
+            f"--insecure-bind-address={address}",
+            f"--insecure-port={port}",
+        ]
+    if audit_policy_path:
+        args += [
+            f"--audit-policy-file={audit_policy_path}",
+            f"--audit-log-path={audit_log_path}",
+        ]
+    return Component(
+        name="kube-apiserver",
+        version=version,
+        links=["etcd"],
+        binary=binary,
+        command=["kube-apiserver"],
+        workDir=workdir,
+        args=args,
+    )
+
+
+def build_kube_controller_manager(
+    binary: str,
+    workdir: str,
+    kubeconfig_path: str,
+    port: int,
+    version: str = "",
+    address: str = LOCAL_ADDRESS,
+    secure_port: bool = False,
+    authorization: bool = False,
+    feature_gates: str = "",
+    ca_cert_path: str = "",
+    admin_key_path: str = "",
+    node_monitor_period_s: float = 0.0,
+    node_monitor_grace_period_s: float = 0.0,
+) -> Component:
+    args = []
+    if feature_gates:
+        args.append(f"--feature-gates={feature_gates}")
+    args.append(f"--kubeconfig={kubeconfig_path}")
+    if secure_port:
+        args += [
+            "--authorization-always-allow-paths=/healthz,/readyz,/livez,/metrics",
+            f"--bind-address={address}",
+            f"--secure-port={port}",
+        ]
+    else:
+        args += [
+            f"--address={address}",
+            f"--port={port}",
+            "--secure-port=0",
+        ]
+    if authorization:
+        args += [
+            f"--root-ca-file={ca_cert_path}",
+            f"--service-account-private-key-file={admin_key_path}",
+        ]
+    # accelerated node-failure detection for simulation scenarios
+    # (kube_controller_manager.go NodeMonitor options)
+    if node_monitor_period_s:
+        args.append(f"--node-monitor-period={node_monitor_period_s}s")
+    if node_monitor_grace_period_s:
+        args.append(f"--node-monitor-grace-period={node_monitor_grace_period_s}s")
+    return Component(
+        name="kube-controller-manager",
+        version=version,
+        links=["kube-apiserver"],
+        binary=binary,
+        command=["kube-controller-manager"],
+        workDir=workdir,
+        args=args,
+    )
+
+
+def build_kube_scheduler(
+    binary: str,
+    workdir: str,
+    kubeconfig_path: str,
+    port: int,
+    version: str = "",
+    address: str = LOCAL_ADDRESS,
+    secure_port: bool = False,
+    feature_gates: str = "",
+) -> Component:
+    args = []
+    if feature_gates:
+        args.append(f"--feature-gates={feature_gates}")
+    args.append(f"--kubeconfig={kubeconfig_path}")
+    if secure_port:
+        args += [
+            "--authorization-always-allow-paths=/healthz,/readyz,/livez,/metrics",
+            f"--bind-address={address}",
+            f"--secure-port={port}",
+        ]
+    else:
+        args += [
+            f"--address={address}",
+            f"--port={port}",
+        ]
+    return Component(
+        name="kube-scheduler",
+        version=version,
+        links=["kube-apiserver"],
+        binary=binary,
+        command=["kube-scheduler"],
+        workDir=workdir,
+        args=args,
+    )
+
+
+def build_kwok_controller(
+    binary: str,
+    workdir: str,
+    kubeconfig_path: str,
+    config_path: str,
+    port: int,
+    version: str = "",
+    address: str = LOCAL_ADDRESS,
+) -> Component:
+    """The simulation engine — THIS package's `kwok` CLI, launched via the
+    shim written by the binary runtime (kwok_controller.go:61-83 arg
+    surface)."""
+    return Component(
+        name="kwok-controller",
+        version=version,
+        links=["kube-apiserver"],
+        binary=binary,
+        command=["kwok"],
+        workDir=workdir,
+        args=[
+            "--manage-all-nodes=true",
+            f"--kubeconfig={kubeconfig_path}",
+            f"--config={config_path}",
+            f"--server-address={address}:{port}",
+        ],
+    )
+
+
+def build_prometheus(
+    binary: str,
+    workdir: str,
+    config_path: str,
+    port: int,
+    version: str = "",
+    address: str = LOCAL_ADDRESS,
+    links: list[str] | None = None,
+) -> Component:
+    # default links assume the full control plane; callers with disabled
+    # components must pass the names actually present, or group_by_links
+    # could never place prometheus
+    return Component(
+        name="prometheus",
+        version=version,
+        links=list(links)
+        if links is not None
+        else [
+            "etcd",
+            "kube-apiserver",
+            "kube-controller-manager",
+            "kube-scheduler",
+            "kwok-controller",
+        ],
+        binary=binary,
+        command=["prometheus"],
+        workDir=workdir,
+        args=[
+            f"--config.file={config_path}",
+            f"--web.listen-address={address}:{port}",
+        ],
+    )
+
+
+def build_prometheus_config(
+    project_name: str,
+    etcd_port: int,
+    kube_apiserver_port: int,
+    kube_controller_manager_port: int,
+    kube_scheduler_port: int,
+    kwok_controller_port: int,
+    secure_port: bool = False,
+    admin_crt_path: str = "",
+    admin_key_path: str = "",
+) -> str:
+    """Scrape config over every control-plane component
+    (runtime/binary/prometheus.yaml.tpl semantics)."""
+    scheme = "https" if secure_port else "http"
+    tls = ""
+    if secure_port:
+        tls = (
+            "    tls_config:\n"
+            "      insecure_skip_verify: true\n"
+            f"      cert_file: {admin_crt_path}\n"
+            f"      key_file: {admin_key_path}\n"
+        )
+
+    def job(name: str, port: int, metrics_path: str = "/metrics", secure: bool = True) -> str:
+        sch = scheme if secure else "http"
+        out = (
+            f"  - job_name: {name}\n"
+            f"    scheme: {sch}\n"
+            f"    metrics_path: {metrics_path}\n"
+        )
+        if secure and tls:
+            out += tls
+        out += (
+            "    static_configs:\n"
+            f"      - targets: ['127.0.0.1:{port}']\n"
+        )
+        return out
+
+    cfg = (
+        "global:\n"
+        "  scrape_interval: 15s\n"
+        f"  external_labels:\n    cluster: {project_name}\n"
+        "scrape_configs:\n"
+    )
+    cfg += job("etcd", etcd_port, secure=False)
+    cfg += job("kube-apiserver", kube_apiserver_port)
+    if kube_controller_manager_port:
+        cfg += job("kube-controller-manager", kube_controller_manager_port)
+    if kube_scheduler_port:
+        cfg += job("kube-scheduler", kube_scheduler_port)
+    cfg += job("kwok-controller", kwok_controller_port, secure=False)
+    return cfg

@@ -1,0 +1,29 @@
+"""Project constants (pkg/consts/consts.go).
+
+Download endpoints are the upstream public release channels; the
+kwok-controller itself is THIS package (launched via a generated shim), so
+there is no controller download.
+"""
+
+PROJECT_NAME = "kwok"
+CONFIG_NAME = "kwok.yaml"
+
+DEFAULT_KUBE_VERSION = "v1.26.0"
+
+KUBE_BINARY_PREFIX = "https://dl.k8s.io/release"
+ETCD_BINARY_PREFIX = "https://github.com/etcd-io/etcd/releases/download"
+PROMETHEUS_VERSION = "2.41.0"
+PROMETHEUS_BINARY_PREFIX = "https://github.com/prometheus/prometheus/releases/download"
+
+RUNTIME_TYPE_BINARY = "binary"
+RUNTIME_TYPE_MOCK = "mock"  # in-process runtime for tests/CI (no downloads)
+
+# Mode presets (kwokctl_configuration_types.go ModeStableFeatureGateAndAPI)
+MODE_STABLE_FEATURE_GATE_AND_API = "StableFeatureGateAndAPI"
+
+COMPONENT_ETCD = "etcd"
+COMPONENT_KUBE_APISERVER = "kube-apiserver"
+COMPONENT_KUBE_CONTROLLER_MANAGER = "kube-controller-manager"
+COMPONENT_KUBE_SCHEDULER = "kube-scheduler"
+COMPONENT_KWOK_CONTROLLER = "kwok-controller"
+COMPONENT_PROMETHEUS = "prometheus"

@@ -1,0 +1,100 @@
+"""Download-with-cache for control-plane binaries.
+
+Behavioral port of pkg/utils/file/download.go:35-112: a sha256(url)-keyed
+cache directory, atomic rename into place, optional single-member extraction
+from .tar.gz / .zip archives (DownloadWithCacheAndExtract). Uses stdlib
+urllib; zero-egress environments simply fail with a clear error, and local
+`file://` or absolute paths bypass the network entirely (the e2e path in CI
+pre-seeds the cache or points at binaries already on disk).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import tempfile
+import urllib.request
+import zipfile
+
+
+def _cache_key(url: str) -> str:
+    return hashlib.sha256(url.encode()).hexdigest()
+
+
+def _fetch_to_cache(cache_dir: str, url: str, quiet: bool = False) -> str:
+    """Return a local path for url: as-is for local files, else the cache
+    entry (downloading on miss)."""
+    if url.startswith("file://"):
+        return url[len("file://") :]
+    if os.path.sep in url and os.path.exists(url):
+        return url
+    os.makedirs(cache_dir, exist_ok=True)
+    cached = os.path.join(cache_dir, _cache_key(url))
+    if os.path.exists(cached):
+        return cached
+    if not quiet:
+        print(f"Downloading {url}")
+    tmp = cached + ".tmp"
+    try:
+        with urllib.request.urlopen(url) as resp, open(tmp, "wb") as out:
+            shutil.copyfileobj(resp, out)
+    except OSError as e:
+        try:
+            os.remove(tmp)
+        except FileNotFoundError:
+            pass
+        raise RuntimeError(
+            f"failed to download {url}: {e} "
+            "(offline? pre-seed the cache dir or pass a local path)"
+        ) from e
+    os.replace(tmp, cached)
+    return cached
+
+
+def download_with_cache(
+    cache_dir: str, src: str, dest: str, mode: int = 0o755, quiet: bool = False
+) -> None:
+    """Fetch src (url or local path) to dest with the cache in between."""
+    local = _fetch_to_cache(cache_dir, src, quiet)
+    os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+    if os.path.abspath(local) != os.path.abspath(dest):
+        tmp = dest + ".tmp"
+        shutil.copyfile(local, tmp)
+        os.replace(tmp, dest)
+    os.chmod(dest, mode)
+
+
+def download_with_cache_and_extract(
+    cache_dir: str,
+    src: str,
+    dest: str,
+    member: str,
+    mode: int = 0o755,
+    quiet: bool = False,
+) -> None:
+    """Fetch an archive and extract the single file whose basename is
+    `member` to dest (download.go:85-112)."""
+    local = _fetch_to_cache(cache_dir, src, quiet)
+    os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+    with tempfile.TemporaryDirectory(dir=os.path.dirname(dest)) as td:
+        extracted = _extract_member(local, member, td)
+        os.replace(extracted, dest)
+    os.chmod(dest, mode)
+
+
+def _extract_member(archive: str, member: str, outdir: str) -> str:
+    if archive.endswith(".zip"):
+        with zipfile.ZipFile(archive) as z:
+            for info in z.infolist():
+                if os.path.basename(info.filename) == member:
+                    z.extract(info, outdir)
+                    return os.path.join(outdir, info.filename)
+    else:
+        with tarfile.open(archive) as t:
+            for info in t:
+                if info.isfile() and os.path.basename(info.name) == member:
+                    t.extract(info, outdir, filter="data")
+                    return os.path.join(outdir, info.name)
+    raise FileNotFoundError(f"member {member!r} not found in {archive}")

@@ -1,0 +1,126 @@
+"""Version-aware Kubernetes matrices + kubeconfig rendering.
+
+Behavioral port of pkg/kwokctl/k8s: get_feature_gates (feature_gates.go:28-60
+"enable only the beta features that eventually went GA"), get_runtime_config
+(runtime_config.go:19), get_etcd_version (etcd.go:47-73 kubeadm constants
+table), build_kubeconfig (kubeconfig.go:32-47 + kubeconfig.yaml.tpl).
+"""
+
+from __future__ import annotations
+
+import re
+
+from kwok_tpu.kwokctl.feature_gates_data import BETA, DEPRECATED, GA, FEATURE_GATES
+
+
+def parse_release(version: str) -> int:
+    """'v1.26.0' / '1.26' -> 26; unparseable -> -1 (vars.go parseRelease)."""
+    m = re.match(r"^v?\d+\.(\d+)", version.strip())
+    return int(m.group(1)) if m else -1
+
+
+def get_feature_gates(release: int) -> str:
+    """Stable-mode gate string for k8s 1.<release>.
+
+    Policy (feature_gates.go:39-61): every gate that is Beta in this release
+    is pinned — to true only if some later stage of that gate reached GA
+    (i.e. the beta eventually graduated), else to false. Alpha gates are
+    never enabled.
+    """
+    if release < 0:
+        return ""
+    went_ga: dict[str, bool] = {}
+    for name, stage, _since, _until in FEATURE_GATES:
+        if stage == GA:
+            went_ga.setdefault(name, True)
+        elif stage == DEPRECATED:
+            went_ga[name] = False
+    enables: dict[str, bool] = {}
+    for name, stage, since, until in FEATURE_GATES:
+        if since <= release and (until < 0 or release <= until):
+            if stage == BETA:
+                enables[name] = went_ga.get(name, False)
+    return ",".join(
+        f"{name}={str(val).lower()}" for name, val in sorted(enables.items())
+    )
+
+
+def get_runtime_config(release: int) -> str:
+    """Stable-mode --runtime-config (runtime_config.go:19-24)."""
+    if release < 17:
+        return ""
+    return "api/legacy=false,api/alpha=false"
+
+
+# kubeadm's etcd-per-k8s-minor constants (etcd.go:28-45); '-0' image-tag
+# suffixes dropped since the binary runtime downloads plain release tars.
+_ETCD_VERSIONS = {
+    8: "3.0.17",
+    9: "3.1.12",
+    10: "3.1.12",
+    11: "3.2.18",
+    12: "3.2.24",
+    13: "3.2.24",
+    14: "3.3.10",
+    15: "3.3.10",
+    16: "3.3.17",
+    17: "3.4.3",
+    18: "3.4.3",
+    19: "3.4.13",
+    20: "3.4.13",
+    21: "3.4.13",
+    22: "3.5.6",
+    23: "3.5.6",
+    24: "3.5.6",
+    25: "3.5.6",
+}
+
+
+def get_etcd_version(release: int) -> str:
+    """etcd version for k8s 1.<release>, clamped to the table's range
+    (etcd.go:47-73)."""
+    if release < 0:
+        return "unknown"
+    if release in _ETCD_VERSIONS:
+        return _ETCD_VERSIONS[release]
+    lo, hi = min(_ETCD_VERSIONS), max(_ETCD_VERSIONS)
+    return _ETCD_VERSIONS[min(max(release, lo), hi)]
+
+
+def build_kubeconfig(
+    project_name: str,
+    address: str,
+    secure_port: bool = False,
+    admin_crt_path: str = "",
+    admin_key_path: str = "",
+) -> str:
+    """Render a kubeconfig document (kubeconfig.yaml.tpl semantics: client
+    certs + skip-tls-verify only on the secure path)."""
+    lines = [
+        "apiVersion: v1",
+        "kind: Config",
+        "preferences: {}",
+        f"current-context: {project_name}",
+        "clusters:",
+        f"  - name: {project_name}",
+        "    cluster:",
+        f"      server: {address}",
+    ]
+    if secure_port:
+        lines.append("      insecure-skip-tls-verify: true")
+    lines += [
+        "contexts:",
+        f"  - name: {project_name}",
+        "    context:",
+        f"      cluster: {project_name}",
+    ]
+    if secure_port:
+        lines += [
+            f"      user: {project_name}",
+            "users:",
+            f"  - name: {project_name}",
+            "    user:",
+            f"      client-certificate: {admin_crt_path}",
+            f"      client-key: {admin_key_path}",
+        ]
+    return "\n".join(lines) + "\n"

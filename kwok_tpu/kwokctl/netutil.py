@@ -1,0 +1,21 @@
+"""Small networking helpers (pkg/utils/net)."""
+
+from __future__ import annotations
+
+import socket
+
+
+def get_unused_port() -> int:
+    """Bind port 0, return the kernel-assigned port (net.GetUnusedPort)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def is_port_open(host: str, port: int, timeout: float = 0.5) -> bool:
+    try:
+        with socket.create_connection((host, port), timeout=timeout):
+            return True
+    except OSError:
+        return False

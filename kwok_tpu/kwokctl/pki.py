@@ -1,0 +1,117 @@
+"""Self-signed PKI for the local control plane.
+
+Behavioral port of pkg/kwokctl/pki (pki.go:33-91, pkiutil.go:72-141): one CA
+plus an admin cert/key pair whose key doubles as the service-account signing
+key. ECDSA P-256, ~100-year validity, SANs covering localhost loopback.
+Implemented with the `cryptography` package instead of Go crypto/x509.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+CA_NAME = "kwok-ca"
+ADMIN_NAME = "kwok-admin"
+_HUNDRED_YEARS = datetime.timedelta(days=365 * 100)
+
+
+def _write(path: str, data: bytes, mode: int) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+    os.chmod(path, mode)
+
+
+def _key_pem(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+def generate_pki(pki_dir: str, sans: tuple[str, ...] = ()) -> None:
+    """Write ca.crt / ca.key / admin.crt / admin.key into pki_dir
+    (pki.go GeneratePki layout)."""
+    os.makedirs(pki_dir, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc) - datetime.timedelta(hours=1)
+
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    ca_subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, CA_NAME)])
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_subject)
+        .issuer_name(ca_subject)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + _HUNDRED_YEARS)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True,
+                key_cert_sign=True,
+                crl_sign=True,
+                content_commitment=False,
+                key_encipherment=False,
+                data_encipherment=False,
+                key_agreement=False,
+                encipher_only=False,
+                decipher_only=False,
+            ),
+            critical=True,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    admin_key = ec.generate_private_key(ec.SECP256R1())
+    # system:masters group grants cluster-admin through the subject's O
+    # (pkiutil.go NewCertAndKey admin semantics)
+    admin_subject = x509.Name(
+        [
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, "system:masters"),
+            x509.NameAttribute(NameOID.COMMON_NAME, ADMIN_NAME),
+        ]
+    )
+    alt_names: list[x509.GeneralName] = [
+        x509.DNSName("localhost"),
+        x509.DNSName("kubernetes"),
+        x509.DNSName("kubernetes.default"),
+        x509.DNSName("kubernetes.default.svc"),
+        x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+        x509.IPAddress(ipaddress.ip_address("::1")),
+    ]
+    for san in sans:
+        try:
+            alt_names.append(x509.IPAddress(ipaddress.ip_address(san)))
+        except ValueError:
+            alt_names.append(x509.DNSName(san))
+    admin_cert = (
+        x509.CertificateBuilder()
+        .subject_name(admin_subject)
+        .issuer_name(ca_subject)
+        .public_key(admin_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + _HUNDRED_YEARS)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+        .add_extension(
+            x509.ExtendedKeyUsage(
+                [ExtendedKeyUsageOID.SERVER_AUTH, ExtendedKeyUsageOID.CLIENT_AUTH]
+            ),
+            critical=False,
+        )
+        .add_extension(x509.SubjectAlternativeName(alt_names), critical=False)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    _write(os.path.join(pki_dir, "ca.crt"), ca_cert.public_bytes(serialization.Encoding.PEM), 0o644)
+    _write(os.path.join(pki_dir, "ca.key"), _key_pem(ca_key), 0o600)
+    _write(os.path.join(pki_dir, "admin.crt"), admin_cert.public_bytes(serialization.Encoding.PEM), 0o644)
+    _write(os.path.join(pki_dir, "admin.key"), _key_pem(admin_key), 0o600)

@@ -1,0 +1,130 @@
+"""Detached-process supervision with a restartable on-disk layout.
+
+Behavioral port of pkg/utils/exec (cmd.go:35-137, cmd_other.go:28-49):
+components run as daemonized children whose state survives the orchestrator
+exiting — `<workdir>/pids/<name>.pid`, `<workdir>/cmdline/<name>` (NUL-joined
+argv, so `fork_exec_restart` can replay the exact command after a host
+reboot), `<workdir>/logs/<name>.log`. Liveness = signal 0 on the stored pid.
+The layout is byte-compatible with the reference so its clusters could be
+adopted in place.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+
+
+def _pid_path(workdir: str, name: str) -> str:
+    return os.path.join(workdir, "pids", os.path.basename(name) + ".pid")
+
+
+def _cmdline_path(workdir: str, name: str) -> str:
+    return os.path.join(workdir, "cmdline", os.path.basename(name))
+
+
+def log_path(workdir: str, name: str) -> str:
+    return os.path.join(workdir, "logs", os.path.basename(name) + ".log")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    except OSError:
+        return False
+    return True
+
+
+def _read_pid(workdir: str, name: str) -> int | None:
+    try:
+        with open(_pid_path(workdir, name)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def is_running(workdir: str, name: str) -> bool:
+    pid = _read_pid(workdir, name)
+    return pid is not None and _pid_alive(pid)
+
+
+def fork_exec(workdir: str, binary: str, *args: str) -> None:
+    """Start `binary args...` detached; no-op if the pid file still points at
+    a live process (cmd.go:35-92)."""
+    pid = _read_pid(workdir, binary)
+    if pid is not None and _pid_alive(pid):
+        return
+
+    argv = [binary, *args]
+    lp = log_path(workdir, binary)
+    cp = _cmdline_path(workdir, binary)
+    pp = _pid_path(workdir, binary)
+    for p in (lp, cp, pp):
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+
+    with open(cp, "w") as f:
+        f.write("\x00".join(argv))
+    logf = open(lp, "wb")
+    try:
+        proc = subprocess.Popen(
+            argv,
+            cwd=workdir,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            start_new_session=True,  # Setsid detach (cmd_other.go:28-35)
+        )
+    finally:
+        logf.close()
+    with open(pp, "w") as f:
+        f.write(str(proc.pid))
+
+
+def fork_exec_restart(workdir: str, name: str) -> None:
+    """Replay the stored cmdline (cmd.go:95-106)."""
+    with open(_cmdline_path(workdir, name)) as f:
+        argv = f.read().split("\x00")
+    fork_exec(workdir, argv[0], *argv[1:])
+
+
+def fork_exec_kill(workdir: str, name: str, timeout: float = 10.0) -> None:
+    """SIGTERM (grace) then SIGKILL the stored pid; remove the pid file
+    (cmd.go:109-137; the reference SIGKILLs immediately — we give components
+    a short grace so etcd can fsync)."""
+    import time
+
+    pid = _read_pid(workdir, name)
+    if pid is None:
+        return
+    if _pid_alive(pid):
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and _pid_alive(pid):
+            time.sleep(0.05)
+        if _pid_alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        # reap if it was our child; ignore ECHILD for adopted processes
+        try:
+            os.waitpid(pid, 0)
+        except ChildProcessError:
+            pass
+        except OSError:
+            pass
+    try:
+        os.remove(_pid_path(workdir, name))
+    except FileNotFoundError:
+        pass
+
+
+def exec_foreground(argv: list[str], workdir: str = "", **kwargs) -> int:
+    """Run a command in the foreground wired to our stdio (cmd.go Exec)."""
+    return subprocess.call(argv, cwd=workdir or None, **kwargs)

@@ -1,0 +1,52 @@
+"""Runtime registry (pkg/kwokctl/runtime/registry.go).
+
+`get` builds a runtime by name; `load` re-reads a saved cluster's config to
+pick the runtime that created it (registry.go:50-66), so every later verb
+(start/stop/logs/snapshot/delete) works without repeating --runtime.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kwok_tpu.config.ctl import KwokctlConfiguration
+from kwok_tpu.config.types import first_of, load_documents
+from kwok_tpu.kwokctl.runtime.base import CONFIG_NAME, Cluster
+from kwok_tpu.kwokctl.runtime.binary import BinaryCluster
+from kwok_tpu.kwokctl.runtime.mock import MockCluster
+
+_REGISTRY: dict[str, type[Cluster]] = {}
+
+
+def register(name: str, cls: type[Cluster]) -> None:
+    _REGISTRY[name] = cls
+
+
+def get(runtime: str, name: str, workdir: str) -> Cluster:
+    try:
+        cls = _REGISTRY[runtime]
+    except KeyError:
+        raise ValueError(
+            f"unknown runtime {runtime!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+    return cls(name, workdir)
+
+
+def load(name: str, workdir: str) -> Cluster:
+    """Pick the runtime from the cluster's saved config."""
+    conf = first_of(
+        load_documents(os.path.join(workdir, CONFIG_NAME)), KwokctlConfiguration
+    )
+    if conf is None:
+        raise FileNotFoundError(f"cluster {name!r} does not exist (no {CONFIG_NAME})")
+    rt = get(conf.options.runtime, name, workdir)
+    rt.set_config(conf)
+    return rt
+
+
+def known_runtimes() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+register(BinaryCluster.RUNTIME, BinaryCluster)
+register(MockCluster.RUNTIME, MockCluster)

@@ -1,0 +1,204 @@
+"""Runtime contract + base cluster implementation.
+
+Behavioral port of pkg/kwokctl/runtime/{config.go,cluster.go}: the Runtime
+interface is the 24-method lifecycle contract every backend implements; the
+base Cluster provides the workdir layout (`kwok.yaml` config round-trip,
+bin/ logs/ pki/ subdirs), readiness = GET /healthz == "ok" against the
+apiserver (cluster.go:164-182, via direct HTTP instead of shelling to
+kubectl), WaitReady polling (:184-207), kubectl passthrough and log access.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import ssl
+import time
+import urllib.error
+import urllib.request
+
+from kwok_tpu.config.ctl import Component, KwokctlConfiguration
+from kwok_tpu.config.types import load_documents, save_documents, first_of
+from kwok_tpu.kwokctl import procutil
+
+CONFIG_NAME = "kwok.yaml"
+IN_HOST_KUBECONFIG_NAME = "kubeconfig.yaml"
+ETCD_DATA_DIR_NAME = "etcd"
+PKI_NAME = "pki"
+PROMETHEUS_NAME = "prometheus.yaml"
+AUDIT_POLICY_NAME = "audit.yaml"
+AUDIT_LOG_NAME = "audit.log"
+
+
+class ComponentNotFoundError(KeyError):
+    pass
+
+
+class Cluster:
+    """Base runtime; backends subclass and override the lifecycle verbs."""
+
+    def __init__(self, name: str, workdir: str) -> None:
+        self.name = name
+        self.workdir = workdir
+        self._conf: KwokctlConfiguration | None = None
+
+    # --- workdir layout ---------------------------------------------------
+
+    def workdir_path(self, *names: str) -> str:
+        return os.path.join(self.workdir, *names)
+
+    def bin_path(self, name: str) -> str:
+        return os.path.join(self.workdir, "bin", name)
+
+    def log_path(self, name: str) -> str:
+        return os.path.join(self.workdir, "logs", name)
+
+    # --- config round-trip ------------------------------------------------
+
+    def config(self) -> KwokctlConfiguration:
+        if self._conf is None:
+            conf = first_of(
+                load_documents(self.workdir_path(CONFIG_NAME)), KwokctlConfiguration
+            )
+            if conf is None:
+                raise FileNotFoundError(
+                    f"no cluster config at {self.workdir_path(CONFIG_NAME)}"
+                )
+            self._conf = conf
+        return self._conf
+
+    def set_config(self, conf: KwokctlConfiguration) -> None:
+        self._conf = conf
+
+    def save(self, extra_docs: list | None = None) -> None:
+        if self._conf is None:
+            return
+        docs: list = [self._conf]
+        if extra_docs:
+            docs += extra_docs
+        save_documents(self.workdir_path(CONFIG_NAME), docs)
+
+    # --- lifecycle (overridden by backends) -------------------------------
+
+    def install(self) -> None:
+        raise NotImplementedError
+
+    def uninstall(self) -> None:
+        """Remove the whole workdir (cluster.go Uninstall)."""
+        shutil.rmtree(self.workdir, ignore_errors=True)
+
+    def up(self) -> None:
+        raise NotImplementedError
+
+    def down(self) -> None:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        self.up()
+
+    def stop(self) -> None:
+        self.down()
+
+    def start_component(self, name: str) -> None:
+        raise NotImplementedError
+
+    def stop_component(self, name: str) -> None:
+        raise NotImplementedError
+
+    def get_component(self, name: str) -> Component:
+        for c in self.config().components:
+            if c.name == name:
+                return c
+        raise ComponentNotFoundError(name)
+
+    # --- readiness --------------------------------------------------------
+
+    def apiserver_url(self) -> str:
+        conf = self.config().options
+        scheme = "https" if conf.securePort else "http"
+        return f"{scheme}://127.0.0.1:{conf.kubeApiserverPort}"
+
+    def ready(self) -> bool:
+        """GET /healthz == b"ok" (cluster.go:164-182)."""
+        url = self.apiserver_url() + "/healthz"
+        ctx = None
+        if url.startswith("https"):
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            pki = self.workdir_path(PKI_NAME)
+            admin_crt = os.path.join(pki, "admin.crt")
+            if os.path.exists(admin_crt):
+                ctx.load_cert_chain(admin_crt, os.path.join(pki, "admin.key"))
+        try:
+            with urllib.request.urlopen(url, timeout=2, context=ctx) as r:
+                return r.read() == b"ok"
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def wait_ready(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.ready():
+                return
+            time.sleep(1.0)
+        raise TimeoutError(f"cluster {self.name} not ready after {timeout}s")
+
+    # --- tool passthrough -------------------------------------------------
+
+    def kubectl_path(self) -> str:
+        """PATH kubectl, else the workdir copy downloaded at install
+        (cluster.go kubectlPath)."""
+        found = shutil.which("kubectl")
+        if found:
+            return found
+        return self.bin_path("kubectl")
+
+    def kubectl(self, args: list[str], **kwargs) -> int:
+        return procutil.exec_foreground([self.kubectl_path(), *args], **kwargs)
+
+    def kubectl_in_cluster(self, args: list[str], **kwargs) -> int:
+        return self.kubectl(
+            ["--kubeconfig", self.workdir_path(IN_HOST_KUBECONFIG_NAME), *args],
+            **kwargs,
+        )
+
+    def etcdctl_in_cluster(self, args: list[str], **kwargs) -> int:
+        raise NotImplementedError
+
+    # --- logs -------------------------------------------------------------
+
+    def logs(self, name: str, out, follow: bool = False) -> None:
+        self.get_component(name)  # raise if unknown
+        self._cat(self.log_path(os.path.basename(name) + ".log"), out, follow)
+
+    def audit_logs(self, out, follow: bool = False) -> None:
+        self._cat(self.log_path(AUDIT_LOG_NAME), out, follow)
+
+    @staticmethod
+    def _cat(path: str, out, follow: bool) -> None:
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(65536)
+                if chunk:
+                    out.write(chunk.decode(errors="replace"))
+                    continue
+                if not follow:
+                    return
+                time.sleep(0.2)
+
+    # --- artifacts --------------------------------------------------------
+
+    def list_binaries(self) -> list[str]:
+        return []
+
+    def list_images(self) -> list[str]:
+        return []
+
+    # --- snapshot ---------------------------------------------------------
+
+    def snapshot_save(self, path: str) -> None:
+        raise NotImplementedError
+
+    def snapshot_restore(self, path: str) -> None:
+        raise NotImplementedError

@@ -1,0 +1,378 @@
+"""Binary runtime: real control-plane processes around the TPU engine.
+
+Behavioral port of pkg/kwokctl/runtime/binary/cluster.go: download the
+upstream etcd/kube-apiserver/kube-controller-manager/kube-scheduler binaries
+with a shared cache (:56-116), generate PKI (:125-131), allocate free ports
+for port-0 options (:156-167), build declarative Component specs (:169-453),
+then start them in link-order waves with pid-file supervision and retry
+until the apiserver reports healthy (:455-520); stop in reverse (:526-545).
+
+The kwok-controller component is THIS package's engine: install() writes a
+`kwok-controller` shim script that execs `python -m kwok_tpu.kwok`, so the
+component model (binary + argv + pid/log files) stays uniform with the
+reference while the engine itself runs JAX.
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+import subprocess
+import sys
+import time
+
+from kwok_tpu.kwokctl import components as comp
+from kwok_tpu.kwokctl import download, k8s, netutil, pki, procutil
+from kwok_tpu.kwokctl.runtime import base
+from kwok_tpu.kwokctl.runtime.base import Cluster
+
+LOCAL = "127.0.0.1"
+
+
+class BinaryCluster(Cluster):
+    RUNTIME = "binary"
+
+    # --- install ----------------------------------------------------------
+
+    def install(self) -> None:
+        conf = self.config().options
+        self._download_binaries()
+        self._setup_workdir()
+        self._setup_ports()
+        self._build_components()
+        self._write_kubeconfig()
+        self.save()
+
+    def _download_binaries(self) -> None:
+        conf = self.config().options
+        cache = conf.cacheDir
+        quiet = conf.quietPull
+        download.download_with_cache(
+            cache, conf.kubeApiserverBinary, self.bin_path("kube-apiserver"), quiet=quiet
+        )
+        if not conf.disableKubeControllerManager:
+            download.download_with_cache(
+                cache,
+                conf.kubeControllerManagerBinary,
+                self.bin_path("kube-controller-manager"),
+                quiet=quiet,
+            )
+        if not conf.disableKubeScheduler:
+            download.download_with_cache(
+                cache, conf.kubeSchedulerBinary, self.bin_path("kube-scheduler"), quiet=quiet
+            )
+        if conf.etcdBinary:
+            download.download_with_cache(
+                cache, conf.etcdBinary, self.bin_path("etcd"), quiet=quiet
+            )
+        else:
+            download.download_with_cache_and_extract(
+                cache, conf.etcdBinaryTar, self.bin_path("etcd"), "etcd", quiet=quiet
+            )
+        if conf.prometheusPort:
+            if conf.prometheusBinary:
+                download.download_with_cache(
+                    cache, conf.prometheusBinary, self.bin_path("prometheus"), quiet=quiet
+                )
+            else:
+                download.download_with_cache_and_extract(
+                    cache,
+                    conf.prometheusBinaryTar,
+                    self.bin_path("prometheus"),
+                    "prometheus",
+                    quiet=quiet,
+                )
+        self._write_kwok_shim()
+
+    def _write_kwok_shim(self) -> None:
+        """The engine 'binary': a generated script running this package's
+        kwok CLI under the installing interpreter (with its module paths
+        baked in, so it works however the orchestrator was launched)."""
+        shim = self.bin_path("kwok-controller")
+        os.makedirs(os.path.dirname(shim), exist_ok=True)
+        paths = [p for p in sys.path if p]
+        with open(shim, "w") as f:
+            f.write(
+                f"#!{sys.executable}\n"
+                "# generated kwok-controller shim (kwok_tpu binary runtime)\n"
+                "import sys\n"
+                f"sys.path[:0] = {paths!r}\n"
+                "from kwok_tpu.kwok.cli import main\n"
+                "sys.exit(main(sys.argv[1:]))\n"
+            )
+        os.chmod(shim, os.stat(shim).st_mode | stat.S_IEXEC | stat.S_IXGRP | stat.S_IXOTH)
+
+    def _setup_workdir(self) -> None:
+        conf = self.config().options
+        pki_path = self.workdir_path(base.PKI_NAME)
+        if not os.path.exists(os.path.join(pki_path, "ca.crt")):
+            pki.generate_pki(pki_path)
+        os.makedirs(self.workdir_path(base.ETCD_DATA_DIR_NAME), exist_ok=True)
+        os.makedirs(self.workdir_path("logs"), exist_ok=True)
+        if conf.kubeAuditPolicy:
+            import shutil
+
+            shutil.copyfile(
+                conf.kubeAuditPolicy, self.workdir_path(base.AUDIT_POLICY_NAME)
+            )
+            open(self.log_path(base.AUDIT_LOG_NAME), "a").close()
+
+    def _setup_ports(self) -> None:
+        conf = self.config().options
+        for field in (
+            "etcdPeerPort",
+            "etcdPort",
+            "kubeApiserverPort",
+            "kwokControllerPort",
+            "kubeControllerManagerPort",
+            "kubeSchedulerPort",
+        ):
+            if field == "kubeControllerManagerPort" and conf.disableKubeControllerManager:
+                continue
+            if field == "kubeSchedulerPort" and conf.disableKubeScheduler:
+                continue
+            if not getattr(conf, field):
+                setattr(conf, field, netutil.get_unused_port())
+
+    def _build_components(self) -> None:
+        config = self.config()
+        conf = config.options
+        workdir = self.workdir
+        pki_dir = self.workdir_path(base.PKI_NAME)
+        ca_crt = os.path.join(pki_dir, "ca.crt")
+        admin_crt = os.path.join(pki_dir, "admin.crt")
+        admin_key = os.path.join(pki_dir, "admin.key")
+        kubeconfig = self.workdir_path(base.IN_HOST_KUBECONFIG_NAME)
+        audit_policy = audit_log = ""
+        if conf.kubeAuditPolicy:
+            audit_policy = self.workdir_path(base.AUDIT_POLICY_NAME)
+            audit_log = self.log_path(base.AUDIT_LOG_NAME)
+
+        cs = [
+            comp.build_etcd(
+                binary=self.bin_path("etcd"),
+                data_path=self.workdir_path(base.ETCD_DATA_DIR_NAME),
+                workdir=workdir,
+                version=conf.etcdVersion,
+                address=LOCAL,
+                port=conf.etcdPort,
+                peer_port=conf.etcdPeerPort,
+            ),
+            comp.build_kube_apiserver(
+                binary=self.bin_path("kube-apiserver"),
+                workdir=workdir,
+                port=conf.kubeApiserverPort,
+                version=conf.kubeVersion,
+                address=LOCAL,
+                etcd_port=conf.etcdPort,
+                runtime_config=conf.kubeRuntimeConfig,
+                feature_gates=conf.kubeFeatureGates,
+                secure_port=bool(conf.securePort),
+                authorization=conf.kubeAuthorization,
+                audit_policy_path=audit_policy,
+                audit_log_path=audit_log,
+                ca_cert_path=ca_crt,
+                admin_cert_path=admin_crt,
+                admin_key_path=admin_key,
+            ),
+        ]
+        if not conf.disableKubeControllerManager:
+            cs.append(
+                comp.build_kube_controller_manager(
+                    binary=self.bin_path("kube-controller-manager"),
+                    workdir=workdir,
+                    kubeconfig_path=kubeconfig,
+                    port=conf.kubeControllerManagerPort,
+                    version=conf.kubeVersion,
+                    address=LOCAL,
+                    secure_port=bool(conf.securePort),
+                    authorization=conf.kubeAuthorization,
+                    feature_gates=conf.kubeFeatureGates,
+                    ca_cert_path=ca_crt,
+                    admin_key_path=admin_key,
+                )
+            )
+        if not conf.disableKubeScheduler:
+            cs.append(
+                comp.build_kube_scheduler(
+                    binary=self.bin_path("kube-scheduler"),
+                    workdir=workdir,
+                    kubeconfig_path=kubeconfig,
+                    port=conf.kubeSchedulerPort,
+                    version=conf.kubeVersion,
+                    address=LOCAL,
+                    secure_port=bool(conf.securePort),
+                    feature_gates=conf.kubeFeatureGates,
+                )
+            )
+        cs.append(
+            comp.build_kwok_controller(
+                binary=self.bin_path("kwok-controller"),
+                workdir=workdir,
+                kubeconfig_path=kubeconfig,
+                config_path=self.workdir_path(base.CONFIG_NAME),
+                port=conf.kwokControllerPort,
+                address=LOCAL,
+            )
+        )
+        if conf.prometheusPort:
+            prom_cfg = comp.build_prometheus_config(
+                project_name=self.name,
+                etcd_port=conf.etcdPort,
+                kube_apiserver_port=conf.kubeApiserverPort,
+                kube_controller_manager_port=0
+                if conf.disableKubeControllerManager
+                else conf.kubeControllerManagerPort,
+                kube_scheduler_port=0
+                if conf.disableKubeScheduler
+                else conf.kubeSchedulerPort,
+                kwok_controller_port=conf.kwokControllerPort,
+                secure_port=bool(conf.securePort),
+                admin_crt_path=admin_crt,
+                admin_key_path=admin_key,
+            )
+            prom_path = self.workdir_path(base.PROMETHEUS_NAME)
+            with open(prom_path, "w") as f:
+                f.write(prom_cfg)
+            cs.append(
+                comp.build_prometheus(
+                    binary=self.bin_path("prometheus"),
+                    workdir=workdir,
+                    config_path=prom_path,
+                    port=conf.prometheusPort,
+                    version=conf.prometheusVersion,
+                    address=LOCAL,
+                    links=[c.name for c in cs],
+                )
+            )
+        config.components = cs
+
+    def _write_kubeconfig(self) -> None:
+        conf = self.config().options
+        pki_dir = self.workdir_path(base.PKI_NAME)
+        scheme = "https" if conf.securePort else "http"
+        data = k8s.build_kubeconfig(
+            project_name=self.name,
+            address=f"{scheme}://{LOCAL}:{conf.kubeApiserverPort}",
+            secure_port=bool(conf.securePort),
+            admin_crt_path=os.path.join(pki_dir, "admin.crt"),
+            admin_key_path=os.path.join(pki_dir, "admin.key"),
+        )
+        with open(self.workdir_path(base.IN_HOST_KUBECONFIG_NAME), "w") as f:
+            f.write(data)
+
+    # --- up/down ----------------------------------------------------------
+
+    def up(self, timeout: float = 120.0) -> None:
+        """Start all components in link waves; retry the whole sequence until
+        the apiserver is healthy and every pid is live (cluster.go:455-520)."""
+        config = self.config()
+        groups = comp.group_by_links(config.components)
+        deadline = time.monotonic() + timeout
+        while True:
+            for group in groups:
+                for c in group:
+                    procutil.fork_exec(c.workDir or self.workdir, c.binary, *c.args)
+            if self.ready() and all(
+                procutil.is_running(c.workDir or self.workdir, c.binary)
+                for g in groups
+                for c in g
+            ):
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"cluster {self.name} failed to come up within {timeout}s; "
+                    f"see {self.workdir_path('logs')}"
+                )
+            time.sleep(1.0)
+
+    def down(self) -> None:
+        config = self.config()
+        groups = comp.group_by_links(config.components)
+        for group in reversed(groups):
+            for c in group:
+                procutil.fork_exec_kill(c.workDir or self.workdir, c.binary)
+
+    def start_component(self, name: str) -> None:
+        c = self.get_component(name)
+        procutil.fork_exec(c.workDir or self.workdir, c.binary, *c.args)
+
+    def stop_component(self, name: str) -> None:
+        c = self.get_component(name)
+        procutil.fork_exec_kill(c.workDir or self.workdir, c.binary)
+
+    # --- artifacts --------------------------------------------------------
+
+    def list_binaries(self) -> list[str]:
+        conf = self.config().options
+        return [
+            conf.etcdBinaryTar,
+            conf.kubeApiserverBinary,
+            conf.kubeControllerManagerBinary,
+            conf.kubeSchedulerBinary,
+            conf.kubectlBinary,
+            conf.prometheusBinaryTar,
+        ]
+
+    def kubectl_path(self) -> str:
+        """PATH kubectl, else download into the workdir on first use
+        (runtime/cluster.go kubectlPath download-or-find)."""
+        import shutil
+
+        found = shutil.which("kubectl")
+        if found:
+            return found
+        conf = self.config().options
+        path = self.bin_path("kubectl")
+        if not os.path.exists(path):
+            download.download_with_cache(
+                conf.cacheDir, conf.kubectlBinary, path, quiet=conf.quietPull
+            )
+        return path
+
+    # --- etcdctl / snapshot ----------------------------------------------
+
+    def _etcdctl_path(self) -> str:
+        conf = self.config().options
+        path = self.bin_path("etcdctl")
+        if not os.path.exists(path):
+            download.download_with_cache_and_extract(
+                conf.cacheDir, conf.etcdBinaryTar, path, "etcdctl", quiet=conf.quietPull
+            )
+        return path
+
+    def etcdctl_in_cluster(self, args: list[str], **kwargs) -> int:
+        conf = self.config().options
+        return procutil.exec_foreground(
+            [
+                self._etcdctl_path(),
+                "--endpoints",
+                f"{LOCAL}:{conf.etcdPort}",
+                *args,
+            ],
+            **kwargs,
+        )
+
+    def snapshot_save(self, path: str) -> None:
+        """etcdctl snapshot save (cluster_snapshot.go:31-51)."""
+        rc = self.etcdctl_in_cluster(["snapshot", "save", path])
+        if rc != 0:
+            raise RuntimeError(f"etcdctl snapshot save failed with {rc}")
+
+    def snapshot_restore(self, path: str) -> None:
+        """Stop etcd -> restore into a fresh data dir -> swap -> restart
+        (cluster_snapshot.go:54-100)."""
+        import shutil
+
+        self.stop_component("etcd")
+        data_dir = self.workdir_path(base.ETCD_DATA_DIR_NAME)
+        tmp_dir = data_dir + ".restore"
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        rc = subprocess.call(
+            [self._etcdctl_path(), "snapshot", "restore", path, "--data-dir", tmp_dir]
+        )
+        if rc != 0:
+            raise RuntimeError(f"etcdctl snapshot restore failed with {rc}")
+        shutil.rmtree(data_dir, ignore_errors=True)
+        os.replace(tmp_dir, data_dir)
+        self.start_component("etcd")

@@ -1,0 +1,145 @@
+"""Workdir layout + option defaulting with KWOK_* env overrides.
+
+Behavioral port of pkg/config/vars.go:28-51 (workdir), :100-445 (defaults +
+GetEnvWithPrefix): every option falls back file -> env -> computed default.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+
+from kwok_tpu.config.ctl import KwokctlConfigurationOptions
+from kwok_tpu.config.types import parse_bool
+from kwok_tpu.kwokctl import consts, k8s
+
+ENV_PREFIX = "KWOK_"
+
+
+def work_dir() -> str:
+    env = os.environ.get(ENV_PREFIX + "WORKDIR")
+    if env:
+        return env
+    home = os.path.expanduser("~")
+    return os.path.join(home, "." + consts.PROJECT_NAME)
+
+
+def clusters_dir() -> str:
+    return os.path.join(work_dir(), "clusters")
+
+
+def cluster_workdir(name: str) -> str:
+    return os.path.join(clusters_dir(), name)
+
+
+def cluster_name(name: str) -> str:
+    return f"{consts.PROJECT_NAME}-{name}"
+
+
+def _env(key: str, default):
+    raw = os.environ.get(ENV_PREFIX + key)
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return parse_bool(raw)
+    if isinstance(default, int) and not isinstance(default, bool):
+        return int(raw)
+    return raw
+
+
+def _goarch() -> str:
+    m = platform.machine().lower()
+    return {"x86_64": "amd64", "aarch64": "arm64", "arm64": "arm64"}.get(m, m)
+
+
+def set_defaults(opts: KwokctlConfigurationOptions) -> KwokctlConfigurationOptions:
+    """Fill every empty option from env or computed default
+    (vars.go setKwokctlConfigurationDefaults)."""
+    goos = "linux" if os.name == "posix" else os.name
+    arch = _goarch()
+
+    opts.kubeVersion = _env(
+        "KUBE_VERSION", opts.kubeVersion or consts.DEFAULT_KUBE_VERSION
+    )
+    if not opts.kubeVersion.startswith("v"):
+        opts.kubeVersion = "v" + opts.kubeVersion
+    release = k8s.parse_release(opts.kubeVersion)
+
+    if opts.securePort is None:
+        # insecure serving was removed after 1.19; the reference's cutover
+        # (vars.go:118) keys on >1.12
+        opts.securePort = release > 12
+    opts.securePort = _env("SECURE_PORT", opts.securePort)
+
+    opts.runtime = _env("RUNTIME", opts.runtime or consts.RUNTIME_TYPE_BINARY)
+    opts.mode = _env("MODE", opts.mode)
+    opts.quietPull = _env("QUIET_PULL", opts.quietPull)
+    opts.disableKubeScheduler = _env(
+        "DISABLE_KUBE_SCHEDULER", opts.disableKubeScheduler
+    )
+    opts.disableKubeControllerManager = _env(
+        "DISABLE_KUBE_CONTROLLER_MANAGER", opts.disableKubeControllerManager
+    )
+    opts.kubeAuthorization = _env("KUBE_AUTHORIZATION", opts.kubeAuthorization)
+    opts.kubeApiserverPort = _env("KUBE_APISERVER_PORT", opts.kubeApiserverPort)
+    opts.kubeAuditPolicy = _env("KUBE_AUDIT_POLICY", opts.kubeAuditPolicy)
+
+    if not opts.kubeFeatureGates and opts.mode == consts.MODE_STABLE_FEATURE_GATE_AND_API:
+        opts.kubeFeatureGates = k8s.get_feature_gates(release)
+    opts.kubeFeatureGates = _env("KUBE_FEATURE_GATES", opts.kubeFeatureGates)
+
+    if not opts.kubeRuntimeConfig and opts.mode == consts.MODE_STABLE_FEATURE_GATE_AND_API:
+        opts.kubeRuntimeConfig = k8s.get_runtime_config(release)
+    opts.kubeRuntimeConfig = _env("KUBE_RUNTIME_CONFIG", opts.kubeRuntimeConfig)
+
+    if not opts.cacheDir:
+        opts.cacheDir = os.path.join(work_dir(), "cache")
+
+    if not opts.kubeBinaryPrefix:
+        opts.kubeBinaryPrefix = (
+            f"{consts.KUBE_BINARY_PREFIX}/{opts.kubeVersion}/bin/{goos}/{arch}"
+        )
+    opts.kubeBinaryPrefix = _env("KUBE_BINARY_PREFIX", opts.kubeBinaryPrefix)
+    for field, name in (
+        ("kubeApiserverBinary", "kube-apiserver"),
+        ("kubeControllerManagerBinary", "kube-controller-manager"),
+        ("kubeSchedulerBinary", "kube-scheduler"),
+        ("kubectlBinary", "kubectl"),
+    ):
+        if not getattr(opts, field):
+            setattr(opts, field, f"{opts.kubeBinaryPrefix}/{name}{opts.binSuffix}")
+    opts.kubeApiserverBinary = _env("KUBE_APISERVER_BINARY", opts.kubeApiserverBinary)
+    opts.kubeControllerManagerBinary = _env(
+        "KUBE_CONTROLLER_MANAGER_BINARY", opts.kubeControllerManagerBinary
+    )
+    opts.kubeSchedulerBinary = _env("KUBE_SCHEDULER_BINARY", opts.kubeSchedulerBinary)
+    opts.kubectlBinary = _env("KUBECTL_BINARY", opts.kubectlBinary)
+
+    if not opts.etcdVersion:
+        opts.etcdVersion = k8s.get_etcd_version(release)
+    opts.etcdVersion = _env("ETCD_VERSION", opts.etcdVersion)
+    if not opts.etcdBinaryPrefix:
+        opts.etcdBinaryPrefix = consts.ETCD_BINARY_PREFIX
+    if not opts.etcdBinaryTar:
+        v = opts.etcdVersion
+        ext = "zip" if goos == "windows" else "tar.gz"
+        opts.etcdBinaryTar = (
+            f"{opts.etcdBinaryPrefix}/v{v}/etcd-v{v}-{goos}-{arch}.{ext}"
+        )
+    opts.etcdBinary = _env("ETCD_BINARY", opts.etcdBinary)
+    opts.etcdBinaryTar = _env("ETCD_BINARY_TAR", opts.etcdBinaryTar)
+
+    if not opts.prometheusVersion:
+        opts.prometheusVersion = consts.PROMETHEUS_VERSION
+    opts.prometheusVersion = _env("PROMETHEUS_VERSION", opts.prometheusVersion)
+    if not opts.prometheusBinaryPrefix:
+        opts.prometheusBinaryPrefix = consts.PROMETHEUS_BINARY_PREFIX
+    if not opts.prometheusBinaryTar:
+        v = opts.prometheusVersion
+        opts.prometheusBinaryTar = (
+            f"{opts.prometheusBinaryPrefix}/v{v}/prometheus-{v}.{goos}-{arch}.tar.gz"
+        )
+    opts.prometheusBinary = _env("PROMETHEUS_BINARY", opts.prometheusBinary)
+    opts.prometheusBinaryTar = _env("PROMETHEUS_BINARY_TAR", opts.prometheusBinaryTar)
+
+    return opts

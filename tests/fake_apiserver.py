@@ -1,190 +1,4 @@
-"""In-memory fake apiserver implementing the KubeClient protocol.
+"""Compatibility shim: the fake apiserver moved into the package
+(kwok_tpu.edge.mockserver) so the kwokctl mock runtime can use it."""
 
-The test fixture replacing k8s.io/client-go/kubernetes/fake
-(node_controller_test.go:38, pod_controller_test.go:38-71): an object store
-with resourceVersion bumps, watch fan-out, strategic-merge status patches,
-and kubelet-style deletion semantics (deletionTimestamp + finalizer
-blocking).
-"""
-
-from __future__ import annotations
-
-import copy
-import queue
-import threading
-from typing import Iterator
-
-from kwok_tpu.edge.kubeclient import (
-    ADDED,
-    DELETED,
-    MODIFIED,
-    WatchEvent,
-    match_field_selector,
-)
-from kwok_tpu.edge.merge import strategic_merge
-from kwok_tpu.edge.render import now_rfc3339
-from kwok_tpu.edge.selectors import parse_selector
-
-
-class _Watch:
-    def __init__(self, server: "FakeKube", kind: str, field_selector, label_selector):
-        self.server = server
-        self.kind = kind
-        self.field_selector = field_selector
-        self.label_selector = parse_selector(label_selector)
-        self.q: "queue.Queue[WatchEvent | None]" = queue.Queue()
-        self.stopped = False
-
-    def _matches(self, obj: dict) -> bool:
-        if not match_field_selector(obj, self.field_selector):
-            return False
-        if self.label_selector is not None:
-            labels = (obj.get("metadata") or {}).get("labels") or {}
-            if not self.label_selector.matches(labels):
-                return False
-        return True
-
-    def __iter__(self) -> Iterator[WatchEvent]:
-        while True:
-            ev = self.q.get()
-            if ev is None:
-                return
-            yield ev
-
-    def stop(self) -> None:
-        self.stopped = True
-        self.q.put(None)
-
-
-class FakeKube:
-    """kinds: "nodes" (cluster-scoped) and "pods" (namespaced)."""
-
-    def __init__(self) -> None:
-        self._lock = threading.RLock()
-        self._store: dict[str, dict[tuple[str, str], dict]] = {"nodes": {}, "pods": {}}
-        self._rv = 0
-        self._watches: list[_Watch] = []
-        # observability for tests
-        self.patch_count = 0
-        self.delete_count = 0
-
-    # -- helpers ------------------------------------------------------------
-
-    def _key(self, namespace, name):
-        return (namespace or "", name)
-
-    def _bump(self, obj: dict) -> None:
-        self._rv += 1
-        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
-
-    def _emit(self, kind: str, type_: str, obj: dict) -> None:
-        for w in list(self._watches):
-            if w.stopped or w.kind != kind:
-                continue
-            if w._matches(obj):
-                w.q.put(WatchEvent(type_, copy.deepcopy(obj)))
-
-    # -- test-side API ------------------------------------------------------
-
-    def create(self, kind: str, obj: dict) -> dict:
-        with self._lock:
-            obj = copy.deepcopy(obj)
-            meta = obj.setdefault("metadata", {})
-            meta.setdefault("creationTimestamp", now_rfc3339())
-            meta.setdefault("uid", f"uid-{self._rv + 1}")
-            key = self._key(meta.get("namespace"), meta["name"])
-            self._bump(obj)
-            self._store[kind][key] = obj
-            self._emit(kind, ADDED, obj)
-            return copy.deepcopy(obj)
-
-    def update(self, kind: str, obj: dict) -> dict:
-        with self._lock:
-            obj = copy.deepcopy(obj)
-            meta = obj.get("metadata") or {}
-            key = self._key(meta.get("namespace"), meta.get("name"))
-            if key not in self._store[kind]:
-                raise KeyError(key)
-            self._bump(obj)
-            self._store[kind][key] = obj
-            self._emit(kind, MODIFIED, obj)
-            return copy.deepcopy(obj)
-
-    # -- KubeClient protocol ------------------------------------------------
-
-    def list(self, kind, *, field_selector=None, label_selector=None):
-        sel = parse_selector(label_selector)
-        with self._lock:
-            out = []
-            for obj in self._store[kind].values():
-                if not match_field_selector(obj, field_selector):
-                    continue
-                if sel is not None:
-                    labels = (obj.get("metadata") or {}).get("labels") or {}
-                    if not sel.matches(labels):
-                        continue
-                out.append(copy.deepcopy(obj))
-            return out
-
-    def watch(self, kind, *, field_selector=None, label_selector=None):
-        w = _Watch(self, kind, field_selector, label_selector)
-        with self._lock:
-            self._watches.append(w)
-        return w
-
-    def get(self, kind, namespace, name):
-        with self._lock:
-            obj = self._store[kind].get(self._key(namespace, name))
-            return copy.deepcopy(obj) if obj else None
-
-    def patch_status(self, kind, namespace, name, patch):
-        with self._lock:
-            key = self._key(namespace, name)
-            obj = self._store[kind].get(key)
-            if obj is None:
-                return None
-            status = obj.get("status") or {}
-            obj["status"] = strategic_merge(status, patch.get("status", patch))
-            self._bump(obj)
-            self.patch_count += 1
-            self._emit(kind, MODIFIED, obj)
-            return copy.deepcopy(obj)
-
-    def patch_meta(self, kind, namespace, name, patch):
-        with self._lock:
-            key = self._key(namespace, name)
-            obj = self._store[kind].get(key)
-            if obj is None:
-                return None
-            meta_patch = (patch or {}).get("metadata", {})
-            meta = obj.setdefault("metadata", {})
-            for k, v in meta_patch.items():
-                if v is None:
-                    meta.pop(k, None)
-                else:
-                    meta[k] = copy.deepcopy(v)
-            self._bump(obj)
-            self._emit(kind, MODIFIED, obj)
-            return copy.deepcopy(obj)
-
-    def delete(self, kind, namespace, name, grace_seconds: int = 0):
-        with self._lock:
-            key = self._key(namespace, name)
-            obj = self._store[kind].get(key)
-            if obj is None:
-                return
-            meta = obj.setdefault("metadata", {})
-            finalizers = meta.get("finalizers") or []
-            if kind == "pods" and (grace_seconds > 0 or finalizers):
-                # graceful: mark for deletion, wait for the kubelet (the
-                # engine) to force-delete / strip finalizers
-                if "deletionTimestamp" not in meta:
-                    meta["deletionTimestamp"] = now_rfc3339()
-                meta["deletionGracePeriodSeconds"] = grace_seconds
-                self._bump(obj)
-                self._emit(kind, MODIFIED, obj)
-                return
-            del self._store[kind][key]
-            self.delete_count += 1
-            self._bump(obj)
-            self._emit(kind, DELETED, obj)
+from kwok_tpu.edge.mockserver import FakeKube  # noqa: F401

@@ -1,155 +1,4 @@
-"""HTTP facade over FakeKube speaking the kube-apiserver wire protocol
-(list/watch/get/patch/delete on /api/v1 paths) — lets HttpKubeClient and the
-tpukwok CLI be tested end-to-end over real sockets."""
+"""Compatibility shim: the HTTP fake apiserver moved into the package
+(kwok_tpu.edge.mockserver) so the kwokctl mock runtime can use it."""
 
-from __future__ import annotations
-
-import json
-import re
-import threading
-import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-from tests.fake_apiserver import FakeKube
-
-_PATHS = re.compile(
-    r"^/api/v1(?:/namespaces/(?P<ns>[^/]+))?/(?P<kind>nodes|pods)"
-    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status))?$"
-)
-
-
-class HttpFakeApiserver:
-    def __init__(self, store: FakeKube | None = None, port: int = 0) -> None:
-        self.store = store or FakeKube()
-        handler = self._make_handler()
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
-        self.port = self.httpd.server_address[1]
-        self.url = f"http://127.0.0.1:{self.port}"
-        self._thread: threading.Thread | None = None
-
-    def start(self):
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, daemon=True, name="fake-apiserver"
-        )
-        self._thread.start()
-        return self
-
-    def stop(self):
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
-
-    def _make_handler(self):
-        store = self.store
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *a):
-                pass
-
-            def _send_json(self, obj, code=200):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def _body(self):
-                n = int(self.headers.get("Content-Length") or 0)
-                return json.loads(self.rfile.read(n) or b"null") if n else None
-
-            def do_GET(self):  # noqa: N802
-                parsed = urllib.parse.urlparse(self.path)
-                if parsed.path == "/healthz":
-                    self.send_response(200)
-                    self.send_header("Content-Length", "2")
-                    self.end_headers()
-                    self.wfile.write(b"ok")
-                    return
-                m = _PATHS.match(parsed.path)
-                if not m:
-                    self.send_error(404)
-                    return
-                q = urllib.parse.parse_qs(parsed.query)
-                kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
-                if name:
-                    obj = store.get(kind, ns, name)
-                    if obj is None:
-                        self._send_json({"kind": "Status", "code": 404}, 404)
-                    else:
-                        self._send_json(obj)
-                    return
-                fs = (q.get("fieldSelector") or [None])[0]
-                ls = (q.get("labelSelector") or [None])[0]
-                if (q.get("watch") or ["false"])[0] in ("true", "1"):
-                    self._stream_watch(kind, fs, ls)
-                    return
-                items = store.list(kind, field_selector=fs, label_selector=ls)
-                self._send_json({
-                    "kind": "List", "apiVersion": "v1",
-                    "metadata": {}, "items": items,
-                })
-
-            def _stream_watch(self, kind, fs, ls):
-                w = store.watch(kind, field_selector=fs, label_selector=ls)
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-                try:
-                    for ev in w:
-                        line = json.dumps(
-                            {"type": ev.type, "object": ev.object}
-                        ).encode() + b"\n"
-                        self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
-                        self.wfile.flush()
-                except (BrokenPipeError, ConnectionResetError):
-                    pass
-                finally:
-                    w.stop()
-
-            def do_PATCH(self):  # noqa: N802
-                parsed = urllib.parse.urlparse(self.path)
-                m = _PATHS.match(parsed.path)
-                if not m or not m.group("name"):
-                    self.send_error(404)
-                    return
-                kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
-                patch = self._body()
-                if m.group("sub") == "status":
-                    obj = store.patch_status(kind, ns, name, patch)
-                else:
-                    obj = store.patch_meta(kind, ns, name, patch)
-                if obj is None:
-                    self._send_json({"kind": "Status", "code": 404}, 404)
-                else:
-                    self._send_json(obj)
-
-            def do_DELETE(self):  # noqa: N802
-                parsed = urllib.parse.urlparse(self.path)
-                m = _PATHS.match(parsed.path)
-                if not m or not m.group("name"):
-                    self.send_error(404)
-                    return
-                body = self._body() or {}
-                store.delete(
-                    m.group("kind"), m.group("ns"), m.group("name"),
-                    grace_seconds=int(body.get("gracePeriodSeconds") or 0),
-                )
-                self._send_json({"kind": "Status", "status": "Success"})
-
-            def do_POST(self):  # noqa: N802 (test convenience: create)
-                parsed = urllib.parse.urlparse(self.path)
-                m = _PATHS.match(parsed.path)
-                if not m:
-                    self.send_error(404)
-                    return
-                obj = self._body()
-                if m.group("ns"):
-                    obj.setdefault("metadata", {})["namespace"] = m.group("ns")
-                self._send_json(store.create(m.group("kind"), obj), 201)
-
-        return Handler
+from kwok_tpu.edge.mockserver import HttpFakeApiserver  # noqa: F401

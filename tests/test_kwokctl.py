@@ -1,0 +1,390 @@
+"""kwokctl orchestration-plane tests.
+
+Mirrors the reference's unit coverage (components/utils_test.go GroupByLinks,
+pki/pki_test.go, k8s/feature_gates_data_test.go, config round-trip) plus a
+full create->up->simulate->down e2e on the mock runtime, which is this
+suite's analogue of test/kwokctl/kwokctl_workable_test.sh (real detached
+processes, no upstream binaries).
+"""
+
+import io
+import json
+import os
+import tarfile
+import time
+import urllib.request
+
+import pytest
+
+from kwok_tpu.config.ctl import Component, KwokctlConfiguration
+from kwok_tpu.config.types import first_of, load_documents, save_documents
+from kwok_tpu.kwokctl import components as comp
+from kwok_tpu.kwokctl import download, k8s, netutil, pki, procutil
+from kwok_tpu.kwokctl import vars as ctlvars
+
+
+# --- group_by_links (components/utils_test.go) ---------------------------
+
+
+def _comps(*specs):
+    return [Component(name=n, links=list(links)) for n, links in specs]
+
+
+def test_group_by_links_waves():
+    cs = _comps(
+        ("etcd", []),
+        ("kube-apiserver", ["etcd"]),
+        ("kube-controller-manager", ["kube-apiserver"]),
+        ("kube-scheduler", ["kube-apiserver"]),
+        ("kwok-controller", ["kube-apiserver"]),
+        ("prometheus", ["etcd", "kube-apiserver", "kube-controller-manager",
+                        "kube-scheduler", "kwok-controller"]),
+    )
+    groups = comp.group_by_links(cs)
+    names = [[c.name for c in g] for g in groups]
+    assert names == [
+        ["etcd"],
+        ["kube-apiserver"],
+        ["kube-controller-manager", "kube-scheduler", "kwok-controller"],
+        ["prometheus"],
+    ]
+
+
+def test_group_by_links_broken():
+    with pytest.raises(comp.BrokenLinksError):
+        comp.group_by_links(_comps(("a", ["missing"])))
+
+
+# --- component arg matrices ----------------------------------------------
+
+
+def test_apiserver_args_insecure_vs_secure():
+    insecure = comp.build_kube_apiserver(
+        binary="/bin/kube-apiserver", workdir="/w", port=8080, etcd_port=2379
+    )
+    assert "--insecure-port=8080" in insecure.args
+    assert not any(a.startswith("--tls-cert-file") for a in insecure.args)
+    secure = comp.build_kube_apiserver(
+        binary="/bin/kube-apiserver", workdir="/w", port=6443, etcd_port=2379,
+        secure_port=True, authorization=True,
+        ca_cert_path="/pki/ca.crt", admin_cert_path="/pki/admin.crt",
+        admin_key_path="/pki/admin.key",
+    )
+    assert "--secure-port=6443" in secure.args
+    assert "--authorization-mode=Node,RBAC" in secure.args
+    assert "--service-account-signing-key-file=/pki/admin.key" in secure.args
+    assert secure.links == ["etcd"]
+
+
+def test_controller_manager_insecure_disables_secure_port():
+    c = comp.build_kube_controller_manager(
+        binary="/b", workdir="/w", kubeconfig_path="/kc", port=10252
+    )
+    assert "--secure-port=0" in c.args and "--port=10252" in c.args
+
+
+# --- k8s matrices ---------------------------------------------------------
+
+
+def test_parse_release():
+    assert k8s.parse_release("v1.26.0") == 26
+    assert k8s.parse_release("1.19") == 19
+    assert k8s.parse_release("garbage") == -1
+
+
+def test_feature_gates_policy():
+    # release 20: ServerSideApply is Beta and later reached GA -> pinned true
+    g20 = dict(kv.split("=") for kv in k8s.get_feature_gates(20).split(","))
+    assert g20.get("ServerSideApply") == "true"
+    # at the head release nothing beta has graduated yet -> everything false
+    g26 = dict(kv.split("=") for kv in k8s.get_feature_gates(26).split(","))
+    assert g26 and set(g26.values()) == {"false"}
+    # alpha-only gates never appear
+    assert "APISelfSubjectReview" not in g26
+    assert k8s.get_feature_gates(-1) == ""
+
+
+def test_runtime_config_cutover():
+    assert k8s.get_runtime_config(16) == ""
+    assert k8s.get_runtime_config(17) == "api/legacy=false,api/alpha=false"
+
+
+def test_etcd_version_clamps():
+    assert k8s.get_etcd_version(8) == "3.0.17"
+    assert k8s.get_etcd_version(22) == "3.5.6"
+    assert k8s.get_etcd_version(99) == "3.5.6"  # clamp above
+    assert k8s.get_etcd_version(1) == "3.0.17"  # clamp below
+
+
+def test_kubeconfig_secure_has_user_certs():
+    secure = k8s.build_kubeconfig("kwok-x", "https://127.0.0.1:6443",
+                                  True, "/pki/admin.crt", "/pki/admin.key")
+    assert "client-certificate: /pki/admin.crt" in secure
+    assert "insecure-skip-tls-verify: true" in secure
+    insecure = k8s.build_kubeconfig("kwok-x", "http://127.0.0.1:8080")
+    assert "users:" not in insecure
+
+
+# --- pki (pki/pki_test.go) ------------------------------------------------
+
+
+def test_generate_pki(tmp_path):
+    from cryptography import x509
+    from cryptography.hazmat.primitives.asymmetric.ec import ECDSA
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    d = str(tmp_path / "pki")
+    pki.generate_pki(d)
+    for f in ("ca.crt", "ca.key", "admin.crt", "admin.key"):
+        assert os.path.exists(os.path.join(d, f))
+    ca = x509.load_pem_x509_certificate(open(os.path.join(d, "ca.crt"), "rb").read())
+    admin = x509.load_pem_x509_certificate(
+        open(os.path.join(d, "admin.crt"), "rb").read()
+    )
+    assert admin.issuer == ca.subject
+    # CA actually signed the admin cert
+    ca.public_key().verify(
+        admin.signature, admin.tbs_certificate_bytes, ECDSA(SHA256())
+    )
+    sans = admin.extensions.get_extension_for_class(x509.SubjectAlternativeName).value
+    assert "localhost" in sans.get_values_for_type(x509.DNSName)
+    # admin belongs to system:masters
+    orgs = admin.subject.get_attributes_for_oid(x509.NameOID.ORGANIZATION_NAME)
+    assert orgs[0].value == "system:masters"
+
+
+# --- procutil (exec/cmd.go semantics) ------------------------------------
+
+
+def test_fork_exec_lifecycle(tmp_path):
+    wd = str(tmp_path)
+    procutil.fork_exec(wd, "/bin/sleep", "30")
+    assert procutil.is_running(wd, "/bin/sleep")
+    # second fork_exec is a no-op while alive
+    pid1 = open(os.path.join(wd, "pids", "sleep.pid")).read()
+    procutil.fork_exec(wd, "/bin/sleep", "30")
+    assert open(os.path.join(wd, "pids", "sleep.pid")).read() == pid1
+    # cmdline file enables exact restart
+    assert open(os.path.join(wd, "cmdline", "sleep")).read() == "/bin/sleep\x0030"
+    procutil.fork_exec_kill(wd, "/bin/sleep")
+    assert not procutil.is_running(wd, "/bin/sleep")
+    assert not os.path.exists(os.path.join(wd, "pids", "sleep.pid"))
+    procutil.fork_exec_restart(wd, "sleep")
+    assert procutil.is_running(wd, "/bin/sleep")
+    procutil.fork_exec_kill(wd, "/bin/sleep")
+
+
+# --- download cache -------------------------------------------------------
+
+
+def test_download_local_and_extract(tmp_path):
+    src = tmp_path / "tool"
+    src.write_text("#!/bin/sh\necho hi\n")
+    dest = tmp_path / "bin" / "tool"
+    download.download_with_cache(str(tmp_path / "cache"), str(src), str(dest))
+    assert os.access(dest, os.X_OK)
+
+    tar_path = tmp_path / "etcd.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as t:
+        data = b"#!/bin/sh\necho etcd\n"
+        info = tarfile.TarInfo("etcd-v3.5.6-linux-amd64/etcd")
+        info.size = len(data)
+        t.addfile(info, io.BytesIO(data))
+    dest2 = tmp_path / "bin" / "etcd"
+    download.download_with_cache_and_extract(
+        str(tmp_path / "cache"), str(tar_path), str(dest2), "etcd"
+    )
+    assert open(dest2).read() == "#!/bin/sh\necho etcd\n"
+
+
+# --- config round-trip ----------------------------------------------------
+
+
+def test_kwokctl_config_round_trip(tmp_path):
+    conf = KwokctlConfiguration(name="demo")
+    conf.options.runtime = "binary"
+    conf.options.kubeVersion = "v1.26.0"
+    conf.options.kubeApiserverPort = 6443
+    conf.components = [
+        Component(name="etcd", binary="/bin/etcd", args=["--name=node0"]),
+        Component(name="kube-apiserver", links=["etcd"]),
+    ]
+    p = str(tmp_path / "kwok.yaml")
+    save_documents(p, [conf])
+    loaded = first_of(load_documents(p), KwokctlConfiguration)
+    assert loaded.name == "demo"
+    assert loaded.options.runtime == "binary"
+    assert loaded.options.kubeApiserverPort == 6443
+    assert [c.name for c in loaded.components] == ["etcd", "kube-apiserver"]
+    assert loaded.components[1].links == ["etcd"]
+
+
+def test_set_defaults_urls(monkeypatch, tmp_path):
+    monkeypatch.setenv("KWOK_WORKDIR", str(tmp_path))
+    from kwok_tpu.config.ctl import KwokctlConfigurationOptions
+
+    opts = KwokctlConfigurationOptions(kubeVersion="v1.26.0")
+    ctlvars.set_defaults(opts)
+    assert opts.securePort is True  # 26 > 12
+    assert opts.kubeApiserverBinary.endswith("/kube-apiserver")
+    assert "dl.k8s.io/release/v1.26.0" in opts.kubeApiserverBinary
+    assert "etcd-v3.5.6" in opts.etcdBinaryTar
+    assert opts.cacheDir == str(tmp_path / "cache")
+    # env override wins
+    monkeypatch.setenv("KWOK_ETCD_BINARY_TAR", "file:///x/etcd.tar.gz")
+    opts2 = KwokctlConfigurationOptions(kubeVersion="v1.26.0")
+    ctlvars.set_defaults(opts2)
+    assert opts2.etcdBinaryTar == "file:///x/etcd.tar.gz"
+
+
+# --- mock-runtime e2e (kwokctl_workable_test.sh analogue) -----------------
+
+
+@pytest.fixture
+def kwok_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("KWOK_WORKDIR", str(tmp_path))
+    # Engine subprocesses must not grab the TPU in CI: the axon sitecustomize
+    # claims the chip at interpreter start whenever PALLAS_AXON_POOL_IPS is
+    # set (and concurrent claimants deadlock), so strip it from the env the
+    # fork_exec'd components inherit, and force the engine onto CPU.
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("KWOK_TPU_PLATFORM", "cpu")
+    return tmp_path
+
+
+def _api(url, path, obj=None, method=None):
+    data = json.dumps(obj).encode() if obj is not None else None
+    req = urllib.request.Request(url + path, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+def test_mock_cluster_workable(kwok_home):
+    from kwok_tpu.kwokctl.cli import main
+
+    name = "e2e"
+    port = netutil.get_unused_port()
+    assert main([
+        "--name", name, "create", "cluster",
+        "--runtime", "mock",
+        "--kube-apiserver-port", str(port),
+        "--wait", "30s",
+    ]) == 0
+    url = f"http://127.0.0.1:{port}"
+    try:
+        _api(url, "/api/v1/nodes",
+             {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n0"}},
+             method="POST")
+        _api(url, "/api/v1/namespaces/default/pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p0", "namespace": "default"},
+            "spec": {"nodeName": "n0", "containers": [{"name": "c", "image": "i"}]},
+        }, method="POST")
+        deadline = time.time() + 60
+        node_ready = pod_running = False
+        while time.time() < deadline and not (node_ready and pod_running):
+            node = _api(url, "/api/v1/nodes/n0")
+            conds = {c["type"]: c["status"]
+                     for c in (node.get("status") or {}).get("conditions", [])}
+            node_ready = conds.get("Ready") == "True"
+            pod = _api(url, "/api/v1/namespaces/default/pods/p0")
+            pod_running = (pod.get("status") or {}).get("phase") == "Running"
+            time.sleep(0.25)
+        assert node_ready, "fake node never went Ready"
+        assert pod_running, "pod never went Running"
+
+        # workdir layout matches the reference's restartable design
+        wd = ctlvars.cluster_workdir(name)
+        assert os.path.exists(os.path.join(wd, "kwok.yaml"))
+        assert os.path.exists(os.path.join(wd, "kubeconfig.yaml"))
+        assert os.path.exists(os.path.join(wd, "pids", "kwok-controller.pid"))
+        assert os.path.exists(os.path.join(wd, "cmdline", "kube-apiserver"))
+
+        # get clusters sees it
+        import io as _io
+        from contextlib import redirect_stdout
+
+        buf = _io.StringIO()
+        with redirect_stdout(buf):
+            main(["get", "clusters"])
+        assert name in buf.getvalue().split()
+    finally:
+        assert main(["--name", name, "stop", "cluster"]) == 0
+        assert main(["--name", name, "delete", "cluster"]) == 0
+    assert not os.path.exists(ctlvars.cluster_workdir(name))
+
+
+def test_prometheus_links_respect_disabled_components(kwok_home, monkeypatch):
+    """--prometheus-port with scheduler/KCM disabled must still topo-sort
+    (review regression: hardcoded links -> BrokenLinksError)."""
+    from kwok_tpu.config.ctl import KwokctlConfiguration, KwokctlConfigurationOptions
+    from kwok_tpu.kwokctl.runtime.binary import BinaryCluster
+
+    opts = KwokctlConfigurationOptions(
+        runtime="binary", kubeVersion="v1.26.0", prometheusPort=9090,
+        disableKubeScheduler=True, disableKubeControllerManager=True,
+        etcdPort=2379, etcdPeerPort=2380, kubeApiserverPort=6443,
+        kwokControllerPort=10247,
+    )
+    rt = BinaryCluster("t", str(kwok_home / "clusters" / "t"))
+    rt.set_config(KwokctlConfiguration(options=opts, name="t"))
+    os.makedirs(rt.workdir_path(), exist_ok=True)
+    rt._build_components()
+    groups = comp.group_by_links(rt.config().components)
+    assert [c.name for c in groups[-1]] == ["prometheus"]
+
+
+def test_stage_selector_validation_is_kind_aware():
+    from kwok_tpu.config.stages import Stage
+
+    with pytest.raises(ValueError, match="unknown matchSelector"):
+        Stage.from_doc({
+            "kind": "Stage", "metadata": {"name": "bad"},
+            "spec": {"resourceRef": {"kind": "Pod"},
+                     "selector": {"matchSelector": "heartbeat"},
+                     "next": {"phase": "Running"}},
+        })
+    # but heartbeat is valid on Node stages
+    Stage.from_doc({
+        "kind": "Stage", "metadata": {"name": "ok"},
+        "spec": {"resourceRef": {"kind": "Node"},
+                 "selector": {"matchSelector": "heartbeat"},
+                 "next": {"phase": "Ready"}},
+    })
+
+
+def test_create_flags_merge_with_config_file(kwok_home, tmp_path, monkeypatch):
+    """File kubeVersion must drive derived URLs when no flag overrides it
+    (review regression: defaults computed before the file merge)."""
+    import kwok_tpu.kwokctl.cli as ctl_cli
+
+    cfg = tmp_path / "conf.yaml"
+    cfg.write_text(
+        "apiVersion: kwok.x-k8s.io/v1alpha1\n"
+        "kind: KwokctlConfiguration\n"
+        "options:\n"
+        "  kubeVersion: v1.20.0\n"
+        "  securePort: false\n"
+    )
+    captured = {}
+
+    class FakeRT:
+        def __init__(self, name, workdir):
+            pass
+        def set_config(self, conf):
+            captured["opts"] = conf.options
+        def save(self, extra=None): pass
+        def install(self): pass
+        def up(self): pass
+        def wait_ready(self, t): pass
+
+    monkeypatch.setattr(ctl_cli.runtime_registry, "get", lambda r, n, w: FakeRT(n, w))
+    ctl_cli.main(["--name", "m", "create", "cluster", "--config", str(cfg)])
+    opts = captured["opts"]
+    assert opts.kubeVersion == "v1.20.0"
+    assert "v1.20.0" in opts.kubeApiserverBinary
+    assert opts.etcdVersion == "3.4.13"
+    assert opts.securePort is False  # explicit false survives the merge
