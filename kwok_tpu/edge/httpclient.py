@@ -162,9 +162,13 @@ class HttpKubeClient:
             req, context=self._ctx, timeout=timeout or self.timeout
         )
 
-    def _json(self, method: str, url: str, body: dict | None = None,
+    def _json(self, method: str, url: str, body: dict | bytes | None = None,
               content_type: str = "application/json") -> dict | None:
-        data = json.dumps(body).encode() if body is not None else None
+        # bytes-like bodies are pre-encoded JSON (native codec egress)
+        if isinstance(body, (bytes, bytearray, memoryview)):
+            data = bytes(body)
+        else:
+            data = json.dumps(body).encode() if body is not None else None
         try:
             with self._request(method, url, data, content_type) as resp:
                 return json.loads(resp.read() or b"null")

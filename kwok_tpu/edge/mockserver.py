@@ -149,6 +149,8 @@ class FakeKube:
             return copy.deepcopy(obj) if obj else None
 
     def patch_status(self, kind, namespace, name, patch):
+        if isinstance(patch, (bytes, bytearray, memoryview)):
+            patch = json.loads(bytes(patch))
         with self._lock:
             key = self._key(namespace, name)
             obj = self._store[kind].get(key)

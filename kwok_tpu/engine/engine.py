@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import queue
 import threading
 import time
@@ -32,6 +33,7 @@ from kwok_tpu.edge.ippool import IPPool
 from kwok_tpu.edge.kubeclient import ADDED, DELETED, KubeClient
 from kwok_tpu.edge.merge import node_status_patch_needed, pod_status_patch_needed
 from kwok_tpu.edge.render import (
+    _NODE_CONDITION_META,
     now_rfc3339,
     render_node_heartbeat,
     render_node_status,
@@ -190,6 +192,20 @@ class ClusterEngine:
         self._executor: ThreadPoolExecutor | None = None
         self._ip_lock = threading.Lock()
         self._metrics_lock = threading.Lock()
+
+        # Native C++ egress codec: batch-renders heartbeat patch bytes for
+        # the O(nodes)-every-30s hot loop. Optional — pure-Python renderers
+        # are the fallback; KWOK_TPU_NATIVE=0 disables it explicitly.
+        self._codec = None
+        if os.environ.get("KWOK_TPU_NATIVE", "1") != "0":
+            from kwok_tpu import native
+
+            if native.available():
+                self._codec = native
+        self._hb_cond_meta = [
+            (name, *_NODE_CONDITION_META.get(name, ("KwokRule", name)))
+            for name in NODE_PHASES.conditions
+        ]
         self.metrics = {
             "transitions_total": 0,
             "status_patches_total": 0,
@@ -606,10 +622,16 @@ class ClusterEngine:
                 name = k.pool.key_of(int(idx))
                 if name is not None:
                     self._submit(self._patch_node_status, name, int(idx))
-            for idx in np.nonzero(hb)[0]:
-                name = k.pool.key_of(int(idx))
-                if name is not None:
-                    self._submit(self._heartbeat_node, name, int(idx), now_str)
+            hb_rows = [
+                (name, int(idx))
+                for idx in np.nonzero(hb)[0]
+                if (name := k.pool.key_of(int(idx))) is not None
+            ]
+            if self._codec is not None and len(hb_rows) > 1:
+                self._emit_heartbeats_native(k, hb_rows, now_str)
+            else:
+                for name, idx in hb_rows:
+                    self._submit(self._heartbeat_node, name, idx, now_str)
         else:
             for idx in np.nonzero(dirty)[0]:
                 key = k.pool.key_of(int(idx))
@@ -640,6 +662,26 @@ class ClusterEngine:
         k = self.nodes
         rendered = render_node_heartbeat(int(k.cond_h[idx]), now_str, self.start_time)
         self.client.patch_status("nodes", None, name, {"status": rendered})
+        self._inc("heartbeats_total")
+
+    def _emit_heartbeats_native(self, k, hb_rows, now_str: str) -> None:
+        """One C++ call renders every due heartbeat's patch bytes; the
+        workers then only do HTTP (KeepNodeHeartbeat's batch, minus the
+        per-object template execution)."""
+        idxs = np.array([i for _, i in hb_rows], np.int64)
+        start = self.start_time.encode()
+        bodies = self._codec.render_heartbeats(
+            k.cond_h[idxs], self._hb_cond_meta, now_str, [start] * len(hb_rows)
+        )
+        if bodies is None:  # codec raced away; fall back
+            for name, idx in hb_rows:
+                self._submit(self._heartbeat_node, name, idx, now_str)
+            return
+        for (name, _idx), body in zip(hb_rows, bodies):
+            self._submit(self._send_heartbeat_bytes, name, body)
+
+    def _send_heartbeat_bytes(self, name: str, body: bytes) -> None:
+        self.client.patch_status("nodes", None, name, body)
         self._inc("heartbeats_total")
 
     def _render_pod(self, idx: int):

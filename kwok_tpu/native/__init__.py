@@ -1,0 +1,220 @@
+"""Native codec loader: compile-on-demand C++ with a pure-Python fallback.
+
+The reference ships native static binaries for everything (SURVEY.md §2.4);
+our compute path is JAX/XLA and the remaining native-worthy hot spot is the
+host JSON egress. codec.cc is built here with g++ on first use (cached next
+to the source, keyed by source mtime) and bound via ctypes. If no compiler
+is available the engine silently falls back to kwok_tpu.edge.render — the
+codec is a throughput optimization, never a functional dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger("kwok_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "codec.cc")
+_LIB = os.path.join(_DIR, "libkwokcodec.so")
+ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O2", "-shared", "-fPIC", "-o", _LIB + ".tmp", _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.info("native codec build failed (%s); using python renderers", e)
+        return False
+    os.replace(_LIB + ".tmp", _LIB)
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.kwok_codec_abi_version.restype = ctypes.c_int32
+    lib.kwok_render_heartbeats.restype = ctypes.c_int64
+    lib.kwok_render_heartbeats.argtypes = [
+        ctypes.c_int32, u32p, ctypes.c_int32,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, ctypes.c_int32,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, ctypes.c_int64, i64p,
+    ]
+    lib.kwok_render_pod_statuses.restype = ctypes.c_int64
+    lib.kwok_render_pod_statuses.argtypes = [
+        ctypes.c_int32, u8p, u32p,
+        ctypes.c_char_p, i64p,
+        ctypes.c_int32, ctypes.c_char_p, i64p,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, ctypes.c_int64, i64p,
+    ]
+    return lib
+
+
+def load() -> ctypes.CDLL | None:
+    """The codec library, building it if stale/missing; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        fresh = os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
+            _SRC
+        )
+        if not fresh and not _build():
+            return None
+        try:
+            lib = _bind(ctypes.CDLL(_LIB))
+            if lib.kwok_codec_abi_version() != ABI_VERSION:
+                logger.info("native codec ABI mismatch; rebuilding")
+                os.remove(_LIB)
+                if not _build():
+                    return None
+                lib = _bind(ctypes.CDLL(_LIB))
+        except OSError as e:
+            logger.info("native codec load failed (%s)", e)
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _blob(items: list[bytes]) -> tuple[bytes, np.ndarray]:
+    off = np.zeros(len(items) + 1, np.int64)
+    np.cumsum([len(x) for x in items], out=off[1:])
+    return b"".join(items), off
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _split(buf: bytearray, off: np.ndarray) -> list[memoryview]:
+    """Zero-copy per-row views into the shared output buffer (the HTTP layer
+    accepts any bytes-like body)."""
+    mv = memoryview(buf)
+    off_l = off.tolist()
+    return [mv[off_l[i] : off_l[i + 1]] for i in range(len(off_l) - 1)]
+
+
+def render_heartbeats(
+    cond_bits: np.ndarray,
+    cond_meta: list[tuple[str, str, str]],
+    now: str,
+    start_times: list[bytes],
+) -> list[bytes] | None:
+    """Batch-render node heartbeat status patches; one bytes body per row.
+
+    cond_meta: (type, reason, message) per condition bit, in bit order.
+    Returns None when the native library is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    n = len(start_times)
+    bits = np.ascontiguousarray(cond_bits, np.uint32)
+    meta_items = [s.encode() for t in cond_meta for s in t]
+    meta_blob, meta_off = _blob(meta_items)
+    start_blob, start_off = _blob(start_times)
+    now_b = now.encode()
+    out_off = np.zeros(n + 1, np.int64)
+    # exact-ish guess: per condition ~120B of literals + the four strings
+    per_cond = 128 + len(now_b) + len(meta_blob) // max(1, len(cond_meta))
+    cap = max(1024, n * (len(cond_meta) * per_cond + 32) + len(start_blob) * len(cond_meta))
+    for _ in range(2):
+        out = bytearray(cap)
+        need = lib.kwok_render_heartbeats(
+            n,
+            bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(cond_meta),
+            meta_blob, _i64p(meta_off),
+            now_b, len(now_b),
+            start_blob, _i64p(start_off),
+            (ctypes.c_char * len(out)).from_buffer(out), cap, _i64p(out_off),
+        )
+        if need <= cap:
+            return _split(out, out_off)
+        cap = need
+    raise AssertionError("codec buffer sizing did not converge")
+
+
+def render_pod_statuses(
+    phase_kind: np.ndarray,
+    cond_bits: np.ndarray,
+    phase_names: list[bytes],
+    cond_names: list[str],
+    host_ips: list[bytes],
+    pod_ips: list[bytes],
+    start_times: list[bytes],
+    containers: list[bytes],
+    init_containers: list[bytes],
+) -> list[bytes] | None:
+    """Batch-render pod status patches.
+
+    phase_kind: per row, 0 running-like / 1 terminated-ok / 2 terminated-err.
+    containers / init_containers: per-row records "name\\x1fimage\\x1e..." .
+    """
+    lib = load()
+    if lib is None:
+        return None
+    n = len(phase_names)
+    pk = np.ascontiguousarray(phase_kind, np.uint8)
+    bits = np.ascontiguousarray(cond_bits, np.uint32)
+    phase_blob, phase_off = _blob(phase_names)
+    cname_blob, cname_off = _blob([c.encode() for c in cond_names])
+    host_blob, host_off = _blob(host_ips)
+    pod_blob, pod_off = _blob(pod_ips)
+    start_blob, start_off = _blob(start_times)
+    ctr_blob, ctr_off = _blob(containers)
+    ictr_blob, ictr_off = _blob(init_containers)
+    out_off = np.zeros(n + 1, np.int64)
+    cap = max(
+        2048,
+        int(
+            n * 512
+            + len(ctr_blob) * 4
+            + len(ictr_blob) * 4
+            + len(start_blob) * 8
+        ),
+    )
+    for _ in range(2):
+        out = bytearray(cap)
+        need = lib.kwok_render_pod_statuses(
+            n,
+            pk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            phase_blob, _i64p(phase_off),
+            len(cond_names), cname_blob, _i64p(cname_off),
+            host_blob, _i64p(host_off),
+            pod_blob, _i64p(pod_off),
+            start_blob, _i64p(start_off),
+            ctr_blob, _i64p(ctr_off),
+            ictr_blob, _i64p(ictr_off),
+            (ctypes.c_char * len(out)).from_buffer(out), cap, _i64p(out_off),
+        )
+        if need <= cap:
+            return _split(out, out_off)
+        cap = need
+    raise AssertionError("codec buffer sizing did not converge")

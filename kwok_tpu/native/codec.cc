@@ -1,0 +1,264 @@
+// kwok_tpu native codec: batched JSON egress rendering.
+//
+// The host-side hot path of the engine is turning dirty rows into
+// Kubernetes status-patch JSON (the replacement for the reference's
+// per-object template rendering, pkg/kwok/controllers/renderer.go:49-89).
+// Python dict building + json.dumps dominates at O(100k) rows; this
+// library assembles the same bytes in one pass over flat blobs.
+//
+// Deliberately k8s-agnostic: all strings (condition metadata, phase names,
+// timestamps, ips, container specs) arrive as caller-provided blobs with
+// offset arrays, so the JSON *shape* lives here and the vocabulary stays in
+// Python (kwok_tpu/edge/render.py is the semantic source of truth; parity
+// is enforced by tests/test_native.py).
+//
+// Memory contract: every function returns the total bytes required. If that
+// exceeds out_cap nothing useful is in `out`; the caller re-allocates and
+// calls again. Per-row boundaries are written to out_off[0..n] so callers
+// can slice row i as out[out_off[i]:out_off[i+1]].
+//
+// Build: g++ -O2 -shared -fPIC -o libkwokcodec.so codec.cc  (see __init__.py)
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct Buf {
+  char* out;
+  int64_t cap;
+  int64_t len;  // bytes written (capped) — `need` tracks true size
+
+  inline void put(const char* s, int64_t n) {
+    if (len + n <= cap) {
+      std::memcpy(out + len, s, n);
+    }
+    len += n;
+  }
+  inline void put(char c) {
+    if (len + 1 <= cap) {
+      out[len] = c;
+    }
+    len += 1;
+  }
+  inline void lit(const char* s) { put(s, (int64_t)std::strlen(s)); }
+
+  // JSON-escaped string content (no surrounding quotes).
+  void esc(const char* s, int64_t n) {
+    static const char hex[] = "0123456789abcdef";
+    for (int64_t i = 0; i < n; i++) {
+      unsigned char c = (unsigned char)s[i];
+      switch (c) {
+        case '"': lit("\\\""); break;
+        case '\\': lit("\\\\"); break;
+        case '\n': lit("\\n"); break;
+        case '\r': lit("\\r"); break;
+        case '\t': lit("\\t"); break;
+        default:
+          if (c < 0x20) {
+            char u[7] = {'\\', 'u', '0', '0', hex[c >> 4], hex[c & 15], 0};
+            put(u, 6);
+          } else {
+            put((char)c);
+          }
+      }
+    }
+  }
+  inline void qesc(const char* s, int64_t n) {
+    put('"');
+    esc(s, n);
+    put('"');
+  }
+};
+
+struct Slices {
+  const char* blob;
+  const int64_t* off;
+  inline const char* ptr(int64_t i) const { return blob + off[i]; }
+  inline int64_t len(int64_t i) const { return off[i + 1] - off[i]; }
+};
+
+inline void put_kv(Buf& b, const char* key, const char* v, int64_t vn) {
+  b.put('"');
+  b.lit(key);
+  b.lit("\":");
+  b.qesc(v, vn);
+}
+
+}  // namespace
+
+extern "C" {
+
+// {"conditions":[{lastHeartbeatTime,lastTransitionTime,message,reason,
+//                 status,type} x n_conds]}
+// cond_meta holds 3*n_conds strings laid out (type, reason, message) per
+// condition; status of condition j for row i = bit j of cond_bits[i].
+int64_t kwok_render_heartbeats(
+    int32_t n_rows, const uint32_t* cond_bits, int32_t n_conds,
+    const char* cond_meta_blob, const int64_t* cond_meta_off,
+    const char* now, int32_t now_len,
+    const char* start_blob, const int64_t* start_off,
+    char* out, int64_t out_cap, int64_t* out_off) {
+  Buf b{out, out_cap, 0};
+  Slices meta{cond_meta_blob, cond_meta_off};
+  Slices start{start_blob, start_off};
+  for (int32_t i = 0; i < n_rows; i++) {
+    out_off[i] = b.len;
+    b.lit("{\"status\":{\"conditions\":[");
+    uint32_t bits = cond_bits[i];
+    for (int32_t j = 0; j < n_conds; j++) {
+      if (j) b.put(',');
+      b.lit("{\"lastHeartbeatTime\":");
+      b.qesc(now, now_len);
+      b.lit(",\"lastTransitionTime\":");
+      b.qesc(start.ptr(i), start.len(i));
+      b.put(',');
+      put_kv(b, "message", meta.ptr(3 * j + 2), meta.len(3 * j + 2));
+      b.put(',');
+      put_kv(b, "reason", meta.ptr(3 * j + 1), meta.len(3 * j + 1));
+      b.lit(",\"status\":");
+      b.lit((bits >> j) & 1 ? "\"True\"" : "\"False\"");
+      b.lit(",\"type\":");
+      b.qesc(meta.ptr(3 * j), meta.len(3 * j));
+      b.put('}');
+    }
+    b.lit("]}}");
+  }
+  out_off[n_rows] = b.len;
+  return b.len;
+}
+
+// Full pod status patch per row:
+// {"status":{"conditions":[3],"containerStatuses":[...],
+//   "initContainerStatuses":[...],"hostIP","podIP","phase","startTime"}}
+// phase_kind: 0 = running-like, 1 = terminated-ok, 2 = terminated-error.
+// Container specs per row: fields separated by \x1f, containers by \x1e
+// ("name\x1fimage\x1ename\x1fimage").
+int64_t kwok_render_pod_statuses(
+    int32_t n_rows, const uint8_t* phase_kind, const uint32_t* cond_bits,
+    const char* phase_blob, const int64_t* phase_off,
+    int32_t n_conds,
+    const char* cond_names_blob, const int64_t* cond_names_off,
+    const char* host_blob, const int64_t* host_off,
+    const char* pod_blob, const int64_t* pod_off,
+    const char* start_blob, const int64_t* start_off,
+    const char* ctr_blob, const int64_t* ctr_off,
+    const char* ictr_blob, const int64_t* ictr_off,
+    char* out, int64_t out_cap, int64_t* out_off) {
+  Buf b{out, out_cap, 0};
+  Slices phase{phase_blob, phase_off};
+  Slices cname{cond_names_blob, cond_names_off};
+  Slices host{host_blob, host_off};
+  Slices pod{pod_blob, pod_off};
+  Slices start{start_blob, start_off};
+  Slices ctr{ctr_blob, ctr_off};
+  Slices ictr{ictr_blob, ictr_off};
+
+  for (int32_t i = 0; i < n_rows; i++) {
+    out_off[i] = b.len;
+    const char* st = start.ptr(i);
+    int64_t stn = start.len(i);
+    uint8_t kind = phase_kind[i];
+    bool ready = kind == 0;
+
+    b.lit("{\"status\":{\"conditions\":[");
+    uint32_t bits = cond_bits[i];
+    for (int32_t j = 0; j < n_conds; j++) {
+      if (j) b.put(',');
+      b.lit("{\"lastTransitionTime\":");
+      b.qesc(st, stn);
+      b.lit(",\"status\":");
+      b.lit((bits >> j) & 1 ? "\"True\"" : "\"False\"");
+      b.lit(",\"type\":");
+      b.qesc(cname.ptr(j), cname.len(j));
+      b.put('}');
+    }
+    b.lit("],\"containerStatuses\":[");
+
+    // containers
+    const char* cs = ctr.ptr(i);
+    int64_t cn = ctr.len(i);
+    int64_t pos = 0;
+    bool first = true;
+    while (pos < cn) {
+      const char* rec = cs + pos;
+      const char* rec_end = (const char*)std::memchr(rec, '\x1e', cn - pos);
+      int64_t rec_len = rec_end ? rec_end - rec : cn - pos;
+      const char* sep = (const char*)std::memchr(rec, '\x1f', rec_len);
+      int64_t name_len = sep ? sep - rec : rec_len;
+      const char* img = sep ? sep + 1 : rec + rec_len;
+      int64_t img_len = sep ? rec + rec_len - img : 0;
+      if (!first) b.put(',');
+      first = false;
+      b.lit("{\"image\":");
+      b.qesc(img, img_len);
+      b.lit(",\"name\":");
+      b.qesc(rec, name_len);
+      b.lit(",\"ready\":");
+      b.lit(ready ? "true" : "false");
+      b.lit(",\"restartCount\":0,\"state\":");
+      if (kind == 0) {
+        b.lit("{\"running\":{\"startedAt\":");
+        b.qesc(st, stn);
+        b.lit("}}");
+      } else {
+        b.lit("{\"terminated\":{\"exitCode\":");
+        b.lit(kind == 1 ? "0" : "1");
+        b.lit(",\"finishedAt\":");
+        b.qesc(st, stn);
+        b.lit(",\"reason\":");
+        b.lit(kind == 1 ? "\"Completed\"" : "\"Error\"");
+        b.lit(",\"startedAt\":");
+        b.qesc(st, stn);
+        b.lit("}}");
+      }
+      b.put('}');
+      pos += rec_len + (rec_end ? 1 : 0);
+    }
+
+    b.lit("],\"initContainerStatuses\":[");
+    const char* is = ictr.ptr(i);
+    int64_t in_ = ictr.len(i);
+    pos = 0;
+    first = true;
+    while (pos < in_) {
+      const char* rec = is + pos;
+      const char* rec_end = (const char*)std::memchr(rec, '\x1e', in_ - pos);
+      int64_t rec_len = rec_end ? rec_end - rec : in_ - pos;
+      const char* sep = (const char*)std::memchr(rec, '\x1f', rec_len);
+      int64_t name_len = sep ? sep - rec : rec_len;
+      const char* img = sep ? sep + 1 : rec + rec_len;
+      int64_t img_len = sep ? rec + rec_len - img : 0;
+      if (!first) b.put(',');
+      first = false;
+      b.lit("{\"image\":");
+      b.qesc(img, img_len);
+      b.lit(",\"name\":");
+      b.qesc(rec, name_len);
+      b.lit(
+          ",\"ready\":true,\"restartCount\":0,\"state\":{\"terminated\":"
+          "{\"exitCode\":0,\"finishedAt\":");
+      b.qesc(st, stn);
+      b.lit(",\"reason\":\"Completed\",\"startedAt\":");
+      b.qesc(st, stn);
+      b.lit("}}}");
+      pos += rec_len + (rec_end ? 1 : 0);
+    }
+
+    b.lit("],\"hostIP\":");
+    b.qesc(host.ptr(i), host.len(i));
+    b.lit(",\"podIP\":");
+    b.qesc(pod.ptr(i), pod.len(i));
+    b.lit(",\"phase\":");
+    b.qesc(phase.ptr(i), phase.len(i));
+    b.lit(",\"startTime\":");
+    b.qesc(st, stn);
+    b.lit("}}");
+  }
+  out_off[n_rows] = b.len;
+  return b.len;
+}
+
+int32_t kwok_codec_abi_version() { return 1; }
+
+}  // extern "C"
