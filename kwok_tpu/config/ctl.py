@@ -126,6 +126,25 @@ class KwokctlConfigurationOptions:
     kubeSchedulerPort: int = 0
     kwokControllerPort: int = 0
     cacheDir: str = ""
+    # image-mode options (compose/kind runtimes; types.go image fields)
+    kubeImagePrefix: str = ""
+    etcdImagePrefix: str = ""
+    kwokImagePrefix: str = ""
+    prometheusImagePrefix: str = ""
+    kindNodeImagePrefix: str = ""
+    etcdImage: str = ""
+    kubeApiserverImage: str = ""
+    kubeControllerManagerImage: str = ""
+    kubeSchedulerImage: str = ""
+    kwokControllerImage: str = ""
+    prometheusImage: str = ""
+    kindNodeImage: str = ""
+    dockerComposeVersion: str = ""
+    dockerComposeBinaryPrefix: str = ""
+    dockerComposeBinary: str = ""
+    kindVersion: str = ""
+    kindBinaryPrefix: str = ""
+    kindBinary: str = ""
     # TPU-native engine knobs passed through to the kwok component
     # (not in the reference):
     tickInterval: float = 0.05

@@ -14,9 +14,19 @@ compose runtime.
 
 from __future__ import annotations
 
-from kwok_tpu.config.ctl import Component
+from kwok_tpu.config.ctl import Component, Port, Volume
 
 LOCAL_ADDRESS = "127.0.0.1"
+PUBLIC_ADDRESS = "0.0.0.0"
+
+# In-container well-known paths (components/*.go image branches)
+IN_CONTAINER_PKI = "/etc/kubernetes/pki"
+IN_CONTAINER_KUBECONFIG = "/root/.kube/config"
+IN_CONTAINER_KWOK_CONFIG = "/root/.kwok/kwok.yaml"
+IN_CONTAINER_ETCD_DATA = "/etcd-data"
+IN_CONTAINER_AUDIT_POLICY = "/etc/kubernetes/audit-policy.yaml"
+IN_CONTAINER_AUDIT_LOG = "/var/log/kubernetes/audit/audit.log"
+IN_CONTAINER_PROMETHEUS_CONFIG = "/etc/prometheus/prometheus.yaml"
 
 
 class BrokenLinksError(ValueError):
@@ -42,38 +52,43 @@ def group_by_links(components: list[Component]) -> list[list[Component]]:
 
 
 def build_etcd(
-    binary: str,
-    data_path: str,
-    workdir: str,
+    binary: str = "",
+    data_path: str = "",
+    workdir: str = "",
+    image: str = "",
     version: str = "",
     address: str = LOCAL_ADDRESS,
     port: int = 2379,
     peer_port: int = 2380,
 ) -> Component:
+    args = [
+        "--name=node0",
+        f"--initial-advertise-peer-urls=http://{address}:{peer_port}",
+        f"--listen-peer-urls=http://{address}:{peer_port}",
+        f"--advertise-client-urls=http://{address}:{port}",
+        f"--listen-client-urls=http://{address}:{port}",
+        f"--initial-cluster=node0=http://{address}:{peer_port}",
+        "--auto-compaction-retention=1",
+        "--quota-backend-bytes=8589934592",
+    ]
+    # image mode stores data inside the container (etcd.go:61-77)
+    args.append(f"--data-dir={IN_CONTAINER_ETCD_DATA if image else data_path}")
     return Component(
         name="etcd",
         version=version,
         binary=binary,
+        image=image,
         command=["etcd"],
         workDir=workdir,
-        args=[
-            "--name=node0",
-            f"--initial-advertise-peer-urls=http://{address}:{peer_port}",
-            f"--listen-peer-urls=http://{address}:{peer_port}",
-            f"--advertise-client-urls=http://{address}:{port}",
-            f"--listen-client-urls=http://{address}:{port}",
-            f"--initial-cluster=node0=http://{address}:{peer_port}",
-            "--auto-compaction-retention=1",
-            "--quota-backend-bytes=8589934592",
-            f"--data-dir={data_path}",
-        ],
+        args=args,
     )
 
 
 def build_kube_apiserver(
-    binary: str,
-    workdir: str,
-    port: int,
+    binary: str = "",
+    workdir: str = "",
+    port: int = 0,
+    image: str = "",
     version: str = "",
     address: str = LOCAL_ADDRESS,
     etcd_address: str = LOCAL_ADDRESS,
@@ -88,6 +103,12 @@ def build_kube_apiserver(
     admin_cert_path: str = "",
     admin_key_path: str = "",
 ) -> Component:
+    """Image mode (kube_apiserver.go:75-183): fixed in-container ports
+    (6443 secure / 8080 insecure) published to the host port, certs and
+    audit files bind-mounted at /etc/kubernetes paths."""
+    in_container = bool(image)
+    ports: list[Port] = []
+    volumes: list[Volume] = []
     args = [
         "--admission-control=",
         f"--etcd-servers=http://{etcd_address}:{etcd_port}",
@@ -101,73 +122,132 @@ def build_kube_apiserver(
     if secure_port:
         if authorization:
             args.append("--authorization-mode=Node,RBAC")
+        if in_container:
+            ports = [Port(hostPort=port, port=6443)]
+            volumes += [
+                Volume(hostPath=ca_cert_path, mountPath=f"{IN_CONTAINER_PKI}/ca.crt", readOnly=True),
+                Volume(hostPath=admin_cert_path, mountPath=f"{IN_CONTAINER_PKI}/admin.crt", readOnly=True),
+                Volume(hostPath=admin_key_path, mountPath=f"{IN_CONTAINER_PKI}/admin.key", readOnly=True),
+            ]
+            crt = f"{IN_CONTAINER_PKI}/admin.crt"
+            key = f"{IN_CONTAINER_PKI}/admin.key"
+            ca = f"{IN_CONTAINER_PKI}/ca.crt"
+            bind, sport = PUBLIC_ADDRESS, 6443
+        else:
+            crt, key, ca = admin_cert_path, admin_key_path, ca_cert_path
+            bind, sport = address, port
         args += [
-            f"--bind-address={address}",
-            f"--secure-port={port}",
-            f"--tls-cert-file={admin_cert_path}",
-            f"--tls-private-key-file={admin_key_path}",
-            f"--client-ca-file={ca_cert_path}",
-            f"--service-account-key-file={admin_key_path}",
-            f"--service-account-signing-key-file={admin_key_path}",
+            f"--bind-address={bind}",
+            f"--secure-port={sport}",
+            f"--tls-cert-file={crt}",
+            f"--tls-private-key-file={key}",
+            f"--client-ca-file={ca}",
+            f"--service-account-key-file={key}",
+            f"--service-account-signing-key-file={key}",
             "--service-account-issuer=https://kubernetes.default.svc.cluster.local",
         ]
     else:
-        args += [
-            f"--insecure-bind-address={address}",
-            f"--insecure-port={port}",
-        ]
+        if in_container:
+            ports = [Port(hostPort=port, port=8080)]
+            args += [
+                f"--insecure-bind-address={PUBLIC_ADDRESS}",
+                "--insecure-port=8080",
+            ]
+        else:
+            args += [
+                f"--insecure-bind-address={address}",
+                f"--insecure-port={port}",
+            ]
     if audit_policy_path:
-        args += [
-            f"--audit-policy-file={audit_policy_path}",
-            f"--audit-log-path={audit_log_path}",
-        ]
+        if in_container:
+            volumes += [
+                Volume(hostPath=audit_policy_path, mountPath=IN_CONTAINER_AUDIT_POLICY, readOnly=True),
+                Volume(hostPath=audit_log_path, mountPath=IN_CONTAINER_AUDIT_LOG, readOnly=False),
+            ]
+            args += [
+                f"--audit-policy-file={IN_CONTAINER_AUDIT_POLICY}",
+                f"--audit-log-path={IN_CONTAINER_AUDIT_LOG}",
+            ]
+        else:
+            args += [
+                f"--audit-policy-file={audit_policy_path}",
+                f"--audit-log-path={audit_log_path}",
+            ]
     return Component(
         name="kube-apiserver",
         version=version,
         links=["etcd"],
         binary=binary,
+        image=image,
         command=["kube-apiserver"],
         workDir=workdir,
+        ports=ports,
+        volumes=volumes,
         args=args,
     )
 
 
 def build_kube_controller_manager(
-    binary: str,
-    workdir: str,
-    kubeconfig_path: str,
-    port: int,
+    binary: str = "",
+    workdir: str = "",
+    kubeconfig_path: str = "",
+    port: int = 0,
+    image: str = "",
     version: str = "",
     address: str = LOCAL_ADDRESS,
     secure_port: bool = False,
     authorization: bool = False,
     feature_gates: str = "",
     ca_cert_path: str = "",
+    admin_cert_path: str = "",
     admin_key_path: str = "",
     node_monitor_period_s: float = 0.0,
     node_monitor_grace_period_s: float = 0.0,
 ) -> Component:
+    """Image mode (kube_controller_manager.go:54-147): kubeconfig + certs
+    bind-mounted, fixed in-container ports 10257/10252."""
+    in_container = bool(image)
+    volumes: list[Volume] = []
+    if in_container:
+        volumes += [
+            Volume(hostPath=kubeconfig_path, mountPath=IN_CONTAINER_KUBECONFIG, readOnly=True),
+            Volume(hostPath=admin_cert_path, mountPath=f"{IN_CONTAINER_PKI}/admin.crt", readOnly=True),
+            Volume(hostPath=admin_key_path, mountPath=f"{IN_CONTAINER_PKI}/admin.key", readOnly=True),
+        ]
     args = []
     if feature_gates:
         args.append(f"--feature-gates={feature_gates}")
-    args.append(f"--kubeconfig={kubeconfig_path}")
+    args.append(
+        f"--kubeconfig={IN_CONTAINER_KUBECONFIG if in_container else kubeconfig_path}"
+    )
     if secure_port:
-        args += [
-            "--authorization-always-allow-paths=/healthz,/readyz,/livez,/metrics",
-            f"--bind-address={address}",
-            f"--secure-port={port}",
-        ]
+        args.append(
+            "--authorization-always-allow-paths=/healthz,/readyz,/livez,/metrics"
+        )
+        if in_container:
+            args += [f"--bind-address={PUBLIC_ADDRESS}", "--secure-port=10257"]
+        else:
+            args += [f"--bind-address={address}", f"--secure-port={port}"]
     else:
-        args += [
-            f"--address={address}",
-            f"--port={port}",
-            "--secure-port=0",
-        ]
+        if in_container:
+            args += [f"--address={PUBLIC_ADDRESS}", "--port=10252"]
+        else:
+            args += [f"--address={address}", f"--port={port}"]
+        args.append("--secure-port=0")
     if authorization:
-        args += [
-            f"--root-ca-file={ca_cert_path}",
-            f"--service-account-private-key-file={admin_key_path}",
-        ]
+        if in_container:
+            volumes.append(
+                Volume(hostPath=ca_cert_path, mountPath=f"{IN_CONTAINER_PKI}/ca.crt", readOnly=True)
+            )
+            args += [
+                f"--root-ca-file={IN_CONTAINER_PKI}/ca.crt",
+                f"--service-account-private-key-file={IN_CONTAINER_PKI}/admin.key",
+            ]
+        else:
+            args += [
+                f"--root-ca-file={ca_cert_path}",
+                f"--service-account-private-key-file={admin_key_path}",
+            ]
     # accelerated node-failure detection for simulation scenarios
     # (kube_controller_manager.go NodeMonitor options)
     if node_monitor_period_s:
@@ -179,88 +259,160 @@ def build_kube_controller_manager(
         version=version,
         links=["kube-apiserver"],
         binary=binary,
+        image=image,
         command=["kube-controller-manager"],
         workDir=workdir,
+        volumes=volumes,
         args=args,
     )
 
 
 def build_kube_scheduler(
-    binary: str,
-    workdir: str,
-    kubeconfig_path: str,
-    port: int,
+    binary: str = "",
+    workdir: str = "",
+    kubeconfig_path: str = "",
+    port: int = 0,
+    image: str = "",
     version: str = "",
     address: str = LOCAL_ADDRESS,
     secure_port: bool = False,
     feature_gates: str = "",
+    admin_cert_path: str = "",
+    admin_key_path: str = "",
 ) -> Component:
+    """Image mode (kube_scheduler.go:53-122): kubeconfig + certs
+    bind-mounted, fixed in-container ports 10259/10251."""
+    in_container = bool(image)
+    volumes: list[Volume] = []
+    if in_container:
+        volumes += [
+            Volume(hostPath=kubeconfig_path, mountPath=IN_CONTAINER_KUBECONFIG, readOnly=True),
+            Volume(hostPath=admin_cert_path, mountPath=f"{IN_CONTAINER_PKI}/admin.crt", readOnly=True),
+            Volume(hostPath=admin_key_path, mountPath=f"{IN_CONTAINER_PKI}/admin.key", readOnly=True),
+        ]
     args = []
     if feature_gates:
         args.append(f"--feature-gates={feature_gates}")
-    args.append(f"--kubeconfig={kubeconfig_path}")
+    args.append(
+        f"--kubeconfig={IN_CONTAINER_KUBECONFIG if in_container else kubeconfig_path}"
+    )
     if secure_port:
-        args += [
-            "--authorization-always-allow-paths=/healthz,/readyz,/livez,/metrics",
-            f"--bind-address={address}",
-            f"--secure-port={port}",
-        ]
+        args.append(
+            "--authorization-always-allow-paths=/healthz,/readyz,/livez,/metrics"
+        )
+        if in_container:
+            args += [f"--bind-address={PUBLIC_ADDRESS}", "--secure-port=10259"]
+        else:
+            args += [f"--bind-address={address}", f"--secure-port={port}"]
     else:
-        args += [
-            f"--address={address}",
-            f"--port={port}",
-        ]
+        if in_container:
+            args += [f"--address={PUBLIC_ADDRESS}", "--port=10251"]
+        else:
+            args += [f"--address={address}", f"--port={port}"]
     return Component(
         name="kube-scheduler",
         version=version,
         links=["kube-apiserver"],
         binary=binary,
+        image=image,
         command=["kube-scheduler"],
         workDir=workdir,
+        volumes=volumes,
         args=args,
     )
 
 
 def build_kwok_controller(
-    binary: str,
-    workdir: str,
-    kubeconfig_path: str,
-    config_path: str,
-    port: int,
+    binary: str = "",
+    workdir: str = "",
+    kubeconfig_path: str = "",
+    config_path: str = "",
+    port: int = 0,
+    image: str = "",
     version: str = "",
     address: str = LOCAL_ADDRESS,
+    admin_cert_path: str = "",
+    admin_key_path: str = "",
 ) -> Component:
     """The simulation engine — THIS package's `kwok` CLI, launched via the
     shim written by the binary runtime (kwok_controller.go:61-83 arg
-    surface)."""
+    surface). Image mode (:47-78) bind-mounts kubeconfig, certs and config
+    and serves on 0.0.0.0:8080 in-container."""
+    in_container = bool(image)
+    volumes: list[Volume] = []
+    ports: list[Port] = []
+    if in_container:
+        volumes += [
+            Volume(hostPath=kubeconfig_path, mountPath=IN_CONTAINER_KUBECONFIG, readOnly=True),
+            Volume(hostPath=admin_cert_path, mountPath=f"{IN_CONTAINER_PKI}/admin.crt", readOnly=True),
+            Volume(hostPath=admin_key_path, mountPath=f"{IN_CONTAINER_PKI}/admin.key", readOnly=True),
+            Volume(hostPath=config_path, mountPath=IN_CONTAINER_KWOK_CONFIG, readOnly=True),
+        ]
+        if port:
+            # publish the engine's healthz/metrics server to the host
+            ports = [Port(hostPort=port, port=8080)]
+        args = [
+            "--manage-all-nodes=true",
+            f"--kubeconfig={IN_CONTAINER_KUBECONFIG}",
+            f"--config={IN_CONTAINER_KWOK_CONFIG}",
+            f"--server-address={PUBLIC_ADDRESS}:8080",
+        ]
+    else:
+        args = [
+            "--manage-all-nodes=true",
+            f"--kubeconfig={kubeconfig_path}",
+            f"--config={config_path}",
+            f"--server-address={address}:{port}",
+        ]
     return Component(
         name="kwok-controller",
         version=version,
         links=["kube-apiserver"],
         binary=binary,
+        image=image,
         command=["kwok"],
         workDir=workdir,
-        args=[
-            "--manage-all-nodes=true",
-            f"--kubeconfig={kubeconfig_path}",
-            f"--config={config_path}",
-            f"--server-address={address}:{port}",
-        ],
+        ports=ports,
+        volumes=volumes,
+        args=args,
     )
 
 
 def build_prometheus(
-    binary: str,
-    workdir: str,
-    config_path: str,
-    port: int,
+    binary: str = "",
+    workdir: str = "",
+    config_path: str = "",
+    port: int = 0,
+    image: str = "",
     version: str = "",
     address: str = LOCAL_ADDRESS,
     links: list[str] | None = None,
+    admin_cert_path: str = "",
+    admin_key_path: str = "",
 ) -> Component:
     # default links assume the full control plane; callers with disabled
     # components must pass the names actually present, or group_by_links
     # could never place prometheus
+    in_container = bool(image)
+    ports: list[Port] = []
+    volumes: list[Volume] = []
+    if in_container:
+        # prometheus.go:47-75: config + certs mounted, 9090 published
+        volumes += [
+            Volume(hostPath=config_path, mountPath=IN_CONTAINER_PROMETHEUS_CONFIG, readOnly=True),
+            Volume(hostPath=admin_cert_path, mountPath=f"{IN_CONTAINER_PKI}/admin.crt", readOnly=True),
+            Volume(hostPath=admin_key_path, mountPath=f"{IN_CONTAINER_PKI}/admin.key", readOnly=True),
+        ]
+        ports = [Port(hostPort=port, port=9090)]
+        args = [
+            f"--config.file={IN_CONTAINER_PROMETHEUS_CONFIG}",
+            f"--web.listen-address={PUBLIC_ADDRESS}:9090",
+        ]
+    else:
+        args = [
+            f"--config.file={config_path}",
+            f"--web.listen-address={address}:{port}",
+        ]
     return Component(
         name="prometheus",
         version=version,
@@ -274,12 +426,12 @@ def build_prometheus(
             "kwok-controller",
         ],
         binary=binary,
+        image=image,
         command=["prometheus"],
         workDir=workdir,
-        args=[
-            f"--config.file={config_path}",
-            f"--web.listen-address={address}:{port}",
-        ],
+        ports=ports,
+        volumes=volumes,
+        args=args,
     )
 
 
@@ -334,4 +486,59 @@ def build_prometheus_config(
     if kube_scheduler_port:
         cfg += job("kube-scheduler", kube_scheduler_port)
     cfg += job("kwok-controller", kwok_controller_port, secure=False)
+    return cfg
+
+
+def build_prometheus_config_compose(
+    project_name: str,
+    secure_port: bool = False,
+    admin_crt_path: str = f"{IN_CONTAINER_PKI}/admin.crt",
+    admin_key_path: str = f"{IN_CONTAINER_PKI}/admin.key",
+    kube_controller_manager: bool = True,
+    kube_scheduler: bool = True,
+) -> str:
+    """Scrape config for the compose runtime: targets are container DNS
+    names `<project>-<component>:<in-container port>`
+    (runtime/compose/prometheus.yaml.tpl semantics)."""
+    scheme = "https" if secure_port else "http"
+    tls = ""
+    if secure_port:
+        tls = (
+            "    tls_config:\n"
+            "      insecure_skip_verify: true\n"
+            f"      cert_file: {admin_crt_path}\n"
+            f"      key_file: {admin_key_path}\n"
+        )
+
+    def job(name: str, target: str, secure: bool = True) -> str:
+        sch = scheme if secure else "http"
+        out = f"  - job_name: {name}\n    scheme: {sch}\n    metrics_path: /metrics\n"
+        if secure and tls:
+            out += tls
+        out += f"    static_configs:\n      - targets: ['{target}']\n"
+        return out
+
+    cfg = (
+        "global:\n"
+        "  scrape_interval: 15s\n"
+        f"  external_labels:\n    cluster: {project_name}\n"
+        "scrape_configs:\n"
+    )
+    cfg += job("prometheus", "localhost:9090", secure=False)
+    cfg += job("etcd", f"{project_name}-etcd:2379", secure=False)
+    cfg += job(
+        "kube-apiserver",
+        f"{project_name}-kube-apiserver:{6443 if secure_port else 8080}",
+    )
+    if kube_controller_manager:
+        cfg += job(
+            "kube-controller-manager",
+            f"{project_name}-kube-controller-manager:{10257 if secure_port else 10252}",
+        )
+    if kube_scheduler:
+        cfg += job(
+            "kube-scheduler",
+            f"{project_name}-kube-scheduler:{10259 if secure_port else 10251}",
+        )
+    cfg += job("kwok-controller", f"{project_name}-kwok-controller:8080", secure=False)
     return cfg

@@ -7,6 +7,7 @@ there is no controller download.
 
 PROJECT_NAME = "kwok"
 CONFIG_NAME = "kwok.yaml"
+KWOK_VERSION = "v0.1.0"  # version tag used for this package's engine image
 
 DEFAULT_KUBE_VERSION = "v1.26.0"
 
@@ -16,7 +17,21 @@ PROMETHEUS_VERSION = "2.41.0"
 PROMETHEUS_BINARY_PREFIX = "https://github.com/prometheus/prometheus/releases/download"
 
 RUNTIME_TYPE_BINARY = "binary"
+RUNTIME_TYPE_DOCKER = "docker"
+RUNTIME_TYPE_NERDCTL = "nerdctl"
+RUNTIME_TYPE_KIND = "kind"
 RUNTIME_TYPE_MOCK = "mock"  # in-process runtime for tests/CI (no downloads)
+
+# Image registries (consts.go:26-44)
+KUBE_IMAGE_PREFIX = "registry.k8s.io"
+KWOK_IMAGE_PREFIX = "registry.k8s.io/kwok"
+PROMETHEUS_IMAGE_PREFIX = "docker.io/prom"
+KIND_NODE_IMAGE_PREFIX = "docker.io/kindest"
+
+DOCKER_COMPOSE_VERSION = "2.13.0"
+DOCKER_COMPOSE_BINARY_PREFIX = "https://github.com/docker/compose/releases/download"
+KIND_VERSION = "0.17.0"
+KIND_BINARY_PREFIX = "https://github.com/kubernetes-sigs/kind/releases/download"
 
 # Mode presets (kwokctl_configuration_types.go ModeStableFeatureGateAndAPI)
 MODE_STABLE_FEATURE_GATE_AND_API = "StableFeatureGateAndAPI"

@@ -1,0 +1,493 @@
+"""Compose runtime: the control plane as docker/nerdctl containers.
+
+Behavioral port of pkg/kwokctl/runtime/compose: install() builds the same
+declarative Component specs as the binary runtime but in image mode
+(in-container paths + published ports), converts them to a docker-compose v3
+document (compose.go:28-85: entrypoint=command, command=args, restart:
+always, bind volumes, ingress ports, links, per-project network), and
+up/down/start/stop shells out to `<runtime> compose` with the reference's
+nerdctl quirks (cluster.go:525-566: nerdctl start = `up -d`, stop = `down`
+plus an etcd snapshot round-trip so state survives `down`).
+
+Liveness is `compose ps --format=json`: every service must be "running"
+(cluster.go:463-505). Snapshots: save = etcdctl inside the etcd container +
+`cp` out (cluster_snapshot.go:30-52); restore = host etcdctl rebuilds a data
+dir which is `cp`'d back in (:55-140).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+
+from kwok_tpu.config.ctl import Component
+from kwok_tpu.kwokctl import components as comp
+from kwok_tpu.kwokctl import consts, download, k8s, netutil, pki
+from kwok_tpu.kwokctl.runtime import base
+from kwok_tpu.kwokctl.runtime.base import Cluster
+
+COMPOSE_NAME = "docker-compose.yaml"
+IN_CLUSTER_KUBECONFIG_NAME = "kubeconfig"
+
+
+def components_to_compose(project: str, components: list[Component]) -> dict:
+    """Component list -> docker-compose v3 document (compose.go:28-85)."""
+    services: dict[str, dict] = {}
+    for c in components:
+        svc: dict = {
+            "container_name": f"{project}-{c.name}",
+            "image": c.image,
+            "restart": "always",
+            "entrypoint": list(c.command),
+        }
+        if c.links:
+            svc["links"] = list(c.links)
+        if c.args:
+            svc["command"] = list(c.args)
+        if c.ports:
+            svc["ports"] = [
+                {
+                    "mode": "ingress",
+                    "target": p.port,
+                    "published": str(p.hostPort),
+                    "protocol": p.protocol.lower(),
+                }
+                for p in c.ports
+            ]
+        if c.envs:
+            svc["environment"] = {e.name: e.value for e in c.envs}
+        if c.volumes:
+            svc["volumes"] = [
+                {
+                    "type": "bind",
+                    "source": v.hostPath,
+                    "target": v.mountPath,
+                    **({"read_only": True} if v.readOnly else {}),
+                }
+                for v in c.volumes
+            ]
+        services[c.name] = svc
+    return {
+        "version": "3",
+        "services": services,
+        "networks": {"default": {"name": project}},
+    }
+
+
+def dump_compose_yaml(doc: dict) -> str:
+    import yaml
+
+    return yaml.safe_dump(doc, sort_keys=False)
+
+
+class ComposeCluster(Cluster):
+    """Shared docker/nerdctl backend; `options.runtime` picks the CLI."""
+
+    RUNTIME = consts.RUNTIME_TYPE_DOCKER
+
+    # --- helpers ----------------------------------------------------------
+
+    def _runtime_bin(self) -> str:
+        return self.config().options.runtime or consts.RUNTIME_TYPE_DOCKER
+
+    def _project(self) -> str:
+        return f"{consts.PROJECT_NAME}-{self.name}"
+
+    def _container(self, component: str) -> str:
+        return f"{self._project()}-{component}"
+
+    def _run(self, args: list[str], capture: bool = False, check: bool = True, **kw):
+        """Run a container-CLI command in the workdir."""
+        if capture:
+            res = subprocess.run(
+                args, cwd=self.workdir, capture_output=True, text=True, **kw
+            )
+        else:
+            res = subprocess.run(args, cwd=self.workdir, **kw)
+        if check and res.returncode != 0:
+            err = (res.stderr or "") if capture else ""
+            raise RuntimeError(f"{' '.join(args)} failed ({res.returncode}): {err}")
+        return res
+
+    _compose_prefix: list[str] | None = None
+
+    def _compose_cmd(self, *args: str) -> list[str]:
+        """`<runtime> compose <args>`, falling back to a downloaded
+        docker-compose binary when the docker CLI lacks the subcommand
+        (cluster.go buildComposeCommands). The probe result is cached per
+        instance — up()'s retry loop calls this every second."""
+        if self._compose_prefix is None:
+            rt = self._runtime_bin()
+            prefix = [rt, "compose"]
+            if rt == consts.RUNTIME_TYPE_DOCKER:
+                probe = subprocess.run(
+                    [rt, "compose", "version"], capture_output=True, text=True
+                )
+                if probe.returncode != 0:
+                    conf = self.config().options
+                    path = self.bin_path("docker-compose" + conf.binSuffix)
+                    if not os.path.exists(path):
+                        download.download_with_cache(
+                            conf.cacheDir, conf.dockerComposeBinary, path,
+                            quiet=conf.quietPull,
+                        )
+                    prefix = [path]
+            self._compose_prefix = prefix
+        return [*self._compose_prefix, *args]
+
+    # --- install ----------------------------------------------------------
+
+    def install(self) -> None:
+        config = self.config()
+        conf = config.options
+        self._setup_workdir()
+        if not conf.kubeApiserverPort:
+            conf.kubeApiserverPort = netutil.get_unused_port()
+        if not conf.kwokControllerPort:
+            conf.kwokControllerPort = netutil.get_unused_port()
+        self._pull_images()
+        self._build_components()
+        self._write_kubeconfigs()
+        with open(self.workdir_path(COMPOSE_NAME), "w") as f:
+            f.write(dump_compose_yaml(
+                components_to_compose(self._project(), config.components)
+            ))
+        self.save()
+
+    def _setup_workdir(self) -> None:
+        conf = self.config().options
+        pki_path = self.workdir_path(base.PKI_NAME)
+        if not os.path.exists(os.path.join(pki_path, "ca.crt")):
+            pki.generate_pki(pki_path)
+        os.makedirs(self.workdir_path(base.ETCD_DATA_DIR_NAME), exist_ok=True)
+        os.makedirs(self.workdir_path("logs"), exist_ok=True)
+        if conf.kubeAuditPolicy:
+            shutil.copyfile(conf.kubeAuditPolicy, self.workdir_path(base.AUDIT_POLICY_NAME))
+            open(self.log_path(base.AUDIT_LOG_NAME), "a").close()
+
+    def _pull_images(self) -> None:
+        conf = self.config().options
+        for image in self.list_images():
+            if not image:
+                continue
+            inspect = subprocess.run(
+                [self._runtime_bin(), "image", "inspect", image],
+                capture_output=True,
+            )
+            if inspect.returncode == 0:
+                continue
+            self._run([self._runtime_bin(), "pull", image], check=True)
+
+    def _build_components(self) -> None:
+        config = self.config()
+        conf = config.options
+        workdir = self.workdir
+        pki_dir = self.workdir_path(base.PKI_NAME)
+        ca_crt = os.path.join(pki_dir, "ca.crt")
+        admin_crt = os.path.join(pki_dir, "admin.crt")
+        admin_key = os.path.join(pki_dir, "admin.key")
+        in_cluster_kubeconfig = self.workdir_path(IN_CLUSTER_KUBECONFIG_NAME)
+        audit_policy = audit_log = ""
+        if conf.kubeAuditPolicy:
+            audit_policy = self.workdir_path(base.AUDIT_POLICY_NAME)
+            audit_log = self.log_path(base.AUDIT_LOG_NAME)
+
+        cs = [
+            comp.build_etcd(
+                image=conf.etcdImage,
+                workdir=workdir,
+                version=conf.etcdVersion,
+                address="0.0.0.0",
+            ),
+            comp.build_kube_apiserver(
+                image=conf.kubeApiserverImage,
+                workdir=workdir,
+                port=conf.kubeApiserverPort,
+                version=conf.kubeVersion,
+                etcd_address=self._container("etcd"),
+                etcd_port=2379,
+                runtime_config=conf.kubeRuntimeConfig,
+                feature_gates=conf.kubeFeatureGates,
+                secure_port=bool(conf.securePort),
+                authorization=conf.kubeAuthorization,
+                audit_policy_path=audit_policy,
+                audit_log_path=audit_log,
+                ca_cert_path=ca_crt,
+                admin_cert_path=admin_crt,
+                admin_key_path=admin_key,
+            ),
+        ]
+        if not conf.disableKubeControllerManager:
+            cs.append(
+                comp.build_kube_controller_manager(
+                    image=conf.kubeControllerManagerImage,
+                    workdir=workdir,
+                    kubeconfig_path=in_cluster_kubeconfig,
+                    version=conf.kubeVersion,
+                    secure_port=bool(conf.securePort),
+                    authorization=conf.kubeAuthorization,
+                    feature_gates=conf.kubeFeatureGates,
+                    ca_cert_path=ca_crt,
+                    admin_cert_path=admin_crt,
+                    admin_key_path=admin_key,
+                )
+            )
+        if not conf.disableKubeScheduler:
+            cs.append(
+                comp.build_kube_scheduler(
+                    image=conf.kubeSchedulerImage,
+                    workdir=workdir,
+                    kubeconfig_path=in_cluster_kubeconfig,
+                    version=conf.kubeVersion,
+                    secure_port=bool(conf.securePort),
+                    feature_gates=conf.kubeFeatureGates,
+                    admin_cert_path=admin_crt,
+                    admin_key_path=admin_key,
+                )
+            )
+        cs.append(
+            comp.build_kwok_controller(
+                image=conf.kwokControllerImage,
+                workdir=workdir,
+                kubeconfig_path=in_cluster_kubeconfig,
+                config_path=self.workdir_path(base.CONFIG_NAME),
+                port=conf.kwokControllerPort,
+                version=conf.kwokVersion,
+                admin_cert_path=admin_crt,
+                admin_key_path=admin_key,
+            )
+        )
+        if conf.prometheusPort:
+            prom_cfg = comp.build_prometheus_config_compose(
+                project_name=self._project(),
+                secure_port=bool(conf.securePort),
+                kube_controller_manager=not conf.disableKubeControllerManager,
+                kube_scheduler=not conf.disableKubeScheduler,
+            )
+            prom_path = self.workdir_path(base.PROMETHEUS_NAME)
+            with open(prom_path, "w") as f:
+                f.write(prom_cfg)
+            cs.append(
+                comp.build_prometheus(
+                    image=conf.prometheusImage,
+                    workdir=workdir,
+                    config_path=prom_path,
+                    port=conf.prometheusPort,
+                    version=conf.prometheusVersion,
+                    links=[c.name for c in cs],
+                    admin_cert_path=admin_crt,
+                    admin_key_path=admin_key,
+                )
+            )
+        config.components = cs
+
+    def _write_kubeconfigs(self) -> None:
+        conf = self.config().options
+        pki_dir = self.workdir_path(base.PKI_NAME)
+        scheme = "https" if conf.securePort else "http"
+        host_port = conf.kubeApiserverPort
+        data = k8s.build_kubeconfig(
+            project_name=self.name,
+            address=f"{scheme}://127.0.0.1:{host_port}",
+            secure_port=bool(conf.securePort),
+            admin_crt_path=os.path.join(pki_dir, "admin.crt"),
+            admin_key_path=os.path.join(pki_dir, "admin.key"),
+        )
+        with open(self.workdir_path(base.IN_HOST_KUBECONFIG_NAME), "w") as f:
+            f.write(data)
+        # in-cluster flavor: container DNS name + in-container port and
+        # in-container cert paths (compose/cluster.go:341-352)
+        in_port = 6443 if conf.securePort else 8080
+        in_data = k8s.build_kubeconfig(
+            project_name=self.name,
+            address=f"{scheme}://{self._container('kube-apiserver')}:{in_port}",
+            secure_port=bool(conf.securePort),
+            admin_crt_path=f"{comp.IN_CONTAINER_PKI}/admin.crt",
+            admin_key_path=f"{comp.IN_CONTAINER_PKI}/admin.key",
+        )
+        with open(self.workdir_path(IN_CLUSTER_KUBECONFIG_NAME), "w") as f:
+            f.write(in_data)
+
+    # --- up/down/start/stop ----------------------------------------------
+
+    def up(self, timeout: float = 120.0) -> None:
+        import time
+
+        conf = self.config().options
+        args = ["up", "-d"]
+        if conf.quietPull:
+            args.append("--quiet-pull")
+        deadline = time.monotonic() + timeout
+        while True:
+            res = self._run(self._compose_cmd(*args), check=False)
+            if res.returncode == 0 and self.is_running():
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"cluster {self.name} failed to come up within {timeout}s"
+                )
+            time.sleep(1.0)
+
+    def is_running(self) -> bool:
+        """All compose services report state running
+        (cluster.go:463-505). Accepts both a JSON array (docker compose
+        v2.20 and earlier) and NDJSON (later)."""
+        res = self._run(self._compose_cmd("ps", "--format=json"),
+                        capture=True, check=False)
+        if res.returncode != 0:
+            return False
+        text = (res.stdout or "").strip()
+        if not text:
+            return False
+        try:
+            items = json.loads(text)
+            if isinstance(items, dict):
+                items = [items]
+        except json.JSONDecodeError:
+            items = []
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    items.append(json.loads(line))
+                except json.JSONDecodeError:
+                    return False  # garbled output counts as not-ready
+        if not items:
+            return False
+        return all(
+            str(i.get("State", i.get("state", ""))).lower() == "running"
+            for i in items
+        )
+
+    def down(self) -> None:
+        self._run(self._compose_cmd("down"), check=False)
+
+    def start(self) -> None:
+        conf = self.config().options
+        if conf.runtime == consts.RUNTIME_TYPE_NERDCTL:
+            # nerdctl lacks `compose start` (cluster.go:525-531)
+            self._run(self._compose_cmd("up", "-d"))
+            backup = self.workdir_path("restart.db")
+            if os.path.isfile(backup):
+                self.snapshot_restore(backup)
+                os.remove(backup)
+        else:
+            self._run(self._compose_cmd("start"))
+
+    def stop(self) -> None:
+        conf = self.config().options
+        if conf.runtime == consts.RUNTIME_TYPE_NERDCTL:
+            # nerdctl lacks `compose stop`; snapshot so `down` loses nothing
+            # (cluster.go:570-580)
+            self.snapshot_save(self.workdir_path("restart.db"))
+            self._run(self._compose_cmd("down"))
+        else:
+            self._run(self._compose_cmd("stop"))
+
+    def start_component(self, name: str) -> None:
+        self.get_component(name)
+        self._run([self._runtime_bin(), "start", self._container(name)])
+
+    def stop_component(self, name: str) -> None:
+        self.get_component(name)
+        self._run([self._runtime_bin(), "stop", self._container(name)])
+
+    # --- logs -------------------------------------------------------------
+
+    def logs(self, name: str, out, follow: bool = False) -> None:
+        """Stream `<runtime> logs [-f]`; -f never exits, so output must be
+        piped through as it arrives, not captured."""
+        self.get_component(name)
+        args = [self._runtime_bin(), "logs"]
+        if follow:
+            args.append("-f")
+        args.append(self._container(name))
+        proc = subprocess.Popen(
+            args, cwd=self.workdir, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                out.write(line)
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+            proc.wait()
+
+    # --- artifacts --------------------------------------------------------
+
+    def list_binaries(self) -> list[str]:
+        conf = self.config().options
+        return [conf.kubectlBinary]
+
+    def list_images(self) -> list[str]:
+        conf = self.config().options
+        images = [conf.etcdImage, conf.kubeApiserverImage, conf.kwokControllerImage]
+        if not conf.disableKubeControllerManager:
+            images.append(conf.kubeControllerManagerImage)
+        if not conf.disableKubeScheduler:
+            images.append(conf.kubeSchedulerImage)
+        if conf.prometheusPort:
+            images.append(conf.prometheusImage)
+        return images
+
+    # --- etcdctl / snapshot ----------------------------------------------
+
+    def etcdctl_in_cluster(self, args: list[str], **kwargs) -> int:
+        from kwok_tpu.kwokctl import procutil
+
+        return procutil.exec_foreground(
+            [self._runtime_bin(), "exec", "-i", self._container("etcd"), "etcdctl",
+             *args],
+            **kwargs,
+        )
+
+    def snapshot_save(self, path: str) -> None:
+        """etcdctl snapshot save inside the container, then cp out
+        (cluster_snapshot.go:30-52)."""
+        tmp = "/snapshot.db"
+        self._run([self._runtime_bin(), "exec", "-i", self._container("etcd"),
+                   "etcdctl", "snapshot", "save", tmp])
+        self._run([self._runtime_bin(), "cp", f"{self._container('etcd')}:{tmp}", path])
+
+    def snapshot_restore(self, path: str) -> None:
+        """Host etcdctl rebuilds a data dir; cp it into the container
+        around an etcd restart (cluster_snapshot.go:55-140)."""
+        conf = self.config().options
+        etcdctl = self.bin_path("etcdctl")
+        if not os.path.exists(etcdctl):
+            download.download_with_cache_and_extract(
+                conf.cacheDir, conf.etcdBinaryTar, etcdctl, "etcdctl",
+                quiet=conf.quietPull,
+            )
+        tmp_dir = self.workdir_path("etcd-data")
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        self._run([etcdctl, "snapshot", "restore", path, "--data-dir", tmp_dir])
+        rt = self._runtime_bin()
+        etcd_ctr = self._container("etcd")
+        try:
+            # Freeze the only writer, then swap the data dir underneath the
+            # (still-running) etcd and bounce it. `cp` into a live container
+            # works on docker AND nerdctl (nerdctl cp can't touch stopped
+            # containers), and the exec rm first matters: `cp dir ctr:/`
+            # MERGES into an existing /etcd-data, which would leave stale
+            # WAL/snap files alongside the restored ones.
+            self.stop_component("kube-apiserver")
+            try:
+                self._run([rt, "exec", etcd_ctr, "rm", "-rf", "/etcd-data"],
+                          check=False)
+                self._run([rt, "cp", tmp_dir, f"{etcd_ctr}:/"])
+                self.stop_component("etcd")
+                self.start_component("etcd")
+            finally:
+                self.start_component("kube-apiserver")
+        finally:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+
+
+class NerdctlCluster(ComposeCluster):
+    RUNTIME = consts.RUNTIME_TYPE_NERDCTL

@@ -142,4 +142,104 @@ def set_defaults(opts: KwokctlConfigurationOptions) -> KwokctlConfigurationOptio
     opts.prometheusBinary = _env("PROMETHEUS_BINARY", opts.prometheusBinary)
     opts.prometheusBinaryTar = _env("PROMETHEUS_BINARY_TAR", opts.prometheusBinaryTar)
 
+    _set_image_defaults(opts, goos, arch)
+
     return opts
+
+
+def _join_image_uri(prefix: str, name: str, version: str) -> str:
+    """vars.go joinImageURI: <prefix>/<name>:<version>."""
+    return f"{prefix}/{name}:{version}"
+
+
+def _set_image_defaults(opts: KwokctlConfigurationOptions, goos: str, arch: str) -> None:
+    """Container-image + compose/kind tool defaults (vars.go:226-345).
+    Only consulted by the compose/kind runtimes."""
+    opts.kubeImagePrefix = _env(
+        "KUBE_IMAGE_PREFIX", opts.kubeImagePrefix or consts.KUBE_IMAGE_PREFIX
+    )
+    if not opts.kubeApiserverImage:
+        opts.kubeApiserverImage = _join_image_uri(
+            opts.kubeImagePrefix, "kube-apiserver", opts.kubeVersion
+        )
+    opts.kubeApiserverImage = _env("KUBE_APISERVER_IMAGE", opts.kubeApiserverImage)
+    if not opts.kubeControllerManagerImage:
+        opts.kubeControllerManagerImage = _join_image_uri(
+            opts.kubeImagePrefix, "kube-controller-manager", opts.kubeVersion
+        )
+    opts.kubeControllerManagerImage = _env(
+        "KUBE_CONTROLLER_MANAGER_IMAGE", opts.kubeControllerManagerImage
+    )
+    if not opts.kubeSchedulerImage:
+        opts.kubeSchedulerImage = _join_image_uri(
+            opts.kubeImagePrefix, "kube-scheduler", opts.kubeVersion
+        )
+    opts.kubeSchedulerImage = _env("KUBE_SCHEDULER_IMAGE", opts.kubeSchedulerImage)
+
+    opts.etcdImagePrefix = _env(
+        "ETCD_IMAGE_PREFIX", opts.etcdImagePrefix or opts.kubeImagePrefix
+    )
+    if not opts.etcdImage:
+        # registry.k8s.io publishes kubeadm-style tags ("3.5.6-0"); the
+        # version table stores bare versions for binary downloads
+        tag = opts.etcdVersion
+        if "-" not in tag:
+            tag += "-0"
+        opts.etcdImage = _join_image_uri(opts.etcdImagePrefix, "etcd", tag)
+    opts.etcdImage = _env("ETCD_IMAGE", opts.etcdImage)
+
+    opts.kwokImagePrefix = _env(
+        "IMAGE_PREFIX", opts.kwokImagePrefix or consts.KWOK_IMAGE_PREFIX
+    )
+    if not opts.kwokVersion:
+        opts.kwokVersion = consts.KWOK_VERSION
+    if not opts.kwokControllerImage:
+        opts.kwokControllerImage = _join_image_uri(
+            opts.kwokImagePrefix, "kwok", opts.kwokVersion
+        )
+    opts.kwokControllerImage = _env("CONTROLLER_IMAGE", opts.kwokControllerImage)
+
+    opts.prometheusImagePrefix = _env(
+        "PROMETHEUS_IMAGE_PREFIX",
+        opts.prometheusImagePrefix or consts.PROMETHEUS_IMAGE_PREFIX,
+    )
+    if not opts.prometheusImage:
+        opts.prometheusImage = _join_image_uri(
+            opts.prometheusImagePrefix, "prometheus", "v" + opts.prometheusVersion
+        )
+    opts.prometheusImage = _env("PROMETHEUS_IMAGE", opts.prometheusImage)
+
+    opts.kindNodeImagePrefix = _env(
+        "KIND_NODE_IMAGE_PREFIX",
+        opts.kindNodeImagePrefix or consts.KIND_NODE_IMAGE_PREFIX,
+    )
+    if not opts.kindNodeImage:
+        opts.kindNodeImage = _join_image_uri(
+            opts.kindNodeImagePrefix, "node", opts.kubeVersion
+        )
+    opts.kindNodeImage = _env("KIND_NODE_IMAGE", opts.kindNodeImage)
+
+    if not opts.dockerComposeVersion:
+        opts.dockerComposeVersion = consts.DOCKER_COMPOSE_VERSION
+    opts.dockerComposeVersion = _env("DOCKER_COMPOSE_VERSION", opts.dockerComposeVersion)
+    if not opts.dockerComposeBinaryPrefix:
+        opts.dockerComposeBinaryPrefix = (
+            f"{consts.DOCKER_COMPOSE_BINARY_PREFIX}/v{opts.dockerComposeVersion}"
+        )
+    if not opts.dockerComposeBinary:
+        # docker/compose release assets use uname-style arch names
+        compose_arch = {"amd64": "x86_64", "arm64": "aarch64"}.get(arch, arch)
+        opts.dockerComposeBinary = (
+            f"{opts.dockerComposeBinaryPrefix}/docker-compose-{goos}-{compose_arch}"
+            f"{opts.binSuffix}"
+        )
+    opts.dockerComposeBinary = _env("DOCKER_COMPOSE_BINARY", opts.dockerComposeBinary)
+
+    if not opts.kindVersion:
+        opts.kindVersion = consts.KIND_VERSION
+    opts.kindVersion = _env("KIND_VERSION", opts.kindVersion)
+    if not opts.kindBinaryPrefix:
+        opts.kindBinaryPrefix = f"{consts.KIND_BINARY_PREFIX}/v{opts.kindVersion}"
+    if not opts.kindBinary:
+        opts.kindBinary = f"{opts.kindBinaryPrefix}/kind-{goos}-{arch}"
+    opts.kindBinary = _env("KIND_BINARY", opts.kindBinary)

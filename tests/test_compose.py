@@ -1,0 +1,193 @@
+"""Compose (docker/nerdctl) runtime tests.
+
+The container CLI is faked with a recording shell script on PATH, so these
+cover the full install -> compose-yaml -> up -> snapshot command surface
+without docker — the compose analogue of the reference's
+runtime/compose unit+e2e behavior (compose.go, cluster.go, cluster_snapshot.go).
+"""
+
+import json
+import os
+import stat
+
+import pytest
+import yaml
+
+from kwok_tpu.config.ctl import KwokctlConfiguration
+from kwok_tpu.kwokctl import components as comp
+from kwok_tpu.kwokctl import vars as ctlvars
+from kwok_tpu.kwokctl.runtime.compose import (
+    ComposeCluster,
+    components_to_compose,
+    dump_compose_yaml,
+)
+
+
+# --- pure conversion ------------------------------------------------------
+
+
+def test_components_to_compose_shape():
+    cs = [
+        comp.build_etcd(image="registry.k8s.io/etcd:3.5.6-0", version="3.5.6"),
+        comp.build_kube_apiserver(
+            image="registry.k8s.io/kube-apiserver:v1.26.0",
+            port=35000,
+            secure_port=True,
+            ca_cert_path="/pki/ca.crt",
+            admin_cert_path="/pki/admin.crt",
+            admin_key_path="/pki/admin.key",
+        ),
+    ]
+    doc = components_to_compose("kwok-x", cs)
+    assert doc["version"] == "3"
+    assert doc["networks"]["default"]["name"] == "kwok-x"
+    svc = doc["services"]["kube-apiserver"]
+    assert svc["container_name"] == "kwok-x-kube-apiserver"
+    assert svc["restart"] == "always"
+    assert svc["entrypoint"] == ["kube-apiserver"]
+    assert svc["links"] == ["etcd"]
+    # host port published onto in-container 6443
+    assert svc["ports"] == [
+        {"mode": "ingress", "target": 6443, "published": "35000", "protocol": "tcp"}
+    ]
+    # pki volumes bind-mounted read-only
+    sources = {v["source"]: v for v in svc["volumes"]}
+    assert sources["/pki/ca.crt"]["target"] == "/etc/kubernetes/pki/ca.crt"
+    assert sources["/pki/ca.crt"]["read_only"] is True
+    # YAML round-trips
+    assert yaml.safe_load(dump_compose_yaml(doc)) == doc
+
+
+def test_image_mode_builders_use_container_paths():
+    c = comp.build_kwok_controller(
+        image="registry.k8s.io/kwok/kwok:v0.1.0",
+        kubeconfig_path="/w/kubeconfig",
+        config_path="/w/kwok.yaml",
+        admin_cert_path="/w/pki/admin.crt",
+        admin_key_path="/w/pki/admin.key",
+    )
+    assert "--kubeconfig=/root/.kube/config" in c.args
+    assert "--config=/root/.kwok/kwok.yaml" in c.args
+    assert "--server-address=0.0.0.0:8080" in c.args
+    assert {v.mountPath for v in c.volumes} == {
+        "/root/.kube/config",
+        "/etc/kubernetes/pki/admin.crt",
+        "/etc/kubernetes/pki/admin.key",
+        "/root/.kwok/kwok.yaml",
+    }
+
+    etcd = comp.build_etcd(image="x", data_path="/ignored")
+    assert "--data-dir=/etcd-data" in etcd.args
+
+    kcm = comp.build_kube_controller_manager(
+        image="x", kubeconfig_path="/w/kubeconfig", secure_port=True,
+        admin_cert_path="/w/a.crt", admin_key_path="/w/a.key",
+    )
+    assert "--secure-port=10257" in kcm.args
+    sched = comp.build_kube_scheduler(
+        image="x", kubeconfig_path="/w/kubeconfig", secure_port=False,
+    )
+    assert "--port=10251" in sched.args
+
+
+def test_image_defaults():
+    opts = ctlvars.set_defaults(KwokctlConfiguration().options)
+    assert opts.kubeApiserverImage == f"registry.k8s.io/kube-apiserver:{opts.kubeVersion}"
+    # registry tags are kubeadm-style ("3.5.6-0")
+    assert opts.etcdImage == f"registry.k8s.io/etcd:{opts.etcdVersion}-0"
+    assert opts.kwokControllerImage.startswith("registry.k8s.io/kwok/kwok:")
+    assert opts.prometheusImage == f"docker.io/prom/prometheus:v{opts.prometheusVersion}"
+    # release assets use uname-style arch names
+    assert opts.dockerComposeBinary.rsplit("-", 1)[-1] in ("x86_64", "aarch64")
+    assert opts.kindNodeImage == f"docker.io/kindest/node:{opts.kubeVersion}"
+
+
+# --- fake docker CLI ------------------------------------------------------
+
+FAKE_DOCKER = """#!/bin/sh
+echo "$@" >> "$DOCKER_LOG"
+case "$*" in
+  "compose version") exit 0 ;;
+  compose\\ ps*) echo '[{"Service":"etcd","State":"running"}]' ; exit 0 ;;
+  image\\ inspect*) exit 0 ;;
+esac
+exit 0
+"""
+
+
+@pytest.fixture
+def fake_docker(tmp_path, monkeypatch):
+    bin_dir = tmp_path / "fakebin"
+    bin_dir.mkdir()
+    script = bin_dir / "docker"
+    script.write_text(FAKE_DOCKER)
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / "docker.log"
+    log.write_text("")
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("DOCKER_LOG", str(log))
+    return log
+
+
+@pytest.fixture
+def kwok_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("KWOK_WORKDIR", str(tmp_path))
+    return tmp_path
+
+
+def _calls(log) -> list[str]:
+    return [l for l in log.read_text().splitlines() if l]
+
+
+def test_compose_install_and_up(kwok_home, fake_docker, tmp_path):
+    workdir = tmp_path / "clusters" / "c0"
+    os.makedirs(workdir)
+    rt = ComposeCluster("c0", str(workdir))
+    conf = KwokctlConfiguration(name="c0")
+    conf.options.runtime = "docker"
+    conf.options.prometheusPort = 19090
+    rt.set_config(ctlvars_defaults(conf))
+
+    rt.install()
+    # compose file exists and holds every component incl. prometheus
+    doc = yaml.safe_load(open(workdir / "docker-compose.yaml"))
+    assert set(doc["services"]) == {
+        "etcd", "kube-apiserver", "kube-controller-manager",
+        "kube-scheduler", "kwok-controller", "prometheus",
+    }
+    # kwok-controller runs from an image, no host binary
+    assert doc["services"]["kwok-controller"]["image"].startswith("registry.k8s.io/kwok")
+    # both kubeconfig flavors written
+    assert (workdir / "kubeconfig.yaml").exists()
+    assert (workdir / "kubeconfig").exists()
+    in_cluster = (workdir / "kubeconfig").read_text()
+    assert "kwok-c0-kube-apiserver" in in_cluster
+    # prometheus scrape config targets container DNS names
+    prom = (workdir / "prometheus.yaml").read_text()
+    assert "kwok-c0-etcd:2379" in prom
+    # saved config reloads with the docker runtime recorded
+    from kwok_tpu.kwokctl import runtime as reg
+
+    rt2 = reg.load("c0", str(workdir))
+    assert isinstance(rt2, ComposeCluster)
+
+    rt.up()
+    calls = _calls(fake_docker)
+    assert any(c.startswith("compose up -d") for c in calls)
+    assert any(c.startswith("compose ps") for c in calls)
+
+    rt.stop_component("etcd")
+    assert "stop kwok-c0-etcd" in _calls(fake_docker)
+
+    rt.snapshot_save(str(tmp_path / "snap.db"))
+    calls = _calls(fake_docker)
+    assert "exec -i kwok-c0-etcd etcdctl snapshot save /snapshot.db" in calls
+    assert any(c.startswith("cp kwok-c0-etcd:/snapshot.db") for c in calls)
+
+    rt.down()
+    assert any(c == "compose down" for c in _calls(fake_docker))
+
+
+def ctlvars_defaults(conf: KwokctlConfiguration) -> KwokctlConfiguration:
+    ctlvars.set_defaults(conf.options)
+    return conf
