@@ -14,6 +14,7 @@ from kwok_tpu.config.types import first_of, load_documents
 from kwok_tpu.kwokctl.runtime.base import CONFIG_NAME, Cluster
 from kwok_tpu.kwokctl.runtime.binary import BinaryCluster
 from kwok_tpu.kwokctl.runtime.compose import ComposeCluster, NerdctlCluster
+from kwok_tpu.kwokctl.runtime.kindcluster import KindCluster
 from kwok_tpu.kwokctl.runtime.mock import MockCluster
 
 _REGISTRY: dict[str, type[Cluster]] = {}
@@ -52,4 +53,5 @@ def known_runtimes() -> list[str]:
 register(BinaryCluster.RUNTIME, BinaryCluster)
 register(ComposeCluster.RUNTIME, ComposeCluster)
 register(NerdctlCluster.RUNTIME, NerdctlCluster)
+register(KindCluster.RUNTIME, KindCluster)
 register(MockCluster.RUNTIME, MockCluster)

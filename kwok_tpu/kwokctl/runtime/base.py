@@ -147,12 +147,34 @@ class Cluster:
     # --- tool passthrough -------------------------------------------------
 
     def kubectl_path(self) -> str:
-        """PATH kubectl, else the workdir copy downloaded at install
-        (cluster.go kubectlPath)."""
+        """PATH kubectl, else download into the workdir on first use
+        (cluster.go kubectlPath download-or-find)."""
         found = shutil.which("kubectl")
         if found:
             return found
-        return self.bin_path("kubectl")
+        path = self.bin_path("kubectl")
+        if not os.path.exists(path):
+            from kwok_tpu.kwokctl import download
+
+            conf = self.config().options
+            download.download_with_cache(
+                conf.cacheDir, conf.kubectlBinary, path, quiet=conf.quietPull
+            )
+        return path
+
+    def etcdctl_path(self) -> str:
+        """Workdir etcdctl, extracted from the etcd release tar on first use
+        (shared by the binary/compose/kind snapshot paths)."""
+        from kwok_tpu.kwokctl import download
+
+        conf = self.config().options
+        path = self.bin_path("etcdctl")
+        if not os.path.exists(path):
+            download.download_with_cache_and_extract(
+                conf.cacheDir, conf.etcdBinaryTar, path, "etcdctl",
+                quiet=conf.quietPull,
+            )
+        return path
 
     def kubectl(self, args: list[str], **kwargs) -> int:
         return procutil.exec_foreground([self.kubectl_path(), *args], **kwargs)

@@ -314,38 +314,13 @@ class BinaryCluster(Cluster):
             conf.prometheusBinaryTar,
         ]
 
-    def kubectl_path(self) -> str:
-        """PATH kubectl, else download into the workdir on first use
-        (runtime/cluster.go kubectlPath download-or-find)."""
-        import shutil
-
-        found = shutil.which("kubectl")
-        if found:
-            return found
-        conf = self.config().options
-        path = self.bin_path("kubectl")
-        if not os.path.exists(path):
-            download.download_with_cache(
-                conf.cacheDir, conf.kubectlBinary, path, quiet=conf.quietPull
-            )
-        return path
-
     # --- etcdctl / snapshot ----------------------------------------------
-
-    def _etcdctl_path(self) -> str:
-        conf = self.config().options
-        path = self.bin_path("etcdctl")
-        if not os.path.exists(path):
-            download.download_with_cache_and_extract(
-                conf.cacheDir, conf.etcdBinaryTar, path, "etcdctl", quiet=conf.quietPull
-            )
-        return path
 
     def etcdctl_in_cluster(self, args: list[str], **kwargs) -> int:
         conf = self.config().options
         return procutil.exec_foreground(
             [
-                self._etcdctl_path(),
+                self.etcdctl_path(),
                 "--endpoints",
                 f"{LOCAL}:{conf.etcdPort}",
                 *args,
@@ -369,7 +344,7 @@ class BinaryCluster(Cluster):
         tmp_dir = data_dir + ".restore"
         shutil.rmtree(tmp_dir, ignore_errors=True)
         rc = subprocess.call(
-            [self._etcdctl_path(), "snapshot", "restore", path, "--data-dir", tmp_dir]
+            [self.etcdctl_path(), "snapshot", "restore", path, "--data-dir", tmp_dir]
         )
         if rc != 0:
             raise RuntimeError(f"etcdctl snapshot restore failed with {rc}")

@@ -161,7 +161,7 @@ class ComposeCluster(Cluster):
         pki_path = self.workdir_path(base.PKI_NAME)
         if not os.path.exists(os.path.join(pki_path, "ca.crt")):
             pki.generate_pki(pki_path)
-        os.makedirs(self.workdir_path(base.ETCD_DATA_DIR_NAME), exist_ok=True)
+        # no host etcd dir: image mode keeps data at /etcd-data in-container
         os.makedirs(self.workdir_path("logs"), exist_ok=True)
         if conf.kubeAuditPolicy:
             shutil.copyfile(conf.kubeAuditPolicy, self.workdir_path(base.AUDIT_POLICY_NAME))
@@ -357,10 +357,21 @@ class ComposeCluster(Cluster):
                     return False  # garbled output counts as not-ready
         if not items:
             return False
-        return all(
-            str(i.get("State", i.get("state", ""))).lower() == "running"
+        states = {
+            str(i.get("Service", i.get("Name", ""))):
+            str(i.get("State", i.get("state", ""))).lower()
             for i in items
-        )
+        }
+        # `ps` omits exited containers entirely, so also require every
+        # expected component to be present (cluster.go checks each one)
+        for c in self.config().components:
+            name = c.name
+            state = states.get(name) or next(
+                (s for n, s in states.items() if name in n), None
+            )
+            if state != "running":
+                return False
+        return True
 
     def down(self) -> None:
         self._run(self._compose_cmd("down"), check=False)
@@ -457,13 +468,7 @@ class ComposeCluster(Cluster):
     def snapshot_restore(self, path: str) -> None:
         """Host etcdctl rebuilds a data dir; cp it into the container
         around an etcd restart (cluster_snapshot.go:55-140)."""
-        conf = self.config().options
-        etcdctl = self.bin_path("etcdctl")
-        if not os.path.exists(etcdctl):
-            download.download_with_cache_and_extract(
-                conf.cacheDir, conf.etcdBinaryTar, etcdctl, "etcdctl",
-                quiet=conf.quietPull,
-            )
+        etcdctl = self.etcdctl_path()
         tmp_dir = self.workdir_path("etcd-data")
         shutil.rmtree(tmp_dir, ignore_errors=True)
         self._run([etcdctl, "snapshot", "restore", path, "--data-dir", tmp_dir])

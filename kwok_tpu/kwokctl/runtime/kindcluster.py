@@ -1,0 +1,580 @@
+"""Kind runtime: attach the engine to a kind-provisioned control plane.
+
+Behavioral port of pkg/kwokctl/runtime/kind: install() renders a kind
+Cluster config (kind.yaml.tpl — apiserver/prometheus port mappings, feature
+gates, runtime config, audit wiring, kwok.yaml extraMount), a kwok-controller
+**static pod** manifest, and a prometheus in-cluster manifest set. up() runs
+`kind create cluster`, side-loads the images (`kind load docker-image`,
+cluster.go:288-304), then docker-cp's the static pod into the control-plane's
+/etc/kubernetes/manifests so kubelet runs the engine (cluster.go:210).
+Component stop/start = moving the static-pod manifest out of/back into the
+manifests dir (cluster.go:407-421). This runtime proves "attach the TPU
+engine to an existing cluster" — the engine itself still runs as a container
+image serving 0.0.0.0:8080 with --manage-all-nodes=false + the fake-node
+annotation selector (kwok_controller_pod.yaml.tpl).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+
+from kwok_tpu.kwokctl import consts, download
+from kwok_tpu.kwokctl.runtime import base
+from kwok_tpu.kwokctl.runtime.base import Cluster
+
+KIND_NAME = "kind.yaml"
+KWOK_POD_NAME = "kwok-controller-pod.yaml"
+PROMETHEUS_DEPLOY_NAME = "prometheus-deployment.yaml"
+
+
+def build_kind_yaml(
+    kube_apiserver_port: int = 0,
+    prometheus_port: int = 0,
+    feature_gates: list[str] | None = None,
+    runtime_config: list[str] | None = None,
+    audit_policy: str = "",
+    audit_log: str = "",
+    config_path: str = "",
+) -> str:
+    """kind Cluster document (kind.yaml.tpl semantics)."""
+    out = [
+        "kind: Cluster",
+        "apiVersion: kind.x-k8s.io/v1alpha4",
+        "networking:",
+        '  apiServerAddress: "0.0.0.0"',
+    ]
+    if kube_apiserver_port:
+        out.append(f"  apiServerPort: {kube_apiserver_port}")
+    out.append("nodes:")
+    out.append("- role: control-plane")
+    if prometheus_port:
+        out += [
+            "  extraPortMappings:",
+            "  - containerPort: 9090",
+            f"    hostPort: {prometheus_port}",
+            '    listenAddress: "0.0.0.0"',
+            "    protocol: TCP",
+        ]
+    if audit_policy:
+        out += [
+            "  kubeadmConfigPatches:",
+            "  - |",
+            "    kind: ClusterConfiguration",
+            "    apiServer:",
+            "      extraArgs:",
+            "        audit-log-path: /var/log/kubernetes/audit.log",
+            "        audit-policy-file: /etc/kubernetes/audit/audit.yaml",
+            "      extraVolumes:",
+            "      - name: audit-policies",
+            "        hostPath: /etc/kubernetes/audit",
+            "        mountPath: /etc/kubernetes/audit",
+            "        readOnly: true",
+            '        pathType: "DirectoryOrCreate"',
+            '      - name: "audit-logs"',
+            '        hostPath: "/var/log/kubernetes"',
+            '        mountPath: "/var/log/kubernetes"',
+            "        readOnly: false",
+            "        pathType: DirectoryOrCreate",
+        ]
+    out += [
+        "  extraMounts:",
+        f"  - hostPath: {config_path}",
+        "    containerPath: /etc/kwok/kwok.yaml",
+        "    readOnly: true",
+    ]
+    if audit_policy:
+        out += [
+            f"  - hostPath: {audit_policy}",
+            "    containerPath: /etc/kubernetes/audit/audit.yaml",
+            "    readOnly: true",
+            f"  - hostPath: {audit_log}",
+            "    containerPath: /var/log/kubernetes/audit.log",
+            "    readOnly: false",
+        ]
+    if feature_gates:
+        out.append("featureGates:")
+        out += [f"  {g}" for g in feature_gates]
+    if runtime_config:
+        out.append("runtimeConfig:")
+        out += [f"  {r}" for r in runtime_config]
+    return "\n".join(out) + "\n"
+
+
+def build_kwok_controller_pod(image: str) -> str:
+    """Static-pod manifest for the engine (kwok_controller_pod.yaml.tpl):
+    hostNetwork, kubelet-supervised, fake-node annotation selectors and the
+    disregard-status escape hatch preconfigured."""
+    return f"""apiVersion: v1
+kind: Pod
+metadata:
+  labels:
+    app: kwok-controller
+  name: kwok-controller
+  namespace: kube-system
+spec:
+  containers:
+  - args:
+    - --config=/etc/kwok/kwok.yaml
+    - --manage-all-nodes=false
+    - --manage-nodes-with-annotation-selector=kwok.x-k8s.io/node=fake
+    - --manage-nodes-with-label-selector=
+    - --disregard-status-with-annotation-selector=kwok.x-k8s.io/status=custom
+    - --disregard-status-with-label-selector=
+    - --server-address=0.0.0.0:8080
+    - --kubeconfig=/etc/kubernetes/admin.conf
+    - --node-ip=$(POD_IP)
+    env:
+    - name: POD_IP
+      valueFrom:
+        fieldRef:
+          fieldPath: status.podIP
+    image: '{image}'
+    imagePullPolicy: IfNotPresent
+    livenessProbe:
+      failureThreshold: 3
+      httpGet:
+        path: /healthz
+        port: 8080
+        scheme: HTTP
+      initialDelaySeconds: 2
+      periodSeconds: 10
+      timeoutSeconds: 2
+    name: kwok-controller
+    readinessProbe:
+      failureThreshold: 5
+      httpGet:
+        path: /healthz
+        port: 8080
+        scheme: HTTP
+      initialDelaySeconds: 2
+      periodSeconds: 20
+      timeoutSeconds: 2
+    volumeMounts:
+    - mountPath: /etc/kubernetes/admin.conf
+      name: kubeconfig
+      readOnly: true
+    - mountPath: /etc/kwok/kwok.yaml
+      name: config
+      readOnly: true
+  hostNetwork: true
+  restartPolicy: Always
+  volumes:
+  - hostPath:
+      path: /etc/kubernetes/admin.conf
+      type: FileOrCreate
+    name: kubeconfig
+  - hostPath:
+      path: /etc/kwok/kwok.yaml
+      type: FileOrCreate
+    name: config
+"""
+
+
+def build_prometheus_deployment(name: str, image: str) -> str:
+    """In-cluster prometheus: RBAC + ConfigMap + hostNetwork Pod pinned to
+    the control-plane node (prometheus_deployment.yaml.tpl). All targets are
+    localhost because every control-plane process shares the node's netns."""
+    scrape = """    global:
+      scrape_interval: 15s
+      scrape_timeout: 10s
+      evaluation_interval: 15s
+    scrape_configs:
+      - job_name: "prometheus"
+        scheme: http
+        metrics_path: /metrics
+        static_configs:
+          - targets: ["localhost:9090"]
+      - job_name: "etcd"
+        scheme: https
+        metrics_path: /metrics
+        tls_config:
+          cert_file: /etc/kubernetes/pki/apiserver-etcd-client.crt
+          key_file: /etc/kubernetes/pki/apiserver-etcd-client.key
+          insecure_skip_verify: true
+        static_configs:
+          - targets: ["localhost:2379"]
+      - job_name: "kwok-controller"
+        scheme: http
+        metrics_path: /metrics
+        static_configs:
+          - targets: ["localhost:8080"]
+      - job_name: "kube-apiserver"
+        scheme: https
+        metrics_path: /metrics
+        tls_config:
+          cert_file: /etc/kubernetes/pki/apiserver-etcd-client.crt
+          key_file: /etc/kubernetes/pki/apiserver-etcd-client.key
+          insecure_skip_verify: true
+        static_configs:
+          - targets: ["localhost:6443"]
+      - job_name: "kube-controller-manager"
+        scheme: https
+        metrics_path: /metrics
+        tls_config:
+          insecure_skip_verify: true
+        bearer_token_file: /var/run/secrets/kubernetes.io/serviceaccount/token
+        static_configs:
+          - targets: ["localhost:10257"]
+      - job_name: "kube-scheduler"
+        scheme: https
+        metrics_path: /metrics
+        tls_config:
+          insecure_skip_verify: true
+        bearer_token_file: /var/run/secrets/kubernetes.io/serviceaccount/token
+        static_configs:
+          - targets: ["localhost:10259"]
+"""
+    return f"""apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata:
+  name: prometheus
+rules:
+  - nonResourceURLs: ["/metrics"]
+    verbs: ["get"]
+---
+apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: prometheus
+  namespace: kube-system
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRoleBinding
+metadata:
+  name: prometheus
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: ClusterRole
+  name: prometheus
+subjects:
+  - kind: ServiceAccount
+    name: prometheus
+    namespace: kube-system
+---
+apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: prometheus-configmap
+  namespace: kube-system
+data:
+  prometheus.yaml: |
+{scrape}
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: prometheus
+  namespace: kube-system
+spec:
+  containers:
+    - name: prometheus
+      image: {image}
+      args:
+        - --config.file
+        - /etc/prometheus/prometheus.yaml
+      ports:
+        - name: web
+          containerPort: 9090
+      securityContext:
+        runAsUser: 0
+      volumeMounts:
+        - name: config-volume
+          mountPath: /etc/prometheus/
+          readOnly: true
+        - mountPath: /etc/kubernetes/pki
+          name: k8s-certs
+          readOnly: true
+  volumes:
+    - name: config-volume
+      configMap:
+        name: prometheus-configmap
+    - hostPath:
+        path: /etc/kubernetes/pki
+        type: DirectoryOrCreate
+      name: k8s-certs
+  serviceAccountName: prometheus
+  restartPolicy: Always
+  hostNetwork: true
+  nodeName: {name}-control-plane
+"""
+
+
+class KindCluster(Cluster):
+    RUNTIME = consts.RUNTIME_TYPE_KIND
+
+    # --- helpers ----------------------------------------------------------
+
+    def _control_plane(self) -> str:
+        return f"{self.name}-control-plane"
+
+    def _component_pod(self, name: str) -> str:
+        # control-plane static pods get the node-name suffix; prometheus is
+        # a plain pod (cluster.go getComponentName)
+        if name == "prometheus":
+            return name
+        return f"{name}-{self._control_plane()}"
+
+    def _run(self, args: list[str], capture: bool = False, check: bool = True):
+        if capture:
+            res = subprocess.run(args, capture_output=True, text=True)
+        else:
+            res = subprocess.run(args)
+        if check and res.returncode != 0:
+            err = (res.stderr or "") if capture else ""
+            raise RuntimeError(f"{' '.join(args)} failed ({res.returncode}): {err}")
+        return res
+
+    def _kind_path(self) -> str:
+        found = shutil.which("kind")
+        if found:
+            return found
+        conf = self.config().options
+        path = self.bin_path("kind" + conf.binSuffix)
+        if not os.path.exists(path):
+            download.download_with_cache(
+                conf.cacheDir, conf.kindBinary, path, quiet=conf.quietPull
+            )
+        return path
+
+    # --- install ----------------------------------------------------------
+
+    def install(self) -> None:
+        from kwok_tpu.kwokctl import netutil
+
+        config = self.config()
+        conf = config.options
+        os.makedirs(self.workdir_path("logs"), exist_ok=True)
+        if not conf.kubeApiserverPort:
+            # pin the host port kind publishes the apiserver on, else base
+            # ready()/wait_ready would poll 127.0.0.1:0
+            conf.kubeApiserverPort = netutil.get_unused_port()
+        audit_policy = audit_log = ""
+        if conf.kubeAuditPolicy:
+            audit_policy = self.workdir_path(base.AUDIT_POLICY_NAME)
+            shutil.copyfile(conf.kubeAuditPolicy, audit_policy)
+            audit_log = self.log_path(base.AUDIT_LOG_NAME)
+            open(audit_log, "a").close()
+        # `a=b,c=d` -> yaml mapping entries `a: b` (cluster.go:59-66)
+        fg = [s.replace("=", ": ") for s in conf.kubeFeatureGates.split(",") if s]
+        rc = [s.replace("=", ": ") for s in conf.kubeRuntimeConfig.split(",") if s]
+        with open(self.workdir_path(KIND_NAME), "w") as f:
+            f.write(build_kind_yaml(
+                kube_apiserver_port=conf.kubeApiserverPort,
+                prometheus_port=conf.prometheusPort,
+                feature_gates=fg,
+                runtime_config=rc,
+                audit_policy=audit_policy,
+                audit_log=audit_log,
+                config_path=self.workdir_path(base.CONFIG_NAME),
+            ))
+        with open(self.workdir_path(KWOK_POD_NAME), "w") as f:
+            f.write(build_kwok_controller_pod(conf.kwokControllerImage))
+        if conf.prometheusPort:
+            with open(self.workdir_path(PROMETHEUS_DEPLOY_NAME), "w") as f:
+                f.write(build_prometheus_deployment(self.name, conf.prometheusImage))
+        self._pull_images()
+        self.save()
+
+    def _pull_images(self) -> None:
+        for image in self.list_images():
+            if not image:
+                continue
+            if subprocess.run(["docker", "image", "inspect", image],
+                              capture_output=True).returncode == 0:
+                continue
+            self._run(["docker", "pull", image])
+
+    # --- up/down ----------------------------------------------------------
+
+    def up(self, timeout: float = 120.0) -> None:
+        from kwok_tpu.config.ctl import Component
+
+        config = self.config()
+        conf = config.options
+        kind = self._kind_path()
+        self._run([
+            kind, "create", "cluster",
+            "--config", self.workdir_path(KIND_NAME),
+            "--name", self.name,
+            "--image", conf.kindNodeImage,
+            "--wait", "1m",
+        ])
+        images = [conf.kwokControllerImage]
+        if conf.prometheusPort:
+            images.append(conf.prometheusImage)
+        for image in images:
+            self._run([kind, "load", "docker-image", image, "--name", self.name])
+        # snapshot the kubeconfig kind just wrote into the default config
+        res = self._run(
+            [self.kubectl_path(), "config", "view", "--minify=true", "--raw=true",
+             "--context", f"kind-{self.name}"],
+            capture=True,
+        )
+        with open(self.workdir_path(base.IN_HOST_KUBECONFIG_NAME), "w") as f:
+            f.write(res.stdout)
+        # the engine enters as a kubelet static pod
+        self._run([
+            "docker", "cp", self.workdir_path(KWOK_POD_NAME),
+            f"{self._control_plane()}:/etc/kubernetes/manifests/kwok-controller.yaml",
+        ])
+        components = ["etcd", "kube-apiserver", "kwok-controller"]
+        if conf.prometheusPort:
+            self._run([self.kubectl_path(), "--context", f"kind-{self.name}",
+                       "apply", "-f", self.workdir_path(PROMETHEUS_DEPLOY_NAME)])
+            components.append("prometheus")
+        # nothing schedules onto the real node; fake nodes only
+        self._run([self.kubectl_path(), "--context", f"kind-{self.name}",
+                   "cordon", self._control_plane()], check=False)
+        if conf.disableKubeScheduler:
+            self.stop_component("kube-scheduler")
+        else:
+            components.append("kube-scheduler")
+        if conf.disableKubeControllerManager:
+            self.stop_component("kube-controller-manager")
+        else:
+            components.append("kube-controller-manager")
+        config.components = [Component(name=n) for n in components]
+        self.save()
+
+    def down(self) -> None:
+        self._run([self._kind_path(), "delete", "cluster", "--name", self.name],
+                  check=False)
+
+    def start(self) -> None:
+        self._run(["docker", "start", self._control_plane()])
+
+    def stop(self) -> None:
+        self._run(["docker", "stop", self._control_plane()])
+
+    def start_component(self, name: str) -> None:
+        """Static pods: move the parked manifest back (cluster.go:407-413).
+        prometheus is a kubectl-applied plain pod, so re-apply it."""
+        if self.config().components:
+            self.get_component(name)
+        if name == "prometheus":
+            self._run([self.kubectl_path(), "--context", f"kind-{self.name}",
+                       "apply", "-f", self.workdir_path(PROMETHEUS_DEPLOY_NAME)])
+            return
+        self._run(["docker", "exec", self._control_plane(), "mv",
+                   f"/etc/kubernetes/{name}.yaml.bak",
+                   f"/etc/kubernetes/manifests/{name}.yaml"])
+
+    def stop_component(self, name: str) -> None:
+        """Park the static-pod manifest outside the manifests dir
+        (cluster.go:415-421); delete the plain prometheus pod."""
+        if self.config().components:
+            self.get_component(name)
+        if name == "prometheus":
+            self._run([self.kubectl_path(), "--context", f"kind-{self.name}",
+                       "delete", "pod", "-n", "kube-system", "prometheus",
+                       "--ignore-not-found"])
+            return
+        self._run(["docker", "exec", self._control_plane(), "mv",
+                   f"/etc/kubernetes/manifests/{name}.yaml",
+                   f"/etc/kubernetes/{name}.yaml.bak"])
+
+    # --- readiness --------------------------------------------------------
+
+    def ready(self) -> bool:
+        """Apiserver healthy AND every kube-system pod Running
+        (cluster.go:327-372)."""
+        if not super().ready():
+            return False
+        res = self._run(
+            [self.kubectl_path(), "--kubeconfig",
+             self.workdir_path(base.IN_HOST_KUBECONFIG_NAME),
+             "get", "pod", "--namespace=kube-system",
+             "--field-selector=status.phase!=Running", "--output=json"],
+            capture=True, check=False,
+        )
+        if res.returncode != 0:
+            return False
+        try:
+            data = json.loads(res.stdout)
+        except json.JSONDecodeError:
+            return False
+        return not data.get("items")
+
+    # --- logs -------------------------------------------------------------
+
+    def logs(self, name: str, out, follow: bool = False) -> None:
+        args = [self.kubectl_path(), "--context", f"kind-{self.name}",
+                "logs", "-n", "kube-system"]
+        if follow:
+            args.append("-f")
+        args.append(self._component_pod(name))
+        proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        try:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                out.write(line)
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+            proc.wait()
+
+    # --- artifacts --------------------------------------------------------
+
+    def list_binaries(self) -> list[str]:
+        conf = self.config().options
+        return [conf.kubectlBinary]
+
+    def list_images(self) -> list[str]:
+        conf = self.config().options
+        images = [conf.kindNodeImage, conf.kwokControllerImage]
+        if conf.prometheusPort:
+            images.append(conf.prometheusImage)
+        return images
+
+    # --- etcdctl / snapshot ----------------------------------------------
+
+    _ETCDCTL_CERTS = [
+        "--endpoints=127.0.0.1:2379",
+        "--cert=/etc/kubernetes/pki/etcd/server.crt",
+        "--key=/etc/kubernetes/pki/etcd/server.key",
+        "--cacert=/etc/kubernetes/pki/etcd/ca.crt",
+    ]
+
+    def etcdctl_in_cluster(self, args: list[str], **kwargs) -> int:
+        from kwok_tpu.kwokctl import procutil
+
+        return procutil.exec_foreground(
+            [self.kubectl_path(), "--kubeconfig",
+             self.workdir_path(base.IN_HOST_KUBECONFIG_NAME),
+             "exec", "-i", "-n", "kube-system", self._component_pod("etcd"), "--",
+             "etcdctl", *self._ETCDCTL_CERTS, *args],
+            **kwargs,
+        )
+
+    def snapshot_save(self, path: str) -> None:
+        """etcdctl save into /var/lib/etcd (the one dir shared with the kind
+        node container), docker cp out, clean up (cluster_snapshot.go:30-58)."""
+        tmp = "/var/lib/etcd/snapshot.db"
+        rc = self.etcdctl_in_cluster(["snapshot", "save", tmp])
+        if rc != 0:
+            raise RuntimeError(f"etcdctl snapshot save failed with {rc}")
+        try:
+            self._run(["docker", "cp", f"{self._control_plane()}:{tmp}", path])
+        finally:
+            self._run(["docker", "exec", "-i", self._control_plane(),
+                       "rm", "-f", tmp], check=False)
+
+    def snapshot_restore(self, path: str) -> None:
+        """Host etcdctl restore -> docker cp into /var/lib/ around an etcd
+        static-pod stop/start (cluster_snapshot.go:61-110)."""
+        etcdctl = self.etcdctl_path()
+        self.stop_component("etcd")
+        tmp_dir = self.workdir_path("etcd")
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        try:
+            self._run([etcdctl, "snapshot", "restore", path, "--data-dir", tmp_dir])
+            self._run(["docker", "exec", self._control_plane(),
+                       "rm", "-rf", "/var/lib/etcd"], check=False)
+            self._run(["docker", "cp", tmp_dir, f"{self._control_plane()}:/var/lib/"])
+        finally:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            self.start_component("etcd")

@@ -226,6 +226,9 @@ def _set_image_defaults(opts: KwokctlConfigurationOptions, goos: str, arch: str)
         opts.dockerComposeBinaryPrefix = (
             f"{consts.DOCKER_COMPOSE_BINARY_PREFIX}/v{opts.dockerComposeVersion}"
         )
+    opts.dockerComposeBinaryPrefix = _env(
+        "DOCKER_COMPOSE_BINARY_PREFIX", opts.dockerComposeBinaryPrefix
+    )
     if not opts.dockerComposeBinary:
         # docker/compose release assets use uname-style arch names
         compose_arch = {"amd64": "x86_64", "arm64": "aarch64"}.get(arch, arch)
@@ -240,6 +243,7 @@ def _set_image_defaults(opts: KwokctlConfigurationOptions, goos: str, arch: str)
     opts.kindVersion = _env("KIND_VERSION", opts.kindVersion)
     if not opts.kindBinaryPrefix:
         opts.kindBinaryPrefix = f"{consts.KIND_BINARY_PREFIX}/v{opts.kindVersion}"
+    opts.kindBinaryPrefix = _env("KIND_BINARY_PREFIX", opts.kindBinaryPrefix)
     if not opts.kindBinary:
         opts.kindBinary = f"{opts.kindBinaryPrefix}/kind-{goos}-{arch}"
     opts.kindBinary = _env("KIND_BINARY", opts.kindBinary)

@@ -108,7 +108,7 @@ FAKE_DOCKER = """#!/bin/sh
 echo "$@" >> "$DOCKER_LOG"
 case "$*" in
   "compose version") exit 0 ;;
-  compose\\ ps*) echo '[{"Service":"etcd","State":"running"}]' ; exit 0 ;;
+  compose\\ ps*) echo '[{"Service":"etcd","State":"running"},{"Service":"kube-apiserver","State":"running"},{"Service":"kube-controller-manager","State":"running"},{"Service":"kube-scheduler","State":"running"},{"Service":"kwok-controller","State":"running"},{"Service":"prometheus","State":"running"}]' ; exit 0 ;;
   image\\ inspect*) exit 0 ;;
 esac
 exit 0

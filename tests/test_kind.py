@@ -1,0 +1,138 @@
+"""Kind runtime tests: fake docker/kind/kubectl CLIs record the command
+surface, covering install -> up -> component stop/start -> snapshot without
+a real kind cluster (runtime/kind/cluster.go behavior)."""
+
+import os
+import stat
+
+import pytest
+import yaml
+
+from kwok_tpu.config.ctl import KwokctlConfiguration
+from kwok_tpu.kwokctl import vars as ctlvars
+from kwok_tpu.kwokctl.runtime.kindcluster import (
+    KindCluster,
+    build_kind_yaml,
+    build_kwok_controller_pod,
+    build_prometheus_deployment,
+)
+
+FAKE_CLI = """#!/bin/sh
+echo "{name} $@" >> "$CLI_LOG"
+case "{name} $*" in
+  "kubectl config view"*) echo "apiVersion: v1" ;;
+  "kubectl "*"get pod"*) echo '{{"items": []}}' ;;
+  "docker image inspect"*) exit 0 ;;
+esac
+exit 0
+"""
+
+
+@pytest.fixture
+def fake_clis(tmp_path, monkeypatch):
+    bin_dir = tmp_path / "fakebin"
+    bin_dir.mkdir()
+    for name in ("docker", "kind", "kubectl"):
+        script = bin_dir / name
+        script.write_text(FAKE_CLI.format(name=name))
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / "cli.log"
+    log.write_text("")
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("CLI_LOG", str(log))
+    monkeypatch.setenv("KWOK_WORKDIR", str(tmp_path))
+    return log
+
+
+def _calls(log):
+    return [l for l in log.read_text().splitlines() if l]
+
+
+def test_build_kind_yaml_shape():
+    text = build_kind_yaml(
+        kube_apiserver_port=35001,
+        prometheus_port=9090,
+        feature_gates=["A: true"],
+        runtime_config=["api/all: true"],
+        audit_policy="/w/audit.yaml",
+        audit_log="/w/logs/audit.log",
+        config_path="/w/kwok.yaml",
+    )
+    doc = yaml.safe_load(text)
+    assert doc["kind"] == "Cluster"
+    assert doc["networking"]["apiServerPort"] == 35001
+    node = doc["nodes"][0]
+    assert node["role"] == "control-plane"
+    assert node["extraPortMappings"][0]["hostPort"] == 9090
+    mounts = {m["hostPath"]: m["containerPath"] for m in node["extraMounts"]}
+    assert mounts["/w/kwok.yaml"] == "/etc/kwok/kwok.yaml"
+    assert mounts["/w/audit.yaml"] == "/etc/kubernetes/audit/audit.yaml"
+    assert doc["featureGates"] == {"A": True}
+    assert "audit-policy-file: /etc/kubernetes/audit/audit.yaml" in node["kubeadmConfigPatches"][0]
+
+
+def test_static_pod_manifest():
+    doc = yaml.safe_load(build_kwok_controller_pod("registry.k8s.io/kwok/kwok:v0.1.0"))
+    assert doc["kind"] == "Pod"
+    spec = doc["spec"]
+    assert spec["hostNetwork"] is True
+    args = spec["containers"][0]["args"]
+    assert "--manage-all-nodes=false" in args
+    assert "--manage-nodes-with-annotation-selector=kwok.x-k8s.io/node=fake" in args
+    assert "--disregard-status-with-annotation-selector=kwok.x-k8s.io/status=custom" in args
+
+
+def test_prometheus_deployment_manifest():
+    docs = list(yaml.safe_load_all(build_prometheus_deployment("kc", "prom:v1")))
+    kinds = [d["kind"] for d in docs]
+    assert kinds == ["ClusterRole", "ServiceAccount", "ClusterRoleBinding", "ConfigMap", "Pod"]
+    pod = docs[-1]
+    assert pod["spec"]["nodeName"] == "kc-control-plane"
+    assert "localhost:2379" in docs[3]["data"]["prometheus.yaml"]
+
+
+def test_kind_install_up_stop_snapshot(fake_clis, tmp_path):
+    workdir = tmp_path / "clusters" / "kc"
+    os.makedirs(workdir)
+    rt = KindCluster("kc", str(workdir))
+    conf = KwokctlConfiguration(name="kc")
+    conf.options.runtime = "kind"
+    conf.options.prometheusPort = 9090
+    ctlvars.set_defaults(conf.options)
+    rt.set_config(conf)
+
+    rt.install()
+    assert (workdir / "kind.yaml").exists()
+    assert (workdir / "kwok-controller-pod.yaml").exists()
+    assert (workdir / "prometheus-deployment.yaml").exists()
+
+    rt.up()
+    calls = _calls(fake_clis)
+    assert any(c.startswith("kind create cluster") for c in calls)
+    assert any(c.startswith("kind load docker-image") for c in calls)
+    # engine enters as a static pod
+    assert any("cp" in c and "/etc/kubernetes/manifests/kwok-controller.yaml" in c
+               for c in calls if c.startswith("docker"))
+    assert any("apply -f" in c for c in calls if c.startswith("kubectl"))
+    assert any("cordon kc-control-plane" in c for c in calls)
+    # components recorded for later verbs
+    assert {c.name for c in rt.config().components} == {
+        "etcd", "kube-apiserver", "kwok-controller", "prometheus",
+        "kube-scheduler", "kube-controller-manager",
+    }
+
+    rt.stop_component("kube-scheduler")
+    assert any(
+        "mv /etc/kubernetes/manifests/kube-scheduler.yaml /etc/kubernetes/kube-scheduler.yaml.bak" in c
+        for c in _calls(fake_clis)
+    )
+
+    rt.snapshot_save(str(tmp_path / "snap.db"))
+    calls = _calls(fake_clis)
+    assert any("etcdctl" in c and "snapshot save /var/lib/etcd/snapshot.db" in c
+               for c in calls)
+    assert any(c.startswith("docker cp kc-control-plane:/var/lib/etcd/snapshot.db")
+               for c in calls)
+
+    rt.down()
+    assert any(c.startswith("kind delete cluster") for c in _calls(fake_clis))
