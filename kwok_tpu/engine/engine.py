@@ -212,8 +212,12 @@ class ClusterEngine:
             "heartbeats_total": 0,
             "deletes_total": 0,
             "watch_events_total": 0,
+            "patch_errors_total": 0,
             "ticks_total": 0,
             "tick_seconds_sum": 0.0,
+            "tick_seconds_last": 0.0,
+            "watch_lag_seconds": 0.0,
+            "ingest_queue_depth": 0,
             "nodes_managed": 0,
             "pods_managed": 0,
         }
@@ -301,10 +305,10 @@ class ClusterEngine:
                     # marker covers anything missed before/while down
                     objs = self.client.list(kind, **opts)
                     for obj in objs:
-                        self._q.put((kind, ADDED, obj))
-                    self._q.put((kind, "RESYNC", objs))
+                        self._q.put((kind, ADDED, obj, time.monotonic()))
+                    self._q.put((kind, "RESYNC", objs, time.monotonic()))
                     for ev in w:
-                        self._q.put((kind, ev.type, ev.object))
+                        self._q.put((kind, ev.type, ev.object, time.monotonic()))
                     if not self._running:
                         return
                 except Exception as e:  # re-watch with backoff
@@ -532,6 +536,7 @@ class ClusterEngine:
         interval = self.config.tick_interval
         while self._running:
             deadline = time.monotonic() + interval
+            lag_max = 0.0
             # drain ingest until the next tick is due
             while True:
                 timeout = deadline - time.monotonic()
@@ -545,7 +550,8 @@ class ClusterEngine:
                     if not self._running:
                         return
                     continue
-                self._ingest_safe(*item)
+                lag_max = max(lag_max, time.monotonic() - item[3])
+                self._ingest_safe(*item[:3])
                 # keep draining whatever is immediately available
                 while True:
                     try:
@@ -556,7 +562,12 @@ class ClusterEngine:
                         if not self._running:
                             return
                         continue
-                    self._ingest_safe(*item)
+                    lag_max = max(lag_max, time.monotonic() - item[3])
+                    self._ingest_safe(*item[:3])
+            with self._metrics_lock:
+                # enqueue -> processing delay of the slowest event this tick
+                self.metrics["watch_lag_seconds"] = lag_max
+                self.metrics["ingest_queue_depth"] = self._q.qsize()
             try:
                 self.tick_once()
             except Exception:
@@ -595,11 +606,13 @@ class ClusterEngine:
                 k.phase_h = np.array(out.state.phase)
                 k.cond_h = np.array(out.state.cond_bits)
                 self._emit(kind, k, dirty, deleted, hb, now_str)
+        elapsed = time.perf_counter() - t0
         with self._metrics_lock:
             self.metrics["nodes_managed"] = len(self.nodes.pool)
             self.metrics["pods_managed"] = len(self.pods.pool)
             self.metrics["ticks_total"] += 1
-            self.metrics["tick_seconds_sum"] += time.perf_counter() - t0
+            self.metrics["tick_seconds_sum"] += elapsed
+            self.metrics["tick_seconds_last"] = elapsed
 
     # ------------------------------------------------------------------ emit
 
@@ -609,11 +622,11 @@ class ClusterEngine:
         else:
             self._executor.submit(self._safe, fn, *args)
 
-    @staticmethod
-    def _safe(fn, *args) -> None:
+    def _safe(self, fn, *args) -> None:
         try:
             fn(*args)
         except Exception:
+            self._inc("patch_errors_total")
             logger.exception("patch job failed")
 
     def _emit(self, kind, k, dirty, deleted, hb, now_str) -> None:

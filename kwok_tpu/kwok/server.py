@@ -16,8 +16,12 @@ _METRIC_HELP = {
     "heartbeats_total": "Node heartbeat patches sent",
     "deletes_total": "Pod deletes issued",
     "watch_events_total": "Watch events ingested",
+    "patch_errors_total": "Patch/delete jobs that raised",
     "ticks_total": "Engine ticks executed",
     "tick_seconds_sum": "Total seconds spent in tick_once",
+    "tick_seconds_last": "Duration of the most recent tick",
+    "watch_lag_seconds": "Enqueue-to-processing delay of the slowest event in the last tick",
+    "ingest_queue_depth": "Watch events waiting to be ingested",
     "nodes_managed": "Nodes currently managed",
     "pods_managed": "Pods currently tracked",
 }

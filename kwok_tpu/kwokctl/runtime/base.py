@@ -111,6 +111,22 @@ class Cluster:
                 return c
         raise ComponentNotFoundError(name)
 
+    # --- subprocess helper ------------------------------------------------
+
+    def _run(self, args: list, capture: bool = False, check: bool = True,
+             cwd: str | None = None):
+        """Run a tool command, raising with stderr context on failure."""
+        import subprocess
+
+        if capture:
+            res = subprocess.run(args, cwd=cwd, capture_output=True, text=True)
+        else:
+            res = subprocess.run(args, cwd=cwd)
+        if check and res.returncode != 0:
+            err = (res.stderr or "") if capture else ""
+            raise RuntimeError(f"{' '.join(args)} failed ({res.returncode}): {err}")
+        return res
+
     # --- readiness --------------------------------------------------------
 
     def apiserver_url(self) -> str:

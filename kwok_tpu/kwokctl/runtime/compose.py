@@ -98,18 +98,12 @@ class ComposeCluster(Cluster):
     def _container(self, component: str) -> str:
         return f"{self._project()}-{component}"
 
-    def _run(self, args: list[str], capture: bool = False, check: bool = True, **kw):
-        """Run a container-CLI command in the workdir."""
-        if capture:
-            res = subprocess.run(
-                args, cwd=self.workdir, capture_output=True, text=True, **kw
-            )
-        else:
-            res = subprocess.run(args, cwd=self.workdir, **kw)
-        if check and res.returncode != 0:
-            err = (res.stderr or "") if capture else ""
-            raise RuntimeError(f"{' '.join(args)} failed ({res.returncode}): {err}")
-        return res
+    def _run(self, args: list, capture: bool = False, check: bool = True,
+             cwd: str | None = None):
+        """Container-CLI commands run from the workdir (where the compose
+        file lives)."""
+        return super()._run(args, capture=capture, check=check,
+                            cwd=cwd or self.workdir)
 
     _compose_prefix: list[str] | None = None
 

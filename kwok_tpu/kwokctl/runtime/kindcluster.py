@@ -317,16 +317,6 @@ class KindCluster(Cluster):
             return name
         return f"{name}-{self._control_plane()}"
 
-    def _run(self, args: list[str], capture: bool = False, check: bool = True):
-        if capture:
-            res = subprocess.run(args, capture_output=True, text=True)
-        else:
-            res = subprocess.run(args)
-        if check and res.returncode != 0:
-            err = (res.stderr or "") if capture else ""
-            raise RuntimeError(f"{' '.join(args)} failed ({res.returncode}): {err}")
-        return res
-
     def _kind_path(self) -> str:
         found = shutil.which("kind")
         if found:
@@ -394,13 +384,16 @@ class KindCluster(Cluster):
 
         config = self.config()
         conf = config.options
+        # the component list is rebuilt below; clear any previously saved one
+        # so the disable-component path doesn't trip the existence guard
+        config.components = []
         kind = self._kind_path()
         self._run([
             kind, "create", "cluster",
             "--config", self.workdir_path(KIND_NAME),
             "--name", self.name,
             "--image", conf.kindNodeImage,
-            "--wait", "1m",
+            "--wait", f"{max(int(timeout), 60)}s",
         ])
         images = [conf.kwokControllerImage]
         if conf.prometheusPort:
@@ -568,13 +561,15 @@ class KindCluster(Cluster):
         static-pod stop/start (cluster_snapshot.go:61-110)."""
         etcdctl = self.etcdctl_path()
         self.stop_component("etcd")
-        tmp_dir = self.workdir_path("etcd")
+        # stage under a different name, then swap atomically: a failed cp
+        # must leave the original /var/lib/etcd untouched
+        tmp_dir = self.workdir_path("etcd.new")
         shutil.rmtree(tmp_dir, ignore_errors=True)
         try:
             self._run([etcdctl, "snapshot", "restore", path, "--data-dir", tmp_dir])
-            self._run(["docker", "exec", self._control_plane(),
-                       "rm", "-rf", "/var/lib/etcd"], check=False)
             self._run(["docker", "cp", tmp_dir, f"{self._control_plane()}:/var/lib/"])
+            self._run(["docker", "exec", self._control_plane(), "sh", "-c",
+                       "rm -rf /var/lib/etcd && mv /var/lib/etcd.new /var/lib/etcd"])
         finally:
             shutil.rmtree(tmp_dir, ignore_errors=True)
             self.start_component("etcd")

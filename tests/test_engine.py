@@ -305,3 +305,25 @@ def test_native_heartbeat_batch_matches_python():
     python_out, python_hb = run(force_python=True)
     assert native_out == python_out
     assert native_hb > 0 and python_hb > 0
+
+
+def test_metrics_surface(rig):
+    """SURVEY section 5.5 counters: transitions, patches, tick latency, watch
+    lag are exposed and Prometheus-renderable."""
+    from kwok_tpu.kwok.server import render_metrics
+
+    server, eng = rig
+    server.create("nodes", make_node("node0"))
+    server.create("pods", make_pod("pod0"))
+    eng.pump(3)
+    m = eng.metrics
+    assert m["transitions_total"] > 0
+    assert m["status_patches_total"] > 0
+    assert m["ticks_total"] >= 3
+    assert m["tick_seconds_last"] > 0
+    assert m["patch_errors_total"] == 0
+    text = render_metrics(dict(m))
+    assert "# TYPE kwok_transitions_total counter" in text
+    assert "# TYPE kwok_watch_lag_seconds gauge" in text
+    assert "# TYPE kwok_tick_seconds_last gauge" in text
+    assert "kwok_ingest_queue_depth" in text
