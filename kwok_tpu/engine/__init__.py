@@ -8,5 +8,6 @@ reference's 16-way parallelTasks pools, controller.go:118-136).
 """
 
 from kwok_tpu.engine.engine import ClusterEngine, EngineConfig
+from kwok_tpu.engine.federation import FederatedEngine
 
-__all__ = ["ClusterEngine", "EngineConfig"]
+__all__ = ["ClusterEngine", "EngineConfig", "FederatedEngine"]

@@ -258,15 +258,20 @@ class ClusterEngine:
 
     # ------------------------------------------------------------- lifecycle
 
-    def start(self) -> None:
+    def start(self, run_tick_loop: bool = True) -> None:
+        """Start watch ingest + the patch executor, and (by default) the tick
+        thread. A FederatedEngine passes run_tick_loop=False: it owns a single
+        stacked device state for all member clusters and drives their ingest
+        queues + emit paths from one shared tick loop."""
         self._running = True
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.parallelism, thread_name_prefix="kwok-patch"
         )
-        # move state to device (sharded placement if the kernel supports it)
-        for k in (self.nodes, self.pods):
-            if hasattr(k.kernel, "place"):
-                k.state = k.kernel.place(k.state)
+        if run_tick_loop:
+            # move state to device (sharded placement if the kernel supports it)
+            for k in (self.nodes, self.pods):
+                if hasattr(k.kernel, "place"):
+                    k.state = k.kernel.place(k.state)
 
         node_label_sel = self.config.manage_nodes_with_label_selector or None
         # Each watch thread registers its watch FIRST, then lists and emits a
@@ -276,9 +281,10 @@ class ClusterEngine:
         self._spawn_watch("nodes", label_selector=node_label_sel)
         self._spawn_watch("pods", field_selector="spec.nodeName!=")
 
-        t = threading.Thread(target=self._tick_loop, name="kwok-tick", daemon=True)
-        t.start()
-        self._threads.append(t)
+        if run_tick_loop:
+            t = threading.Thread(target=self._tick_loop, name="kwok-tick", daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def stop(self) -> None:
         self._running = False

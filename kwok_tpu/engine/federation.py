@@ -1,0 +1,270 @@
+"""FederatedEngine: N simulated clusters, one device mesh (BASELINE config 5).
+
+The reference is a single Go process bound to a single apiserver; its only
+scale-out story is "run more kwok processes". Here the multi-cluster case is
+a first-class device-level construct: N member clusters — each with its own
+apiserver, watch streams, IP pool, and patch executor — share ONE stacked
+row-state tensor of shape [N * R] sharded over the TPU mesh, ticked by ONE
+jitted shard_map'd kernel per resource kind. With N == mesh size each
+cluster's rows land whole on one core ("8 kwok apiservers sharded
+1-per-TPU-core"); otherwise the flat row axis still shards evenly and
+correctness is unchanged (rows are independent).
+
+Host side, each member is a full ClusterEngine minus its tick thread
+(start(run_tick_loop=False)): ingest queues and patch egress stay
+per-cluster (per-apiserver HTTP fan-out, like the reference's per-process
+parallelTasks pools), while state mutation and rule evaluation are batched
+across clusters in the shared tick.
+
+All members must share one lifecycle rule set (the compiled rule table is
+baked into the jitted kernel). Heterogeneous-rule federations would need one
+kernel per rule-set group — out of scope, as is cross-cluster scheduling
+(federated *scheduling* is the real scheduler's job; we simulate the
+kubelets under it).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import queue
+import threading
+import time
+
+import numpy as np
+
+from kwok_tpu.edge.kubeclient import KubeClient
+from kwok_tpu.edge.render import now_rfc3339
+from kwok_tpu.engine.engine import ClusterEngine, EngineConfig
+from kwok_tpu.models.defaults import SEL_HEARTBEAT
+from kwok_tpu.ops.state import RowState, new_row_state
+from kwok_tpu.ops.tick import to_host
+from kwok_tpu.parallel import ShardedTickKernel, make_mesh
+
+logger = logging.getLogger("kwok_tpu.federation")
+
+
+def _pad_cluster_capacity(r: int, n_clusters: int, n_devices: int) -> int:
+    """Smallest R' >= r such that n_clusters * R' shards evenly."""
+    step = n_devices // math.gcd(n_clusters, n_devices)
+    return ((r + step - 1) // step) * step
+
+
+class FederatedEngine:
+    """Drive N member clusters from one stacked, mesh-sharded tick."""
+
+    def __init__(
+        self,
+        clients: list[KubeClient],
+        config: EngineConfig,
+        mesh=None,
+    ) -> None:
+        if not clients:
+            raise ValueError("federation needs at least one cluster")
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n = len(clients)
+        d = int(self.mesh.devices.size)
+        self.cluster_capacity = _pad_cluster_capacity(
+            max(int(config.initial_capacity), 1), n, d
+        )
+
+        self.engines: list[ClusterEngine] = []
+        for client in clients:
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                config, initial_capacity=self.cluster_capacity, use_mesh=False
+            )
+            self.engines.append(ClusterEngine(client, cfg))
+
+        e0 = self.engines[0]
+        # One kernel per kind; the rule table is e0's (all members share it).
+        hb_bit = e0.node_bits[SEL_HEARTBEAT]
+        self._node_kernel = ShardedTickKernel(
+            e0.nodes.table,
+            mesh=self.mesh,
+            hb_interval=config.heartbeat_interval,
+            hb_sel_bit=hb_bit,
+        )
+        self._pod_kernel = ShardedTickKernel(e0.pods.table, mesh=self.mesh)
+
+        # Shared engine epoch so one `now` is correct for every member.
+        self._epoch = time.time()
+        for e in self.engines:
+            e._epoch = self._epoch
+
+        cap = self.cluster_capacity * n
+        self._stacked: dict[str, RowState] = {
+            "nodes": self._node_kernel.place(new_row_state(cap)),
+            "pods": self._pod_kernel.place(new_row_state(cap)),
+        }
+        self._kernels = {"nodes": self._node_kernel, "pods": self._pod_kernel}
+
+        self.config = config
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._running = True
+        for e in self.engines:
+            e.start(run_tick_loop=False)
+        self._thread = threading.Thread(
+            target=self._tick_loop, name="kwok-fed-tick", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        # join the shared tick first so it cannot submit patch jobs to
+        # members whose executors are already shut down
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for e in self.engines:
+            e.stop()
+
+    # ------------------------------------------------------------- tick loop
+
+    def _tick_loop(self) -> None:
+        interval = self.config.tick_interval
+        while self._running:
+            deadline = time.monotonic() + interval
+            self._drain_ingest(deadline)
+            try:
+                self.tick_once()
+            except Exception:
+                logger.exception("federated tick failed")
+
+    def _drain_ingest(self, deadline: float) -> None:
+        """Round-robin the members' ingest queues until the tick is due."""
+        lag: dict[int, float] = {}
+        try:
+            while self._running:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                drained_any = False
+                for i, e in enumerate(self.engines):
+                    while True:
+                        try:
+                            item = e._q.get_nowait()
+                        except queue.Empty:
+                            break
+                        if item is None:
+                            continue
+                        drained_any = True
+                        lag[i] = max(
+                            lag.get(i, 0.0), time.monotonic() - item[3]
+                        )
+                        e._ingest_safe(*item[:3])
+                if not drained_any:
+                    time.sleep(min(remaining, 0.002))
+        finally:
+            # slowest enqueue->processing delay this tick; 0 on a quiet tick
+            for i, e in enumerate(self.engines):
+                with e._metrics_lock:
+                    e.metrics["watch_lag_seconds"] = lag.get(i, 0.0)
+                    e.metrics["ingest_queue_depth"] = e._q.qsize()
+
+    # ------------------------------------------------------------------ tick
+
+    def tick_once(self) -> None:
+        self._maybe_regrow()
+        t0 = time.perf_counter()
+        now = time.time() - self._epoch
+        now_str = now_rfc3339()
+        r = self.cluster_capacity
+        for kind in ("nodes", "pods"):
+            state = self._stacked[kind]
+            any_rows = False
+            for c, e in enumerate(self.engines):
+                k = e.nodes if kind == "nodes" else e.pods
+                if k.buffer.pending:
+                    state = k.buffer.flush(state, offset=c * r)
+                    any_rows = True
+                elif len(k.pool):
+                    any_rows = True
+            self._stacked[kind] = state
+            if not any_rows:
+                continue
+            out = self._kernels[kind](state, now)
+            self._stacked[kind] = out.state
+            n_trans = int(out.transitions)
+            n_hb = int(out.heartbeats)
+            if not (n_trans or n_hb):
+                continue
+            dirty = np.asarray(out.dirty)
+            deleted = np.asarray(out.deleted)
+            hb = np.asarray(out.hb_fired)
+            phase = np.asarray(out.state.phase)
+            cond = np.asarray(out.state.cond_bits)
+            for c, e in enumerate(self.engines):
+                k = e.nodes if kind == "nodes" else e.pods
+                lo, hi = c * r, (c + 1) * r
+                d_c, del_c, hb_c = dirty[lo:hi], deleted[lo:hi], hb[lo:hi]
+                trans_c = int(np.count_nonzero(d_c) + np.count_nonzero(del_c))
+                if trans_c:
+                    e._inc("transitions_total", trans_c)
+                if trans_c or hb_c.any():
+                    k.phase_h = phase[lo:hi].copy()
+                    k.cond_h = cond[lo:hi].copy()
+                    e._emit(kind, k, d_c, del_c, hb_c, now_str)
+        elapsed = time.perf_counter() - t0
+        for e in self.engines:
+            with e._metrics_lock:
+                e.metrics["ticks_total"] += 1
+                e.metrics["tick_seconds_sum"] += elapsed
+                e.metrics["tick_seconds_last"] = elapsed
+                e.metrics["nodes_managed"] = len(e.nodes.pool)
+                e.metrics["pods_managed"] = len(e.pods.pool)
+
+    # ------------------------------------------------------------------ grow
+
+    def _maybe_regrow(self) -> None:
+        """If any member's pool grew (ClusterEngine._grow during ingest),
+        rebuild the stacked state at the new common per-cluster capacity."""
+        want = max(k.capacity for e in self.engines for k in (e.nodes, e.pods))
+        if want <= self.cluster_capacity:
+            return
+        n = len(self.engines)
+        d = int(self.mesh.devices.size)
+        new_r = _pad_cluster_capacity(want, n, d)
+        old_r = self.cluster_capacity
+        logger.info("federation regrow: %d -> %d rows/cluster", old_r, new_r)
+        for e in self.engines:
+            for k in (e.nodes, e.pods):
+                if k.capacity < new_r:
+                    k.grow(new_r)
+        for kind in ("nodes", "pods"):
+            host = to_host(self._stacked[kind])
+            stacked = new_row_state(new_r * n)
+            for c in range(n):
+                for f in RowState._fields:
+                    getattr(stacked, f)[c * new_r : c * new_r + old_r] = getattr(
+                        host, f
+                    )[c * old_r : (c + 1) * old_r]
+            self._stacked[kind] = self._kernels[kind].place(stacked)
+        self.cluster_capacity = new_r
+
+    # --------------------------------------------------------------- metrics
+
+    @property
+    def metrics(self) -> dict:
+        """Aggregated counters across members (gauges are summed too —
+        nodes/pods managed are totals across the federation)."""
+        agg: dict[str, float] = {}
+        for e in self.engines:
+            with e._metrics_lock:
+                for name, v in e.metrics.items():
+                    if name == "watch_lag_seconds":
+                        # worst-case lag, not a sum over members
+                        agg[name] = max(agg.get(name, 0.0), v)
+                    else:
+                        agg[name] = agg.get(name, 0) + v
+        if self.engines:
+            n = len(self.engines)
+            # every member records the same shared-tick values; un-sum them
+            for name in ("ticks_total", "tick_seconds_sum", "tick_seconds_last"):
+                agg[name] = agg[name] / n
+        return agg

@@ -567,6 +567,10 @@ class KindCluster(Cluster):
         shutil.rmtree(tmp_dir, ignore_errors=True)
         try:
             self._run([etcdctl, "snapshot", "restore", path, "--data-dir", tmp_dir])
+            # a previously interrupted restore may have left /var/lib/etcd.new
+            # in the container; docker cp would merge into it
+            self._run(["docker", "exec", self._control_plane(),
+                       "rm", "-rf", "/var/lib/etcd.new"], check=False)
             self._run(["docker", "cp", tmp_dir, f"{self._control_plane()}:/var/lib/"])
             self._run(["docker", "exec", self._control_plane(), "sh", "-c",
                        "rm -rf /var/lib/etcd && mv /var/lib/etcd.new /var/lib/etcd"])

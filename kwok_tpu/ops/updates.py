@@ -96,15 +96,20 @@ class UpdateBuffer:
     def pending(self) -> int:
         return len(self._init) + len(self._upd)
 
-    def flush(self, state: RowState) -> RowState:
-        cap = self.capacity
+    def flush(self, state: RowState, offset: int = 0) -> RowState:
+        """Apply staged writes. `offset` shifts row indices (a cluster's slice
+        of a federated stacked state). Padding lanes use the TARGET state's
+        capacity as their index, which is always out of bounds under
+        mode='drop' regardless of offset."""
+        cap = state.capacity
+        off = np.int32(offset)
         while self._init:
             chunk, self._init = self._init[:BATCH], self._init[BATCH:]
             n = len(chunk)
             pad = BATCH - n
             b = InitBatch(
                 idx=np.concatenate(
-                    [np.fromiter((c[0] for c in chunk), np.int32, n),
+                    [np.fromiter((c[0] for c in chunk), np.int32, n) + off,
                      np.full(pad, cap, np.int32)]
                 ),
                 active=np.concatenate(
@@ -133,7 +138,7 @@ class UpdateBuffer:
             pad = BATCH - n
             b = UpdateBatch(
                 idx=np.concatenate(
-                    [np.fromiter((c[0] for c in chunk), np.int32, n),
+                    [np.fromiter((c[0] for c in chunk), np.int32, n) + off,
                      np.full(pad, cap, np.int32)]
                 ),
                 sel_bits=np.concatenate(

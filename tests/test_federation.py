@@ -1,0 +1,131 @@
+"""FederatedEngine: N fake apiservers, one stacked mesh-sharded tick
+(BASELINE config 5: "8 kwok apiservers sharded 1-per-TPU-core")."""
+
+import time
+
+import pytest
+
+from kwok_tpu.engine import EngineConfig, FederatedEngine
+from kwok_tpu.engine.federation import _pad_cluster_capacity
+from tests.fake_apiserver import FakeKube
+from tests.test_engine import make_node, make_pod
+
+
+def wait_until(pred, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_pad_cluster_capacity():
+    # 8 devices, 8 clusters: any R shards evenly
+    assert _pad_cluster_capacity(5, 8, 8) == 5
+    # 4 clusters over 8 devices: R must be even
+    assert _pad_cluster_capacity(5, 4, 8) == 6
+    # 3 clusters over 8 devices: R must be a multiple of 8
+    assert _pad_cluster_capacity(5, 3, 8) == 8
+
+
+@pytest.mark.parametrize("n_clusters", [2, 8])
+def test_federated_convergence(n_clusters):
+    servers = [FakeKube() for _ in range(n_clusters)]
+    fed = FederatedEngine(
+        servers,
+        EngineConfig(manage_all_nodes=True, tick_interval=0.02),
+    )
+    fed.start()
+    try:
+        for c, server in enumerate(servers):
+            for i in range(2):
+                server.create("nodes", make_node(f"c{c}-node{i}"))
+            for i in range(5):
+                server.create("pods", make_pod(f"c{c}-pod{i}", node=f"c{c}-node0"))
+
+        def converged():
+            for server in servers:
+                for obj in server.list("nodes"):
+                    conds = {
+                        c["type"]: c["status"]
+                        for c in (obj.get("status") or {}).get("conditions") or []
+                    }
+                    if conds.get("Ready") != "True":
+                        return False
+                pods = server.list("pods", field_selector="spec.nodeName!=")
+                if len(pods) != 5:
+                    return False
+                for obj in pods:
+                    if (obj.get("status") or {}).get("phase") != "Running":
+                        return False
+            return True
+
+        assert wait_until(converged), "federated clusters did not converge"
+
+        # members are isolated: each apiserver saw only its own objects
+        for c, server in enumerate(servers):
+            names = {o["metadata"]["name"] for o in server.list("nodes")}
+            assert names == {f"c{c}-node0", f"c{c}-node1"}
+
+        m = fed.metrics
+        assert m["nodes_managed"] == 2 * n_clusters
+        assert m["pods_managed"] == 5 * n_clusters
+        assert m["transitions_total"] >= 7 * n_clusters
+    finally:
+        fed.stop()
+
+
+def test_federated_regrow():
+    """Member pool growth rebuilds the stacked state without losing rows."""
+    servers = [FakeKube() for _ in range(2)]
+    fed = FederatedEngine(
+        servers,
+        EngineConfig(manage_all_nodes=True, tick_interval=0.02, initial_capacity=4),
+    )
+    start_cap = fed.cluster_capacity
+    fed.start()
+    try:
+        for c, server in enumerate(servers):
+            server.create("nodes", make_node(f"c{c}-node0"))
+            for i in range(3 * start_cap):
+                server.create("pods", make_pod(f"c{c}-pod{i}", node=f"c{c}-node0"))
+
+        def all_running():
+            for server in servers:
+                pods = server.list("pods", field_selector="spec.nodeName!=")
+                if len(pods) != 3 * start_cap:
+                    return False
+                if any(
+                    (o.get("status") or {}).get("phase") != "Running" for o in pods
+                ):
+                    return False
+            return True
+
+        assert wait_until(all_running), "pods did not converge after regrow"
+        assert fed.cluster_capacity > start_cap
+    finally:
+        fed.stop()
+
+
+def test_federated_deletion():
+    servers = [FakeKube() for _ in range(2)]
+    fed = FederatedEngine(
+        servers, EngineConfig(manage_all_nodes=True, tick_interval=0.02)
+    )
+    fed.start()
+    try:
+        servers[0].create("nodes", make_node("n0"))
+        servers[0].create("pods", make_pod("p0", node="n0"))
+        assert wait_until(
+            lambda: (servers[0].get("pods", "default", "p0") or {})
+            .get("status", {})
+            .get("phase")
+            == "Running"
+        )
+        servers[0].delete("pods", "default", "p0", grace_seconds=30)
+        assert wait_until(
+            lambda: servers[0].get("pods", "default", "p0") is None
+        ), "deleting pod was not reaped"
+    finally:
+        fed.stop()
