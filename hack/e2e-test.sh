@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# e2e dispatcher (port of the reference's hack/e2e-test.sh case discovery):
+# every test/**/*.test.sh is a case; run all, or only those whose path
+# matches the given substrings.
+#
+#   hack/e2e-test.sh            # run everything
+#   hack/e2e-test.sh kwokctl    # run cases with "kwokctl" in the path
+
+set -o errexit -o nounset -o pipefail
+
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "${ROOT}"
+
+mapfile -t ALL < <(find test -name '*.test.sh' -o -name '*_test.sh' | sort)
+
+CASES=()
+if [ "$#" -eq 0 ]; then
+  CASES=("${ALL[@]}")
+else
+  for want in "$@"; do
+    for c in "${ALL[@]}"; do
+      case "${c}" in
+      *"${want}"*) CASES+=("${c}") ;;
+      esac
+    done
+  done
+fi
+
+if [ "${#CASES[@]}" -eq 0 ]; then
+  echo "no e2e cases matched: $*" >&2
+  exit 1
+fi
+
+failed=()
+for c in "${CASES[@]}"; do
+  echo "=== RUN   ${c}"
+  start="$(date +%s)"
+  if bash "${c}"; then
+    echo "--- PASS: ${c} ($(($(date +%s) - start))s)"
+  else
+    echo "--- FAIL: ${c} ($(($(date +%s) - start))s)"
+    failed+=("${c}")
+  fi
+done
+
+if [ "${#failed[@]}" -ne 0 ]; then
+  echo "FAIL: ${failed[*]}"
+  exit 1
+fi
+echo "PASS: ${#CASES[@]} case(s)"

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import copy
 import json
+import os
 import queue
 import re
 import threading
@@ -180,6 +181,35 @@ class FakeKube:
             self._emit(kind, MODIFIED, obj)
             return copy.deepcopy(obj)
 
+    def dump(self) -> dict:
+        """Serializable snapshot of the whole store — the mock's 'etcd
+        snapshot' (cluster state IS store state, SURVEY.md section 3.5)."""
+        with self._lock:
+            return {
+                "resourceVersion": self._rv,
+                "objects": {
+                    kind: copy.deepcopy(list(objs.values()))
+                    for kind, objs in self._store.items()
+                },
+            }
+
+    def load(self, data: dict) -> None:
+        """Replace the store from a dump(). All open watches are closed so
+        clients re-list, like watchers reconnecting after an etcd restore."""
+        with self._lock:
+            self._store = {"nodes": {}, "pods": {}}
+            for kind, objs in (data.get("objects") or {}).items():
+                if kind not in self._store:
+                    continue
+                for obj in objs:
+                    meta = obj.get("metadata") or {}
+                    key = self._key(meta.get("namespace"), meta.get("name"))
+                    self._store[kind][key] = copy.deepcopy(obj)
+            self._rv = max(self._rv, int(data.get("resourceVersion") or 0)) + 1
+            watches, self._watches = self._watches, []
+        for w in watches:
+            w.stop()
+
     def delete(self, kind, namespace, name, grace_seconds: int = 0):
         with self._lock:
             key = self._key(namespace, name)
@@ -211,11 +241,18 @@ _PATHS = re.compile(
 )
 
 
+class _Server(ThreadingHTTPServer):
+    # the default backlog of 5 drops connections under bursty load
+    # (benchmark cases open ~1k sockets while patch workers hold 16 more)
+    request_queue_size = 256
+    daemon_threads = True
+
+
 class HttpFakeApiserver:
     def __init__(self, store: FakeKube | None = None, port: int = 0) -> None:
         self.store = store or FakeKube()
         handler = self._make_handler()
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.httpd = _Server(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self.url = f"http://127.0.0.1:{self.port}"
         self._thread: threading.Thread | None = None
@@ -261,6 +298,10 @@ class HttpFakeApiserver:
                     self.send_header("Content-Length", "2")
                     self.end_headers()
                     self.wfile.write(b"ok")
+                    return
+                if parsed.path == "/snapshot":
+                    # the mock's `etcdctl snapshot save`
+                    self._send_json(store.dump())
                     return
                 m = _PATHS.match(parsed.path)
                 if not m:
@@ -336,6 +377,11 @@ class HttpFakeApiserver:
 
             def do_POST(self):  # noqa: N802 (test convenience: create)
                 parsed = urllib.parse.urlparse(self.path)
+                if parsed.path == "/restore":
+                    # the mock's `etcdctl snapshot restore` + etcd restart
+                    store.load(self._body() or {})
+                    self._send_json({"kind": "Status", "status": "Success"})
+                    return
                 m = _PATHS.match(parsed.path)
                 if not m:
                     self.send_error(404)
@@ -354,14 +400,41 @@ def main(argv=None) -> int:
 
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=0)
+    p.add_argument(
+        "--data-file",
+        default="",
+        help="persist the store here across restarts (the mock's etcd "
+        "data dir): loaded at startup, written on shutdown",
+    )
     args = p.parse_args(argv)
     srv = HttpFakeApiserver(port=args.port)
+    if args.data_file:
+        try:
+            with open(args.data_file) as f:
+                srv.store.load(json.load(f))
+            print(f"restored store from {args.data_file}", flush=True)
+        except FileNotFoundError:
+            pass
     print(f"mock apiserver listening on {srv.url}", flush=True)
-    signal.signal(signal.SIGTERM, lambda *a: srv.httpd.shutdown())
+
+    # SIGTERM arrives on the thread running serve_forever, so calling
+    # shutdown() from the handler would deadlock (it waits for the serve
+    # loop it interrupted). Raise instead: the exception unwinds out of
+    # serve_forever and the finally block persists the store.
+    def _term(*_a):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _term)
     try:
         srv.httpd.serve_forever()
-    except KeyboardInterrupt:
+    except (KeyboardInterrupt, SystemExit):
         pass
+    finally:
+        if args.data_file:
+            tmp = args.data_file + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(srv.store.dump(), f)
+            os.replace(tmp, args.data_file)
     return 0
 
 

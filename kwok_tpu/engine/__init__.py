@@ -8,6 +8,15 @@ reference's 16-way parallelTasks pools, controller.go:118-136).
 """
 
 from kwok_tpu.engine.engine import ClusterEngine, EngineConfig
-from kwok_tpu.engine.federation import FederatedEngine
 
 __all__ = ["ClusterEngine", "EngineConfig", "FederatedEngine"]
+
+
+def __getattr__(name):
+    # lazy: federation pulls in the mesh/shard_map machinery, which
+    # single-cluster consumers (the common case) never need
+    if name == "FederatedEngine":
+        from kwok_tpu.engine.federation import FederatedEngine
+
+        return FederatedEngine
+    raise AttributeError(name)

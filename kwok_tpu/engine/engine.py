@@ -114,7 +114,7 @@ class _Kind:
         self.capacity = capacity
         self.state: RowState = new_row_state(capacity)  # host until start()
         self.pool = RowPool(capacity)
-        self.buffer = UpdateBuffer(capacity)
+        self.buffer = UpdateBuffer()
         self.phase_h = np.zeros(capacity, np.int32)
         self.cond_h = np.zeros(capacity, np.uint32)
 
@@ -124,7 +124,6 @@ class _Kind:
         self.state = host
         self.capacity = new_capacity
         self.pool.grow(new_capacity)
-        self.buffer.capacity = new_capacity
         extra = new_capacity - self.phase_h.shape[0]
         self.phase_h = np.concatenate([self.phase_h, np.zeros(extra, np.int32)])
         self.cond_h = np.concatenate([self.cond_h, np.zeros(extra, np.uint32)])
@@ -625,8 +624,13 @@ class ClusterEngine:
     def _submit(self, fn, *args) -> None:
         if self._executor is None:
             fn(*args)  # synchronous mode (tests may call tick_once directly)
-        else:
+            return
+        try:
             self._executor.submit(self._safe, fn, *args)
+        except RuntimeError:
+            # executor shut down while a (federated) tick was still in
+            # flight — we are stopping; drop the patch job
+            pass
 
     def _safe(self, fn, *args) -> None:
         try:

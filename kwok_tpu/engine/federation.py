@@ -25,6 +25,7 @@ kubelets under it).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import math
 import queue
@@ -70,8 +71,6 @@ class FederatedEngine:
 
         self.engines: list[ClusterEngine] = []
         for client in clients:
-            import dataclasses
-
             cfg = dataclasses.replace(
                 config, initial_capacity=self.cluster_capacity, use_mesh=False
             )

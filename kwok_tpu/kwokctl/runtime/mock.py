@@ -66,7 +66,11 @@ class MockCluster(BinaryCluster):
             name="kube-apiserver",
             binary=self.bin_path("kube-apiserver"),
             workDir=self.workdir,
-            args=[f"--port={conf.kubeApiserverPort}"],
+            args=[
+                f"--port={conf.kubeApiserverPort}",
+                # the mock's etcd data dir: store survives stop/start
+                f"--data-file={self.workdir_path('apiserver-state.json')}",
+            ],
         )
         kwok = comp.build_kwok_controller(
             binary=self.bin_path("kwok-controller"),
@@ -88,8 +92,30 @@ class MockCluster(BinaryCluster):
         with open(self.workdir_path(base.IN_HOST_KUBECONFIG_NAME), "w") as f:
             f.write(data)
 
+    def _apiserver_url(self) -> str:
+        return f"http://{LOCAL}:{self.config().options.kubeApiserverPort}"
+
     def snapshot_save(self, path: str) -> None:
-        raise NotImplementedError("mock runtime has no etcd to snapshot")
+        """GET /snapshot — the mock analogue of `etcdctl snapshot save`
+        (cluster state IS apiserver-store state, SURVEY.md section 3.5)."""
+        import urllib.request
+
+        with urllib.request.urlopen(self._apiserver_url() + "/snapshot") as r:
+            data = r.read()
+        with open(path, "wb") as f:
+            f.write(data)
 
     def snapshot_restore(self, path: str) -> None:
-        raise NotImplementedError("mock runtime has no etcd to snapshot")
+        """POST /restore — replaces the store and closes watches, so the
+        engine re-lists, exactly like watchers after an etcd restore."""
+        import urllib.request
+
+        with open(path, "rb") as f:
+            data = f.read()
+        req = urllib.request.Request(
+            self._apiserver_url() + "/restore",
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        urllib.request.urlopen(req).read()

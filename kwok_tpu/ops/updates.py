@@ -73,8 +73,7 @@ def update_rows(state: RowState, b: UpdateBatch) -> RowState:
 class UpdateBuffer:
     """Host-side accumulator that flushes padded batches to device."""
 
-    def __init__(self, capacity: int) -> None:
-        self.capacity = capacity
+    def __init__(self) -> None:
         self._init: list[tuple[int, bool, int, int, int, bool]] = []
         self._upd: list[tuple[int, int, bool]] = []
 

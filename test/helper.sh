@@ -1,0 +1,104 @@
+#!/usr/bin/env bash
+# Shared helpers for the e2e suite (port of the reference's
+# test/kwokctl/helper.sh + test/kwok/kwok.test.sh plumbing).
+#
+# Every python child runs on CPU JAX with the TPU-claim relay disabled:
+# concurrent processes grabbing the single tunneled TPU chip would deadlock
+# (see .claude/skills/verify/SKILL.md).
+
+set -o errexit -o nounset -o pipefail
+
+E2E_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+pyrun() {
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu PYTHONPATH="${E2E_ROOT}" \
+    python3 "$@"
+}
+
+kwokctl() {
+  pyrun -m kwok_tpu.kwokctl "$@"
+}
+
+apiserver_url() { # CLUSTER_NAME -> http://127.0.0.1:PORT
+  local kc
+  kc="$(kwokctl --name "$1" get kubeconfig)"
+  awk '/server:/ {print $2; exit}' "${kc}"
+}
+
+retry() { # TIMEOUT_SECONDS CMD ARGS... — poll every second
+  local timeout="$1"
+  shift
+  local deadline=$(($(date +%s) + timeout))
+  while true; do
+    if "$@" >/dev/null 2>&1; then
+      return 0
+    fi
+    if [ "$(date +%s)" -ge "${deadline}" ]; then
+      echo "retry: timed out after ${timeout}s: $*" >&2
+      return 1
+    fi
+    sleep 1
+  done
+}
+
+create_node() { # URL NAME [ANNOTATIONS_JSON]
+  local annotations="${3:-}"
+  [ -n "${annotations}" ] || annotations="{}"
+  curl -fsS -X POST "$1/api/v1/nodes" -H 'Content-Type: application/json' \
+    -d "{\"apiVersion\":\"v1\",\"kind\":\"Node\",\"metadata\":{\"name\":\"$2\",\"annotations\":${annotations}}}" \
+    >/dev/null
+}
+
+create_pod() { # URL NS NAME NODE [ANNOTATIONS_JSON]
+  local annotations="${5:-}"
+  [ -n "${annotations}" ] || annotations="{}"
+  curl -fsS -X POST "$1/api/v1/namespaces/$2/pods" \
+    -H 'Content-Type: application/json' \
+    -d "{\"apiVersion\":\"v1\",\"kind\":\"Pod\",\"metadata\":{\"name\":\"$3\",\"namespace\":\"$2\",\"annotations\":${annotations}},\"spec\":{\"nodeName\":\"$4\",\"containers\":[{\"name\":\"c\",\"image\":\"busybox\"}]},\"status\":{\"phase\":\"Pending\"}}" \
+    >/dev/null
+}
+
+node_is_ready() { # URL NAME
+  curl -fsS "$1/api/v1/nodes/$2" | pyrun -c '
+import json, sys
+node = json.load(sys.stdin)
+conds = {c["type"]: c["status"] for c in (node.get("status") or {}).get("conditions") or []}
+sys.exit(0 if conds.get("Ready") == "True" else 1)
+'
+}
+
+count_ready_nodes() { # URL
+  curl -fsS "$1/api/v1/nodes" | pyrun -c '
+import json, sys
+items = json.load(sys.stdin)["items"]
+print(sum(1 for n in items
+          if any(c.get("type") == "Ready" and c.get("status") == "True"
+                 for c in (n.get("status") or {}).get("conditions") or [])))
+'
+}
+
+count_running_pods() { # URL
+  curl -fsS "$1/api/v1/pods" | pyrun -c '
+import json, sys
+items = json.load(sys.stdin)["items"]
+print(sum(1 for p in items if (p.get("status") or {}).get("phase") == "Running"))
+'
+}
+
+count_pods() { # URL
+  curl -fsS "$1/api/v1/pods" | pyrun -c '
+import json, sys; print(len(json.load(sys.stdin)["items"]))
+'
+}
+
+running_pods_equal() { # URL N
+  [ "$(count_running_pods "$1")" = "$2" ]
+}
+
+ready_nodes_equal() { # URL N
+  [ "$(count_ready_nodes "$1")" = "$2" ]
+}
+
+pods_equal() { # URL N
+  [ "$(count_pods "$1")" = "$2" ]
+}

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Port of the reference's test/kwok/kwok.test.sh four checks, against the
+# standalone mock apiserver instead of a kind cluster (no egress here):
+#   1. a fake node becomes Ready within 30s
+#   2. five "deployment" pods bound to it become Running
+#   3. a manual status patch on a disregard-annotated NODE sticks
+#   4. a manual status patch on a disregard-annotated POD sticks
+# Checks 3-4 are the disregard-selector contract (kwok.test.sh:76-105).
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+WORK="$(mktemp -d)"
+APISERVER_PID=""
+KWOK_PID=""
+
+cleanup() {
+  [ -n "${KWOK_PID}" ] && kill "${KWOK_PID}" 2>/dev/null || true
+  [ -n "${APISERVER_PID}" ] && kill "${APISERVER_PID}" 2>/dev/null || true
+  rm -rf "${WORK}"
+}
+trap cleanup EXIT
+
+PORT="$(pyrun -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')"
+URL="http://127.0.0.1:${PORT}"
+
+pyrun -m kwok_tpu.edge.mockserver --port "${PORT}" \
+  >"${WORK}/apiserver.log" 2>&1 &
+APISERVER_PID="$!"
+retry 10 curl -fsS "${URL}/healthz"
+
+pyrun -m kwok_tpu.kwok \
+  --master "${URL}" \
+  --manage-all-nodes=true \
+  --disregard-status-with-annotation-selector "kwok.x-k8s.io/status=custom" \
+  --tick-interval 0.05 \
+  >"${WORK}/kwok.log" 2>&1 &
+KWOK_PID="$!"
+
+# 1. fake node Ready within 30s
+create_node "${URL}" fake-node
+retry 30 node_is_ready "${URL}" fake-node
+
+# 2. five pods Running
+for i in 0 1 2 3 4; do
+  create_pod "${URL}" default "fake-pod-${i}" fake-node
+done
+retry 30 running_pods_equal "${URL}" 5
+
+# 3. manual status patch on a disregard-annotated node sticks
+create_node "${URL}" custom-node '{"kwok.x-k8s.io/status":"custom"}'
+sleep 2 # give the engine a chance to (wrongly) lock it
+curl -fsS -X PATCH "${URL}/api/v1/nodes/custom-node/status" \
+  -H 'Content-Type: application/json' \
+  -d '{"status":{"nodeInfo":{"kubeletVersion":"fake-custom"}}}' >/dev/null
+sleep 3
+got="$(curl -fsS "${URL}/api/v1/nodes/custom-node" | pyrun -c '
+import json, sys
+print(((json.load(sys.stdin).get("status") or {}).get("nodeInfo") or {}).get("kubeletVersion", ""))
+')"
+[ "${got}" = "fake-custom" ] || {
+  echo "disregard-node status was overwritten: ${got}" >&2
+  exit 1
+}
+
+# 4. manual status patch on a disregard-annotated pod sticks
+create_pod "${URL}" default custom-pod fake-node '{"kwok.x-k8s.io/status":"custom"}'
+sleep 2
+curl -fsS -X PATCH "${URL}/api/v1/namespaces/default/pods/custom-pod/status" \
+  -H 'Content-Type: application/json' \
+  -d '{"status":{"phase":"Failed","reason":"CustomFault"}}' >/dev/null
+sleep 3
+got="$(curl -fsS "${URL}/api/v1/namespaces/default/pods/custom-pod" | pyrun -c '
+import json, sys
+print((json.load(sys.stdin).get("status") or {}).get("phase", ""))
+')"
+[ "${got}" = "Failed" ] || {
+  echo "disregard-pod status was overwritten: ${got}" >&2
+  exit 1
+}
+
+echo "kwok.test.sh: all four checks passed"

@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Port of kwokctl_benchmark_test.sh (:152-173) — the reference's
+# benchmark-as-test gates, same thresholds:
+#   create 1,000 pods on 1 node -> all Running  <= 120s
+#   delete 1,000 pods (grace 1s) -> all gone    <= 120s
+#   create 1,000 nodes -> all Ready             <= 120s
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+CLUSTER="e2e-benchmark"
+cleanup() {
+  kwokctl --name "${CLUSTER}" delete cluster >/dev/null 2>&1 || true
+}
+trap cleanup EXIT
+
+kwokctl --name "${CLUSTER}" create cluster --runtime mock --wait 60s
+URL="$(apiserver_url "${CLUSTER}")"
+
+create_node "${URL}" bench-node
+retry 30 node_is_ready "${URL}" bench-node
+
+# --- create 1,000 pods ---------------------------------------------------
+start="$(date +%s)"
+pyrun - "${URL}" <<'EOF'
+import json, sys, urllib.request
+url = sys.argv[1]
+for i in range(1000):
+    body = json.dumps({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"bench-pod-{i}", "namespace": "default"},
+        "spec": {"nodeName": "bench-node",
+                 "containers": [{"name": "c", "image": "busybox"}]},
+        "status": {"phase": "Pending"},
+    }).encode()
+    req = urllib.request.Request(
+        url + "/api/v1/namespaces/default/pods", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    urllib.request.urlopen(req).read()
+EOF
+retry 110 running_pods_equal "${URL}" 1000
+elapsed=$(($(date +%s) - start))
+[ "${elapsed}" -le 120 ] || { echo "create 1000 pods took ${elapsed}s (>120s)" >&2; exit 1; }
+echo "create 1000 pods -> Running: ${elapsed}s"
+
+# --- delete 1,000 pods (grace 1) -----------------------------------------
+start="$(date +%s)"
+pyrun - "${URL}" <<'EOF'
+import json, sys, urllib.request
+url = sys.argv[1]
+for i in range(1000):
+    req = urllib.request.Request(
+        f"{url}/api/v1/namespaces/default/pods/bench-pod-{i}",
+        data=json.dumps({"gracePeriodSeconds": 1}).encode(),
+        headers={"Content-Type": "application/json"}, method="DELETE")
+    urllib.request.urlopen(req).read()
+EOF
+retry 110 pods_equal "${URL}" 0
+elapsed=$(($(date +%s) - start))
+[ "${elapsed}" -le 120 ] || { echo "delete 1000 pods took ${elapsed}s (>120s)" >&2; exit 1; }
+echo "delete 1000 pods: ${elapsed}s"
+
+# --- create 1,000 nodes ---------------------------------------------------
+start="$(date +%s)"
+pyrun - "${URL}" <<'EOF'
+import json, sys, urllib.request
+url = sys.argv[1]
+for i in range(1000):
+    body = json.dumps({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": f"bench-node-{i}"},
+    }).encode()
+    req = urllib.request.Request(
+        url + "/api/v1/nodes", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    urllib.request.urlopen(req).read()
+EOF
+retry 110 ready_nodes_equal "${URL}" 1001
+elapsed=$(($(date +%s) - start))
+[ "${elapsed}" -le 120 ] || { echo "create 1000 nodes took ${elapsed}s (>120s)" >&2; exit 1; }
+echo "create 1000 nodes -> Ready: ${elapsed}s"
+
+echo "kwokctl_benchmark_test.sh passed"

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Port of kwokctl_restart_test.sh: cluster state must survive a full
+# stop/start cycle (the mock apiserver persists its store to a data file,
+# standing in for etcd's data dir), and the engine must re-lock after
+# restart (crash recovery by re-list, SURVEY.md section 5.3).
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+CLUSTER="e2e-restart"
+cleanup() {
+  kwokctl --name "${CLUSTER}" delete cluster >/dev/null 2>&1 || true
+}
+trap cleanup EXIT
+
+kwokctl --name "${CLUSTER}" create cluster --runtime mock --wait 60s
+URL="$(apiserver_url "${CLUSTER}")"
+
+create_node "${URL}" fake-node
+create_pod "${URL}" default fake-pod fake-node
+retry 30 node_is_ready "${URL}" fake-node
+retry 30 running_pods_equal "${URL}" 1
+
+kwokctl --name "${CLUSTER}" stop cluster
+if curl -fsS --max-time 2 "${URL}/healthz" >/dev/null 2>&1; then
+  echo "apiserver still answering after stop" >&2
+  exit 1
+fi
+
+kwokctl --name "${CLUSTER}" start cluster
+retry 30 curl -fsS "${URL}/healthz"
+
+# state survived: the node and pod are still there and still simulated
+retry 30 node_is_ready "${URL}" fake-node
+retry 30 running_pods_equal "${URL}" 1
+
+# the restarted engine still simulates NEW objects
+create_pod "${URL}" default fake-pod-2 fake-node
+retry 30 running_pods_equal "${URL}" 2
+
+echo "kwokctl_restart_test.sh passed"

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Port of kwokctl_snapshot_test.sh: save -> mutate -> restore -> the object
+# list diffs back to the saved state (SURVEY.md section 3.5: cluster state
+# is store state; the engine rebuilds device arrays from list+watch).
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+CLUSTER="e2e-snapshot"
+SNAP="$(mktemp -u)"
+cleanup() {
+  kwokctl --name "${CLUSTER}" delete cluster >/dev/null 2>&1 || true
+  rm -f "${SNAP}"
+}
+trap cleanup EXIT
+
+kwokctl --name "${CLUSTER}" create cluster --runtime mock --wait 60s
+URL="$(apiserver_url "${CLUSTER}")"
+
+create_node "${URL}" fake-node
+create_pod "${URL}" default keep-pod fake-node
+retry 30 running_pods_equal "${URL}" 1
+
+kwokctl --name "${CLUSTER}" snapshot save --path "${SNAP}"
+[ -s "${SNAP}" ] || { echo "snapshot file empty" >&2; exit 1; }
+
+# mutate after the snapshot: extra pod + extra node
+create_pod "${URL}" default drop-pod fake-node
+create_node "${URL}" drop-node
+retry 30 pods_equal "${URL}" 2
+
+kwokctl --name "${CLUSTER}" snapshot restore --path "${SNAP}"
+
+# restored: the mutation is gone, the saved objects are back
+retry 30 pods_equal "${URL}" 1
+curl -fsS "${URL}/api/v1/namespaces/default/pods/keep-pod" >/dev/null
+if curl -fsS "${URL}/api/v1/nodes/drop-node" >/dev/null 2>&1; then
+  echo "drop-node survived the restore" >&2
+  exit 1
+fi
+
+# the engine keeps simulating after a restore (watches resynced)
+create_pod "${URL}" default post-restore-pod fake-node
+retry 30 running_pods_equal "${URL}" 2
+
+echo "kwokctl_snapshot_test.sh passed"

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Port of kwokctl_workable_test.sh (:50-85): create cluster -> fake node +
+# "deployment" pods -> Running -> component logs sane -> delete cluster.
+# Runtime matrix: mock always; binary/docker/kind need downloadable
+# upstream binaries (KWOK_TPU_E2E_RUNTIMES to widen when egress exists).
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+CLUSTER="e2e-workable"
+cleanup() {
+  kwokctl --name "${CLUSTER}" delete cluster >/dev/null 2>&1 || true
+}
+trap cleanup EXIT
+
+for runtime in ${KWOK_TPU_E2E_RUNTIMES:-mock}; do
+  echo "workable: runtime=${runtime}"
+  kwokctl --name "${CLUSTER}" create cluster --runtime "${runtime}" --wait 60s
+
+  URL="$(apiserver_url "${CLUSTER}")"
+  create_node "${URL}" fake-node
+  retry 30 node_is_ready "${URL}" fake-node
+  for i in 0 1 2 3 4; do
+    create_pod "${URL}" default "fake-pod-${i}" fake-node
+  done
+  retry 60 running_pods_equal "${URL}" 5
+
+  # logs plumbing: every component wrote a log file we can read back
+  kwokctl --name "${CLUSTER}" logs kube-apiserver | head -5
+  kwokctl --name "${CLUSTER}" logs kwok-controller | head -5
+
+  # get verbs
+  kwokctl get clusters | grep -q "${CLUSTER}"
+  kwokctl --name "${CLUSTER}" get artifacts >/dev/null
+
+  kwokctl --name "${CLUSTER}" delete cluster
+  if kwokctl get clusters | grep -q "${CLUSTER}"; then
+    echo "cluster still listed after delete" >&2
+    exit 1
+  fi
+done
+
+echo "kwokctl_workable_test.sh passed"
