@@ -1,0 +1,38 @@
+# Build/test entry points (parity: the reference Makefile's
+# unit-test / verify / build targets, hack/releases.sh, hack/e2e-test.sh).
+#
+# Python children run on CPU JAX with the TPU-claim relay disabled so
+# parallel processes don't deadlock on the single tunneled chip.
+PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+
+.PHONY: all build unit-test e2e-test test verify bench image cluster-image clean
+
+all: build
+
+build: ## native codec + wheel
+	./hack/releases.sh
+
+unit-test:
+	$(PYENV) XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    python3 -m pytest tests/ -x -q
+
+e2e-test:
+	./hack/e2e-test.sh
+
+test: unit-test e2e-test
+
+verify:
+	./hack/verify-all.sh
+
+bench: ## the headline benchmark on the real device (ONE process, owns the TPU)
+	python3 bench.py
+
+image:
+	./images/kwok/build.sh
+
+cluster-image:
+	./images/cluster/build.sh
+
+clean:
+	rm -rf build dist *.egg-info kwok_tpu/native/libkwokcodec.so
+	find . -name __pycache__ -type d -not -path './.git/*' -exec rm -rf {} +

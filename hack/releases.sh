@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Build release artifacts (parity: hack/releases.sh — there: CGO_ENABLED=0
+# cross-compiled Go binaries; here: the native egress codec + a wheel).
+set -o errexit -o nounset -o pipefail
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "${ROOT}"
+
+echo ">> building native egress codec"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python3 - <<'EOF'
+from kwok_tpu import native
+ok = native.available()
+print(f"native codec available: {ok}")
+raise SystemExit(0 if ok else 1)
+EOF
+
+echo ">> building wheel"
+if env -u PALLAS_AXON_POOL_IPS python3 -c "import build" 2>/dev/null; then
+  env -u PALLAS_AXON_POOL_IPS python3 -m build --wheel --no-isolation
+else
+  echo "python-build unavailable; skipping wheel"
+fi
+
+echo ">> artifacts:"
+ls -l dist/ 2>/dev/null || true
+ls -l kwok_tpu/native/libkwokcodec.so

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Static checks (parity: the reference's hack/verify-* lint suite).
+set -o errexit -o nounset -o pipefail
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "${ROOT}"
+
+echo ">> python syntax (compileall)"
+python3 -m compileall -q kwok_tpu tests bench.py __graft_entry__.py
+
+echo ">> pytest collection"
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python3 -m pytest tests/ --collect-only -q >/dev/null
+
+echo ">> bash syntax"
+find hack test images -name '*.sh' -print0 | xargs -0 -n1 bash -n
+
+echo ">> yaml manifests parse"
+python3 - <<'EOF'
+import glob, yaml
+for f in glob.glob("kustomize/**/*.yaml", recursive=True):
+    with open(f) as fh:
+        list(yaml.safe_load_all(fh))
+    print(f"  ok {f}")
+EOF
+
+echo "verify: OK"

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Build the all-in-one cluster image (parity: images/cluster/build.sh).
+set -o errexit -o nounset -o pipefail
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+IMAGE="${IMAGE:-kwok-tpu/cluster}"
+TAG="${TAG:-latest}"
+DOCKER="${DOCKER:-docker}"
+RUNTIME="${KWOK_RUNTIME:-mock}"
+exec "${DOCKER}" build -t "${IMAGE}:${TAG}" \
+  --build-arg "kwok_runtime=${RUNTIME}" \
+  -f "${ROOT}/images/cluster/Dockerfile" "${ROOT}"

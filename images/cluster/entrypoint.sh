@@ -1,0 +1,34 @@
+#!/bin/sh
+# Re-create the baked cluster at container start and keep it in the
+# foreground (parity: images/cluster/entrypoint.sh).
+set -e
+
+# bind 0.0.0.0 so docker-proxy's published-port forward reaches the
+# in-container apiserver
+export KWOK_BIND_ADDRESS="${KWOK_BIND_ADDRESS:-0.0.0.0}"
+
+python -m kwok_tpu.kwokctl create cluster \
+  --runtime "${KWOK_RUNTIME:-mock}" \
+  --kube-apiserver-port "${KWOK_KUBE_APISERVER_PORT:-8080}" \
+  --bind-address "${KWOK_BIND_ADDRESS}" \
+  --wait 60s "$@"
+
+echo "##############################################################"
+echo "# The cluster is up; this kubeconfig connects from the host: #"
+echo "##############################################################"
+cat <<EOF
+apiVersion: v1
+kind: Config
+clusters:
+  - name: kwok
+    cluster:
+      server: http://127.0.0.1:${KWOK_KUBE_APISERVER_PORT:-8080}
+contexts:
+  - name: kwok
+    context:
+      cluster: kwok
+current-context: kwok
+EOF
+
+# keep the components (detached, pid-file supervised) in the foreground
+exec tail -f "$HOME"/.kwok/clusters/kwok/logs/*.log

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Build the engine image (parity: images/kwok/build.sh).
+set -o errexit -o nounset -o pipefail
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+IMAGE="${IMAGE:-kwok-tpu/kwok}"
+TAG="${TAG:-latest}"
+DOCKER="${DOCKER:-docker}"
+exec "${DOCKER}" build -t "${IMAGE}:${TAG}" -f "${ROOT}/images/kwok/Dockerfile" "${ROOT}"

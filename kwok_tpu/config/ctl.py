@@ -149,6 +149,9 @@ class KwokctlConfigurationOptions:
     # (not in the reference):
     tickInterval: float = 0.05
     useMesh: bool = False
+    # apiserver bind address; 0.0.0.0 makes a containerized cluster
+    # reachable through published ports (images/cluster)
+    bindAddress: str = "127.0.0.1"
 
 
 @dataclasses.dataclass

@@ -249,12 +249,18 @@ class _Server(ThreadingHTTPServer):
 
 
 class HttpFakeApiserver:
-    def __init__(self, store: FakeKube | None = None, port: int = 0) -> None:
+    def __init__(
+        self,
+        store: FakeKube | None = None,
+        port: int = 0,
+        address: str = "127.0.0.1",
+    ) -> None:
         self.store = store or FakeKube()
         handler = self._make_handler()
-        self.httpd = _Server(("127.0.0.1", port), handler)
+        self.httpd = _Server((address, port), handler)
         self.port = self.httpd.server_address[1]
-        self.url = f"http://127.0.0.1:{self.port}"
+        host = "127.0.0.1" if address in ("", "0.0.0.0") else address
+        self.url = f"http://{host}:{self.port}"
         self._thread: threading.Thread | None = None
 
     def start(self):
@@ -401,13 +407,19 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=0)
     p.add_argument(
+        "--address",
+        default="127.0.0.1",
+        help="bind address (0.0.0.0 for containerized clusters with "
+        "published ports)",
+    )
+    p.add_argument(
         "--data-file",
         default="",
         help="persist the store here across restarts (the mock's etcd "
         "data dir): loaded at startup, written on shutdown",
     )
     args = p.parse_args(argv)
-    srv = HttpFakeApiserver(port=args.port)
+    srv = HttpFakeApiserver(port=args.port, address=args.address)
     if args.data_file:
         try:
             with open(args.data_file) as f:

@@ -68,6 +68,7 @@ class MockCluster(BinaryCluster):
             workDir=self.workdir,
             args=[
                 f"--port={conf.kubeApiserverPort}",
+                f"--address={conf.bindAddress}",
                 # the mock's etcd data dir: store survives stop/start
                 f"--data-file={self.workdir_path('apiserver-state.json')}",
             ],

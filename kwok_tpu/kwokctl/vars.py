@@ -82,6 +82,7 @@ def set_defaults(opts: KwokctlConfigurationOptions) -> KwokctlConfigurationOptio
     )
     opts.kubeAuthorization = _env("KUBE_AUTHORIZATION", opts.kubeAuthorization)
     opts.kubeApiserverPort = _env("KUBE_APISERVER_PORT", opts.kubeApiserverPort)
+    opts.bindAddress = _env("BIND_ADDRESS", opts.bindAddress)
     opts.kubeAuditPolicy = _env("KUBE_AUDIT_POLICY", opts.kubeAuditPolicy)
 
     if not opts.kubeFeatureGates and opts.mode == consts.MODE_STABLE_FEATURE_GATE_AND_API:
