@@ -1,0 +1,49 @@
+"""CNI hook (the pkg/cni equivalent — stub).
+
+The reference can optionally hand pod-IP allocation to real CNI plugins via
+a netns dance on Linux (pkg/cni/cni_linux.go:30-83, netns_linux.go:66-165)
+and stubs it elsewhere (cni_other.go:26-36). Real CNI is out of scope for
+the TPU build (SURVEY.md §2.3): IPs come from the vectorized CIDR pool
+(kwok_tpu.edge.ippool). This module keeps the `--enable-cni` flag honest —
+the hook points exist, delegate to a pluggable provider, and default to a
+stub that reports unavailability exactly like the reference's non-Linux
+build.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+__all__ = ["available", "setup", "remove", "register"]
+
+# provider: (setup(ns, name, uid) -> list[str], remove(ns, name, uid) -> None)
+_provider: tuple[Callable, Callable] | None = None
+
+
+def register(setup_fn: Callable, remove_fn: Callable) -> None:
+    """Install a real CNI provider (tests / future Linux support)."""
+    global _provider
+    _provider = (setup_fn, remove_fn)
+
+
+def available() -> bool:
+    return _provider is not None
+
+
+def setup(namespace: str, name: str, uid: str) -> list[str]:
+    """Allocate IPs for a pod via CNI (cni_linux.go:30 Setup).
+
+    Raises RuntimeError when no provider is registered — the engine treats
+    that as 'fall back to the IP pool', mirroring cni_other.go:26-36's
+    unsupported-platform error.
+    """
+    if _provider is None:
+        raise RuntimeError("cni: no provider registered (unsupported platform)")
+    return _provider[0](namespace, name, uid)
+
+
+def remove(namespace: str, name: str, uid: str) -> None:
+    """Release a pod's CNI resources (cni_linux.go Remove)."""
+    if _provider is None:
+        raise RuntimeError("cni: no provider registered (unsupported platform)")
+    _provider[1](namespace, name, uid)

@@ -29,6 +29,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from kwok_tpu import cni
 from kwok_tpu.edge.ippool import IPPool
 from kwok_tpu.edge.kubeclient import ADDED, DELETED, KubeClient
 from kwok_tpu.edge.merge import node_status_patch_needed, pod_status_patch_needed
@@ -190,6 +191,9 @@ class ClusterEngine:
         self._running = False
         self._executor: ThreadPoolExecutor | None = None
         self._ip_lock = threading.Lock()
+        # serializes CNI commit/undo decisions against row deletion; NEVER
+        # held across provider calls (cni.setup may do netns/network I/O)
+        self._cni_lock = threading.Lock()
         self._metrics_lock = threading.Lock()
 
         # Native C++ egress codec: batch-renders heartbeat patch bytes for
@@ -465,9 +469,17 @@ class ClusterEngine:
         )
         status = pod.get("status") or {}
         pod_ip = status.get("podIP")
-        if pod_ip and not self.config.enable_cni and self.ippool.contains(pod_ip):
+        if pod_ip and self.ippool.contains(pod_ip):
+            # pin any pool-range IP on (re)list — including the
+            # cni-enabled-but-no-provider fallback — so a restarted engine
+            # neither reassigns it nor hands it to another pod
             self.ippool.use(pod_ip)
             m["podIP"] = pod_ip
+        elif pod_ip and self.config.enable_cni:
+            # out-of-pool IP under CNI: adopt it as CNI-owned so deletion
+            # releases it through the provider
+            m["podIP"] = pod_ip
+            m["cni"] = True
         has_del = "deletionTimestamp" in meta
         bits = self._pod_bits(m)
         self.pods_by_node.setdefault(node_name, set()).add(key)
@@ -501,13 +513,31 @@ class ClusterEngine:
         if idx is None:
             return
         m = k.pool.meta[idx]
-        ip = m.get("podIP") or (pod.get("status") or {}).get("podIP")
-        if ip and not self.config.enable_cni:
-            self.ippool.put(ip)  # recycle (pod_controller.go:334-337)
         node_name = m.get("node")
+        with self._cni_lock:
+            # release inside the lock: a cni setup committing concurrently
+            # either lands before (we see m["cni"] and remove) or its
+            # liveness check sees the released row and undoes itself
+            k.pool.release(key)
+            cni_owned = bool(m.get("cni"))
+            ip = m.get("podIP") or (pod.get("status") or {}).get("podIP")
+        if cni_owned:
+            # cni.Remove on Deleted (pod_controller.go:329-343)
+            try:
+                if cni.available():
+                    cni.remove(
+                        m.get("namespace") or "default",
+                        m.get("name") or "",
+                        ((pod.get("metadata") or {}).get("uid")) or "",
+                    )
+            except Exception:
+                logger.exception("cni remove failed")
+        elif ip and self.ippool.contains(ip):
+            # recycle pool-allocated IPs (pod_controller.go:334-337) — also
+            # covers the cni-enabled-but-no-provider fallback
+            self.ippool.put(ip)
         if node_name and node_name in self.pods_by_node:
             self.pods_by_node[node_name].discard(key)
-        k.pool.release(key)
         k.buffer.stage_init(idx, False)
 
     def _update_pods_on_node(self, node_name: str) -> None:
@@ -715,14 +745,64 @@ class ClusterEngine:
         phase_name = POD_PHASES.phases[int(k.phase_h[idx])]
         if phase_name == "Gone":
             return None
-        with self._ip_lock:  # check+allocate must be atomic across workers
-            ip = m.get("podIP")
-            if not ip:
-                ip = self.ippool.get()
-                m["podIP"] = ip
+        ip = m.get("podIP")
+        if not ip and self.config.enable_cni and cni.available():
+            # real-CNI path (configurePod's cni.Setup branch,
+            # pod_controller.go:382-391); falls back to the pool when no
+            # provider is registered (the non-Linux stub contract)
+            ip, row_gone = self._cni_allocate(m, idx)
+            if row_gone or (ip is None and m.get("cni_pending")):
+                return None  # deleted mid-setup / another worker mid-setup
+        if not ip:
+            with self._ip_lock:  # check+allocate atomic across workers
+                ip = m.get("podIP")
+                if not ip:
+                    ip = self.ippool.get()
+                    m["podIP"] = ip
         return render_pod_status(
             m["obj"], phase_name, int(k.cond_h[idx]), self.config.node_ip, ip
         )
+
+    def _cni_allocate(self, m: dict, idx: int) -> tuple[str | None, bool]:
+        """Allocate a pod IP through the CNI provider.
+
+        Returns (ip, row_gone). The provider call runs OUTSIDE every lock (it
+        may block on netns/network I/O); _cni_lock only guards the
+        pending-flag and the liveness-checked commit, so a deletion racing
+        with setup either sees the committed `cni` flag (and removes) or the
+        commit sees the released row (and undoes its own allocation).
+        """
+        ns = m.get("namespace") or "default"
+        name = m.get("name") or ""
+        uid = ((m.get("obj") or {}).get("metadata") or {}).get("uid") or ""
+        with self._cni_lock:
+            if m.get("podIP"):
+                return m["podIP"], False
+            if m.get("cni_pending"):
+                return None, False
+            m["cni_pending"] = True
+        try:
+            ips = cni.setup(ns, name, uid)
+        except Exception:
+            logger.exception("cni setup failed; falling back to IP pool")
+            ips = None
+        undo = False
+        with self._cni_lock:
+            m.pop("cni_pending", None)
+            if not ips:
+                return None, self.pods.pool.meta[idx] is not m
+            if self.pods.pool.meta[idx] is m:  # row still ours: commit
+                m["podIP"] = ips[0]
+                m["cni"] = True
+            else:
+                undo = True
+        if undo:  # deleted mid-setup; release the fresh allocation
+            try:
+                cni.remove(ns, name, uid)
+            except Exception:
+                logger.exception("cni remove (undo) failed")
+            return None, True
+        return ips[0], False
 
     def _patch_pod_status(self, key, idx: int) -> None:
         k = self.pods

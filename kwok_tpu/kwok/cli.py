@@ -126,10 +126,9 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
     stages = [d for d in docs if isinstance(d, Stage)]
 
     args = build_parser(conf.options).parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbosity > 0 else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from kwok_tpu import log
+
+    log.setup(args.verbosity)
 
     from kwok_tpu.edge.httpclient import HttpKubeClient
     from kwok_tpu.engine import ClusterEngine

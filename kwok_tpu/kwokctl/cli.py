@@ -28,6 +28,9 @@ def build_parser() -> argparse.ArgumentParser:
         "creation and management of simulated clusters (TPU-native engine)."
     )
     p.add_argument("--name", default="kwok", help="cluster name")
+    from kwok_tpu import log
+
+    log.add_flags(p)
     sub = p.add_subparsers(dest="verb", required=True)
 
     # create cluster
@@ -208,6 +211,9 @@ def cmd_get(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from kwok_tpu import log
+
+    log.setup(args.verbosity)
     verb = args.verb
     if verb == "create":
         return cmd_create(args)

@@ -163,7 +163,10 @@ class BinaryCluster(Cluster):
                 workdir=workdir,
                 port=conf.kubeApiserverPort,
                 version=conf.kubeVersion,
-                address=LOCAL,
+                # 0.0.0.0 makes a containerized cluster reachable through
+                # published ports (images/cluster); clients still use
+                # 127.0.0.1 via the kubeconfig
+                address=conf.bindAddress or LOCAL,
                 etcd_port=conf.etcdPort,
                 runtime_config=conf.kubeRuntimeConfig,
                 feature_gates=conf.kubeFeatureGates,

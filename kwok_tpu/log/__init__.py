@@ -1,0 +1,25 @@
+"""Structured logging (the pkg/log equivalent).
+
+The reference wraps slog with a human TTY handler: colored level, message,
+then dim `key=value` attributes (pkg/log/logger_ctl.go:78-139), a noop
+logger, a `-v` verbosity flag (pkg/log/flags.go:26), and `KObj` object refs
+(pkg/log/kobj.go:32). Here the same surface sits on stdlib logging:
+
+    from kwok_tpu import log
+    logger = log.get("kwok_tpu.engine")
+    logger.info("node locked", node=log.kobj(node), elapsed=0.012)
+
+renders (on a TTY, with color; plain otherwise):
+
+    14:02:11 INFO  node locked  node=default/node-0 elapsed=0.012
+"""
+
+from kwok_tpu.log.logger import (
+    KVLogger,
+    add_flags,
+    get,
+    kobj,
+    setup,
+)
+
+__all__ = ["KVLogger", "add_flags", "get", "kobj", "setup"]

@@ -1,0 +1,126 @@
+"""TTY-aware structured handler + kwargs logger + KObj refs."""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+_RESET = "\x1b[0m"
+_DIM = "\x1b[2m"
+_LEVEL_COLORS = {
+    logging.DEBUG: "\x1b[36m",  # cyan
+    logging.INFO: "\x1b[32m",  # green
+    logging.WARNING: "\x1b[33m",  # yellow
+    logging.ERROR: "\x1b[31m",  # red
+    logging.CRITICAL: "\x1b[35m",  # magenta
+}
+
+
+def kobj(obj) -> str:
+    """Compact object ref (pkg/log/kobj.go:32): `ns/name` or `name`."""
+    meta = (obj or {}).get("metadata") or {} if isinstance(obj, dict) else {}
+    name = meta.get("name") or "<unknown>"
+    ns = meta.get("namespace")
+    return f"{ns}/{name}" if ns else name
+
+
+class HumanFormatter(logging.Formatter):
+    """`HH:MM:SS LEVEL message  key=value ...` with color on a TTY
+    (logger_ctl.go:78-139: colored level, dim attributes)."""
+
+    def __init__(self, color: bool) -> None:
+        super().__init__()
+        self.color = color
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime("%H:%M:%S", time.localtime(record.created))
+        level = record.levelname
+        msg = record.getMessage()
+        kv = getattr(record, "kwok_kv", None)
+        parts = []
+        if self.color:
+            c = _LEVEL_COLORS.get(record.levelno, "")
+            parts.append(f"{ts} {c}{level:<5}{_RESET} {msg}")
+            if kv:
+                attrs = " ".join(f"{k}={_fmt(v)}" for k, v in kv.items())
+                parts.append(f"  {_DIM}{attrs}{_RESET}")
+        else:
+            parts.append(f"{ts} {level:<5} {msg}")
+            if kv:
+                parts.append(
+                    "  " + " ".join(f"{k}={_fmt(v)}" for k, v in kv.items())
+                )
+        out = "".join(parts)
+        if record.exc_info:
+            out += "\n" + self.formatException(record.exc_info)
+        return out
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    s = str(v)
+    if " " in s or not s:
+        return repr(s)
+    return s
+
+
+class KVLogger:
+    """Thin kwargs front-end: `log.info("msg", key=value)` attaches the
+    attributes to the record for HumanFormatter (slog's AddAttrs shape
+    without the reference's interface plumbing)."""
+
+    def __init__(self, logger: logging.Logger) -> None:
+        self._logger = logger
+
+    def _log(self, level: int, msg: str, kv: dict, exc_info=None) -> None:
+        if self._logger.isEnabledFor(level):
+            self._logger.log(
+                level, msg, extra={"kwok_kv": kv or None}, exc_info=exc_info
+            )
+
+    def debug(self, msg: str, **kv) -> None:
+        self._log(logging.DEBUG, msg, kv)
+
+    def info(self, msg: str, **kv) -> None:
+        self._log(logging.INFO, msg, kv)
+
+    def warning(self, msg: str, **kv) -> None:
+        self._log(logging.WARNING, msg, kv)
+
+    def error(self, msg: str, **kv) -> None:
+        self._log(logging.ERROR, msg, kv)
+
+    def exception(self, msg: str, **kv) -> None:
+        self._log(logging.ERROR, msg, kv, exc_info=True)
+
+
+def get(name: str) -> KVLogger:
+    return KVLogger(logging.getLogger(name))
+
+
+def add_flags(parser) -> None:
+    """The `-v` flag (flags.go:26): 0=info, >=1 debug."""
+    parser.add_argument(
+        "-v",
+        "--verbosity",
+        type=int,
+        default=0,
+        help="log verbosity: 0 info, >=1 debug",
+    )
+
+
+def setup(verbosity: int = 0, stream=None) -> None:
+    """Install the human handler on the root logger (idempotent)."""
+    stream = stream if stream is not None else sys.stderr
+    color = hasattr(stream, "isatty") and stream.isatty()
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(HumanFormatter(color))
+    root = logging.getLogger()
+    root.handlers = [
+        h for h in root.handlers if not getattr(h, "_kwok_log", False)
+    ]
+    handler._kwok_log = True
+    root.addHandler(handler)
+    root.setLevel(logging.DEBUG if verbosity > 0 else logging.INFO)

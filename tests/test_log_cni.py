@@ -1,0 +1,151 @@
+"""Log package (pkg/log parity) + CNI hook (pkg/cni parity)."""
+
+import io
+import logging
+
+import pytest
+
+from kwok_tpu import cni, log
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    cni._provider = None
+    logging.getLogger().handlers = [
+        h for h in logging.getLogger().handlers
+        if not getattr(h, "_kwok_log", False)
+    ]
+
+
+def test_human_formatter_plain_and_kv():
+    stream = io.StringIO()
+    log.setup(0, stream=stream)
+    logger = log.get("kwok_tpu.test")
+    logger.info("node locked", node="default/n0", elapsed=0.0123)
+    out = stream.getvalue()
+    assert "INFO" in out
+    assert "node locked" in out
+    assert "node=default/n0" in out
+    assert "elapsed=0.0123" in out
+    assert "\x1b[" not in out  # StringIO is not a TTY -> no color
+
+
+def test_verbosity_gates_debug():
+    stream = io.StringIO()
+    log.setup(0, stream=stream)
+    log.get("kwok_tpu.test").debug("hidden")
+    assert "hidden" not in stream.getvalue()
+    log.setup(1, stream=stream)
+    log.get("kwok_tpu.test").debug("shown")
+    assert "shown" in stream.getvalue()
+
+
+def test_setup_is_idempotent():
+    stream = io.StringIO()
+    log.setup(0, stream=stream)
+    log.setup(0, stream=stream)
+    log.get("kwok_tpu.test").info("once")
+    assert stream.getvalue().count("once") == 1
+
+
+def test_kobj():
+    assert log.kobj({"metadata": {"namespace": "ns", "name": "p"}}) == "ns/p"
+    assert log.kobj({"metadata": {"name": "n"}}) == "n"
+    assert log.kobj({}) == "<unknown>"
+
+
+def test_cni_stub_unavailable():
+    assert not cni.available()
+    with pytest.raises(RuntimeError):
+        cni.setup("ns", "p", "uid")
+    with pytest.raises(RuntimeError):
+        cni.remove("ns", "p", "uid")
+
+
+def test_cni_provider_roundtrip():
+    calls = []
+    cni.register(
+        lambda ns, n, u: (calls.append(("setup", ns, n, u)) or ["10.9.0.7"]),
+        lambda ns, n, u: calls.append(("remove", ns, n, u)),
+    )
+    assert cni.available()
+    assert cni.setup("ns", "p", "u1") == ["10.9.0.7"]
+    cni.remove("ns", "p", "u1")
+    assert calls == [("setup", "ns", "p", "u1"), ("remove", "ns", "p", "u1")]
+
+
+def test_cni_delete_during_setup_undoes_allocation():
+    """A pod deleted while cni.setup is in flight must not leak the
+    allocation: the commit's liveness check undoes it."""
+    import threading
+
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+    from tests.fake_apiserver import FakeKube
+    from tests.test_engine import SyncEngine, make_node, make_pod
+
+    armed = threading.Event()
+    setup_entered = threading.Event()
+    release_setup = threading.Event()
+    removed = []
+
+    def slow_setup(ns, n, u):
+        if not armed.is_set():
+            raise RuntimeError("not armed")  # pool fallback during pump
+        setup_entered.set()
+        assert release_setup.wait(5)
+        return ["10.77.0.9"]
+
+    cni.register(slow_setup, lambda ns, n, u: removed.append(n))
+
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True, enable_cni=True))
+    server.create("nodes", make_node("node0"))
+    eng.feed_all(server)
+    eng.pump(2)
+    server.create("pods", make_pod("pod0"))
+    eng.feed_all(server)
+    eng.pump(2)  # transitions to Running; _render_pod runs synchronously...
+
+    # run the render (and its CNI setup) on a side thread, then delete the
+    # pod while setup is blocked
+    idx = eng.pods.pool.lookup(("default", "pod0"))
+    t = threading.Thread(target=eng._render_pod, args=(idx,), daemon=True)
+    # clear the pool-fallback IP a previous render assigned, then arm the
+    # provider so this render's setup blocks
+    eng.pods.pool.meta[idx].pop("podIP", None)
+    armed.set()
+    t.start()
+    assert setup_entered.wait(5)
+    eng._pod_deleted({"metadata": {"namespace": "default", "name": "pod0"}})
+    release_setup.set()
+    t.join(5)
+    assert removed == ["pod0"], "mid-setup allocation was not undone"
+
+
+def test_engine_uses_cni_provider():
+    """enable_cni + registered provider: pod IP comes from CNI and is
+    released on deletion (pod_controller.go:329-343)."""
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+    from tests.fake_apiserver import FakeKube
+    from tests.test_engine import SyncEngine, make_node, make_pod
+
+    released = []
+    cni.register(lambda ns, n, u: ["10.77.0.5"], lambda ns, n, u: released.append(n))
+
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True, enable_cni=True))
+    server.create("nodes", make_node("node0"))
+    eng.feed_all(server)
+    eng.pump(2)
+    server.create("pods", make_pod("pod0"))
+    eng.feed_all(server)
+    eng.pump(2)
+    pod = server.get("pods", "default", "pod0")
+    assert pod["status"]["phase"] == "Running"
+    assert pod["status"]["podIP"] == "10.77.0.5"
+
+    eng._q.put(("pods", "DELETED", pod))  # the watch's Deleted event
+    eng.pump(2)
+    # deletion event reached the engine -> provider released the pod
+    assert released == ["pod0"]
