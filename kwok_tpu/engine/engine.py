@@ -51,7 +51,12 @@ from kwok_tpu.models.lifecycle import (
     ResourceKind,
 )
 from kwok_tpu.ops.state import RowState, grow as grow_state, new_row_state
-from kwok_tpu.ops.tick import TickKernel, to_host
+from kwok_tpu.ops.tick import (
+    MultiTickKernel,
+    prefetch,
+    to_host,
+    unpack_wire,
+)
 from kwok_tpu.ops.updates import UpdateBuffer
 from kwok_tpu.engine.rowpool import RowPool
 
@@ -84,6 +89,10 @@ class EngineConfig:
     node_rules: list[LifecycleRule] | None = None
     pod_rules: list[LifecycleRule] | None = None
     use_mesh: bool = False
+    # when set, a JAX profiler trace of ticks [2, 102) is written here
+    # (SURVEY.md §5.1: the reference has no tracing at all; we add device
+    # traces + the per-tick timing counters in `metrics`)
+    profile_dir: str = ""
 
     def validate(self) -> None:
         if not (
@@ -108,10 +117,8 @@ def _selector_bits(table, extra: tuple[str, ...]) -> dict[str, int]:
 class _Kind:
     """Per-resource-kind engine state (device arrays + host bookkeeping)."""
 
-    def __init__(self, table, kernel_factory, capacity: int):
+    def __init__(self, table, capacity: int):
         self.table = table
-        self.kernel_factory = kernel_factory
-        self.kernel = kernel_factory()
         self.capacity = capacity
         self.state: RowState = new_row_state(capacity)  # host until start()
         self.pool = RowPool(capacity)
@@ -159,26 +166,28 @@ class ClusterEngine:
         self.pod_bits = _selector_bits(ptab, (SEL_MANAGED, SEL_ON_MANAGED_NODE))
 
         hb_bit = self.node_bits[SEL_HEARTBEAT]
+        self._mesh = None
         if config.use_mesh:
-            from kwok_tpu.parallel import ShardedTickKernel, make_mesh
+            from kwok_tpu.parallel import make_mesh
             from kwok_tpu.parallel.mesh import pad_to_multiple
 
-            mesh = make_mesh()
-            cap = pad_to_multiple(config.initial_capacity, mesh)
-            node_kf = lambda: ShardedTickKernel(
-                ntab, mesh=mesh,
-                hb_interval=config.heartbeat_interval, hb_sel_bit=hb_bit,
-            )
-            pod_kf = lambda: ShardedTickKernel(ptab, mesh=mesh)
+            self._mesh = make_mesh()
+            cap = pad_to_multiple(config.initial_capacity, self._mesh)
         else:
             cap = config.initial_capacity
-            node_kf = lambda: TickKernel(
-                ntab, hb_interval=config.heartbeat_interval, hb_sel_bit=hb_bit
-            )
-            pod_kf = lambda: TickKernel(ptab)
+        # nodes + pods tick in ONE dispatch: on remote/tunneled devices the
+        # per-call latency dominates the row math (ops/tick.MultiTickKernel).
+        # Built lazily so engines whose tick a FederatedEngine drives (it
+        # owns its own stacked kernels) never allocate device rule tables.
+        self._fused_specs = [
+            (ntab, config.heartbeat_interval, (), hb_bit),
+            (ptab, config.heartbeat_interval, (), -1),
+        ]
+        self._fused: MultiTickKernel | None = None
+        self._owns_tick = True  # False when a FederatedEngine drives us
 
-        self.nodes = _Kind(ntab, node_kf, cap)
-        self.pods = _Kind(ptab, pod_kf, cap)
+        self.nodes = _Kind(ntab, cap)
+        self.pods = _Kind(ptab, cap)
 
         self.node_has: set[str] = set()  # nodesSets (need-heartbeat membership)
         self.pods_by_node: dict[str, set[tuple[str, str]]] = {}
@@ -190,10 +199,12 @@ class ClusterEngine:
         self._threads: list[threading.Thread] = []
         self._running = False
         self._executor: ThreadPoolExecutor | None = None
-        self._ip_lock = threading.Lock()
-        # serializes CNI commit/undo decisions against row deletion; NEVER
-        # held across provider calls (cni.setup may do netns/network I/O)
-        self._cni_lock = threading.Lock()
+        # ONE lock for all IP/meta allocation bookkeeping: pool get/use/put,
+        # podIP/cni commits, the cni_pending flag, and row-release reads in
+        # _pod_deleted. A single lock makes the allocate-vs-delete races
+        # tractable; it is NEVER held across provider calls (cni.setup may
+        # do netns/network I/O) or any other blocking work.
+        self._alloc_lock = threading.Lock()
         self._metrics_lock = threading.Lock()
 
         # Native C++ egress codec: batch-renders heartbeat patch bytes for
@@ -267,14 +278,15 @@ class ClusterEngine:
         stacked device state for all member clusters and drives their ingest
         queues + emit paths from one shared tick loop."""
         self._running = True
+        self._owns_tick = run_tick_loop
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.parallelism, thread_name_prefix="kwok-patch"
         )
         if run_tick_loop:
-            # move state to device (sharded placement if the kernel supports it)
+            # move state to device (row-sharded placement under a mesh)
+            fused = self._get_fused()
             for k in (self.nodes, self.pods):
-                if hasattr(k.kernel, "place"):
-                    k.state = k.kernel.place(k.state)
+                k.state = fused.place(k.state)
 
         node_label_sel = self.config.manage_nodes_with_label_selector or None
         # Each watch thread registers its watch FIRST, then lists and emits a
@@ -289,8 +301,27 @@ class ClusterEngine:
             t.start()
             self._threads.append(t)
 
+    def _get_fused(self) -> MultiTickKernel:
+        if self._fused is None:
+            self._fused = MultiTickKernel(
+                self._fused_specs, mesh=self._mesh, pack=True
+            )
+        return self._fused
+
     def stop(self) -> None:
         self._running = False
+        if getattr(self, "_profiling", False):
+            # short runs stop before tick 102; flush the trace anyway
+            import jax
+
+            self._profiling = False
+            try:
+                jax.profiler.stop_trace()
+                logger.info(
+                    "profiler trace written to %s", self.config.profile_dir
+                )
+            except Exception:
+                logger.exception("profiler stop failed")
         for w in list(self._watches.values()):
             try:
                 w.stop()
@@ -469,17 +500,19 @@ class ClusterEngine:
         )
         status = pod.get("status") or {}
         pod_ip = status.get("podIP")
-        if pod_ip and self.ippool.contains(pod_ip):
-            # pin any pool-range IP on (re)list — including the
-            # cni-enabled-but-no-provider fallback — so a restarted engine
-            # neither reassigns it nor hands it to another pod
-            self.ippool.use(pod_ip)
-            m["podIP"] = pod_ip
-        elif pod_ip and self.config.enable_cni:
-            # out-of-pool IP under CNI: adopt it as CNI-owned so deletion
-            # releases it through the provider
-            m["podIP"] = pod_ip
-            m["cni"] = True
+        if pod_ip:
+            with self._alloc_lock:
+                if self.ippool.contains(pod_ip):
+                    # pin pool-range IPs on (re)list so a restarted engine
+                    # neither reassigns them nor hands them to another pod
+                    self.ippool.use(pod_ip)
+                m["podIP"] = pod_ip
+                if self.config.enable_cni and cni.available():
+                    # a live provider owns every IP it may have assigned —
+                    # even ones inside the pool CIDR — so deletion must go
+                    # through cni.remove (CNI DEL is idempotent); the pinned
+                    # pool slot then simply stays retired
+                    m["cni"] = True
         has_del = "deletionTimestamp" in meta
         bits = self._pod_bits(m)
         self.pods_by_node.setdefault(node_name, set()).add(key)
@@ -514,7 +547,7 @@ class ClusterEngine:
             return
         m = k.pool.meta[idx]
         node_name = m.get("node")
-        with self._cni_lock:
+        with self._alloc_lock:
             # release inside the lock: a cni setup committing concurrently
             # either lands before (we see m["cni"] and remove) or its
             # liveness check sees the released row and undoes itself
@@ -556,14 +589,16 @@ class ClusterEngine:
 
     def _grow(self, k: _Kind) -> None:
         new_cap = max(k.capacity * 2, 1024)
-        if hasattr(k.kernel, "mesh"):
+        if self._mesh is not None:
             from kwok_tpu.parallel.mesh import pad_to_multiple
 
-            new_cap = pad_to_multiple(new_cap, k.kernel.mesh)
+            new_cap = pad_to_multiple(new_cap, self._mesh)
         logger.info("growing row pool %d -> %d", k.capacity, new_cap)
         k.grow(new_cap)
-        if hasattr(k.kernel, "place"):
-            k.state = k.kernel.place(k.state)
+        if self._owns_tick:
+            k.state = self._get_fused().place(k.state)
+        # else: a FederatedEngine drives this engine; it rebuilds its own
+        # stacked device state from the new capacities (_maybe_regrow)
 
     # ------------------------------------------------------------- tick loop
 
@@ -615,32 +650,66 @@ class ClusterEngine:
         except Exception:
             logger.exception("ingest failed for %s %s", kind, type_)
 
+    def _maybe_profile(self) -> None:
+        ticks = self.metrics["ticks_total"]
+        if ticks == 2 and not getattr(self, "_profiling", False):
+            import jax
+
+            self._profiling = True
+            jax.profiler.start_trace(self.config.profile_dir)
+            logger.info("profiler trace started -> %s", self.config.profile_dir)
+        elif ticks >= 102 and getattr(self, "_profiling", False):
+            import jax
+
+            self._profiling = False
+            jax.profiler.stop_trace()
+            logger.info("profiler trace written to %s", self.config.profile_dir)
+
     def tick_once(self) -> None:
-        """One engine step: flush staged writes, run the kernel, emit."""
+        """One engine step: flush staged writes, run ONE fused kernel over
+        both kinds, emit. Host fetches are started async right after the
+        dispatch so the D2H copies overlap the counter sync."""
+        if self.config.profile_dir:
+            self._maybe_profile()
         t0 = time.perf_counter()
         now = self._now()
         now_str = now_rfc3339()
-        for k, kind in ((self.nodes, "nodes"), (self.pods, "pods")):
+        work = False
+        for k in (self.nodes, self.pods):
             if k.buffer.pending:
                 k.state = k.buffer.flush(k.state)
-            elif len(k.pool) == 0:
-                continue
-            out = k.kernel(k.state, now)
-            k.state = out.state
-            n_trans = int(out.transitions)
-            n_hb = int(out.heartbeats)
-            if n_trans:
-                self._inc("transitions_total", n_trans)
-            if n_trans or n_hb:
-                # D2H only when something actually fired: phase/cond change
-                # exclusively via transitions, so the mirrors stay valid on
-                # quiet ticks.
-                dirty = np.asarray(out.dirty)
-                deleted = np.asarray(out.deleted)
-                hb = np.asarray(out.hb_fired)
-                k.phase_h = np.array(out.state.phase)
-                k.cond_h = np.array(out.state.cond_bits)
-                self._emit(kind, k, dirty, deleted, hb, now_str)
+                work = True
+            elif len(k.pool):
+                work = True
+        if work:
+            (nout, pout), wire = self._get_fused()(
+                (self.nodes.state, self.pods.state), now
+            )
+            self.nodes.state = nout.state
+            self.pods.state = pout.state
+            prefetch(wire)
+            # the whole tick summary (counters + bit-packed masks) in ONE
+            # D2H transfer (latency is per-array on remote devices; bytes
+            # are 1/8 of bool masks)
+            counters, masks_fn = unpack_wire(
+                np.asarray(wire), [self.nodes.capacity, self.pods.capacity]
+            )
+            masks = masks_fn() if counters.any() else None
+            for i, (k, kind, out) in enumerate(
+                ((self.nodes, "nodes", nout), (self.pods, "pods", pout))
+            ):
+                n_trans = int(counters[i])
+                n_hb = int(counters[2 + i])
+                if n_trans:
+                    self._inc("transitions_total", n_trans)
+                if n_trans or n_hb:
+                    # full phase/cond mirrors refresh only when something
+                    # actually fired: phase/cond change exclusively via
+                    # transitions, so the mirrors stay valid on quiet ticks
+                    dirty, deleted, hb = masks[i]
+                    k.phase_h = np.array(out.state.phase)
+                    k.cond_h = np.array(out.state.cond_bits)
+                    self._emit(kind, k, dirty, deleted, hb, now_str)
         elapsed = time.perf_counter() - t0
         with self._metrics_lock:
             self.metrics["nodes_managed"] = len(self.nodes.pool)
@@ -754,9 +823,11 @@ class ClusterEngine:
             if row_gone or (ip is None and m.get("cni_pending")):
                 return None  # deleted mid-setup / another worker mid-setup
         if not ip:
-            with self._ip_lock:  # check+allocate atomic across workers
+            with self._alloc_lock:  # check+allocate atomic across workers
                 ip = m.get("podIP")
                 if not ip:
+                    if k.pool.meta[idx] is not m:
+                        return None  # row deleted since this job was queued
                     ip = self.ippool.get()
                     m["podIP"] = ip
         return render_pod_status(
@@ -767,7 +838,7 @@ class ClusterEngine:
         """Allocate a pod IP through the CNI provider.
 
         Returns (ip, row_gone). The provider call runs OUTSIDE every lock (it
-        may block on netns/network I/O); _cni_lock only guards the
+        may block on netns/network I/O); _alloc_lock only guards the
         pending-flag and the liveness-checked commit, so a deletion racing
         with setup either sees the committed `cni` flag (and removes) or the
         commit sees the released row (and undoes its own allocation).
@@ -775,7 +846,7 @@ class ClusterEngine:
         ns = m.get("namespace") or "default"
         name = m.get("name") or ""
         uid = ((m.get("obj") or {}).get("metadata") or {}).get("uid") or ""
-        with self._cni_lock:
+        with self._alloc_lock:
             if m.get("podIP"):
                 return m["podIP"], False
             if m.get("cni_pending"):
@@ -787,7 +858,7 @@ class ClusterEngine:
             logger.exception("cni setup failed; falling back to IP pool")
             ips = None
         undo = False
-        with self._cni_lock:
+        with self._alloc_lock:
             m.pop("cni_pending", None)
             if not ips:
                 return None, self.pods.pool.meta[idx] is not m

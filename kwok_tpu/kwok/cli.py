@@ -62,7 +62,11 @@ def build_parser(defaults) -> argparse.ArgumentParser:
     p.add_argument("--initial-capacity", type=int, default=o.initialCapacity)
     p.add_argument("--use-mesh", type=_bool, default=o.useMesh,
                    help="shard cluster state across all local devices")
-    p.add_argument("-v", "--verbosity", type=int, default=0)
+    p.add_argument("--profile-dir", default="",
+                   help="write a JAX profiler trace of ticks 2-102 here")
+    from kwok_tpu import log
+
+    log.add_flags(p)
     return p
 
 
@@ -86,6 +90,7 @@ def _engine_config(args, stages: list[Stage]):
         parallelism=args.parallelism,
         initial_capacity=args.initial_capacity,
         use_mesh=args.use_mesh,
+        profile_dir=args.profile_dir,
         node_rules=stages_to_rules(stages, ResourceKind.NODE),
         pod_rules=stages_to_rules(stages, ResourceKind.POD),
     )

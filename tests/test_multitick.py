@@ -1,0 +1,84 @@
+"""MultiTickKernel == per-kind TickKernel; packed wire roundtrip; profiler."""
+
+import numpy as np
+
+from kwok_tpu.models import compile_rules, default_rules
+from kwok_tpu.models.lifecycle import ResourceKind
+from kwok_tpu.ops import TickKernel, new_row_state
+from kwok_tpu.ops.tick import MultiTickKernel, to_host, unpack_wire
+
+
+def _seed(n, seed):
+    rng = np.random.default_rng(seed)
+    st = new_row_state(n)
+    st.active[: n // 2] = True
+    st.phase[: n // 2] = rng.integers(0, 2, n // 2)
+    st.sel_bits[: n // 2] = rng.integers(0, 4, n // 2)
+    return st
+
+
+def test_multi_matches_single_kernels():
+    ntab = compile_rules(default_rules(), ResourceKind.NODE)
+    ptab = compile_rules(default_rules(), ResourceKind.POD)
+    nodes, pods = _seed(64, 0), _seed(256, 1)
+
+    multi = MultiTickKernel([(ntab, 30.0, (), 1), (ptab, 30.0, (), -1)])
+    # force identical RNG streams: the fused kernel folds (key, step, kind)
+    nk = TickKernel(ntab, hb_interval=30.0, hb_sel_bit=1)
+    pk = TickKernel(ptab)
+    import jax
+
+    nk._key = jax.random.fold_in(jax.random.fold_in(multi._key, 1), 0)
+    pk._key = jax.random.fold_in(jax.random.fold_in(multi._key, 1), 1)
+    nk._step = pk._step = -1  # so fold_in(key, 0) reproduces the fused keys
+
+    nout_m, pout_m = (to_host(o) for o in multi((nodes, pods), 0.0))
+    nout_s = to_host(nk(_seed(64, 0), 0.0))
+    pout_s = to_host(pk(_seed(256, 1), 0.0))
+
+    for m, s in ((nout_m, nout_s), (pout_m, pout_s)):
+        for f in ("phase", "cond_bits", "pending_rule", "gen"):
+            np.testing.assert_array_equal(
+                getattr(m.state, f), getattr(s.state, f), err_msg=f
+            )
+        np.testing.assert_array_equal(m.dirty, s.dirty)
+        assert int(m.transitions) == int(s.transitions)
+
+
+def test_packed_wire_roundtrip():
+    ntab = compile_rules(default_rules(), ResourceKind.NODE)
+    ptab = compile_rules(default_rules(), ResourceKind.POD)
+    nodes, pods = _seed(64, 2), _seed(200, 3)
+
+    packed = MultiTickKernel(
+        [(ntab, 30.0, (), 1), (ptab, 30.0, (), -1)], pack=True
+    )
+    (nout, pout), wire = packed((nodes, pods), 0.0)
+    counters, masks_fn = unpack_wire(np.asarray(wire), [64, 200])
+    masks = masks_fn()
+
+    assert int(counters[0]) == int(nout.transitions)
+    assert int(counters[1]) == int(pout.transitions)
+    assert int(counters[2]) == int(nout.heartbeats)
+    assert int(counters[3]) == int(pout.heartbeats)
+    for (d, dl, hb), out in zip(masks, (nout, pout)):
+        np.testing.assert_array_equal(d, np.asarray(out.dirty))
+        np.testing.assert_array_equal(dl, np.asarray(out.deleted))
+        np.testing.assert_array_equal(hb, np.asarray(out.hb_fired))
+
+
+def test_profiler_hook_writes_trace(tmp_path):
+    from kwok_tpu.engine import EngineConfig
+    from tests.fake_apiserver import FakeKube
+    from tests.test_engine import SyncEngine, make_node
+
+    eng = SyncEngine(
+        FakeKube(),
+        EngineConfig(
+            manage_all_nodes=True, initial_capacity=8, profile_dir=str(tmp_path)
+        ),
+    )
+    eng._q.put(("nodes", "ADDED", make_node("n0")))
+    eng.pump(105)
+    assert not getattr(eng, "_profiling", False), "trace not stopped"
+    assert any(tmp_path.rglob("*")), "no trace files written"
