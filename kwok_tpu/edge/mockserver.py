@@ -254,14 +254,66 @@ class HttpFakeApiserver:
         store: FakeKube | None = None,
         port: int = 0,
         address: str = "127.0.0.1",
+        audit_log_path: str | None = None,
     ) -> None:
         self.store = store or FakeKube()
+        self._audit_lock = threading.Lock()
+        self._audit_file = None
         handler = self._make_handler()
-        self.httpd = _Server((address, port), handler)
+        self.httpd = _Server((address, port), handler)  # bind before open:
+        # a bind failure must not leak the audit file handle
+        if audit_log_path:
+            try:
+                self._audit_file = open(audit_log_path, "a", encoding="utf-8")
+            except OSError:
+                self.httpd.server_close()
+                raise
         self.port = self.httpd.server_address[1]
         host = "127.0.0.1" if address in ("", "0.0.0.0") else address
         self.url = f"http://{host}:{self.port}"
         self._thread: threading.Thread | None = None
+
+    @staticmethod
+    def _audit_verb(method: str, uri: str) -> str:
+        """HTTP method + URI -> Kubernetes audit verb (get/list/watch/
+        create/update/patch/delete), matching real apiserver audit Events."""
+        method = method.upper()
+        parsed = urllib.parse.urlparse(uri)
+        if method == "GET":
+            q = urllib.parse.parse_qs(parsed.query)
+            if (q.get("watch") or ["false"])[0] in ("true", "1"):
+                return "watch"
+            m = _PATHS.match(parsed.path)
+            if m and not m.group("name"):
+                return "list"
+            return "get"
+        return {
+            "POST": "create",
+            "PUT": "update",
+            "PATCH": "patch",
+            "DELETE": "delete",
+        }.get(method, method.lower())
+
+    def _audit(self, method: str, uri: str, code: int) -> None:
+        """One audit.k8s.io/v1 Event line per request (the mock analogue of
+        the apiserver's --audit-log-path; asserted by the audit e2e case)."""
+        if self._audit_file is None:
+            return
+        line = json.dumps(
+            {
+                "kind": "Event",
+                "apiVersion": "audit.k8s.io/v1",
+                "level": "Metadata",
+                "stage": "ResponseComplete",
+                "verb": self._audit_verb(method, uri),
+                "requestURI": uri,
+                "responseStatus": {"code": code},
+                "stageTimestamp": now_rfc3339(),
+            }
+        )
+        with self._audit_lock:
+            self._audit_file.write(line + "\n")
+            self._audit_file.flush()
 
     def start(self):
         self._thread = threading.Thread(
@@ -275,15 +327,24 @@ class HttpFakeApiserver:
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._audit_file is not None:
+            self._audit_file.close()
 
     def _make_handler(self):
         store = self.store
+        server_obj = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
             def log_message(self, *a):
                 pass
+
+            def log_request(self, code="-", size="-"):  # noqa: A002
+                try:
+                    server_obj._audit(self.command or "", self.path, int(code))
+                except Exception:
+                    pass
 
             def _send_json(self, obj, code=200):
                 body = json.dumps(obj).encode()
@@ -413,13 +474,22 @@ def main(argv=None) -> int:
         "published ports)",
     )
     p.add_argument(
+        "--audit-log",
+        default="",
+        help="append one audit.k8s.io/v1 Event JSON line per request here",
+    )
+    p.add_argument(
         "--data-file",
         default="",
         help="persist the store here across restarts (the mock's etcd "
         "data dir): loaded at startup, written on shutdown",
     )
     args = p.parse_args(argv)
-    srv = HttpFakeApiserver(port=args.port, address=args.address)
+    srv = HttpFakeApiserver(
+        port=args.port,
+        address=args.address,
+        audit_log_path=args.audit_log or None,
+    )
     if args.data_file:
         try:
             with open(args.data_file) as f:

@@ -39,8 +39,8 @@ from kwok_tpu.edge.render import now_rfc3339
 from kwok_tpu.engine.engine import ClusterEngine, EngineConfig
 from kwok_tpu.models.defaults import SEL_HEARTBEAT
 from kwok_tpu.ops.state import RowState, new_row_state
-from kwok_tpu.ops.tick import to_host
-from kwok_tpu.parallel import ShardedTickKernel, make_mesh
+from kwok_tpu.ops.tick import MultiTickKernel, prefetch, to_host, unpack_wire
+from kwok_tpu.parallel import make_mesh
 
 logger = logging.getLogger("kwok_tpu.federation")
 
@@ -77,15 +77,18 @@ class FederatedEngine:
             self.engines.append(ClusterEngine(client, cfg))
 
         e0 = self.engines[0]
-        # One kernel per kind; the rule table is e0's (all members share it).
+        # ONE fused kernel for both kinds across the whole stacked state
+        # (rule tables are e0's — all members share them): one dispatch and
+        # one packed-wire D2H per federated tick (ops/tick.MultiTickKernel).
         hb_bit = e0.node_bits[SEL_HEARTBEAT]
-        self._node_kernel = ShardedTickKernel(
-            e0.nodes.table,
+        self._fused = MultiTickKernel(
+            [
+                (e0.nodes.table, config.heartbeat_interval, (), hb_bit),
+                (e0.pods.table, config.heartbeat_interval, (), -1),
+            ],
             mesh=self.mesh,
-            hb_interval=config.heartbeat_interval,
-            hb_sel_bit=hb_bit,
+            pack=True,
         )
-        self._pod_kernel = ShardedTickKernel(e0.pods.table, mesh=self.mesh)
 
         # Shared engine epoch so one `now` is correct for every member.
         self._epoch = time.time()
@@ -94,10 +97,9 @@ class FederatedEngine:
 
         cap = self.cluster_capacity * n
         self._stacked: dict[str, RowState] = {
-            "nodes": self._node_kernel.place(new_row_state(cap)),
-            "pods": self._pod_kernel.place(new_row_state(cap)),
+            "nodes": self._fused.place(new_row_state(cap)),
+            "pods": self._fused.place(new_row_state(cap)),
         }
-        self._kernels = {"nodes": self._node_kernel, "pods": self._pod_kernel}
 
         self.config = config
         self._running = False
@@ -174,9 +176,9 @@ class FederatedEngine:
         now = time.time() - self._epoch
         now_str = now_rfc3339()
         r = self.cluster_capacity
+        any_rows = False
         for kind in ("nodes", "pods"):
             state = self._stacked[kind]
-            any_rows = False
             for c, e in enumerate(self.engines):
                 k = e.nodes if kind == "nodes" else e.pods
                 if k.buffer.pending:
@@ -185,30 +187,35 @@ class FederatedEngine:
                 elif len(k.pool):
                     any_rows = True
             self._stacked[kind] = state
-            if not any_rows:
-                continue
-            out = self._kernels[kind](state, now)
-            self._stacked[kind] = out.state
-            n_trans = int(out.transitions)
-            n_hb = int(out.heartbeats)
-            if not (n_trans or n_hb):
-                continue
-            dirty = np.asarray(out.dirty)
-            deleted = np.asarray(out.deleted)
-            hb = np.asarray(out.hb_fired)
-            phase = np.asarray(out.state.phase)
-            cond = np.asarray(out.state.cond_bits)
-            for c, e in enumerate(self.engines):
-                k = e.nodes if kind == "nodes" else e.pods
-                lo, hi = c * r, (c + 1) * r
-                d_c, del_c, hb_c = dirty[lo:hi], deleted[lo:hi], hb[lo:hi]
-                trans_c = int(np.count_nonzero(d_c) + np.count_nonzero(del_c))
-                if trans_c:
-                    e._inc("transitions_total", trans_c)
-                if trans_c or hb_c.any():
-                    k.phase_h = phase[lo:hi].copy()
-                    k.cond_h = cond[lo:hi].copy()
-                    e._emit(kind, k, d_c, del_c, hb_c, now_str)
+        if any_rows:
+            (nout, pout), wire = self._fused(
+                (self._stacked["nodes"], self._stacked["pods"]), now
+            )
+            self._stacked["nodes"] = nout.state
+            self._stacked["pods"] = pout.state
+            prefetch(wire)
+            cap = r * len(self.engines)
+            counters, masks_fn = unpack_wire(np.asarray(wire), [cap, cap])
+            masks = masks_fn() if counters.any() else None
+            for i, (kind, out) in enumerate((("nodes", nout), ("pods", pout))):
+                if not (int(counters[i]) or int(counters[2 + i])):
+                    continue
+                dirty, deleted, hb = masks[i]
+                phase = np.asarray(out.state.phase)
+                cond = np.asarray(out.state.cond_bits)
+                for c, e in enumerate(self.engines):
+                    k = e.nodes if kind == "nodes" else e.pods
+                    lo, hi = c * r, (c + 1) * r
+                    d_c, del_c, hb_c = dirty[lo:hi], deleted[lo:hi], hb[lo:hi]
+                    trans_c = int(
+                        np.count_nonzero(d_c) + np.count_nonzero(del_c)
+                    )
+                    if trans_c:
+                        e._inc("transitions_total", trans_c)
+                    if trans_c or hb_c.any():
+                        k.phase_h = phase[lo:hi].copy()
+                        k.cond_h = cond[lo:hi].copy()
+                        e._emit(kind, k, d_c, del_c, hb_c, now_str)
         elapsed = time.perf_counter() - t0
         for e in self.engines:
             with e._metrics_lock:
@@ -243,7 +250,7 @@ class FederatedEngine:
                     getattr(stacked, f)[c * new_r : c * new_r + old_r] = getattr(
                         host, f
                     )[c * old_r : (c + 1) * old_r]
-            self._stacked[kind] = self._kernels[kind].place(stacked)
+            self._stacked[kind] = self._fused.place(stacked)
         self.cluster_capacity = new_r
 
     # --------------------------------------------------------------- metrics

@@ -56,22 +56,39 @@ class MockCluster(BinaryCluster):
         os.chmod(shim, os.stat(shim).st_mode | stat.S_IEXEC | stat.S_IXGRP | stat.S_IXOTH)
 
     def _setup_workdir(self) -> None:
+        conf = self.config().options
         os.makedirs(self.workdir_path("logs"), exist_ok=True)
+        if conf.kubeAuditPolicy:
+            # same audit setup as the binary runtime (binary.py
+            # _setup_workdir): policy copied into the workdir, log
+            # pre-created so `kwokctl audit-logs` works before the
+            # apiserver's first write
+            import shutil
+
+            shutil.copyfile(
+                conf.kubeAuditPolicy, self.workdir_path(base.AUDIT_POLICY_NAME)
+            )
+            open(self.log_path(base.AUDIT_LOG_NAME), "a").close()
 
     def _build_components(self) -> None:
         config = self.config()
         conf = config.options
         kubeconfig = self.workdir_path(base.IN_HOST_KUBECONFIG_NAME)
+        args = [
+            f"--port={conf.kubeApiserverPort}",
+            f"--address={conf.bindAddress}",
+            # the mock's etcd data dir: store survives stop/start
+            f"--data-file={self.workdir_path('apiserver-state.json')}",
+        ]
+        if conf.kubeAuditPolicy:
+            # policy/log files are prepared by _setup_workdir; the mock
+            # apiserver emits audit.k8s.io/v1 Event lines per request
+            args.append(f"--audit-log={self.log_path(base.AUDIT_LOG_NAME)}")
         apiserver = Component(
             name="kube-apiserver",
             binary=self.bin_path("kube-apiserver"),
             workDir=self.workdir,
-            args=[
-                f"--port={conf.kubeApiserverPort}",
-                f"--address={conf.bindAddress}",
-                # the mock's etcd data dir: store survives stop/start
-                f"--data-file={self.workdir_path('apiserver-state.json')}",
-            ],
+            args=args,
         )
         kwok = comp.build_kwok_controller(
             binary=self.bin_path("kwok-controller"),

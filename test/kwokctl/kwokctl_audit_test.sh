@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Port of the reference's `audit` case: create cluster with an audit policy,
+# exercise the API, assert audit log lines exist and cover the requests.
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+CLUSTER="e2e-audit"
+POLICY="$(mktemp)"
+cleanup() {
+  kwokctl --name "${CLUSTER}" delete cluster >/dev/null 2>&1 || true
+  rm -f "${POLICY}"
+}
+trap cleanup EXIT
+
+cat > "${POLICY}" <<'EOF'
+apiVersion: audit.k8s.io/v1
+kind: Policy
+rules:
+  - level: Metadata
+EOF
+
+kwokctl --name "${CLUSTER}" create cluster --runtime mock \
+  --kube-audit-policy "${POLICY}" --wait 60s
+URL="$(apiserver_url "${CLUSTER}")"
+
+create_node "${URL}" audit-node
+retry 60 node_is_ready "${URL}" audit-node
+
+AUDIT="$(kwokctl --name "${CLUSTER}" audit-logs)"
+echo "${AUDIT}" | head -3
+echo "${AUDIT}" | grep -q '"kind": "Event"'
+echo "${AUDIT}" | grep -q '"verb": "create"'      # our node create
+echo "${AUDIT}" | grep -q '"verb": "watch"'       # the engine's watch
+echo "${AUDIT}" | grep -q '"verb": "patch"'       # the engine's status patch
+
+echo "kwokctl_audit_test.sh passed"

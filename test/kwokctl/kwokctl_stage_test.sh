@@ -1,0 +1,87 @@
+#!/usr/bin/env bash
+# Custom Stage lifecycle end-to-end: a user-provided Stage document (the
+# selector/delay/next rule API) flows kwokctl --config -> cluster workdir ->
+# kwok engine -> compiled rule table -> observable phase transition.
+# Here: running pods "complete" to Succeeded after ~1s.
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+CLUSTER="e2e-stage"
+CONF="$(mktemp)"
+cleanup() {
+  kwokctl --name "${CLUSTER}" delete cluster >/dev/null 2>&1 || true
+  rm -f "${CONF}"
+}
+trap cleanup EXIT
+
+# Stages REPLACE the built-in rule set for their resource (upstream kwok
+# semantics: Stage documents fully define the lifecycle), so the config
+# carries the whole pod lifecycle: delete -> ready -> complete.
+cat > "${CONF}" <<'EOF'
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata:
+  name: pod-delete
+spec:
+  resourceRef:
+    kind: Pod
+  selector:
+    matchSelector: on-managed-node
+    matchDeletion: present
+    matchPhases: ["Pending", "Running", "Succeeded", "Failed", "Terminating"]
+  next:
+    delete: true
+---
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata:
+  name: pod-ready
+spec:
+  resourceRef:
+    kind: Pod
+  selector:
+    matchPhases: ["Pending"]
+  next:
+    phase: Running
+    conditions:
+      Initialized: true
+      Ready: true
+      ContainersReady: true
+---
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata:
+  name: pod-complete
+spec:
+  resourceRef:
+    kind: Pod
+  selector:
+    matchPhases: ["Running"]
+  delay:
+    duration: 1s
+  next:
+    phase: Succeeded
+    conditions:
+      Ready: false
+      ContainersReady: false
+EOF
+
+kwokctl --name "${CLUSTER}" create cluster --runtime mock \
+  --config "${CONF}" --wait 60s
+URL="$(apiserver_url "${CLUSTER}")"
+
+create_node "${URL}" stage-node
+retry 30 node_is_ready "${URL}" stage-node
+create_pod "${URL}" default stage-pod stage-node
+
+pod_phase_is() { # URL NS NAME PHASE
+  [ "$(curl -fsS "$1/api/v1/namespaces/$2/pods/$3" | pyrun -c '
+import json, sys; print((json.load(sys.stdin).get("status") or {}).get("phase",""))
+')" = "$4" ]
+}
+
+# default stages make it Running; the custom stage then completes it
+retry 30 pod_phase_is "${URL}" default stage-pod Succeeded
+
+echo "kwokctl_stage_test.sh passed"
