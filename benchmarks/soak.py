@@ -1,0 +1,173 @@
+"""Scheduler-soak rig (BASELINE.json config 4: "kube-scheduler soak:
+50k Pending pods x 10k nodes").
+
+Measures END-TO-END simulated-kubelet throughput over the real HTTP path:
+create N fake nodes, pour in M unbound pods, bind them (a built-in
+round-robin binder stands in for kube-scheduler when no external scheduler
+is attached — pass --no-bind when a real scheduler owns binding), and time
+until the engine has driven every pod to Running. This exercises the whole
+watch -> device tick -> strategic-merge patch egress loop that bench.py's
+device-only number excludes (SURVEY.md "Hard parts": the watch/patch edge,
+not the math, is the bottleneck).
+
+Usage (self-contained, in-process apiserver + engine over real sockets):
+    python benchmarks/soak.py --nodes 1000 --pods 10000
+Against an existing cluster (real kube-scheduler does the binding):
+    python benchmarks/soak.py --apiserver http://HOST:PORT --no-bind ...
+
+Prints ONE JSON line with pods/s to Running and engine metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the rig measures the HTTP edge, not device math — default to CPU JAX so a
+# bare run never claims the (single, tunneled) TPU chip; export
+# JAX_PLATFORMS=tpu explicitly to bench the device path end to end
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+def _post(url: str, path: str, obj: dict) -> None:
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + path,
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    urllib.request.urlopen(req).read()
+
+
+def _patch_spec(url: str, ns: str, name: str, node: str) -> None:
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{url}/api/v1/namespaces/{ns}/pods/{name}",
+        data=json.dumps({"spec": {"nodeName": node}}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="PATCH",
+    )
+    urllib.request.urlopen(req).read()
+
+
+def _count(url: str, path: str, pred) -> int:
+    import urllib.request
+
+    with urllib.request.urlopen(url + path) as r:
+        items = json.loads(r.read())["items"]
+    return sum(1 for o in items if pred(o))
+
+
+def _running(o: dict) -> bool:
+    return (o.get("status") or {}).get("phase") == "Running"
+
+
+def _ready(o: dict) -> bool:
+    return any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in (o.get("status") or {}).get("conditions") or []
+    )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=1000)
+    p.add_argument("--pods", type=int, default=10000)
+    p.add_argument("--apiserver", default="", help="existing cluster URL")
+    p.add_argument("--no-bind", action="store_true",
+                   help="an external scheduler binds; just create and wait")
+    p.add_argument("--workers", type=int, default=32)
+    p.add_argument("--timeout", type=float, default=600.0)
+    args = p.parse_args()
+
+    engine = srv = None
+    if args.apiserver:
+        url = args.apiserver
+    else:
+        from kwok_tpu.edge.httpclient import HttpKubeClient
+        from kwok_tpu.edge.mockserver import HttpFakeApiserver
+        from kwok_tpu.engine import ClusterEngine, EngineConfig
+
+        srv = HttpFakeApiserver().start()
+        url = srv.url
+        engine = ClusterEngine(
+            HttpKubeClient.from_kubeconfig(None, url),
+            EngineConfig(
+                manage_all_nodes=True,
+                tick_interval=0.02,
+                parallelism=64,
+                initial_capacity=max(args.pods, args.nodes, 4096),
+            ),
+        )
+        engine.start()
+
+    pool = ThreadPoolExecutor(max_workers=args.workers)
+
+    # --- nodes -> Ready ----------------------------------------------------
+    t_nodes = time.perf_counter()
+    list(pool.map(
+        lambda i: _post(url, "/api/v1/nodes", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"soak-node-{i}"},
+        }),
+        range(args.nodes),
+    ))
+    deadline = time.monotonic() + args.timeout
+    poll = max(0.25, min(2.0, args.pods / 20000))
+    while _count(url, "/api/v1/nodes", _ready) < args.nodes:
+        if time.monotonic() > deadline:
+            raise SystemExit("timeout waiting for nodes Ready")
+        time.sleep(poll)
+    nodes_s = time.perf_counter() - t_nodes
+
+    # --- pods: create (Pending, unbound) -> bind -> Running ----------------
+    t_pods = time.perf_counter()
+
+    def create_pod(i: int) -> None:
+        _post(url, "/api/v1/namespaces/default/pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"soak-pod-{i}", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "soak"}]},
+            "status": {"phase": "Pending"},
+        })
+        if not args.no_bind:  # round-robin binder (kube-scheduler stand-in)
+            _patch_spec(url, "default", f"soak-pod-{i}",
+                        f"soak-node-{i % args.nodes}")
+
+    list(pool.map(create_pod, range(args.pods)))
+    while _count(url, "/api/v1/pods", _running) < args.pods:
+        if time.monotonic() > deadline:
+            raise SystemExit("timeout waiting for pods Running")
+        time.sleep(poll)
+    pods_s = time.perf_counter() - t_pods
+
+    out = {
+        "metric": (
+            f"e2e soak: {args.pods} pods x {args.nodes} nodes over HTTP "
+            "(create+bind -> Running)"
+        ),
+        "pods_per_s": round(args.pods / pods_s, 1),
+        "pods_elapsed_s": round(pods_s, 2),
+        "nodes_per_s": round(args.nodes / nodes_s, 1),
+        "nodes_elapsed_s": round(nodes_s, 2),
+    }
+    if engine is not None:
+        m = engine.metrics
+        out["status_patches_total"] = m["status_patches_total"]
+        out["transitions_total"] = m["transitions_total"]
+        engine.stop()
+    if srv is not None:
+        srv.stop()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import atexit
 import base64
+import http.client
 import json
 import logging
 import os
@@ -64,6 +65,19 @@ class HttpKubeClient:
         self.server = server.rstrip("/")
         self.token = token
         self.timeout = timeout
+        # per-thread persistent connections for unary requests (keep-alive):
+        # a new TCP (+TLS) handshake per status patch would dominate the
+        # egress at high transition rates (SURVEY.md "Hard parts":
+        # connection pooling on the watch/patch edge)
+        self._local = threading.local()
+        split = urllib.parse.urlsplit(self.server)
+        self._host = split.hostname or "127.0.0.1"
+        self._port = split.port
+        # server URLs may carry a base path (proxy-style clusters); unary
+        # requests must keep it when extracting the path from a full URL
+        self._base_path = split.path.rstrip("/")
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         ctx: ssl.SSLContext | None = None
         if self.server.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
@@ -162,6 +176,34 @@ class HttpKubeClient:
             req, context=self._ctx, timeout=timeout or self.timeout
         )
 
+    def _conn(self):
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            if self.server.startswith("https"):
+                c = http.client.HTTPSConnection(
+                    self._host, self._port, context=self._ctx,
+                    timeout=self.timeout,
+                )
+            else:
+                c = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self.timeout
+                )
+            self._local.conn = c
+            with self._conns_lock:
+                self._conns.add(c)
+        return c
+
+    def close(self) -> None:
+        """Close every pooled keep-alive connection (all threads)."""
+        with self._conns_lock:
+            conns, self._conns = self._conns, set()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._local = threading.local()
+
     def _json(self, method: str, url: str, body: dict | bytes | None = None,
               content_type: str = "application/json") -> dict | None:
         # bytes-like bodies are pre-encoded JSON (native codec egress)
@@ -169,13 +211,36 @@ class HttpKubeClient:
             data = bytes(body)
         else:
             data = json.dumps(body).encode() if body is not None else None
-        try:
-            with self._request(method, url, data, content_type) as resp:
-                return json.loads(resp.read() or b"null")
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        path = (self._base_path + url[len(self.server):]) or "/"
+        headers = {"Accept": "application/json"}
+        if data is not None and content_type:
+            headers["Content-Type"] = content_type
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                status = resp.status
+                break
+            except (http.client.HTTPException, OSError):
+                # stale keep-alive connection; rebuild once, then give up
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                self._local.conn = None
+                if attempt:
+                    raise
+        if status == 404:
+            return None
+        if status >= 400:
+            raise urllib.error.HTTPError(
+                url, status, payload.decode(errors="replace"), None, None
+            )
+        return json.loads(payload or b"null")
 
     # ------------------------------------------------------------- KubeClient
 

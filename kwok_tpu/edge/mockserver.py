@@ -165,18 +165,24 @@ class FakeKube:
             return copy.deepcopy(obj)
 
     def patch_meta(self, kind, namespace, name, patch):
+        """Merge-patch metadata (and spec — covers the scheduler's pod
+        binding, which the soak rig's binder issues as a spec.nodeName
+        patch; real schedulers use POST .../binding to the same effect)."""
         with self._lock:
             key = self._key(namespace, name)
             obj = self._store[kind].get(key)
             if obj is None:
                 return None
-            meta_patch = (patch or {}).get("metadata", {})
-            meta = obj.setdefault("metadata", {})
-            for k, v in meta_patch.items():
-                if v is None:
-                    meta.pop(k, None)
-                else:
-                    meta[k] = copy.deepcopy(v)
+            for section in ("metadata", "spec"):
+                sec_patch = (patch or {}).get(section)
+                if not sec_patch:
+                    continue
+                sec = obj.setdefault(section, {})
+                for k, v in sec_patch.items():
+                    if v is None:
+                        sec.pop(k, None)
+                    else:
+                        sec[k] = copy.deepcopy(v)
             self._bump(obj)
             self._emit(kind, MODIFIED, obj)
             return copy.deepcopy(obj)

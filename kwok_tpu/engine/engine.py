@@ -53,7 +53,6 @@ from kwok_tpu.models.lifecycle import (
 from kwok_tpu.ops.state import RowState, grow as grow_state, new_row_state
 from kwok_tpu.ops.tick import (
     MultiTickKernel,
-    prefetch,
     to_host,
     unpack_wire,
 )
@@ -332,6 +331,9 @@ class ClusterEngine:
             t.join(timeout=5)
         if self._executor:
             self._executor.shutdown(wait=True)
+        close = getattr(self.client, "close", None)
+        if callable(close):  # release pooled keep-alive connections
+            close()
 
     def _spawn_watch(self, kind: str, **sel) -> None:
         opts = {k: v for k, v in sel.items() if v}
@@ -687,7 +689,6 @@ class ClusterEngine:
             )
             self.nodes.state = nout.state
             self.pods.state = pout.state
-            prefetch(wire)
             # the whole tick summary (counters + bit-packed masks) in ONE
             # D2H transfer (latency is per-array on remote devices; bytes
             # are 1/8 of bool masks)

@@ -39,7 +39,7 @@ from kwok_tpu.edge.render import now_rfc3339
 from kwok_tpu.engine.engine import ClusterEngine, EngineConfig
 from kwok_tpu.models.defaults import SEL_HEARTBEAT
 from kwok_tpu.ops.state import RowState, new_row_state
-from kwok_tpu.ops.tick import MultiTickKernel, prefetch, to_host, unpack_wire
+from kwok_tpu.ops.tick import MultiTickKernel, to_host, unpack_wire
 from kwok_tpu.parallel import make_mesh
 
 logger = logging.getLogger("kwok_tpu.federation")
@@ -193,7 +193,6 @@ class FederatedEngine:
             )
             self._stacked["nodes"] = nout.state
             self._stacked["pods"] = pout.state
-            prefetch(wire)
             cap = r * len(self.engines)
             counters, masks_fn = unpack_wire(np.asarray(wire), [cap, cap])
             masks = masks_fn() if counters.any() else None

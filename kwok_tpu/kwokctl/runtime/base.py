@@ -210,6 +210,15 @@ class Cluster:
         self.get_component(name)  # raise if unknown
         self._cat(self.log_path(os.path.basename(name) + ".log"), out, follow)
 
+    def _setup_audit_files(self, policy_path: str) -> None:
+        """Copy the audit policy into the workdir and pre-create the log so
+        `audit-logs` works before the apiserver's first write (shared by the
+        binary and mock runtimes)."""
+        import shutil
+
+        shutil.copyfile(policy_path, self.workdir_path(AUDIT_POLICY_NAME))
+        open(self.log_path(AUDIT_LOG_NAME), "a").close()
+
     def audit_logs(self, out, follow: bool = False) -> None:
         self._cat(self.log_path(AUDIT_LOG_NAME), out, follow)
 

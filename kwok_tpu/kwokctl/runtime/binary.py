@@ -110,12 +110,7 @@ class BinaryCluster(Cluster):
         os.makedirs(self.workdir_path(base.ETCD_DATA_DIR_NAME), exist_ok=True)
         os.makedirs(self.workdir_path("logs"), exist_ok=True)
         if conf.kubeAuditPolicy:
-            import shutil
-
-            shutil.copyfile(
-                conf.kubeAuditPolicy, self.workdir_path(base.AUDIT_POLICY_NAME)
-            )
-            open(self.log_path(base.AUDIT_LOG_NAME), "a").close()
+            self._setup_audit_files(conf.kubeAuditPolicy)
 
     def _setup_ports(self) -> None:
         conf = self.config().options

@@ -59,16 +59,7 @@ class MockCluster(BinaryCluster):
         conf = self.config().options
         os.makedirs(self.workdir_path("logs"), exist_ok=True)
         if conf.kubeAuditPolicy:
-            # same audit setup as the binary runtime (binary.py
-            # _setup_workdir): policy copied into the workdir, log
-            # pre-created so `kwokctl audit-logs` works before the
-            # apiserver's first write
-            import shutil
-
-            shutil.copyfile(
-                conf.kubeAuditPolicy, self.workdir_path(base.AUDIT_POLICY_NAME)
-            )
-            open(self.log_path(base.AUDIT_LOG_NAME), "a").close()
+            self._setup_audit_files(conf.kubeAuditPolicy)
 
     def _build_components(self) -> None:
         config = self.config()

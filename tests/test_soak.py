@@ -1,0 +1,36 @@
+"""Soak rig smoke (benchmarks/soak.py) + pod-binding spec patch."""
+
+import json
+import subprocess
+import sys
+
+from kwok_tpu.edge.mockserver import FakeKube
+
+
+def test_patch_meta_merges_spec_for_binding():
+    kube = FakeKube()
+    kube.create("pods", {"metadata": {"name": "p", "namespace": "d"},
+                         "spec": {"containers": []}})
+    w = kube.watch("pods", field_selector="spec.nodeName!=")
+    kube.patch_meta("pods", "d", "p", {"spec": {"nodeName": "n0"}})
+    pod = kube.get("pods", "d", "p")
+    assert pod["spec"]["nodeName"] == "n0"
+    assert pod["spec"]["containers"] == []  # merge, not replace
+    ev = w.q.get_nowait()  # binding made it match the engine's selector
+    assert ev.object["spec"]["nodeName"] == "n0"
+
+
+def test_soak_smoke():
+    import os
+
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "soak.py"),
+         "--nodes", "5", "--pods", "40", "--timeout", "120"],
+        capture_output=True, text=True, timeout=300, check=True, env=env,
+    )
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["pods_per_s"] > 0
+    assert result["transitions_total"] >= 45  # 5 nodes + 40 pods
