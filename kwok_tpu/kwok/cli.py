@@ -139,12 +139,29 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
     from kwok_tpu.engine import ClusterEngine
     from kwok_tpu.kwok.server import EngineServer
 
-    client = HttpKubeClient.from_kubeconfig(
-        args.kubeconfig or None, args.master or None
-    )
-    wait_for_apiserver(client)
+    # --master takes a comma-separated list: N apiservers federate onto one
+    # stacked mesh-sharded tick (BASELINE config 5, engine/federation.py)
+    masters = [m.strip() for m in (args.master or "").split(",") if m.strip()]
+    if len(masters) > 1:
+        from kwok_tpu.engine import FederatedEngine
 
-    engine = ClusterEngine(client, _engine_config(args, stages))
+        clients = [
+            HttpKubeClient.from_kubeconfig(args.kubeconfig or None, m)
+            for m in masters
+        ]
+        # wait for all members concurrently: startup is bounded by ONE
+        # backoff window, not N sequential ones
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(clients)) as pool:
+            list(pool.map(wait_for_apiserver, clients))
+        engine = FederatedEngine(clients, _engine_config(args, stages))
+    else:
+        client = HttpKubeClient.from_kubeconfig(
+            args.kubeconfig or None, masters[0] if masters else None
+        )
+        wait_for_apiserver(client)
+        engine = ClusterEngine(client, _engine_config(args, stages))
     server = None
     if args.server_address:
         server = EngineServer(engine, args.server_address)

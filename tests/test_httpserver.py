@@ -111,3 +111,50 @@ def test_tpukwok_cli_end_to_end(api, tmp_path):
     conds = {c["type"]: c["status"] for c in node["status"]["conditions"]}
     assert conds["Ready"] == "True"
     assert api.store.get("pods", "default", "cli-pod")["status"]["phase"] == "Running"
+
+
+def test_tpukwok_cli_federated(tmp_path):
+    """--master with a comma-separated list federates N apiservers onto one
+    stacked tick (BASELINE config 5 through the real CLI over sockets)."""
+    from kwok_tpu.kwok.cli import main
+
+    apis = [HttpFakeApiserver().start() for _ in range(2)]
+    try:
+        stop = threading.Event()
+        rc = []
+        t = threading.Thread(
+            target=lambda: rc.append(main([
+                "--master", ",".join(a.url for a in apis),
+                "--kubeconfig", str(tmp_path / "nope"),
+                "--manage-all-nodes", "true",
+                "--tick-interval", "0.02",
+                "--server-address", "127.0.0.1:0",
+                "--config", str(tmp_path / "absent.yaml"),
+            ], stop_event=stop)),
+            daemon=True,
+        )
+        t.start()
+        for i, a in enumerate(apis):
+            a.store.create("nodes", make_node(f"fed-node-{i}"))
+            a.store.create("pods", make_pod(f"fed-pod-{i}", node=f"fed-node-{i}"))
+        deadline = time.time() + 30
+        def all_running():
+            for i, a in enumerate(apis):
+                pod = a.store.get("pods", "default", f"fed-pod-{i}")
+                if not pod or (pod.get("status") or {}).get("phase") != "Running":
+                    return False
+            return True
+        while time.time() < deadline and not all_running():
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=15)
+        assert rc == [0]
+        assert all_running()
+        # isolation: each member only ever saw its own objects
+        for i, a in enumerate(apis):
+            assert [n["metadata"]["name"] for n in a.store.list("nodes")] == [
+                f"fed-node-{i}"
+            ]
+    finally:
+        for a in apis:
+            a.stop()
