@@ -15,6 +15,7 @@ reference while the engine itself runs JAX.
 
 from __future__ import annotations
 
+import logging
 import os
 import stat
 import subprocess
@@ -27,6 +28,8 @@ from kwok_tpu.kwokctl.runtime import base
 from kwok_tpu.kwokctl.runtime.base import Cluster
 
 LOCAL = "127.0.0.1"
+
+logger = logging.getLogger("kwok_tpu.kwokctl.binary")
 
 
 class BinaryCluster(Cluster):
@@ -83,8 +86,26 @@ class BinaryCluster(Cluster):
                     quiet=quiet,
                 )
         self._write_kwok_shim()
+        self._verify_versions()
 
-    def _write_kwok_shim(self) -> None:
+    def _verify_versions(self) -> None:
+        """Probe `<bin> --version` on the fetched control-plane binaries and
+        warn when a custom binary disagrees with the configured version —
+        version-keyed arg matrices (feature gates, etcd prefix) would be
+        wrong (pkg/utils/version ParseFromBinary usage)."""
+        from kwok_tpu.kwokctl import version as verlib
+
+        conf = self.config().options
+        detected = verlib.parse_from_binary(self.bin_path("kube-apiserver"))
+        if detected and conf.kubeVersion and not detected.startswith(
+            conf.kubeVersion.split("-")[0]
+        ):
+            logger.warning(
+                "kube-apiserver reports %s but the cluster is configured "
+                "for %s; version-keyed defaults may not match",
+                detected,
+                conf.kubeVersion,
+            )
         """The engine 'binary': a generated script running this package's
         kwok CLI under the installing interpreter (with its module paths
         baked in, so it works however the orchestrator was launched)."""

@@ -1,0 +1,50 @@
+"""Component version detection (pkg/utils/version/version.go:55-104 parity).
+
+The reference learns a component's real version by running `<binary>
+--version` (ParseFromBinary) or reading an image tag (ParseFromImage), so
+version-keyed arg matrices stay correct when users supply custom binaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import subprocess
+
+logger = logging.getLogger("kwok_tpu.kwokctl.version")
+
+_VERSION_RE = re.compile(r"v?(\d+\.\d+\.\d+(?:-[0-9A-Za-z.+-]+)?)")
+
+
+def parse_from_output(text: str) -> str | None:
+    """First semantic version in arbitrary `--version` output
+    (handles `Kubernetes v1.26.0`, `etcd Version: 3.5.6`, bare `v1.2.3`)."""
+    m = _VERSION_RE.search(text or "")
+    return "v" + m.group(1) if m else None
+
+
+def parse_from_binary(path: str, timeout: float = 10.0) -> str | None:
+    """Run `<path> --version` and parse (version.go:55-78). Returns None for
+    missing/unrunnable binaries or unparseable output."""
+    try:
+        out = subprocess.run(
+            [path, "--version"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.debug("version probe of %s failed: %s", path, e)
+        return None
+    return parse_from_output(out.stdout + "\n" + out.stderr)
+
+
+def parse_from_image(image: str) -> str | None:
+    """Version from an image tag (version.go:80-104): text after the last
+    ':' that is not part of a registry port."""
+    if not image:
+        return None
+    tag = image.rsplit(":", 1)
+    if len(tag) != 2 or "/" in tag[1]:
+        return None
+    return parse_from_output(tag[1])

@@ -1,0 +1,30 @@
+"""Version detection (pkg/utils/version ParseFromBinary/Image parity)."""
+
+import os
+import stat
+
+from kwok_tpu.kwokctl import version as v
+
+
+def test_parse_from_output():
+    assert v.parse_from_output("Kubernetes v1.26.0") == "v1.26.0"
+    assert v.parse_from_output("etcd Version: 3.5.6\nGit SHA: x") == "v3.5.6"
+    assert v.parse_from_output("v1.2.3-alpha.1") == "v1.2.3-alpha.1"
+    assert v.parse_from_output("junk") is None
+    assert v.parse_from_output("") is None
+
+
+def test_parse_from_image():
+    assert v.parse_from_image("registry.k8s.io/kube-apiserver:v1.26.0") == "v1.26.0"
+    assert v.parse_from_image("etcd:3.5.6-0") == "v3.5.6-0"
+    assert v.parse_from_image("localhost:5000/img") is None
+    assert v.parse_from_image("no-tag") is None
+    assert v.parse_from_image("") is None
+
+
+def test_parse_from_binary(tmp_path):
+    p = tmp_path / "fake-apiserver"
+    p.write_text("#!/bin/sh\necho Kubernetes v1.25.3\n")
+    os.chmod(p, os.stat(p).st_mode | stat.S_IEXEC)
+    assert v.parse_from_binary(str(p)) == "v1.25.3"
+    assert v.parse_from_binary(str(tmp_path / "missing")) is None
