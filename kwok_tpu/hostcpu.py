@@ -1,0 +1,44 @@
+"""Force JAX onto the host-CPU platform with N virtual devices.
+
+Single home for the guard used by tests/conftest.py and
+__graft_entry__.dryrun_multichip: multi-chip TPU hardware is unavailable, so
+sharding correctness runs on XLA's host platform with virtual devices (same
+program, same collectives). The axon TPU plugin registers itself with a
+priority that outranks env-level platform selection, so the env vars alone
+are not enough — ``jax.config.update("jax_platforms", "cpu")`` wins over the
+plugin's registration.
+
+Import-light on purpose: importing this module pulls in nothing; jax is only
+imported inside the function, and the env vars are set before that import so
+they apply regardless of import order elsewhere.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_devices(n_devices: int = 8) -> None:
+    """Must run before any JAX backend initialisation (first ``jax.devices()``
+    or trace). Rewrites any pre-existing device-count pin in XLA_FLAGS rather
+    than silently keeping a stale value."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    pin = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", pin, flags
+        )
+    else:
+        flags = (flags + " " + pin).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.device_count() < n_devices:
+        raise RuntimeError(
+            f"JAX backend already initialised with {jax.device_count()} "
+            f"devices; force_cpu_devices({n_devices}) must run first"
+        )

@@ -209,11 +209,37 @@ def cmd_get(args) -> int:
     return 0
 
 
+# Read-only verbs that stream to stdout: a downstream reader closing the pipe
+# early (`kwokctl get ... | grep -q`) means "got what I needed", not failure.
+_PIPE_TOLERANT_VERBS = frozenset({"get", "logs", "audit-logs"})
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from kwok_tpu import log
 
     log.setup(args.verbosity)
+    try:
+        rc = _dispatch(args)
+        # Flush inside the try: with a block-buffered pipe the EPIPE often
+        # only surfaces here (or at interpreter-exit teardown, where it
+        # becomes an unhandled "Exception ignored" + exit 120).
+        sys.stdout.flush()
+        return rc
+    except BrokenPipeError:
+        # Point stdout at devnull so interpreter-exit flush stays quiet.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        if args.verb in _PIPE_TOLERANT_VERBS:
+            return 0
+        # A mutating verb (snapshot, create, kubectl passthrough) may raise
+        # BrokenPipeError from a network socket, not stdout — never report
+        # success. 141 = shell convention for death-by-SIGPIPE.
+        print(f"kwokctl {args.verb}: broken pipe", file=sys.stderr)
+        return 141
+
+
+def _dispatch(args) -> int:
     verb = args.verb
     if verb == "create":
         return cmd_create(args)

@@ -106,6 +106,8 @@ class BinaryCluster(Cluster):
                 detected,
                 conf.kubeVersion,
             )
+
+    def _write_kwok_shim(self) -> None:
         """The engine 'binary': a generated script running this package's
         kwok CLI under the installing interpreter (with its module paths
         baked in, so it works however the orchestrator was launched)."""

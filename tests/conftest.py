@@ -1,26 +1,16 @@
-"""Test config: force an 8-device virtual CPU mesh before jax imports.
+"""Test config: force an 8-device virtual CPU mesh before jax backend init.
 
 Multi-chip TPU hardware is unavailable in CI; sharding correctness is
 validated on XLA's host platform with 8 virtual devices (same program, same
-collectives), mirroring how the driver dry-runs the multi-chip path.
+collectives), mirroring how the driver dry-runs the multi-chip path. The
+guard lives in kwok_tpu.hostcpu (shared with __graft_entry__.dryrun_multichip).
 """
 
 import os
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# The axon TPU plugin (registered via sitecustomize before this file runs)
-# overrides env-level platform selection; force CPU through jax.config,
-# which wins over the plugin's registration priority.
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kwok_tpu.hostcpu import force_cpu_devices
+
+force_cpu_devices(8)
