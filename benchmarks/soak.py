@@ -10,7 +10,12 @@ watch -> device tick -> strategic-merge patch egress loop that bench.py's
 device-only number excludes (SURVEY.md "Hard parts": the watch/patch edge,
 not the math, is the bottleneck).
 
-Usage (self-contained, in-process apiserver + engine over real sockets):
+Topology mirrors a real cluster: the mock apiserver and the engine (the
+kwok CLI) run as SEPARATE processes; this rig is only the load generator +
+clock. (--in-process collapses all three into one interpreter for tests.)
+All traffic rides pooled keep-alive connections with TCP_NODELAY.
+
+Usage:
     python benchmarks/soak.py --nodes 1000 --pods 10000
 Against an existing cluster (real kube-scheduler does the binding):
     python benchmarks/soak.py --apiserver http://HOST:PORT --no-bind ...
@@ -21,78 +26,199 @@ Prints ONE JSON line with pods/s to Running and engine metrics.
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import os
+import socket
+import subprocess
 import sys
 import time
+import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-# the rig measures the HTTP edge, not device math — default to CPU JAX so a
-# bare run never claims the (single, tunneled) TPU chip; export
-# JAX_PLATFORMS=tpu explicitly to bench the device path end to end
+# the rig measures the HTTP edge, not device math — every process (this one
+# and the spawned engine/apiserver) runs CPU JAX so nothing claims the
+# (single, tunneled) TPU chip; export JAX_PLATFORMS=tpu explicitly to bench
+# the device path end to end
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-def _post(url: str, path: str, obj: dict) -> None:
-    import urllib.request
 
-    req = urllib.request.Request(
-        url + path,
-        data=json.dumps(obj).encode(),
-        headers={"Content-Type": "application/json"},
-        method="POST",
-    )
-    urllib.request.urlopen(req).read()
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = os.environ.get("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # concurrent processes deadlock waiting for the single-TPU relay grant
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
 
 
-def _patch_spec(url: str, ns: str, name: str, node: str) -> None:
-    import urllib.request
+class _Poller:
+    """Single persistent connection for the progress polls; counts objects
+    in the raw List bytes (`"resourceVersion":` appears once per object plus
+    once in the List envelope) so a 50k-pod poll costs no client-side JSON
+    parse."""
 
-    req = urllib.request.Request(
-        f"{url}/api/v1/namespaces/{ns}/pods/{name}",
-        data=json.dumps({"spec": {"nodeName": node}}).encode(),
-        headers={"Content-Type": "application/json"},
-        method="PATCH",
-    )
-    urllib.request.urlopen(req).read()
+    def __init__(self, url: str) -> None:
+        split = urllib.parse.urlsplit(url)
+        self._https = split.scheme == "https"
+        self._host, self._port = split.hostname, split.port
+        self._base = split.path.rstrip("/")
+        self._conn: http.client.HTTPConnection | None = None
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            if self._https:
+                import ssl
+
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+                c = http.client.HTTPSConnection(
+                    self._host, self._port, timeout=120, context=ctx
+                )
+            else:
+                c = http.client.HTTPConnection(
+                    self._host, self._port, timeout=120
+                )
+            c.connect()
+            c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conn = c
+        return self._conn
+
+    def raw(self, path: str) -> bytes:
+        for attempt in (0, 1):
+            c = self._connect()
+            try:
+                c.request("GET", self._base + path)
+                resp = c.getresponse()
+                body = resp.read()
+                if resp.status >= 400:
+                    raise SystemExit(
+                        f"poll GET {path} -> {resp.status}: {body[:200]!r}"
+                    )
+                return body
+            except (http.client.HTTPException, OSError):
+                try:
+                    c.close()
+                except Exception:
+                    pass
+                self._conn = None
+                if attempt:
+                    raise
+        raise AssertionError
+
+    def count(self, path: str) -> int:
+        # minus the List envelope's own resourceVersion
+        return max(0, self.raw(path).count(b'"resourceVersion":') - 1)
+
+    def count_ready_nodes(self) -> int:
+        items = json.loads(self.raw("/api/v1/nodes"))["items"]
+        return sum(
+            1
+            for n in items
+            if any(
+                c.get("type") == "Ready" and c.get("status") == "True"
+                for c in (n.get("status") or {}).get("conditions") or []
+            )
+        )
 
 
-def _count(url: str, path: str, pred) -> int:
-    import urllib.request
+def _wait_http(url: str, path: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            split = urllib.parse.urlsplit(url)
+            c = http.client.HTTPConnection(split.hostname, split.port, timeout=2)
+            c.request("GET", path)
+            if c.getresponse().status < 500:
+                c.close()
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise SystemExit(f"timeout waiting for {url}{path}")
 
-    with urllib.request.urlopen(url + path) as r:
-        items = json.loads(r.read())["items"]
-    return sum(1 for o in items if pred(o))
+
+def _scrape_metrics(url: str) -> dict:
+    """Prometheus text -> {name: value} (the kwok server's /metrics)."""
+    out: dict[str, float] = {}
+    try:
+        split = urllib.parse.urlsplit(url)
+        c = http.client.HTTPConnection(split.hostname, split.port, timeout=5)
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode()
+        c.close()
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                name, _, val = line.partition(" ")
+                try:
+                    out[name.partition("{")[0]] = float(val)
+                except ValueError:
+                    pass
+    except OSError:
+        pass
+    return out
 
 
-def _running(o: dict) -> bool:
-    return (o.get("status") or {}).get("phase") == "Running"
+def _load_worker_entry() -> None:
+    """Child-process loader: create [lo,hi) pods (and bind unless told not
+    to) against the apiserver, then exit. Args via argv."""
+    (_, url, lo, hi, nodes, bind, workers) = sys.argv
+    lo, hi, nodes, workers = int(lo), int(hi), int(nodes), int(workers)
+    from kwok_tpu.edge.httpclient import HttpKubeClient
 
+    client = HttpKubeClient.from_kubeconfig(None, url)
+    pool = ThreadPoolExecutor(max_workers=workers)
 
-def _ready(o: dict) -> bool:
-    return any(
-        c.get("type") == "Ready" and c.get("status") == "True"
-        for c in (o.get("status") or {}).get("conditions") or []
-    )
+    def one(i: int) -> None:
+        client.create("pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"soak-pod-{i}", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "soak"}]},
+            "status": {"phase": "Pending"},
+        })
+        if bind == "1":
+            client.patch_meta(
+                "pods", "default", f"soak-pod-{i}",
+                {"spec": {"nodeName": f"soak-node-{i % nodes}"}},
+            )
+
+    list(pool.map(one, range(lo, hi)))
 
 
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1].startswith("http"):
+        _load_worker_entry()
+        return
+
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=1000)
     p.add_argument("--pods", type=int, default=10000)
     p.add_argument("--apiserver", default="", help="existing cluster URL")
     p.add_argument("--no-bind", action="store_true",
                    help="an external scheduler binds; just create and wait")
-    p.add_argument("--workers", type=int, default=32)
+    p.add_argument("--workers", type=int, default=16,
+                   help="loader threads per loader process")
+    p.add_argument("--load-procs", type=int, default=4,
+                   help="loader processes for the pod-create phase")
     p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--engine-parallelism", type=int, default=64)
+    p.add_argument("--tick-interval", type=float, default=0.02)
+    p.add_argument("--in-process", action="store_true",
+                   help="single-interpreter mode (tests); GIL-bound")
     args = p.parse_args()
 
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.kwokctl import netutil
+
     engine = srv = None
+    procs: list[subprocess.Popen] = []
+    metrics_url = ""
     if args.apiserver:
         url = args.apiserver
-    else:
-        from kwok_tpu.edge.httpclient import HttpKubeClient
+    elif args.in_process:
         from kwok_tpu.edge.mockserver import HttpFakeApiserver
         from kwok_tpu.engine import ClusterEngine, EngineConfig
 
@@ -102,71 +228,135 @@ def main() -> None:
             HttpKubeClient.from_kubeconfig(None, url),
             EngineConfig(
                 manage_all_nodes=True,
-                tick_interval=0.02,
-                parallelism=64,
+                tick_interval=args.tick_interval,
+                parallelism=args.engine_parallelism,
                 initial_capacity=max(args.pods, args.nodes, 4096),
             ),
         )
         engine.start()
+    else:
+        # real topology: apiserver process + engine process + this loader
+        api_port = netutil.get_unused_port()
+        srv_port = netutil.get_unused_port()
+        url = f"http://127.0.0.1:{api_port}"
+        metrics_url = f"http://127.0.0.1:{srv_port}"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kwok_tpu.edge.mockserver",
+             "--port", str(api_port)],
+            env=_child_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        ))
+        _wait_http(url, "/healthz")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kwok_tpu.kwok",
+             "--master", url,
+             "--manage-all-nodes", "true",
+             "--tick-interval", str(args.tick_interval),
+             "--parallelism", str(args.engine_parallelism),
+             "--initial-capacity", str(max(args.pods, args.nodes, 4096)),
+             "--server-address", f"127.0.0.1:{srv_port}"],
+            env=_child_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        ))
+        _wait_http(metrics_url, "/healthz")
 
-    pool = ThreadPoolExecutor(max_workers=args.workers)
+    client = HttpKubeClient.from_kubeconfig(None, url)
+    poller = _Poller(url)
+    pool = ThreadPoolExecutor(max_workers=max(args.workers, 16))
 
-    # --- nodes -> Ready ----------------------------------------------------
-    t_nodes = time.perf_counter()
-    list(pool.map(
-        lambda i: _post(url, "/api/v1/nodes", {
-            "apiVersion": "v1", "kind": "Node",
-            "metadata": {"name": f"soak-node-{i}"},
-        }),
-        range(args.nodes),
-    ))
-    deadline = time.monotonic() + args.timeout
-    poll = max(0.25, min(2.0, args.pods / 20000))
-    while _count(url, "/api/v1/nodes", _ready) < args.nodes:
-        if time.monotonic() > deadline:
-            raise SystemExit("timeout waiting for nodes Ready")
-        time.sleep(poll)
-    nodes_s = time.perf_counter() - t_nodes
+    try:
+        # --- nodes -> Ready ------------------------------------------------
+        t_nodes = time.perf_counter()
+        list(pool.map(
+            lambda i: client.create("nodes", {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": f"soak-node-{i}"},
+            }),
+            range(args.nodes),
+        ))
+        create_nodes_s = time.perf_counter() - t_nodes
+        deadline = time.monotonic() + args.timeout
+        poll = max(0.2, min(2.0, args.pods / 50000))
+        while poller.count_ready_nodes() < args.nodes:
+            if time.monotonic() > deadline:
+                raise SystemExit("timeout waiting for nodes Ready")
+            time.sleep(poll)
+        nodes_s = time.perf_counter() - t_nodes
 
-    # --- pods: create (Pending, unbound) -> bind -> Running ----------------
-    t_pods = time.perf_counter()
+        # --- pods: create (Pending, unbound) -> bind -> Running ------------
+        t_pods = time.perf_counter()
+        bind = "0" if args.no_bind else "1"
+        n_load = max(1, args.load_procs)
+        if args.in_process or n_load == 1:
+            sys.argv = ["soak", url, "0", str(args.pods), str(args.nodes),
+                        bind, str(args.workers)]
+            _load_worker_entry()
+        else:
+            step = (args.pods + n_load - 1) // n_load
+            loaders = [
+                subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), url,
+                     str(lo), str(min(lo + step, args.pods)),
+                     str(args.nodes), bind, str(args.workers)],
+                    env=_child_env(),
+                )
+                for lo in range(0, args.pods, step)
+            ]
+            for lp in loaders:
+                if lp.wait() != 0:
+                    raise SystemExit("loader process failed")
+        create_pods_s = time.perf_counter() - t_pods
 
-    def create_pod(i: int) -> None:
-        _post(url, "/api/v1/namespaces/default/pods", {
-            "apiVersion": "v1", "kind": "Pod",
-            "metadata": {"name": f"soak-pod-{i}", "namespace": "default"},
-            "spec": {"containers": [{"name": "c", "image": "soak"}]},
-            "status": {"phase": "Pending"},
-        })
-        if not args.no_bind:  # round-robin binder (kube-scheduler stand-in)
-            _patch_spec(url, "default", f"soak-pod-{i}",
-                        f"soak-node-{i % args.nodes}")
+        running_path = (
+            "/api/v1/pods?fieldSelector="
+            + urllib.parse.quote("status.phase=Running")
+        )
+        while poller.count(running_path) < args.pods:
+            if time.monotonic() > deadline:
+                n = poller.count(running_path)
+                raise SystemExit(
+                    f"timeout waiting for pods Running ({n}/{args.pods})"
+                )
+            time.sleep(poll)
+        pods_s = time.perf_counter() - t_pods
 
-    list(pool.map(create_pod, range(args.pods)))
-    while _count(url, "/api/v1/pods", _running) < args.pods:
-        if time.monotonic() > deadline:
-            raise SystemExit("timeout waiting for pods Running")
-        time.sleep(poll)
-    pods_s = time.perf_counter() - t_pods
-
-    out = {
-        "metric": (
-            f"e2e soak: {args.pods} pods x {args.nodes} nodes over HTTP "
-            "(create+bind -> Running)"
-        ),
-        "pods_per_s": round(args.pods / pods_s, 1),
-        "pods_elapsed_s": round(pods_s, 2),
-        "nodes_per_s": round(args.nodes / nodes_s, 1),
-        "nodes_elapsed_s": round(nodes_s, 2),
-    }
-    if engine is not None:
-        m = engine.metrics
-        out["status_patches_total"] = m["status_patches_total"]
-        out["transitions_total"] = m["transitions_total"]
-        engine.stop()
-    if srv is not None:
-        srv.stop()
-    print(json.dumps(out))
+        out = {
+            "metric": (
+                f"e2e soak: {args.pods} pods x {args.nodes} nodes over HTTP "
+                "(create+bind -> Running)"
+            ),
+            "pods_per_s": round(args.pods / pods_s, 1),
+            "pods_elapsed_s": round(pods_s, 2),
+            "pods_create_bind_s": round(create_pods_s, 2),
+            "nodes_per_s": round(args.nodes / nodes_s, 1),
+            "nodes_elapsed_s": round(nodes_s, 2),
+            "nodes_create_s": round(create_nodes_s, 2),
+        }
+        if engine is not None:
+            m = engine.metrics
+            out["status_patches_total"] = m["status_patches_total"]
+            out["transitions_total"] = m["transitions_total"]
+            engine.stop()
+        elif metrics_url:
+            m = _scrape_metrics(metrics_url)
+            for k_out, k_in in (
+                ("status_patches_total", "kwok_status_patches_total"),
+                ("transitions_total", "kwok_transitions_total"),
+                ("heartbeats_total", "kwok_heartbeats_total"),
+            ):
+                if k_in in m:
+                    out[k_out] = int(m[k_in])
+        if srv is not None:
+            srv.stop()
+        print(json.dumps(out))
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
 
 
 if __name__ == "__main__":

@@ -188,6 +188,13 @@ class HttpKubeClient:
                 c = http.client.HTTPConnection(
                     self._host, self._port, timeout=self.timeout
                 )
+            c.connect()
+            try:
+                # Without this, request bodies Nagle-stall behind the
+                # server's delayed ACK on every keep-alive round trip.
+                c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except (OSError, AttributeError):
+                pass
             self._local.conn = c
             with self._conns_lock:
                 self._conns.add(c)
@@ -218,8 +225,9 @@ class HttpKubeClient:
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         for attempt in (0, 1):
-            conn = self._conn()
+            conn = None
             try:
+                conn = self._conn()
                 conn.request(method, path, body=data, headers=headers)
                 resp = conn.getresponse()
                 payload = resp.read()
@@ -269,6 +277,12 @@ class HttpKubeClient:
 
     def get(self, kind, namespace, name):
         return self._json("GET", self._url(kind, namespace, name))
+
+    def create(self, kind, obj, namespace=None):
+        """POST a new object (used by load rigs and tests; the engine itself
+        never creates API objects)."""
+        ns = namespace or (obj.get("metadata") or {}).get("namespace")
+        return self._json("POST", self._url(kind, ns), obj)
 
     def patch_status(self, kind, namespace, name, patch):
         return self._json(

@@ -74,6 +74,10 @@ class FakeKube:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._store: dict[str, dict[tuple[str, str], dict]] = {"nodes": {}, "pods": {}}
+        # per-object serialized JSON, invalidated on mutation: list/get/patch
+        # responses are cache joins, so a 50k-pod LIST poll costs no
+        # deepcopies and only serializes objects that changed since last read
+        self._json: dict[str, dict[tuple[str, str], bytes]] = {"nodes": {}, "pods": {}}
         self._rv = 0
         self._watches: list[_Watch] = []
         # observability for tests
@@ -85,9 +89,22 @@ class FakeKube:
     def _key(self, namespace, name):
         return (namespace or "", name)
 
-    def _bump(self, obj: dict) -> None:
+    def _bump(self, obj: dict, kind: str | None = None, key=None) -> None:
         self._rv += 1
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        if kind is not None:
+            self._json[kind].pop(key, None)
+
+    def _obj_bytes(self, kind: str, key) -> bytes | None:
+        """Serialized form of a stored object (caller holds the lock)."""
+        b = self._json[kind].get(key)
+        if b is None:
+            obj = self._store[kind].get(key)
+            if obj is None:
+                return None
+            b = json.dumps(obj, separators=(",", ":")).encode()
+            self._json[kind][key] = b
+        return b
 
     def _emit(self, kind: str, type_: str, obj: dict) -> None:
         for w in list(self._watches):
@@ -105,10 +122,24 @@ class FakeKube:
             meta.setdefault("creationTimestamp", now_rfc3339())
             meta.setdefault("uid", f"uid-{self._rv + 1}")
             key = self._key(meta.get("namespace"), meta["name"])
-            self._bump(obj)
+            self._bump(obj, kind, key)
             self._store[kind][key] = obj
             self._emit(kind, ADDED, obj)
             return copy.deepcopy(obj)
+
+    def create_bytes(self, kind: str, obj: dict) -> bytes:
+        """HTTP hot path: create + serialized response in one lock hold (no
+        deepcopied return value)."""
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("creationTimestamp", now_rfc3339())
+            meta.setdefault("uid", f"uid-{self._rv + 1}")
+            key = self._key(meta.get("namespace"), meta["name"])
+            self._bump(obj, kind, key)
+            self._store[kind][key] = obj
+            self._emit(kind, ADDED, obj)
+            return self._obj_bytes(kind, key)
 
     def update(self, kind: str, obj: dict) -> dict:
         with self._lock:
@@ -117,7 +148,7 @@ class FakeKube:
             key = self._key(meta.get("namespace"), meta.get("name"))
             if key not in self._store[kind]:
                 raise KeyError(key)
-            self._bump(obj)
+            self._bump(obj, kind, key)
             self._store[kind][key] = obj
             self._emit(kind, MODIFIED, obj)
             return copy.deepcopy(obj)
@@ -138,6 +169,59 @@ class FakeKube:
                 out.append(copy.deepcopy(obj))
             return out
 
+    def list_bytes(
+        self,
+        kind,
+        *,
+        field_selector=None,
+        label_selector=None,
+        limit: int = 0,
+        continue_: str | None = None,
+    ) -> bytes:
+        """Serialized List response (HTTP hot path): joins per-object cached
+        bytes — no deepcopies, no whole-list re-serialization per poll.
+
+        Pagination follows the kube-apiserver chunking protocol
+        (limit/continue, staging/src/k8s.io/apiserver pagination): objects
+        are returned in stable key order and `metadata.continue` is an
+        opaque token resuming strictly after the last returned key."""
+        sel = parse_selector(label_selector)
+        with self._lock:
+            keys = sorted(self._store[kind].keys())
+            if continue_:
+                ns, _, name = continue_.partition("\x00")
+                last = (ns, name)
+                # binary search would be nicer; linear is fine at mock scale
+                keys = [k for k in keys if k > last]
+            chunks: list[bytes] = []
+            token = ""
+            for pos, key in enumerate(keys):
+                obj = self._store[kind][key]
+                if not match_field_selector(obj, field_selector):
+                    continue
+                if sel is not None:
+                    labels = (obj.get("metadata") or {}).get("labels") or {}
+                    if not sel.matches(labels):
+                        continue
+                chunks.append(self._obj_bytes(kind, key))
+                if limit and len(chunks) >= limit:
+                    if pos + 1 < len(keys):
+                        token = f"{key[0]}\x00{key[1]}"
+                    break
+            rv = str(self._rv)
+        meta = f'{{"resourceVersion":"{rv}"'.encode()
+        if token:
+            meta += b',"continue":' + json.dumps(token).encode()
+        meta += b"}"
+        return (
+            b'{"kind":"List","apiVersion":"v1","metadata":' + meta
+            + b',"items":[' + b",".join(chunks) + b"]}"
+        )
+
+    def get_bytes(self, kind, namespace, name) -> bytes | None:
+        with self._lock:
+            return self._obj_bytes(kind, self._key(namespace, name))
+
     def watch(self, kind, *, field_selector=None, label_selector=None):
         w = _Watch(self, kind, field_selector, label_selector)
         with self._lock:
@@ -149,20 +233,32 @@ class FakeKube:
             obj = self._store[kind].get(self._key(namespace, name))
             return copy.deepcopy(obj) if obj else None
 
+    def _patch_status_locked(self, kind, key, patch):
+        obj = self._store[kind].get(key)
+        if obj is None:
+            return None
+        status = obj.get("status") or {}
+        obj["status"] = strategic_merge(status, patch.get("status", patch))
+        self._bump(obj, kind, key)
+        self.patch_count += 1
+        self._emit(kind, MODIFIED, obj)
+        return obj
+
     def patch_status(self, kind, namespace, name, patch):
         if isinstance(patch, (bytes, bytearray, memoryview)):
             patch = json.loads(bytes(patch))
         with self._lock:
+            obj = self._patch_status_locked(kind, self._key(namespace, name), patch)
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def patch_status_bytes(self, kind, namespace, name, patch) -> bytes | None:
+        """HTTP hot path: patch + serialized response in one lock hold."""
+        if isinstance(patch, (bytes, bytearray, memoryview)):
+            patch = json.loads(bytes(patch))
+        with self._lock:
             key = self._key(namespace, name)
-            obj = self._store[kind].get(key)
-            if obj is None:
-                return None
-            status = obj.get("status") or {}
-            obj["status"] = strategic_merge(status, patch.get("status", patch))
-            self._bump(obj)
-            self.patch_count += 1
-            self._emit(kind, MODIFIED, obj)
-            return copy.deepcopy(obj)
+            obj = self._patch_status_locked(kind, key, patch)
+            return None if obj is None else self._obj_bytes(kind, key)
 
     def patch_meta(self, kind, namespace, name, patch):
         """Merge-patch metadata (and spec — covers the scheduler's pod
@@ -183,7 +279,7 @@ class FakeKube:
                         sec.pop(k, None)
                     else:
                         sec[k] = copy.deepcopy(v)
-            self._bump(obj)
+            self._bump(obj, kind, key)
             self._emit(kind, MODIFIED, obj)
             return copy.deepcopy(obj)
 
@@ -204,6 +300,7 @@ class FakeKube:
         clients re-list, like watchers reconnecting after an etcd restore."""
         with self._lock:
             self._store = {"nodes": {}, "pods": {}}
+            self._json = {"nodes": {}, "pods": {}}
             for kind, objs in (data.get("objects") or {}).items():
                 if kind not in self._store:
                     continue
@@ -230,10 +327,11 @@ class FakeKube:
                 if "deletionTimestamp" not in meta:
                     meta["deletionTimestamp"] = now_rfc3339()
                 meta["deletionGracePeriodSeconds"] = grace_seconds
-                self._bump(obj)
+                self._bump(obj, kind, key)
                 self._emit(kind, MODIFIED, obj)
                 return
             del self._store[kind][key]
+            self._json[kind].pop(key, None)
             self.delete_count += 1
             self._bump(obj)
             self._emit(kind, DELETED, obj)
@@ -342,6 +440,12 @@ class HttpFakeApiserver:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # One TCP segment per response: Nagle on the server side holds
+            # the body segment until the client ACKs the header segment, and
+            # the client's delayed ACK turns every unary request into a
+            # ~40ms stall (measured: 22 -> ~2900 req/s per connection).
+            disable_nagle_algorithm = True
+            wbufsize = -1  # fully buffer: headers+body leave in one write
 
             def log_message(self, *a):
                 pass
@@ -353,7 +457,9 @@ class HttpFakeApiserver:
                     pass
 
             def _send_json(self, obj, code=200):
-                body = json.dumps(obj).encode()
+                self._send_body(json.dumps(obj, separators=(",", ":")).encode(), code)
+
+            def _send_body(self, body: bytes, code=200):
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -383,22 +489,24 @@ class HttpFakeApiserver:
                 q = urllib.parse.parse_qs(parsed.query)
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
                 if name:
-                    obj = store.get(kind, ns, name)
-                    if obj is None:
+                    body = store.get_bytes(kind, ns, name)
+                    if body is None:
                         self._send_json({"kind": "Status", "code": 404}, 404)
                     else:
-                        self._send_json(obj)
+                        self._send_body(body)
                     return
                 fs = (q.get("fieldSelector") or [None])[0]
                 ls = (q.get("labelSelector") or [None])[0]
                 if (q.get("watch") or ["false"])[0] in ("true", "1"):
                     self._stream_watch(kind, fs, ls)
                     return
-                items = store.list(kind, field_selector=fs, label_selector=ls)
-                self._send_json({
-                    "kind": "List", "apiVersion": "v1",
-                    "metadata": {}, "items": items,
-                })
+                self._send_body(store.list_bytes(
+                    kind,
+                    field_selector=fs,
+                    label_selector=ls,
+                    limit=int((q.get("limit") or [0])[0] or 0),
+                    continue_=(q.get("continue") or [None])[0],
+                ))
 
             def _stream_watch(self, kind, fs, ls):
                 w = store.watch(kind, field_selector=fs, label_selector=ls)
@@ -406,10 +514,14 @@ class HttpFakeApiserver:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                # wfile is fully buffered (wbufsize): push the headers out
+                # now or the client blocks until the first event arrives
+                self.wfile.flush()
                 try:
                     for ev in w:
                         line = json.dumps(
-                            {"type": ev.type, "object": ev.object}
+                            {"type": ev.type, "object": ev.object},
+                            separators=(",", ":"),
                         ).encode() + b"\n"
                         self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
                         self.wfile.flush()
@@ -427,13 +539,16 @@ class HttpFakeApiserver:
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
                 patch = self._body()
                 if m.group("sub") == "status":
-                    obj = store.patch_status(kind, ns, name, patch)
+                    body = store.patch_status_bytes(kind, ns, name, patch)
                 else:
                     obj = store.patch_meta(kind, ns, name, patch)
-                if obj is None:
+                    body = (
+                        None if obj is None else store.get_bytes(kind, ns, name)
+                    )
+                if body is None:
                     self._send_json({"kind": "Status", "code": 404}, 404)
                 else:
-                    self._send_json(obj)
+                    self._send_body(body)
 
             def do_DELETE(self):  # noqa: N802
                 parsed = urllib.parse.urlparse(self.path)
@@ -462,7 +577,7 @@ class HttpFakeApiserver:
                 obj = self._body()
                 if m.group("ns"):
                     obj.setdefault("metadata", {})["namespace"] = m.group("ns")
-                self._send_json(store.create(m.group("kind"), obj), 201)
+                self._send_body(store.create_bytes(m.group("kind"), obj), 201)
 
         return Handler
 
