@@ -70,6 +70,98 @@ def make_cyclic_rules():
     return rules
 
 
+def _run(kern, pstate, nstate, n_pods, n_nodes, ticks) -> float:
+    """Tick `ticks` times and return transitions/s (counters + masks
+    materialized host-side, exactly what the engine's egress consumes)."""
+    import numpy as np
+
+    from kwok_tpu.ops.tick import prefetch, unpack_wire
+
+    now = 0.0
+    for _ in range(WARMUP):
+        (pout, nout), wire = kern((pstate, nstate), now)
+        pstate, nstate = pout.state, nout.state
+        now += DT
+    _ = np.asarray(wire)  # sync
+
+    wires = []
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        (pout, nout), wire = kern((pstate, nstate), now)
+        pstate, nstate = pout.state, nout.state
+        prefetch(wire)
+        wires.append(wire)
+        now += DT
+    total = 0
+    for wire in wires:
+        counters, masks_fn = unpack_wire(np.asarray(wire), [n_pods, n_nodes])
+        total += int(counters[0]) + int(counters[1])
+        masks_fn()
+    return total / (time.perf_counter() - t0)
+
+
+def mesh_main(n_devices: int, n_pods: int, ticks: int) -> None:
+    """VERDICT #8: 1-device vs n-virtual-device scaling of the fused tick on
+    the host platform. On a single-core host this measures the *overhead* of
+    the shard_map'd row-sharded path (collectives, resharding), not a
+    speedup — the virtual devices timeshare one core; the TPU headline
+    number stays the default single-chip run."""
+    from kwok_tpu.hostcpu import force_cpu_devices
+
+    force_cpu_devices(n_devices)
+
+    from kwok_tpu.models import compile_rules, default_rules
+    from kwok_tpu.models.lifecycle import ResourceKind
+    from kwok_tpu.ops import new_row_state
+    from kwok_tpu.ops.tick import MultiTickKernel, to_device
+    from kwok_tpu.parallel import make_mesh
+    from kwok_tpu.parallel.mesh import pad_to_multiple
+
+    ptab = compile_rules(make_cyclic_rules(), ResourceKind.POD)
+    ntab = compile_rules(default_rules(), ResourceKind.NODE)
+    mesh = make_mesh(n_devices)
+    n_pods = pad_to_multiple(n_pods, mesh)
+    n_nodes = pad_to_multiple(max(n_pods // 100, n_devices), mesh)
+
+    def seeded(n):
+        s = new_row_state(n)
+        s.active[:] = True
+        s.sel_bits[:] = 0b11
+        return s
+
+    results = {}
+    for label, m in (("1dev", None), (f"{n_devices}dev", mesh)):
+        kern = MultiTickKernel(
+            [(ptab, 30.0, (), -1), (ntab, 30.0, (), 1)], mesh=m, pack=True
+        )
+        if m is None:
+            pstate = to_device(seeded(n_pods))
+            nstate = to_device(seeded(n_nodes))
+        else:
+            pstate = kern.place(seeded(n_pods))
+            nstate = kern.place(seeded(n_nodes))
+        results[label] = round(
+            _run(kern, pstate, nstate, n_pods, n_nodes, ticks), 1
+        )
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"fused-tick mesh scaling at {n_pods} pods x {n_nodes} "
+                    f"nodes (virtual CPU devices; single-core host measures "
+                    "sharding overhead, not speedup)"
+                ),
+                "transitions_per_s": results,
+                "unit": "transitions/s",
+                "relative": round(
+                    results[f"{n_devices}dev"] / max(results["1dev"], 1e-9), 3
+                ),
+            }
+        )
+    )
+
+
 def main() -> None:
     import jax
 
@@ -146,4 +238,19 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    _p = argparse.ArgumentParser()
+    _p.add_argument("--mesh", type=int, default=0,
+                    help="N virtual CPU devices: record 1-dev vs N-dev "
+                         "scaling of the sharded tick instead of the TPU "
+                         "headline number")
+    _p.add_argument("--pods", type=int, default=262_144,
+                    help="row count for --mesh mode")
+    _p.add_argument("--ticks", type=int, default=30,
+                    help="timed ticks for --mesh mode")
+    _a = _p.parse_args()
+    if _a.mesh:
+        mesh_main(_a.mesh, _a.pods, _a.ticks)
+    else:
+        main()

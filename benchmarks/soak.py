@@ -111,7 +111,10 @@ class _Poller:
         raise AssertionError
 
     def count(self, path: str) -> int:
-        # minus the List envelope's own resourceVersion
+        # One per object plus one List envelope. Occurrences inside string
+        # values (e.g. last-applied-configuration annotations on a real
+        # apiserver) cannot false-match: JSON-in-string escapes its quotes,
+        # so the byte sequence `"resourceVersion":` never appears there.
         return max(0, self.raw(path).count(b'"resourceVersion":') - 1)
 
     def count_ready_nodes(self) -> int:
@@ -129,15 +132,17 @@ class _Poller:
 def _wait_http(url: str, path: str, timeout: float = 30.0) -> None:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
+        split = urllib.parse.urlsplit(url)
+        c = http.client.HTTPConnection(split.hostname, split.port, timeout=2)
         try:
-            split = urllib.parse.urlsplit(url)
-            c = http.client.HTTPConnection(split.hostname, split.port, timeout=2)
             c.request("GET", path)
             if c.getresponse().status < 500:
-                c.close()
                 return
         except OSError:
-            time.sleep(0.1)
+            pass
+        finally:
+            c.close()
+        time.sleep(0.1)
     raise SystemExit(f"timeout waiting for {url}{path}")
 
 
@@ -302,9 +307,11 @@ def main() -> None:
                 )
                 for lo in range(0, args.pods, step)
             ]
+            failed = False
             for lp in loaders:
-                if lp.wait() != 0:
-                    raise SystemExit("loader process failed")
+                failed |= lp.wait() != 0
+            if failed:
+                raise SystemExit("loader process failed")
         create_pods_s = time.perf_counter() - t_pods
 
         running_path = (

@@ -115,31 +115,27 @@ class FakeKube:
 
     # -- test-side API ------------------------------------------------------
 
+    def _create_locked(self, kind: str, obj: dict):
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("creationTimestamp", now_rfc3339())
+        meta.setdefault("uid", f"uid-{self._rv + 1}")
+        key = self._key(meta.get("namespace"), meta["name"])
+        self._bump(obj, kind, key)
+        self._store[kind][key] = obj
+        self._emit(kind, ADDED, obj)
+        return key
+
     def create(self, kind: str, obj: dict) -> dict:
         with self._lock:
-            obj = copy.deepcopy(obj)
-            meta = obj.setdefault("metadata", {})
-            meta.setdefault("creationTimestamp", now_rfc3339())
-            meta.setdefault("uid", f"uid-{self._rv + 1}")
-            key = self._key(meta.get("namespace"), meta["name"])
-            self._bump(obj, kind, key)
-            self._store[kind][key] = obj
-            self._emit(kind, ADDED, obj)
-            return copy.deepcopy(obj)
+            key = self._create_locked(kind, obj)
+            return copy.deepcopy(self._store[kind][key])
 
     def create_bytes(self, kind: str, obj: dict) -> bytes:
         """HTTP hot path: create + serialized response in one lock hold (no
         deepcopied return value)."""
         with self._lock:
-            obj = copy.deepcopy(obj)
-            meta = obj.setdefault("metadata", {})
-            meta.setdefault("creationTimestamp", now_rfc3339())
-            meta.setdefault("uid", f"uid-{self._rv + 1}")
-            key = self._key(meta.get("namespace"), meta["name"])
-            self._bump(obj, kind, key)
-            self._store[kind][key] = obj
-            self._emit(kind, ADDED, obj)
-            return self._obj_bytes(kind, key)
+            return self._obj_bytes(kind, self._create_locked(kind, obj))
 
     def update(self, kind: str, obj: dict) -> dict:
         with self._lock:
@@ -265,23 +261,34 @@ class FakeKube:
         binding, which the soak rig's binder issues as a spec.nodeName
         patch; real schedulers use POST .../binding to the same effect)."""
         with self._lock:
+            obj = self._patch_meta_locked(kind, self._key(namespace, name), patch)
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def patch_meta_bytes(self, kind, namespace, name, patch) -> bytes | None:
+        """HTTP hot path: patch + serialized response in one lock hold, so
+        the response is exactly the object this patch produced."""
+        with self._lock:
             key = self._key(namespace, name)
-            obj = self._store[kind].get(key)
-            if obj is None:
-                return None
-            for section in ("metadata", "spec"):
-                sec_patch = (patch or {}).get(section)
-                if not sec_patch:
-                    continue
-                sec = obj.setdefault(section, {})
-                for k, v in sec_patch.items():
-                    if v is None:
-                        sec.pop(k, None)
-                    else:
-                        sec[k] = copy.deepcopy(v)
-            self._bump(obj, kind, key)
-            self._emit(kind, MODIFIED, obj)
-            return copy.deepcopy(obj)
+            obj = self._patch_meta_locked(kind, key, patch)
+            return None if obj is None else self._obj_bytes(kind, key)
+
+    def _patch_meta_locked(self, kind, key, patch):
+        obj = self._store[kind].get(key)
+        if obj is None:
+            return None
+        for section in ("metadata", "spec"):
+            sec_patch = (patch or {}).get(section)
+            if not sec_patch:
+                continue
+            sec = obj.setdefault(section, {})
+            for k, v in sec_patch.items():
+                if v is None:
+                    sec.pop(k, None)
+                else:
+                    sec[k] = copy.deepcopy(v)
+        self._bump(obj, kind, key)
+        self._emit(kind, MODIFIED, obj)
+        return obj
 
     def dump(self) -> dict:
         """Serializable snapshot of the whole store — the mock's 'etcd
@@ -541,10 +548,7 @@ class HttpFakeApiserver:
                 if m.group("sub") == "status":
                     body = store.patch_status_bytes(kind, ns, name, patch)
                 else:
-                    obj = store.patch_meta(kind, ns, name, patch)
-                    body = (
-                        None if obj is None else store.get_bytes(kind, ns, name)
-                    )
+                    body = store.patch_meta_bytes(kind, ns, name, patch)
                 if body is None:
                     self._send_json({"kind": "Status", "code": 404}, 404)
                 else:

@@ -52,7 +52,9 @@ from kwok_tpu.models.lifecycle import (
 )
 from kwok_tpu.ops.state import RowState, grow as grow_state, new_row_state
 from kwok_tpu.ops.tick import (
+    REBASE_AFTER,
     MultiTickKernel,
+    rebase_times,
     to_host,
     unpack_wire,
 )
@@ -675,6 +677,13 @@ class ClusterEngine:
             self._maybe_profile()
         t0 = time.perf_counter()
         now = self._now()
+        if now >= REBASE_AFTER:
+            # f32 engine time: re-zero the epoch before resolution decays
+            # (ops/tick.REBASE_AFTER) — long-soak heartbeats stay sub-16ms
+            self._epoch += now
+            for k in (self.nodes, self.pods):
+                k.state = rebase_times(k.state, now)
+            now = 0.0
         now_str = now_rfc3339()
         work = False
         for k in (self.nodes, self.pods):

@@ -39,7 +39,13 @@ from kwok_tpu.edge.render import now_rfc3339
 from kwok_tpu.engine.engine import ClusterEngine, EngineConfig
 from kwok_tpu.models.defaults import SEL_HEARTBEAT
 from kwok_tpu.ops.state import RowState, new_row_state
-from kwok_tpu.ops.tick import MultiTickKernel, to_host, unpack_wire
+from kwok_tpu.ops.tick import (
+    REBASE_AFTER,
+    MultiTickKernel,
+    rebase_times,
+    to_host,
+    unpack_wire,
+)
 from kwok_tpu.parallel import make_mesh
 
 logger = logging.getLogger("kwok_tpu.federation")
@@ -174,6 +180,15 @@ class FederatedEngine:
         self._maybe_regrow()
         t0 = time.perf_counter()
         now = time.time() - self._epoch
+        if now >= REBASE_AFTER:
+            # shared-epoch rebase (see ClusterEngine.tick_once): shift the
+            # stacked time fields and every member's epoch together
+            self._epoch += now
+            for e in self.engines:
+                e._epoch = self._epoch
+            for kind in ("nodes", "pods"):
+                self._stacked[kind] = rebase_times(self._stacked[kind], now)
+            now = 0.0
         now_str = now_rfc3339()
         r = self.cluster_capacity
         any_rows = False

@@ -39,6 +39,23 @@ from kwok_tpu.ops.state import RowState, TickOutputs
 
 INF = jnp.float32(jnp.inf)
 
+# Engine time is f32 (TPU-native width). Past 2**17 s (~36h) the ulp grows
+# beyond 2**-6 s and heartbeat/delay quantization would creep; the engine
+# rebases its epoch (rebase_times + epoch shift on the host clock) before
+# `now` ever crosses this, keeping sub-16ms resolution for unbounded uptimes.
+REBASE_AFTER = 131072.0
+
+
+@jax.jit
+def rebase_times(state: RowState, shift: jnp.ndarray) -> RowState:
+    """Shift the engine-time fields down by `shift` seconds (epoch rebase).
+    +inf sentinels are preserved (inf - finite == inf). One fused elementwise
+    pass; sharding of the inputs is preserved under jit."""
+    s = jnp.float32(shift)
+    return state._replace(
+        fire_at=state.fire_at - s, hb_due=state.hb_due - s
+    )
+
 
 def _rule_arrays(table: CompiledRules) -> dict[str, jnp.ndarray]:
     return {

@@ -231,3 +231,69 @@ def test_property_matches_reference_oracle(seed):
         np.testing.assert_array_equal(ref.deleted & act, dev.deleted & act)
         np.testing.assert_array_equal(ref.hb_fired & act, dev.hb_fired & act)
         ref_state, dev_state = ref.state, dev.state
+
+
+# ---------------------------------------------------------- time horizon
+
+
+def test_rebase_times_shifts_finite_preserves_inf():
+    from kwok_tpu.ops.tick import rebase_times
+
+    state = new_row_state(8)
+    state.fire_at[:4] = [150000.0, 131072.5, 200000.0, np.inf]
+    state.hb_due[:4] = [np.inf, 140000.25, 131073.0, 160000.0]
+    out = to_host(rebase_times(state, 131072.0))
+    np.testing.assert_allclose(
+        out.fire_at[:3], [150000.0 - 131072.0, 0.5, 200000.0 - 131072.0]
+    )
+    assert np.isinf(out.fire_at[3])
+    assert np.isinf(out.hb_due[0])
+    np.testing.assert_allclose(out.hb_due[1:4], [8928.25, 1.0, 28928.0])
+
+
+def test_heartbeat_quantization_bounded_after_rebase():
+    """The long-soak property the rebase exists for: at engine uptimes past
+    REBASE_AFTER the engine re-zeros, so the kernel never sees `now` where
+    the f32 ulp exceeds 2**-6 s and a 30s heartbeat interval stays exact to
+    <16ms. Without rebasing, now=1e6 quantizes +30.0 to ±0.0625s."""
+    from kwok_tpu.ops.tick import REBASE_AFTER
+
+    # ulp at the max now the kernel can observe post-rebase
+    max_now = np.float32(REBASE_AFTER)
+    ulp = np.spacing(max_now)
+    assert ulp <= 2.0**-6
+    # and the interval arithmetic the heartbeat wheel performs stays exact
+    # to one ulp at that magnitude
+    hb = np.float32(max_now) + np.float32(30.0)
+    assert abs(float(hb) - (float(max_now) + 30.0)) <= float(ulp)
+
+
+def test_engine_epoch_rebase_keeps_schedules():
+    """A pending delay armed before the rebase still fires on (relative)
+    schedule afterwards; heartbeats keep firing."""
+    import time as _time
+
+    from tests.fake_apiserver import FakeKube
+    from tests.test_engine import SyncEngine, make_node
+
+    from kwok_tpu.engine import EngineConfig
+    from kwok_tpu.ops.tick import REBASE_AFTER
+
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True))
+    server.create("nodes", make_node("rb-n1"))
+    eng.feed_all(server)
+    eng.pump()
+    assert (server.get("nodes", None, "rb-n1")["status"]["conditions"][0]
+            ["status"]) == "True"
+    # jump engine uptime past the rebase threshold
+    eng._epoch = _time.time() - (REBASE_AFTER + 10.0)
+    before = eng._epoch
+    eng.pump()
+    assert eng._epoch > before  # rebased
+    assert eng._now() < 5.0  # clock re-zeroed
+    hb_due = np.asarray(eng.nodes.state.hb_due)[:1]
+    assert np.isfinite(hb_due).all()
+    # heartbeat schedule survived in relative terms: due within interval
+    assert float(hb_due[0]) <= eng.config.heartbeat_interval + 5.0
+    eng.pump()  # still ticks fine after the shift
