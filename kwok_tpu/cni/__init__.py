@@ -12,9 +12,11 @@ build.
 
 from __future__ import annotations
 
+import importlib
+import os
 from typing import Callable
 
-__all__ = ["available", "setup", "remove", "register"]
+__all__ = ["available", "setup", "remove", "register", "load_from_env"]
 
 # provider: (setup(ns, name, uid) -> list[str], remove(ns, name, uid) -> None)
 _provider: tuple[Callable, Callable] | None = None
@@ -24,6 +26,30 @@ def register(setup_fn: Callable, remove_fn: Callable) -> None:
     """Install a real CNI provider (tests / future Linux support)."""
     global _provider
     _provider = (setup_fn, remove_fn)
+
+
+def load_from_env() -> bool:
+    """Install the provider named by KWOK_TPU_CNI_PROVIDER ("module" or
+    "module:attr"; the object must expose setup/remove). This is the
+    process-boundary analogue of the reference selecting its CNI plugin
+    binaries from /etc/cni/net.d at runtime (cni_linux.go:30-83) — an
+    external provider gets wired in without code changes here. Returns
+    False when the variable is unset."""
+    spec = os.environ.get("KWOK_TPU_CNI_PROVIDER")
+    if not spec:
+        return False
+    try:
+        modname, _, attr = spec.partition(":")
+        obj = importlib.import_module(modname)
+        if attr:
+            obj = getattr(obj, attr)
+        register(obj.setup, obj.remove)
+    except (ImportError, AttributeError, ValueError) as e:
+        raise RuntimeError(
+            f"KWOK_TPU_CNI_PROVIDER={spec!r} could not be loaded: {e} "
+            "(expected 'module' or 'module:attr' exposing setup/remove)"
+        ) from e
+    return True
 
 
 def available() -> bool:

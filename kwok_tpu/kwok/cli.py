@@ -139,6 +139,12 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
     from kwok_tpu.engine import ClusterEngine
     from kwok_tpu.kwok.server import EngineServer
 
+    if args.enable_cni:
+        from kwok_tpu import cni
+
+        if cni.load_from_env():
+            logger.info("cni provider loaded from KWOK_TPU_CNI_PROVIDER")
+
     # --master takes a comma-separated list: N apiservers federate onto one
     # stacked mesh-sharded tick (BASELINE config 5, engine/federation.py)
     masters = [m.strip() for m in (args.master or "").split(",") if m.strip()]

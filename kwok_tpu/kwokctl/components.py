@@ -29,6 +29,16 @@ IN_CONTAINER_AUDIT_LOG = "/var/log/kubernetes/audit/audit.log"
 IN_CONTAINER_PROMETHEUS_CONFIG = "/etc/prometheus/prometheus.yaml"
 
 
+def _release_at_least(version: str, minor: int) -> bool:
+    """True when a k8s version string is >= 1.<minor>; unknown/empty
+    versions count as current (the reference always has a parsed Version,
+    defaulting to the newest supported release)."""
+    from kwok_tpu.kwokctl.k8s import parse_release
+
+    release = parse_release(version or "")
+    return release < 0 or release >= minor
+
+
 class BrokenLinksError(ValueError):
     pass
 
@@ -221,9 +231,13 @@ def build_kube_controller_manager(
         f"--kubeconfig={IN_CONTAINER_KUBECONFIG if in_container else kubeconfig_path}"
     )
     if secure_port:
-        args.append(
-            "--authorization-always-allow-paths=/healthz,/readyz,/livez,/metrics"
-        )
+        if _release_at_least(version, 12):
+            # --authorization-always-allow-paths exists since 1.12
+            # (kube_controller_manager.go:84-89 Version.GE(1,12,0) gate)
+            args.append(
+                "--authorization-always-allow-paths="
+                "/healthz,/readyz,/livez,/metrics"
+            )
         if in_container:
             args += [f"--bind-address={PUBLIC_ADDRESS}", "--secure-port=10257"]
         else:
@@ -297,9 +311,13 @@ def build_kube_scheduler(
         f"--kubeconfig={IN_CONTAINER_KUBECONFIG if in_container else kubeconfig_path}"
     )
     if secure_port:
-        args.append(
-            "--authorization-always-allow-paths=/healthz,/readyz,/livez,/metrics"
-        )
+        if _release_at_least(version, 12):
+            # same 1.12 gate as the controller-manager
+            # (kube_scheduler.go:84-88)
+            args.append(
+                "--authorization-always-allow-paths="
+                "/healthz,/readyz,/livez,/metrics"
+            )
         if in_container:
             args += [f"--bind-address={PUBLIC_ADDRESS}", "--secure-port=10259"]
         else:

@@ -1,0 +1,92 @@
+#!/usr/bin/env bash
+# CNI e2e (port of the reference's test/kwok-with-cni/kwok.test.sh, scoped
+# per SURVEY §2.3: real netns CNI is out of scope; the provider hook is the
+# contract). A fake provider is loaded into the kwok process via
+# KWOK_TPU_CNI_PROVIDER; asserts:
+#   1. a pod's podIP comes from the provider (distinctive 10.99.0.0/16
+#      range, not the engine's default CIDR pool)
+#   2. deleting the pod calls the provider's remove (CNI DEL) — observed
+#      through the provider's journal file
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+WORK="$(mktemp -d)"
+APISERVER_PID=""
+KWOK_PID=""
+
+cleanup() {
+  [ -n "${KWOK_PID}" ] && kill "${KWOK_PID}" 2>/dev/null || true
+  [ -n "${APISERVER_PID}" ] && kill "${APISERVER_PID}" 2>/dev/null || true
+  rm -rf "${WORK}"
+}
+trap cleanup EXIT
+
+# the fake provider: allocates from 10.99.0.0/16 and journals every call
+cat >"${WORK}/fake_cni.py" <<EOF
+import json, os, threading
+
+JOURNAL = "${WORK}/cni-journal.jsonl"
+_lock = threading.Lock()
+_next = [1]
+
+def _log(entry):
+    with _lock:
+        with open(JOURNAL, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+
+def setup(namespace, name, uid):
+    with _lock:
+        n = _next[0]
+        _next[0] += 1
+    ip = f"10.99.{n // 256}.{n % 256}"
+    _log({"op": "ADD", "ns": namespace, "name": name, "uid": uid, "ip": ip})
+    return [ip]
+
+def remove(namespace, name, uid):
+    _log({"op": "DEL", "ns": namespace, "name": name, "uid": uid})
+EOF
+
+PORT="$(pyrun -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')"
+URL="http://127.0.0.1:${PORT}"
+
+pyrun -m kwok_tpu.edge.mockserver --port "${PORT}" \
+  >"${WORK}/apiserver.log" 2>&1 &
+APISERVER_PID="$!"
+retry 10 curl -fsS "${URL}/healthz"
+
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  PYTHONPATH="${WORK}:${E2E_ROOT}" KWOK_TPU_CNI_PROVIDER=fake_cni \
+  python3 -m kwok_tpu.kwok \
+  --master "${URL}" \
+  --manage-all-nodes=true \
+  --enable-cni=true \
+  --tick-interval 0.05 \
+  >"${WORK}/kwok.log" 2>&1 &
+KWOK_PID="$!"
+
+create_node "${URL}" cni-node
+retry 30 node_is_ready "${URL}" cni-node
+create_pod "${URL}" default cni-pod cni-node
+retry 30 running_pods_equal "${URL}" 1
+
+# 1. the pod IP is the provider's, not the pool's
+ip="$(curl -fsS "${URL}/api/v1/namespaces/default/pods/cni-pod" | pyrun -c '
+import json, sys
+print((json.load(sys.stdin).get("status") or {}).get("podIP", ""))
+')"
+case "${ip}" in
+10.99.*) ;;
+*)
+  echo "pod IP ${ip} did not come from the CNI provider" >&2
+  exit 1
+  ;;
+esac
+grep -q '"op": "ADD"' "${WORK}/cni-journal.jsonl"
+
+# 2. deleting the pod triggers CNI DEL
+curl -fsS -X DELETE "${URL}/api/v1/namespaces/default/pods/cni-pod" \
+  -H 'Content-Type: application/json' -d '{"gracePeriodSeconds": 0}' >/dev/null
+retry 20 grep -q '"op": "DEL"' "${WORK}/cni-journal.jsonl"
+
+echo "kwok_cni_test.sh passed (provider ip=${ip})"

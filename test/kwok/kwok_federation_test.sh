@@ -1,0 +1,94 @@
+#!/usr/bin/env bash
+# Federation e2e: ONE kwok engine process federates FOUR out-of-process mock
+# apiservers (--master a,b,c,d — BASELINE config 5 "8 kwok apiservers"
+# shape, scaled to the CI box). Asserts:
+#   1. every member's node goes Ready and pods go Running (per-member
+#      isolation: each member only ever sees its own objects)
+#   2. the engine's /metrics transition counter equals the SUM of work
+#      across members (the stacked tick drives all members in one dispatch)
+# Reference analogue: there is none — the reference runs one controller per
+# cluster; federation is this port's scale-out path (engine/federation.py).
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+N_MEMBERS=4
+PODS_PER_MEMBER=3
+
+WORK="$(mktemp -d)"
+PIDS=()
+KWOK_PID=""
+
+cleanup() {
+  [ -n "${KWOK_PID}" ] && kill "${KWOK_PID}" 2>/dev/null || true
+  for pid in "${PIDS[@]:-}"; do
+    [ -n "${pid}" ] && kill "${pid}" 2>/dev/null || true
+  done
+  rm -rf "${WORK}"
+}
+trap cleanup EXIT
+
+URLS=()
+for i in $(seq 1 "${N_MEMBERS}"); do
+  PORT="$(pyrun -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')"
+  pyrun -m kwok_tpu.edge.mockserver --port "${PORT}" \
+    >"${WORK}/apiserver-${i}.log" 2>&1 &
+  PIDS+=("$!")
+  URLS+=("http://127.0.0.1:${PORT}")
+done
+for url in "${URLS[@]}"; do
+  retry 10 curl -fsS "${url}/healthz"
+done
+
+SRV_PORT="$(pyrun -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')"
+MASTERS="$(IFS=,; echo "${URLS[*]}")"
+pyrun -m kwok_tpu.kwok \
+  --master "${MASTERS}" \
+  --manage-all-nodes=true \
+  --tick-interval 0.05 \
+  --server-address "127.0.0.1:${SRV_PORT}" \
+  >"${WORK}/kwok.log" 2>&1 &
+KWOK_PID="$!"
+retry 15 curl -fsS "http://127.0.0.1:${SRV_PORT}/healthz"
+
+# one node + PODS_PER_MEMBER pods per member
+for i in $(seq 0 $((N_MEMBERS - 1))); do
+  url="${URLS[$i]}"
+  create_node "${url}" "fed-node-${i}"
+done
+for i in $(seq 0 $((N_MEMBERS - 1))); do
+  url="${URLS[$i]}"
+  retry 30 node_is_ready "${url}" "fed-node-${i}"
+  for j in $(seq 0 $((PODS_PER_MEMBER - 1))); do
+    create_pod "${url}" default "fed-pod-${i}-${j}" "fed-node-${i}"
+  done
+done
+for i in $(seq 0 $((N_MEMBERS - 1))); do
+  url="${URLS[$i]}"
+  retry 30 running_pods_equal "${url}" "${PODS_PER_MEMBER}"
+done
+
+# member isolation: member i never saw any other member's objects
+for i in $(seq 0 $((N_MEMBERS - 1))); do
+  url="${URLS[$i]}"
+  names="$(curl -fsS "${url}/api/v1/nodes" | pyrun -c '
+import json, sys
+print(" ".join(sorted(n["metadata"]["name"] for n in json.load(sys.stdin)["items"])))
+')"
+  [ "${names}" = "fed-node-${i}" ] || {
+    echo "member ${i} node list polluted: ${names}" >&2
+    exit 1
+  }
+done
+
+# the shared engine's counters sum the work across all members:
+# every node (1 transition) + every pod (1 transition) at minimum
+want=$((N_MEMBERS + N_MEMBERS * PODS_PER_MEMBER))
+got="$(curl -fsS "http://127.0.0.1:${SRV_PORT}/metrics" | awk '
+/^kwok_transitions_total/ {sum += $2} END {printf "%d", sum}')"
+[ "${got}" -ge "${want}" ] || {
+  echo "federated transitions_total=${got}, want >= ${want}" >&2
+  exit 1
+}
+
+echo "kwok_federation_test.sh passed (${N_MEMBERS} members, transitions=${got})"
