@@ -39,11 +39,13 @@ from concurrent.futures import ThreadPoolExecutor
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-# the rig measures the HTTP edge, not device math — every process (this one
+# The rig measures the HTTP edge, not device math — every process (this one
 # and the spawned engine/apiserver) runs CPU JAX so nothing claims the
-# (single, tunneled) TPU chip; export JAX_PLATFORMS=tpu explicitly to bench
-# the device path end to end
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# (single, tunneled) TPU chip. The build environment exports
+# JAX_PLATFORMS=axon (the TPU tunnel), which only works for ONE process at
+# a time, so an inherited value is overridden, not respected; set
+# KWOK_TPU_SOAK_PLATFORM=tpu explicitly to bench the device path end to end.
+os.environ["JAX_PLATFORMS"] = os.environ.get("KWOK_TPU_SOAK_PLATFORM", "cpu")
 
 
 def _child_env() -> dict:
@@ -213,6 +215,9 @@ def main() -> None:
     p.add_argument("--tick-interval", type=float, default=0.02)
     p.add_argument("--in-process", action="store_true",
                    help="single-interpreter mode (tests); GIL-bound")
+    p.add_argument("--no-native-load", action="store_true",
+                   help="force the Python thread/process load generator "
+                   "even when the native pump is available")
     args = p.parse_args()
 
     from kwok_tpu.edge.httpclient import HttpKubeClient
@@ -245,40 +250,75 @@ def main() -> None:
         srv_port = netutil.get_unused_port()
         url = f"http://127.0.0.1:{api_port}"
         metrics_url = f"http://127.0.0.1:{srv_port}"
+        logdir = os.environ.get("KWOK_TPU_SOAK_LOGDIR", "/tmp/kwok-tpu-soak")
+        os.makedirs(logdir, exist_ok=True)
+        api_log = open(os.path.join(logdir, "apiserver.log"), "wb")
+        eng_log = open(os.path.join(logdir, "engine.log"), "wb")
+        from kwok_tpu import native
+
+        apiserver_bin = native.apiserver_binary()
+        if apiserver_bin:
+            api_cmd = [apiserver_bin, "--port", str(api_port)]
+        else:
+            api_cmd = [sys.executable, "-m", "kwok_tpu.edge.mockserver",
+                       "--port", str(api_port)]
         procs.append(subprocess.Popen(
-            [sys.executable, "-m", "kwok_tpu.edge.mockserver",
-             "--port", str(api_port)],
-            env=_child_env(), stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+            api_cmd,
+            env=_child_env(), stdout=api_log, stderr=api_log,
         ))
-        _wait_http(url, "/healthz")
+        _wait_http(url, "/healthz", timeout=60.0)
+        prof = os.environ.get("KWOK_TPU_SOAK_PROFILE_ENGINE", "")
+        prof_args = ["-m", "cProfile", "-o", prof] if prof else []
         procs.append(subprocess.Popen(
-            [sys.executable, "-m", "kwok_tpu.kwok",
+            [sys.executable, *prof_args, "-m", "kwok_tpu.kwok",
              "--master", url,
              "--manage-all-nodes", "true",
              "--tick-interval", str(args.tick_interval),
              "--parallelism", str(args.engine_parallelism),
              "--initial-capacity", str(max(args.pods, args.nodes, 4096)),
              "--server-address", f"127.0.0.1:{srv_port}"],
-            env=_child_env(), stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+            env=_child_env(), stdout=eng_log, stderr=eng_log,
         ))
-        _wait_http(metrics_url, "/healthz")
+        _wait_http(metrics_url, "/healthz", timeout=60.0)
 
     client = HttpKubeClient.from_kubeconfig(None, url)
     poller = _Poller(url)
     pool = ThreadPoolExecutor(max_workers=max(args.workers, 16))
 
+    # Native load generator: one C++ pump call per phase issues the whole
+    # batch over pipelined keep-alive connections (the loader would
+    # otherwise dominate a shared-core host and hide the engine's number).
+    pump = None
+    if not args.no_native_load:
+        from kwok_tpu import native
+
+        split = urllib.parse.urlsplit(url)
+        if split.scheme == "http" and native.available():
+            pump = native.Pump(split.hostname, split.port, nconn=4)
+
     try:
         # --- nodes -> Ready ------------------------------------------------
         t_nodes = time.perf_counter()
-        list(pool.map(
-            lambda i: client.create("nodes", {
-                "apiVersion": "v1", "kind": "Node",
-                "metadata": {"name": f"soak-node-{i}"},
-            }),
-            range(args.nodes),
-        ))
+        if pump is not None:
+            reqs = [
+                ("POST", "/api/v1/nodes", json.dumps({
+                    "apiVersion": "v1", "kind": "Node",
+                    "metadata": {"name": f"soak-node-{i}"},
+                }).encode())
+                for i in range(args.nodes)
+            ]
+            st = pump.send(reqs)
+            ok = int(((st >= 200) & (st < 300)).sum())
+            if ok < args.nodes:
+                raise SystemExit(f"node load: only {ok}/{args.nodes} created")
+        else:
+            list(pool.map(
+                lambda i: client.create("nodes", {
+                    "apiVersion": "v1", "kind": "Node",
+                    "metadata": {"name": f"soak-node-{i}"},
+                }),
+                range(args.nodes),
+            ))
         create_nodes_s = time.perf_counter() - t_nodes
         deadline = time.monotonic() + args.timeout
         poll = max(0.2, min(2.0, args.pods / 50000))
@@ -292,7 +332,35 @@ def main() -> None:
         t_pods = time.perf_counter()
         bind = "0" if args.no_bind else "1"
         n_load = max(1, args.load_procs)
-        if args.in_process or n_load == 1:
+        if pump is not None:
+            reqs = [
+                ("POST", "/api/v1/namespaces/default/pods", json.dumps({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"soak-pod-{i}",
+                                 "namespace": "default"},
+                    "spec": {"containers": [{"name": "c", "image": "soak"}]},
+                    "status": {"phase": "Pending"},
+                }).encode())
+                for i in range(args.pods)
+            ]
+            st = pump.send(reqs)
+            ok = int(((st >= 200) & (st < 300)).sum())
+            if ok < args.pods:
+                raise SystemExit(f"pod load: only {ok}/{args.pods} created")
+            if bind == "1":
+                reqs = [
+                    ("PATCH", f"/api/v1/namespaces/default/pods/soak-pod-{i}",
+                     json.dumps({"spec": {
+                         "nodeName": f"soak-node-{i % args.nodes}",
+                     }}).encode(),
+                     "application/merge-patch+json")
+                    for i in range(args.pods)
+                ]
+                st = pump.send(reqs)
+                ok = int(((st >= 200) & (st < 300)).sum())
+                if ok < args.pods:
+                    raise SystemExit(f"bind: only {ok}/{args.pods} bound")
+        elif args.in_process or n_load == 1:
             sys.argv = ["soak", url, "0", str(args.pods), str(args.nodes),
                         bind, str(args.workers)]
             _load_worker_entry()
@@ -357,6 +425,8 @@ def main() -> None:
             srv.stop()
         print(json.dumps(out))
     finally:
+        if pump is not None:
+            pump.close()
         for proc in procs:
             proc.terminate()
         for proc in procs:

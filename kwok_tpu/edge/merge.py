@@ -16,7 +16,6 @@ in core/v1).
 from __future__ import annotations
 
 import copy
-import json
 from typing import Any
 
 # path (tuple of dict keys, "*" wildcard not needed here) -> merge key
@@ -56,8 +55,35 @@ def _merge_value(orig: Any, patch: Any, mk: dict[str, str], field: str | None) -
     return copy.deepcopy(patch)
 
 
-def _canonical(doc: Any) -> str:
-    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+def _merge_view(orig: Any, patch: Any, mk: dict[str, str], field: str | None) -> Any:
+    """strategic_merge without the defensive deepcopies: shares unmodified
+    subtrees with its inputs. ONLY for read-only comparison (the no-op
+    suppression checks below run once per watch event — at O(10k) events/s
+    the copies dominated the engine's ingest profile). The comparisons use
+    Python `==`, which unlike the former canonical-JSON compare treats
+    1 == 1.0 == True — a deliberate narrowing (k8s numeric equality)."""
+    if isinstance(patch, dict) and isinstance(orig, dict):
+        out = dict(orig)
+        for k, v in patch.items():
+            if v is None:
+                out.pop(k, None)
+            elif k in out:
+                out[k] = _merge_view(out[k], v, mk, field=k)
+            else:
+                out[k] = v
+        return out
+    if isinstance(patch, list) and isinstance(orig, list) and field in mk:
+        key = mk[field]
+        out_list = list(orig)
+        index = {x.get(key): i for i, x in enumerate(out_list) if isinstance(x, dict)}
+        for item in patch:
+            if isinstance(item, dict) and item.get(key) in index:
+                i = index[item[key]]
+                out_list[i] = _merge_view(out_list[i], item, mk, field=None)
+            else:
+                out_list.append(item)
+        return out_list
+    return patch
 
 
 def node_status_patch_needed(current_status: dict, rendered: dict) -> bool:
@@ -65,18 +91,18 @@ def node_status_patch_needed(current_status: dict, rendered: dict) -> bool:
     the current value (node_controller.go:377 `nodeStatus.Conditions =
     node.Status.Conditions`) — heartbeat-only condition changes do not
     count as drift."""
-    merged = strategic_merge(current_status, rendered)
+    merged = _merge_view(current_status, rendered, _MERGE_KEYS, None)
     merged = dict(merged)
     if "conditions" in current_status:
         merged["conditions"] = current_status["conditions"]
     else:
         merged.pop("conditions", None)
-    return _canonical(merged) != _canonical(current_status)
+    return merged != current_status
 
 
 def pod_status_patch_needed(current_status: dict, rendered: dict) -> bool:
     """computePatchData's check: only suppress when phase != Pending."""
     if current_status.get("phase", "Pending") == "Pending":
         return True
-    merged = strategic_merge(current_status, rendered)
-    return _canonical(merged) != _canonical(current_status)
+    merged = _merge_view(current_status, rendered, _MERGE_KEYS, None)
+    return merged != current_status

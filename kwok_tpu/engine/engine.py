@@ -20,6 +20,7 @@ Architecture (replaces pkg/kwok/controllers/controller.go + node_controller.go
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import queue
@@ -217,6 +218,13 @@ class ClusterEngine:
 
             if native.available():
                 self._codec = native
+        # Batched pipelined egress (native/pump.cc): one C++ call sends a
+        # whole tick's status patches over pooled keep-alive connections,
+        # GIL-free. Plain-HTTP apiservers only (the mock/lab edge); TLS
+        # clusters use the executor path below. Built lazily on first emit.
+        self._pump = None
+        self._pump_tried = False
+        self._pump_lock = threading.Lock()
         self._hb_cond_meta = [
             (name, *_NODE_CONDITION_META.get(name, ("KwokRule", name)))
             for name in NODE_PHASES.conditions
@@ -333,6 +341,9 @@ class ClusterEngine:
             t.join(timeout=5)
         if self._executor:
             self._executor.shutdown(wait=True)
+        if self._pump is not None:
+            self._pump.close()
+            self._pump = None
         close = getattr(self.client, "close", None)
         if callable(close):  # release pooled keep-alive connections
             close()
@@ -748,12 +759,75 @@ class ClusterEngine:
             self._inc("patch_errors_total")
             logger.exception("patch job failed")
 
+    def _get_pump(self):
+        """Native pump bound to the client's plain-HTTP endpoint, or None
+        (TLS/in-process clients keep the executor path)."""
+        if self._pump_tried:
+            return self._pump
+        self._pump_tried = True
+        if self._codec is None:
+            return None
+        server = getattr(self.client, "server", "")
+        if not isinstance(server, str) or not server.startswith("http://"):
+            return None
+        host = getattr(self.client, "_host", None)
+        port = getattr(self.client, "_port", None)
+        base = getattr(self.client, "_base_path", "") or ""
+        if not host or not port:
+            return None
+        token = getattr(self.client, "token", None)
+        extra = f"Authorization: Bearer {token}\r\n" if token else ""
+        try:
+            self._pump = self._codec.Pump(host, int(port), nconn=4, header_extra=extra)
+            self._pump_base = base
+        except Exception:
+            logger.exception("native pump unavailable; using executor egress")
+            self._pump = None
+        return self._pump
+
+    def _emit_nodes_native(self, k, idxs: list[int]) -> None:
+        """Render node status patches in Python (cold-ish: node transitions
+        are rare relative to pods) but ship them in ONE pump batch instead
+        of a round-trip per node."""
+        import urllib.parse
+
+        now = now_rfc3339()
+        reqs, sent = [], []
+        for idx in idxs:
+            name = k.pool.key_of(idx)
+            m = k.pool.meta[idx]
+            if name is None or not m:
+                continue
+            node = m.get("obj") or {}
+            current = node.get("status") or {}
+            rendered = render_node_status(
+                node, int(k.cond_h[idx]), self.config.node_ip, now,
+                self.start_time,
+            )
+            if not node_status_patch_needed(current, rendered):
+                continue
+            body = json.dumps({"status": rendered}, separators=(",", ":")).encode()
+            reqs.append((
+                "PATCH",
+                f"{self._pump_base}/api/v1/nodes/"
+                f"{urllib.parse.quote(name)}/status",
+                body,
+                "application/strategic-merge-patch+json",
+            ))
+            sent.append(idx)
+        if reqs:
+            self._submit(self._pump_send, reqs, sent, "nodes")
+
     def _emit(self, kind, k, dirty, deleted, hb, now_str) -> None:
         if kind == "nodes":
-            for idx in np.nonzero(dirty)[0]:
-                name = k.pool.key_of(int(idx))
+            node_rows = [int(i) for i in np.nonzero(dirty)[0]]
+            if len(node_rows) > 1 and self._get_pump() is not None:
+                self._emit_nodes_native(k, node_rows)
+                node_rows = []
+            for idx in node_rows:
+                name = k.pool.key_of(idx)
                 if name is not None:
-                    self._submit(self._patch_node_status, name, int(idx))
+                    self._submit(self._patch_node_status, name, idx)
             hb_rows = [
                 (name, int(idx))
                 for idx in np.nonzero(hb)[0]
@@ -765,14 +839,136 @@ class ClusterEngine:
                 for name, idx in hb_rows:
                     self._submit(self._heartbeat_node, name, idx, now_str)
         else:
-            for idx in np.nonzero(dirty)[0]:
-                key = k.pool.key_of(int(idx))
+            dirty_rows = [int(i) for i in np.nonzero(dirty)[0]]
+            if len(dirty_rows) > 1 and self._get_pump() is not None:
+                dirty_rows = self._emit_pods_native(k, dirty_rows)
+            for idx in dirty_rows:
+                key = k.pool.key_of(idx)
                 if key is not None:
-                    self._submit(self._patch_pod_status, key, int(idx))
+                    self._submit(self._patch_pod_status, key, idx)
             for idx in np.nonzero(deleted)[0]:
                 key = k.pool.key_of(int(idx))
                 if key is not None:
                     self._submit(self._delete_pod, key, int(idx))
+
+    _POD_KIND = {"Running": 0, "Succeeded": 1, "Failed": 2}
+
+    def _emit_pods_native(self, k, idxs: list[int]) -> list[int]:
+        """Batch path for transition-driven pod patches: C++ renders every
+        body (codec.render_pod_statuses) and the pump sends them in one
+        GIL-free call. Returns the rows that must take the Python path
+        (readiness gates, CNI, suppression checks, missing state). Runs on
+        the tick thread — the only row mutator — so rows cannot vanish
+        mid-batch."""
+        import urllib.parse
+
+        slow: list[int] = []
+        rows: list[tuple[int, tuple]] = []
+        cni_live = self.config.enable_cni and cni.available()
+        for idx in idxs:
+            key = k.pool.key_of(idx)
+            m = k.pool.meta[idx]
+            if key is None or not m or "obj" not in m:
+                continue
+            phase_name = POD_PHASES.phases[int(k.phase_h[idx])]
+            if phase_name == "Gone":
+                continue
+            obj = m["obj"]
+            spec = obj.get("spec") or {}
+            status = obj.get("status") or {}
+            if cni_live or spec.get("readinessGates"):
+                slow.append(idx)
+                continue
+            if status.get("phase") == phase_name:
+                # target phase already on the server: the reference would
+                # run the full merge/no-op check — keep that path exact
+                slow.append(idx)
+                continue
+            ip = m.get("podIP")
+            if not ip:
+                with self._alloc_lock:
+                    ip = m.get("podIP")
+                    if not ip:
+                        ip = self.ippool.get()
+                        m["podIP"] = ip
+            meta = obj.get("metadata") or {}
+            start = meta.get("creationTimestamp") or now_rfc3339()
+            ctr = b"\x1e".join(
+                f"{c.get('name') or ''}\x1f{c.get('image') or ''}".encode()
+                for c in spec.get("containers") or []
+            )
+            ictr = b"\x1e".join(
+                f"{c.get('name') or ''}\x1f{c.get('image') or ''}".encode()
+                for c in spec.get("initContainers") or []
+            )
+            ns, name = key
+            path = (
+                f"{self._pump_base}/api/v1/namespaces/"
+                f"{urllib.parse.quote(ns)}/pods/{urllib.parse.quote(name)}/status"
+            )
+            rows.append((
+                idx,
+                (
+                    self._POD_KIND.get(phase_name, 0),
+                    int(k.cond_h[idx]),
+                    phase_name.encode(),
+                    (status.get("hostIP") or self.config.node_ip).encode(),
+                    ip.encode(),
+                    start.encode(),
+                    ctr,
+                    ictr,
+                    path,
+                ),
+            ))
+        if not rows:
+            return slow
+        bodies = self._codec.render_pod_statuses(
+            np.array([r[1][0] for r in rows], np.uint8),
+            np.array([r[1][1] for r in rows], np.uint32),
+            [r[1][2] for r in rows],
+            list(POD_PHASES.conditions[:3]),
+            [r[1][3] for r in rows],
+            [r[1][4] for r in rows],
+            [r[1][5] for r in rows],
+            [r[1][6] for r in rows],
+            [r[1][7] for r in rows],
+        )
+        if bodies is None:
+            return slow + [r[0] for r in rows]
+        reqs = [
+            ("PATCH", r[1][8], body, "application/strategic-merge-patch+json")
+            for r, body in zip(rows, bodies)
+        ]
+        self._submit(self._pump_send, reqs, [r[0] for r in rows], "pods")
+        return slow
+
+    def _pump_send(self, reqs, idxs, kind) -> None:
+        """One executor job sends the whole batch; rows whose connection
+        died are retried through the per-object Python path."""
+        with self._pump_lock:
+            status = self._pump.send(reqs)
+        ok = int(((status >= 200) & (status < 300)).sum())
+        if kind == "heartbeat":
+            self._inc("heartbeats_total", ok)
+        else:
+            self._inc("status_patches_total", ok)
+        for st, idx in zip(status.tolist(), idxs):
+            if 200 <= st < 300 or st == 404:
+                continue  # 404 = object deleted server-side; Python path
+                # treats that as a no-op too
+            if kind == "pods":
+                key = self.pods.pool.key_of(idx)
+                if key is not None:
+                    self._submit(self._patch_pod_status, key, idx)
+            elif kind == "nodes":
+                name = self.nodes.pool.key_of(idx)
+                if name is not None:
+                    self._submit(self._patch_node_status, name, idx)
+            elif kind == "heartbeat":
+                name = self.nodes.pool.key_of(idx)
+                if name is not None:
+                    self._inc("patch_errors_total")
+                    logger.warning("heartbeat pump send failed for %s: %s", name, st)
 
     def _patch_node_status(self, name: str, idx: int) -> None:
         k = self.nodes
@@ -808,6 +1004,23 @@ class ClusterEngine:
         if bodies is None:  # codec raced away; fall back
             for name, idx in hb_rows:
                 self._submit(self._heartbeat_node, name, idx, now_str)
+            return
+        if self._get_pump() is not None:
+            import urllib.parse
+
+            reqs = [
+                (
+                    "PATCH",
+                    f"{self._pump_base}/api/v1/nodes/"
+                    f"{urllib.parse.quote(name)}/status",
+                    body,
+                    "application/strategic-merge-patch+json",
+                )
+                for (name, _idx), body in zip(hb_rows, bodies)
+            ]
+            self._submit(
+                self._pump_send, reqs, [i for _, i in hb_rows], "heartbeat"
+            )
             return
         for (name, _idx), body in zip(hb_rows, bodies):
             self._submit(self._send_heartbeat_bytes, name, body)

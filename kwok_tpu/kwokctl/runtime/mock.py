@@ -33,6 +33,12 @@ from kwok_tpu.edge.mockserver import main
 sys.exit(main(sys.argv[1:]))
 """
 
+_APISERVER_NATIVE = """\
+#!/bin/sh
+# generated mock kube-apiserver shim -> native binary (kwok_tpu mock runtime)
+exec {binary} "$@"
+"""
+
 
 class MockCluster(BinaryCluster):
     """BinaryCluster with downloads replaced by generated shims."""
@@ -50,9 +56,21 @@ class MockCluster(BinaryCluster):
     def _write_apiserver_shim(self) -> None:
         shim = self.bin_path("kube-apiserver")
         os.makedirs(os.path.dirname(shim), exist_ok=True)
-        repo_paths = [p for p in sys.path if p]
+        # Prefer the compiled apiserver (same wire protocol, native speed,
+        # see native/apiserver.cc); fall back to the Python mockserver shim
+        # when no compiler is available or KWOK_TPU_NATIVE=0.
+        from kwok_tpu import native
+
+        binary = native.apiserver_binary()
+        if binary:
+            content = _APISERVER_NATIVE.format(binary=binary)
+        else:
+            repo_paths = [p for p in sys.path if p]
+            content = _APISERVER_MAIN.format(
+                python=sys.executable, syspath=repo_paths
+            )
         with open(shim, "w") as f:
-            f.write(_APISERVER_MAIN.format(python=sys.executable, syspath=repo_paths))
+            f.write(content)
         os.chmod(shim, os.stat(shim).st_mode | stat.S_IEXEC | stat.S_IXGRP | stat.S_IXOTH)
 
     def _setup_workdir(self) -> None:

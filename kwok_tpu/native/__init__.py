@@ -22,17 +22,26 @@ logger = logging.getLogger("kwok_tpu.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "codec.cc")
+_PUMP_SRC = os.path.join(_DIR, "pump.cc")
 _LIB = os.path.join(_DIR, "libkwokcodec.so")
-ABI_VERSION = 1
+_APISERVER_SRC = os.path.join(_DIR, "apiserver.cc")
+_APISERVER_BIN = os.path.join(_DIR, "kwok-mock-apiserver")
+ABI_VERSION = 2
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
+_apiserver_lock = threading.Lock()
+_apiserver_path: str | None = None
+_apiserver_tried = False
 
 
 def _build() -> bool:
     cxx = os.environ.get("CXX", "g++")
-    cmd = [cxx, "-O2", "-shared", "-fPIC", "-o", _LIB + ".tmp", _SRC]
+    cmd = [
+        cxx, "-O2", "-std=c++17", "-pthread", "-shared", "-fPIC",
+        "-o", _LIB + ".tmp", _SRC, _PUMP_SRC,
+    ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (OSError, subprocess.SubprocessError) as e:
@@ -67,6 +76,22 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p, i64p,
         ctypes.c_char_p, ctypes.c_int64, i64p,
     ]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.kwok_pump_open.restype = ctypes.c_int64
+    lib.kwok_pump_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
+    ]
+    lib.kwok_pump_send.restype = ctypes.c_int64
+    lib.kwok_pump_send.argtypes = [
+        ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, i64p,
+        ctypes.c_char_p, i64p,
+        i32p,
+    ]
+    lib.kwok_pump_close.restype = None
+    lib.kwok_pump_close.argtypes = [ctypes.c_int64]
     return lib
 
 
@@ -77,8 +102,8 @@ def load() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        fresh = os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
-            _SRC
+        fresh = os.path.exists(_LIB) and os.path.getmtime(_LIB) >= max(
+            os.path.getmtime(_SRC), os.path.getmtime(_PUMP_SRC)
         )
         if not fresh and not _build():
             return None
@@ -99,6 +124,95 @@ def load() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return load() is not None
+
+
+class Pump:
+    """Batched pipelined HTTP client over a fixed pool of keep-alive
+    connections (native/pump.cc). send() blocks outside the GIL while the
+    whole batch is written/read, so O(10k) unary requests cost one Python
+    call. Response bodies are discarded by design: the engine learns state
+    from the watch echo; callers only get status codes back."""
+
+    def __init__(
+        self, host: str, port: int, nconn: int = 4, header_extra: str = ""
+    ) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.kwok_pump_open(
+            host.encode(), port, nconn, header_extra.encode()
+        )
+
+    def send(self, requests: list[tuple]) -> "np.ndarray":
+        """requests: (method, path, body[, content_type]) tuples; the
+        content type defaults to application/json (k8s PATCH verbs need
+        their specific patch types — pass them explicitly). Returns the
+        per-request HTTP status array (0 = connection failure, caller may
+        retry)."""
+        n = len(requests)
+        status = np.zeros(n, np.int32)
+        if n == 0:
+            return status
+        m_blob, m_off = _blob([r[0].encode() for r in requests])
+        p_blob, p_off = _blob([r[1].encode() for r in requests])
+        b_blob, b_off = _blob([bytes(r[2]) for r in requests])
+        c_blob, c_off = _blob(
+            [(r[3].encode() if len(r) > 3 else b"") for r in requests]
+        )
+        self._lib.kwok_pump_send(
+            self._handle, n,
+            m_blob, _i64p(m_off),
+            p_blob, _i64p(p_off),
+            c_blob, _i64p(c_off),
+            b_blob, _i64p(b_off),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return status
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.kwok_pump_close(self._handle)
+            self._handle = 0
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def apiserver_binary() -> str | None:
+    """Path to the native mock kube-apiserver, compiling it on first use
+    (mtime-cached next to the source). None when no compiler is available —
+    callers fall back to the Python mockserver shim. Disabled along with the
+    rest of the native layer by KWOK_TPU_NATIVE=0."""
+    global _apiserver_path, _apiserver_tried
+    if os.environ.get("KWOK_TPU_NATIVE", "1") == "0":
+        return None
+    with _apiserver_lock:
+        if _apiserver_path is not None or _apiserver_tried:
+            return _apiserver_path
+        _apiserver_tried = True
+        fresh = os.path.exists(_APISERVER_BIN) and os.path.getmtime(
+            _APISERVER_BIN
+        ) >= os.path.getmtime(_APISERVER_SRC)
+        if not fresh:
+            cxx = os.environ.get("CXX", "g++")
+            cmd = [
+                cxx, "-O2", "-std=c++17", "-pthread",
+                "-o", _APISERVER_BIN + ".tmp", _APISERVER_SRC,
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+            except (OSError, subprocess.SubprocessError) as e:
+                logger.info(
+                    "native apiserver build failed (%s); using python mock", e
+                )
+                return None
+            os.replace(_APISERVER_BIN + ".tmp", _APISERVER_BIN)
+        _apiserver_path = _APISERVER_BIN
+        return _apiserver_path
 
 
 def _blob(items: list[bytes]) -> tuple[bytes, np.ndarray]:

@@ -1,0 +1,1420 @@
+// kwok-mock-apiserver: native in-memory kube-apiserver for the mock runtime.
+//
+// The Python HttpFakeApiserver (kwok_tpu/edge/mockserver.py) is the semantic
+// source of truth; this binary speaks the same wire protocol at native
+// speed so the lab apiserver is never the wall when benchmarking the
+// engine's watch/patch edge (SURVEY.md §7 "Hard parts": the edge, not the
+// math, is the bottleneck; the reference sidesteps it by being slow).
+// kwokctl's mock runtime prefers this binary when a compiler is available
+// and falls back to the Python shim otherwise; both serve:
+//
+//   GET    /healthz                      -> "ok"
+//   GET    /snapshot                     -> whole-store dump (mock etcdctl)
+//   POST   /restore                      -> replace store, close watches
+//   GET    /api/v1[/namespaces/NS]/KIND              list (+watch=true)
+//   GET    /api/v1[/namespaces/NS]/KIND/NAME         get
+//   POST   /api/v1[/namespaces/NS]/KIND              create
+//   PATCH  /api/v1[/namespaces/NS]/KIND/NAME[/status] strategic-merge status
+//                                                     / merge-patch meta+spec
+//   DELETE /api/v1[/namespaces/NS]/KIND/NAME         (graceful for pods)
+//
+// Concurrency model: thread-per-connection (connection counts are bounded:
+// engine watches + patch pool + loaders), one store mutex. Each watch event
+// is serialized ONCE and the bytes shared across all matching watchers.
+// JSON numbers are kept as raw token text end-to-end so stored objects
+// round-trip byte-exactly.
+//
+// Build: g++ -O2 -std=c++17 -pthread -o kwok-mock-apiserver apiserver.cc
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+// ---------------------------------------------------------------- JSON DOM
+
+struct JVal;
+using JObj = std::vector<std::pair<std::string, JVal>>;  // insertion order
+
+struct JVal {
+  enum Type : uint8_t { NUL, BOOL, NUM, STR, ARR, OBJ } type = NUL;
+  bool b = false;
+  std::string s;  // STR: decoded text; NUM: raw token text
+  std::vector<JVal> arr;
+  JObj obj;
+
+  bool is_obj() const { return type == OBJ; }
+  const JVal* find(const std::string& k) const {
+    if (type != OBJ) return nullptr;
+    for (const auto& kv : obj)
+      if (kv.first == k) return &kv.second;
+    return nullptr;
+  }
+  JVal* find(const std::string& k) {
+    if (type != OBJ) return nullptr;
+    for (auto& kv : obj)
+      if (kv.first == k) return &kv.second;
+    return nullptr;
+  }
+  JVal& set(const std::string& k, JVal v) {
+    if (JVal* e = find(k)) {
+      *e = std::move(v);
+      return *e;
+    }
+    obj.emplace_back(k, std::move(v));
+    return obj.back().second;
+  }
+  JVal& get_or_insert_obj(const std::string& k) {
+    if (JVal* e = find(k)) {
+      if (e->type != OBJ) *e = JVal{OBJ};
+      return *e;
+    }
+    JVal v;
+    v.type = OBJ;
+    obj.emplace_back(k, std::move(v));
+    return obj.back().second;
+  }
+  void erase(const std::string& k) {
+    if (type != OBJ) return;
+    for (auto it = obj.begin(); it != obj.end(); ++it)
+      if (it->first == k) {
+        obj.erase(it);
+        return;
+      }
+  }
+  static JVal str(std::string v) {
+    JVal j;
+    j.type = STR;
+    j.s = std::move(v);
+    return j;
+  }
+  static JVal num_raw(std::string v) {
+    JVal j;
+    j.type = NUM;
+    j.s = std::move(v);
+    return j;
+  }
+};
+
+// --- parser (recursive descent; tolerant of whitespace; \uXXXX -> UTF-8)
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JParser(const std::string& text)
+      : p(text.data()), end(text.data() + text.size()) {}
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      p++;
+  }
+  bool lit(const char* s, size_t n) {
+    if ((size_t)(end - p) < n || std::memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  JVal parse() {
+    ws();
+    JVal v = value();
+    ws();
+    if (p != end) ok = false;
+    return v;
+  }
+
+  JVal value() {
+    if (p >= end) {
+      ok = false;
+      return {};
+    }
+    switch (*p) {
+      case '{':
+        return object();
+      case '[':
+        return array();
+      case '"': {
+        JVal v;
+        v.type = JVal::STR;
+        v.s = string();
+        return v;
+      }
+      case 't':
+        if (lit("true", 4)) {
+          JVal v;
+          v.type = JVal::BOOL;
+          v.b = true;
+          return v;
+        }
+        break;
+      case 'f':
+        if (lit("false", 5)) {
+          JVal v;
+          v.type = JVal::BOOL;
+          v.b = false;
+          return v;
+        }
+        break;
+      case 'n':
+        if (lit("null", 4)) return {};
+        break;
+      default:
+        if (*p == '-' || (*p >= '0' && *p <= '9')) return number();
+    }
+    ok = false;
+    return {};
+  }
+
+  JVal number() {
+    const char* s = p;
+    if (p < end && *p == '-') p++;
+    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+                       *p == 'E' || *p == '+' || *p == '-'))
+      p++;
+    JVal v;
+    v.type = JVal::NUM;
+    v.s.assign(s, p - s);
+    return v;
+  }
+
+  std::string string() {
+    std::string out;
+    if (p >= end || *p != '"') {
+      ok = false;
+      return out;
+    }
+    p++;
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        p++;
+        if (p >= end) break;
+        switch (*p) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            unsigned cp = hex4();
+            if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 && p[1] == '\\' &&
+                p[2] == 'u') {
+              p += 2;
+              unsigned lo = hex4();
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: ok = false;
+        }
+        p++;
+      } else {
+        out += *p++;
+      }
+    }
+    if (p < end) p++;  // closing quote
+    else ok = false;
+    return out;
+  }
+
+  unsigned hex4() {
+    unsigned v = 0;
+    for (int i = 0; i < 4 && p + 1 < end; i++) {
+      p++;
+      char c = *p;
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= c - '0';
+      else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+      else ok = false;
+    }
+    return v;
+  }
+
+  static void append_utf8(std::string& out, unsigned cp) {
+    if (cp < 0x80) {
+      out += (char)cp;
+    } else if (cp < 0x800) {
+      out += (char)(0xC0 | (cp >> 6));
+      out += (char)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += (char)(0xE0 | (cp >> 12));
+      out += (char)(0x80 | ((cp >> 6) & 0x3F));
+      out += (char)(0x80 | (cp & 0x3F));
+    } else {
+      out += (char)(0xF0 | (cp >> 18));
+      out += (char)(0x80 | ((cp >> 12) & 0x3F));
+      out += (char)(0x80 | ((cp >> 6) & 0x3F));
+      out += (char)(0x80 | (cp & 0x3F));
+    }
+  }
+
+  JVal object() {
+    JVal v;
+    v.type = JVal::OBJ;
+    p++;  // {
+    ws();
+    if (p < end && *p == '}') {
+      p++;
+      return v;
+    }
+    while (p < end) {
+      ws();
+      std::string k = string();
+      ws();
+      if (p >= end || *p != ':') {
+        ok = false;
+        return v;
+      }
+      p++;
+      ws();
+      v.obj.emplace_back(std::move(k), value());
+      ws();
+      if (p < end && *p == ',') {
+        p++;
+        continue;
+      }
+      break;
+    }
+    if (p < end && *p == '}') p++;
+    else ok = false;
+    return v;
+  }
+
+  JVal array() {
+    JVal v;
+    v.type = JVal::ARR;
+    p++;  // [
+    ws();
+    if (p < end && *p == ']') {
+      p++;
+      return v;
+    }
+    while (p < end) {
+      ws();
+      v.arr.push_back(value());
+      ws();
+      if (p < end && *p == ',') {
+        p++;
+        continue;
+      }
+      break;
+    }
+    if (p < end && *p == ']') p++;
+    else ok = false;
+    return v;
+  }
+};
+
+static void json_escape(std::string& out, const std::string& s) {
+  static const char hex[] = "0123456789abcdef";
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          out += "\\u00";
+          out += hex[c >> 4];
+          out += hex[c & 15];
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+}
+
+static void serialize(const JVal& v, std::string& out) {
+  switch (v.type) {
+    case JVal::NUL: out += "null"; break;
+    case JVal::BOOL: out += v.b ? "true" : "false"; break;
+    case JVal::NUM: out += v.s; break;
+    case JVal::STR:
+      out += '"';
+      json_escape(out, v.s);
+      out += '"';
+      break;
+    case JVal::ARR: {
+      out += '[';
+      for (size_t i = 0; i < v.arr.size(); i++) {
+        if (i) out += ',';
+        serialize(v.arr[i], out);
+      }
+      out += ']';
+      break;
+    }
+    case JVal::OBJ: {
+      out += '{';
+      for (size_t i = 0; i < v.obj.size(); i++) {
+        if (i) out += ',';
+        out += '"';
+        json_escape(out, v.obj[i].first);
+        out += "\":";
+        serialize(v.obj[i].second, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+static std::string dumps(const JVal& v) {
+  std::string out;
+  serialize(v, out);
+  return out;
+}
+
+// ------------------------------------------------------------- selectors
+
+// Label-selector grammar mirror of kwok_tpu/edge/selectors.py: `k=v`,
+// `k==v`, `k!=v`, `k in (a,b)`, `k notin (a,b)`, `k`, `!k`, comma-joined.
+struct LabelReq {
+  enum Op { EQ, NE, IN, NOTIN, EXISTS, NOTEXISTS } op;
+  std::string key;
+  std::vector<std::string> values;
+
+  bool matches(const JVal* labels) const {
+    const JVal* v = labels ? labels->find(key) : nullptr;
+    bool present = v != nullptr && v->type == JVal::STR;
+    switch (op) {
+      case EXISTS: return v != nullptr;
+      case NOTEXISTS: return v == nullptr;
+      case EQ:
+      case IN: {
+        if (!present) return false;
+        for (const auto& x : values)
+          if (x == v->s) return true;
+        return false;
+      }
+      case NE:
+      case NOTIN: {
+        if (!present) return true;  // absent matches != / notin
+        for (const auto& x : values)
+          if (x == v->s) return false;
+        return true;
+      }
+    }
+    return false;
+  }
+};
+
+static std::string strip(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t");
+  return s.substr(a, b - a + 1);
+}
+
+static std::vector<std::string> split_top_level(const std::string& s) {
+  std::vector<std::string> parts;
+  int depth = 0;
+  std::string cur;
+  for (char ch : s) {
+    if (ch == '(') depth++;
+    else if (ch == ')') depth--;
+    if (ch == ',' && depth == 0) {
+      std::string t = strip(cur);
+      if (!t.empty()) parts.push_back(t);
+      cur.clear();
+    } else {
+      cur += ch;
+    }
+  }
+  std::string t = strip(cur);
+  if (!t.empty()) parts.push_back(t);
+  return parts;
+}
+
+struct LabelSel {
+  std::vector<LabelReq> reqs;
+  bool parsed = false;  // false => no selector (match everything)
+
+  static LabelSel parse(const std::string& s) {
+    LabelSel sel;
+    std::string t = strip(s);
+    if (t.empty()) return sel;
+    sel.parsed = true;
+    for (const std::string& part : split_top_level(t)) {
+      LabelReq r;
+      size_t sp = part.find(' ');
+      // `key in (a,b)` / `key notin (a,b)`
+      if (sp != std::string::npos) {
+        std::string key = strip(part.substr(0, sp));
+        std::string rest = strip(part.substr(sp));
+        bool isin = rest.rfind("in", 0) == 0 && rest.find('(') != std::string::npos;
+        bool isnot = rest.rfind("notin", 0) == 0;
+        if ((isin || isnot) && key.find('=') == std::string::npos &&
+            key.find('!') == std::string::npos) {
+          size_t lp = rest.find('('), rp = rest.rfind(')');
+          if (lp != std::string::npos && rp != std::string::npos && rp > lp) {
+            r.key = key;
+            r.op = isnot ? LabelReq::NOTIN : LabelReq::IN;
+            std::string vals = rest.substr(lp + 1, rp - lp - 1);
+            size_t pos = 0;
+            while (pos <= vals.size()) {
+              size_t c = vals.find(',', pos);
+              std::string v =
+                  strip(vals.substr(pos, c == std::string::npos ? c : c - pos));
+              if (!v.empty()) r.values.push_back(v);
+              if (c == std::string::npos) break;
+              pos = c + 1;
+            }
+            sel.reqs.push_back(std::move(r));
+            continue;
+          }
+        }
+      }
+      size_t ne = part.find("!=");
+      size_t ee = part.find("==");
+      size_t e = part.find('=');
+      if (ne != std::string::npos) {
+        r.key = strip(part.substr(0, ne));
+        r.op = LabelReq::NE;
+        r.values.push_back(strip(part.substr(ne + 2)));
+      } else if (ee != std::string::npos) {
+        r.key = strip(part.substr(0, ee));
+        r.op = LabelReq::EQ;
+        r.values.push_back(strip(part.substr(ee + 2)));
+      } else if (e != std::string::npos) {
+        r.key = strip(part.substr(0, e));
+        r.op = LabelReq::EQ;
+        r.values.push_back(strip(part.substr(e + 1)));
+      } else if (!part.empty() && part[0] == '!') {
+        r.key = strip(part.substr(1));
+        r.op = LabelReq::NOTEXISTS;
+      } else {
+        r.key = part;
+        r.op = LabelReq::EXISTS;
+      }
+      sel.reqs.push_back(std::move(r));
+    }
+    return sel;
+  }
+
+  bool matches(const JVal& obj) const {
+    if (!parsed) return true;
+    const JVal* meta = obj.find("metadata");
+    const JVal* labels = meta ? meta->find("labels") : nullptr;
+    for (const auto& r : reqs)
+      if (!r.matches(labels)) return false;
+    return true;
+  }
+};
+
+// fieldSelector: comma-joined `path=value` / `path!=value` terms; missing
+// fields stringify to "" (kwok_tpu/edge/kubeclient.py match_field_selector).
+static std::string field_str(const JVal& obj, const std::string& path) {
+  const JVal* cur = &obj;
+  size_t pos = 0;
+  while (pos <= path.size()) {
+    size_t dot = path.find('.', pos);
+    std::string part =
+        path.substr(pos, dot == std::string::npos ? dot : dot - pos);
+    if (cur->type != JVal::OBJ) return "";
+    cur = cur->find(strip(part));
+    if (!cur) return "";
+    if (dot == std::string::npos) break;
+    pos = dot + 1;
+  }
+  switch (cur->type) {
+    case JVal::STR: return cur->s;
+    case JVal::NUM: return cur->s;
+    case JVal::BOOL: return cur->b ? "True" : "False";  // Python str(bool)
+    default: return "";
+  }
+}
+
+static bool match_field_selector(const JVal& obj, const std::string& sel) {
+  if (sel.empty()) return true;
+  size_t pos = 0;
+  while (pos <= sel.size()) {
+    size_t c = sel.find(',', pos);
+    std::string term =
+        strip(sel.substr(pos, c == std::string::npos ? c : c - pos));
+    if (!term.empty()) {
+      size_t ne = term.find("!=");
+      if (ne != std::string::npos) {
+        std::string path = term.substr(0, ne);
+        std::string val = term.substr(ne + 2);
+        if (field_str(obj, path) == val) return false;
+      } else {
+        size_t ee = term.find("==");
+        size_t e = term.find('=');
+        std::string path, val;
+        if (ee != std::string::npos) {
+          path = term.substr(0, ee);
+          val = term.substr(ee + 2);
+        } else if (e != std::string::npos) {
+          path = term.substr(0, e);
+          val = term.substr(e + 1);
+        } else {
+          goto next;
+        }
+        // mirror Python's path.rstrip("=") on the `=` split
+        while (!path.empty() && path.back() == '=') path.pop_back();
+        if (field_str(obj, path) != val) return false;
+      }
+    }
+  next:
+    if (c == std::string::npos) break;
+    pos = c + 1;
+  }
+  return true;
+}
+
+// ------------------------------------------------------- strategic merge
+
+// Mirrors kwok_tpu/edge/merge.py: object merge with null deletion; list
+// merge by key `type` for fields `conditions`/`addresses`; everything else
+// replaces atomically.
+static bool merge_list_field(const std::string& field) {
+  return field == "conditions" || field == "addresses";
+}
+
+static JVal merge_value(const JVal& orig, const JVal& patch,
+                        const std::string& field) {
+  if (patch.type == JVal::OBJ && orig.type == JVal::OBJ) {
+    JVal out = orig;
+    for (const auto& kv : patch.obj) {
+      if (kv.second.type == JVal::NUL) {
+        out.erase(kv.first);
+      } else if (JVal* cur = out.find(kv.first)) {
+        *cur = merge_value(*cur, kv.second, kv.first);
+      } else {
+        out.obj.emplace_back(kv.first, kv.second);
+      }
+    }
+    return out;
+  }
+  if (patch.type == JVal::ARR && orig.type == JVal::ARR &&
+      merge_list_field(field)) {
+    JVal out = orig;
+    for (const auto& item : patch.arr) {
+      const JVal* ik = item.type == JVal::OBJ ? item.find("type") : nullptr;
+      bool merged = false;
+      if (ik && ik->type == JVal::STR) {
+        for (auto& existing : out.arr) {
+          const JVal* ek =
+              existing.type == JVal::OBJ ? existing.find("type") : nullptr;
+          if (ek && ek->type == JVal::STR && ek->s == ik->s) {
+            existing = merge_value(existing, item, "");
+            merged = true;
+            break;
+          }
+        }
+      }
+      if (!merged) out.arr.push_back(item);
+    }
+    return out;
+  }
+  return patch;
+}
+
+// ----------------------------------------------------------------- store
+
+static std::string now_rfc3339() {
+  time_t t = time(nullptr);
+  struct tm tm_;
+  gmtime_r(&t, &tm_);
+  char buf[32];
+  strftime(buf, sizeof buf, "%Y-%m-%dT%H:%M:%SZ", &tm_);
+  return buf;
+}
+
+using Key = std::pair<std::string, std::string>;  // (namespace-or-"", name)
+
+struct Entry {
+  JVal obj;
+  std::string bytes;  // serialized cache; empty => stale
+  const std::string& ser() {
+    if (bytes.empty()) bytes = dumps(obj);
+    return bytes;
+  }
+};
+
+struct Watch {
+  int kind;  // 0 nodes, 1 pods
+  std::string field_sel;
+  LabelSel label_sel;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::shared_ptr<const std::string>> q;
+  bool closed = false;
+
+  void push(std::shared_ptr<const std::string> ev) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (closed) return;
+      q.push_back(std::move(ev));
+    }
+    cv.notify_one();
+  }
+  void close() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      closed = true;
+    }
+    cv.notify_all();
+  }
+};
+
+static int kind_index(const std::string& kind) {
+  if (kind == "nodes") return 0;
+  if (kind == "pods") return 1;
+  return -1;
+}
+static const char* KIND_NAMES[2] = {"nodes", "pods"};
+
+struct Store {
+  std::mutex mu;
+  std::map<Key, Entry> kinds[2];
+  int64_t rv = 0;
+  std::vector<std::shared_ptr<Watch>> watches;
+
+  // caller holds mu
+  void bump(JVal& obj) {
+    rv++;
+    obj.get_or_insert_obj("metadata")
+        .set("resourceVersion", JVal::str(std::to_string(rv)));
+  }
+
+  // caller holds mu; serializes the event once, fans out to matching watches
+  void emit(int kind, const char* type, const JVal& obj) {
+    bool any = false;
+    for (const auto& w : watches)
+      if (w->kind == kind) {
+        any = true;
+        break;
+      }
+    if (!any) return;
+    std::shared_ptr<const std::string> line;
+    for (const auto& w : watches) {
+      if (w->kind != kind) continue;
+      if (!match_field_selector(obj, w->field_sel)) continue;
+      if (!w->label_sel.matches(obj)) continue;
+      if (!line) {
+        std::string ev = "{\"type\":\"";
+        ev += type;
+        ev += "\",\"object\":";
+        serialize(obj, ev);
+        ev += "}\n";
+        line = std::make_shared<const std::string>(std::move(ev));
+      }
+      w->push(line);
+    }
+  }
+
+  static Key obj_key(const JVal& obj) {
+    const JVal* meta = obj.find("metadata");
+    const JVal* ns = meta ? meta->find("namespace") : nullptr;
+    const JVal* name = meta ? meta->find("name") : nullptr;
+    return {ns && ns->type == JVal::STR ? ns->s : "",
+            name && name->type == JVal::STR ? name->s : ""};
+  }
+};
+
+// ----------------------------------------------------------- HTTP server
+
+struct Request {
+  std::string method;
+  std::string path;     // without query
+  std::string query;    // raw query string
+  std::string body;
+  bool close = false;   // Connection: close
+};
+
+static bool read_exact(int fd, std::string& buf, size_t need) {
+  while (buf.size() < need) {
+    char tmp[65536];
+    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    if (n <= 0) return false;
+    buf.append(tmp, n);
+  }
+  return true;
+}
+
+// Reads one HTTP/1.1 request; `buf` carries leftover pipelined bytes.
+static bool read_request(int fd, std::string& buf, Request& req) {
+  size_t hdr_end;
+  while ((hdr_end = buf.find("\r\n\r\n")) == std::string::npos) {
+    char tmp[65536];
+    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    if (n <= 0) return false;
+    buf.append(tmp, n);
+    if (buf.size() > (32u << 20)) return false;
+  }
+  std::string head = buf.substr(0, hdr_end);
+  size_t line_end = head.find("\r\n");
+  std::string line = head.substr(0, line_end);
+  size_t sp1 = line.find(' ');
+  size_t sp2 = line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 <= sp1) return false;
+  req.method = line.substr(0, sp1);
+  std::string uri = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  size_t qm = uri.find('?');
+  req.path = qm == std::string::npos ? uri : uri.substr(0, qm);
+  req.query = qm == std::string::npos ? "" : uri.substr(qm + 1);
+
+  size_t content_len = 0;
+  req.close = false;
+  size_t pos = line_end + 2;
+  while (pos < head.size()) {
+    size_t e = head.find("\r\n", pos);
+    if (e == std::string::npos) e = head.size();
+    std::string h = head.substr(pos, e - pos);
+    pos = e + 2;
+    size_t colon = h.find(':');
+    if (colon == std::string::npos) continue;
+    std::string k = h.substr(0, colon);
+    std::transform(k.begin(), k.end(), k.begin(), ::tolower);
+    std::string v = strip(h.substr(colon + 1));
+    if (k == "content-length") content_len = (size_t)atoll(v.c_str());
+    else if (k == "connection") {
+      std::transform(v.begin(), v.end(), v.begin(), ::tolower);
+      if (v == "close") req.close = true;
+    }
+  }
+  size_t total = hdr_end + 4 + content_len;
+  if (!read_exact(fd, buf, total)) return false;
+  req.body = buf.substr(hdr_end + 4, content_len);
+  buf.erase(0, total);
+  return true;
+}
+
+static bool send_all(int fd, const char* data, size_t n) {
+  while (n > 0) {
+    ssize_t w = send(fd, data, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    data += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+static bool send_response(int fd, int code, const std::string& body) {
+  const char* reason = code == 200   ? "OK"
+                       : code == 201 ? "Created"
+                       : code == 404 ? "Not Found"
+                                     : "Error";
+  char head[256];
+  int hn = snprintf(head, sizeof head,
+                    "HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
+                    "Content-Length: %zu\r\n\r\n",
+                    code, reason, body.size());
+  std::string out;
+  out.reserve(hn + body.size());
+  out.append(head, hn);
+  out += body;
+  return send_all(fd, out.data(), out.size());
+}
+
+static std::string url_decode(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      auto hexv = [](char c) -> int {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+        if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+        return -1;
+      };
+      int hi = hexv(s[i + 1]), lo = hexv(s[i + 2]);
+      if (hi >= 0 && lo >= 0) {
+        out += (char)((hi << 4) | lo);
+        i += 2;
+        continue;
+      }
+    }
+    out += s[i] == '+' ? ' ' : s[i];
+  }
+  return out;
+}
+
+static std::map<std::string, std::string> parse_query(const std::string& q) {
+  std::map<std::string, std::string> out;
+  size_t pos = 0;
+  while (pos <= q.size()) {
+    size_t amp = q.find('&', pos);
+    std::string kv = q.substr(pos, amp == std::string::npos ? amp : amp - pos);
+    if (!kv.empty()) {
+      size_t e = kv.find('=');
+      if (e == std::string::npos) out[url_decode(kv)] = "";
+      else out[url_decode(kv.substr(0, e))] = url_decode(kv.substr(e + 1));
+    }
+    if (amp == std::string::npos) break;
+    pos = amp + 1;
+  }
+  return out;
+}
+
+// path: /api/v1[/namespaces/NS]/(nodes|pods)[/NAME][/status]
+struct PathMatch {
+  bool ok = false;
+  int kind = -1;
+  std::string ns, name;
+  bool status = false;
+};
+
+static PathMatch match_path(const std::string& path) {
+  PathMatch m;
+  const std::string prefix = "/api/v1";
+  if (path.rfind(prefix, 0) != 0) return m;
+  std::string rest = path.substr(prefix.size());
+  std::vector<std::string> parts;
+  size_t pos = 0;
+  while (pos < rest.size()) {
+    if (rest[pos] == '/') {
+      pos++;
+      continue;
+    }
+    size_t slash = rest.find('/', pos);
+    parts.push_back(
+        rest.substr(pos, slash == std::string::npos ? slash : slash - pos));
+    if (slash == std::string::npos) break;
+    pos = slash + 1;
+  }
+  size_t i = 0;
+  if (i + 1 < parts.size() && parts[i] == "namespaces") {
+    m.ns = url_decode(parts[i + 1]);
+    i += 2;
+  }
+  if (i >= parts.size()) return m;
+  m.kind = kind_index(parts[i]);
+  if (m.kind < 0) return m;
+  i++;
+  if (i < parts.size()) {
+    m.name = url_decode(parts[i]);
+    i++;
+  }
+  if (i < parts.size()) {
+    if (parts[i] != "status") return m;
+    m.status = true;
+    i++;
+  }
+  if (i != parts.size()) return m;
+  m.ok = true;
+  return m;
+}
+
+// ------------------------------------------------------------------ app
+
+struct App {
+  Store store;
+  std::mutex audit_mu;
+  FILE* audit = nullptr;
+  std::string data_file;
+  int listen_fd = -1;
+  std::atomic<bool> stopping{false};
+
+  void audit_line(const std::string& method, const std::string& uri, int code);
+  void handle_conn(int fd);
+  bool handle_request(int fd, Request& req);
+  std::string snapshot_dump();
+  void restore_load(const JVal& data);
+  void persist();
+};
+
+static App* g_app = nullptr;
+
+void App::audit_line(const std::string& method, const std::string& uri,
+                     int code) {
+  if (!audit) return;
+  // HTTP method + URI -> k8s audit verb (matches the Python mock)
+  std::string verb;
+  if (method == "GET") {
+    verb = "get";
+    size_t qm = uri.find('?');
+    std::string path = qm == std::string::npos ? uri : uri.substr(0, qm);
+    std::string query = qm == std::string::npos ? "" : uri.substr(qm + 1);
+    auto q = parse_query(query);
+    auto w = q.find("watch");
+    if (w != q.end() && (w->second == "true" || w->second == "1")) {
+      verb = "watch";
+    } else {
+      PathMatch m = match_path(path);
+      if (m.ok && m.name.empty()) verb = "list";
+    }
+  } else if (method == "POST") verb = "create";
+  else if (method == "PUT") verb = "update";
+  else if (method == "PATCH") verb = "patch";
+  else if (method == "DELETE") verb = "delete";
+  else {
+    verb = method;
+    std::transform(verb.begin(), verb.end(), verb.begin(), ::tolower);
+  }
+  std::string line =
+      "{\"kind\": \"Event\", \"apiVersion\": \"audit.k8s.io/v1\", "
+      "\"level\": \"Metadata\", \"stage\": \"ResponseComplete\", \"verb\": \"";
+  line += verb;
+  line += "\", \"requestURI\": \"";
+  json_escape(line, uri);
+  line += "\", \"responseStatus\": {\"code\": ";
+  line += std::to_string(code);
+  line += "}, \"stageTimestamp\": \"";
+  line += now_rfc3339();
+  line += "\"}\n";
+  std::lock_guard<std::mutex> lk(audit_mu);
+  fwrite(line.data(), 1, line.size(), audit);
+  fflush(audit);
+}
+
+std::string App::snapshot_dump() {
+  std::lock_guard<std::mutex> lk(store.mu);
+  std::string out = "{\"resourceVersion\":";
+  out += std::to_string(store.rv);
+  out += ",\"objects\":{";
+  for (int k = 0; k < 2; k++) {
+    if (k) out += ',';
+    out += '"';
+    out += KIND_NAMES[k];
+    out += "\":[";
+    bool first = true;
+    for (auto& kv : store.kinds[k]) {
+      if (!first) out += ',';
+      first = false;
+      out += kv.second.ser();
+    }
+    out += ']';
+  }
+  out += "}}";
+  return out;
+}
+
+void App::restore_load(const JVal& data) {
+  std::vector<std::shared_ptr<Watch>> old;
+  {
+    std::lock_guard<std::mutex> lk(store.mu);
+    for (int k = 0; k < 2; k++) store.kinds[k].clear();
+    const JVal* objects = data.find("objects");
+    if (objects && objects->type == JVal::OBJ) {
+      for (int k = 0; k < 2; k++) {
+        const JVal* list = objects->find(KIND_NAMES[k]);
+        if (!list || list->type != JVal::ARR) continue;
+        for (const JVal& obj : list->arr) {
+          Key key = Store::obj_key(obj);
+          if (key.second.empty()) continue;
+          store.kinds[k][key] = Entry{obj, ""};
+        }
+      }
+    }
+    int64_t rv = 0;
+    const JVal* rvv = data.find("resourceVersion");
+    if (rvv && rvv->type == JVal::NUM) rv = atoll(rvv->s.c_str());
+    store.rv = std::max(store.rv, rv) + 1;
+    old.swap(store.watches);
+  }
+  for (auto& w : old) w->close();
+}
+
+void App::persist() {
+  if (data_file.empty()) return;
+  std::string tmp = data_file + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) return;
+  std::string dump = snapshot_dump();
+  fwrite(dump.data(), 1, dump.size(), f);
+  fclose(f);
+  rename(tmp.c_str(), data_file.c_str());
+}
+
+// returns false when the connection must close
+bool App::handle_request(int fd, Request& req) {
+  auto q = parse_query(req.query);
+  std::string uri = req.path;
+  if (!req.query.empty()) uri += "?" + req.query;
+
+  auto respond = [&](int code, const std::string& body) {
+    audit_line(req.method, uri, code);
+    return send_response(fd, code, body) && !req.close;
+  };
+
+  if (req.method == "GET" && req.path == "/healthz")
+    return respond(200, "ok");
+  if (req.method == "GET" && req.path == "/snapshot")
+    return respond(200, snapshot_dump());
+  if (req.method == "POST" && req.path == "/restore") {
+    JParser p(req.body);
+    JVal data = p.parse();
+    restore_load(data);
+    return respond(200, "{\"kind\":\"Status\",\"status\":\"Success\"}");
+  }
+
+  PathMatch m = match_path(req.path);
+  if (!m.ok || (req.method != "GET" && m.name.empty() && req.method != "POST"))
+    return respond(404, "{\"kind\":\"Status\",\"code\":404}");
+
+  Key key{m.ns, m.name};
+
+  if (req.method == "GET") {
+    if (!m.name.empty()) {
+      // build the body under the lock, send outside it: a stalled reader
+      // must never wedge the store (send_all can block on TCP backpressure)
+      std::string body;
+      int code = 200;
+      {
+        std::lock_guard<std::mutex> lk(store.mu);
+        auto it = store.kinds[m.kind].find(key);
+        if (it == store.kinds[m.kind].end()) {
+          code = 404;
+          body = "{\"kind\":\"Status\",\"code\":404}";
+        } else {
+          body = it->second.ser();
+        }
+      }
+      return respond(code, body);
+    }
+    std::string fs = q.count("fieldSelector") ? q["fieldSelector"] : "";
+    std::string lsq = q.count("labelSelector") ? q["labelSelector"] : "";
+    auto wq = q.find("watch");
+    if (wq != q.end() && (wq->second == "true" || wq->second == "1")) {
+      // ---- watch stream: headers now, then chunked events forever
+      auto w = std::make_shared<Watch>();
+      w->kind = m.kind;
+      w->field_sel = fs;
+      w->label_sel = LabelSel::parse(lsq);
+      {
+        std::lock_guard<std::mutex> lk(store.mu);
+        store.watches.push_back(w);
+      }
+      audit_line(req.method, uri, 200);
+      const char* head =
+          "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+          "Transfer-Encoding: chunked\r\n\r\n";
+      bool alive = send_all(fd, head, strlen(head));
+      while (alive && !stopping.load()) {
+        std::shared_ptr<const std::string> ev;
+        {
+          std::unique_lock<std::mutex> lk(w->mu);
+          w->cv.wait(lk, [&] { return w->closed || !w->q.empty(); });
+          if (w->closed && w->q.empty()) break;
+          ev = std::move(w->q.front());
+          w->q.pop_front();
+        }
+        char chunk_head[32];
+        int hn = snprintf(chunk_head, sizeof chunk_head, "%zx\r\n", ev->size());
+        std::string out;
+        out.reserve(hn + ev->size() + 2);
+        out.append(chunk_head, hn);
+        out += *ev;
+        out += "\r\n";
+        alive = send_all(fd, out.data(), out.size());
+      }
+      {
+        std::lock_guard<std::mutex> lk(store.mu);
+        auto& ws = store.watches;
+        ws.erase(std::remove(ws.begin(), ws.end(), w), ws.end());
+      }
+      w->close();
+      return false;  // watch connections never go back to unary
+    }
+    // ---- list (with the kube-apiserver limit/continue chunking protocol)
+    LabelSel ls = LabelSel::parse(lsq);
+    long limit = q.count("limit") ? atol(q["limit"].c_str()) : 0;
+    std::string cont = q.count("continue") ? q["continue"] : "";
+    std::string items;
+    std::string token;
+    int64_t rv_now;
+    {
+      std::lock_guard<std::mutex> lk(store.mu);
+      auto& kindmap = store.kinds[m.kind];
+      auto it = kindmap.begin();
+      if (!cont.empty()) {
+        size_t nul = cont.find('\0');
+        Key last{cont.substr(0, nul),
+                 nul == std::string::npos ? "" : cont.substr(nul + 1)};
+        it = kindmap.upper_bound(last);
+      }
+      long count = 0;
+      bool first = true;
+      for (; it != kindmap.end(); ++it) {
+        if (!match_field_selector(it->second.obj, fs)) continue;
+        if (!ls.matches(it->second.obj)) continue;
+        if (!first) items += ',';
+        first = false;
+        items += it->second.ser();
+        count++;
+        if (limit && count >= limit) {
+          auto next = std::next(it);
+          if (next != kindmap.end()) {
+            token = it->first.first;
+            token += '\0';
+            token += it->first.second;
+          }
+          break;
+        }
+      }
+      rv_now = store.rv;
+    }
+    std::string body =
+        "{\"kind\":\"List\",\"apiVersion\":\"v1\",\"metadata\":{"
+        "\"resourceVersion\":\"";
+    body += std::to_string(rv_now);
+    body += '"';
+    if (!token.empty()) {
+      body += ",\"continue\":\"";
+      json_escape(body, token);
+      body += '"';
+    }
+    body += "},\"items\":[";
+    body += items;
+    body += "]}";
+    return respond(200, body);
+  }
+
+  if (req.method == "POST") {
+    JParser p(req.body);
+    JVal obj = p.parse();
+    if (!p.ok || obj.type != JVal::OBJ)
+      return respond(400, "{\"kind\":\"Status\",\"code\":400}");
+    JVal& meta = obj.get_or_insert_obj("metadata");
+    if (!m.ns.empty()) meta.set("namespace", JVal::str(m.ns));
+    Key k = Store::obj_key(obj);
+    if (k.second.empty())
+      return respond(400, "{\"kind\":\"Status\",\"code\":400}");
+    std::string body;
+    {
+      std::lock_guard<std::mutex> lk(store.mu);
+      if (!meta.find("creationTimestamp"))
+        meta.set("creationTimestamp", JVal::str(now_rfc3339()));
+      if (!meta.find("uid"))
+        meta.set("uid", JVal::str("uid-" + std::to_string(store.rv + 1)));
+      store.bump(obj);
+      Entry& e = store.kinds[m.kind][k];
+      e.obj = std::move(obj);
+      e.bytes.clear();
+      store.emit(m.kind, "ADDED", e.obj);
+      body = e.ser();
+    }
+    return respond(201, body);
+  }
+
+  if (req.method == "PATCH") {
+    JParser p(req.body);
+    JVal patch = p.parse();
+    if (!p.ok) return respond(400, "{\"kind\":\"Status\",\"code\":400}");
+    std::string body;
+    int code = 200;
+    {
+      std::lock_guard<std::mutex> lk(store.mu);
+      auto it = store.kinds[m.kind].find(key);
+      if (it == store.kinds[m.kind].end()) {
+        code = 404;
+        body = "{\"kind\":\"Status\",\"code\":404}";
+      } else {
+        Entry& e = it->second;
+        if (m.status) {
+          // strategic-merge on the status subresource; accept either a
+          // {"status": {...}} wrapper or a bare status document
+          const JVal* sp = patch.is_obj() ? patch.find("status") : nullptr;
+          const JVal& spv = sp ? *sp : patch;
+          JVal cur_status;
+          cur_status.type = JVal::OBJ;
+          if (const JVal* cs = e.obj.find("status"))
+            if (cs->type == JVal::OBJ) cur_status = *cs;
+          e.obj.set("status", merge_value(cur_status, spv, ""));
+        } else {
+          // merge-patch on metadata + spec with null deletion; top-level
+          // key replace within each section (mockserver.patch_meta)
+          for (const char* section : {"metadata", "spec"}) {
+            const JVal* sec_patch =
+                patch.is_obj() ? patch.find(section) : nullptr;
+            if (!sec_patch || sec_patch->type != JVal::OBJ ||
+                sec_patch->obj.empty())
+              continue;
+            JVal& sec = e.obj.get_or_insert_obj(section);
+            for (const auto& kv : sec_patch->obj) {
+              if (kv.second.type == JVal::NUL) sec.erase(kv.first);
+              else sec.set(kv.first, kv.second);
+            }
+          }
+        }
+        store.bump(e.obj);
+        e.bytes.clear();
+        store.emit(m.kind, "MODIFIED", e.obj);
+        body = e.ser();
+      }
+    }
+    return respond(code, body);
+  }
+
+  if (req.method == "DELETE") {
+    long grace = 0;
+    if (!req.body.empty()) {
+      JParser p(req.body);
+      JVal b = p.parse();
+      const JVal* g = b.is_obj() ? b.find("gracePeriodSeconds") : nullptr;
+      if (g && g->type == JVal::NUM) grace = atol(g->s.c_str());
+    }
+    {
+      std::lock_guard<std::mutex> lk(store.mu);
+      auto it = store.kinds[m.kind].find(key);
+      if (it != store.kinds[m.kind].end()) {
+        Entry& e = it->second;
+        JVal& meta = e.obj.get_or_insert_obj("metadata");
+        const JVal* fins = meta.find("finalizers");
+        bool has_fins =
+            fins && fins->type == JVal::ARR && !fins->arr.empty();
+        if (m.kind == 1 && (grace > 0 || has_fins)) {
+          // graceful: mark, wait for the kubelet (engine) to force-delete
+          if (!meta.find("deletionTimestamp"))
+            meta.set("deletionTimestamp", JVal::str(now_rfc3339()));
+          meta.set("deletionGracePeriodSeconds",
+                   JVal::num_raw(std::to_string(grace)));
+          store.bump(e.obj);
+          e.bytes.clear();
+          store.emit(m.kind, "MODIFIED", e.obj);
+        } else {
+          JVal obj = std::move(e.obj);
+          store.kinds[m.kind].erase(it);
+          store.bump(obj);
+          store.emit(m.kind, "DELETED", obj);
+        }
+      }
+    }
+    return respond(200, "{\"kind\":\"Status\",\"status\":\"Success\"}");
+  }
+
+  return respond(404, "{\"kind\":\"Status\",\"code\":404}");
+}
+
+void App::handle_conn(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  std::string buf;
+  Request req;
+  while (!stopping.load() && read_request(fd, buf, req)) {
+    if (!handle_request(fd, req)) break;
+  }
+  close(fd);
+}
+
+static void on_term(int) {
+  // async-signal-safe only: flag + wake the accept loop (shutdown() on the
+  // listening socket makes accept() fail); persistence runs on the main
+  // thread where taking the store mutex is legal
+  if (g_app) {
+    g_app->stopping.store(true);
+    if (g_app->listen_fd >= 0) shutdown(g_app->listen_fd, SHUT_RDWR);
+  }
+}
+
+int main(int argc, char** argv) {
+  int port = 0;
+  std::string address = "127.0.0.1";
+  std::string audit_log, data_file;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto val = [&](const char* flag) -> const char* {
+      size_t n = strlen(flag);
+      if (a.rfind(flag, 0) == 0 && a.size() > n && a[n] == '=')
+        return a.c_str() + n + 1;
+      if (a == flag && i + 1 < argc) return argv[++i];
+      return nullptr;
+    };
+    if (const char* v = val("--port")) port = atoi(v);
+    else if (const char* v = val("--address")) address = v;
+    else if (const char* v = val("--audit-log")) audit_log = v;
+    else if (const char* v = val("--data-file")) data_file = v;
+  }
+
+  signal(SIGPIPE, SIG_IGN);
+
+  App app;
+  g_app = &app;
+  app.data_file = data_file;
+  if (!audit_log.empty()) {
+    app.audit = fopen(audit_log.c_str(), "a");
+    if (!app.audit) {
+      fprintf(stderr, "cannot open audit log %s\n", audit_log.c_str());
+      return 1;
+    }
+  }
+  if (!data_file.empty()) {
+    FILE* f = fopen(data_file.c_str(), "r");
+    if (f) {
+      std::string text;
+      char tmp[65536];
+      size_t n;
+      while ((n = fread(tmp, 1, sizeof tmp, f)) > 0) text.append(tmp, n);
+      fclose(f);
+      JParser p(text);
+      JVal data = p.parse();
+      if (p.ok) {
+        app.restore_load(data);
+        printf("restored store from %s\n", data_file.c_str());
+        fflush(stdout);
+      }
+    }
+  }
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) {
+    perror("socket");
+    return 1;
+  }
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, address.c_str(), &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad address %s\n", address.c_str());
+    return 1;
+  }
+  if (bind(lfd, (struct sockaddr*)&addr, sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(lfd, 512) != 0) {
+    perror("listen");
+    return 1;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(lfd, (struct sockaddr*)&addr, &alen);
+  app.listen_fd = lfd;
+  const char* shown =
+      (address == "0.0.0.0" || address.empty()) ? "127.0.0.1" : address.c_str();
+  printf("mock apiserver listening on http://%s:%d\n", shown,
+         ntohs(addr.sin_port));
+  fflush(stdout);
+
+  signal(SIGTERM, on_term);
+  signal(SIGINT, on_term);
+
+  while (!app.stopping.load()) {
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (errno == EINTR && !app.stopping.load()) continue;
+      break;
+    }
+    std::thread(&App::handle_conn, &app, cfd).detach();
+  }
+  app.persist();
+  return 0;
+}

@@ -259,6 +259,6 @@ int64_t kwok_render_pod_statuses(
   return b.len;
 }
 
-int32_t kwok_codec_abi_version() { return 1; }
+int32_t kwok_codec_abi_version() { return 2; }
 
 }  // extern "C"

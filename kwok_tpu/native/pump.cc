@@ -1,0 +1,316 @@
+// kwok_tpu native HTTP pump: batched pipelined unary requests.
+//
+// The engine's patch egress and the soak rig's load generation are
+// request-per-object HTTP (the Kubernetes API has no batch verb), so at
+// O(10k) objects/s the per-request client cost dominates a Python sender —
+// especially on small hosts where engine, loader and apiserver share
+// cores. This pump issues a whole batch of prepared (method, path, body)
+// requests over a small pool of persistent connections, pipelining within
+// each connection (write side streams all requests in large buffers; read
+// side consumes responses in order), entirely outside the GIL.
+//
+// Protocol assumptions (valid for kube-apiservers and the mock): HTTP/1.1
+// keep-alive, responses carry Content-Length or chunked bodies, response
+// bodies are discarded (the engine learns outcomes from the watch echo;
+// only status codes are reported back).
+//
+// Failure contract: if a connection dies mid-batch, every unsent/unread
+// request on it gets status 0 and the connection is re-established on the
+// next call; the Python caller decides whether to retry.
+//
+// Build: part of libkwokcodec.so (see native/__init__.py _build).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Conn {
+  int fd = -1;
+};
+
+struct Pump {
+  std::string host;
+  int port = 0;
+  std::vector<Conn> conns;
+  std::string header_extra;  // e.g. "Authorization: Bearer ...\r\n"
+};
+
+std::mutex g_pumps_mu;
+std::map<int64_t, Pump*> g_pumps;
+int64_t g_next_id = 1;
+
+int dial(const std::string& host, int port) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (getaddrinfo(host.c_str(), portbuf, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd >= 0) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    // a stalled (not dead) server must fail the batch, not wedge the
+    // engine's egress forever — the Python client this replaces had a
+    // per-request timeout; timed-out requests report status 0
+    struct timeval tv{60, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  }
+  return fd;
+}
+
+bool send_all(int fd, const char* data, size_t n) {
+  while (n > 0) {
+    ssize_t w = send(fd, data, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    data += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+struct Slices {
+  const char* blob;
+  const int64_t* off;
+  const char* ptr(int64_t i) const { return blob + off[i]; }
+  int64_t len(int64_t i) const { return off[i + 1] - off[i]; }
+};
+
+// Streaming response reader over a buffered connection.
+struct RespReader {
+  int fd;
+  std::string buf;
+  size_t pos = 0;
+
+  bool fill() {
+    char tmp[65536];
+    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    if (n <= 0) return false;
+    if (pos > (1u << 20) && pos * 2 > buf.size()) {
+      buf.erase(0, pos);
+      pos = 0;
+    }
+    buf.append(tmp, n);
+    return true;
+  }
+
+  // reads until the delimiter appears at/after pos; returns index or npos
+  size_t find(const char* delim) {
+    size_t at;
+    while ((at = buf.find(delim, pos)) == std::string::npos) {
+      if (!fill()) return std::string::npos;
+    }
+    return at;
+  }
+
+  bool need(size_t n) {
+    while (buf.size() - pos < n) {
+      if (!fill()) return false;
+    }
+    return true;
+  }
+
+  // Parses one response; returns status code or 0 on connection error.
+  int read_response() {
+    size_t hdr_end = find("\r\n\r\n");
+    if (hdr_end == std::string::npos) return 0;
+    std::string head = buf.substr(pos, hdr_end - pos);
+    pos = hdr_end + 4;
+    int code = 0;
+    size_t sp = head.find(' ');
+    if (sp != std::string::npos) code = atoi(head.c_str() + sp + 1);
+    // locate framing headers (case-insensitive)
+    long content_len = -1;
+    bool chunked = false;
+    size_t lpos = 0;
+    while (lpos < head.size()) {
+      size_t e = head.find("\r\n", lpos);
+      if (e == std::string::npos) e = head.size();
+      std::string line = head.substr(lpos, e - lpos);
+      lpos = e + 2;
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string k = line.substr(0, colon);
+      for (auto& c : k) c = (char)tolower((unsigned char)c);
+      std::string v = line.substr(colon + 1);
+      size_t a = v.find_first_not_of(" \t");
+      if (a != std::string::npos) v = v.substr(a);
+      if (k == "content-length") content_len = atol(v.c_str());
+      else if (k == "transfer-encoding" && v.rfind("chunked", 0) == 0)
+        chunked = true;
+    }
+    if (chunked) {
+      while (true) {
+        size_t le = find("\r\n");
+        if (le == std::string::npos) return 0;
+        long sz = strtol(buf.c_str() + pos, nullptr, 16);
+        pos = le + 2;
+        if (!need((size_t)sz + 2)) return 0;
+        pos += (size_t)sz + 2;
+        if (sz == 0) break;
+      }
+    } else if (content_len > 0) {
+      if (!need((size_t)content_len)) return 0;
+      pos += (size_t)content_len;
+    }
+    return code;
+  }
+};
+
+void run_conn(Pump* p, size_t ci, const Slices& method, const Slices& path,
+              const Slices& ctype, const Slices& body,
+              const std::vector<int32_t>& idxs, int32_t* status_out) {
+  Conn& c = p->conns[ci];
+  if (c.fd < 0) c.fd = dial(p->host, p->port);
+  if (c.fd < 0) {
+    for (int32_t i : idxs) status_out[i] = 0;
+    return;
+  }
+
+  // writer thread streams all requests; this thread reads responses
+  bool write_ok = true;
+  std::thread writer([&] {
+    std::string out;
+    out.reserve(1 << 20);
+    char clen[64];
+    for (int32_t i : idxs) {
+      out.append(method.ptr(i), method.len(i));
+      out += ' ';
+      out.append(path.ptr(i), path.len(i));
+      out += " HTTP/1.1\r\nHost: ";
+      out += p->host;
+      out += "\r\nContent-Type: ";
+      if (ctype.len(i) > 0) out.append(ctype.ptr(i), ctype.len(i));
+      else out += "application/json";
+      out += "\r\n";
+      out += p->header_extra;
+      int n = snprintf(clen, sizeof clen, "Content-Length: %lld\r\n\r\n",
+                       (long long)body.len(i));
+      out.append(clen, n);
+      out.append(body.ptr(i), body.len(i));
+      if (out.size() >= (1 << 20)) {
+        if (!send_all(c.fd, out.data(), out.size())) {
+          write_ok = false;
+          return;
+        }
+        out.clear();
+      }
+    }
+    if (!out.empty() && !send_all(c.fd, out.data(), out.size()))
+      write_ok = false;
+  });
+
+  RespReader rr{c.fd};
+  size_t done = 0;
+  for (; done < idxs.size(); done++) {
+    int code = rr.read_response();
+    if (code == 0) break;
+    status_out[idxs[done]] = code;
+  }
+  writer.join();
+  if (done < idxs.size() || !write_ok) {
+    for (size_t i = done; i < idxs.size(); i++) status_out[idxs[i]] = 0;
+    close(c.fd);
+    c.fd = -1;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t kwok_pump_open(const char* host, int32_t port, int32_t nconn,
+                       const char* header_extra) {
+  Pump* p = new Pump;
+  p->host = host;
+  p->port = port;
+  p->conns.resize(nconn > 0 ? nconn : 1);
+  if (header_extra && header_extra[0]) p->header_extra = header_extra;
+  std::lock_guard<std::mutex> lk(g_pumps_mu);
+  int64_t id = g_next_id++;
+  g_pumps[id] = p;
+  return id;
+}
+
+// Issues n requests split round-robin across the pool; blocks until every
+// response is read (or its connection died). status_out[i] = HTTP code, or
+// 0 for connection failure. Returns the count of codes in [200, 300).
+int64_t kwok_pump_send(int64_t handle, int32_t n,
+                       const char* method_blob, const int64_t* method_off,
+                       const char* path_blob, const int64_t* path_off,
+                       const char* ctype_blob, const int64_t* ctype_off,
+                       const char* body_blob, const int64_t* body_off,
+                       int32_t* status_out) {
+  Pump* p;
+  {
+    std::lock_guard<std::mutex> lk(g_pumps_mu);
+    auto it = g_pumps.find(handle);
+    if (it == g_pumps.end()) return -1;
+    p = it->second;
+  }
+  Slices method{method_blob, method_off};
+  Slices path{path_blob, path_off};
+  Slices ctype{ctype_blob, ctype_off};
+  Slices body{body_blob, body_off};
+
+  size_t nconn = p->conns.size();
+  std::vector<std::vector<int32_t>> shards(nconn);
+  for (int32_t i = 0; i < n; i++) shards[i % nconn].push_back(i);
+
+  std::vector<std::thread> threads;
+  for (size_t ci = 0; ci < nconn; ci++) {
+    if (shards[ci].empty()) continue;
+    threads.emplace_back(run_conn, p, ci, std::cref(method), std::cref(path),
+                         std::cref(ctype), std::cref(body),
+                         std::cref(shards[ci]), status_out);
+  }
+  for (auto& t : threads) t.join();
+
+  int64_t ok = 0;
+  for (int32_t i = 0; i < n; i++)
+    if (status_out[i] >= 200 && status_out[i] < 300) ok++;
+  return ok;
+}
+
+void kwok_pump_close(int64_t handle) {
+  Pump* p = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_pumps_mu);
+    auto it = g_pumps.find(handle);
+    if (it != g_pumps.end()) {
+      p = it->second;
+      g_pumps.erase(it);
+    }
+  }
+  if (!p) return;
+  for (Conn& c : p->conns)
+    if (c.fd >= 0) close(c.fd);
+  delete p;
+}
+
+}  // extern "C"

@@ -15,6 +15,15 @@ pyrun() {
     python3 "$@"
 }
 
+# Background-only variant: `pyspawn ... &` execs python3 inside the
+# backgrounded subshell so $! is the python pid itself. With plain
+# `pyrun ... &`, $! is the subshell; killing it orphans the python child,
+# and leaked engines/apiservers then eat the (single-core) CI box.
+pyspawn() {
+  exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu PYTHONPATH="${E2E_ROOT}" \
+    python3 "$@"
+}
+
 kwokctl() {
   pyrun -m kwok_tpu.kwokctl "$@"
 }

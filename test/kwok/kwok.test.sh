@@ -24,12 +24,12 @@ trap cleanup EXIT
 PORT="$(pyrun -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')"
 URL="http://127.0.0.1:${PORT}"
 
-pyrun -m kwok_tpu.edge.mockserver --port "${PORT}" \
+pyspawn -m kwok_tpu.edge.mockserver --port "${PORT}" \
   >"${WORK}/apiserver.log" 2>&1 &
 APISERVER_PID="$!"
 retry 10 curl -fsS "${URL}/healthz"
 
-pyrun -m kwok_tpu.kwok \
+pyspawn -m kwok_tpu.kwok \
   --master "${URL}" \
   --manage-all-nodes=true \
   --disregard-status-with-annotation-selector "kwok.x-k8s.io/status=custom" \

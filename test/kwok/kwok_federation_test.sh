@@ -31,7 +31,7 @@ trap cleanup EXIT
 URLS=()
 for i in $(seq 1 "${N_MEMBERS}"); do
   PORT="$(pyrun -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')"
-  pyrun -m kwok_tpu.edge.mockserver --port "${PORT}" \
+  pyspawn -m kwok_tpu.edge.mockserver --port "${PORT}" \
     >"${WORK}/apiserver-${i}.log" 2>&1 &
   PIDS+=("$!")
   URLS+=("http://127.0.0.1:${PORT}")
@@ -42,7 +42,7 @@ done
 
 SRV_PORT="$(pyrun -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')"
 MASTERS="$(IFS=,; echo "${URLS[*]}")"
-pyrun -m kwok_tpu.kwok \
+pyspawn -m kwok_tpu.kwok \
   --master "${MASTERS}" \
   --manage-all-nodes=true \
   --tick-interval 0.05 \

@@ -1,0 +1,338 @@
+"""Parity tests: the native C++ mock apiserver vs the Python semantic oracle.
+
+kwok_tpu/native/apiserver.cc reimplements kwok_tpu/edge/mockserver.py's wire
+protocol at native speed (so the lab apiserver is never the wall when
+benchmarking the engine's edge). These tests drive the compiled binary over
+real sockets with the same client the engine uses, and cross-check
+strategic-merge results against the Python implementation the rest of the
+suite trusts (kwok_tpu/edge/merge.py).
+
+Skipped wholesale when no C++ compiler is available.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from kwok_tpu import native
+from kwok_tpu.edge.httpclient import HttpKubeClient
+from kwok_tpu.edge.merge import strategic_merge
+from tests.test_engine import make_node, make_pod
+
+pytestmark = pytest.mark.skipif(
+    native.apiserver_binary() is None, reason="no C++ compiler"
+)
+
+
+class NativeServer:
+    def __init__(self, args=()):
+        self.proc = subprocess.Popen(
+            [native.apiserver_binary(), "--port", "0", *args],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        self.url = None
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            line = self.proc.stdout.readline()
+            if "listening on" in line:
+                self.url = line.rsplit(" ", 1)[-1].strip()
+                break
+        assert self.url, "native apiserver did not start"
+
+    def stop(self, sig=signal.SIGTERM):
+        self.proc.send_signal(sig)
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+@pytest.fixture
+def srv():
+    s = NativeServer()
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def client(srv):
+    c = HttpKubeClient(srv.url)
+    yield c
+    c.close()
+
+
+def test_crud_roundtrip(client):
+    client.create("nodes", make_node("n1"))
+    client.create("pods", make_pod("p1", node="n1"))
+    assert [n["metadata"]["name"] for n in client.list("nodes")] == ["n1"]
+    got = client.get("pods", "default", "p1")
+    assert got["spec"]["nodeName"] == "n1"
+    assert got["metadata"]["uid"]
+    assert got["metadata"]["creationTimestamp"]
+    assert client.get("pods", "default", "absent") is None
+    client.patch_status("nodes", None, "n1", {"status": {"phase": "Running"}})
+    assert client.get("nodes", None, "n1")["status"]["phase"] == "Running"
+    client.patch_meta("pods", "default", "p1", {"metadata": {"labels": {"a": "b"}}})
+    assert client.get("pods", "default", "p1")["metadata"]["labels"] == {"a": "b"}
+    # null deletes the key (finalizer-strip semantics)
+    client.patch_meta("pods", "default", "p1", {"metadata": {"labels": None}})
+    assert "labels" not in client.get("pods", "default", "p1")["metadata"]
+    client.delete("pods", "default", "p1", grace_seconds=0)
+    assert client.get("pods", "default", "p1") is None
+    assert client.healthz()
+
+
+def test_resource_versions_bump(client):
+    client.create("nodes", make_node("rv"))
+    rv1 = int(client.get("nodes", None, "rv")["metadata"]["resourceVersion"])
+    client.patch_status("nodes", None, "rv", {"status": {"phase": "X"}})
+    rv2 = int(client.get("nodes", None, "rv")["metadata"]["resourceVersion"])
+    assert rv2 > rv1
+
+
+def test_strategic_merge_parity_with_python(client):
+    """The C++ merge must agree with kwok_tpu/edge/merge.py on the shapes
+    the engine emits: conditions/addresses keyed by `type`, atomic lists,
+    nested objects, null deletion."""
+    base_status = {
+        "phase": "Pending",
+        "conditions": [
+            {"type": "Ready", "status": "False", "reason": "old"},
+            {"type": "PodScheduled", "status": "True"},
+        ],
+        "addresses": [{"type": "InternalIP", "address": "1.2.3.4"}],
+        "containerStatuses": [{"name": "old", "ready": False}],
+        "nested": {"keep": 1, "drop": 2},
+    }
+    patches = [
+        {"phase": "Running"},
+        {"conditions": [{"type": "Ready", "status": "True"}]},
+        {"conditions": [{"type": "New", "status": "True"}]},
+        {"addresses": [{"type": "InternalIP", "address": "5.6.7.8"}]},
+        {"containerStatuses": [{"name": "new", "ready": True}]},
+        {"nested": {"drop": None, "add": 3}},
+    ]
+    pod = make_pod("merge-p", node="n")
+    pod["status"] = base_status
+    client.create("pods", pod)
+    expect = base_status
+    for p in patches:
+        expect = strategic_merge(expect, p)
+        client.patch_status("pods", "default", "merge-p", {"status": p})
+    got = client.get("pods", "default", "merge-p")["status"]
+    assert got == expect
+
+
+def test_field_and_label_selectors(client):
+    bound = make_pod("bound", node="n1")
+    bound["metadata"]["labels"] = {"app": "web", "tier": "front"}
+    client.create("pods", bound)
+    unbound = make_pod("unbound")
+    unbound["spec"]["nodeName"] = ""
+    client.create("pods", unbound)
+    names = [
+        p["metadata"]["name"]
+        for p in client.list("pods", field_selector="spec.nodeName!=")
+    ]
+    assert names == ["bound"]
+    assert [
+        p["metadata"]["name"]
+        for p in client.list("pods", field_selector="spec.nodeName=n1")
+    ] == ["bound"]
+    assert [
+        p["metadata"]["name"] for p in client.list("pods", label_selector="app=web")
+    ] == ["bound"]
+    assert [
+        p["metadata"]["name"]
+        for p in client.list("pods", label_selector="app in (web, db)")
+    ] == ["bound"]
+    assert [
+        p["metadata"]["name"]
+        for p in client.list("pods", label_selector="app notin (web)")
+    ] == ["unbound"]
+    assert [
+        p["metadata"]["name"] for p in client.list("pods", label_selector="tier")
+    ] == ["bound"]
+    assert [
+        p["metadata"]["name"] for p in client.list("pods", label_selector="!tier")
+    ] == ["unbound"]
+
+
+def test_watch_stream_and_filtering(client):
+    w = client.watch("pods", field_selector="spec.nodeName!=")
+    events = []
+    done = threading.Event()
+
+    def consume():
+        for ev in w:
+            events.append((ev.type, ev.object["metadata"]["name"]))
+            if len(events) >= 3:
+                done.set()
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    unbound = make_pod("w-unbound")
+    unbound["spec"]["nodeName"] = ""
+    client.create("pods", unbound)  # filtered out
+    client.create("pods", make_pod("w1", node="n1"))
+    client.patch_status("pods", "default", "w1", {"status": {"phase": "Running"}})
+    client.delete("pods", "default", "w1", grace_seconds=0)
+    assert done.wait(5), f"events: {events}"
+    assert events == [("ADDED", "w1"), ("MODIFIED", "w1"), ("DELETED", "w1")]
+    w.stop()
+
+
+def test_graceful_pod_deletion(client):
+    pod = make_pod("grace", node="n1")
+    pod["metadata"]["finalizers"] = ["kwok.x-k8s.io/fake"]
+    client.create("pods", pod)
+    client.delete("pods", "default", "grace", grace_seconds=1)
+    got = client.get("pods", "default", "grace")
+    assert got is not None and "deletionTimestamp" in got["metadata"]
+    # the kubelet (engine) strips finalizers then force-deletes
+    client.patch_meta("pods", "default", "grace", {"metadata": {"finalizers": None}})
+    client.delete("pods", "default", "grace", grace_seconds=0)
+    assert client.get("pods", "default", "grace") is None
+
+
+def test_pagination_limit_continue(client):
+    for i in range(7):
+        client.create("nodes", make_node(f"pg-{i}"))
+    raw = client._json("GET", client.server + "/api/v1/nodes?limit=3")
+    assert len(raw["items"]) == 3
+    token = raw["metadata"]["continue"]
+    names = [n["metadata"]["name"] for n in raw["items"]]
+    while token:
+        raw = client._json(
+            "GET",
+            client.server
+            + "/api/v1/nodes?limit=3&continue="
+            + urllib.parse.quote(token),
+        )
+        names += [n["metadata"]["name"] for n in raw["items"]]
+        token = (raw.get("metadata") or {}).get("continue")
+    assert names == sorted(f"pg-{i}" for i in range(7))
+    assert len(client.list("nodes")) == 7
+
+
+def test_snapshot_restore_closes_watches(client, srv):
+    client.create("nodes", make_node("snap-n"))
+    with urllib.request.urlopen(srv.url + "/snapshot") as r:
+        snap = json.load(r)
+    assert [o["metadata"]["name"] for o in snap["objects"]["nodes"]] == ["snap-n"]
+
+    w = client.watch("nodes")
+    closed = threading.Event()
+
+    def consume():
+        for _ in w:
+            pass
+        closed.set()
+
+    threading.Thread(target=consume, daemon=True).start()
+    time.sleep(0.2)
+    client.create("nodes", make_node("snap-extra"))
+    req = urllib.request.Request(
+        srv.url + "/restore",
+        data=json.dumps(snap).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    urllib.request.urlopen(req).read()
+    assert closed.wait(5), "restore must close open watches (forces re-list)"
+    assert [n["metadata"]["name"] for n in client.list("nodes")] == ["snap-n"]
+
+
+def test_audit_log_verbs(tmp_path):
+    audit = tmp_path / "audit.log"
+    s = NativeServer(["--audit-log", str(audit)])
+    try:
+        c = HttpKubeClient(s.url)
+        c.create("nodes", make_node("a1"))
+        c.list("nodes")
+        c.get("nodes", None, "a1")
+        c.patch_status("nodes", None, "a1", {"status": {"phase": "X"}})
+        c.delete("nodes", None, "a1")
+        c.close()
+    finally:
+        s.stop()
+    lines = [json.loads(x) for x in audit.read_text().splitlines()]
+    verbs = [x["verb"] for x in lines]
+    for expected in ("create", "list", "get", "patch", "delete"):
+        assert expected in verbs, verbs
+    for x in lines:
+        assert x["apiVersion"] == "audit.k8s.io/v1"
+        assert x["responseStatus"]["code"] in (200, 201)
+
+
+def test_data_file_persistence(tmp_path):
+    data = tmp_path / "state.json"
+    s = NativeServer(["--data-file", str(data)])
+    c = HttpKubeClient(s.url)
+    c.create("nodes", make_node("persist-n"))
+    c.close()
+    s.stop()  # SIGTERM -> persist
+    assert data.exists()
+    s2 = NativeServer(["--data-file", str(data)])
+    try:
+        c2 = HttpKubeClient(s2.url)
+        assert [n["metadata"]["name"] for n in c2.list("nodes")] == ["persist-n"]
+        c2.close()
+    finally:
+        s2.stop()
+
+
+def test_engine_end_to_end_against_native_server(srv, tmp_path):
+    """The full slice: tpukwok CLI engine drives node Ready + pod Running
+    against the native apiserver (the same 4-check shape as the kwok e2e)."""
+    from kwok_tpu.kwok.cli import main
+
+    client = HttpKubeClient(srv.url)
+    client.create("nodes", make_node("e2e-node"))
+    stop = threading.Event()
+    rc = []
+    t = threading.Thread(
+        target=lambda: rc.append(main([
+            "--master", srv.url,
+            "--kubeconfig", str(tmp_path / "nope"),
+            "--manage-all-nodes", "true",
+            "--tick-interval", "0.02",
+            "--server-address", "127.0.0.1:0",
+            "--config", str(tmp_path / "absent.yaml"),
+        ], stop_event=stop)),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        node = client.get("nodes", None, "e2e-node")
+        if (node.get("status") or {}).get("conditions"):
+            break
+        time.sleep(0.05)
+    client.create("pods", make_pod("e2e-pod", node="e2e-node"))
+    while time.time() < deadline:
+        pod = client.get("pods", "default", "e2e-pod")
+        if pod and (pod.get("status") or {}).get("phase") == "Running":
+            break
+        time.sleep(0.05)
+    stop.set()
+    t.join(timeout=15)
+    client.close()
+    assert rc == [0]
+    node = client.get("nodes", None, "e2e-node")
+    conds = {c["type"]: c["status"] for c in node["status"]["conditions"]}
+    assert conds["Ready"] == "True"
+    pod = client.get("pods", "default", "e2e-pod")
+    assert pod["status"]["phase"] == "Running"
+    assert pod["status"]["podIP"]
