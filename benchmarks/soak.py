@@ -421,6 +421,22 @@ def main() -> None:
             ):
                 if k_in in m:
                     out[k_out] = int(m[k_in])
+            # where the engine's time went (VERDICT: the breakdown, not
+            # just the headline number)
+            breakdown = {}
+            for k_out, k_in in (
+                ("engine_cpu_s", "kwok_process_cpu_seconds_total"),
+                ("tick_s", "kwok_tick_seconds_sum"),
+                ("tick_flush_s", "kwok_tick_flush_seconds_sum"),
+                ("tick_kernel_s", "kwok_tick_kernel_seconds_sum"),
+                ("tick_emit_s", "kwok_tick_emit_seconds_sum"),
+                ("ticks", "kwok_ticks_total"),
+                ("watch_events", "kwok_watch_events_total"),
+            ):
+                if k_in in m:
+                    breakdown[k_out] = m[k_in]
+            if breakdown:
+                out["engine"] = breakdown
         if srv is not None:
             srv.stop()
         print(json.dumps(out))

@@ -357,6 +357,22 @@ class _HttpWatch:
             except Exception:
                 pass
 
+    def raw_lines(self) -> Iterator[bytes]:
+        """Undecoded event lines — the engine's native ingest parses them in
+        C++ (kwok_tpu.native.EventParser) instead of json.loads per event."""
+        try:
+            for raw in self._resp:
+                if self._stopped.is_set():
+                    return
+                line = raw.strip()
+                if line:
+                    yield line
+        finally:
+            try:
+                self._resp.close()
+            except Exception:
+                pass
+
     def stop(self) -> None:
         self._stopped.set()
         # Closing the response would block on the buffer lock held by a

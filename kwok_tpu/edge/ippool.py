@@ -12,18 +12,55 @@ import ipaddress
 import threading
 
 
+_DIGITS = frozenset("0123456789")
+
+
+def _ip4_int(ip: str) -> int | None:
+    """CANONICAL dotted-quad -> int without an ipaddress object (the
+    allocator runs once per pod; IPv4Address construction dominated it in
+    profiles). Only canonical quads qualify — no leading zeros, ASCII
+    decimal digits only (str.isdigit accepts non-decimal digit chars that
+    int() rejects) — everything else falls back to the ipaddress parser so
+    behavior matches it exactly."""
+    parts = ip.split(".")
+    if len(parts) != 4:
+        return None
+    v = 0
+    for p in parts:
+        if not 0 < len(p) <= 3 or (len(p) > 1 and p[0] == "0"):
+            return None
+        for c in p:
+            if c not in _DIGITS:
+                return None
+        o = int(p)
+        if o > 255:
+            return None
+        v = (v << 8) | o
+    return v
+
+
+def _ip4_str(v: int) -> str:
+    return f"{v >> 24 & 255}.{v >> 16 & 255}.{v >> 8 & 255}.{v & 255}"
+
+
 class IPPool:
     """Thread-safe: get/put/use are called from patch-executor workers."""
 
     def __init__(self, cidr: str) -> None:
         self.net = ipaddress.ip_network(cidr, strict=False)
         self._base = int(self.net.network_address)
+        self._v4 = self.net.version == 4
+        self._mask = int(self.net.netmask) if self._v4 else 0
         self._next = 1  # skip the network address, like addIP starting at offset
         self._free: list[str] = []
         self._used: set[str] = set()
         self._lock = threading.Lock()
 
     def contains(self, ip: str) -> bool:
+        if self._v4:
+            v = _ip4_int(ip)
+            if v is not None:
+                return (v & self._mask) == self._base
         try:
             return ipaddress.ip_address(ip) in self.net
         except ValueError:
@@ -37,7 +74,8 @@ class IPPool:
                     self._used.add(ip)
                     return ip
             while True:
-                ip = str(ipaddress.ip_address(self._base + self._next))
+                v = self._base + self._next
+                ip = _ip4_str(v) if self._v4 else str(ipaddress.ip_address(v))
                 self._next += 1
                 if ip not in self._used:
                     self._used.add(ip)

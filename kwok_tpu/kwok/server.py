@@ -28,6 +28,16 @@ _METRIC_HELP = {
 
 
 def render_metrics(metrics: dict) -> str:
+    metrics = dict(metrics)
+    try:  # standard process collector subset (user+sys CPU of this process)
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        metrics["process_cpu_seconds_total"] = round(
+            ru.ru_utime + ru.ru_stime, 2
+        )
+    except (ImportError, OSError):
+        pass
     lines = []
     for name, value in sorted(metrics.items()):
         full = f"kwok_{name}"

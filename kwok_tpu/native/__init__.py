@@ -23,10 +23,11 @@ logger = logging.getLogger("kwok_tpu.native")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "codec.cc")
 _PUMP_SRC = os.path.join(_DIR, "pump.cc")
+_INGEST_SRC = os.path.join(_DIR, "ingest.cc")
 _LIB = os.path.join(_DIR, "libkwokcodec.so")
 _APISERVER_SRC = os.path.join(_DIR, "apiserver.cc")
 _APISERVER_BIN = os.path.join(_DIR, "kwok-mock-apiserver")
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -40,7 +41,7 @@ def _build() -> bool:
     cxx = os.environ.get("CXX", "g++")
     cmd = [
         cxx, "-O2", "-std=c++17", "-pthread", "-shared", "-fPIC",
-        "-o", _LIB + ".tmp", _SRC, _PUMP_SRC,
+        "-o", _LIB + ".tmp", _SRC, _PUMP_SRC, _INGEST_SRC,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -92,6 +93,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.kwok_pump_close.restype = None
     lib.kwok_pump_close.argtypes = [ctypes.c_int64]
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.kwok_parse_events.restype = ctypes.c_int64
+    lib.kwok_parse_events.argtypes = [
+        ctypes.c_char_p, i64p, ctypes.c_int32,
+        u64p, u64p, u64p, u64p, u8p,
+        ctypes.c_char_p, ctypes.c_int64, i64p,
+    ]
+    lib.kwok_fingerprint_statuses.restype = None
+    lib.kwok_fingerprint_statuses.argtypes = [
+        ctypes.c_char_p, i64p, ctypes.c_int32, u64p,
+    ]
     return lib
 
 
@@ -103,7 +115,9 @@ def load() -> ctypes.CDLL | None:
             return _lib
         _tried = True
         fresh = os.path.exists(_LIB) and os.path.getmtime(_LIB) >= max(
-            os.path.getmtime(_SRC), os.path.getmtime(_PUMP_SRC)
+            os.path.getmtime(_SRC),
+            os.path.getmtime(_PUMP_SRC),
+            os.path.getmtime(_INGEST_SRC),
         )
         if not fresh and not _build():
             return None
@@ -124,6 +138,147 @@ def load() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return load() is not None
+
+
+#: field order of EventRecord string fields (ingest.cc kwok_parse_events)
+_REC_STRINGS = 11  # type, ns, name, nodeName, phase, podIP, hostIP,
+#                    creation, containers, initContainers, trueConditions
+
+# flags bits (ingest.cc)
+REC_OK = 1
+REC_DELETION = 2
+REC_FINALIZERS = 4
+REC_READINESS_GATES = 8
+REC_STATUS_SCALAR_ONLY = 16
+
+
+class EventRecord:
+    """Compact parse of one watch line (native/ingest.cc): routing strings,
+    flags, canonical fingerprints, and pre-formatted container/condition
+    blobs (codec renderer input format). `raw` keeps the original line for
+    the full-parse fallback."""
+
+    __slots__ = (
+        "type", "namespace", "name", "node_name", "phase", "pod_ip",
+        "host_ip", "creation", "containers", "init_containers",
+        "true_conditions", "flags", "fp_status", "fp_status_nc",
+        "fp_spec", "fp_meta_sel", "raw",
+    )
+
+    def __init__(self, type_, ns, name, node, phase, pod_ip, host_ip,
+                 creation, ctrs, ictrs, conds, flags, fp_s, fp_nc, fp_spec,
+                 fp_meta, raw):
+        self.type = type_
+        self.namespace = ns
+        self.name = name
+        self.node_name = node
+        self.phase = phase
+        self.pod_ip = pod_ip
+        self.host_ip = host_ip
+        self.creation = creation
+        self.containers = ctrs
+        self.init_containers = ictrs
+        self.true_conditions = conds
+        self.flags = flags
+        self.fp_status = fp_s
+        self.fp_status_nc = fp_nc
+        self.fp_spec = fp_spec
+        self.fp_meta_sel = fp_meta
+        self.raw = raw
+
+    @property
+    def ok(self) -> bool:
+        return bool(self.flags & REC_OK)
+
+
+class EventParser:
+    """Reusable single-line parser: one ctypes call per watch line, with
+    preallocated output buffers (the watch threads run this per event, so
+    per-call numpy allocation would eat the win)."""
+
+    def __init__(self) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._fp = np.zeros(4, np.uint64)  # status, status_nc, spec, meta
+        self._flags = np.zeros(1, np.uint8)
+        self._str_off = np.zeros(_REC_STRINGS + 1, np.int64)
+        self._off = np.zeros(2, np.int64)
+        self._cap = 4096
+        self._buf = bytearray(self._cap)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        self._fp_ptrs = tuple(
+            self._fp[i:].ctypes.data_as(u64p) for i in range(4)
+        )
+        self._flags_p = self._flags.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint8)
+        )
+        self._off_p = _i64p(self._off)
+        self._str_off_p = _i64p(self._str_off)
+
+    def parse(self, line: bytes) -> EventRecord:
+        self._off[1] = len(line)
+        fp = self._fp
+        p0, p1, p2, p3 = self._fp_ptrs
+        for _ in range(2):
+            need = self._lib.kwok_parse_events(
+                line, self._off_p, 1,
+                p0, p1, p2, p3,
+                self._flags_p,
+                (ctypes.c_char * self._cap).from_buffer(self._buf),
+                self._cap, self._str_off_p,
+            )
+            if need <= self._cap:
+                break
+            self._cap = int(need) + 1024
+            self._buf = bytearray(self._cap)
+        off = self._str_off
+        buf = self._buf
+        flags = int(self._flags[0])
+
+        def s(i: int) -> str:
+            b = bytes(buf[off[i] : off[i + 1]])
+            if b"\\" in b:
+                # raw JSON string bytes with escapes: routing strings must
+                # match Python-decoded values, so force the slow path
+                nonlocal flags
+                flags &= ~REC_OK
+            return b.decode("utf-8", "surrogateescape")
+
+        def blob(i: int) -> bytes:
+            b = bytes(buf[off[i] : off[i + 1]])
+            if b"\\" in b:
+                # escaped container/condition strings: the pre-formatted
+                # blob would not match Python-decoded values — the engine's
+                # fast row-init must not trust it
+                nonlocal flags
+                flags &= ~REC_STATUS_SCALAR_ONLY
+                flags &= ~REC_OK
+            return b
+
+        return EventRecord(
+            s(0), s(1), s(2), s(3), s(4), s(5), s(6), s(7),
+            blob(8), blob(9), blob(10),
+            flags, int(fp[0]), int(fp[1]), int(fp[2]), int(fp[3]), line,
+        )
+
+
+def fingerprint_statuses(bodies: list) -> "np.ndarray | None":
+    """Canonical fingerprint of the `status` subtree of each rendered patch
+    body, with the same algorithm the event parser applies to incoming
+    objects — equal fingerprints mean the server-side merged status will
+    echo back exactly this document."""
+    lib = load()
+    if lib is None:
+        return None
+    blob, off = _blob([bytes(b) for b in bodies])
+    out = np.zeros(len(bodies), np.uint64)
+    lib.kwok_fingerprint_statuses(
+        blob, _i64p(off), len(bodies),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return out
 
 
 class Pump:

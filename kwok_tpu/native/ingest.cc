@@ -1,0 +1,758 @@
+// kwok_tpu native ingest: watch-event extraction + canonical fingerprints.
+//
+// The engine's ingest edge was the scale wall (at 50k pods the tick thread
+// spent ~85% of its time in per-event json.loads + repair-path render/merge
+// on events that are echoes of the engine's own patches). This library
+// parses a watch-event line ONCE in C++ and returns:
+//
+//   - the routing fields the engine needs (type, namespace, name, nodeName,
+//     deletion/finalizer flags),
+//   - order-insensitive canonical fingerprints of the subtrees whose change
+//     forces full (Python) processing: status, status-minus-conditions
+//     (nodes: the reference's no-op check pins conditions, so heartbeat
+//     echoes only differ there — node_controller.go:377), spec, and the
+//     selector-relevant metadata (labels+annotations+deletion+finalizers).
+//
+// The engine then DROPS events whose fingerprints prove the reference's
+// render->merge->compare pipeline would conclude "no patch needed", and
+// fully parses only the survivors. Dropping is always the conservative
+// direction: any mismatch or parse surprise routes to the Python path.
+//
+// Fingerprint: objects combine members with XOR (insertion-order
+// invariant: the server may store keys in a different order than our
+// renderer emits), arrays combine in order, scalars hash their raw token
+// text. Two serializations of the same document agree as long as they
+// escape strings identically — when they don't, fingerprints differ and
+// the engine just takes the slow path.
+//
+// Build: part of libkwokcodec.so (see native/__init__.py _build).
+
+#include <cstdint>
+#include <cstring>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct Cursor {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      p++;
+  }
+  bool at(char c) { return p < end && *p == c; }
+  void expect(char c) {
+    if (at(c)) p++;
+    else ok = false;
+  }
+};
+
+constexpr uint64_t FNV_OFFSET = 1469598103934665603ull;
+constexpr uint64_t FNV_PRIME = 1099511628211ull;
+constexpr uint64_t OBJ_SEED = 0x9e3779b97f4a7c15ull;
+constexpr uint64_t ARR_SEED = 0xc2b2ae3d27d4eb4full;
+
+inline uint64_t fnv(const char* s, int64_t n, uint64_t h = FNV_OFFSET) {
+  for (int64_t i = 0; i < n; i++) {
+    h ^= (unsigned char)s[i];
+    h *= FNV_PRIME;
+  }
+  return h;
+}
+
+inline uint64_t mix(uint64_t a, uint64_t b) {
+  uint64_t x = a ^ (b + 0x9e3779b97f4a7c15ull + (a << 6) + (a >> 2));
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdull;
+  x ^= x >> 33;
+  return x;
+}
+
+// Raw string token: bytes between the quotes, escapes NOT decoded.
+// Returns [start, len) into the buffer; cursor ends after closing quote.
+bool raw_string(Cursor& c, const char** start, int64_t* len) {
+  if (!c.at('"')) {
+    c.ok = false;
+    return false;
+  }
+  c.p++;
+  *start = c.p;
+  while (c.p < c.end) {
+    if (*c.p == '\\') {
+      c.p += 2;
+      continue;
+    }
+    if (*c.p == '"') {
+      *len = c.p - *start;
+      c.p++;
+      return true;
+    }
+    c.p++;
+  }
+  c.ok = false;
+  return false;
+}
+
+uint64_t fp_value(Cursor& c);
+
+uint64_t fp_object(Cursor& c) {
+  c.expect('{');
+  c.ws();
+  uint64_t h = OBJ_SEED;
+  if (c.at('}')) {
+    c.p++;
+    return h;
+  }
+  while (c.ok) {
+    c.ws();
+    const char* ks;
+    int64_t kn;
+    if (!raw_string(c, &ks, &kn)) return h;
+    c.ws();
+    c.expect(':');
+    c.ws();
+    uint64_t kv = mix(fnv(ks, kn), fp_value(c));
+    h ^= kv;  // XOR: member order must not matter
+    c.ws();
+    if (c.at(',')) {
+      c.p++;
+      continue;
+    }
+    break;
+  }
+  c.expect('}');
+  return h;
+}
+
+uint64_t fp_array(Cursor& c) {
+  c.expect('[');
+  c.ws();
+  uint64_t h = ARR_SEED;
+  if (c.at(']')) {
+    c.p++;
+    return h;
+  }
+  while (c.ok) {
+    c.ws();
+    h = mix(h, fp_value(c));  // order matters for arrays
+    c.ws();
+    if (c.at(',')) {
+      c.p++;
+      continue;
+    }
+    break;
+  }
+  c.expect(']');
+  return h;
+}
+
+uint64_t fp_value(Cursor& c) {
+  c.ws();
+  if (c.p >= c.end) {
+    c.ok = false;
+    return 0;
+  }
+  switch (*c.p) {
+    case '{': return fp_object(c);
+    case '[': return fp_array(c);
+    case '"': {
+      const char* s;
+      int64_t n;
+      raw_string(c, &s, &n);
+      return fnv(s, n) ^ 0x5bd1e995u;
+    }
+    default: {
+      const char* s = c.p;
+      while (c.p < c.end && *c.p != ',' && *c.p != '}' && *c.p != ']' &&
+             *c.p != ' ' && *c.p != '\t' && *c.p != '\n' && *c.p != '\r')
+        c.p++;
+      return fnv(s, c.p - s);
+    }
+  }
+}
+
+void skip_value(Cursor& c) {
+  c.ws();
+  if (c.p >= c.end) {
+    c.ok = false;
+    return;
+  }
+  switch (*c.p) {
+    case '{': {
+      c.p++;
+      int depth = 1;
+      while (c.p < c.end && depth) {
+        if (*c.p == '"') {
+          const char* s;
+          int64_t n;
+          raw_string(c, &s, &n);
+          continue;
+        }
+        if (*c.p == '{') depth++;
+        else if (*c.p == '}') depth--;
+        c.p++;
+      }
+      if (depth) c.ok = false;
+      return;
+    }
+    case '[': {
+      c.p++;
+      int depth = 1;
+      while (c.p < c.end && depth) {
+        if (*c.p == '"') {
+          const char* s;
+          int64_t n;
+          raw_string(c, &s, &n);
+          continue;
+        }
+        if (*c.p == '[') depth++;
+        else if (*c.p == ']') depth--;
+        c.p++;
+      }
+      if (depth) c.ok = false;
+      return;
+    }
+    case '"': {
+      const char* s;
+      int64_t n;
+      raw_string(c, &s, &n);
+      return;
+    }
+    default:
+      while (c.p < c.end && *c.p != ',' && *c.p != '}' && *c.p != ']' &&
+             *c.p != ' ' && *c.p != '\t' && *c.p != '\n' && *c.p != '\r')
+        c.p++;
+  }
+}
+
+struct Span {
+  const char* p = nullptr;
+  int64_t n = 0;
+  bool present() const { return p != nullptr; }
+};
+
+bool span_eq(const Span& s, const char* lit) {
+  int64_t n = (int64_t)strlen(lit);
+  return s.n == n && memcmp(s.p, lit, n) == 0;
+}
+
+// One parsed watch event (or list item).
+struct Event {
+  Span type;       // ADDED / MODIFIED / DELETED / ...
+  Span name, ns, node_name, phase, pod_ip, host_ip, creation;
+  bool has_deletion = false;
+  bool has_finalizers = false;
+  bool has_readiness_gates = false;
+  bool status_scalar_only = true;  // keys subset of {phase,hostIP,podIP,startTime}
+  uint64_t fp_status = 0;
+  uint64_t fp_status_nc = 0;  // status minus top-level "conditions"
+  uint64_t fp_spec = 0;
+  uint64_t fp_meta_sel = 0;   // labels+annotations+deletion+finalizers
+  std::vector<std::pair<Span, Span>> containers;       // (name, image)
+  std::vector<std::pair<Span, Span>> init_containers;  // (name, image)
+  std::vector<Span> true_conditions;                   // types with status True
+  bool ok = false;
+};
+
+// Fingerprint an array of container objects while extracting (name, image)
+// span pairs — same fp algorithm as fp_array/fp_object.
+uint64_t fp_container_array(Cursor& c,
+                            std::vector<std::pair<Span, Span>>* out) {
+  c.ws();
+  if (!c.at('[')) return fp_value(c);
+  c.p++;
+  uint64_t h = ARR_SEED;
+  c.ws();
+  if (c.at(']')) {
+    c.p++;
+    return h;
+  }
+  while (c.ok) {
+    c.ws();
+    if (!c.at('{')) {
+      h = mix(h, fp_value(c));
+    } else {
+      c.p++;
+      uint64_t eh = OBJ_SEED;
+      Span cname, cimage;
+      c.ws();
+      if (c.at('}')) {
+        c.p++;
+      } else {
+        while (c.ok) {
+          c.ws();
+          const char* ks;
+          int64_t kn;
+          if (!raw_string(c, &ks, &kn)) break;
+          c.ws();
+          c.expect(':');
+          c.ws();
+          Span key{ks, kn};
+          if (span_eq(key, "name") && c.at('"')) {
+            raw_string(c, &cname.p, &cname.n);
+            eh ^= mix(fnv(ks, kn), fnv(cname.p, cname.n) ^ 0x5bd1e995u);
+          } else if (span_eq(key, "image") && c.at('"')) {
+            raw_string(c, &cimage.p, &cimage.n);
+            eh ^= mix(fnv(ks, kn), fnv(cimage.p, cimage.n) ^ 0x5bd1e995u);
+          } else {
+            eh ^= mix(fnv(ks, kn), fp_value(c));
+          }
+          c.ws();
+          if (c.at(',')) {
+            c.p++;
+            continue;
+          }
+          break;
+        }
+        c.expect('}');
+      }
+      if (out) out->emplace_back(cname, cimage);
+      h = mix(h, eh);
+    }
+    c.ws();
+    if (c.at(',')) {
+      c.p++;
+      continue;
+    }
+    break;
+  }
+  c.expect(']');
+  return h;
+}
+
+// Fingerprint the conditions array while collecting the True-status types.
+uint64_t fp_conditions_array(Cursor& c, std::vector<Span>* out) {
+  c.ws();
+  if (!c.at('[')) return fp_value(c);
+  c.p++;
+  uint64_t h = ARR_SEED;
+  c.ws();
+  if (c.at(']')) {
+    c.p++;
+    return h;
+  }
+  while (c.ok) {
+    c.ws();
+    if (!c.at('{')) {
+      h = mix(h, fp_value(c));
+    } else {
+      c.p++;
+      uint64_t eh = OBJ_SEED;
+      Span ctype, cstatus;
+      c.ws();
+      if (c.at('}')) {
+        c.p++;
+      } else {
+        while (c.ok) {
+          c.ws();
+          const char* ks;
+          int64_t kn;
+          if (!raw_string(c, &ks, &kn)) break;
+          c.ws();
+          c.expect(':');
+          c.ws();
+          Span key{ks, kn};
+          if (span_eq(key, "type") && c.at('"')) {
+            raw_string(c, &ctype.p, &ctype.n);
+            eh ^= mix(fnv(ks, kn), fnv(ctype.p, ctype.n) ^ 0x5bd1e995u);
+          } else if (span_eq(key, "status") && c.at('"')) {
+            raw_string(c, &cstatus.p, &cstatus.n);
+            eh ^= mix(fnv(ks, kn), fnv(cstatus.p, cstatus.n) ^ 0x5bd1e995u);
+          } else {
+            eh ^= mix(fnv(ks, kn), fp_value(c));
+          }
+          c.ws();
+          if (c.at(',')) {
+            c.p++;
+            continue;
+          }
+          break;
+        }
+        c.expect('}');
+      }
+      if (out && ctype.present() && span_eq(cstatus, "True"))
+        out->push_back(ctype);
+      h = mix(h, eh);
+    }
+    c.ws();
+    if (c.at(',')) {
+      c.p++;
+      continue;
+    }
+    break;
+  }
+  c.expect(']');
+  return h;
+}
+
+// Fingerprint the status object while noting phase/podIP/hostIP spans and
+// computing the minus-conditions variant.
+void walk_status(Cursor& c, Event& ev) {
+  c.ws();
+  if (!c.at('{')) {  // status may be null/absent-shaped
+    ev.fp_status = fp_value(c);
+    ev.fp_status_nc = ev.fp_status;
+    return;
+  }
+  c.p++;
+  uint64_t h = OBJ_SEED, hnc = OBJ_SEED;
+  c.ws();
+  if (c.at('}')) {
+    c.p++;
+    ev.fp_status = h;
+    ev.fp_status_nc = hnc;
+    return;
+  }
+  while (c.ok) {
+    c.ws();
+    const char* ks;
+    int64_t kn;
+    if (!raw_string(c, &ks, &kn)) break;
+    c.ws();
+    c.expect(':');
+    c.ws();
+    Span key{ks, kn};
+    const char* vstart = c.p;
+    if (span_eq(key, "phase") && c.at('"')) {
+      raw_string(c, &ev.phase.p, &ev.phase.n);
+      uint64_t kv = mix(fnv(ks, kn), fnv(ev.phase.p, ev.phase.n) ^ 0x5bd1e995u);
+      h ^= kv;
+      hnc ^= kv;
+    } else if (span_eq(key, "podIP") && c.at('"')) {
+      raw_string(c, &ev.pod_ip.p, &ev.pod_ip.n);
+      uint64_t kv =
+          mix(fnv(ks, kn), fnv(ev.pod_ip.p, ev.pod_ip.n) ^ 0x5bd1e995u);
+      h ^= kv;
+      hnc ^= kv;
+    } else if (span_eq(key, "hostIP") && c.at('"')) {
+      raw_string(c, &ev.host_ip.p, &ev.host_ip.n);
+      uint64_t kv =
+          mix(fnv(ks, kn), fnv(ev.host_ip.p, ev.host_ip.n) ^ 0x5bd1e995u);
+      h ^= kv;
+      hnc ^= kv;
+    } else if (span_eq(key, "conditions")) {
+      uint64_t vfp = fp_conditions_array(c, &ev.true_conditions);
+      h ^= mix(fnv(ks, kn), vfp);  // excluded from hnc by definition
+      ev.status_scalar_only = false;
+    } else {
+      uint64_t vfp = fp_value(c);
+      uint64_t kv = mix(fnv(ks, kn), vfp);
+      h ^= kv;
+      hnc ^= kv;
+      if (!span_eq(key, "startTime")) ev.status_scalar_only = false;
+    }
+    (void)vstart;
+    c.ws();
+    if (c.at(',')) {
+      c.p++;
+      continue;
+    }
+    break;
+  }
+  c.expect('}');
+  ev.fp_status = h;
+  ev.fp_status_nc = hnc;
+}
+
+void walk_metadata(Cursor& c, Event& ev) {
+  c.ws();
+  if (!c.at('{')) {
+    skip_value(c);
+    return;
+  }
+  c.p++;
+  uint64_t sel = OBJ_SEED;
+  c.ws();
+  if (c.at('}')) {
+    c.p++;
+    ev.fp_meta_sel = sel;
+    return;
+  }
+  while (c.ok) {
+    c.ws();
+    const char* ks;
+    int64_t kn;
+    if (!raw_string(c, &ks, &kn)) break;
+    c.ws();
+    c.expect(':');
+    c.ws();
+    Span key{ks, kn};
+    if (span_eq(key, "name") && c.at('"')) {
+      raw_string(c, &ev.name.p, &ev.name.n);
+    } else if (span_eq(key, "namespace") && c.at('"')) {
+      raw_string(c, &ev.ns.p, &ev.ns.n);
+    } else if (span_eq(key, "creationTimestamp") && c.at('"')) {
+      raw_string(c, &ev.creation.p, &ev.creation.n);
+    } else if (span_eq(key, "deletionTimestamp")) {
+      ev.has_deletion = !(c.p + 4 <= c.end && memcmp(c.p, "null", 4) == 0);
+      skip_value(c);
+    } else if (span_eq(key, "finalizers")) {
+      const char* before = c.p;
+      skip_value(c);
+      // non-empty array?
+      for (const char* q = before; q < c.p; q++) {
+        if (*q == '[') continue;
+        if (*q == ' ' || *q == '\n' || *q == '\t' || *q == '\r') continue;
+        ev.has_finalizers = (*q != ']');
+        break;
+      }
+      sel ^= mix(fnv(ks, kn), fnv(before, c.p - before));
+    } else if (span_eq(key, "labels") || span_eq(key, "annotations")) {
+      uint64_t vfp = fp_value(c);
+      sel ^= mix(fnv(ks, kn), vfp);
+    } else {
+      skip_value(c);
+    }
+    c.ws();
+    if (c.at(',')) {
+      c.p++;
+      continue;
+    }
+    break;
+  }
+  c.expect('}');
+  sel = mix(sel, (uint64_t)ev.has_deletion << 1 | (uint64_t)ev.has_finalizers);
+  ev.fp_meta_sel = sel;
+}
+
+void walk_spec(Cursor& c, Event& ev) {
+  c.ws();
+  if (!c.at('{')) {
+    ev.fp_spec = fp_value(c);
+    return;
+  }
+  c.p++;
+  uint64_t h = OBJ_SEED;
+  c.ws();
+  if (c.at('}')) {
+    c.p++;
+    ev.fp_spec = h;
+    return;
+  }
+  while (c.ok) {
+    c.ws();
+    const char* ks;
+    int64_t kn;
+    if (!raw_string(c, &ks, &kn)) break;
+    c.ws();
+    c.expect(':');
+    c.ws();
+    Span key{ks, kn};
+    if (span_eq(key, "nodeName") && c.at('"')) {
+      raw_string(c, &ev.node_name.p, &ev.node_name.n);
+      h ^= mix(fnv(ks, kn),
+               fnv(ev.node_name.p, ev.node_name.n) ^ 0x5bd1e995u);
+    } else if (span_eq(key, "containers")) {
+      h ^= mix(fnv(ks, kn), fp_container_array(c, &ev.containers));
+    } else if (span_eq(key, "initContainers")) {
+      h ^= mix(fnv(ks, kn), fp_container_array(c, &ev.init_containers));
+    } else if (span_eq(key, "readinessGates")) {
+      const char* before = c.p;
+      uint64_t vfp = fp_value(c);
+      h ^= mix(fnv(ks, kn), vfp);
+      for (const char* q = before; q < c.p; q++) {
+        if (*q == '[') continue;
+        if (*q == ' ' || *q == '\n' || *q == '\t' || *q == '\r') continue;
+        ev.has_readiness_gates = (*q != ']');
+        break;
+      }
+    } else {
+      uint64_t vfp = fp_value(c);
+      h ^= mix(fnv(ks, kn), vfp);
+    }
+    c.ws();
+    if (c.at(',')) {
+      c.p++;
+      continue;
+    }
+    break;
+  }
+  c.expect('}');
+  ev.fp_spec = h;
+}
+
+// Parse {"type":"...","object":{...}} (a watch line) or a bare object (a
+// List item). Populates ev; ev.ok=false routes the caller to Python.
+void parse_event(const char* data, int64_t n, Event& ev) {
+  Cursor c{data, data + n};
+  c.ws();
+  if (!c.at('{')) return;
+  c.p++;
+  bool saw_object = false;
+  while (c.ok) {
+    c.ws();
+    const char* ks;
+    int64_t kn;
+    if (!raw_string(c, &ks, &kn)) break;
+    c.ws();
+    c.expect(':');
+    c.ws();
+    Span key{ks, kn};
+    if (span_eq(key, "type") && c.at('"')) {
+      raw_string(c, &ev.type.p, &ev.type.n);
+    } else if (span_eq(key, "object")) {
+      // nested object document
+      c.ws();
+      if (!c.at('{')) {
+        skip_value(c);
+      } else {
+        saw_object = true;
+        c.p++;
+        while (c.ok) {
+          c.ws();
+          const char* oks;
+          int64_t okn;
+          if (!raw_string(c, &oks, &okn)) break;
+          c.ws();
+          c.expect(':');
+          Span okey{oks, okn};
+          if (span_eq(okey, "metadata")) walk_metadata(c, ev);
+          else if (span_eq(okey, "spec")) walk_spec(c, ev);
+          else if (span_eq(okey, "status")) walk_status(c, ev);
+          else skip_value(c);
+          c.ws();
+          if (c.at(',')) {
+            c.p++;
+            continue;
+          }
+          break;
+        }
+        c.expect('}');
+      }
+    } else if (span_eq(key, "metadata")) {
+      // bare object form (List item)
+      walk_metadata(c, ev);
+      saw_object = true;
+    } else if (span_eq(key, "spec")) {
+      walk_spec(c, ev);
+      saw_object = true;
+    } else if (span_eq(key, "status")) {
+      walk_status(c, ev);
+      saw_object = true;
+    } else {
+      skip_value(c);
+    }
+    c.ws();
+    if (c.at(',')) {
+      c.p++;
+      continue;
+    }
+    break;
+  }
+  c.expect('}');
+  ev.ok = c.ok && saw_object && ev.name.present();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse n event lines (concatenated, offsets delimit). Fixed-width outputs
+// per event; string fields are copied into str_out with per-event offsets
+// for (type, ns, name, nodeName, phase, podIP, hostIP, creationTimestamp,
+// containers, initContainers, trueConditions) — 11 strings per event, so
+// str_off has 11*n+1 entries. Containers are "name\x1fimage" records
+// joined by \x1e (the codec renderer's input format); trueConditions are
+// condition types with status True joined by \x1f. Returns total string
+// bytes needed (if > str_cap, call again with a bigger buffer).
+// flags bit 0 = parse ok, 1 = has_deletion, 2 = has_finalizers,
+// 3 = has_readiness_gates, 4 = status has scalar-replace keys only.
+int64_t kwok_parse_events(
+    const char* blob, const int64_t* off, int32_t n,
+    uint64_t* fp_status, uint64_t* fp_status_nc, uint64_t* fp_spec,
+    uint64_t* fp_meta_sel, uint8_t* flags,
+    char* str_out, int64_t str_cap, int64_t* str_off) {
+  int64_t used = 0;
+  auto put_bytes = [&](const char* p, int64_t len) {
+    if (p && len > 0) {
+      if (used + len <= str_cap) memcpy(str_out + used, p, len);
+      used += len;
+    }
+  };
+  auto put = [&](const Span& s, int64_t slot) {
+    str_off[slot] = used;
+    put_bytes(s.p, s.n);
+  };
+  auto put_ctrs = [&](const std::vector<std::pair<Span, Span>>& cs,
+                      int64_t slot) {
+    str_off[slot] = used;
+    for (size_t j = 0; j < cs.size(); j++) {
+      if (j) put_bytes("\x1e", 1);
+      put_bytes(cs[j].first.p, cs[j].first.n);
+      put_bytes("\x1f", 1);
+      put_bytes(cs[j].second.p, cs[j].second.n);
+    }
+  };
+  for (int32_t i = 0; i < n; i++) {
+    Event ev;
+    parse_event(blob + off[i], off[i + 1] - off[i], ev);
+    fp_status[i] = ev.fp_status;
+    fp_status_nc[i] = ev.fp_status_nc;
+    fp_spec[i] = ev.fp_spec;
+    fp_meta_sel[i] = ev.fp_meta_sel;
+    flags[i] = (uint8_t)(ev.ok | (ev.has_deletion << 1) |
+                         (ev.has_finalizers << 2) |
+                         (ev.has_readiness_gates << 3) |
+                         (ev.status_scalar_only << 4));
+    int64_t base = (int64_t)i * 11;
+    put(ev.type, base + 0);
+    put(ev.ns, base + 1);
+    put(ev.name, base + 2);
+    put(ev.node_name, base + 3);
+    put(ev.phase, base + 4);
+    put(ev.pod_ip, base + 5);
+    put(ev.host_ip, base + 6);
+    put(ev.creation, base + 7);
+    put_ctrs(ev.containers, base + 8);
+    put_ctrs(ev.init_containers, base + 9);
+    str_off[base + 10] = used;
+    for (size_t j = 0; j < ev.true_conditions.size(); j++) {
+      if (j) put_bytes("\x1f", 1);
+      put_bytes(ev.true_conditions[j].p, ev.true_conditions[j].n);
+    }
+  }
+  str_off[(int64_t)n * 11] = used;
+  return used;
+}
+
+// Fingerprint the "status" subtree of each rendered patch body
+// ({"status":{...}}), with the SAME algorithm the event parser uses — the
+// engine stores these as the expected post-patch status fingerprint.
+void kwok_fingerprint_statuses(const char* blob, const int64_t* off,
+                               int32_t n, uint64_t* out) {
+  for (int32_t i = 0; i < n; i++) {
+    Cursor c{blob + off[i], blob + off[i + 1]};
+    c.ws();
+    uint64_t fp = 0;
+    if (c.at('{')) {
+      c.p++;
+      while (c.ok) {
+        c.ws();
+        const char* ks;
+        int64_t kn;
+        if (!raw_string(c, &ks, &kn)) break;
+        c.ws();
+        c.expect(':');
+        if (kn == 6 && memcmp(ks, "status", 6) == 0) {
+          Event ev;
+          walk_status(c, ev);
+          fp = ev.fp_status;
+        } else {
+          skip_value(c);
+        }
+        c.ws();
+        if (c.at(',')) {
+          c.p++;
+          continue;
+        }
+        break;
+      }
+    }
+    out[i] = fp;
+  }
+}
+
+}  // extern "C"

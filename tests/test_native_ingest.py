@@ -1,0 +1,241 @@
+"""Native ingest parity: the C++ event parser + canonical fingerprints
+(native/ingest.cc) against Python json semantics, and the engine's
+echo-drop behavior (engine._ingest_record) under external drift.
+
+The invariants that make dropping safe:
+- fingerprints are insensitive to object key order (servers may store keys
+  in a different order than the renderer emits) but sensitive to any value
+  change;
+- the expectation fingerprint computed from a rendered patch body equals
+  the event fingerprint of the identical status document;
+- anything surprising (escapes, parse failures, changed spec/meta) routes
+  to the full Python path.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kwok_tpu import native
+from tests.test_engine import make_node, make_pod
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain"
+)
+
+
+def ev_line(type_, obj) -> bytes:
+    return json.dumps({"type": type_, "object": obj}).encode()
+
+
+@pytest.fixture
+def parser():
+    return native.EventParser()
+
+
+def test_field_extraction(parser):
+    pod = {
+        "metadata": {
+            "name": "p1", "namespace": "ns1",
+            "creationTimestamp": "2026-07-01T00:00:00Z",
+            "labels": {"app": "x"},
+            "finalizers": ["keep"],
+            "deletionTimestamp": "2026-07-02T00:00:00Z",
+        },
+        "spec": {
+            "nodeName": "n1",
+            "containers": [
+                {"name": "c1", "image": "img1"},
+                {"name": "c2", "image": "img2"},
+            ],
+            "initContainers": [{"name": "i1", "image": "init1"}],
+            "readinessGates": [{"conditionType": "G"}],
+        },
+        "status": {
+            "phase": "Running", "podIP": "10.0.0.9", "hostIP": "1.2.3.4",
+            "conditions": [
+                {"type": "Ready", "status": "True"},
+                {"type": "Initialized", "status": "False"},
+            ],
+        },
+    }
+    r = parser.parse(ev_line("MODIFIED", pod))
+    assert r.ok
+    assert (r.type, r.namespace, r.name, r.node_name) == (
+        "MODIFIED", "ns1", "p1", "n1"
+    )
+    assert (r.phase, r.pod_ip, r.host_ip) == ("Running", "10.0.0.9", "1.2.3.4")
+    assert r.creation == "2026-07-01T00:00:00Z"
+    assert r.flags & native.REC_DELETION
+    assert r.flags & native.REC_FINALIZERS
+    assert r.flags & native.REC_READINESS_GATES
+    assert not r.flags & native.REC_STATUS_SCALAR_ONLY  # conditions present
+    assert r.containers == b"c1\x1fimg1\x1ec2\x1fimg2"
+    assert r.init_containers == b"i1\x1finit1"
+    assert r.true_conditions == b"Ready"
+
+
+def test_scalar_only_flag(parser):
+    obj = {"metadata": {"name": "p"}, "status": {"phase": "Pending"}}
+    assert parser.parse(ev_line("ADDED", obj)).flags & native.REC_STATUS_SCALAR_ONLY
+    obj["status"]["qosClass"] = "BestEffort"
+    assert not (
+        parser.parse(ev_line("ADDED", obj)).flags & native.REC_STATUS_SCALAR_ONLY
+    )
+
+
+def test_fingerprint_key_order_invariance(parser):
+    a = {
+        "metadata": {"name": "p", "labels": {"a": "1", "b": "2"}},
+        "spec": {"nodeName": "n", "containers": [{"name": "c", "image": "i"}]},
+        "status": {"phase": "Running", "hostIP": "h", "podIP": "q"},
+    }
+    b = {
+        "status": {"podIP": "q", "phase": "Running", "hostIP": "h"},
+        "spec": {"containers": [{"image": "i", "name": "c"}], "nodeName": "n"},
+        "metadata": {"labels": {"b": "2", "a": "1"}, "name": "p"},
+    }
+    ra, rb = parser.parse(ev_line("M", a)), parser.parse(ev_line("M", b))
+    assert ra.fp_status == rb.fp_status
+    assert ra.fp_spec == rb.fp_spec
+    assert ra.fp_meta_sel == rb.fp_meta_sel
+
+
+def test_fingerprint_sensitivity(parser):
+    base = {
+        "metadata": {"name": "p", "labels": {"a": "1"}},
+        "spec": {"nodeName": "n"},
+        "status": {"phase": "Running"},
+    }
+    r0 = parser.parse(ev_line("M", base))
+    import copy
+
+    v = copy.deepcopy(base)
+    v["status"]["phase"] = "Failed"
+    assert parser.parse(ev_line("M", v)).fp_status != r0.fp_status
+    v = copy.deepcopy(base)
+    v["spec"]["nodeName"] = "other"
+    assert parser.parse(ev_line("M", v)).fp_spec != r0.fp_spec
+    v = copy.deepcopy(base)
+    v["metadata"]["labels"]["a"] = "2"
+    assert parser.parse(ev_line("M", v)).fp_meta_sel != r0.fp_meta_sel
+    v = copy.deepcopy(base)
+    v["metadata"]["deletionTimestamp"] = "t"
+    assert parser.parse(ev_line("M", v)).fp_meta_sel != r0.fp_meta_sel
+    # array order matters (conditions lists are order-preserving documents)
+    c1 = dict(base, status={"conditions": [
+        {"type": "A", "status": "True"}, {"type": "B", "status": "False"},
+    ]})
+    c2 = dict(base, status={"conditions": [
+        {"type": "B", "status": "False"}, {"type": "A", "status": "True"},
+    ]})
+    assert (
+        parser.parse(ev_line("M", c1)).fp_status
+        != parser.parse(ev_line("M", c2)).fp_status
+    )
+
+
+def test_status_nc_ignores_conditions_only_changes(parser):
+    s1 = {
+        "metadata": {"name": "n"},
+        "status": {
+            "capacity": {"cpu": "1k"},
+            "conditions": [{"type": "Ready", "status": "True",
+                            "lastHeartbeatTime": "t1"}],
+        },
+    }
+    s2 = json.loads(json.dumps(s1))
+    s2["status"]["conditions"][0]["lastHeartbeatTime"] = "t2"
+    r1, r2 = parser.parse(ev_line("M", s1)), parser.parse(ev_line("M", s2))
+    assert r1.fp_status != r2.fp_status  # full status sees the heartbeat
+    assert r1.fp_status_nc == r2.fp_status_nc  # minus-conditions does not
+    s3 = json.loads(json.dumps(s2))
+    s3["status"]["capacity"] = {"cpu": "2k"}
+    assert parser.parse(ev_line("M", s3)).fp_status_nc != r2.fp_status_nc
+
+
+def test_escapes_force_slow_path(parser):
+    obj = {"metadata": {"name": 'we"ird'}, "status": {}}
+    r = parser.parse(ev_line("ADDED", obj))
+    assert not r.ok  # escaped name: routing strings unreliable
+
+
+def test_expectation_matches_event_fingerprint(parser):
+    status = {
+        "conditions": [{"type": "Ready", "status": "True",
+                        "lastTransitionTime": "t"}],
+        "containerStatuses": [{"name": "c", "ready": True,
+                               "restartCount": 0}],
+        "hostIP": "1.2.3.4", "podIP": "10.0.0.7",
+        "phase": "Running", "startTime": "t",
+    }
+    body = json.dumps({"status": status}, separators=(",", ":")).encode()
+    fp = native.fingerprint_statuses([body])[0]
+    # the echo stores the same document, possibly reordered
+    reordered = {k: status[k] for k in reversed(list(status))}
+    rec = parser.parse(
+        ev_line("MODIFIED", {"metadata": {"name": "p"}, "status": reordered})
+    )
+    assert int(fp) == rec.fp_status
+
+
+def test_engine_drops_echoes_but_repairs_external_drift(tmp_path):
+    """Over real HTTP: the engine must still repair an externally-mangled
+    pod status (the fingerprints differ, so the event takes the full
+    reference path) while its own patch echoes are droppable."""
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.kwok.cli import main
+
+    srv_bin = native.apiserver_binary()
+    if srv_bin is None:
+        pytest.skip("no native apiserver")
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(
+        [srv_bin, "--port", "0"], stdout=subprocess.PIPE, text=True
+    )
+    try:
+        url = proc.stdout.readline().rsplit(" ", 1)[-1].strip()
+        client = HttpKubeClient(url)
+        client.create("nodes", make_node("drift-node"))
+        stop = threading.Event()
+        rc = []
+        t = threading.Thread(
+            target=lambda: rc.append(main([
+                "--master", url,
+                "--kubeconfig", str(tmp_path / "nope"),
+                "--manage-all-nodes", "true",
+                "--tick-interval", "0.02",
+                "--server-address", "127.0.0.1:0",
+                "--config", str(tmp_path / "absent.yaml"),
+            ], stop_event=stop)),
+            daemon=True,
+        )
+        t.start()
+        client.create("pods", make_pod("drift-pod", node="drift-node"))
+        deadline = time.time() + 30
+
+        def phase():
+            pod = client.get("pods", "default", "drift-pod")
+            return (pod.get("status") or {}).get("phase") if pod else None
+
+        while time.time() < deadline and phase() != "Running":
+            time.sleep(0.05)
+        assert phase() == "Running"
+        # external actor mangles the status -> engine must re-lock it
+        client.patch_status(
+            "pods", "default", "drift-pod", {"status": {"phase": "Failed"}}
+        )
+        while time.time() < deadline and phase() != "Running":
+            time.sleep(0.05)
+        assert phase() == "Running", "external drift was not repaired"
+        stop.set()
+        t.join(timeout=15)
+        client.close()
+        assert rc == [0]
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=5)
