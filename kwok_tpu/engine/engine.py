@@ -394,9 +394,16 @@ class ClusterEngine:
                         # thread drops echo events by fingerprint and fully
                         # parses only the survivors (_ingest_record)
                         for line in raw_iter():
+                            rec = parser.parse(line)
+                            if rec.type == "ERROR":
+                                # terminate this watch like __iter__ does:
+                                # re-watch + re-list (410 Gone semantics)
+                                logger.warning(
+                                    "watch error event: %.200r", line
+                                )
+                                break
                             self._q.put(
-                                (kind, "REC", parser.parse(line),
-                                 time.monotonic())
+                                (kind, "REC", rec, time.monotonic())
                             )
                     else:
                         for ev in w:

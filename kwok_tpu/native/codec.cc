@@ -259,6 +259,8 @@ int64_t kwok_render_pod_statuses(
   return b.len;
 }
 
-int32_t kwok_codec_abi_version() { return 2; }
+// Keep in lockstep with ABI_VERSION in native/__init__.py — a mismatch
+// triggers delete+rebuild loops (and bricks hosts without a compiler).
+int32_t kwok_codec_abi_version() { return 3; }
 
 }  // extern "C"

@@ -415,7 +415,6 @@ void walk_status(Cursor& c, Event& ev) {
     c.expect(':');
     c.ws();
     Span key{ks, kn};
-    const char* vstart = c.p;
     if (span_eq(key, "phase") && c.at('"')) {
       raw_string(c, &ev.phase.p, &ev.phase.n);
       uint64_t kv = mix(fnv(ks, kn), fnv(ev.phase.p, ev.phase.n) ^ 0x5bd1e995u);
@@ -444,7 +443,6 @@ void walk_status(Cursor& c, Event& ev) {
       hnc ^= kv;
       if (!span_eq(key, "startTime")) ev.status_scalar_only = false;
     }
-    (void)vstart;
     c.ws();
     if (c.at(',')) {
       c.p++;

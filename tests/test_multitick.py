@@ -82,3 +82,75 @@ def test_profiler_hook_writes_trace(tmp_path):
     eng.pump(105)
     assert not getattr(eng, "_profiling", False), "trace not stopped"
     assert any(tmp_path.rglob("*")), "no trace files written"
+
+
+def test_multi_step_scan_matches_sequential():
+    """steps=K in one dispatch == K sequential single-step dispatches:
+    identical final state, counters summed, masks OR'ed. Constant delays
+    keep the comparison PRNG-independent."""
+    from kwok_tpu.models.defaults import SEL_MANAGED
+    from kwok_tpu.models.lifecycle import (
+        Delay,
+        LifecycleRule,
+        ResourceKind,
+        StatusEffect,
+    )
+
+    rules = [
+        LifecycleRule(
+            name="up",
+            resource=ResourceKind.POD,
+            from_phases=("Pending",),
+            selector=SEL_MANAGED,
+            delay=Delay.constant(1.0),
+            effect=StatusEffect(to_phase="Running", conditions={"Ready": True}),
+        ),
+        LifecycleRule(
+            name="done",
+            resource=ResourceKind.POD,
+            from_phases=("Running",),
+            selector=SEL_MANAGED,
+            delay=Delay.constant(2.0),
+            effect=StatusEffect(to_phase="Succeeded", conditions={"Ready": False}),
+        ),
+    ]
+    ptab = compile_rules(rules, ResourceKind.POD)
+    ntab = compile_rules(default_rules(), ResourceKind.NODE)
+    K, DT = 6, 1.0
+
+    single = MultiTickKernel([(ptab, 30.0, (), -1), (ntab, 30.0, (), 1)])
+    multi = MultiTickKernel(
+        [(ptab, 30.0, (), -1), (ntab, 30.0, (), 1)], steps=K, dt=DT
+    )
+
+    pods_s, nodes_s = _seed(128, 7), _seed(32, 8)
+    pods_m, nodes_m = _seed(128, 7), _seed(32, 8)
+
+    # sequential reference
+    acc_dirty = np.zeros(128, bool)
+    acc_del = np.zeros(128, bool)
+    acc_hb = np.zeros(32, bool)
+    total_tr = total_hb = 0
+    ps, ns = pods_s, nodes_s
+    now = 0.0
+    for _ in range(K):
+        pout, nout = single((ps, ns), now)
+        ps, ns = pout.state, nout.state
+        acc_dirty |= np.asarray(pout.dirty)
+        acc_del |= np.asarray(pout.deleted)
+        acc_hb |= np.asarray(nout.hb_fired)
+        total_tr += int(pout.transitions) + int(nout.transitions)
+        total_hb += int(pout.heartbeats) + int(nout.heartbeats)
+        now += DT
+
+    pm, nm = multi((pods_m, nodes_m), 0.0)
+    seq_p, seq_n = to_host(ps), to_host(ns)
+    got_p, got_n = to_host(pm.state), to_host(nm.state)
+    for f in ("phase", "cond_bits", "pending_rule", "gen", "active"):
+        np.testing.assert_array_equal(getattr(got_p, f), getattr(seq_p, f), f)
+        np.testing.assert_array_equal(getattr(got_n, f), getattr(seq_n, f), f)
+    np.testing.assert_array_equal(np.asarray(pm.dirty), acc_dirty)
+    np.testing.assert_array_equal(np.asarray(pm.deleted), acc_del)
+    np.testing.assert_array_equal(np.asarray(nm.hb_fired), acc_hb)
+    assert int(pm.transitions) + int(nm.transitions) == total_tr
+    assert int(pm.heartbeats) + int(nm.heartbeats) == total_hb
