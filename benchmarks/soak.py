@@ -218,6 +218,14 @@ def main() -> None:
     p.add_argument("--no-native-load", action="store_true",
                    help="force the Python thread/process load generator "
                    "even when the native pump is available")
+    p.add_argument("--heartbeat-interval", type=float, default=30.0,
+                   help="engine node-heartbeat interval (seconds)")
+    p.add_argument("--hold", type=float, default=0.0,
+                   help="after all pods Running, hold this many seconds and "
+                   "report the steady-state heartbeat rate")
+    p.add_argument("--churn", type=int, default=0,
+                   help="after the hold, gracefully delete this many pods "
+                   "and time the engine's strip+delete flow")
     args = p.parse_args()
 
     from kwok_tpu.edge.httpclient import HttpKubeClient
@@ -239,6 +247,7 @@ def main() -> None:
             EngineConfig(
                 manage_all_nodes=True,
                 tick_interval=args.tick_interval,
+                heartbeat_interval=args.heartbeat_interval,
                 parallelism=args.engine_parallelism,
                 initial_capacity=max(args.pods, args.nodes, 4096),
             ),
@@ -274,6 +283,7 @@ def main() -> None:
              "--master", url,
              "--manage-all-nodes", "true",
              "--tick-interval", str(args.tick_interval),
+             "--heartbeat-interval", str(args.heartbeat_interval),
              "--parallelism", str(args.engine_parallelism),
              "--initial-capacity", str(max(args.pods, args.nodes, 4096)),
              "--server-address", f"127.0.0.1:{srv_port}"],
@@ -395,6 +405,67 @@ def main() -> None:
             time.sleep(poll)
         pods_s = time.perf_counter() - t_pods
 
+        # --- steady state: heartbeat flood ---------------------------------
+        hold_out = {}
+        if args.hold > 0:
+            def hb_count() -> float:
+                if engine is not None:
+                    return engine.metrics["heartbeats_total"]
+                return _scrape_metrics(metrics_url).get(
+                    "kwok_heartbeats_total", 0
+                )
+
+            hb0 = hb_count()
+            t_hold = time.perf_counter()
+            time.sleep(args.hold)
+            held = time.perf_counter() - t_hold
+            deadline += held  # the hold must not eat the churn wait's budget
+            hold_out = {
+                "hold_s": round(held, 2),
+                "heartbeats_per_s": round((hb_count() - hb0) / held, 1),
+                "heartbeat_interval_s": args.heartbeat_interval,
+            }
+
+        # --- churn: graceful deletes -> engine strip+delete ----------------
+        churn_out = {}
+        if args.churn > 0:
+            n_churn = min(args.churn, args.pods)
+            t0 = time.perf_counter()
+            body = b'{"gracePeriodSeconds":1}'
+            if pump is not None:
+                st = pump.send([
+                    ("DELETE", f"/api/v1/namespaces/default/pods/soak-pod-{i}",
+                     body)
+                    for i in range(n_churn)
+                ])
+                ok = int(((st >= 200) & (st < 300)).sum())
+                if ok < n_churn:
+                    raise SystemExit(f"churn: only {ok}/{n_churn} deletes sent")
+            else:
+                list(pool.map(
+                    lambda i: client.delete(
+                        "pods", "default", f"soak-pod-{i}", grace_seconds=1
+                    ),
+                    range(n_churn),
+                ))
+            issue_s = time.perf_counter() - t0
+            remaining = args.pods - n_churn
+            while poller.count("/api/v1/pods") > remaining:
+                if time.monotonic() > deadline:
+                    n = poller.count("/api/v1/pods")
+                    raise SystemExit(
+                        f"timeout waiting for churn deletes ({n} pods left, "
+                        f"want {remaining})"
+                    )
+                time.sleep(poll)
+            churn_s = time.perf_counter() - t0
+            churn_out = {
+                "churn_pods": n_churn,
+                "churn_deletes_per_s": round(n_churn / churn_s, 1),
+                "churn_elapsed_s": round(churn_s, 2),
+                "churn_issue_s": round(issue_s, 2),
+            }
+
         out = {
             "metric": (
                 f"e2e soak: {args.pods} pods x {args.nodes} nodes over HTTP "
@@ -407,6 +478,8 @@ def main() -> None:
             "nodes_elapsed_s": round(nodes_s, 2),
             "nodes_create_s": round(create_nodes_s, 2),
         }
+        out.update(hold_out)
+        out.update(churn_out)
         if engine is not None:
             m = engine.metrics
             out["status_patches_total"] = m["status_patches_total"]

@@ -1132,10 +1132,16 @@ class ClusterEngine:
                 key = k.pool.key_of(idx)
                 if key is not None:
                     self._submit(self._patch_pod_status, key, idx)
-            for idx in np.nonzero(deleted)[0]:
-                key = k.pool.key_of(int(idx))
-                if key is not None:
-                    self._submit(self._delete_pod, key, int(idx))
+            del_rows = [
+                (key, int(idx))
+                for idx in np.nonzero(deleted)[0]
+                if (key := k.pool.key_of(int(idx))) is not None
+            ]
+            if len(del_rows) > 1 and self._get_pump() is not None:
+                self._emit_deletes_native(k, del_rows)
+            else:
+                for key, idx in del_rows:
+                    self._submit(self._delete_pod, key, idx)
 
     _POD_KIND = {"Running": 0, "Succeeded": 1, "Failed": 2}
 
@@ -1422,3 +1428,48 @@ class ClusterEngine:
             self.client.patch_meta("pods", ns, name, {"metadata": {"finalizers": None}})
         self.client.delete("pods", ns, name, grace_seconds=0)
         self._inc("deletes_total")
+
+    def _emit_deletes_native(self, k, del_rows) -> None:
+        """Batch the DeletePod flow: all finalizer strips in one pump call,
+        then all grace-0 deletes (global order preserves each pod's
+        strip-before-delete)."""
+        import urllib.parse
+
+        strips, strip_rows, deletes = [], [], []
+        for (ns, name), idx in del_rows:
+            m = k.pool.meta[idx]
+            path = (
+                f"{self._pump_base}/api/v1/namespaces/"
+                f"{urllib.parse.quote(ns)}/pods/{urllib.parse.quote(name)}"
+            )
+            if m and m.get("finalizers"):
+                strips.append((
+                    "PATCH", path, b'{"metadata":{"finalizers":null}}',
+                    "application/merge-patch+json",
+                ))
+                strip_rows.append(((ns, name), idx))
+            deletes.append(("DELETE", path, b'{"gracePeriodSeconds":0}'))
+        self._submit(
+            self._pump_send_deletes, strips, strip_rows, deletes, del_rows
+        )
+
+    def _pump_send_deletes(self, strips, strip_rows, deletes, del_rows) -> None:
+        retry: set[int] = set()
+        with self._pump_lock:
+            if strips:
+                strip_status = self._pump.send(strips)
+                # a failed strip leaves finalizers on the pod, turning the
+                # grace-0 delete into a graceful mark — those rows must go
+                # through the per-object strip+delete fallback
+                for st, (_key, idx) in zip(strip_status.tolist(), strip_rows):
+                    if not (200 <= st < 300 or st == 404):
+                        retry.add(idx)
+            status = self._pump.send(deletes)
+        # 404 = already gone server-side; the per-object path counts every
+        # issued delete, so the batch path matches that accounting
+        ok = int(((status >= 200) & (status < 300)).sum())
+        ok += int((status == 404).sum())
+        self._inc("deletes_total", ok)
+        for st, (key, idx) in zip(status.tolist(), del_rows):
+            if idx in retry or not (200 <= st < 300 or st == 404):
+                self._submit(self._delete_pod, key, idx)
