@@ -34,6 +34,7 @@ class KwokConfigurationOptions:
     enableCNI: bool = False
     # TPU-native extensions (not in the reference):
     tickInterval: float = 0.05
+    tickSubsteps: int = 1
     heartbeatInterval: float = 30.0
     parallelism: int = 16
     initialCapacity: int = 4096

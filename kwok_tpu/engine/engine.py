@@ -88,6 +88,11 @@ class EngineConfig:
     node_ip: str = "196.168.0.1"
     enable_cni: bool = False  # accepted for parity; real CNI is out of scope
     tick_interval: float = 0.05
+    # Inner simulated ticks per device dispatch (ops/tick.MultiTickKernel
+    # steps): >1 amortizes dispatch round-trips on remote/tunneled devices.
+    # Counters stay exact; a row transitioning more than once per dispatch
+    # is patched once with its final state (the engine's normal coalescing).
+    tick_substeps: int = 1
     heartbeat_interval: float = 30.0
     parallelism: int = 16
     initial_capacity: int = 4096
@@ -332,8 +337,10 @@ class ClusterEngine:
 
     def _get_fused(self) -> MultiTickKernel:
         if self._fused is None:
+            steps = max(1, int(self.config.tick_substeps))
             self._fused = MultiTickKernel(
-                self._fused_specs, mesh=self._mesh, pack=True
+                self._fused_specs, mesh=self._mesh, pack=True,
+                steps=steps, dt=self.config.tick_interval / steps,
             )
         return self._fused
 
@@ -978,8 +985,12 @@ class ClusterEngine:
         t_kernel = t_flush
         emit_s = 0.0
         if work:
-            (nout, pout), wire = self._get_fused()(
-                (self.nodes.state, self.pods.state), now
+            fused = self._get_fused()
+            # with substeps, the scan runs at now_base + i*dt; anchor the
+            # LAST substep at wall-now so firing never runs ahead of time
+            now_base = now - (fused.steps - 1) * fused.dt
+            (nout, pout), wire = fused(
+                (self.nodes.state, self.pods.state), now_base
             )
             self.nodes.state = nout.state
             self.pods.state = pout.state

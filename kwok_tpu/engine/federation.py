@@ -87,6 +87,7 @@ class FederatedEngine:
         # (rule tables are e0's — all members share them): one dispatch and
         # one packed-wire D2H per federated tick (ops/tick.MultiTickKernel).
         hb_bit = e0.node_bits[SEL_HEARTBEAT]
+        steps = max(1, int(getattr(config, "tick_substeps", 1)))
         self._fused = MultiTickKernel(
             [
                 (e0.nodes.table, config.heartbeat_interval, (), hb_bit),
@@ -94,6 +95,8 @@ class FederatedEngine:
             ],
             mesh=self.mesh,
             pack=True,
+            steps=steps,
+            dt=config.tick_interval / steps,
         )
 
         # Shared engine epoch so one `now` is correct for every member.
@@ -203,8 +206,10 @@ class FederatedEngine:
                     any_rows = True
             self._stacked[kind] = state
         if any_rows:
+            # with substeps, anchor the LAST scan step at wall-now
+            now_base = now - (self._fused.steps - 1) * self._fused.dt
             (nout, pout), wire = self._fused(
-                (self._stacked["nodes"], self._stacked["pods"]), now
+                (self._stacked["nodes"], self._stacked["pods"]), now_base
             )
             self._stacked["nodes"] = nout.state
             self._stacked["pods"] = pout.state

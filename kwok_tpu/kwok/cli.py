@@ -57,6 +57,9 @@ def build_parser(defaults) -> argparse.ArgumentParser:
                    help="healthz/metrics address, e.g. 0.0.0.0:10247")
     p.add_argument("--enable-cni", type=_bool, default=o.enableCNI)
     p.add_argument("--tick-interval", type=float, default=o.tickInterval)
+    p.add_argument("--tick-substeps", type=int, default=o.tickSubsteps,
+                   help="simulated ticks fused into one device dispatch "
+                   "(amortizes round-trips on remote/tunneled TPUs)")
     p.add_argument("--heartbeat-interval", type=float, default=o.heartbeatInterval)
     p.add_argument("--parallelism", type=int, default=o.parallelism)
     p.add_argument("--initial-capacity", type=int, default=o.initialCapacity)
@@ -86,6 +89,7 @@ def _engine_config(args, stages: list[Stage]):
         node_ip=args.node_ip,
         enable_cni=args.enable_cni,
         tick_interval=args.tick_interval,
+        tick_substeps=args.tick_substeps,
         heartbeat_interval=args.heartbeat_interval,
         parallelism=args.parallelism,
         initial_capacity=args.initial_capacity,

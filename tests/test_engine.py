@@ -327,3 +327,24 @@ def test_metrics_surface(rig):
     assert "# TYPE kwok_watch_lag_seconds gauge" in text
     assert "# TYPE kwok_tick_seconds_last gauge" in text
     assert "kwok_ingest_queue_depth" in text
+
+
+def test_tick_substeps_full_lifecycle():
+    """tick_substeps > 1 (one fused multi-step dispatch per engine tick)
+    preserves the node-Ready + pod-Running lifecycle end to end."""
+    server = FakeKube()
+    eng = SyncEngine(
+        server, EngineConfig(manage_all_nodes=True, tick_substeps=4)
+    )
+    server.create("nodes", make_node("sub-node"))
+    server.create("pods", make_pod("sub-pod", node="sub-node"))
+    eng.feed_all(server)
+    eng.pump(3)
+    node = server.get("nodes", None, "sub-node")
+    conds = {c["type"]: c["status"] for c in node["status"]["conditions"]}
+    assert conds["Ready"] == "True"
+    pod = server.get("pods", "default", "sub-pod")
+    assert pod["status"]["phase"] == "Running"
+    assert pod["status"]["podIP"]
+    kern = eng._get_fused()
+    assert kern.steps == 4
