@@ -226,6 +226,10 @@ def main() -> None:
     p.add_argument("--churn", type=int, default=0,
                    help="after the hold, gracefully delete this many pods "
                    "and time the engine's strip+delete flow")
+    p.add_argument("--members", type=int, default=1,
+                   help="N apiserver processes federated onto ONE engine "
+                   "(--master a,b,..., BASELINE config 5); nodes/pods are "
+                   "split evenly across members")
     args = p.parse_args()
 
     from kwok_tpu.edge.httpclient import HttpKubeClient
@@ -255,37 +259,50 @@ def main() -> None:
         engine.start()
     else:
         # real topology: apiserver process + engine process + this loader
-        api_port = netutil.get_unused_port()
+        n_members = max(1, args.members)
         srv_port = netutil.get_unused_port()
-        url = f"http://127.0.0.1:{api_port}"
         metrics_url = f"http://127.0.0.1:{srv_port}"
         logdir = os.environ.get("KWOK_TPU_SOAK_LOGDIR", "/tmp/kwok-tpu-soak")
         os.makedirs(logdir, exist_ok=True)
-        api_log = open(os.path.join(logdir, "apiserver.log"), "wb")
         eng_log = open(os.path.join(logdir, "engine.log"), "wb")
         from kwok_tpu import native
 
         apiserver_bin = native.apiserver_binary()
-        if apiserver_bin:
-            api_cmd = [apiserver_bin, "--port", str(api_port)]
-        else:
-            api_cmd = [sys.executable, "-m", "kwok_tpu.edge.mockserver",
-                       "--port", str(api_port)]
-        procs.append(subprocess.Popen(
-            api_cmd,
-            env=_child_env(), stdout=api_log, stderr=api_log,
-        ))
-        _wait_http(url, "/healthz", timeout=60.0)
+        member_urls = []
+        for m in range(n_members):
+            api_port = netutil.get_unused_port()
+            member_urls.append(f"http://127.0.0.1:{api_port}")
+            api_log = open(os.path.join(logdir, f"apiserver-{m}.log"), "wb")
+            if apiserver_bin:
+                api_cmd = [apiserver_bin, "--port", str(api_port)]
+            else:
+                api_cmd = [sys.executable, "-m", "kwok_tpu.edge.mockserver",
+                           "--port", str(api_port)]
+            procs.append(subprocess.Popen(
+                api_cmd,
+                env=_child_env(), stdout=api_log, stderr=api_log,
+            ))
+        for u in member_urls:
+            _wait_http(u, "/healthz", timeout=60.0)
+        url = member_urls[0]
         prof = os.environ.get("KWOK_TPU_SOAK_PROFILE_ENGINE", "")
         prof_args = ["-m", "cProfile", "-o", prof] if prof else []
+        # The busiest member owns ceil(nodes/N) of the nodes and the pods
+        # bound to them — size capacity for THAT member (an undersized pool
+        # would force a federation regrow inside the timed window).
+        nodes_per_member = (args.nodes + n_members - 1) // n_members
+        pods_per_member = (
+            (args.pods * nodes_per_member + args.nodes - 1) // max(args.nodes, 1)
+        )
+        per_member_cap = max(4096, pods_per_member, nodes_per_member)
         procs.append(subprocess.Popen(
             [sys.executable, *prof_args, "-m", "kwok_tpu.kwok",
-             "--master", url,
+             "--master", ",".join(member_urls),
              "--manage-all-nodes", "true",
              "--tick-interval", str(args.tick_interval),
              "--heartbeat-interval", str(args.heartbeat_interval),
              "--parallelism", str(args.engine_parallelism),
-             "--initial-capacity", str(max(args.pods, args.nodes, 4096)),
+             "--initial-capacity", str(per_member_cap),
              "--server-address", f"127.0.0.1:{srv_port}"],
             env=_child_env(), stdout=eng_log, stderr=eng_log,
         ))
@@ -306,10 +323,56 @@ def main() -> None:
         if split.scheme == "http" and native.available():
             pump = native.Pump(split.hostname, split.port, nconn=4)
 
+    # Federated topology (--members N): per-member pumps/pollers; object i
+    # lives on member (its node's index) % N so every pod shares a member
+    # with its node (the engine's federation keeps members isolated).
+    multi = args.members > 1 and not args.apiserver and not args.in_process
+    member_pumps: list = []
+    member_pollers: list = []
+    if multi:
+        from kwok_tpu import native
+
+        if pump is None:
+            raise SystemExit(
+                "--members needs the native pump (no compiler, or "
+                "--no-native-load was passed)"
+            )
+        pump.close()  # multi mode sends through per-member pumps only
+        pump = None
+        for u in member_urls:
+            s = urllib.parse.urlsplit(u)
+            member_pumps.append(native.Pump(s.hostname, s.port, nconn=2))
+            member_pollers.append(_Poller(u))
+
+    def member_of_node(j: int) -> int:
+        return j % args.members
+
+    def pump_fanout(reqs_by_member: dict) -> int:
+        # concurrent per-member sends (Pump.send runs outside the GIL):
+        # the federated intake must not be measured serialized
+        def one(item):
+            m, reqs = item
+            st = member_pumps[m].send(reqs)
+            return int(((st >= 200) & (st < 300)).sum())
+
+        return sum(pool.map(one, reqs_by_member.items()))
+
     try:
         # --- nodes -> Ready ------------------------------------------------
         t_nodes = time.perf_counter()
-        if pump is not None:
+        if multi:
+            by_member: dict = {}
+            for i in range(args.nodes):
+                by_member.setdefault(member_of_node(i), []).append(
+                    ("POST", "/api/v1/nodes", json.dumps({
+                        "apiVersion": "v1", "kind": "Node",
+                        "metadata": {"name": f"soak-node-{i}"},
+                    }).encode())
+                )
+            ok = pump_fanout(by_member)
+            if ok < args.nodes:
+                raise SystemExit(f"node load: only {ok}/{args.nodes} created")
+        elif pump is not None:
             reqs = [
                 ("POST", "/api/v1/nodes", json.dumps({
                     "apiVersion": "v1", "kind": "Node",
@@ -332,7 +395,13 @@ def main() -> None:
         create_nodes_s = time.perf_counter() - t_nodes
         deadline = time.monotonic() + args.timeout
         poll = max(0.2, min(2.0, args.pods / 50000))
-        while poller.count_ready_nodes() < args.nodes:
+
+        def ready_nodes() -> int:
+            if multi:
+                return sum(p.count_ready_nodes() for p in member_pollers)
+            return poller.count_ready_nodes()
+
+        while ready_nodes() < args.nodes:
             if time.monotonic() > deadline:
                 raise SystemExit("timeout waiting for nodes Ready")
             time.sleep(poll)
@@ -342,7 +411,39 @@ def main() -> None:
         t_pods = time.perf_counter()
         bind = "0" if args.no_bind else "1"
         n_load = max(1, args.load_procs)
-        if pump is not None:
+        if multi:
+            creates: dict = {}
+            binds: dict = {}
+            for i in range(args.pods):
+                node_j = i % args.nodes
+                m = member_of_node(node_j)
+                creates.setdefault(m, []).append(
+                    ("POST", "/api/v1/namespaces/default/pods", json.dumps({
+                        "apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": f"soak-pod-{i}",
+                                     "namespace": "default"},
+                        "spec": {"containers": [{"name": "c",
+                                                 "image": "soak"}]},
+                        "status": {"phase": "Pending"},
+                    }).encode())
+                )
+                if bind == "1":
+                    binds.setdefault(m, []).append(
+                        ("PATCH",
+                         f"/api/v1/namespaces/default/pods/soak-pod-{i}",
+                         json.dumps({"spec": {
+                             "nodeName": f"soak-node-{node_j}",
+                         }}).encode(),
+                         "application/merge-patch+json")
+                    )
+            ok = pump_fanout(creates)
+            if ok < args.pods:
+                raise SystemExit(f"pod load: only {ok}/{args.pods} created")
+            if binds:
+                ok = pump_fanout(binds)
+                if ok < args.pods:
+                    raise SystemExit(f"bind: only {ok}/{args.pods} bound")
+        elif pump is not None:
             reqs = [
                 ("POST", "/api/v1/namespaces/default/pods", json.dumps({
                     "apiVersion": "v1", "kind": "Pod",
@@ -396,9 +497,15 @@ def main() -> None:
             "/api/v1/pods?fieldSelector="
             + urllib.parse.quote("status.phase=Running")
         )
-        while poller.count(running_path) < args.pods:
+
+        def running_pods() -> int:
+            if multi:
+                return sum(p.count(running_path) for p in member_pollers)
+            return poller.count(running_path)
+
+        while running_pods() < args.pods:
             if time.monotonic() > deadline:
-                n = poller.count(running_path)
+                n = running_pods()
                 raise SystemExit(
                     f"timeout waiting for pods Running ({n}/{args.pods})"
                 )
@@ -432,7 +539,19 @@ def main() -> None:
             n_churn = min(args.churn, args.pods)
             t0 = time.perf_counter()
             body = b'{"gracePeriodSeconds":1}'
-            if pump is not None:
+            if multi:
+                by_member: dict = {}
+                for i in range(n_churn):
+                    m = member_of_node(i % args.nodes)
+                    by_member.setdefault(m, []).append(
+                        ("DELETE",
+                         f"/api/v1/namespaces/default/pods/soak-pod-{i}",
+                         body)
+                    )
+                ok = pump_fanout(by_member)
+                if ok < n_churn:
+                    raise SystemExit(f"churn: only {ok}/{n_churn} deletes sent")
+            elif pump is not None:
                 st = pump.send([
                     ("DELETE", f"/api/v1/namespaces/default/pods/soak-pod-{i}",
                      body)
@@ -450,12 +569,19 @@ def main() -> None:
                 ))
             issue_s = time.perf_counter() - t0
             remaining = args.pods - n_churn
-            while poller.count("/api/v1/pods") > remaining:
+
+            def pods_left() -> int:
+                if multi:
+                    return sum(
+                        p.count("/api/v1/pods") for p in member_pollers
+                    )
+                return poller.count("/api/v1/pods")
+
+            while pods_left() > remaining:
                 if time.monotonic() > deadline:
-                    n = poller.count("/api/v1/pods")
                     raise SystemExit(
-                        f"timeout waiting for churn deletes ({n} pods left, "
-                        f"want {remaining})"
+                        f"timeout waiting for churn deletes ({pods_left()} "
+                        f"pods left, want {remaining})"
                     )
                 time.sleep(poll)
             churn_s = time.perf_counter() - t0
@@ -466,10 +592,11 @@ def main() -> None:
                 "churn_issue_s": round(issue_s, 2),
             }
 
+        fed = f", federated over {args.members} apiservers" if multi else ""
         out = {
             "metric": (
                 f"e2e soak: {args.pods} pods x {args.nodes} nodes over HTTP "
-                "(create+bind -> Running)"
+                f"(create+bind -> Running{fed})"
             ),
             "pods_per_s": round(args.pods / pods_s, 1),
             "pods_elapsed_s": round(pods_s, 2),
@@ -516,6 +643,8 @@ def main() -> None:
     finally:
         if pump is not None:
             pump.close()
+        for mp in member_pumps:
+            mp.close()
         for proc in procs:
             proc.terminate()
         for proc in procs:
