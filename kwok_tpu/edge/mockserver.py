@@ -191,7 +191,14 @@ class FakeKube:
                 keys = [k for k in keys if k > last]
             chunks: list[bytes] = []
             token = ""
+            remaining = 0
+            # only the FIRST page scans past the cut (remainingItemCount
+            # for limit=1 count pollers) — counting on every continuation
+            # page would make a full paginated re-list quadratic
+            count_rest = not continue_
             for pos, key in enumerate(keys):
+                if limit and len(chunks) >= limit and not count_rest:
+                    break
                 obj = self._store[kind][key]
                 if not match_field_selector(obj, field_selector):
                     continue
@@ -199,15 +206,18 @@ class FakeKube:
                     labels = (obj.get("metadata") or {}).get("labels") or {}
                     if not sel.matches(labels):
                         continue
-                chunks.append(self._obj_bytes(kind, key))
                 if limit and len(chunks) >= limit:
-                    if pos + 1 < len(keys):
-                        token = f"{key[0]}\x00{key[1]}"
-                    break
+                    remaining += 1
+                    continue
+                chunks.append(self._obj_bytes(kind, key))
+                if limit and len(chunks) >= limit and pos + 1 < len(keys):
+                    token = f"{key[0]}\x00{key[1]}"
             rv = str(self._rv)
         meta = f'{{"resourceVersion":"{rv}"'.encode()
-        if token:
+        if token and (remaining if count_rest else True):
             meta += b',"continue":' + json.dumps(token).encode()
+        if limit and count_rest and remaining:
+            meta += b',"remainingItemCount":' + str(remaining).encode()
         meta += b"}"
         return (
             b'{"kind":"List","apiVersion":"v1","metadata":' + meta

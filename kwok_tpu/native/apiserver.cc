@@ -647,14 +647,24 @@ static std::string now_rfc3339() {
 
 using Key = std::pair<std::string, std::string>;  // (namespace-or-"", name)
 
+// Copy-on-write store entry: immutable once published, serialized at
+// publish time. Readers (LIST/GET/snapshot at 1M objects) snapshot the
+// shared_ptrs under the store mutex and do ALL matching/serialization
+// outside it — a full-population LIST must never starve writers (measured:
+// serializing 1M pods under the lock stalled every patch for seconds and
+// timed out the engine's pump).
 struct Entry {
   JVal obj;
-  std::string bytes;  // serialized cache; empty => stale
-  const std::string& ser() {
-    if (bytes.empty()) bytes = dumps(obj);
-    return bytes;
-  }
+  std::string bytes;
 };
+using EntryPtr = std::shared_ptr<const Entry>;
+
+static EntryPtr publish(JVal obj) {
+  auto e = std::make_shared<Entry>();
+  e->obj = std::move(obj);
+  e->bytes = dumps(e->obj);
+  return e;
+}
 
 struct Watch {
   int kind;  // 0 nodes, 1 pods
@@ -665,11 +675,16 @@ struct Watch {
   std::deque<std::shared_ptr<const std::string>> q;
   bool closed = false;
 
+  // A consumer that stops reading must not pin unbounded memory: past the
+  // cap the watch closes and the client re-lists (410-Gone semantics).
+  static constexpr size_t MAX_BACKLOG = 2'000'000;
+
   void push(std::shared_ptr<const std::string> ev) {
     {
       std::lock_guard<std::mutex> lk(mu);
       if (closed) return;
-      q.push_back(std::move(ev));
+      if (q.size() >= MAX_BACKLOG) closed = true;  // client must re-list
+      else q.push_back(std::move(ev));
     }
     cv.notify_one();
   }
@@ -691,7 +706,7 @@ static const char* KIND_NAMES[2] = {"nodes", "pods"};
 
 struct Store {
   std::mutex mu;
-  std::map<Key, Entry> kinds[2];
+  std::map<Key, EntryPtr> kinds[2];
   int64_t rv = 0;
   std::vector<std::shared_ptr<Watch>> watches;
 
@@ -702,8 +717,10 @@ struct Store {
         .set("resourceVersion", JVal::str(std::to_string(rv)));
   }
 
-  // caller holds mu; serializes the event once, fans out to matching watches
-  void emit(int kind, const char* type, const JVal& obj) {
+  // caller holds mu; serializes the event once (reusing the entry's
+  // published bytes when available), fans out to matching watches
+  void emit(int kind, const char* type, const JVal& obj,
+            const std::string* pre_bytes = nullptr) {
     bool any = false;
     for (const auto& w : watches)
       if (w->kind == kind) {
@@ -720,7 +737,8 @@ struct Store {
         std::string ev = "{\"type\":\"";
         ev += type;
         ev += "\",\"object\":";
-        serialize(obj, ev);
+        if (pre_bytes) ev += *pre_bytes;
+        else serialize(obj, ev);
         ev += "}\n";
         line = std::make_shared<const std::string>(std::move(ev));
       }
@@ -984,9 +1002,18 @@ void App::audit_line(const std::string& method, const std::string& uri,
 }
 
 std::string App::snapshot_dump() {
-  std::lock_guard<std::mutex> lk(store.mu);
+  std::vector<EntryPtr> snap[2];
+  int64_t rv;
+  {
+    std::lock_guard<std::mutex> lk(store.mu);
+    rv = store.rv;
+    for (int k = 0; k < 2; k++) {
+      snap[k].reserve(store.kinds[k].size());
+      for (auto& kv : store.kinds[k]) snap[k].push_back(kv.second);
+    }
+  }
   std::string out = "{\"resourceVersion\":";
-  out += std::to_string(store.rv);
+  out += std::to_string(rv);
   out += ",\"objects\":{";
   for (int k = 0; k < 2; k++) {
     if (k) out += ',';
@@ -994,10 +1021,10 @@ std::string App::snapshot_dump() {
     out += KIND_NAMES[k];
     out += "\":[";
     bool first = true;
-    for (auto& kv : store.kinds[k]) {
+    for (auto& e : snap[k]) {
       if (!first) out += ',';
       first = false;
-      out += kv.second.ser();
+      out += e->bytes;
     }
     out += ']';
   }
@@ -1018,7 +1045,7 @@ void App::restore_load(const JVal& data) {
         for (const JVal& obj : list->arr) {
           Key key = Store::obj_key(obj);
           if (key.second.empty()) continue;
-          store.kinds[k][key] = Entry{obj, ""};
+          store.kinds[k][key] = publish(obj);
         }
       }
     }
@@ -1072,21 +1099,16 @@ bool App::handle_request(int fd, Request& req) {
 
   if (req.method == "GET") {
     if (!m.name.empty()) {
-      // build the body under the lock, send outside it: a stalled reader
-      // must never wedge the store (send_all can block on TCP backpressure)
-      std::string body;
-      int code = 200;
+      // grab the entry ref under the lock, send outside it: a stalled
+      // reader must never wedge the store
+      EntryPtr e;
       {
         std::lock_guard<std::mutex> lk(store.mu);
         auto it = store.kinds[m.kind].find(key);
-        if (it == store.kinds[m.kind].end()) {
-          code = 404;
-          body = "{\"kind\":\"Status\",\"code\":404}";
-        } else {
-          body = it->second.ser();
-        }
+        if (it != store.kinds[m.kind].end()) e = it->second;
       }
-      return respond(code, body);
+      if (!e) return respond(404, "{\"kind\":\"Status\",\"code\":404}");
+      return respond(200, e->bytes);
     }
     std::string fs = q.count("fieldSelector") ? q["fieldSelector"] : "";
     std::string lsq = q.count("labelSelector") ? q["labelSelector"] : "";
@@ -1133,11 +1155,13 @@ bool App::handle_request(int fd, Request& req) {
       return false;  // watch connections never go back to unary
     }
     // ---- list (with the kube-apiserver limit/continue chunking protocol)
+    // Snapshot (key, entry) refs under the lock; match + serialize OUTSIDE
+    // it. Writers only ever stall for the pointer copy, not for a
+    // potentially-hundreds-of-MB response build.
     LabelSel ls = LabelSel::parse(lsq);
     long limit = q.count("limit") ? atol(q["limit"].c_str()) : 0;
     std::string cont = q.count("continue") ? q["continue"] : "";
-    std::string items;
-    std::string token;
+    std::vector<EntryPtr> snap;
     int64_t rv_now;
     {
       std::lock_guard<std::mutex> lk(store.mu);
@@ -1149,36 +1173,66 @@ bool App::handle_request(int fd, Request& req) {
                  nul == std::string::npos ? "" : cont.substr(nul + 1)};
         it = kindmap.upper_bound(last);
       }
-      long count = 0;
-      bool first = true;
-      for (; it != kindmap.end(); ++it) {
-        if (!match_field_selector(it->second.obj, fs)) continue;
-        if (!ls.matches(it->second.obj)) continue;
-        if (!first) items += ',';
-        first = false;
-        items += it->second.ser();
-        count++;
-        if (limit && count >= limit) {
-          auto next = std::next(it);
-          if (next != kindmap.end()) {
-            token = it->first.first;
-            token += '\0';
-            token += it->first.second;
-          }
-          break;
-        }
-      }
+      snap.reserve(kindmap.size());
+      for (; it != kindmap.end(); ++it) snap.push_back(it->second);
       rv_now = store.rv;
+    }
+    // The continue token is rebuilt from the entry's own (immutable)
+    // metadata — map keys may be erased concurrently once the lock drops.
+    auto key_of = [](const JVal& obj, std::string& out) {
+      const JVal* meta = obj.find("metadata");
+      const JVal* ns = meta ? meta->find("namespace") : nullptr;
+      const JVal* name = meta ? meta->find("name") : nullptr;
+      out.clear();
+      if (ns && ns->type == JVal::STR) out += ns->s;
+      out += '\0';
+      if (name && name->type == JVal::STR) out += name->s;
+    };
+    // Continuation pages break at the cut (counting the remainder on every
+    // page would make a full re-list quadratic); only the FIRST page scans
+    // on for ListMeta.remainingItemCount, which is what limit=1 count
+    // pollers read.
+    bool count_rest = cont.empty();
+    std::string items;
+    std::string token;
+    long count = 0;
+    long remaining = 0;
+    bool first = true;
+    for (size_t i = 0; i < snap.size(); i++) {
+      const JVal& obj = snap[i]->obj;
+      if (limit && count >= limit) {
+        if (!count_rest) break;  // continuation pages stop at the cut
+        if (!match_field_selector(obj, fs)) continue;
+        if (!ls.matches(obj)) continue;
+        remaining++;
+        continue;
+      }
+      if (!match_field_selector(obj, fs)) continue;
+      if (!ls.matches(obj)) continue;
+      if (!first) items += ',';
+      first = false;
+      items += snap[i]->bytes;
+      count++;
+      if (limit && count >= limit && i + 1 < snap.size())
+        key_of(obj, token);
     }
     std::string body =
         "{\"kind\":\"List\",\"apiVersion\":\"v1\",\"metadata\":{"
         "\"resourceVersion\":\"";
     body += std::to_string(rv_now);
     body += '"';
-    if (!token.empty()) {
+    // first pages gate the token on a known matching remainder; later
+    // pages emit it whenever entries remain (an empty final page is fine)
+    if (!token.empty() && (count_rest ? remaining > 0 : true)) {
       body += ",\"continue\":\"";
       json_escape(body, token);
       body += '"';
+    }
+    if (limit && count_rest && remaining > 0) {
+      // ListMeta.remainingItemCount: lets pollers count a population with
+      // limit=1 instead of transferring the whole serialized list
+      body += ",\"remainingItemCount\":";
+      body += std::to_string(remaining);
     }
     body += "},\"items\":[";
     body += items;
@@ -1196,7 +1250,7 @@ bool App::handle_request(int fd, Request& req) {
     Key k = Store::obj_key(obj);
     if (k.second.empty())
       return respond(400, "{\"kind\":\"Status\",\"code\":400}");
-    std::string body;
+    EntryPtr e;
     {
       std::lock_guard<std::mutex> lk(store.mu);
       if (!meta.find("creationTimestamp"))
@@ -1204,13 +1258,11 @@ bool App::handle_request(int fd, Request& req) {
       if (!meta.find("uid"))
         meta.set("uid", JVal::str("uid-" + std::to_string(store.rv + 1)));
       store.bump(obj);
-      Entry& e = store.kinds[m.kind][k];
-      e.obj = std::move(obj);
-      e.bytes.clear();
-      store.emit(m.kind, "ADDED", e.obj);
-      body = e.ser();
+      e = publish(std::move(obj));
+      store.kinds[m.kind][k] = e;
+      store.emit(m.kind, "ADDED", e->obj, &e->bytes);
     }
-    return respond(201, body);
+    return respond(201, e->bytes);
   }
 
   if (req.method == "PATCH") {
@@ -1226,7 +1278,7 @@ bool App::handle_request(int fd, Request& req) {
         code = 404;
         body = "{\"kind\":\"Status\",\"code\":404}";
       } else {
-        Entry& e = it->second;
+        JVal obj = it->second->obj;  // copy-on-write
         if (m.status) {
           // strategic-merge on the status subresource; accept either a
           // {"status": {...}} wrapper or a bare status document
@@ -1234,9 +1286,9 @@ bool App::handle_request(int fd, Request& req) {
           const JVal& spv = sp ? *sp : patch;
           JVal cur_status;
           cur_status.type = JVal::OBJ;
-          if (const JVal* cs = e.obj.find("status"))
+          if (const JVal* cs = obj.find("status"))
             if (cs->type == JVal::OBJ) cur_status = *cs;
-          e.obj.set("status", merge_value(cur_status, spv, ""));
+          obj.set("status", merge_value(cur_status, spv, ""));
         } else {
           // merge-patch on metadata + spec with null deletion; top-level
           // key replace within each section (mockserver.patch_meta)
@@ -1246,17 +1298,18 @@ bool App::handle_request(int fd, Request& req) {
             if (!sec_patch || sec_patch->type != JVal::OBJ ||
                 sec_patch->obj.empty())
               continue;
-            JVal& sec = e.obj.get_or_insert_obj(section);
+            JVal& sec = obj.get_or_insert_obj(section);
             for (const auto& kv : sec_patch->obj) {
               if (kv.second.type == JVal::NUL) sec.erase(kv.first);
               else sec.set(kv.first, kv.second);
             }
           }
         }
-        store.bump(e.obj);
-        e.bytes.clear();
-        store.emit(m.kind, "MODIFIED", e.obj);
-        body = e.ser();
+        store.bump(obj);
+        EntryPtr e = publish(std::move(obj));
+        it->second = e;
+        store.emit(m.kind, "MODIFIED", e->obj, &e->bytes);
+        body = e->bytes;
       }
     }
     return respond(code, body);
@@ -1274,8 +1327,8 @@ bool App::handle_request(int fd, Request& req) {
       std::lock_guard<std::mutex> lk(store.mu);
       auto it = store.kinds[m.kind].find(key);
       if (it != store.kinds[m.kind].end()) {
-        Entry& e = it->second;
-        JVal& meta = e.obj.get_or_insert_obj("metadata");
+        JVal obj = it->second->obj;  // copy-on-write
+        JVal& meta = obj.get_or_insert_obj("metadata");
         const JVal* fins = meta.find("finalizers");
         bool has_fins =
             fins && fins->type == JVal::ARR && !fins->arr.empty();
@@ -1285,11 +1338,11 @@ bool App::handle_request(int fd, Request& req) {
             meta.set("deletionTimestamp", JVal::str(now_rfc3339()));
           meta.set("deletionGracePeriodSeconds",
                    JVal::num_raw(std::to_string(grace)));
-          store.bump(e.obj);
-          e.bytes.clear();
-          store.emit(m.kind, "MODIFIED", e.obj);
+          store.bump(obj);
+          EntryPtr e = publish(std::move(obj));
+          it->second = e;
+          store.emit(m.kind, "MODIFIED", e->obj, &e->bytes);
         } else {
-          JVal obj = std::move(e.obj);
           store.kinds[m.kind].erase(it);
           store.bump(obj);
           store.emit(m.kind, "DELETED", obj);

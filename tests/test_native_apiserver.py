@@ -336,3 +336,25 @@ def test_engine_end_to_end_against_native_server(srv, tmp_path):
     pod = client.get("pods", "default", "e2e-pod")
     assert pod["status"]["phase"] == "Running"
     assert pod["status"]["podIP"]
+
+
+def test_remaining_item_count(client):
+    """ListMeta.remainingItemCount on first pages (population counting with
+    limit=1); continuation pages stop at the cut and keep paginating."""
+    for i in range(9):
+        client.create("nodes", make_node(f"ric-{i}"))
+    raw = client._json("GET", client.server + "/api/v1/nodes?limit=1")
+    assert raw["metadata"]["remainingItemCount"] == 8
+    assert len(raw["items"]) == 1
+    # full pagination still yields everything
+    names, token = [], None
+    while True:
+        url = client.server + "/api/v1/nodes?limit=4"
+        if token:
+            url += "&continue=" + urllib.parse.quote(token)
+        raw = client._json("GET", url)
+        names += [n["metadata"]["name"] for n in raw["items"]]
+        token = (raw.get("metadata") or {}).get("continue")
+        if not token:
+            break
+    assert names == sorted(f"ric-{i}" for i in range(9))
