@@ -100,7 +100,7 @@ def _run(kern, pstate, nstate, n_pods, n_nodes, ticks) -> float:
         now += DT
     total = 0
     for wire in wires:
-        counters, masks_fn = unpack_wire(np.asarray(wire), [n_pods, n_nodes])
+        counters, masks_fn, _ = unpack_wire(np.asarray(wire), [n_pods, n_nodes])
         total += int(counters[0]) + int(counters[1])
         masks_fn()
     return total / (time.perf_counter() - t0)
@@ -230,7 +230,7 @@ def main() -> None:
         # egress consumes), then stop the clock
         total = 0
         for wire in wires:
-            counters, masks_fn = unpack_wire(
+            counters, masks_fn, _ = unpack_wire(
                 np.asarray(wire), [N_PODS, N_NODES]
             )
             total += int(counters[0]) + int(counters[1])

@@ -412,9 +412,9 @@ def main() -> None:
             ))
         create_nodes_s = time.perf_counter() - t_nodes
         deadline = time.monotonic() + args.timeout
-        # progress polls LIST the whole population server-side; at 1M pods
-        # a poll builds ~600MB of JSON, so back off with scale
-        poll = max(0.2, min(10.0, args.pods / 50000))
+        # pod-progress polls count via limit=1 + remainingItemCount (O(1)
+        # payload); only the node-Ready poll still parses a full list
+        poll = max(0.2, min(2.0, args.pods / 50000))
 
         def ready_nodes() -> int:
             if multi:

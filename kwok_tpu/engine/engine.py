@@ -247,6 +247,9 @@ class ClusterEngine:
         self._pump = None
         self._pump_tried = False
         self._pump_lock = threading.Lock()
+        # monotonic wake-up for the idle tick loop; 0 = tick immediately,
+        # None = nothing scheduled on device (sleep until an event arrives)
+        self._idle_wake: float | None = 0.0
         self._hb_cond_meta = [
             (name, *_NODE_CONDITION_META.get(name, ("KwokRule", name)))
             for name in NODE_PHASES.conditions
@@ -895,11 +898,30 @@ class ClusterEngine:
 
     # ------------------------------------------------------------- tick loop
 
+    # Idle backstop: with no staged writes and no device timer pending, the
+    # loop still wakes this often (one cheap dispatch) as a safety net.
+    _IDLE_MAX = 60.0
+
     def _tick_loop(self) -> None:
         interval = self.config.tick_interval
         while self._running:
             deadline = time.monotonic() + interval
+            # Nothing staged and no timer due before the next tick? Sleep
+            # until the device-reported deadline (ops/tick.next_due): an
+            # idle engine — even at 1M rows — dispatches nothing. Incoming
+            # watch events wake the queue and pull the deadline back in.
+            if (
+                self._q.empty()
+                and not self.nodes.buffer.pending
+                and not self.pods.buffer.pending
+            ):
+                wake = self._idle_wake
+                if wake is None:
+                    deadline = time.monotonic() + self._IDLE_MAX
+                elif wake > deadline:
+                    deadline = min(wake, time.monotonic() + self._IDLE_MAX)
             lag_max = 0.0
+            got_event = False
             # drain ingest until the next tick is due
             while True:
                 timeout = deadline - time.monotonic()
@@ -913,6 +935,11 @@ class ClusterEngine:
                     if not self._running:
                         return
                     continue
+                if not got_event:
+                    got_event = True
+                    # an event arriving during an idle sleep must be ticked
+                    # within one normal interval
+                    deadline = min(deadline, time.monotonic() + interval)
                 lag_max = max(lag_max, time.monotonic() - item[3])
                 self._ingest_safe(*item[:3])
                 # keep draining whatever is immediately available
@@ -997,8 +1024,13 @@ class ClusterEngine:
             # the whole tick summary (counters + bit-packed masks) in ONE
             # D2H transfer (latency is per-array on remote devices; bytes
             # are 1/8 of bool masks)
-            counters, masks_fn = unpack_wire(
+            counters, masks_fn, dues = unpack_wire(
                 np.asarray(wire), [self.nodes.capacity, self.pods.capacity]
+            )
+            nd = float(dues.min())
+            self._idle_wake = (
+                None if nd == float("inf")
+                else time.monotonic() + max(0.0, nd - now)
             )
             masks = masks_fn() if counters.any() else None
             t_kernel = time.perf_counter()
@@ -1018,6 +1050,8 @@ class ClusterEngine:
                     k.cond_h = np.array(out.state.cond_bits)
                     self._emit(kind, k, dirty, deleted, hb, now_str)
             emit_s = time.perf_counter() - t_kernel
+        else:
+            self._idle_wake = None  # empty engine: sleep until events
         elapsed = time.perf_counter() - t0
         with self._metrics_lock:
             self.metrics["nodes_managed"] = len(self.nodes.pool)

@@ -113,6 +113,9 @@ class FederatedEngine:
         self.config = config
         self._running = False
         self._thread: threading.Thread | None = None
+        # monotonic wake-up for the idle tick loop (see ClusterEngine):
+        # 0 = tick immediately, None = nothing scheduled on device
+        self._idle_wake: float | None = 0.0
 
     # ------------------------------------------------------------- lifecycle
 
@@ -136,10 +139,24 @@ class FederatedEngine:
 
     # ------------------------------------------------------------- tick loop
 
+    _IDLE_MAX = 60.0
+
     def _tick_loop(self) -> None:
         interval = self.config.tick_interval
         while self._running:
             deadline = time.monotonic() + interval
+            if all(e._q.empty() for e in self.engines) and not any(
+                k.buffer.pending
+                for e in self.engines
+                for k in (e.nodes, e.pods)
+            ):
+                # idle: sleep toward the device-reported next deadline
+                # (ops/tick.next_due); arriving events shorten the drain
+                wake = self._idle_wake
+                if wake is None:
+                    deadline = time.monotonic() + self._IDLE_MAX
+                elif wake > deadline:
+                    deadline = min(wake, time.monotonic() + self._IDLE_MAX)
             self._drain_ingest(deadline)
             try:
                 self.tick_once()
@@ -147,8 +164,14 @@ class FederatedEngine:
                 logger.exception("federated tick failed")
 
     def _drain_ingest(self, deadline: float) -> None:
-        """Round-robin the members' ingest queues until the tick is due."""
+        """Round-robin the members' ingest queues until the tick is due.
+        An arriving event during an extended idle sleep pulls the deadline
+        back to one normal interval; consecutive empty polls back off
+        exponentially so idling costs ~no wakeups."""
         lag: dict[int, float] = {}
+        interval = self.config.tick_interval
+        idle_sleep = 0.002
+        got_event = False
         try:
             while self._running:
                 remaining = deadline - time.monotonic()
@@ -168,8 +191,16 @@ class FederatedEngine:
                             lag.get(i, 0.0), time.monotonic() - item[3]
                         )
                         e._ingest_safe(*item[:3])
-                if not drained_any:
-                    time.sleep(min(remaining, 0.002))
+                if drained_any:
+                    idle_sleep = 0.002
+                    if not got_event:
+                        got_event = True
+                        deadline = min(
+                            deadline, time.monotonic() + interval
+                        )
+                else:
+                    time.sleep(min(remaining, idle_sleep))
+                    idle_sleep = min(idle_sleep * 2, 0.1)
         finally:
             # slowest enqueue->processing delay this tick; 0 on a quiet tick
             for i, e in enumerate(self.engines):
@@ -214,7 +245,12 @@ class FederatedEngine:
             self._stacked["nodes"] = nout.state
             self._stacked["pods"] = pout.state
             cap = r * len(self.engines)
-            counters, masks_fn = unpack_wire(np.asarray(wire), [cap, cap])
+            counters, masks_fn, dues = unpack_wire(np.asarray(wire), [cap, cap])
+            nd = float(dues.min())
+            self._idle_wake = (
+                None if nd == float("inf")
+                else time.monotonic() + max(0.0, nd - now)
+            )
             masks = masks_fn() if counters.any() else None
             for i, (kind, out) in enumerate((("nodes", nout), ("pods", pout))):
                 if not (int(counters[i]) or int(counters[2 + i])):
@@ -235,6 +271,8 @@ class FederatedEngine:
                         k.phase_h = phase[lo:hi].copy()
                         k.cond_h = cond[lo:hi].copy()
                         e._emit(kind, k, d_c, del_c, hb_c, now_str)
+        else:
+            self._idle_wake = None  # empty federation: sleep until events
         elapsed = time.perf_counter() - t0
         for e in self.engines:
             with e._metrics_lock:

@@ -683,8 +683,14 @@ struct Watch {
     {
       std::lock_guard<std::mutex> lk(mu);
       if (closed) return;
-      if (q.size() >= MAX_BACKLOG) closed = true;  // client must re-list
-      else q.push_back(std::move(ev));
+      if (q.size() >= MAX_BACKLOG) {
+        // client must re-list; drop the backlog NOW — draining it into a
+        // stalled socket would pin the very memory this cap bounds
+        closed = true;
+        q.clear();
+      } else {
+        q.push_back(std::move(ev));
+      }
     }
     cv.notify_one();
   }
@@ -1161,7 +1167,17 @@ bool App::handle_request(int fd, Request& req) {
     LabelSel ls = LabelSel::parse(lsq);
     long limit = q.count("limit") ? atol(q["limit"].c_str()) : 0;
     std::string cont = q.count("continue") ? q["continue"] : "";
+    // Continuation pages snapshot a BOUNDED slice (each page must be O(page)
+    // lock work, or a full paginated re-list at 1M objects goes quadratic in
+    // pointer copies); a short page with a continue token is protocol-legal,
+    // so heavy selector filtering just yields more, cheaper pages. First
+    // pages (which report remainingItemCount) snapshot everything.
+    bool count_rest = cont.empty();
+    size_t snap_cap = count_rest
+                          ? (size_t)-1
+                          : (size_t)std::max(limit * 4L, 4096L);
     std::vector<EntryPtr> snap;
+    bool more_after = false;
     int64_t rv_now;
     {
       std::lock_guard<std::mutex> lk(store.mu);
@@ -1173,8 +1189,14 @@ bool App::handle_request(int fd, Request& req) {
                  nul == std::string::npos ? "" : cont.substr(nul + 1)};
         it = kindmap.upper_bound(last);
       }
-      snap.reserve(kindmap.size());
-      for (; it != kindmap.end(); ++it) snap.push_back(it->second);
+      snap.reserve(std::min(kindmap.size(), snap_cap));
+      for (; it != kindmap.end(); ++it) {
+        if (snap.size() >= snap_cap) {
+          more_after = true;
+          break;
+        }
+        snap.push_back(it->second);
+      }
       rv_now = store.rv;
     }
     // The continue token is rebuilt from the entry's own (immutable)
@@ -1192,7 +1214,6 @@ bool App::handle_request(int fd, Request& req) {
     // page would make a full re-list quadratic); only the FIRST page scans
     // on for ListMeta.remainingItemCount, which is what limit=1 count
     // pollers read.
-    bool count_rest = cont.empty();
     std::string items;
     std::string token;
     long count = 0;
@@ -1213,9 +1234,13 @@ bool App::handle_request(int fd, Request& req) {
       first = false;
       items += snap[i]->bytes;
       count++;
-      if (limit && count >= limit && i + 1 < snap.size())
+      if (limit && count >= limit && (i + 1 < snap.size() || more_after))
         key_of(obj, token);
     }
+    if (limit && !count_rest && token.empty() && more_after && !snap.empty())
+      // truncated snapshot, page not filled: continue from the last entry
+      // we actually examined (a short page; the client keeps paginating)
+      key_of(snap.back()->obj, token);
     std::string body =
         "{\"kind\":\"List\",\"apiVersion\":\"v1\",\"metadata\":{"
         "\"resourceVersion\":\"";

@@ -198,6 +198,19 @@ def tick_body(
     )
 
 
+def next_due(state: RowState) -> jnp.ndarray:
+    """Engine-time of the earliest pending timer (rule fire or heartbeat)
+    across all active rows; +inf when nothing is scheduled. Lets the host
+    tick loop SLEEP instead of dispatching no-op ticks — an idle engine
+    (even at 1M rows) costs zero device work until the next deadline."""
+    armed = state.active & (state.pending_rule >= 0)
+    fire = jnp.where(armed, state.fire_at, INF)
+    return jnp.minimum(
+        fire.min(initial=jnp.inf),
+        jnp.where(state.active, state.hb_due, INF).min(initial=jnp.inf),
+    )
+
+
 class TickKernel:
     """Compiled tick for one resource kind on one device (or data-sharded).
 
@@ -380,13 +393,19 @@ class MultiTickKernel:
                 counter_bytes = jax.lax.bitcast_convert_type(
                     counters, jnp.uint8
                 ).reshape(-1)
+                dues = jnp.stack([next_due(o.state) for o in outs])
+                due_bytes = jax.lax.bitcast_convert_type(
+                    dues.astype(jnp.float32), jnp.uint8
+                ).reshape(-1)
                 bits = [
                     jnp.packbits(
                         jnp.stack([o.dirty, o.deleted, o.hb_fired]).reshape(-1)
                     )
                     for o in outs
                 ]
-                return outs, jnp.concatenate([counter_bytes] + bits)
+                return outs, jnp.concatenate(
+                    [counter_bytes, due_bytes] + bits
+                )
 
         self._tick = jax.jit(_step, donate_argnums=(0,))
         self._key = jax.random.PRNGKey(0)
@@ -411,16 +430,19 @@ class MultiTickKernel:
 def unpack_wire(blob: np.ndarray, capacities: list[int], lazy: bool = True):
     """Invert the pack=True wire blob.
 
-    Returns (counters, masks_fn): counters is int32[2K] (transitions per
-    kind then heartbeats per kind); masks_fn() materializes, per kind,
-    (dirty, deleted, hb_fired) boolean arrays — deferred so quiet ticks
-    never pay the unpack."""
+    Returns (counters, masks_fn, next_dues): counters is int32[2K]
+    (transitions per kind then heartbeats per kind); next_dues is f32[K]
+    (earliest pending timer per kind, +inf = nothing scheduled — the tick
+    loop sleeps until then); masks_fn() materializes, per kind, (dirty,
+    deleted, hb_fired) boolean arrays — deferred so quiet ticks never pay
+    the unpack."""
     n = len(capacities)
     counters = blob[: 8 * n].view(np.int32)
+    next_dues = blob[8 * n : 12 * n].view(np.float32)
 
     def masks_fn():
         out = []
-        off = 8 * n
+        off = 12 * n
         for cap in capacities:
             seg_bytes = (3 * cap + 7) // 8
             seg = np.unpackbits(blob[off : off + seg_bytes], count=3 * cap)
@@ -429,7 +451,7 @@ def unpack_wire(blob: np.ndarray, capacities: list[int], lazy: bool = True):
             off += seg_bytes
         return out
 
-    return counters, (masks_fn if lazy else masks_fn())
+    return counters, (masks_fn if lazy else masks_fn()), next_dues
 
 
 def prefetch(tree) -> None:

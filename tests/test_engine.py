@@ -348,3 +348,37 @@ def test_tick_substeps_full_lifecycle():
     assert pod["status"]["podIP"]
     kern = eng._get_fused()
     assert kern.steps == 4
+
+
+def test_idle_engine_stops_ticking():
+    """With no pending timers the tick loop sleeps on the device-reported
+    deadline (ops/tick.next_due) instead of dispatching no-op ticks — the
+    reference's 'low resource footprint' claim, kept at tensor scale."""
+    import time as _time
+
+    from kwok_tpu.engine import ClusterEngine
+
+    server = FakeKube()
+    eng = ClusterEngine(
+        server, EngineConfig(manage_all_nodes=True, tick_interval=0.02)
+    )
+    eng.start()
+    try:
+        server.create("nodes", make_node("idle-n"))
+        server.create("pods", make_pod("idle-p", node="idle-n"))
+        deadline = _time.time() + 20
+        while _time.time() < deadline:
+            pod = server.get("pods", "default", "idle-p")
+            if pod and (pod.get("status") or {}).get("phase") == "Running":
+                break
+            _time.sleep(0.05)
+        assert server.get("pods", "default", "idle-p")["status"]["phase"] == "Running"
+        _time.sleep(0.5)  # let in-flight echoes settle
+        t0 = eng.metrics["ticks_total"]
+        _time.sleep(1.5)
+        grew = eng.metrics["ticks_total"] - t0
+        # old behavior: ~75 ticks at 20ms cadence; idle sleep: ~0 (the only
+        # scheduled timer is the node heartbeat 30s out)
+        assert grew <= 3, f"engine ticked {grew} times while idle"
+    finally:
+        eng.stop()

@@ -54,7 +54,7 @@ def test_packed_wire_roundtrip():
         [(ntab, 30.0, (), 1), (ptab, 30.0, (), -1)], pack=True
     )
     (nout, pout), wire = packed((nodes, pods), 0.0)
-    counters, masks_fn = unpack_wire(np.asarray(wire), [64, 200])
+    counters, masks_fn, dues = unpack_wire(np.asarray(wire), [64, 200])
     masks = masks_fn()
 
     assert int(counters[0]) == int(nout.transitions)
