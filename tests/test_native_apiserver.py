@@ -358,3 +358,30 @@ def test_remaining_item_count(client):
         if not token:
             break
     assert names == sorted(f"ric-{i}" for i in range(9))
+
+
+def test_pump_survives_server_restart(tmp_path):
+    """Pump reports status 0 for requests lost to a dead server and
+    re-dials on the next call — the engine's retry contract."""
+    data = tmp_path / "state.json"
+    s = NativeServer(["--data-file", str(data)])
+    port = int(s.url.rsplit(":", 1)[1])
+    pump = native.Pump("127.0.0.1", port, nconn=2)
+    st = pump.send([
+        ("POST", "/api/v1/nodes", json.dumps(
+            {"apiVersion": "v1", "kind": "Node",
+             "metadata": {"name": f"pr-{i}"}}).encode())
+        for i in range(10)
+    ])
+    assert (st == 201).all()
+    s.stop()
+    st = pump.send([("GET", "/healthz", b"")])
+    assert int(st[0]) == 0, "dead server must report status 0, not hang"
+    # restart on the SAME port (persisted store)
+    s2 = NativeServer(["--data-file", str(data), "--port", str(port)])
+    try:
+        st = pump.send([("GET", "/api/v1/nodes/pr-3", b"")])
+        assert int(st[0]) == 200, "pump must re-dial after reconnect"
+    finally:
+        pump.close()
+        s2.stop()
