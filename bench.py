@@ -253,8 +253,38 @@ def main() -> None:
     )
 
 
+def _device_reachable(timeout_s: float = 120.0) -> bool:
+    """Probe jax.devices() in a subprocess: the tunneled TPU plugin can hang
+    indefinitely when the relay is down, and a benchmark that never prints
+    its JSON line is worse than an honestly-labeled CPU number."""
+    import os
+    import subprocess
+    import sys
+
+    # the axon plugin is activated by PALLAS_AXON_POOL_IPS (sitecustomize
+    # calls jax.config.update, which outranks JAX_PLATFORMS — see
+    # kwok_tpu/hostcpu.py), so the probe is only skippable when the pool
+    # var is absent too
+    if (
+        os.environ.get("JAX_PLATFORMS", "") in ("", "cpu")
+        and not os.environ.get("PALLAS_AXON_POOL_IPS")
+    ):
+        return True
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s, capture_output=True,
+        )
+        return proc.returncode == 0 and b"ok" in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 if __name__ == "__main__":
     import argparse
+    import os
+    import sys
 
     _p = argparse.ArgumentParser()
     _p.add_argument("--mesh", type=int, default=0,
@@ -266,7 +296,24 @@ if __name__ == "__main__":
     _p.add_argument("--ticks", type=int, default=30,
                     help="timed ticks for --mesh mode")
     _a = _p.parse_args()
+    if os.environ.get("KWOK_BENCH_CPU_FALLBACK"):
+        # a single CPU core cannot turn over 1M rows in a sane bench
+        # budget; the metric line reports the actual sizes + platform
+        N_PODS = 250_000
+        N_NODES = 2_500
+        TICKS = 60
     if _a.mesh:
         mesh_main(_a.mesh, _a.pods, _a.ticks)
     else:
+        if not _device_reachable():
+            print(
+                "accelerator unreachable (tunnel down?); falling back to "
+                "CPU — the metric line names the platform honestly",
+                file=sys.stderr, flush=True,
+            )
+            env = dict(
+                os.environ, JAX_PLATFORMS="cpu", KWOK_BENCH_CPU_FALLBACK="1"
+            )
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            os.execve(sys.executable, [sys.executable, __file__], env)
         main()
