@@ -11,6 +11,18 @@ Only the list merge strategies that occur in Node/Pod status are
 implemented: conditions (merge key `type`), addresses (merge key `type`);
 all other lists replace atomically (containerStatuses has no patch merge key
 in core/v1).
+
+`$patch: replace` / `$patch: delete` directives are honored the way the real
+apiserver's strategicpatch does for these shapes: a map patch carrying
+`$patch: replace` replaces the original wholesale (minus the directive);
+`$patch: delete` empties it; a merge-list element `{"$patch": "delete",
+<mergeKey>: v}` removes the matching element (deletes apply to the original
+before the patch's own elements merge, as strategicpatch does), and a
+`$patch: replace` element makes the patch's non-directive elements replace
+the list. Unknown
+directive values are dropped tolerantly rather than rejected
+($deleteFromPrimitiveList/$setElementOrder/$retainKeys do not occur in
+node/pod status traffic and are out of scope; see tests/merge_oracle.py).
 """
 
 from __future__ import annotations
@@ -24,35 +36,139 @@ _MERGE_KEYS: dict[str, str] = {
     "addresses": "type",
 }
 
+_DIRECTIVE = "$patch"
+
+
+def _has_directive(item: Any) -> bool:
+    return isinstance(item, dict) and _DIRECTIVE in item
+
+
+def _clean(v: Any) -> bool:
+    """True when a patch subtree carries no $patch markers and no nulls —
+    the common case (everything the engine renders), letting insertion skip
+    the sanitizing rebuild."""
+    if isinstance(v, dict):
+        for k, val in v.items():
+            if k == _DIRECTIVE or val is None or not _clean(val):
+                return False
+        return True
+    if isinstance(v, list):
+        return all(_clean(x) for x in v)
+    return True
+
+
+def _sanitize(v: Any, mk: dict[str, str], field: str | None, *, copies: bool) -> Any:
+    """A patch subtree being inserted where the original has no value: the
+    stored object must never contain $patch markers or nulls (the real
+    apiserver discards unmatched nulls — strategicpatch IgnoreUnmatchedNulls
+    — and directives are instructions, not data). Equivalent to merging the
+    subtree into an empty value, recursively."""
+    if _clean(v):
+        return copy.deepcopy(v) if copies else v
+    if isinstance(v, dict):
+        if v.get(_DIRECTIVE) == "delete":
+            return {}
+        return {
+            k: _sanitize(val, mk, k, copies=copies)
+            for k, val in v.items()
+            if k != _DIRECTIVE and val is not None
+        }
+    if isinstance(v, list) and field in mk:
+        # delete/replace directives are no-ops against an empty list
+        return [
+            _sanitize(x, mk, None, copies=copies) for x in v if not _has_directive(x)
+        ]
+    return copy.deepcopy(v) if copies else v
+
 
 def strategic_merge(original: Any, patch: Any, merge_keys: dict[str, str] | None = None) -> Any:
     merge_keys = _MERGE_KEYS if merge_keys is None else merge_keys
     return _merge_value(original, patch, merge_keys, field=None)
 
 
-def _merge_value(orig: Any, patch: Any, mk: dict[str, str], field: str | None) -> Any:
+def _merge_value(
+    orig: Any, patch: Any, mk: dict[str, str], field: str | None, *, copies: bool = True
+) -> Any:
+    cp = copy.deepcopy if copies else (lambda x: x)
     if isinstance(patch, dict) and isinstance(orig, dict):
+        directive = patch.get(_DIRECTIVE)
+        if directive == "replace":
+            return {
+                k: _sanitize(v, mk, k, copies=copies)
+                for k, v in patch.items()
+                if k != _DIRECTIVE and v is not None
+            }
+        if directive == "delete":
+            return {}
         out = dict(orig)
         for k, v in patch.items():
+            if k == _DIRECTIVE:
+                continue  # unknown directive value: tolerated, dropped
             if v is None:
                 out.pop(k, None)
             elif k in out:
-                out[k] = _merge_value(out[k], v, mk, field=k)
+                out[k] = _merge_value(out[k], v, mk, field=k, copies=copies)
             else:
-                out[k] = copy.deepcopy(v)
+                out[k] = _sanitize(v, mk, k, copies=copies)
         return out
     if isinstance(patch, list) and isinstance(orig, list) and field in mk:
         key = mk[field]
-        out_list = [copy.deepcopy(x) for x in orig]
-        index = {x.get(key): i for i, x in enumerate(out_list) if isinstance(x, dict)}
+        if any(_has_directive(it) and it[_DIRECTIVE] == "replace" for it in patch):
+            return [
+                _sanitize(it, mk, None, copies=copies)
+                for it in patch
+                if not _has_directive(it)
+            ]
+        def build_index(lst):
+            # only string merge keys participate in matching (k8s merge keys
+            # are always strings); first match wins on (malformed) duplicates
+            idx: dict[Any, int] = {}
+            for i, x in enumerate(lst):
+                if isinstance(x, dict) and isinstance(x.get(key), str) and x[key] not in idx:
+                    idx[x[key]] = i
+            return idx
+
+        # strategicpatch applies every $patch:delete to the ORIGINAL before
+        # merging any non-directive element, so a delete never removes an
+        # element the same patch adds
+        deleted = {
+            it[key]
+            for it in patch
+            if _has_directive(it)
+            and it[_DIRECTIVE] == "delete"
+            and isinstance(it.get(key), str)
+        }
+        out_list = [
+            (cp(x) if copies else x)
+            for x in orig
+            if not (
+                isinstance(x, dict)
+                and isinstance(x.get(key), str)
+                and x[key] in deleted
+            )
+        ]
+        index = build_index(out_list)
         for item in patch:
-            if isinstance(item, dict) and item.get(key) in index:
+            if _has_directive(item):
+                continue  # deletes pre-applied; unknown directives dropped
+            if (
+                isinstance(item, dict)
+                and isinstance(item.get(key), str)
+                and item[key] in index
+            ):
                 i = index[item[key]]
-                out_list[i] = _merge_value(out_list[i], item, mk, field=None)
+                out_list[i] = _merge_value(
+                    out_list[i], item, mk, field=None, copies=copies
+                )
             else:
-                out_list.append(copy.deepcopy(item))
+                out_list.append(_sanitize(item, mk, None, copies=copies))
+                if isinstance(item, dict) and isinstance(item.get(key), str):
+                    index[item[key]] = len(out_list) - 1
         return out_list
-    return copy.deepcopy(patch)
+    # type-mismatch / scalar / atomic-list replacement: the patch value
+    # stands alone, so new dict/merge-list subtrees are sanitized the same
+    # way missing-key insertions are
+    return _sanitize(patch, mk, field, copies=copies)
 
 
 def _merge_view(orig: Any, patch: Any, mk: dict[str, str], field: str | None) -> Any:
@@ -62,28 +178,7 @@ def _merge_view(orig: Any, patch: Any, mk: dict[str, str], field: str | None) ->
     the copies dominated the engine's ingest profile). The comparisons use
     Python `==`, which unlike the former canonical-JSON compare treats
     1 == 1.0 == True — a deliberate narrowing (k8s numeric equality)."""
-    if isinstance(patch, dict) and isinstance(orig, dict):
-        out = dict(orig)
-        for k, v in patch.items():
-            if v is None:
-                out.pop(k, None)
-            elif k in out:
-                out[k] = _merge_view(out[k], v, mk, field=k)
-            else:
-                out[k] = v
-        return out
-    if isinstance(patch, list) and isinstance(orig, list) and field in mk:
-        key = mk[field]
-        out_list = list(orig)
-        index = {x.get(key): i for i, x in enumerate(out_list) if isinstance(x, dict)}
-        for item in patch:
-            if isinstance(item, dict) and item.get(key) in index:
-                i = index[item[key]]
-                out_list[i] = _merge_view(out_list[i], item, mk, field=None)
-            else:
-                out_list.append(item)
-        return out_list
-    return patch
+    return _merge_value(orig, patch, mk, field, copies=False)
 
 
 def node_status_patch_needed(current_status: dict, rendered: dict) -> bool:

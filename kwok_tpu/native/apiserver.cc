@@ -590,33 +590,145 @@ static bool match_field_selector(const JVal& obj, const std::string& sel) {
 
 // Mirrors kwok_tpu/edge/merge.py: object merge with null deletion; list
 // merge by key `type` for fields `conditions`/`addresses`; everything else
-// replaces atomically.
+// replaces atomically. `$patch: replace`/`$patch: delete` directives follow
+// the real apiserver's strategicpatch for these shapes (merge.py docstring);
+// unknown directive values are dropped tolerantly.
 static bool merge_list_field(const std::string& field) {
   return field == "conditions" || field == "addresses";
+}
+
+static const JVal* patch_directive(const JVal& v) {
+  const JVal* d = v.type == JVal::OBJ ? v.find("$patch") : nullptr;
+  return (d && d->type == JVal::STR) ? d : nullptr;
+}
+
+// True when a patch subtree carries no $patch markers and no nulls — the
+// common case, letting insertion skip the sanitizing rebuild.
+static bool patch_clean(const JVal& v) {
+  if (v.type == JVal::OBJ) {
+    for (const auto& kv : v.obj)
+      if (kv.first == "$patch" || kv.second.type == JVal::NUL ||
+          !patch_clean(kv.second))
+        return false;
+    return true;
+  }
+  if (v.type == JVal::ARR) {
+    for (const auto& e : v.arr)
+      if (!patch_clean(e)) return false;
+    return true;
+  }
+  return true;
+}
+
+// A patch subtree inserted where the original has no value: stored objects
+// must never contain $patch markers or nulls (mirrors merge.py _sanitize /
+// strategicpatch IgnoreUnmatchedNulls).
+static JVal sanitize_patch(const JVal& v, const std::string& field) {
+  if (patch_clean(v)) return v;
+  if (v.type == JVal::OBJ) {
+    const JVal* d = patch_directive(v);
+    if (d && d->s == "delete") {
+      JVal out;
+      out.type = JVal::OBJ;
+      return out;
+    }
+    JVal out;
+    out.type = JVal::OBJ;
+    for (const auto& kv : v.obj) {
+      if (kv.first == "$patch" || kv.second.type == JVal::NUL) continue;
+      out.obj.emplace_back(kv.first, sanitize_patch(kv.second, kv.first));
+    }
+    return out;
+  }
+  if (v.type == JVal::ARR && merge_list_field(field)) {
+    JVal out;
+    out.type = JVal::ARR;
+    for (const auto& e : v.arr) {
+      if (e.type == JVal::OBJ && e.find("$patch")) continue;
+      out.arr.push_back(sanitize_patch(e, ""));
+    }
+    return out;
+  }
+  return v;  // scalars and atomic lists: opaque values, taken verbatim
 }
 
 static JVal merge_value(const JVal& orig, const JVal& patch,
                         const std::string& field) {
   if (patch.type == JVal::OBJ && orig.type == JVal::OBJ) {
+    if (const JVal* d = patch_directive(patch)) {
+      if (d->s == "replace") {
+        JVal out;
+        out.type = JVal::OBJ;
+        for (const auto& kv : patch.obj) {
+          if (kv.first == "$patch" || kv.second.type == JVal::NUL) continue;
+          out.obj.emplace_back(kv.first, sanitize_patch(kv.second, kv.first));
+        }
+        return out;
+      }
+      if (d->s == "delete") {
+        JVal out;
+        out.type = JVal::OBJ;
+        return out;
+      }
+    }
     JVal out = orig;
     for (const auto& kv : patch.obj) {
+      if (kv.first == "$patch") continue;  // unknown directive: dropped
       if (kv.second.type == JVal::NUL) {
         out.erase(kv.first);
       } else if (JVal* cur = out.find(kv.first)) {
         *cur = merge_value(*cur, kv.second, kv.first);
       } else {
-        out.obj.emplace_back(kv.first, kv.second);
+        out.obj.emplace_back(kv.first, sanitize_patch(kv.second, kv.first));
       }
     }
     return out;
   }
   if (patch.type == JVal::ARR && orig.type == JVal::ARR &&
       merge_list_field(field)) {
-    JVal out = orig;
+    // a `$patch: replace` element -> the patch's non-directive elements
+    // replace the list wholesale
     for (const auto& item : patch.arr) {
+      const JVal* d = patch_directive(item);
+      if (d && d->s == "replace") {
+        JVal out;
+        out.type = JVal::ARR;
+        for (const auto& it : patch.arr)
+          if (!(it.type == JVal::OBJ && it.find("$patch")))
+            out.arr.push_back(sanitize_patch(it, ""));
+        return out;
+      }
+    }
+    // strategicpatch applies every $patch:delete to the ORIGINAL before
+    // merging any non-directive element, so a delete never removes an
+    // element the same patch adds
+    std::vector<std::string> deleted;
+    for (const auto& item : patch.arr) {
+      const JVal* d = patch_directive(item);
       const JVal* ik = item.type == JVal::OBJ ? item.find("type") : nullptr;
+      if (d && d->s == "delete" && ik && ik->type == JVal::STR)
+        deleted.push_back(ik->s);
+    }
+    JVal out = orig;
+    if (!deleted.empty()) {
+      auto& v = out.arr;
+      v.erase(std::remove_if(v.begin(), v.end(),
+                             [&](const JVal& e) {
+                               const JVal* ek = e.type == JVal::OBJ
+                                                    ? e.find("type")
+                                                    : nullptr;
+                               return ek && ek->type == JVal::STR &&
+                                      std::find(deleted.begin(), deleted.end(),
+                                                ek->s) != deleted.end();
+                             }),
+              v.end());
+    }
+    for (const auto& item : patch.arr) {
+      if (item.type == JVal::OBJ && item.find("$patch")) continue;
+      const JVal* ik = item.type == JVal::OBJ ? item.find("type") : nullptr;
+      bool key_is_str = ik && ik->type == JVal::STR;
       bool merged = false;
-      if (ik && ik->type == JVal::STR) {
+      if (key_is_str) {
         for (auto& existing : out.arr) {
           const JVal* ek =
               existing.type == JVal::OBJ ? existing.find("type") : nullptr;
@@ -627,11 +739,13 @@ static JVal merge_value(const JVal& orig, const JVal& patch,
           }
         }
       }
-      if (!merged) out.arr.push_back(item);
+      if (!merged) out.arr.push_back(sanitize_patch(item, ""));
     }
     return out;
   }
-  return patch;
+  // type-mismatch / scalar / atomic-list replacement: sanitized like
+  // missing-key insertions
+  return sanitize_patch(patch, field);
 }
 
 // ----------------------------------------------------------------- store

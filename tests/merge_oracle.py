@@ -1,0 +1,164 @@
+"""An INDEPENDENT strategic-merge-patch oracle.
+
+Round-1 VERDICT: "The mock speaks a protocol the builder also wrote — a
+self-referential oracle." This module is the counterweight: a from-scratch
+implementation of strategic-merge-patch written from the *documented*
+semantics — the Kubernetes API-conventions / strategic-merge-patch docs and
+the core/v1 struct patch tags (`patchStrategy:"merge" patchMergeKey:"type"`
+on NodeStatus/PodStatus `conditions` and NodeStatus `addresses`;
+`$patch: replace|delete` directives) — deliberately NOT derived from
+kwok_tpu/edge/merge.py or kwok_tpu/native/apiserver.cc. It is structured
+differently on purpose (entry-list + first-wins key table instead of
+index-into-output merging) so that agreement between the three
+implementations is evidence about the semantics, not shared code.
+
+Scope (same contract the engine's traffic exercises; reference behavior:
+/root/reference/pkg/kwok/controllers/node_controller.go:356-391,
+pod_controller.go:404-439 go through client-go's full strategicpatch on the
+apiserver side):
+- maps merge recursively; an explicit JSON null deletes the key
+- lists tagged with a merge key merge element-wise by that key; all other
+  lists (e.g. containerStatuses, which has no patchMergeKey in core/v1)
+  replace atomically
+- `$patch: replace` on a map replaces it wholesale; `$patch: delete`
+  empties it; inside a merge list, `{"$patch": "delete", <key>: v}` removes
+  the matching element and a `$patch: replace` element makes the patch's
+  non-directive elements replace the list
+- merge keys are strings (as in k8s); elements without a string merge key
+  never match and are appended positionally
+- out of scope (documented, not occurring in node/pod status traffic):
+  $deleteFromPrimitiveList, $setElementOrder, $retainKeys, and the
+  `$patch: merge` list directive
+
+Name-driven vs schema-driven: the real apiserver walks the Go struct schema;
+for core/v1 node/pod status the two coincide because `conditions` and
+`addresses` are the only merge-tagged list fields reachable from a status
+document.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+# Transcribed from the core/v1 struct patch tags (patchMergeKey).
+MERGE_KEY_BY_FIELD = {"conditions": "type", "addresses": "type"}
+
+DIRECTIVE = "$patch"
+
+
+def _clone(v: Any) -> Any:
+    return json.loads(json.dumps(v))
+
+
+def _strip_markers(value: Any, field_name: str | None = None) -> Any:
+    """A new subtree entering the stored object (no original value to merge
+    with): $patch markers and null members are discarded recursively — the
+    real apiserver never persists directives, and unmatched nulls are
+    ignored (strategicpatch IgnoreUnmatchedNulls). Merge-list directives
+    are no-ops against an absent original. Scalars and atomic lists are
+    opaque values, passed through verbatim."""
+    if isinstance(value, dict):
+        if value.get(DIRECTIVE) == "delete":
+            return {}
+        return {
+            k: _strip_markers(v, k)
+            for k, v in value.items()
+            if k != DIRECTIVE and v is not None
+        }
+    if isinstance(value, list) and field_name in MERGE_KEY_BY_FIELD:
+        return [
+            _strip_markers(e)
+            for e in value
+            if not (isinstance(e, dict) and DIRECTIVE in e)
+        ]
+    return _clone(value)
+
+
+def apply_patch(original: Any, patch: Any, field_name: str | None = None) -> Any:
+    """Apply a strategic-merge patch to `original`, returning a new value."""
+    if isinstance(original, dict) and isinstance(patch, dict):
+        return _patch_map(original, patch)
+    if (
+        isinstance(original, list)
+        and isinstance(patch, list)
+        and field_name in MERGE_KEY_BY_FIELD
+    ):
+        return _patch_merge_list(original, patch, MERGE_KEY_BY_FIELD[field_name])
+    return _strip_markers(patch, field_name)
+
+
+def _patch_map(original: dict, patch: dict) -> dict:
+    directive = patch.get(DIRECTIVE)
+    if directive == "replace":
+        return {
+            k: _strip_markers(v, k)
+            for k, v in patch.items()
+            if k != DIRECTIVE and v is not None
+        }
+    if directive == "delete":
+        return {}
+    result = {k: _clone(v) for k, v in original.items()}
+    for name, value in patch.items():
+        if name == DIRECTIVE:
+            continue  # unrecognized directive value: tolerated, dropped
+        if value is None:
+            result.pop(name, None)
+        elif name in result:
+            result[name] = apply_patch(result[name], value, field_name=name)
+        else:
+            result[name] = _strip_markers(value, name)
+    return result
+
+
+class _Entry:
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+
+def _patch_merge_list(original: list, patch: list, key: str) -> list:
+    if any(isinstance(e, dict) and e.get(DIRECTIVE) == "replace" for e in patch):
+        return [
+            _strip_markers(e)
+            for e in patch
+            if not (isinstance(e, dict) and DIRECTIVE in e)
+        ]
+
+    entries: list[_Entry] = []
+    by_key: dict[str, _Entry] = {}
+
+    def add(value: Any) -> None:
+        e = _Entry(value)
+        entries.append(e)
+        kv = value.get(key) if isinstance(value, dict) else None
+        if isinstance(kv, str) and kv not in by_key:
+            by_key[kv] = e
+
+    # every $patch:delete applies to the ORIGINAL before any non-directive
+    # element merges (strategicpatch runs deleteMatchingEntries first), so a
+    # delete never removes an element the same patch adds
+    doomed = {
+        item[key]
+        for item in patch
+        if isinstance(item, dict)
+        and item.get(DIRECTIVE) == "delete"
+        and isinstance(item.get(key), str)
+    }
+    for item in original:
+        if isinstance(item, dict) and isinstance(item.get(key), str) and item[key] in doomed:
+            continue
+        add(_clone(item))
+
+    for item in patch:
+        if isinstance(item, dict) and DIRECTIVE in item:
+            continue  # deletes pre-applied; unrecognized directives dropped
+        kv = item.get(key) if isinstance(item, dict) else None
+        if isinstance(kv, str) and kv in by_key:
+            e = by_key[kv]
+            e.value = apply_patch(e.value, item, field_name=None)
+        else:
+            add(_strip_markers(item))
+
+    return [e.value for e in entries]
