@@ -147,9 +147,17 @@ class HttpKubeClient:
 
     # -------------------------------------------------------------- plumbing
 
+    _RBAC_KINDS = frozenset(
+        {"roles", "rolebindings", "clusterroles", "clusterrolebindings"}
+    )
+
     def _url(self, kind: str, namespace: str | None = None, name: str | None = None,
              subresource: str | None = None, query: dict | None = None) -> str:
-        parts = ["/api/v1"]
+        parts = [
+            "/apis/rbac.authorization.k8s.io/v1"
+            if kind in self._RBAC_KINDS
+            else "/api/v1"
+        ]
         if namespace:
             parts.append(f"/namespaces/{namespace}")
         parts.append(f"/{kind}")

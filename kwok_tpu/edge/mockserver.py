@@ -68,16 +68,30 @@ class _Watch:
         self.q.put(None)
 
 
+# core/v1 kinds plus the rbac.authorization.k8s.io/v1 group served when the
+# cluster runs with --kube-authorization (reference: kube-apiserver
+# --authorization-mode=Node,RBAC, components/kube_apiserver.go:78-151)
+KINDS = (
+    "nodes",
+    "pods",
+    "roles",
+    "rolebindings",
+    "clusterroles",
+    "clusterrolebindings",
+)
+
+
 class FakeKube:
-    """kinds: "nodes" (cluster-scoped) and "pods" (namespaced)."""
+    """kinds: "nodes"/"clusterroles"/"clusterrolebindings" (cluster-scoped),
+    "pods"/"roles"/"rolebindings" (namespaced)."""
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._store: dict[str, dict[tuple[str, str], dict]] = {"nodes": {}, "pods": {}}
+        self._store: dict[str, dict[tuple[str, str], dict]] = {k: {} for k in KINDS}
         # per-object serialized JSON, invalidated on mutation: list/get/patch
         # responses are cache joins, so a 50k-pod LIST poll costs no
         # deepcopies and only serializes objects that changed since last read
-        self._json: dict[str, dict[tuple[str, str], bytes]] = {"nodes": {}, "pods": {}}
+        self._json: dict[str, dict[tuple[str, str], bytes]] = {k: {} for k in KINDS}
         self._rv = 0
         self._watches: list[_Watch] = []
         # observability for tests
@@ -316,8 +330,8 @@ class FakeKube:
         """Replace the store from a dump(). All open watches are closed so
         clients re-list, like watchers reconnecting after an etcd restore."""
         with self._lock:
-            self._store = {"nodes": {}, "pods": {}}
-            self._json = {"nodes": {}, "pods": {}}
+            self._store = {k: {} for k in KINDS}
+            self._json = {k: {} for k in KINDS}
             for kind, objs in (data.get("objects") or {}).items():
                 if kind not in self._store:
                     continue
@@ -360,6 +374,140 @@ _PATHS = re.compile(
     r"^/api/v1(?:/namespaces/(?P<ns>[^/]+))?/(?P<kind>nodes|pods)"
     r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status))?$"
 )
+_RBAC_PATHS = re.compile(
+    r"^/apis/rbac\.authorization\.k8s\.io/v1"
+    r"(?:/namespaces/(?P<ns>[^/]+))?"
+    r"/(?P<kind>roles|rolebindings|clusterroles|clusterrolebindings)"
+    r"(?:/(?P<name>[^/]+))?(?P<sub>)?$"
+)
+
+
+def _match_path(path: str):
+    return _PATHS.match(path) or _RBAC_PATHS.match(path)
+
+
+# Bootstrap RBAC policy seeded when the cluster runs with
+# --kube-authorization: a representative subset of the objects the real
+# apiserver's bootstrap controller creates (cluster-admin & friends), plus
+# the engine's own role mirroring kustomize/kwok/kwok-clusterrole.yaml.
+# The authorization e2e case asserts all four kinds list non-empty, as the
+# reference's does (test/kwokctl/kwokctl_authorization_test.sh:73-82).
+_BOOTSTRAP_LABELS = {"kubernetes.io/bootstrapping": "rbac-defaults"}
+BOOTSTRAP_RBAC: dict[str, list[dict]] = {
+    "clusterroles": [
+        {
+            "metadata": {"name": "cluster-admin", "labels": _BOOTSTRAP_LABELS},
+            "rules": [
+                {"apiGroups": ["*"], "resources": ["*"], "verbs": ["*"]},
+                {"nonResourceURLs": ["*"], "verbs": ["*"]},
+            ],
+        },
+        {
+            "metadata": {"name": "system:discovery", "labels": _BOOTSTRAP_LABELS},
+            "rules": [
+                {
+                    "nonResourceURLs": ["/api", "/api/*", "/apis", "/apis/*",
+                                        "/healthz", "/version"],
+                    "verbs": ["get"],
+                }
+            ],
+        },
+        {
+            "metadata": {"name": "system:kwok-controller", "labels": _BOOTSTRAP_LABELS},
+            "rules": [
+                {
+                    "apiGroups": [""],
+                    "resources": ["nodes", "pods"],
+                    "verbs": ["get", "watch", "list"],
+                },
+                {
+                    "apiGroups": [""],
+                    "resources": ["nodes/status", "pods/status"],
+                    "verbs": ["update", "patch"],
+                },
+            ],
+        },
+    ],
+    "clusterrolebindings": [
+        {
+            "metadata": {"name": "cluster-admin", "labels": _BOOTSTRAP_LABELS},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "cluster-admin",
+            },
+            "subjects": [
+                {"apiGroup": "rbac.authorization.k8s.io", "kind": "Group",
+                 "name": "system:masters"}
+            ],
+        },
+        {
+            "metadata": {"name": "system:kwok-controller", "labels": _BOOTSTRAP_LABELS},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "system:kwok-controller",
+            },
+            "subjects": [
+                {"kind": "ServiceAccount", "name": "kwok-controller",
+                 "namespace": "kube-system"}
+            ],
+        },
+    ],
+    "roles": [
+        {
+            "metadata": {
+                "name": "extension-apiserver-authentication-reader",
+                "namespace": "kube-system",
+                "labels": _BOOTSTRAP_LABELS,
+            },
+            "rules": [
+                {"apiGroups": [""], "resources": ["configmaps"],
+                 "resourceNames": ["extension-apiserver-authentication"],
+                 "verbs": ["get", "list", "watch"]}
+            ],
+        },
+    ],
+    "rolebindings": [
+        {
+            "metadata": {
+                "name": "system::extension-apiserver-authentication-reader",
+                "namespace": "kube-system",
+                "labels": _BOOTSTRAP_LABELS,
+            },
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "Role",
+                "name": "extension-apiserver-authentication-reader",
+            },
+            "subjects": [
+                {"apiGroup": "rbac.authorization.k8s.io", "kind": "User",
+                 "name": "system:kube-controller-manager"}
+            ],
+        },
+    ],
+}
+
+
+def seed_bootstrap_rbac(store: FakeKube) -> None:
+    """Create the bootstrap policy objects if absent (idempotent across
+    restarts with a persisted --data-file)."""
+    kind_names = {
+        "clusterroles": "ClusterRole",
+        "clusterrolebindings": "ClusterRoleBinding",
+        "roles": "Role",
+        "rolebindings": "RoleBinding",
+    }
+    for kind, objs in BOOTSTRAP_RBAC.items():
+        for obj in objs:
+            meta = obj["metadata"]
+            if store.get(kind, meta.get("namespace"), meta["name"]) is None:
+                doc = {
+                    "apiVersion": "rbac.authorization.k8s.io/v1",
+                    "kind": kind_names[kind],
+                    **copy.deepcopy(obj),
+                }
+                store.create(kind, doc)
 
 
 class _Server(ThreadingHTTPServer):
@@ -376,8 +524,12 @@ class HttpFakeApiserver:
         port: int = 0,
         address: str = "127.0.0.1",
         audit_log_path: str | None = None,
+        token: str | None = None,
     ) -> None:
         self.store = store or FakeKube()
+        # bearer-token authentication (kube-apiserver --token-auth-file):
+        # when set, every request except /healthz must carry it
+        self.token = token
         self._audit_lock = threading.Lock()
         self._audit_file = None
         handler = self._make_handler()
@@ -404,7 +556,7 @@ class HttpFakeApiserver:
             q = urllib.parse.parse_qs(parsed.query)
             if (q.get("watch") or ["false"])[0] in ("true", "1"):
                 return "watch"
-            m = _PATHS.match(parsed.path)
+            m = _match_path(parsed.path)
             if m and not m.group("name"):
                 return "list"
             return "get"
@@ -487,6 +639,28 @@ class HttpFakeApiserver:
                 n = int(self.headers.get("Content-Length") or 0)
                 return json.loads(self.rfile.read(n) or b"null") if n else None
 
+            def _authorized(self) -> bool:
+                """kube-apiserver token authn: /healthz stays anonymous (the
+                components' --authorization-always-allow-paths contract);
+                everything else 401s without the bearer token."""
+                if server_obj.token is None:
+                    return True
+                got = self.headers.get("Authorization") or ""
+                if got == f"Bearer {server_obj.token}":
+                    return True
+                self._send_json(
+                    {
+                        "kind": "Status",
+                        "apiVersion": "v1",
+                        "status": "Failure",
+                        "reason": "Unauthorized",
+                        "message": "Unauthorized",
+                        "code": 401,
+                    },
+                    401,
+                )
+                return False
+
             def do_GET(self):  # noqa: N802
                 parsed = urllib.parse.urlparse(self.path)
                 if parsed.path == "/healthz":
@@ -495,11 +669,13 @@ class HttpFakeApiserver:
                     self.end_headers()
                     self.wfile.write(b"ok")
                     return
+                if not self._authorized():
+                    return
                 if parsed.path == "/snapshot":
                     # the mock's `etcdctl snapshot save`
                     self._send_json(store.dump())
                     return
-                m = _PATHS.match(parsed.path)
+                m = _match_path(parsed.path)
                 if not m:
                     self.send_error(404)
                     return
@@ -548,8 +724,10 @@ class HttpFakeApiserver:
                     w.stop()
 
             def do_PATCH(self):  # noqa: N802
+                if not self._authorized():
+                    return
                 parsed = urllib.parse.urlparse(self.path)
-                m = _PATHS.match(parsed.path)
+                m = _match_path(parsed.path)
                 if not m or not m.group("name"):
                     self.send_error(404)
                     return
@@ -565,8 +743,10 @@ class HttpFakeApiserver:
                     self._send_body(body)
 
             def do_DELETE(self):  # noqa: N802
+                if not self._authorized():
+                    return
                 parsed = urllib.parse.urlparse(self.path)
-                m = _PATHS.match(parsed.path)
+                m = _match_path(parsed.path)
                 if not m or not m.group("name"):
                     self.send_error(404)
                     return
@@ -578,13 +758,15 @@ class HttpFakeApiserver:
                 self._send_json({"kind": "Status", "status": "Success"})
 
             def do_POST(self):  # noqa: N802 (test convenience: create)
+                if not self._authorized():
+                    return
                 parsed = urllib.parse.urlparse(self.path)
                 if parsed.path == "/restore":
                     # the mock's `etcdctl snapshot restore` + etcd restart
                     store.load(self._body() or {})
                     self._send_json({"kind": "Status", "status": "Success"})
                     return
-                m = _PATHS.match(parsed.path)
+                m = _match_path(parsed.path)
                 if not m:
                     self.send_error(404)
                     return
@@ -619,11 +801,37 @@ def main(argv=None) -> int:
         help="persist the store here across restarts (the mock's etcd "
         "data dir): loaded at startup, written on shutdown",
     )
+    p.add_argument(
+        "--authorization",
+        action="store_true",
+        help="serve rbac.authorization.k8s.io/v1 with bootstrap policy "
+        "(the mock analogue of --authorization-mode=Node,RBAC)",
+    )
+    p.add_argument(
+        "--token-auth-file",
+        default="",
+        help="CSV token file (token,user,uid[,groups]) as kube-apiserver's "
+        "--token-auth-file; requests without the token get 401",
+    )
     args = p.parse_args(argv)
+    token = None
+    if args.token_auth_file:
+        with open(args.token_auth_file) as f:
+            first = f.readline().strip()
+        token = first.split(",", 1)[0] if first else ""
+        if not token:
+            # an unusable token file must fail hard, not degrade to
+            # anonymous (the real kube-apiserver refuses to start too)
+            print(
+                f"token file {args.token_auth_file} has no token",
+                flush=True,
+            )
+            return 1
     srv = HttpFakeApiserver(
         port=args.port,
         address=args.address,
         audit_log_path=args.audit_log or None,
+        token=token,
     )
     if args.data_file:
         try:
@@ -632,6 +840,8 @@ def main(argv=None) -> int:
             print(f"restored store from {args.data_file}", flush=True)
         except FileNotFoundError:
             pass
+    if args.authorization:
+        seed_bootstrap_rbac(srv.store)
     print(f"mock apiserver listening on {srv.url}", flush=True)
 
     # SIGTERM arrives on the thread running serve_forever, so calling

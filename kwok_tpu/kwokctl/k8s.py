@@ -93,9 +93,11 @@ def build_kubeconfig(
     secure_port: bool = False,
     admin_crt_path: str = "",
     admin_key_path: str = "",
+    token: str = "",
 ) -> str:
     """Render a kubeconfig document (kubeconfig.yaml.tpl semantics: client
-    certs + skip-tls-verify only on the secure path)."""
+    certs + skip-tls-verify only on the secure path; `token` carries the
+    bearer credential for the mock runtime's --kube-authorization mode)."""
     lines = [
         "apiVersion: v1",
         "kind: Config",
@@ -114,13 +116,18 @@ def build_kubeconfig(
         "    context:",
         f"      cluster: {project_name}",
     ]
-    if secure_port:
+    if secure_port or token:
         lines += [
             f"      user: {project_name}",
             "users:",
             f"  - name: {project_name}",
             "    user:",
+        ]
+    if secure_port:
+        lines += [
             f"      client-certificate: {admin_crt_path}",
             f"      client-key: {admin_key_path}",
         ]
+    if token:
+        lines.append(f"      token: {token}")
     return "\n".join(lines) + "\n"

@@ -93,6 +93,16 @@ class MockCluster(BinaryCluster):
             # policy/log files are prepared by _setup_workdir; the mock
             # apiserver emits audit.k8s.io/v1 Event lines per request
             args.append(f"--audit-log={self.log_path(base.AUDIT_LOG_NAME)}")
+        if conf.kubeAuthorization:
+            # --kube-authorization on the mock: rbac.authorization.k8s.io/v1
+            # with bootstrap policy, plus bearer-token authn; the token is
+            # generated per cluster and carried by the kubeconfig (the mock
+            # analogue of --authorization-mode=Node,RBAC + client certs,
+            # create/cluster/cluster.go --kube-authorization flag)
+            args += [
+                "--authorization",
+                f"--token-auth-file={self._ensure_token_file()}",
+            ]
         apiserver = Component(
             name="kube-apiserver",
             binary=self.bin_path("kube-apiserver"),
@@ -109,12 +119,38 @@ class MockCluster(BinaryCluster):
         )
         config.components = [apiserver, kwok]
 
+    def _ensure_token_file(self) -> str:
+        """Generate (once) the cluster's admin token file, kube-apiserver
+        --token-auth-file CSV format: token,user,uid,groups."""
+        path = self.workdir_path("admin-token.csv")
+        if not os.path.exists(path):
+            import secrets
+
+            token = secrets.token_hex(16)
+            with open(path, "w") as f:
+                f.write(f'{token},kwok-admin,uid-kwok-admin,"system:masters"\n')
+            os.chmod(path, 0o600)
+        return path
+
+    def _admin_token(self) -> str | None:
+        path = self.workdir_path("admin-token.csv")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            first = f.readline().strip()
+        return first.split(",", 1)[0] if first else None
+
     def _write_kubeconfig(self) -> None:
         conf = self.config().options
+        token = ""
+        if conf.kubeAuthorization:
+            self._ensure_token_file()
+            token = self._admin_token() or ""
         data = k8s.build_kubeconfig(
             project_name=self.name,
             address=f"http://{LOCAL}:{conf.kubeApiserverPort}",
             secure_port=False,
+            token=token,
         )
         with open(self.workdir_path(base.IN_HOST_KUBECONFIG_NAME), "w") as f:
             f.write(data)
@@ -122,12 +158,19 @@ class MockCluster(BinaryCluster):
     def _apiserver_url(self) -> str:
         return f"http://{LOCAL}:{self.config().options.kubeApiserverPort}"
 
+    def _auth_headers(self) -> dict[str, str]:
+        token = self._admin_token()
+        return {"Authorization": f"Bearer {token}"} if token else {}
+
     def snapshot_save(self, path: str) -> None:
         """GET /snapshot — the mock analogue of `etcdctl snapshot save`
         (cluster state IS apiserver-store state, SURVEY.md section 3.5)."""
         import urllib.request
 
-        with urllib.request.urlopen(self._apiserver_url() + "/snapshot") as r:
+        req = urllib.request.Request(
+            self._apiserver_url() + "/snapshot", headers=self._auth_headers()
+        )
+        with urllib.request.urlopen(req) as r:
             data = r.read()
         with open(path, "wb") as f:
             f.write(data)
@@ -142,7 +185,7 @@ class MockCluster(BinaryCluster):
         req = urllib.request.Request(
             self._apiserver_url() + "/restore",
             data=data,
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **self._auth_headers()},
             method="POST",
         )
         urllib.request.urlopen(req).read()

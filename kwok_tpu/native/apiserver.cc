@@ -817,16 +817,23 @@ struct Watch {
   }
 };
 
+// core/v1 kinds plus rbac.authorization.k8s.io/v1 (served with bootstrap
+// policy under --authorization; mirrors mockserver.py KINDS)
+static const int NKINDS = 6;
+// order matters: pods must stay index 1 (graceful-delete special case)
+static const char* KIND_NAMES[NKINDS] = {
+    "nodes",        "pods",         "roles",
+    "rolebindings", "clusterroles", "clusterrolebindings",
+};
 static int kind_index(const std::string& kind) {
-  if (kind == "nodes") return 0;
-  if (kind == "pods") return 1;
+  for (int i = 0; i < NKINDS; i++)
+    if (kind == KIND_NAMES[i]) return i;
   return -1;
 }
-static const char* KIND_NAMES[2] = {"nodes", "pods"};
 
 struct Store {
   std::mutex mu;
-  std::map<Key, EntryPtr> kinds[2];
+  std::map<Key, EntryPtr> kinds[NKINDS];
   int64_t rv = 0;
   std::vector<std::shared_ptr<Watch>> watches;
 
@@ -882,6 +889,7 @@ struct Request {
   std::string path;     // without query
   std::string query;    // raw query string
   std::string body;
+  std::string auth;     // Authorization header (bearer-token authn)
   bool close = false;   // Connection: close
 };
 
@@ -919,6 +927,7 @@ static bool read_request(int fd, std::string& buf, Request& req) {
 
   size_t content_len = 0;
   req.close = false;
+  req.auth.clear();
   size_t pos = line_end + 2;
   while (pos < head.size()) {
     size_t e = head.find("\r\n", pos);
@@ -931,6 +940,7 @@ static bool read_request(int fd, std::string& buf, Request& req) {
     std::transform(k.begin(), k.end(), k.begin(), ::tolower);
     std::string v = strip(h.substr(colon + 1));
     if (k == "content-length") content_len = (size_t)atoll(v.c_str());
+    else if (k == "authorization") req.auth = v;
     else if (k == "connection") {
       std::transform(v.begin(), v.end(), v.begin(), ::tolower);
       if (v == "close") req.close = true;
@@ -956,6 +966,7 @@ static bool send_all(int fd, const char* data, size_t n) {
 static bool send_response(int fd, int code, const std::string& body) {
   const char* reason = code == 200   ? "OK"
                        : code == 201 ? "Created"
+                       : code == 401 ? "Unauthorized"
                        : code == 404 ? "Not Found"
                                      : "Error";
   char head[256];
@@ -1020,9 +1031,18 @@ struct PathMatch {
 
 static PathMatch match_path(const std::string& path) {
   PathMatch m;
-  const std::string prefix = "/api/v1";
-  if (path.rfind(prefix, 0) != 0) return m;
-  std::string rest = path.substr(prefix.size());
+  const std::string core = "/api/v1";
+  const std::string rbac = "/apis/rbac.authorization.k8s.io/v1";
+  std::string rest;
+  bool is_rbac = false;
+  if (path.rfind(rbac, 0) == 0) {
+    rest = path.substr(rbac.size());
+    is_rbac = true;
+  } else if (path.rfind(core, 0) == 0) {
+    rest = path.substr(core.size());
+  } else {
+    return m;
+  }
   std::vector<std::string> parts;
   size_t pos = 0;
   while (pos < rest.size()) {
@@ -1044,6 +1064,9 @@ static PathMatch match_path(const std::string& path) {
   if (i >= parts.size()) return m;
   m.kind = kind_index(parts[i]);
   if (m.kind < 0) return m;
+  // group membership: nodes/pods live under /api/v1, rbac kinds under
+  // /apis/rbac.authorization.k8s.io/v1 (mirrors mockserver.py's regexes)
+  if (is_rbac != (m.kind >= 2)) return m;
   i++;
   if (i < parts.size()) {
     m.name = url_decode(parts[i]);
@@ -1066,6 +1089,7 @@ struct App {
   std::mutex audit_mu;
   FILE* audit = nullptr;
   std::string data_file;
+  std::string auth_token;  // --token-auth-file bearer token ("" = authn off)
   int listen_fd = -1;
   std::atomic<bool> stopping{false};
 
@@ -1074,6 +1098,7 @@ struct App {
   bool handle_request(int fd, Request& req);
   std::string snapshot_dump();
   void restore_load(const JVal& data);
+  void seed_rbac();
   void persist();
 };
 
@@ -1122,12 +1147,12 @@ void App::audit_line(const std::string& method, const std::string& uri,
 }
 
 std::string App::snapshot_dump() {
-  std::vector<EntryPtr> snap[2];
+  std::vector<EntryPtr> snap[NKINDS];
   int64_t rv;
   {
     std::lock_guard<std::mutex> lk(store.mu);
     rv = store.rv;
-    for (int k = 0; k < 2; k++) {
+    for (int k = 0; k < NKINDS; k++) {
       snap[k].reserve(store.kinds[k].size());
       for (auto& kv : store.kinds[k]) snap[k].push_back(kv.second);
     }
@@ -1135,7 +1160,7 @@ std::string App::snapshot_dump() {
   std::string out = "{\"resourceVersion\":";
   out += std::to_string(rv);
   out += ",\"objects\":{";
-  for (int k = 0; k < 2; k++) {
+  for (int k = 0; k < NKINDS; k++) {
     if (k) out += ',';
     out += '"';
     out += KIND_NAMES[k];
@@ -1156,10 +1181,10 @@ void App::restore_load(const JVal& data) {
   std::vector<std::shared_ptr<Watch>> old;
   {
     std::lock_guard<std::mutex> lk(store.mu);
-    for (int k = 0; k < 2; k++) store.kinds[k].clear();
+    for (int k = 0; k < NKINDS; k++) store.kinds[k].clear();
     const JVal* objects = data.find("objects");
     if (objects && objects->type == JVal::OBJ) {
-      for (int k = 0; k < 2; k++) {
+      for (int k = 0; k < NKINDS; k++) {
         const JVal* list = objects->find(KIND_NAMES[k]);
         if (!list || list->type != JVal::ARR) continue;
         for (const JVal& obj : list->arr) {
@@ -1176,6 +1201,77 @@ void App::restore_load(const JVal& data) {
     old.swap(store.watches);
   }
   for (auto& w : old) w->close();
+}
+
+// Bootstrap RBAC policy for --authorization: a representative subset of
+// what the real apiserver's bootstrap controller creates, byte-identical in
+// content to mockserver.py BOOTSTRAP_RBAC (the authorization e2e + parity
+// tests assert the two servers seed the same objects).
+static const char* BOOTSTRAP_RBAC_JSON = R"JSON({
+"clusterroles": [
+ {"apiVersion":"rbac.authorization.k8s.io/v1","kind":"ClusterRole",
+  "metadata":{"name":"cluster-admin","labels":{"kubernetes.io/bootstrapping":"rbac-defaults"}},
+  "rules":[{"apiGroups":["*"],"resources":["*"],"verbs":["*"]},
+           {"nonResourceURLs":["*"],"verbs":["*"]}]},
+ {"apiVersion":"rbac.authorization.k8s.io/v1","kind":"ClusterRole",
+  "metadata":{"name":"system:discovery","labels":{"kubernetes.io/bootstrapping":"rbac-defaults"}},
+  "rules":[{"nonResourceURLs":["/api","/api/*","/apis","/apis/*","/healthz","/version"],"verbs":["get"]}]},
+ {"apiVersion":"rbac.authorization.k8s.io/v1","kind":"ClusterRole",
+  "metadata":{"name":"system:kwok-controller","labels":{"kubernetes.io/bootstrapping":"rbac-defaults"}},
+  "rules":[{"apiGroups":[""],"resources":["nodes","pods"],"verbs":["get","watch","list"]},
+           {"apiGroups":[""],"resources":["nodes/status","pods/status"],"verbs":["update","patch"]}]}
+],
+"clusterrolebindings": [
+ {"apiVersion":"rbac.authorization.k8s.io/v1","kind":"ClusterRoleBinding",
+  "metadata":{"name":"cluster-admin","labels":{"kubernetes.io/bootstrapping":"rbac-defaults"}},
+  "roleRef":{"apiGroup":"rbac.authorization.k8s.io","kind":"ClusterRole","name":"cluster-admin"},
+  "subjects":[{"apiGroup":"rbac.authorization.k8s.io","kind":"Group","name":"system:masters"}]},
+ {"apiVersion":"rbac.authorization.k8s.io/v1","kind":"ClusterRoleBinding",
+  "metadata":{"name":"system:kwok-controller","labels":{"kubernetes.io/bootstrapping":"rbac-defaults"}},
+  "roleRef":{"apiGroup":"rbac.authorization.k8s.io","kind":"ClusterRole","name":"system:kwok-controller"},
+  "subjects":[{"kind":"ServiceAccount","name":"kwok-controller","namespace":"kube-system"}]}
+],
+"roles": [
+ {"apiVersion":"rbac.authorization.k8s.io/v1","kind":"Role",
+  "metadata":{"name":"extension-apiserver-authentication-reader","namespace":"kube-system",
+              "labels":{"kubernetes.io/bootstrapping":"rbac-defaults"}},
+  "rules":[{"apiGroups":[""],"resources":["configmaps"],
+            "resourceNames":["extension-apiserver-authentication"],
+            "verbs":["get","list","watch"]}]}
+],
+"rolebindings": [
+ {"apiVersion":"rbac.authorization.k8s.io/v1","kind":"RoleBinding",
+  "metadata":{"name":"system::extension-apiserver-authentication-reader","namespace":"kube-system",
+              "labels":{"kubernetes.io/bootstrapping":"rbac-defaults"}},
+  "roleRef":{"apiGroup":"rbac.authorization.k8s.io","kind":"Role",
+             "name":"extension-apiserver-authentication-reader"},
+  "subjects":[{"apiGroup":"rbac.authorization.k8s.io","kind":"User",
+               "name":"system:kube-controller-manager"}]}
+]
+})JSON";
+
+void App::seed_rbac() {
+  // materialize the literal: JParser keeps pointers into the string
+  const std::string text = BOOTSTRAP_RBAC_JSON;
+  JParser p(text);
+  JVal data = p.parse();
+  if (!p.ok) return;
+  std::lock_guard<std::mutex> lk(store.mu);
+  for (const auto& kv : data.obj) {
+    int k = kind_index(kv.first);
+    if (k < 0 || kv.second.type != JVal::ARR) continue;
+    for (const JVal& tmpl : kv.second.arr) {
+      Key key = Store::obj_key(tmpl);
+      if (key.second.empty() || store.kinds[k].count(key)) continue;
+      JVal obj = tmpl;  // idempotent create-if-absent (data-file restarts)
+      JVal& meta = obj.get_or_insert_obj("metadata");
+      meta.set("creationTimestamp", JVal::str(now_rfc3339()));
+      meta.set("uid", JVal::str("uid-" + std::to_string(store.rv + 1)));
+      store.bump(obj);
+      store.kinds[k][key] = publish(std::move(obj));
+      // no emit: seeding happens before the listener accepts watchers
+    }
+  }
 }
 
 void App::persist() {
@@ -1202,6 +1298,13 @@ bool App::handle_request(int fd, Request& req) {
 
   if (req.method == "GET" && req.path == "/healthz")
     return respond(200, "ok");
+  // bearer-token authn (--token-auth-file): /healthz stays anonymous (the
+  // components' --authorization-always-allow-paths contract)
+  if (!auth_token.empty() && req.auth != "Bearer " + auth_token)
+    return respond(401,
+                   "{\"kind\":\"Status\",\"apiVersion\":\"v1\","
+                   "\"status\":\"Failure\",\"reason\":\"Unauthorized\","
+                   "\"message\":\"Unauthorized\",\"code\":401}");
   if (req.method == "GET" && req.path == "/snapshot")
     return respond(200, snapshot_dump());
   if (req.method == "POST" && req.path == "/restore") {
@@ -1518,7 +1621,8 @@ static void on_term(int) {
 int main(int argc, char** argv) {
   int port = 0;
   std::string address = "127.0.0.1";
-  std::string audit_log, data_file;
+  std::string audit_log, data_file, token_file;
+  bool authorization = false;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto val = [&](const char* flag) -> const char* {
@@ -1532,6 +1636,8 @@ int main(int argc, char** argv) {
     else if (const char* v = val("--address")) address = v;
     else if (const char* v = val("--audit-log")) audit_log = v;
     else if (const char* v = val("--data-file")) data_file = v;
+    else if (const char* v = val("--token-auth-file")) token_file = v;
+    else if (a == "--authorization") authorization = true;
   }
 
   signal(SIGPIPE, SIG_IGN);
@@ -1563,6 +1669,29 @@ int main(int argc, char** argv) {
       }
     }
   }
+  if (!token_file.empty()) {
+    // kube-apiserver --token-auth-file CSV: token,user,uid[,groups]
+    FILE* f = fopen(token_file.c_str(), "r");
+    if (!f) {
+      fprintf(stderr, "cannot open token file %s\n", token_file.c_str());
+      return 1;
+    }
+    char line[4096];
+    if (fgets(line, sizeof line, f)) {
+      std::string first = line;
+      first.erase(first.find_last_not_of(" \t\r\n") + 1);
+      size_t comma = first.find(',');
+      app.auth_token =
+          comma == std::string::npos ? first : first.substr(0, comma);
+    }
+    fclose(f);
+    if (app.auth_token.empty()) {
+      // an unusable token file must fail hard, not degrade to anonymous
+      fprintf(stderr, "token file %s has no token\n", token_file.c_str());
+      return 1;
+    }
+  }
+  if (authorization) app.seed_rbac();
 
   int lfd = socket(AF_INET, SOCK_STREAM, 0);
   if (lfd < 0) {

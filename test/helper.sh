@@ -28,6 +28,16 @@ kwokctl() {
   pyrun -m kwok_tpu.kwokctl "$@"
 }
 
+# curl with the cluster's bearer token when KWOK_E2E_TOKEN is set (the
+# authorization e2e case exports it from the cluster's kubeconfig)
+kcurl() {
+  if [ -n "${KWOK_E2E_TOKEN:-}" ]; then
+    curl -H "Authorization: Bearer ${KWOK_E2E_TOKEN}" "$@"
+  else
+    curl "$@"
+  fi
+}
+
 apiserver_url() { # CLUSTER_NAME -> http://127.0.0.1:PORT
   local kc
   kc="$(kwokctl --name "$1" get kubeconfig)"
@@ -53,7 +63,7 @@ retry() { # TIMEOUT_SECONDS CMD ARGS... — poll every second
 create_node() { # URL NAME [ANNOTATIONS_JSON]
   local annotations="${3:-}"
   [ -n "${annotations}" ] || annotations="{}"
-  curl -fsS -X POST "$1/api/v1/nodes" -H 'Content-Type: application/json' \
+  kcurl -fsS -X POST "$1/api/v1/nodes" -H 'Content-Type: application/json' \
     -d "{\"apiVersion\":\"v1\",\"kind\":\"Node\",\"metadata\":{\"name\":\"$2\",\"annotations\":${annotations}}}" \
     >/dev/null
 }
@@ -61,14 +71,14 @@ create_node() { # URL NAME [ANNOTATIONS_JSON]
 create_pod() { # URL NS NAME NODE [ANNOTATIONS_JSON]
   local annotations="${5:-}"
   [ -n "${annotations}" ] || annotations="{}"
-  curl -fsS -X POST "$1/api/v1/namespaces/$2/pods" \
+  kcurl -fsS -X POST "$1/api/v1/namespaces/$2/pods" \
     -H 'Content-Type: application/json' \
     -d "{\"apiVersion\":\"v1\",\"kind\":\"Pod\",\"metadata\":{\"name\":\"$3\",\"namespace\":\"$2\",\"annotations\":${annotations}},\"spec\":{\"nodeName\":\"$4\",\"containers\":[{\"name\":\"c\",\"image\":\"busybox\"}]},\"status\":{\"phase\":\"Pending\"}}" \
     >/dev/null
 }
 
 node_is_ready() { # URL NAME
-  curl -fsS "$1/api/v1/nodes/$2" | pyrun -c '
+  kcurl -fsS "$1/api/v1/nodes/$2" | pyrun -c '
 import json, sys
 node = json.load(sys.stdin)
 conds = {c["type"]: c["status"] for c in (node.get("status") or {}).get("conditions") or []}
@@ -77,7 +87,7 @@ sys.exit(0 if conds.get("Ready") == "True" else 1)
 }
 
 count_ready_nodes() { # URL
-  curl -fsS "$1/api/v1/nodes" | pyrun -c '
+  kcurl -fsS "$1/api/v1/nodes" | pyrun -c '
 import json, sys
 items = json.load(sys.stdin)["items"]
 print(sum(1 for n in items
@@ -87,7 +97,7 @@ print(sum(1 for n in items
 }
 
 count_running_pods() { # URL
-  curl -fsS "$1/api/v1/pods" | pyrun -c '
+  kcurl -fsS "$1/api/v1/pods" | pyrun -c '
 import json, sys
 items = json.load(sys.stdin)["items"]
 print(sum(1 for p in items if (p.get("status") or {}).get("phase") == "Running"))
@@ -95,7 +105,7 @@ print(sum(1 for p in items if (p.get("status") or {}).get("phase") == "Running")
 }
 
 count_pods() { # URL
-  curl -fsS "$1/api/v1/pods" | pyrun -c '
+  kcurl -fsS "$1/api/v1/pods" | pyrun -c '
 import json, sys; print(len(json.load(sys.stdin)["items"]))
 '
 }
