@@ -139,7 +139,7 @@ def _merge_value(
             and isinstance(it.get(key), str)
         }
         out_list = [
-            (cp(x) if copies else x)
+            cp(x)
             for x in orig
             if not (
                 isinstance(x, dict)

@@ -16,11 +16,14 @@ per-cluster (per-apiserver HTTP fan-out, like the reference's per-process
 parallelTasks pools), while state mutation and rule evaluation are batched
 across clusters in the shared tick.
 
-All members must share one lifecycle rule set (the compiled rule table is
-baked into the jitted kernel). Heterogeneous-rule federations would need one
-kernel per rule-set group — out of scope, as is cross-cluster scheduling
-(federated *scheduling* is the real scheduler's job; we simulate the
-kubelets under it).
+Members MAY run different lifecycle rule sets (`member_configs`): the
+compiled rule table is baked into each jitted kernel, so members are
+grouped by (rule tables, heartbeat interval) and each group gets its own
+stacked state + fused kernel — one dispatch per GROUP per tick, which
+degenerates to the single-dispatch fast path when all members share rules
+(the common case, and the only case round 1 supported). Out of scope:
+cross-cluster scheduling (federated *scheduling* is the real scheduler's
+job; we simulate the kubelets under it).
 """
 
 from __future__ import annotations
@@ -57,58 +60,122 @@ def _pad_cluster_capacity(r: int, n_clusters: int, n_devices: int) -> int:
     return ((r + step - 1) // step) * step
 
 
+def _table_bytes(tab) -> bytes:
+    """Canonical bytes of a CompiledRules table (grouping key)."""
+    import io
+
+    buf = io.BytesIO()
+    for f in (
+        "from_mask", "deletion", "selector_bit", "delay_kind", "delay_a",
+        "delay_b", "to_phase", "cond_assign", "cond_value", "is_delete",
+    ):
+        buf.write(np.ascontiguousarray(getattr(tab, f)).tobytes())
+        buf.write(b"|")
+    return buf.getvalue()
+
+
+class _Group:
+    """Members sharing one compiled rule set: one stacked state and one
+    fused kernel (the round-1 whole-federation layout, now per group)."""
+
+    def __init__(self, engines, cfg, mesh):
+        self.engines = engines  # ClusterEngines, federation order preserved
+        self.r = 0  # rows per cluster; set by alloc
+        e0 = engines[0]
+        hb_bit = e0.node_bits[SEL_HEARTBEAT]
+        steps = max(1, int(getattr(cfg, "tick_substeps", 1)))
+        self.fused = MultiTickKernel(
+            [
+                (e0.nodes.table, cfg.heartbeat_interval, (), hb_bit),
+                (e0.pods.table, cfg.heartbeat_interval, (), -1),
+            ],
+            mesh=mesh,
+            pack=True,
+            steps=steps,
+            dt=cfg.tick_interval / steps,
+        )
+        self.stacked: dict[str, RowState] = {}
+
+    def alloc(self, r: int) -> None:
+        self.r = r
+        cap = r * len(self.engines)
+        self.stacked = {
+            "nodes": self.fused.place(new_row_state(cap)),
+            "pods": self.fused.place(new_row_state(cap)),
+        }
+
+
 class FederatedEngine:
-    """Drive N member clusters from one stacked, mesh-sharded tick."""
+    """Drive N member clusters from one stacked, mesh-sharded tick per
+    rule-set group (a single group — and a single dispatch — when all
+    members share rules)."""
 
     def __init__(
         self,
         clients: list[KubeClient],
         config: EngineConfig,
         mesh=None,
+        member_configs: list[EngineConfig] | None = None,
     ) -> None:
         if not clients:
             raise ValueError("federation needs at least one cluster")
-        self.mesh = mesh if mesh is not None else make_mesh()
-        n = len(clients)
-        d = int(self.mesh.devices.size)
-        self.cluster_capacity = _pad_cluster_capacity(
-            max(int(config.initial_capacity), 1), n, d
-        )
-
-        self.engines: list[ClusterEngine] = []
-        for client in clients:
-            cfg = dataclasses.replace(
-                config, initial_capacity=self.cluster_capacity, use_mesh=False
+        if member_configs is not None and len(member_configs) != len(clients):
+            raise ValueError(
+                f"member_configs has {len(member_configs)} entries "
+                f"for {len(clients)} clusters"
             )
-            self.engines.append(ClusterEngine(client, cfg))
+        self.mesh = mesh if mesh is not None else make_mesh()
+        d = int(self.mesh.devices.size)
+        cfgs = member_configs if member_configs is not None else [config] * len(clients)
 
-        e0 = self.engines[0]
-        # ONE fused kernel for both kinds across the whole stacked state
-        # (rule tables are e0's — all members share them): one dispatch and
-        # one packed-wire D2H per federated tick (ops/tick.MultiTickKernel).
-        hb_bit = e0.node_bits[SEL_HEARTBEAT]
-        steps = max(1, int(getattr(config, "tick_substeps", 1)))
-        self._fused = MultiTickKernel(
-            [
-                (e0.nodes.table, config.heartbeat_interval, (), hb_bit),
-                (e0.pods.table, config.heartbeat_interval, (), -1),
-            ],
-            mesh=self.mesh,
-            pack=True,
-            steps=steps,
-            dt=config.tick_interval / steps,
-        )
+        self.engines = [
+            ClusterEngine(
+                client,
+                dataclasses.replace(
+                    cfg,
+                    initial_capacity=max(int(config.initial_capacity), 1),
+                    use_mesh=False,
+                ),
+            )
+            for client, cfg in zip(clients, cfgs)
+        ]
+
+        # Group members by compiled rule set + heartbeat cadence: the rule
+        # table is baked into the jitted kernel, so each distinct set needs
+        # its own kernel; identical sets share one (one dispatch per group).
+        by_key: dict[tuple, list[int]] = {}
+        for i, (e, cfg) in enumerate(zip(self.engines, cfgs)):
+            key = (
+                _table_bytes(e.nodes.table),
+                _table_bytes(e.pods.table),
+                # everything _Group bakes into the jitted kernel must be in
+                # the key, or differing members would silently coalesce
+                float(cfg.heartbeat_interval),
+                float(cfg.tick_interval),
+                int(getattr(cfg, "tick_substeps", 1)),
+            )
+            by_key.setdefault(key, []).append(i)
+        self.groups: list[_Group] = []
+        for members in by_key.values():
+            g = _Group(
+                [self.engines[i] for i in members], cfgs[members[0]], self.mesh
+            )
+            g.alloc(
+                _pad_cluster_capacity(
+                    max(int(config.initial_capacity), 1), len(members), d
+                )
+            )
+            self.groups.append(g)
+        for g in self.groups:
+            for e in g.engines:
+                for k in (e.nodes, e.pods):
+                    if k.capacity < g.r:
+                        k.grow(g.r)
 
         # Shared engine epoch so one `now` is correct for every member.
         self._epoch = time.time()
         for e in self.engines:
             e._epoch = self._epoch
-
-        cap = self.cluster_capacity * n
-        self._stacked: dict[str, RowState] = {
-            "nodes": self._fused.place(new_row_state(cap)),
-            "pods": self._fused.place(new_row_state(cap)),
-        }
 
         self.config = config
         self._running = False
@@ -116,6 +183,12 @@ class FederatedEngine:
         # monotonic wake-up for the idle tick loop (see ClusterEngine):
         # 0 = tick immediately, None = nothing scheduled on device
         self._idle_wake: float | None = 0.0
+
+    @property
+    def cluster_capacity(self) -> int:
+        """Rows per member cluster (max across groups; groups pad
+        independently so their stacks shard evenly)."""
+        return max(g.r for g in self.groups)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -215,64 +288,22 @@ class FederatedEngine:
         t0 = time.perf_counter()
         now = time.time() - self._epoch
         if now >= REBASE_AFTER:
-            # shared-epoch rebase (see ClusterEngine.tick_once): shift the
-            # stacked time fields and every member's epoch together
+            # shared-epoch rebase (see ClusterEngine.tick_once): shift every
+            # group's stacked time fields and every member's epoch together
             self._epoch += now
             for e in self.engines:
                 e._epoch = self._epoch
-            for kind in ("nodes", "pods"):
-                self._stacked[kind] = rebase_times(self._stacked[kind], now)
+            for g in self.groups:
+                for kind in ("nodes", "pods"):
+                    g.stacked[kind] = rebase_times(g.stacked[kind], now)
             now = 0.0
         now_str = now_rfc3339()
-        r = self.cluster_capacity
-        any_rows = False
-        for kind in ("nodes", "pods"):
-            state = self._stacked[kind]
-            for c, e in enumerate(self.engines):
-                k = e.nodes if kind == "nodes" else e.pods
-                if k.buffer.pending:
-                    state = k.buffer.flush(state, offset=c * r)
-                    any_rows = True
-                elif len(k.pool):
-                    any_rows = True
-            self._stacked[kind] = state
-        if any_rows:
-            # with substeps, anchor the LAST scan step at wall-now
-            now_base = now - (self._fused.steps - 1) * self._fused.dt
-            (nout, pout), wire = self._fused(
-                (self._stacked["nodes"], self._stacked["pods"]), now_base
-            )
-            self._stacked["nodes"] = nout.state
-            self._stacked["pods"] = pout.state
-            cap = r * len(self.engines)
-            counters, masks_fn, dues = unpack_wire(np.asarray(wire), [cap, cap])
-            nd = float(dues.min())
-            self._idle_wake = (
-                None if nd == float("inf")
-                else time.monotonic() + max(0.0, nd - now)
-            )
-            masks = masks_fn() if counters.any() else None
-            for i, (kind, out) in enumerate((("nodes", nout), ("pods", pout))):
-                if not (int(counters[i]) or int(counters[2 + i])):
-                    continue
-                dirty, deleted, hb = masks[i]
-                phase = np.asarray(out.state.phase)
-                cond = np.asarray(out.state.cond_bits)
-                for c, e in enumerate(self.engines):
-                    k = e.nodes if kind == "nodes" else e.pods
-                    lo, hi = c * r, (c + 1) * r
-                    d_c, del_c, hb_c = dirty[lo:hi], deleted[lo:hi], hb[lo:hi]
-                    trans_c = int(
-                        np.count_nonzero(d_c) + np.count_nonzero(del_c)
-                    )
-                    if trans_c:
-                        e._inc("transitions_total", trans_c)
-                    if trans_c or hb_c.any():
-                        k.phase_h = phase[lo:hi].copy()
-                        k.cond_h = cond[lo:hi].copy()
-                        e._emit(kind, k, d_c, del_c, hb_c, now_str)
-        else:
-            self._idle_wake = None  # empty federation: sleep until events
+        wake: float | None = None
+        for g in self.groups:
+            due = self._tick_group(g, now, now_str)
+            if due is not None:
+                wake = due if wake is None else min(wake, due)
+        self._idle_wake = wake
         elapsed = time.perf_counter() - t0
         for e in self.engines:
             with e._metrics_lock:
@@ -282,33 +313,92 @@ class FederatedEngine:
                 e.metrics["nodes_managed"] = len(e.nodes.pool)
                 e.metrics["pods_managed"] = len(e.pods.pool)
 
+    def _tick_group(self, g: _Group, now: float, now_str: str) -> float | None:
+        """One fused dispatch for one rule-set group. Returns the monotonic
+        wake-up for the group's next device-scheduled event (None = none)."""
+        r = g.r
+        any_rows = False
+        for kind in ("nodes", "pods"):
+            state = g.stacked[kind]
+            for c, e in enumerate(g.engines):
+                k = e.nodes if kind == "nodes" else e.pods
+                if k.buffer.pending:
+                    state = k.buffer.flush(state, offset=c * r)
+                    any_rows = True
+                elif len(k.pool):
+                    any_rows = True
+            g.stacked[kind] = state
+        if not any_rows:
+            return None  # empty group: sleep until events
+        # with substeps, anchor the LAST scan step at wall-now
+        now_base = now - (g.fused.steps - 1) * g.fused.dt
+        (nout, pout), wire = g.fused(
+            (g.stacked["nodes"], g.stacked["pods"]), now_base
+        )
+        g.stacked["nodes"] = nout.state
+        g.stacked["pods"] = pout.state
+        cap = r * len(g.engines)
+        counters, masks_fn, dues = unpack_wire(np.asarray(wire), [cap, cap])
+        nd = float(dues.min())
+        wake = (
+            None if nd == float("inf")
+            else time.monotonic() + max(0.0, nd - now)
+        )
+        masks = masks_fn() if counters.any() else None
+        for i, (kind, out) in enumerate((("nodes", nout), ("pods", pout))):
+            if not (int(counters[i]) or int(counters[2 + i])):
+                continue
+            dirty, deleted, hb = masks[i]
+            phase = np.asarray(out.state.phase)
+            cond = np.asarray(out.state.cond_bits)
+            for c, e in enumerate(g.engines):
+                k = e.nodes if kind == "nodes" else e.pods
+                lo, hi = c * r, (c + 1) * r
+                d_c, del_c, hb_c = dirty[lo:hi], deleted[lo:hi], hb[lo:hi]
+                trans_c = int(
+                    np.count_nonzero(d_c) + np.count_nonzero(del_c)
+                )
+                if trans_c:
+                    e._inc("transitions_total", trans_c)
+                if trans_c or hb_c.any():
+                    k.phase_h = phase[lo:hi].copy()
+                    k.cond_h = cond[lo:hi].copy()
+                    e._emit(kind, k, d_c, del_c, hb_c, now_str)
+        return wake
+
     # ------------------------------------------------------------------ grow
 
     def _maybe_regrow(self) -> None:
         """If any member's pool grew (ClusterEngine._grow during ingest),
-        rebuild the stacked state at the new common per-cluster capacity."""
-        want = max(k.capacity for e in self.engines for k in (e.nodes, e.pods))
-        if want <= self.cluster_capacity:
-            return
-        n = len(self.engines)
+        rebuild that member's GROUP at the new common per-cluster capacity
+        (other groups keep their size — heterogeneous federations don't pay
+        for one member's growth)."""
         d = int(self.mesh.devices.size)
-        new_r = _pad_cluster_capacity(want, n, d)
-        old_r = self.cluster_capacity
-        logger.info("federation regrow: %d -> %d rows/cluster", old_r, new_r)
-        for e in self.engines:
-            for k in (e.nodes, e.pods):
-                if k.capacity < new_r:
-                    k.grow(new_r)
-        for kind in ("nodes", "pods"):
-            host = to_host(self._stacked[kind])
-            stacked = new_row_state(new_r * n)
-            for c in range(n):
-                for f in RowState._fields:
-                    getattr(stacked, f)[c * new_r : c * new_r + old_r] = getattr(
-                        host, f
-                    )[c * old_r : (c + 1) * old_r]
-            self._stacked[kind] = self._fused.place(stacked)
-        self.cluster_capacity = new_r
+        for g in self.groups:
+            want = max(k.capacity for e in g.engines for k in (e.nodes, e.pods))
+            if want <= g.r:
+                continue
+            n = len(g.engines)
+            new_r = _pad_cluster_capacity(want, n, d)
+            old_r = g.r
+            logger.info(
+                "federation regrow (%d-member group): %d -> %d rows/cluster",
+                n, old_r, new_r,
+            )
+            for e in g.engines:
+                for k in (e.nodes, e.pods):
+                    if k.capacity < new_r:
+                        k.grow(new_r)
+            for kind in ("nodes", "pods"):
+                host = to_host(g.stacked[kind])
+                stacked = new_row_state(new_r * n)
+                for c in range(n):
+                    for f in RowState._fields:
+                        getattr(stacked, f)[
+                            c * new_r : c * new_r + old_r
+                        ] = getattr(host, f)[c * old_r : (c + 1) * old_r]
+                g.stacked[kind] = g.fused.place(stacked)
+            g.r = new_r
 
     # --------------------------------------------------------------- metrics
 
