@@ -25,12 +25,17 @@ MEAN_SECONDS = 5.0  # per-phase dwell time; cycle = 2 phases
 DT = 0.5  # simulated seconds per tick
 TICKS = 120
 # Inner ticks per dispatch (MultiTickKernel steps): the tunneled device
-# charges ~70ms of round-trip latency per dispatch/fetch, so amortizing 10
+# charges ~70ms+ of round-trip latency per dispatch/fetch, so amortizing
 # simulated ticks into one dispatch keeps the benchmark measuring the
 # engine, not the tunnel. Counters stay exact; masks coalesce (see
-# ops/tick.py MultiTickKernel).
-STEPS = 10
-WARMUP = 5
+# ops/tick.py MultiTickKernel) — exactly what the engine's tick_substeps
+# production path emits. Measured on the tunneled v5e chip: steps 10 ->
+# 15.7M trans/s, 30 -> 24.3M, 60 -> 53.1M, 120 -> 85.7M (still
+# latency-bound); 240 risks the bench's time budget on compile.
+STEPS = int(__import__("os").environ.get("KWOK_BENCH_STEPS", "120"))
+# two warmup dispatches cover compile + the initial Pending->Running wave;
+# more only pays when dispatches are short (small STEPS)
+WARMUP = 5 if STEPS < 60 else 2
 REFERENCE_RATE = 100.0  # transitions/s, implied reference throughput
 
 
@@ -298,10 +303,16 @@ if __name__ == "__main__":
     _a = _p.parse_args()
     if os.environ.get("KWOK_BENCH_CPU_FALLBACK"):
         # a single CPU core cannot turn over 1M rows in a sane bench
-        # budget; the metric line reports the actual sizes + platform
+        # budget; the metric line reports the actual sizes + platform.
+        # STEPS too: per_window floors at 1 dispatch, so the TPU default of
+        # 120 fused steps would run 3*120 timed CPU ticks regardless of
+        # TICKS (large STEPS only pays where dispatch latency dominates)
         N_PODS = 250_000
         N_NODES = 2_500
         TICKS = 60
+        if "KWOK_BENCH_STEPS" not in os.environ:
+            STEPS = 10
+            WARMUP = 5
     if _a.mesh:
         mesh_main(_a.mesh, _a.pods, _a.ticks)
     else:

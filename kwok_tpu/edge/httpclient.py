@@ -308,11 +308,14 @@ class HttpKubeClient:
             "application/merge-patch+json",
         )
 
-    def delete(self, kind, namespace, name, grace_seconds: int = 0):
+    def delete(self, kind, namespace, name, grace_seconds: int | None = 0):
+        """grace_seconds=None omits DeleteOptions.gracePeriodSeconds so the
+        server applies its default (pods: spec.terminationGracePeriodSeconds
+        or 30, like the real apiserver)."""
         self._json(
             "DELETE",
             self._url(kind, namespace, name),
-            {"gracePeriodSeconds": grace_seconds},
+            None if grace_seconds is None else {"gracePeriodSeconds": grace_seconds},
         )
 
     def healthz(self) -> bool:

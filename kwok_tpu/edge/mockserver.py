@@ -344,12 +344,22 @@ class FakeKube:
         for w in watches:
             w.stop()
 
-    def delete(self, kind, namespace, name, grace_seconds: int = 0):
+    def delete(self, kind, namespace, name, grace_seconds: int | None = 0):
+        """grace_seconds=None applies the server default: for pods,
+        spec.terminationGracePeriodSeconds or 30 (real apiserver
+        DeleteOptions semantics); other kinds delete immediately."""
         with self._lock:
             key = self._key(namespace, name)
             obj = self._store[kind].get(key)
             if obj is None:
                 return
+            if grace_seconds is None:
+                grace_seconds = 0
+                if kind == "pods":
+                    tgps = (obj.get("spec") or {}).get(
+                        "terminationGracePeriodSeconds"
+                    )
+                    grace_seconds = int(tgps) if tgps is not None else 30
             meta = obj.setdefault("metadata", {})
             finalizers = meta.get("finalizers") or []
             if kind == "pods" and (grace_seconds > 0 or finalizers):
@@ -751,9 +761,10 @@ class HttpFakeApiserver:
                     self.send_error(404)
                     return
                 body = self._body() or {}
+                grace = body.get("gracePeriodSeconds")
                 store.delete(
                     m.group("kind"), m.group("ns"), m.group("name"),
-                    grace_seconds=int(body.get("gracePeriodSeconds") or 0),
+                    grace_seconds=None if grace is None else int(grace),
                 )
                 self._send_json({"kind": "Status", "status": "Success"})
 

@@ -56,6 +56,12 @@ def now_rfc3339() -> str:
     return rfc3339(None)
 
 
+def parse_rfc3339(ts: str) -> float:
+    """RFC3339 timestamp -> unix seconds (inverse of rfc3339; tolerates
+    fractional seconds and explicit offsets from real apiservers)."""
+    return datetime.datetime.fromisoformat(ts.replace("Z", "+00:00")).timestamp()
+
+
 def _cond_status(cond_bits: int, space: PhaseSpace, name: str) -> str:
     return "True" if (cond_bits >> space.condition_bit(name)) & 1 else "False"
 

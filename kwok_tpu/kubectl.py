@@ -1,0 +1,293 @@
+"""Minimal built-in kubectl (air-gapped fallback for the kubectl verb).
+
+kwokctl's `kubectl` verb is a passthrough to a real kubectl binary, found
+on PATH or downloaded on first use (reference: pkg/kwokctl/cmd/kubectl.go;
+pkg/kwokctl/runtime/cluster.go kubectlPath download-or-find). In
+zero-egress environments (this build's CI, the all-in-one image) neither
+exists, so the base runtime falls back to this shim: enough of kubectl's
+surface for the reference's e2e assertions (get / apply / delete /
+get --raw) against any apiserver this framework speaks to.
+
+Deliberately NOT a full kubectl: printers are table/json/name only, no
+server-side apply, no openapi validation, no exec/logs (the reference
+snapshot's fake pods have no streaming endpoints either).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from kwok_tpu.edge.httpclient import HttpKubeClient
+from kwok_tpu.edge.render import parse_rfc3339
+
+# canonical kind -> (aliases, namespaced)
+_KINDS: dict[str, tuple[tuple[str, ...], bool]] = {
+    "nodes": (("node", "no"), False),
+    "pods": (("pod", "po"), True),
+    "roles": (("role",), True),
+    "rolebindings": (("rolebinding",), True),
+    "clusterroles": (("clusterrole",), False),
+    "clusterrolebindings": (("clusterrolebinding",), False),
+}
+_ALIASES = {
+    alias: kind
+    for kind, (aliases, _) in _KINDS.items()
+    for alias in (kind, *aliases)
+}
+
+
+def _resolve_kind(word: str) -> str:
+    kind = _ALIASES.get(word.lower())
+    if kind is None:
+        raise SystemExit(f'error: the server doesn\'t have a resource type "{word}"')
+    return kind
+
+
+def _is_namespaced(kind: str) -> bool:
+    return _KINDS[kind][1]
+
+
+def _age(obj: dict) -> str:
+    ts = (obj.get("metadata") or {}).get("creationTimestamp")
+    if not ts:
+        return "<unknown>"
+    try:
+        secs = max(0, int(time.time() - parse_rfc3339(ts)))
+    except (ValueError, TypeError):
+        return "<unknown>"
+    for div, unit in ((86400, "d"), (3600, "h"), (60, "m")):
+        if secs >= div:
+            return f"{secs // div}{unit}"
+    return f"{secs}s"
+
+
+def _node_row(o: dict) -> list[str]:
+    conds = {
+        c.get("type"): c.get("status")
+        for c in (o.get("status") or {}).get("conditions") or []
+    }
+    status = "Ready" if conds.get("Ready") == "True" else "NotReady"
+    return [o["metadata"]["name"], status, _age(o)]
+
+
+def _pod_row(o: dict) -> list[str]:
+    st = o.get("status") or {}
+    cs = st.get("containerStatuses") or []
+    total = len(cs) or len((o.get("spec") or {}).get("containers") or [])
+    ready = sum(1 for c in cs if c.get("ready"))
+    phase = st.get("phase") or "Unknown"
+    if (o.get("metadata") or {}).get("deletionTimestamp"):
+        phase = "Terminating"
+    return [o["metadata"]["name"], f"{ready}/{total}", phase, _age(o)]
+
+
+def _print_table(kind: str, objs: list[dict], *, all_namespaces: bool,
+                 no_headers: bool, out=None) -> None:
+    out = out if out is not None else sys.stdout
+    if kind == "nodes":
+        headers, row = ["NAME", "STATUS", "AGE"], _node_row
+    elif kind == "pods":
+        headers, row = ["NAME", "READY", "STATUS", "AGE"], _pod_row
+    else:
+        headers, row = ["NAME", "AGE"], lambda o: [o["metadata"]["name"], _age(o)]
+    if all_namespaces and _is_namespaced(kind):
+        headers = ["NAMESPACE", *headers]
+        inner = row
+        row = lambda o: [(o["metadata"].get("namespace") or ""), *inner(o)]  # noqa: E731
+    rows = [row(o) for o in objs]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    lines = [] if no_headers else [headers]
+    lines += rows
+    for cells in lines:
+        print(
+            "   ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip(),
+            file=out,
+        )
+
+
+def _singular(kind: str) -> str:
+    return _KINDS[kind][0][0]
+
+
+def _load_docs(path: str) -> list[dict]:
+    import yaml
+
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    return [d for d in yaml.safe_load_all(text) if d]
+
+
+_KIND_TO_PLURAL = {
+    "Node": "nodes",
+    "Pod": "pods",
+    "Role": "roles",
+    "RoleBinding": "rolebindings",
+    "ClusterRole": "clusterroles",
+    "ClusterRoleBinding": "clusterrolebindings",
+}
+
+
+def _doc_target(doc: dict) -> tuple[str, str | None, str]:
+    kind = _KIND_TO_PLURAL.get(doc.get("kind") or "")
+    if kind is None:
+        raise SystemExit(f"error: unsupported kind in document: {doc.get('kind')}")
+    meta = doc.get("metadata") or {}
+    ns = meta.get("namespace") or ("default" if _is_namespaced(kind) else None)
+    name = meta.get("name")
+    if not name:
+        raise SystemExit("error: document has no metadata.name")
+    return kind, ns, name
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="kubectl", add_help=True)
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("-s", "--server", default=None)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("args", nargs="*", help="KIND[,KIND...] [NAME]")
+    g.add_argument("--raw", default=None, help="raw URI GET")
+    g.add_argument("-n", "--namespace", default=None)
+    g.add_argument("-A", "--all-namespaces", action="store_true")
+    g.add_argument("-o", "--output", default="",
+                   choices=["", "json", "name"])
+    g.add_argument("--no-headers", action="store_true")
+
+    a = sub.add_parser("apply")
+    a.add_argument("-f", "--filename", required=True)
+    c = sub.add_parser("create")
+    c.add_argument("-f", "--filename", required=True)
+
+    d = sub.add_parser("delete")
+    d.add_argument("args", nargs="*", help="KIND NAME | -f FILE")
+    d.add_argument("-f", "--filename", default=None)
+    d.add_argument("-n", "--namespace", default=None)
+    # None = omit DeleteOptions.gracePeriodSeconds (server-side default,
+    # like real kubectl); 0 = force delete
+    d.add_argument("--grace-period", type=int, default=None)
+
+    v = sub.add_parser("version")
+    v.add_argument("--client", action="store_true")
+
+    args = p.parse_args(argv)
+
+    if args.verb == "version":
+        print("kwok-tpu built-in kubectl (air-gapped fallback shim)")
+        return 0
+
+    client = HttpKubeClient.from_kubeconfig(args.kubeconfig, master=args.server)
+    try:
+        return _run(args, client)
+    finally:
+        client.close()
+
+
+def _run(args, client: HttpKubeClient) -> int:
+    if args.verb == "get":
+        if args.raw:
+            # client._request applies the TLS context, CA, client cert and
+            # bearer token from the kubeconfig (a bare urlopen would fail
+            # against self-signed secure clusters)
+            with client._request("GET", client.server + args.raw) as r:
+                sys.stdout.write(r.read().decode())
+            return 0
+        if not args.args:
+            raise SystemExit("error: you must specify the type of resource to get")
+        kinds = [_resolve_kind(k) for k in args.args[0].split(",")]
+        name = args.args[1] if len(args.args) > 1 else None
+        if name and len(kinds) > 1:
+            raise SystemExit("error: a resource name cannot combine with "
+                             "multiple resource types")
+        any_found = False
+        for kind in kinds:
+            ns = args.namespace or ("default" if _is_namespaced(kind) else None)
+            if name:
+                obj = client.get(kind, ns, name)
+                if obj is None:
+                    print(
+                        f'Error from server (NotFound): {_singular(kind)} '
+                        f'"{name}" not found',
+                        file=sys.stderr,
+                    )
+                    return 1
+                objs = [obj]
+            else:
+                objs = client.list(kind)
+                if _is_namespaced(kind) and not args.all_namespaces:
+                    objs = [
+                        o for o in objs
+                        if (o["metadata"].get("namespace") or "default") == ns
+                    ]
+            if not objs:
+                continue
+            any_found = True
+            if args.output == "json":
+                doc = objs[0] if name else {
+                    "kind": "List", "apiVersion": "v1", "items": objs
+                }
+                json.dump(doc, sys.stdout, indent=2)
+                print()
+            elif args.output == "name":
+                for o in objs:
+                    print(f"{_singular(kind)}/{o['metadata']['name']}")
+            else:
+                _print_table(
+                    kind, objs,
+                    all_namespaces=args.all_namespaces,
+                    no_headers=args.no_headers,
+                )
+        if not any_found:
+            print("No resources found", file=sys.stderr)
+        return 0
+
+    if args.verb in ("apply", "create"):
+        for doc in _load_docs(args.filename):
+            kind, ns, name = _doc_target(doc)
+            existing = client.get(kind, ns, name)
+            if existing is None:
+                client.create(kind, doc, namespace=ns)
+                print(f"{_singular(kind)}/{name} created")
+            elif args.verb == "create":
+                print(
+                    f'Error from server (AlreadyExists): {_singular(kind)} '
+                    f'"{name}" already exists',
+                    file=sys.stderr,
+                )
+                return 1
+            else:
+                # kubectl apply updates the client-owned sections; the mock
+                # servers' merge-patch on metadata+spec models that (status
+                # stays the kubelet's/engine's)
+                client.patch_meta(
+                    kind, ns, name,
+                    {k: doc[k] for k in ("metadata", "spec") if k in doc},
+                )
+                print(f"{_singular(kind)}/{name} configured")
+        return 0
+
+    if args.verb == "delete":
+        targets: list[tuple[str, str | None, str]] = []
+        if args.filename:
+            targets = [_doc_target(d) for d in _load_docs(args.filename)]
+        elif len(args.args) >= 2:
+            kind = _resolve_kind(args.args[0])
+            ns = args.namespace or ("default" if _is_namespaced(kind) else None)
+            targets = [(kind, ns, n) for n in args.args[1:]]
+        else:
+            raise SystemExit("error: specify KIND NAME or -f FILE")
+        for kind, ns, name in targets:
+            client.delete(kind, ns, name, grace_seconds=args.grace_period)
+            print(f'{_singular(kind)} "{name}" deleted')
+        return 0
+
+    raise SystemExit(f"error: unknown verb {args.verb}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,6 +10,7 @@ kubectl), WaitReady polling (:184-207), kubectl passthrough and log access.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import ssl
@@ -20,6 +21,8 @@ import urllib.request
 from kwok_tpu.config.ctl import Component, KwokctlConfiguration
 from kwok_tpu.config.types import load_documents, save_documents, first_of
 from kwok_tpu.kwokctl import procutil
+
+logger = logging.getLogger("kwok_tpu.kwokctl")
 
 CONFIG_NAME = "kwok.yaml"
 IN_HOST_KUBECONFIG_NAME = "kubeconfig.yaml"
@@ -164,7 +167,10 @@ class Cluster:
 
     def kubectl_path(self) -> str:
         """PATH kubectl, else download into the workdir on first use
-        (cluster.go kubectlPath download-or-find)."""
+        (cluster.go kubectlPath download-or-find); in zero-egress
+        environments the download cannot succeed, so fall back to the
+        built-in shim (kwok_tpu/kubectl.py) rather than leaving the
+        kubectl verb dead."""
         found = shutil.which("kubectl")
         if found:
             return found
@@ -173,10 +179,41 @@ class Cluster:
             from kwok_tpu.kwokctl import download
 
             conf = self.config().options
-            download.download_with_cache(
-                conf.cacheDir, conf.kubectlBinary, path, quiet=conf.quietPull
-            )
+            try:
+                download.download_with_cache(
+                    conf.cacheDir, conf.kubectlBinary, path, quiet=conf.quietPull
+                )
+            except Exception as e:
+                logger.info(
+                    "kubectl download failed (%s); using the built-in shim", e
+                )
+                self._write_builtin_kubectl(path)
         return path
+
+    def _write_builtin_kubectl(self, path: str) -> None:
+        import stat
+        import sys
+
+        repo_paths = [p for p in sys.path if p]
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # two files: the python entry plus a /bin/sh wrapper — a direct
+        # `#!{python}` shebang truncates at the first space in the
+        # interpreter path (venvs under spaced dirs)
+        impl = path + "-builtin.py"
+        with open(impl, "w") as f:
+            f.write(
+                "# generated built-in kubectl shim (kwok_tpu air-gapped fallback)\n"
+                "import sys\n"
+                f"sys.path[:0] = {repo_paths!r}\n"
+                "from kwok_tpu.kubectl import main\n"
+                "sys.exit(main(sys.argv[1:]))\n"
+            )
+        with open(path, "w") as f:
+            f.write(
+                "#!/bin/sh\n"
+                f'exec "{sys.executable}" "{impl}" "$@"\n'
+            )
+        os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC | stat.S_IXGRP | stat.S_IXOTH)
 
     def etcdctl_path(self) -> str:
         """Workdir etcdctl, extracted from the etcd release tar on first use

@@ -1559,17 +1559,33 @@ bool App::handle_request(int fd, Request& req) {
 
   if (req.method == "DELETE") {
     long grace = 0;
+    bool grace_given = false;
     if (!req.body.empty()) {
       JParser p(req.body);
       JVal b = p.parse();
       const JVal* g = b.is_obj() ? b.find("gracePeriodSeconds") : nullptr;
-      if (g && g->type == JVal::NUM) grace = atol(g->s.c_str());
+      if (g && g->type == JVal::NUM) {
+        grace = atol(g->s.c_str());
+        grace_given = true;
+      }
     }
     {
       std::lock_guard<std::mutex> lk(store.mu);
       auto it = store.kinds[m.kind].find(key);
       if (it != store.kinds[m.kind].end()) {
         JVal obj = it->second->obj;  // copy-on-write
+        if (!grace_given && m.kind == 1) {
+          // DeleteOptions omitted: server default for pods is
+          // spec.terminationGracePeriodSeconds or 30 (mirrors
+          // mockserver.py FakeKube.delete)
+          grace = 30;
+          const JVal* spec = obj.find("spec");
+          const JVal* tg =
+              spec && spec->is_obj()
+                  ? spec->find("terminationGracePeriodSeconds")
+                  : nullptr;
+          if (tg && tg->type == JVal::NUM) grace = atol(tg->s.c_str());
+        }
         JVal& meta = obj.get_or_insert_obj("metadata");
         const JVal* fins = meta.find("finalizers");
         bool has_fins =

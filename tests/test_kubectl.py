@@ -1,0 +1,203 @@
+"""The built-in kubectl shim (kwok_tpu/kubectl.py): the air-gapped
+fallback for kwokctl's kubectl verb (reference: pkg/kwokctl/cmd/kubectl.go
+passthrough + runtime/cluster.go download-or-find)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kwok_tpu.edge.mockserver import FakeKube, HttpFakeApiserver, seed_bootstrap_rbac
+from kwok_tpu.kubectl import main
+from tests.test_engine import make_node, make_pod
+
+
+@pytest.fixture
+def srv():
+    store = FakeKube()
+    seed_bootstrap_rbac(store)
+    s = HttpFakeApiserver(store=store).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def kubeconfig(srv, tmp_path):
+    p = tmp_path / "kubeconfig.yaml"
+    p.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: t\n"
+        "contexts:\n  - name: t\n    context:\n      cluster: t\n"
+        f"clusters:\n  - name: t\n    cluster:\n      server: {srv.url}\n"
+    )
+    return str(p)
+
+
+def kubectl(kubeconfig, *args, capsys=None):
+    rc = main(["--kubeconfig", kubeconfig, *args])
+    return rc
+
+
+def test_get_nodes_table(srv, kubeconfig, capsys):
+    srv.store.create("nodes", make_node("n1"))
+    srv.store.patch_status(
+        "nodes", None, "n1",
+        {"status": {"conditions": [{"type": "Ready", "status": "True"}]}},
+    )
+    srv.store.create("nodes", make_node("n2"))
+    assert kubectl(kubeconfig, "get", "nodes") == 0
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert out[0].split() == ["NAME", "STATUS", "AGE"]
+    rows = {line.split()[0]: line.split()[1] for line in out[1:]}
+    assert rows == {"n1": "Ready", "n2": "NotReady"}
+
+
+def test_get_pods_ready_and_phase(srv, kubeconfig, capsys):
+    srv.store.create("pods", make_pod("p1", node="n"))
+    srv.store.patch_status(
+        "pods", "default", "p1",
+        {"status": {"phase": "Running",
+                    "containerStatuses": [{"name": "c", "ready": True}]}},
+    )
+    assert kubectl(kubeconfig, "get", "pods") == 0
+    out = capsys.readouterr().out.splitlines()
+    assert out[0].split() == ["NAME", "READY", "STATUS", "AGE"]
+    assert out[1].split()[:3] == ["p1", "1/1", "Running"]
+
+
+def test_get_output_json_and_name(srv, kubeconfig, capsys):
+    srv.store.create("nodes", make_node("n1"))
+    assert kubectl(kubeconfig, "get", "nodes", "-o", "json") == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["kind"] == "List"
+    assert [o["metadata"]["name"] for o in doc["items"]] == ["n1"]
+    assert kubectl(kubeconfig, "get", "node", "n1", "-o", "json") == 0
+    assert json.loads(capsys.readouterr().out)["metadata"]["name"] == "n1"
+    assert kubectl(kubeconfig, "get", "nodes", "-o", "name") == 0
+    assert capsys.readouterr().out.strip() == "node/n1"
+
+
+def test_get_comma_kinds_all_namespaces(srv, kubeconfig, capsys):
+    """The reference's authorization assertion: `kubectl get
+    role,rolebinding,clusterrole,clusterrolebinding -A` is non-empty."""
+    assert kubectl(
+        kubeconfig, "get",
+        "role,rolebinding,clusterrole,clusterrolebinding", "-A",
+    ) == 0
+    out = capsys.readouterr().out
+    assert "cluster-admin" in out
+    assert "extension-apiserver-authentication-reader" in out
+    # namespaced listings with -A grow a NAMESPACE column
+    assert "kube-system" in out
+
+
+def test_get_missing_is_error(srv, kubeconfig, capsys):
+    assert kubectl(kubeconfig, "get", "node", "nope") == 1
+    assert "NotFound" in capsys.readouterr().err
+
+
+def test_apply_create_configure_delete(srv, kubeconfig, tmp_path, capsys):
+    f = tmp_path / "obj.yaml"
+    f.write_text(
+        "apiVersion: v1\nkind: Node\nmetadata:\n  name: a1\n"
+        "---\n"
+        "apiVersion: v1\nkind: Pod\nmetadata:\n  name: ap1\n"
+        "spec:\n  nodeName: a1\n  containers:\n    - name: c\n"
+    )
+    assert kubectl(kubeconfig, "apply", "-f", str(f)) == 0
+    out = capsys.readouterr().out
+    assert "node/a1 created" in out and "pod/ap1 created" in out
+    assert srv.store.get("pods", "default", "ap1") is not None
+
+    # re-apply with a label: configured, label lands, spec preserved
+    f.write_text(
+        "apiVersion: v1\nkind: Node\nmetadata:\n  name: a1\n"
+        "  labels:\n    tier: fake\n"
+    )
+    assert kubectl(kubeconfig, "apply", "-f", str(f)) == 0
+    assert "node/a1 configured" in capsys.readouterr().out
+    assert srv.store.get("nodes", None, "a1")["metadata"]["labels"] == {
+        "tier": "fake"
+    }
+
+    # create on an existing object is AlreadyExists
+    assert kubectl(kubeconfig, "create", "-f", str(f)) == 1
+    assert "AlreadyExists" in capsys.readouterr().err
+
+    assert kubectl(kubeconfig, "delete", "node", "a1") == 0
+    assert 'node "a1" deleted' in capsys.readouterr().out
+    assert srv.store.get("nodes", None, "a1") is None
+
+
+def test_delete_pod_defaults_to_graceful(srv, kubeconfig, capsys):
+    """No --grace-period -> DeleteOptions omitted -> server default grace
+    (pods: terminationGracePeriodSeconds or 30): the pod enters Terminating
+    for the engine to finalize, exactly like real kubectl against a real
+    apiserver. --grace-period=0 force-deletes."""
+    srv.store.create("pods", make_pod("gp", node="n"))
+    assert kubectl(kubeconfig, "delete", "pod", "gp") == 0
+    obj = srv.store.get("pods", "default", "gp")
+    assert obj is not None, "graceful delete removed the pod immediately"
+    assert obj["metadata"].get("deletionTimestamp")
+    assert obj["metadata"]["deletionGracePeriodSeconds"] == 30
+
+    srv.store.create("pods", make_pod("gp0", node="n"))
+    assert kubectl(kubeconfig, "delete", "pod", "gp0", "--grace-period", "0") == 0
+    assert srv.store.get("pods", "default", "gp0") is None
+    capsys.readouterr()
+
+
+def test_get_raw(srv, kubeconfig, capsys):
+    assert kubectl(kubeconfig, "get", "--raw", "/healthz") == 0
+    assert capsys.readouterr().out == "ok"
+
+
+def test_bearer_token_from_kubeconfig(tmp_path, capsys):
+    """A token-auth'd server: the shim authenticates via the kubeconfig
+    exactly like the engine does."""
+    store = FakeKube()
+    store.create("nodes", make_node("n1"))
+    s = HttpFakeApiserver(store=store, token="tok123").start()
+    try:
+        p = tmp_path / "kc.yaml"
+        p.write_text(
+            "apiVersion: v1\nkind: Config\ncurrent-context: t\n"
+            "contexts:\n  - name: t\n    context:\n      cluster: t\n"
+            "      user: t\n"
+            f"clusters:\n  - name: t\n    cluster:\n      server: {s.url}\n"
+            "users:\n  - name: t\n    user:\n      token: tok123\n"
+        )
+        assert main(["--kubeconfig", str(p), "get", "nodes", "-o", "name"]) == 0
+        assert capsys.readouterr().out.strip() == "node/n1"
+    finally:
+        s.stop()
+
+
+def test_runtime_falls_back_to_builtin_shim(tmp_path, monkeypatch):
+    """base.kubectl_path: no PATH kubectl + failed download -> generated
+    shim that execs kwok_tpu.kubectl."""
+    import subprocess
+
+    from kwok_tpu.config.ctl import KwokctlConfiguration, KwokctlConfigurationOptions
+    from kwok_tpu.kwokctl import download
+    from kwok_tpu.kwokctl.runtime.mock import MockCluster
+
+    monkeypatch.setenv("KWOK_WORKDIR", str(tmp_path))
+    monkeypatch.setenv("PATH", "/nonexistent")  # hide any real kubectl
+
+    def _no_download(*a, **k):
+        raise OSError("no egress in this test")
+
+    # the fallback must not depend on this machine actually lacking egress
+    monkeypatch.setattr(download, "download_with_cache", _no_download)
+    cluster = MockCluster("shimtest", str(tmp_path / "shimtest"))
+    cluster.set_config(
+        KwokctlConfiguration(options=KwokctlConfigurationOptions(runtime="mock"))
+    )
+    path = cluster.kubectl_path()
+    assert path.endswith("kubectl")
+    out = subprocess.run(
+        [path, "version", "--client"], capture_output=True, text=True
+    )
+    assert out.returncode == 0
+    assert "built-in kubectl" in out.stdout
