@@ -15,6 +15,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -32,7 +33,7 @@ TICKS = 120
 # production path emits. Measured on the tunneled v5e chip: steps 10 ->
 # 15.7M trans/s, 30 -> 24.3M, 60 -> 53.1M, 120 -> 85.7M (still
 # latency-bound); 240 risks the bench's time budget on compile.
-STEPS = int(__import__("os").environ.get("KWOK_BENCH_STEPS", "120"))
+STEPS = int(os.environ.get("KWOK_BENCH_STEPS", "120"))
 # two warmup dispatches cover compile + the initial Pending->Running wave;
 # more only pays when dispatches are short (small STEPS)
 WARMUP = 5 if STEPS < 60 else 2
@@ -262,7 +263,6 @@ def _device_reachable(timeout_s: float = 120.0) -> bool:
     """Probe jax.devices() in a subprocess: the tunneled TPU plugin can hang
     indefinitely when the relay is down, and a benchmark that never prints
     its JSON line is worse than an honestly-labeled CPU number."""
-    import os
     import subprocess
     import sys
 
@@ -288,7 +288,6 @@ def _device_reachable(timeout_s: float = 120.0) -> bool:
 
 if __name__ == "__main__":
     import argparse
-    import os
     import sys
 
     _p = argparse.ArgumentParser()

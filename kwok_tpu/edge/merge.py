@@ -89,17 +89,24 @@ def strategic_merge(original: Any, patch: Any, merge_keys: dict[str, str] | None
 def _merge_value(
     orig: Any, patch: Any, mk: dict[str, str], field: str | None, *, copies: bool = True
 ) -> Any:
-    cp = copy.deepcopy if copies else (lambda x: x)
-    if isinstance(patch, dict) and isinstance(orig, dict):
-        directive = patch.get(_DIRECTIVE)
-        if directive == "replace":
-            return {
-                k: _sanitize(v, mk, k, copies=copies)
-                for k, v in patch.items()
-                if k != _DIRECTIVE and v is not None
-            }
-        if directive == "delete":
-            return {}
+    """Directive-free traffic (everything the engine itself renders and
+    ingests) stays on fast paths: the $patch machinery and the sanitizing
+    rebuild only engage when a directive/null is actually present. This
+    runs per watch event in the no-op-suppression check, so the common
+    case must not pay for the rare one."""
+    if isinstance(patch, dict):
+        if not isinstance(orig, dict):
+            return _sanitize(patch, mk, field, copies=copies)
+        if _DIRECTIVE in patch:
+            directive = patch[_DIRECTIVE]
+            if directive == "replace":
+                return {
+                    k: _sanitize(v, mk, k, copies=copies)
+                    for k, v in patch.items()
+                    if k != _DIRECTIVE and v is not None
+                }
+            if directive == "delete":
+                return {}
         out = dict(orig)
         for k, v in patch.items():
             if k == _DIRECTIVE:
@@ -111,23 +118,26 @@ def _merge_value(
             else:
                 out[k] = _sanitize(v, mk, k, copies=copies)
         return out
-    if isinstance(patch, list) and isinstance(orig, list) and field in mk:
-        key = mk[field]
+    if isinstance(patch, list):
+        if isinstance(orig, list) and field in mk:
+            return _merge_keyed_list(orig, patch, mk, mk[field], copies)
+        # atomic-list replacement / type mismatch: sanitized like
+        # missing-key insertions
+        return _sanitize(patch, mk, field, copies=copies)
+    return copy.deepcopy(patch) if copies else patch  # scalar leaf
+
+
+def _merge_keyed_list(
+    orig: list, patch: list, mk: dict[str, str], key: str, copies: bool
+) -> list:
+    cp = copy.deepcopy if copies else (lambda x: x)
+    if any(_has_directive(it) for it in patch):
         if any(_has_directive(it) and it[_DIRECTIVE] == "replace" for it in patch):
             return [
                 _sanitize(it, mk, None, copies=copies)
                 for it in patch
                 if not _has_directive(it)
             ]
-        def build_index(lst):
-            # only string merge keys participate in matching (k8s merge keys
-            # are always strings); first match wins on (malformed) duplicates
-            idx: dict[Any, int] = {}
-            for i, x in enumerate(lst):
-                if isinstance(x, dict) and isinstance(x.get(key), str) and x[key] not in idx:
-                    idx[x[key]] = i
-            return idx
-
         # strategicpatch applies every $patch:delete to the ORIGINAL before
         # merging any non-directive element, so a delete never removes an
         # element the same patch adds
@@ -138,8 +148,8 @@ def _merge_value(
             and it[_DIRECTIVE] == "delete"
             and isinstance(it.get(key), str)
         }
-        out_list = [
-            cp(x)
+        orig = [
+            x
             for x in orig
             if not (
                 isinstance(x, dict)
@@ -147,28 +157,26 @@ def _merge_value(
                 and x[key] in deleted
             )
         ]
-        index = build_index(out_list)
-        for item in patch:
-            if _has_directive(item):
-                continue  # deletes pre-applied; unknown directives dropped
-            if (
-                isinstance(item, dict)
-                and isinstance(item.get(key), str)
-                and item[key] in index
-            ):
-                i = index[item[key]]
-                out_list[i] = _merge_value(
-                    out_list[i], item, mk, field=None, copies=copies
-                )
-            else:
-                out_list.append(_sanitize(item, mk, None, copies=copies))
-                if isinstance(item, dict) and isinstance(item.get(key), str):
-                    index[item[key]] = len(out_list) - 1
-        return out_list
-    # type-mismatch / scalar / atomic-list replacement: the patch value
-    # stands alone, so new dict/merge-list subtrees are sanitized the same
-    # way missing-key insertions are
-    return _sanitize(patch, mk, field, copies=copies)
+        patch = [it for it in patch if not _has_directive(it)]
+    out_list = [cp(x) for x in orig] if copies else list(orig)
+    # only string merge keys participate in matching (k8s merge keys are
+    # always strings); first match wins on (malformed) duplicates
+    index: dict[str, int] = {}
+    for i, x in enumerate(out_list):
+        if isinstance(x, dict):
+            kv = x.get(key)
+            if isinstance(kv, str) and kv not in index:
+                index[kv] = i
+    for item in patch:
+        kv = item.get(key) if isinstance(item, dict) else None
+        if isinstance(kv, str) and kv in index:
+            i = index[kv]
+            out_list[i] = _merge_value(out_list[i], item, mk, field=None, copies=copies)
+        else:
+            out_list.append(_sanitize(item, mk, None, copies=copies))
+            if isinstance(kv, str):
+                index[kv] = len(out_list) - 1
+    return out_list
 
 
 def _merge_view(orig: Any, patch: Any, mk: dict[str, str], field: str | None) -> Any:

@@ -204,7 +204,7 @@ def _run(args, client: HttpKubeClient) -> int:
         if name and len(kinds) > 1:
             raise SystemExit("error: a resource name cannot combine with "
                              "multiple resource types")
-        any_found = False
+        per_kind: list[tuple[str, list[dict]]] = []
         for kind in kinds:
             ns = args.namespace or ("default" if _is_namespaced(kind) else None)
             if name:
@@ -224,25 +224,29 @@ def _run(args, client: HttpKubeClient) -> int:
                         o for o in objs
                         if (o["metadata"].get("namespace") or "default") == ns
                     ]
-            if not objs:
-                continue
-            any_found = True
-            if args.output == "json":
-                doc = objs[0] if name else {
-                    "kind": "List", "apiVersion": "v1", "items": objs
-                }
-                json.dump(doc, sys.stdout, indent=2)
-                print()
-            elif args.output == "name":
+            if objs:
+                per_kind.append((kind, objs))
+        if args.output == "json":
+            # one parseable document even across comma-separated kinds
+            # (real kubectl merges everything into a single v1 List)
+            items = [o for _, objs in per_kind for o in objs]
+            doc = items[0] if name else {
+                "kind": "List", "apiVersion": "v1", "items": items
+            }
+            json.dump(doc, sys.stdout, indent=2)
+            print()
+        elif args.output == "name":
+            for kind, objs in per_kind:
                 for o in objs:
                     print(f"{_singular(kind)}/{o['metadata']['name']}")
-            else:
+        else:
+            for kind, objs in per_kind:
                 _print_table(
                     kind, objs,
                     all_namespaces=args.all_namespaces,
                     no_headers=args.no_headers,
                 )
-        if not any_found:
+        if not per_kind:
             print("No resources found", file=sys.stderr)
         return 0
 
@@ -282,6 +286,13 @@ def _run(args, client: HttpKubeClient) -> int:
         else:
             raise SystemExit("error: specify KIND NAME or -f FILE")
         for kind, ns, name in targets:
+            if client.get(kind, ns, name) is None:
+                print(
+                    f'Error from server (NotFound): {_singular(kind)} '
+                    f'"{name}" not found',
+                    file=sys.stderr,
+                )
+                return 1
             client.delete(kind, ns, name, grace_seconds=args.grace_period)
             print(f'{_singular(kind)} "{name}" deleted')
         return 0

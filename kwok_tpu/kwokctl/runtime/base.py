@@ -184,11 +184,19 @@ class Cluster:
                     conf.cacheDir, conf.kubectlBinary, path, quiet=conf.quietPull
                 )
             except Exception as e:
-                logger.info(
+                if not self.KUBECTL_SHIM_OK:
+                    # e.g. kind drives kubectl with config/--context/cordon,
+                    # which the shim does not speak — surface the real error
+                    raise
+                logger.warning(
                     "kubectl download failed (%s); using the built-in shim", e
                 )
                 self._write_builtin_kubectl(path)
         return path
+
+    # runtimes whose kubectl usage goes beyond the built-in shim's surface
+    # (kwok_tpu/kubectl.py) opt out and let download failures propagate
+    KUBECTL_SHIM_OK = True
 
     def _write_builtin_kubectl(self, path: str) -> None:
         import stat

@@ -304,6 +304,9 @@ spec:
 
 class KindCluster(Cluster):
     RUNTIME = consts.RUNTIME_TYPE_KIND
+    # kind drives kubectl with config view/--context/cordon — beyond the
+    # built-in shim's surface, so kubectl download failures must propagate
+    KUBECTL_SHIM_OK = False
 
     # --- helpers ----------------------------------------------------------
 
