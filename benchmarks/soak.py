@@ -205,10 +205,8 @@ def _load_worker_entry() -> None:
             "status": {"phase": "Pending"},
         })
         if bind == "1":
-            client.patch_meta(
-                "pods", "default", f"soak-pod-{i}",
-                {"spec": {"nodeName": f"soak-node-{i % nodes}"}},
-            )
+            # bind the way the real scheduler does: POST .../binding
+            client.bind("default", f"soak-pod-{i}", f"soak-node-{i % nodes}")
 
     list(pool.map(one, range(lo, hi)))
 

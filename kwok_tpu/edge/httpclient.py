@@ -318,6 +318,19 @@ class HttpKubeClient:
             None if grace_seconds is None else {"gracePeriodSeconds": grace_seconds},
         )
 
+    def bind(self, namespace, name, node: str):
+        """POST pods/NAME/binding — the kube-scheduler's bind call."""
+        return self._json(
+            "POST",
+            self._url("pods", namespace, name, subresource="binding"),
+            {
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": name, "namespace": namespace},
+                "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+            },
+        )
+
     def healthz(self) -> bool:
         try:
             with self._request("GET", self.server + "/healthz") as resp:

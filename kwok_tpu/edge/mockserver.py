@@ -38,6 +38,10 @@ from kwok_tpu.edge.render import now_rfc3339
 from kwok_tpu.edge.selectors import parse_selector
 
 
+class BindConflict(Exception):
+    """pods/binding on an already-bound pod (HTTP 409)."""
+
+
 class _Watch:
     def __init__(self, server: "FakeKube", kind: str, field_selector, label_selector):
         self.server = server
@@ -70,7 +74,10 @@ class _Watch:
 
 # core/v1 kinds plus the rbac.authorization.k8s.io/v1 group served when the
 # cluster runs with --kube-authorization (reference: kube-apiserver
-# --authorization-mode=Node,RBAC, components/kube_apiserver.go:78-151)
+# --authorization-mode=Node,RBAC, components/kube_apiserver.go:78-151).
+# "events" exists so a real kube-scheduler's event POSTs land instead of
+# 404ing (the mock is the stand-in for the real apiserver the reference's
+# e2e drives a real scheduler against).
 KINDS = (
     "nodes",
     "pods",
@@ -78,6 +85,7 @@ KINDS = (
     "rolebindings",
     "clusterroles",
     "clusterrolebindings",
+    "events",
 )
 
 
@@ -132,6 +140,19 @@ class FakeKube:
     def _create_locked(self, kind: str, obj: dict):
         obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
+        if "name" not in meta and meta.get("generateName"):
+            # apiserver names.go semantics: generateName + 5-char random
+            # suffix (kube-scheduler POSTs events this way). The real
+            # apiserver 409s on a suffix collision and the client retries;
+            # retrying server-side is equivalent and can't silently
+            # overwrite an existing object.
+            import secrets
+
+            while True:
+                name = meta["generateName"] + secrets.token_hex(3)[:5]
+                if self._key(meta.get("namespace"), name) not in self._store[kind]:
+                    break
+            meta["name"] = name
         meta.setdefault("creationTimestamp", now_rfc3339())
         meta.setdefault("uid", f"uid-{self._rv + 1}")
         key = self._key(meta.get("namespace"), meta["name"])
@@ -150,6 +171,27 @@ class FakeKube:
         deepcopied return value)."""
         with self._lock:
             return self._obj_bytes(kind, self._create_locked(kind, obj))
+
+    def bind(self, namespace, name, node: str) -> dict | None:
+        """POST pods/NAME/binding — the real scheduler's bind call: sets
+        spec.nodeName exactly once. Raises BindConflict when spec.nodeName
+        is already set — even to the same node, matching the real
+        apiserver's BindingREST (any retry after a bind conflicts)."""
+        with self._lock:
+            key = self._key(namespace, name)
+            obj = self._store["pods"].get(key)
+            if obj is None:
+                return None
+            spec = obj.setdefault("spec", {})
+            current = spec.get("nodeName")
+            if current:
+                raise BindConflict(
+                    f'pod {name} is already assigned to node {current}'
+                )
+            spec["nodeName"] = node
+            self._bump(obj, "pods", key)
+            self._emit("pods", MODIFIED, obj)
+            return copy.deepcopy(obj)
 
     def update(self, kind: str, obj: dict) -> dict:
         with self._lock:
@@ -381,8 +423,8 @@ class FakeKube:
 
 
 _PATHS = re.compile(
-    r"^/api/v1(?:/namespaces/(?P<ns>[^/]+))?/(?P<kind>nodes|pods)"
-    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status))?$"
+    r"^/api/v1(?:/namespaces/(?P<ns>[^/]+))?/(?P<kind>nodes|pods|events)"
+    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|binding))?$"
 )
 _RBAC_PATHS = re.compile(
     r"^/apis/rbac\.authorization\.k8s\.io/v1"
@@ -390,10 +432,100 @@ _RBAC_PATHS = re.compile(
     r"/(?P<kind>roles|rolebindings|clusterroles|clusterrolebindings)"
     r"(?:/(?P<name>[^/]+))?(?P<sub>)?$"
 )
+# a real v1.19+ kube-scheduler records events via events.k8s.io/v1, not
+# core v1; both groups route to the one events store (the real apiserver
+# mirrors them)
+_EVENTS_PATHS = re.compile(
+    r"^/apis/events\.k8s\.io/v1"
+    r"(?:/namespaces/(?P<ns>[^/]+))?"
+    r"/(?P<kind>events)(?:/(?P<name>[^/]+))?(?P<sub>)?$"
+)
 
 
 def _match_path(path: str):
-    return _PATHS.match(path) or _RBAC_PATHS.match(path)
+    m = (
+        _PATHS.match(path)
+        or _RBAC_PATHS.match(path)
+        or _EVENTS_PATHS.match(path)
+    )
+    # the binding subresource exists only under pods (real apiserver: 404)
+    if m and m.group("sub") == "binding" and m.group("kind") != "pods":
+        return None
+    return m
+
+
+def _api_resource(name: str, kind: str, namespaced: bool, subs=()):
+    out = [{"name": name, "singularName": "", "namespaced": namespaced,
+            "kind": kind, "verbs": ["create", "delete", "get", "list",
+                                    "patch", "update", "watch"]}]
+    for sub in subs:
+        out.append({"name": f"{name}/{sub}", "singularName": "",
+                    "namespaced": namespaced, "kind": kind,
+                    "verbs": ["get", "patch", "update"]
+                    if sub == "status" else ["create"]})
+    return out
+
+
+# Discovery documents: enough for real clients (kubectl, kube-scheduler's
+# restmapper) to resolve the kinds this server stores. Served by both mock
+# apiservers; parity-tested.
+DISCOVERY: dict[str, dict] = {
+    "/version": {
+        "major": "1", "minor": "26", "gitVersion": "v1.26.0-kwok-tpu",
+        "platform": "linux/amd64",
+    },
+    "/api": {"kind": "APIVersions", "versions": ["v1"]},
+    "/apis": {
+        "kind": "APIGroupList",
+        "apiVersion": "v1",
+        "groups": [
+            {
+                "name": "rbac.authorization.k8s.io",
+                "versions": [
+                    {"groupVersion": "rbac.authorization.k8s.io/v1",
+                     "version": "v1"}
+                ],
+                "preferredVersion": {
+                    "groupVersion": "rbac.authorization.k8s.io/v1",
+                    "version": "v1",
+                },
+            },
+            {
+                "name": "events.k8s.io",
+                "versions": [
+                    {"groupVersion": "events.k8s.io/v1", "version": "v1"}
+                ],
+                "preferredVersion": {
+                    "groupVersion": "events.k8s.io/v1", "version": "v1"
+                },
+            },
+        ],
+    },
+    "/api/v1": {
+        "kind": "APIResourceList",
+        "groupVersion": "v1",
+        "resources": (
+            _api_resource("nodes", "Node", False, subs=("status",))
+            + _api_resource("pods", "Pod", True, subs=("status", "binding"))
+            + _api_resource("events", "Event", True)
+        ),
+    },
+    "/apis/rbac.authorization.k8s.io/v1": {
+        "kind": "APIResourceList",
+        "groupVersion": "rbac.authorization.k8s.io/v1",
+        "resources": (
+            _api_resource("roles", "Role", True)
+            + _api_resource("rolebindings", "RoleBinding", True)
+            + _api_resource("clusterroles", "ClusterRole", False)
+            + _api_resource("clusterrolebindings", "ClusterRoleBinding", False)
+        ),
+    },
+    "/apis/events.k8s.io/v1": {
+        "kind": "APIResourceList",
+        "groupVersion": "events.k8s.io/v1",
+        "resources": _api_resource("events", "Event", True),
+    },
+}
 
 
 # Bootstrap RBAC policy seeded when the cluster runs with
@@ -681,13 +813,16 @@ class HttpFakeApiserver:
                     return
                 if not self._authorized():
                     return
+                if parsed.path in DISCOVERY:
+                    self._send_json(DISCOVERY[parsed.path])
+                    return
                 if parsed.path == "/snapshot":
                     # the mock's `etcdctl snapshot save`
                     self._send_json(store.dump())
                     return
                 m = _match_path(parsed.path)
-                if not m:
-                    self.send_error(404)
+                if not m or m.group("sub") == "binding":
+                    self.send_error(404)  # binding is create-only
                     return
                 q = urllib.parse.parse_qs(parsed.query)
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
@@ -738,8 +873,8 @@ class HttpFakeApiserver:
                     return
                 parsed = urllib.parse.urlparse(self.path)
                 m = _match_path(parsed.path)
-                if not m or not m.group("name"):
-                    self.send_error(404)
+                if not m or not m.group("name") or m.group("sub") == "binding":
+                    self.send_error(404)  # binding is create-only
                     return
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
                 patch = self._body()
@@ -757,8 +892,8 @@ class HttpFakeApiserver:
                     return
                 parsed = urllib.parse.urlparse(self.path)
                 m = _match_path(parsed.path)
-                if not m or not m.group("name"):
-                    self.send_error(404)
+                if not m or not m.group("name") or m.group("sub") == "binding":
+                    self.send_error(404)  # binding is create-only
                     return
                 body = self._body() or {}
                 grace = body.get("gracePeriodSeconds")
@@ -782,6 +917,30 @@ class HttpFakeApiserver:
                     self.send_error(404)
                     return
                 obj = self._body()
+                if m.group("sub") == "binding":
+                    # the real scheduler's bind: POST v1 Binding
+                    node = ((obj or {}).get("target") or {}).get("name") or ""
+                    try:
+                        pod = store.bind(m.group("ns"), m.group("name"), node)
+                    except BindConflict as e:
+                        self._send_json(
+                            {"kind": "Status", "status": "Failure",
+                             "reason": "Conflict", "message": str(e),
+                             "code": 409},
+                            409,
+                        )
+                        return
+                    if pod is None:
+                        self._send_json({"kind": "Status", "code": 404}, 404)
+                    else:
+                        self._send_json(
+                            {"kind": "Status", "status": "Success", "code": 201},
+                            201,
+                        )
+                    return
+                if m.group("name") or m.group("sub"):
+                    self.send_error(404)
+                    return
                 if m.group("ns"):
                     obj.setdefault("metadata", {})["namespace"] = m.group("ns")
                 self._send_body(store.create_bytes(m.group("kind"), obj), 201)

@@ -819,11 +819,12 @@ struct Watch {
 
 // core/v1 kinds plus rbac.authorization.k8s.io/v1 (served with bootstrap
 // policy under --authorization; mirrors mockserver.py KINDS)
-static const int NKINDS = 6;
-// order matters: pods must stay index 1 (graceful-delete special case)
+static const int NKINDS = 7;
+// order matters: pods must stay index 1 (graceful-delete special case);
+// indexes 2-5 are the rbac group, everything else is core/v1
 static const char* KIND_NAMES[NKINDS] = {
-    "nodes",        "pods",         "roles",
-    "rolebindings", "clusterroles", "clusterrolebindings",
+    "nodes",        "pods",         "roles",    "rolebindings",
+    "clusterroles", "clusterrolebindings",      "events",
 };
 static int kind_index(const std::string& kind) {
   for (int i = 0; i < NKINDS; i++)
@@ -1027,17 +1028,25 @@ struct PathMatch {
   int kind = -1;
   std::string ns, name;
   bool status = false;
+  bool binding = false;
 };
 
 static PathMatch match_path(const std::string& path) {
   PathMatch m;
   const std::string core = "/api/v1";
   const std::string rbac = "/apis/rbac.authorization.k8s.io/v1";
+  // a real v1.19+ kube-scheduler records events via events.k8s.io/v1; both
+  // groups route to the one events store (the real apiserver mirrors them)
+  const std::string evg = "/apis/events.k8s.io/v1";
   std::string rest;
   bool is_rbac = false;
+  bool is_events_group = false;
   if (path.rfind(rbac, 0) == 0) {
     rest = path.substr(rbac.size());
     is_rbac = true;
+  } else if (path.rfind(evg, 0) == 0) {
+    rest = path.substr(evg.size());
+    is_events_group = true;
   } else if (path.rfind(core, 0) == 0) {
     rest = path.substr(core.size());
   } else {
@@ -1064,23 +1073,46 @@ static PathMatch match_path(const std::string& path) {
   if (i >= parts.size()) return m;
   m.kind = kind_index(parts[i]);
   if (m.kind < 0) return m;
-  // group membership: nodes/pods live under /api/v1, rbac kinds under
-  // /apis/rbac.authorization.k8s.io/v1 (mirrors mockserver.py's regexes)
-  if (is_rbac != (m.kind >= 2)) return m;
+  // group membership: nodes/pods/events live under /api/v1, rbac kinds
+  // under /apis/rbac.authorization.k8s.io/v1, events also under
+  // /apis/events.k8s.io/v1 (mirrors mockserver.py)
+  if (is_events_group) {
+    if (m.kind != 6) return m;
+  } else if (is_rbac != (m.kind >= 2 && m.kind <= 5)) {
+    return m;
+  }
   i++;
   if (i < parts.size()) {
     m.name = url_decode(parts[i]);
     i++;
   }
   if (i < parts.size()) {
-    if (parts[i] != "status") return m;
-    m.status = true;
+    if (parts[i] == "status") m.status = true;
+    else if (parts[i] == "binding" && m.kind == 1) m.binding = true;
+    else return m;  // binding exists only under pods (real apiserver: 404)
     i++;
   }
   if (i != parts.size()) return m;
   m.ok = true;
   return m;
 }
+
+// Discovery documents served by GET on these exact paths; byte-content
+// mirrors mockserver.py DISCOVERY (json.dumps compact) — parity-tested.
+static const std::pair<const char*, const char*> DISCOVERY_DOCS[] = {
+    {"/version",
+     R"DISC({"major":"1","minor":"26","gitVersion":"v1.26.0-kwok-tpu","platform":"linux/amd64"})DISC"},
+    {"/api",
+     R"DISC({"kind":"APIVersions","versions":["v1"]})DISC"},
+    {"/apis",
+     R"DISC({"kind":"APIGroupList","apiVersion":"v1","groups":[{"name":"rbac.authorization.k8s.io","versions":[{"groupVersion":"rbac.authorization.k8s.io/v1","version":"v1"}],"preferredVersion":{"groupVersion":"rbac.authorization.k8s.io/v1","version":"v1"}},{"name":"events.k8s.io","versions":[{"groupVersion":"events.k8s.io/v1","version":"v1"}],"preferredVersion":{"groupVersion":"events.k8s.io/v1","version":"v1"}}]})DISC"},
+    {"/api/v1",
+     R"DISC({"kind":"APIResourceList","groupVersion":"v1","resources":[{"name":"nodes","singularName":"","namespaced":false,"kind":"Node","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"nodes/status","singularName":"","namespaced":false,"kind":"Node","verbs":["get","patch","update"]},{"name":"pods","singularName":"","namespaced":true,"kind":"Pod","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"pods/status","singularName":"","namespaced":true,"kind":"Pod","verbs":["get","patch","update"]},{"name":"pods/binding","singularName":"","namespaced":true,"kind":"Pod","verbs":["create"]},{"name":"events","singularName":"","namespaced":true,"kind":"Event","verbs":["create","delete","get","list","patch","update","watch"]}]})DISC"},
+    {"/apis/rbac.authorization.k8s.io/v1",
+     R"DISC({"kind":"APIResourceList","groupVersion":"rbac.authorization.k8s.io/v1","resources":[{"name":"roles","singularName":"","namespaced":true,"kind":"Role","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"rolebindings","singularName":"","namespaced":true,"kind":"RoleBinding","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"clusterroles","singularName":"","namespaced":false,"kind":"ClusterRole","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"clusterrolebindings","singularName":"","namespaced":false,"kind":"ClusterRoleBinding","verbs":["create","delete","get","list","patch","update","watch"]}]})DISC"},
+    {"/apis/events.k8s.io/v1",
+     R"DISC({"kind":"APIResourceList","groupVersion":"events.k8s.io/v1","resources":[{"name":"events","singularName":"","namespaced":true,"kind":"Event","verbs":["create","delete","get","list","patch","update","watch"]}]})DISC"},
+};
 
 // ------------------------------------------------------------------ app
 
@@ -1305,6 +1337,10 @@ bool App::handle_request(int fd, Request& req) {
                    "{\"kind\":\"Status\",\"apiVersion\":\"v1\","
                    "\"status\":\"Failure\",\"reason\":\"Unauthorized\","
                    "\"message\":\"Unauthorized\",\"code\":401}");
+  if (req.method == "GET") {
+    for (const auto& d : DISCOVERY_DOCS)
+      if (req.path == d.first) return respond(200, d.second);
+  }
   if (req.method == "GET" && req.path == "/snapshot")
     return respond(200, snapshot_dump());
   if (req.method == "POST" && req.path == "/restore") {
@@ -1315,6 +1351,8 @@ bool App::handle_request(int fd, Request& req) {
   }
 
   PathMatch m = match_path(req.path);
+  if (m.binding && req.method != "POST")
+    return respond(404, "{\"kind\":\"Status\",\"code\":404}");
   if (!m.ok || (req.method != "GET" && m.name.empty() && req.method != "POST"))
     return respond(404, "{\"kind\":\"Status\",\"code\":404}");
 
@@ -1482,28 +1520,105 @@ bool App::handle_request(int fd, Request& req) {
     return respond(200, body);
   }
 
+  if (req.method == "POST" && m.binding) {
+    // the real scheduler's bind: POST v1 Binding -> set spec.nodeName once
+    JParser p(req.body);
+    JVal b = p.parse();
+    const JVal* target = b.is_obj() ? b.find("target") : nullptr;
+    const JVal* tname =
+        target && target->is_obj() ? target->find("name") : nullptr;
+    std::string node = tname && tname->type == JVal::STR ? tname->s : "";
+    std::string conflict;
+    bool found = false;
+    {
+      std::lock_guard<std::mutex> lk(store.mu);
+      auto it = store.kinds[1].find(key);
+      if (it != store.kinds[1].end()) {
+        found = true;
+        JVal obj = it->second->obj;  // copy-on-write
+        JVal& spec = obj.get_or_insert_obj("spec");
+        const JVal* cur = spec.find("nodeName");
+        if (cur && cur->type == JVal::STR && !cur->s.empty()) {
+          // real apiserver BindingREST: any bind after spec.nodeName is set
+          // conflicts, even to the same node
+          conflict = cur->s;
+        } else {
+          spec.set("nodeName", JVal::str(node));
+          store.bump(obj);
+          EntryPtr e = publish(std::move(obj));
+          it->second = e;
+          store.emit(1, "MODIFIED", e->obj, &e->bytes);
+        }
+      }
+    }
+    if (!found) return respond(404, "{\"kind\":\"Status\",\"code\":404}");
+    if (!conflict.empty()) {
+      std::string body =
+          "{\"kind\":\"Status\",\"status\":\"Failure\",\"reason\":"
+          "\"Conflict\",\"message\":\"pod ";
+      json_escape(body, m.name);
+      body += " is already assigned to node ";
+      json_escape(body, conflict);
+      body += "\",\"code\":409}";
+      return respond(409, body);
+    }
+    return respond(
+        201, "{\"kind\":\"Status\",\"status\":\"Success\",\"code\":201}");
+  }
+
   if (req.method == "POST") {
+    if (!m.name.empty() || m.status)
+      return respond(404, "{\"kind\":\"Status\",\"code\":404}");
     JParser p(req.body);
     JVal obj = p.parse();
     if (!p.ok || obj.type != JVal::OBJ)
       return respond(400, "{\"kind\":\"Status\",\"code\":400}");
     JVal& meta = obj.get_or_insert_obj("metadata");
     if (!m.ns.empty()) meta.set("namespace", JVal::str(m.ns));
-    Key k = Store::obj_key(obj);
-    if (k.second.empty())
-      return respond(400, "{\"kind\":\"Status\",\"code\":400}");
     EntryPtr e;
     {
       std::lock_guard<std::mutex> lk(store.mu);
-      if (!meta.find("creationTimestamp"))
-        meta.set("creationTimestamp", JVal::str(now_rfc3339()));
-      if (!meta.find("uid"))
-        meta.set("uid", JVal::str("uid-" + std::to_string(store.rv + 1)));
-      store.bump(obj);
-      e = publish(std::move(obj));
-      store.kinds[m.kind][k] = e;
-      store.emit(m.kind, "ADDED", e->obj, &e->bytes);
+      if (!meta.find("name")) {
+        // apiserver names.go semantics: generateName + 5-char random
+        // suffix (kube-scheduler POSTs events this way). Resolved inside
+        // the create's critical section — the name stays unique through
+        // the insert, never silently overwriting an existing object (the
+        // real apiserver 409s and the client retries; same outcome).
+        const JVal* gn = meta.find("generateName");
+        if (gn && gn->type == JVal::STR && !gn->s.empty()) {
+          static const char hexd[] = "0123456789abcdef";
+          static std::atomic<uint64_t> ctr{0};
+          while (true) {
+            uint64_t x = (uint64_t)time(nullptr) * 1000003u +
+                         ctr.fetch_add(1) * 2654435761u;
+            std::string suffix;
+            for (int i = 0; i < 5; i++) {
+              suffix += hexd[x & 15];
+              x >>= 4;
+            }
+            std::string name = gn->s + suffix;
+            if (!store.kinds[m.kind].count(Key{m.ns, name})) {
+              meta.set("name", JVal::str(name));
+              break;
+            }
+          }
+        }
+      }
+      Key k = Store::obj_key(obj);
+      if (k.second.empty()) {
+        e = nullptr;
+      } else {
+        if (!meta.find("creationTimestamp"))
+          meta.set("creationTimestamp", JVal::str(now_rfc3339()));
+        if (!meta.find("uid"))
+          meta.set("uid", JVal::str("uid-" + std::to_string(store.rv + 1)));
+        store.bump(obj);
+        e = publish(std::move(obj));
+        store.kinds[m.kind][k] = e;
+        store.emit(m.kind, "ADDED", e->obj, &e->bytes);
+      }
     }
+    if (!e) return respond(400, "{\"kind\":\"Status\",\"code\":400}");
     return respond(201, e->bytes);
   }
 

@@ -1,0 +1,226 @@
+"""The apiserver surface a REAL kube-scheduler needs: discovery documents,
+the pods/binding subresource, events with generateName, /version.
+
+The reference's e2e drives a real scheduler against fake nodes
+(test/kwokctl/kwokctl_workable_test.sh; the scheduler binds via POST
+.../pods/NAME/binding and emits v1 Events). No real scheduler is reachable
+in this environment (zero egress, NOTES_r2.md), so this suite pins the
+exact wire surface it would touch — on BOTH mock apiservers, parity-style.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kwok_tpu import native
+from kwok_tpu.edge.httpclient import HttpKubeClient
+from kwok_tpu.edge.mockserver import DISCOVERY, FakeKube, HttpFakeApiserver
+from tests.test_engine import make_node, make_pod
+
+
+@pytest.fixture
+def pysrv():
+    s = HttpFakeApiserver(store=FakeKube()).start()
+    yield s
+    s.stop()
+
+
+def _get_json(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url: str, doc: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+
+    def parse(raw: bytes) -> dict:
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return {}  # python's send_error emits HTML error pages
+
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, parse(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, parse(e.read())
+
+
+BINDING = {
+    "apiVersion": "v1",
+    "kind": "Binding",
+    "metadata": {"name": "p1", "namespace": "default"},
+    "target": {"apiVersion": "v1", "kind": "Node", "name": "n1"},
+}
+
+
+def _check_binding(url: str, client: HttpKubeClient):
+    client.create("nodes", make_node("n1"))
+    pod = make_pod("p1", node="")
+    pod["spec"].pop("nodeName", None)
+    client.create("pods", pod)
+
+    code, _ = _post(f"{url}/api/v1/namespaces/default/pods/p1/binding", BINDING)
+    assert code == 201
+    assert client.get("pods", "default", "p1")["spec"]["nodeName"] == "n1"
+
+    # ANY bind once spec.nodeName is set conflicts — even to the same node
+    # (real apiserver BindingREST semantics)
+    for target in ("n1", "n2"):
+        other = dict(BINDING, target={"kind": "Node", "name": target})
+        code, body = _post(
+            f"{url}/api/v1/namespaces/default/pods/p1/binding", other
+        )
+        assert code == 409, target
+        assert body["reason"] == "Conflict"
+        assert "already assigned" in body["message"]
+    assert client.get("pods", "default", "p1")["spec"]["nodeName"] == "n1"
+
+    # binding a missing pod is NotFound
+    code, _ = _post(f"{url}/api/v1/namespaces/default/pods/nope/binding", BINDING)
+    assert code == 404
+    # binding exists only under pods, and only as create (404 otherwise)
+    code, _ = _post(f"{url}/api/v1/nodes/n1/binding", BINDING)
+    assert code == 404
+    req = urllib.request.Request(
+        f"{url}/api/v1/namespaces/default/pods/p1/binding"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 404
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def _check_discovery(url: str):
+    for path, expect in DISCOVERY.items():
+        assert _get_json(url + path) == expect, path
+
+
+def _check_events_generate_name(client: HttpKubeClient):
+    created = client.create(
+        "events",
+        {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"generateName": "p1.17c0a", "namespace": "default"},
+            "reason": "Scheduled",
+            "message": "Successfully assigned default/p1 to n1",
+        },
+        namespace="default",
+    )
+    name = created["metadata"]["name"]
+    assert name.startswith("p1.17c0a") and len(name) > len("p1.17c0a")
+    assert client.get("events", "default", name)["reason"] == "Scheduled"
+    # distinct names on repeated posts
+    again = client.create(
+        "events",
+        {"apiVersion": "v1", "kind": "Event",
+         "metadata": {"generateName": "p1.17c0a", "namespace": "default"}},
+        namespace="default",
+    )
+    assert again["metadata"]["name"] != name
+    assert len(client.list("events")) == 2
+
+
+def test_python_server_scheduler_surface(pysrv):
+    c = HttpKubeClient(pysrv.url)
+    try:
+        _check_discovery(pysrv.url)
+        _check_binding(pysrv.url, c)
+        _check_events_generate_name(c)
+    finally:
+        c.close()
+
+
+def test_binding_emits_watch_event(pysrv):
+    """The engine learns of scheduler binds through its pod watch: a bind
+    must surface as MODIFIED with the new spec.nodeName."""
+    store = pysrv.store
+    pod = make_pod("wp", node="")
+    pod["spec"].pop("nodeName", None)
+    store.create("pods", pod)
+    w = store.watch("pods")
+    assert store.bind("default", "wp", "n9")["spec"]["nodeName"] == "n9"
+    ev = next(iter(w))
+    w.stop()
+    assert ev.type == "MODIFIED"
+    assert ev.object["spec"]["nodeName"] == "n9"
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_native_server_scheduler_surface():
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer()
+    c = HttpKubeClient(srv.url)
+    try:
+        _check_discovery(srv.url)
+        _check_binding(srv.url, c)
+        _check_events_generate_name(c)
+    finally:
+        c.close()
+        srv.stop()
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_bound_pod_goes_running_via_binding(tmp_path):
+    """Scheduler-shaped end-to-end: an UNBOUND pod is invisible to the
+    engine (spec.nodeName!= pushdown); the binding POST makes it visible
+    and the engine drives it Running."""
+    import subprocess
+    import sys
+    import time
+    import os
+    import signal
+
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer()
+    c = HttpKubeClient(srv.url)
+    # the child must not inherit the TPU-claim relay env: a second claimant
+    # deadlocks on the single tunneled chip (see tests/conftest.py)
+    child_env = {
+        k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
+    }
+    child_env["JAX_PLATFORMS"] = "cpu"
+    eng = subprocess.Popen(
+        [sys.executable, "-m", "kwok_tpu.kwok", "--master", srv.url,
+         "--manage-all-nodes=true", "--server-address", "127.0.0.1:0",
+         "--tick-interval", "0.05"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=child_env,
+    )
+    try:
+        c.create("nodes", make_node("bn"))
+        pod = make_pod("bp", node="")
+        pod["spec"].pop("nodeName", None)
+        c.create("pods", pod)
+        time.sleep(1.5)  # engine running; pod unbound -> must stay Pending
+        st = (c.get("pods", "default", "bp").get("status") or {})
+        assert st.get("phase") != "Running"
+        c.bind("default", "bp", "bn")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = c.get("pods", "default", "bp").get("status") or {}
+            if st.get("phase") == "Running":
+                break
+            time.sleep(0.25)
+        assert st.get("phase") == "Running", st
+    finally:
+        eng.send_signal(signal.SIGTERM)
+        try:
+            eng.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            eng.kill()
+        c.close()
+        srv.stop()
