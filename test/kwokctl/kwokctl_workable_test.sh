@@ -25,6 +25,24 @@ for runtime in ${KWOK_TPU_E2E_RUNTIMES:-mock}; do
   done
   retry 60 running_pods_equal "${URL}" 5
 
+  # the scheduler's write path: an UNBOUND pod stays invisible to the
+  # engine until a POST .../binding sets spec.nodeName (the way a real
+  # kube-scheduler binds), then it runs like any other
+  kcurl -fsS -X POST "${URL}/api/v1/namespaces/default/pods" \
+    -H 'Content-Type: application/json' \
+    -d '{"apiVersion":"v1","kind":"Pod","metadata":{"name":"unbound-pod","namespace":"default"},"spec":{"containers":[{"name":"c","image":"busybox"}]},"status":{"phase":"Pending"}}' \
+    >/dev/null
+  sleep 2  # engine must NOT touch a node-less pod (spec.nodeName pushdown)
+  if [ "$(count_running_pods "${URL}")" != "5" ]; then
+    echo "unbound pod ran before binding" >&2
+    exit 1
+  fi
+  kcurl -fsS -X POST "${URL}/api/v1/namespaces/default/pods/unbound-pod/binding" \
+    -H 'Content-Type: application/json' \
+    -d '{"apiVersion":"v1","kind":"Binding","metadata":{"name":"unbound-pod"},"target":{"apiVersion":"v1","kind":"Node","name":"fake-node"}}' \
+    >/dev/null
+  retry 60 running_pods_equal "${URL}" 6
+
   # logs plumbing: every component wrote a log file we can read back
   kwokctl --name "${CLUSTER}" logs kube-apiserver | head -5
   kwokctl --name "${CLUSTER}" logs kwok-controller | head -5
