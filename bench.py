@@ -20,8 +20,8 @@ import time
 
 import numpy as np
 
-N_PODS = 1_000_000
-N_NODES = 10_000
+N_PODS = int(os.environ.get("KWOK_BENCH_PODS", "1000000"))
+N_NODES = int(os.environ.get("KWOK_BENCH_NODES", "10000"))
 MEAN_SECONDS = 5.0  # per-phase dwell time; cycle = 2 phases
 DT = 0.5  # simulated seconds per tick
 TICKS = 120
@@ -82,6 +82,38 @@ def make_cyclic_rules():
     return rules
 
 
+def _seeded_state(n):
+    """All-active rows with the managed+heartbeat selector bits set."""
+    from kwok_tpu.ops import new_row_state
+
+    s = new_row_state(n)
+    s.active[:] = True
+    s.sel_bits[:] = 0b11
+    return s
+
+
+def _best_of_windows(tick, consume, per_window: int, n_windows: int = 3) -> float:
+    """The shared timing harness: the device is reached through a shared
+    tunnel whose latency has multi-second transients, so a single long
+    window under-reports the engine by whatever the tunnel happened to do.
+    Take the best of `n_windows` independent windows — the max is the
+    honest device capability. `tick()` dispatches one engine tick and
+    returns an opaque item; `consume(item)` materializes its host-visible
+    summary and returns the transition count (clock stops after the last
+    consume, exactly what the engine's egress pays)."""
+    rates = []
+    for _ in range(n_windows):
+        items = []
+        t0 = time.perf_counter()
+        for _ in range(per_window):
+            items.append(tick())
+        total = 0
+        for item in items:
+            total += consume(item)
+        rates.append(total / (time.perf_counter() - t0))
+    return max(rates)
+
+
 def _run(kern, pstate, nstate, n_pods, n_nodes, ticks) -> float:
     """Tick `ticks` times and return transitions/s (counters + masks
     materialized host-side, exactly what the engine's egress consumes)."""
@@ -135,11 +167,7 @@ def mesh_main(n_devices: int, n_pods: int, ticks: int) -> None:
     n_pods = pad_to_multiple(n_pods, mesh)
     n_nodes = pad_to_multiple(max(n_pods // 100, n_devices), mesh)
 
-    def seeded(n):
-        s = new_row_state(n)
-        s.active[:] = True
-        s.sel_bits[:] = 0b11
-        return s
+    seeded = _seeded_state
 
     results = {}
     for label, m in (("1dev", None), (f"{n_devices}dev", mesh)):
@@ -174,25 +202,95 @@ def mesh_main(n_devices: int, n_pods: int, ticks: int) -> None:
     )
 
 
+def pallas_main() -> None:
+    """KWOK_BENCH_PALLAS=1: the VMEM-resident K-substep kernel
+    (ops/pallas_tick.py) instead of the XLA lax.scan path. Both kinds'
+    kernels are composed under ONE jit (one dispatch per engine tick, same
+    as MultiTickKernel); masks travel unpacked (3 bool arrays per kind),
+    so D2H bytes are ~8x the packed wire — the kernel, not the wire, is
+    what this mode measures."""
+    import jax
+
+    from kwok_tpu.models import compile_rules, default_rules
+    from kwok_tpu.models.lifecycle import ResourceKind
+    from kwok_tpu.ops.pallas_tick import PallasTickKernel
+    from kwok_tpu.ops.tick import prefetch, to_device
+
+    platform = jax.devices()[0].platform
+    # pallas rows come in blocks of 8x128
+    n_pods = (N_PODS + 1023) // 1024 * 1024
+    n_nodes = (N_NODES + 1023) // 1024 * 1024
+
+    ptab = compile_rules(make_cyclic_rules(), ResourceKind.POD)
+    ntab = compile_rules(default_rules(), ResourceKind.NODE)
+    interpret = platform == "cpu"
+    pk = PallasTickKernel(ptab, 30.0, (), -1, steps=STEPS, dt=DT,
+                          interpret=interpret)
+    nk = PallasTickKernel(ntab, 30.0, (), 1, steps=STEPS, dt=DT,
+                          interpret=interpret)
+    run_p = pk.raw_step(n_pods)
+    run_n = nk.raw_step(n_nodes)
+
+    @jax.jit
+    def fused(pstate, nstate, now, seed):
+        return run_p(pstate, now, seed), run_n(nstate, now, seed + 1)
+
+    pstate = to_device(_seeded_state(n_pods))
+    nstate = to_device(_seeded_state(n_nodes))
+
+    now = 0.0
+    seed = np.uint32(0x5EEDC0DE)
+    for _ in range(WARMUP):
+        pout, nout = fused(pstate, nstate, np.float32(now), seed)
+        pstate, nstate = pout.state, nout.state
+        now += DT * STEPS
+        seed += 2
+    np.asarray(nout.transitions)  # sync on the LAST-launched output
+
+    state = {"now": now, "seed": seed, "p": pstate, "n": nstate}
+
+    def tick():
+        pout, nout = fused(
+            state["p"], state["n"], np.float32(state["now"]), state["seed"]
+        )
+        state["p"], state["n"] = pout.state, nout.state
+        state["now"] += DT * STEPS
+        state["seed"] += 2
+        prefetch((pout.transitions, nout.transitions,
+                  pout.dirty, nout.dirty, pout.hb_fired, nout.hb_fired))
+        return pout, nout
+
+    def consume(item):
+        pout, nout = item
+        np.asarray(pout.dirty), np.asarray(nout.dirty)
+        return int(np.asarray(pout.transitions)) + int(
+            np.asarray(nout.transitions)
+        )
+
+    rate = _best_of_windows(tick, consume, max(1, TICKS // (3 * STEPS)))
+    print(json.dumps({
+        "metric": (
+            f"pod-phase transitions/sec at {n_pods} pods x {n_nodes} nodes "
+            f"(PALLAS VMEM-resident {STEPS}-substep kernel, {platform}"
+            f"{', interpret' if interpret else ''})"
+        ),
+        "value": round(rate, 1),
+        "unit": "transitions/s",
+        "vs_baseline": round(rate / REFERENCE_RATE, 1),
+    }))
+
+
 def main() -> None:
     import jax
 
     from kwok_tpu.models import compile_rules, default_rules
     from kwok_tpu.models.lifecycle import ResourceKind
-    from kwok_tpu.ops import new_row_state
-    from kwok_tpu.ops.tick import MultiTickKernel, prefetch, to_device
+    from kwok_tpu.ops.tick import MultiTickKernel, prefetch, to_device, unpack_wire
 
     platform = jax.devices()[0].platform
 
     ptab = compile_rules(make_cyclic_rules(), ResourceKind.POD)
     ntab = compile_rules(default_rules(), ResourceKind.NODE)
-
-    pods = new_row_state(N_PODS)
-    pods.active[:] = True
-    pods.sel_bits[:] = 0b11
-    nodes = new_row_state(N_NODES)
-    nodes.active[:] = True
-    nodes.sel_bits[:] = 0b11
 
     # Both kinds tick in ONE dispatch; host consumption (transition counters
     # + dirty/heartbeat masks — exactly what the engine's patch egress reads)
@@ -203,8 +301,8 @@ def main() -> None:
         pack=True, steps=STEPS, dt=DT,
     )
 
-    pstate = to_device(pods)
-    nstate = to_device(nodes)
+    pstate = to_device(_seeded_state(N_PODS))
+    nstate = to_device(_seeded_state(N_NODES))
 
     now = 0.0
     # warmup: compile + initial Pending->Running wave
@@ -214,36 +312,23 @@ def main() -> None:
         now += DT * STEPS
     _ = np.asarray(wire)  # sync
 
-    # The device is reached through a shared tunnel whose latency has
-    # multi-second transients; a single long window under-reports the
-    # engine by whatever the tunnel happened to do. Take the best of
-    # three independent windows — the max is the honest device capability.
-    from kwok_tpu.ops.tick import unpack_wire
+    state = {"now": now, "p": pstate, "n": nstate}
 
-    per_window = max(1, TICKS // (3 * STEPS))
-    window_rates = []
-    for _window in range(3):
-        wires = []
-        t0 = time.perf_counter()
-        for _ in range(per_window):
-            (pout, nout), wire = kern((pstate, nstate), now)
-            pstate, nstate = pout.state, nout.state
-            prefetch(wire)
-            wires.append(wire)
-            now += DT * STEPS
-        # materialize every dispatch's host-visible summary (counters +
-        # bit-packed dirty/deleted/hb masks — what the engine's patch
-        # egress consumes), then stop the clock
-        total = 0
-        for wire in wires:
-            counters, masks_fn, _ = unpack_wire(
-                np.asarray(wire), [N_PODS, N_NODES]
-            )
-            total += int(counters[0]) + int(counters[1])
-            masks_fn()
-        window_rates.append(total / (time.perf_counter() - t0))
+    def tick():
+        (pout, nout), wire = kern((state["p"], state["n"]), state["now"])
+        state["p"], state["n"] = pout.state, nout.state
+        state["now"] += DT * STEPS
+        prefetch(wire)
+        return wire
 
-    rate = max(window_rates)
+    def consume(wire):
+        # counters + bit-packed dirty/deleted/hb masks — what the engine's
+        # patch egress consumes
+        counters, masks_fn, _ = unpack_wire(np.asarray(wire), [N_PODS, N_NODES])
+        masks_fn()
+        return int(counters[0]) + int(counters[1])
+
+    rate = _best_of_windows(tick, consume, max(1, TICKS // (3 * STEPS)))
     print(
         json.dumps(
             {
@@ -325,5 +410,11 @@ if __name__ == "__main__":
                 os.environ, JAX_PLATFORMS="cpu", KWOK_BENCH_CPU_FALLBACK="1"
             )
             env.pop("PALLAS_AXON_POOL_IPS", None)
+            # pallas interpret mode is orders slower than the XLA scan the
+            # fallback sizes were tuned for: always fall back to main()
+            env.pop("KWOK_BENCH_PALLAS", None)
             os.execve(sys.executable, [sys.executable, __file__], env)
-        main()
+        if os.environ.get("KWOK_BENCH_PALLAS"):
+            pallas_main()
+        else:
+            main()

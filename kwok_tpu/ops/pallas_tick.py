@@ -1,0 +1,403 @@
+"""Experimental Pallas TPU kernel: K fused tick substeps with VMEM-resident
+rows.
+
+The XLA path (`ops/tick.py MultiTickKernel(steps=K)`) lax.scans K substeps;
+the scan carry round-trips the full SoA row state through HBM every step —
+~60 MB per step at 1M rows, ~7 GB per dispatch at K=120 (~9 ms of HBM
+traffic at v5e bandwidth). This kernel instead grids over row blocks and
+keeps each block in VMEM across ALL K substeps: one HBM read + one write
+per row per dispatch, K× less state traffic. On the tunneled bench chip the
+dispatch RTT (~70 ms) dwarfs that 9 ms, so this is OPT-IN
+(`KWOK_BENCH_PALLAS=1 python bench.py`) and disabled by default; on
+locally-attached TPUs (µs-scale dispatch) it is the next step up — see
+docs/architecture.md "Why Pallas is opt-in".
+
+Semantics are `ops/tick.py tick_body` exactly (match → re-arm → fire →
+heartbeat wheel), with one documented divergence: delay sampling uses an
+in-kernel counter-based hash RNG (finalizer-style integer mix over
+(row, step, seed)) instead of jax.random's threefry stream — same
+distributions, different stream, so constant-delay rule sets are
+bit-identical to the XLA path and stochastic ones agree in distribution
+(tests/test_pallas_tick.py pins both).
+
+Layout: every field is viewed as [C/128, 128] (rows padded to a multiple of
+block_rows*128 by the caller); bool fields travel as int32 so every ref
+uses the f32/i32 (8,128) tile. Rule tables are tiny ([R], R < 32) and ride
+along in SMEM; `now`/`seed` are scalar-prefetch style SMEM inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kwok_tpu.models.compiler import CompiledRules
+from kwok_tpu.models.lifecycle import DelayKind
+from kwok_tpu.ops.state import RowState, TickOutputs
+
+LANES = 128
+# numpy scalar, not a jnp array: pallas kernels may not capture
+# concrete jax arrays as closure constants
+INF = np.float32(np.inf)
+
+
+def _mix(x: jnp.ndarray) -> jnp.ndarray:
+    """32-bit finalizer-style integer mix (xorshift-multiply), uint32 in/out."""
+    x = x ^ (x >> 17)
+    x = x * jnp.uint32(0xED5AD4BB)
+    x = x ^ (x >> 11)
+    x = x * jnp.uint32(0xAC4C1B51)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x31848BAB)
+    x = x ^ (x >> 14)
+    return x
+
+
+def _uniform01(gid: jnp.ndarray, step: jnp.ndarray, seed: jnp.ndarray) -> jnp.ndarray:
+    """u in [1e-7, 1) from (row id, step, seed) — the kernel's stand-in for
+    tick_body's jax.random.uniform(minval=1e-7)."""
+    h = _mix(gid ^ (step.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)) ^ seed)
+    # top 23 bits -> mantissa of a float in [1, 2), minus 1 -> [0, 1)
+    f = jax.lax.bitcast_convert_type(
+        (h >> 9) | jnp.uint32(0x3F800000), jnp.float32
+    ) - jnp.float32(1.0)
+    return jnp.maximum(f, jnp.float32(1e-7))
+
+
+def _kernel(
+    # --- SMEM scalars -----------------------------------------------------
+    now_ref, seed_ref,
+    fm_ref, del_ref, selbit_ref, dk_ref, da_ref, db_ref,
+    tp_ref, ca_ref, cv_ref, isdel_ref,
+    # --- row blocks (VMEM) ------------------------------------------------
+    active_ref, phase_ref, cond_ref, selb_ref, hasdel_ref,
+    pend_ref, fire_ref, hb_ref, gen_ref,
+    # --- outputs ----------------------------------------------------------
+    o_phase, o_cond, o_pend, o_fire, o_hb, o_gen,
+    o_dirty, o_deleted, o_hbf, o_counts,
+    *,
+    num_rules: int,
+    steps: int,
+    dt: float,
+    hb_interval: float,
+    hb_phase_mask: int,
+    hb_sel_bit: int,
+    block_rows: int,
+):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+
+    active = active_ref[:] != 0
+    has_deletion = hasdel_ref[:] != 0
+    sel_bits = selb_ref[:].astype(jnp.uint32)
+
+    # global row id for the RNG stream
+    r_iota = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, LANES), 0)
+    c_iota = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, LANES), 1)
+    gid = (
+        (jnp.uint32(i) * jnp.uint32(block_rows) + r_iota) * jnp.uint32(LANES)
+        + c_iota
+    )
+    seed = seed_ref[0].astype(jnp.uint32)
+    now0 = now_ref[0]
+
+    zero_b = jnp.zeros((block_rows, LANES), jnp.bool_)
+
+    def step_fn(s, carry):
+        (phase, cond, pend, fire, hb_due, gen,
+         dirty_acc, del_acc, hbf_acc, trans, hbs) = carry
+        now = now0 + s.astype(jnp.float32) * jnp.float32(dt)
+
+        if num_rules > 0:
+            phase_u = phase.astype(jnp.uint32)
+            best = jnp.full((block_rows, LANES), -1, jnp.int32)
+            # R is static and tiny: unrolled first-match-wins scan
+            for r in range(num_rules):
+                phase_ok = ((fm_ref[r].astype(jnp.uint32) >> phase_u) & 1) == 1
+                dmode = del_ref[r]
+                del_ok = (dmode == -1) | ((dmode == 1) == has_deletion)
+                sbit = selbit_ref[r]
+                sel_ok = (sbit < 0) | (
+                    ((sel_bits >> jnp.maximum(sbit, 0).astype(jnp.uint32)) & 1)
+                    == 1
+                )
+                m = active & phase_ok & del_ok & sel_ok
+                best = jnp.where((best < 0) & m, jnp.int32(r), best)
+
+            rearm = active & (best != pend) & (best >= 0)
+            # delay sampling: gather rule params by best (tiny R: select)
+            rid = jnp.maximum(best, 0)
+            dk = jnp.zeros((block_rows, LANES), jnp.int32)
+            a = jnp.zeros((block_rows, LANES), jnp.float32)
+            b = jnp.zeros((block_rows, LANES), jnp.float32)
+            for r in range(num_rules):
+                sel = rid == r
+                dk = jnp.where(sel, dk_ref[r], dk)
+                a = jnp.where(sel, da_ref[r], a)
+                b = jnp.where(sel, db_ref[r], b)
+            u = _uniform01(gid, s, seed)
+            d_uniform = a + (b - a) * u
+            d_exp = -a * jnp.log(u)
+            d_exp = jnp.where(b > 0, jnp.minimum(d_exp, b), d_exp)
+            delay = jnp.where(
+                dk == int(DelayKind.CONSTANT),
+                a,
+                jnp.where(dk == int(DelayKind.UNIFORM), d_uniform, d_exp),
+            )
+            pend = jnp.where(active, best, jnp.int32(-1))
+            fire = jnp.where(
+                rearm, now + delay, jnp.where(pend >= 0, fire, INF)
+            )
+
+            can_fire = active & (pend >= 0) & (now >= fire)
+            frid = jnp.maximum(pend, 0)
+            tp = jnp.zeros((block_rows, LANES), jnp.int32)
+            ca = jnp.zeros((block_rows, LANES), jnp.uint32)
+            cv = jnp.zeros((block_rows, LANES), jnp.uint32)
+            isdel = zero_b
+            for r in range(num_rules):
+                sel = frid == r
+                tp = jnp.where(sel, tp_ref[r], tp)
+                ca = jnp.where(sel, ca_ref[r].astype(jnp.uint32), ca)
+                cv = jnp.where(sel, cv_ref[r].astype(jnp.uint32), cv)
+                isdel = jnp.where(sel, isdel_ref[r] != 0, isdel)
+            fired_delete = can_fire & isdel
+            phase = jnp.where(can_fire, tp, phase)
+            cond = jnp.where(can_fire, (cond & ~ca) | cv, cond)
+            pend = jnp.where(can_fire, jnp.int32(-1), pend)
+            fire = jnp.where(can_fire, INF, fire)
+            gen = gen + can_fire.astype(jnp.int32)
+            dirty = can_fire & ~fired_delete
+        else:
+            can_fire = zero_b
+            dirty = zero_b
+            fired_delete = zero_b
+
+        # heartbeat wheel (gating mirrors tick_body exactly)
+        if hb_phase_mask == 0 and hb_sel_bit < 0:
+            hb_on = zero_b
+        else:
+            hb_on = active
+            if hb_phase_mask != 0:
+                hb_on = hb_on & (
+                    ((jnp.uint32(hb_phase_mask) >> phase.astype(jnp.uint32))
+                     & 1) == 1
+                )
+            if hb_sel_bit >= 0:
+                hb_on = hb_on & (
+                    ((sel_bits >> jnp.uint32(hb_sel_bit)) & 1) == 1
+                )
+        entered = hb_on & jnp.isinf(hb_due)
+        hb_fired = hb_on & (now >= hb_due)
+        hb_due = jnp.where(
+            ~hb_on,
+            INF,
+            jnp.where(
+                hb_fired | entered, now + jnp.float32(hb_interval), hb_due
+            ),
+        )
+
+        return (
+            phase, cond, pend, fire, hb_due, gen,
+            dirty_acc | dirty, del_acc | fired_delete, hbf_acc | hb_fired,
+            trans + can_fire.sum(dtype=jnp.int32),
+            hbs + hb_fired.sum(dtype=jnp.int32),
+        )
+
+    init = (
+        phase_ref[:], cond_ref[:].astype(jnp.uint32), pend_ref[:],
+        fire_ref[:], hb_ref[:], gen_ref[:],
+        zero_b, zero_b, zero_b, jnp.int32(0), jnp.int32(0),
+    )
+    (phase, cond, pend, fire, hb_due, gen,
+     dirty, deleted, hbf, trans, hbs) = jax.lax.fori_loop(
+        0, steps, step_fn, init
+    )
+
+    o_phase[:] = phase
+    o_cond[:] = cond
+    o_pend[:] = pend
+    o_fire[:] = fire
+    o_hb[:] = hb_due
+    o_gen[:] = gen
+    o_dirty[:] = dirty.astype(jnp.int32)
+    o_deleted[:] = deleted.astype(jnp.int32)
+    o_hbf[:] = hbf.astype(jnp.int32)
+    o_counts[0, 0] = trans
+    o_counts[0, 1] = hbs
+
+
+class PallasTickKernel:
+    """K fused substeps for ONE resource kind, rows resident in VMEM.
+
+    Drop-in for `TickKernel` at the `MultiTickKernel(steps=K)` semantics:
+    `__call__(state, now)` advances K substeps of `dt` starting at `now`
+    and returns TickOutputs with OR'd masks and summed counters — the same
+    contract the engine's emit consumes.
+    """
+
+    def __init__(
+        self,
+        table: CompiledRules,
+        hb_interval: float = 30.0,
+        hb_phases: tuple[str, ...] = (),
+        hb_sel_bit: int = -1,
+        steps: int = 1,
+        dt: float = 0.0,
+        block_rows: int = 8,
+        interpret: bool = False,
+    ) -> None:
+        self.table = table
+        self.steps = int(steps)
+        self.dt = float(dt)
+        self.block_rows = int(block_rows)
+        self.interpret = bool(interpret)
+        mask = 0
+        for p in hb_phases:
+            mask |= 1 << table.space.phase_id(p)
+        self.hb_phase_mask = mask
+        self.hb_sel_bit = int(hb_sel_bit)
+        self.hb_interval = float(hb_interval)
+        self._rules_host = table
+        self._seed = np.uint32(0x5EEDC0DE)
+        self._step_n = 0
+        self._compiled = None
+
+    # ----------------------------------------------------------- plumbing
+
+    def _build(self, capacity: int):
+        import jax.experimental.pallas as pl
+
+        t = self._rules_host
+        R = len(t.from_mask)
+        br = self.block_rows
+        assert capacity % (br * LANES) == 0, (
+            f"capacity {capacity} must be a multiple of {br * LANES}"
+        )
+        grid = capacity // (br * LANES)
+        shape2 = (capacity // LANES, LANES)
+
+        try:
+            from jax.experimental.pallas import tpu as pltpu
+
+            smem = pltpu.SMEM
+        except Exception:  # pragma: no cover - cpu-only installs
+            smem = None
+
+        def spec_scalar(n):
+            if smem is None:
+                return pl.BlockSpec(memory_space=None)
+            return pl.BlockSpec(memory_space=smem)
+
+        row_spec = pl.BlockSpec((br, LANES), lambda i: (i, 0))
+        kern = functools.partial(
+            _kernel,
+            num_rules=R,
+            steps=self.steps,
+            dt=self.dt,
+            hb_interval=self.hb_interval,
+            hb_phase_mask=self.hb_phase_mask,
+            hb_sel_bit=self.hb_sel_bit,
+            block_rows=br,
+        )
+        i32 = jnp.int32
+        out_shapes = [
+            jax.ShapeDtypeStruct(shape2, i32),        # phase
+            jax.ShapeDtypeStruct(shape2, jnp.uint32), # cond
+            jax.ShapeDtypeStruct(shape2, i32),        # pend
+            jax.ShapeDtypeStruct(shape2, jnp.float32),# fire
+            jax.ShapeDtypeStruct(shape2, jnp.float32),# hb_due
+            jax.ShapeDtypeStruct(shape2, i32),        # gen
+            jax.ShapeDtypeStruct(shape2, i32),        # dirty
+            jax.ShapeDtypeStruct(shape2, i32),        # deleted
+            jax.ShapeDtypeStruct(shape2, i32),        # hbf
+            jax.ShapeDtypeStruct((grid, 2), i32),     # per-block counters
+        ]
+        out_specs = [row_spec] * 9 + [pl.BlockSpec((1, 2), lambda i: (i, 0))]
+        in_specs = (
+            [spec_scalar(1)] * 2       # now, seed
+            + [spec_scalar(R)] * 10    # rule arrays
+            + [row_spec] * 9           # state blocks
+        )
+        call = pl.pallas_call(
+            kern,
+            grid=(grid,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=self.interpret,
+        )
+
+        rules = (
+            jnp.asarray(t.from_mask, jnp.uint32),
+            jnp.asarray(t.deletion, jnp.int32),
+            jnp.asarray(t.selector_bit, jnp.int32),
+            jnp.asarray(t.delay_kind, jnp.int32),
+            jnp.asarray(t.delay_a, jnp.float32),
+            jnp.asarray(t.delay_b, jnp.float32),
+            jnp.asarray(t.to_phase, jnp.int32),
+            jnp.asarray(t.cond_assign, jnp.uint32),
+            jnp.asarray(t.cond_value, jnp.uint32),
+            jnp.asarray(t.is_delete, jnp.int32),
+        )
+
+        def run(state: RowState, now, seed):
+            r2 = lambda a, dt_: a.astype(dt_).reshape(shape2)  # noqa: E731
+            outs = call(
+                jnp.asarray([now], jnp.float32),
+                jnp.asarray([seed], jnp.uint32),
+                *rules,
+                r2(state.active, jnp.int32),
+                r2(state.phase, jnp.int32),
+                r2(state.cond_bits, jnp.uint32),
+                r2(state.sel_bits, jnp.uint32),
+                r2(state.has_deletion, jnp.int32),
+                r2(state.pending_rule, jnp.int32),
+                r2(state.fire_at, jnp.float32),
+                r2(state.hb_due, jnp.float32),
+                r2(state.gen, jnp.int32),
+            )
+            (phase, cond, pend, fire, hb_due, gen,
+             dirty, deleted, hbf, counts) = outs
+            flat = lambda a: a.reshape(capacity)  # noqa: E731
+            new_state = RowState(
+                active=state.active,
+                phase=flat(phase),
+                cond_bits=flat(cond),
+                sel_bits=state.sel_bits,
+                has_deletion=state.has_deletion,
+                pending_rule=flat(pend),
+                fire_at=flat(fire),
+                hb_due=flat(hb_due),
+                gen=flat(gen),
+            )
+            return TickOutputs(
+                state=new_state,
+                dirty=flat(dirty) != 0,
+                deleted=flat(deleted) != 0,
+                hb_fired=flat(hbf) != 0,
+                transitions=counts[:, 0].sum(dtype=jnp.int32),
+                heartbeats=counts[:, 1].sum(dtype=jnp.int32),
+            )
+
+        return run
+
+    def raw_step(self, capacity: int):
+        """The UNJITTED step function (state, now, seed) -> TickOutputs —
+        for callers composing several kernels under one jit (bench.py's
+        pallas mode fuses pods+nodes into a single dispatch this way)."""
+        return self._build(capacity)
+
+    def __call__(self, state: RowState, now: float) -> TickOutputs:
+        cap = int(state.active.shape[0])
+        if self._compiled is None or self._cap != cap:
+            self._compiled = jax.jit(self._build(cap))
+            self._cap = cap
+        self._step_n += 1
+        return self._compiled(
+            state, jnp.float32(now), np.uint32(self._seed + self._step_n)
+        )

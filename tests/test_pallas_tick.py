@@ -1,0 +1,184 @@
+"""PallasTickKernel (ops/pallas_tick.py) vs the XLA scan path.
+
+Runs in Pallas INTERPRET mode on the CPU test platform: correctness of the
+VMEM-resident K-substep kernel is pinned against MultiTickKernel/TickKernel
+(the shipped XLA path) before it ever runs compiled on a TPU. Constant
+delays make every comparison exact (no RNG stream in play — see the module
+docstring's documented divergence); the stochastic path is checked for
+distributional sanity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kwok_tpu.models import compile_rules, default_rules
+from kwok_tpu.models.defaults import SEL_MANAGED
+from kwok_tpu.models.lifecycle import (
+    Delay,
+    LifecycleRule,
+    ResourceKind,
+    StatusEffect,
+)
+from kwok_tpu.ops import TickKernel, new_row_state
+from kwok_tpu.ops.pallas_tick import PallasTickKernel
+from kwok_tpu.ops.tick import to_host
+
+CAP = 2048  # 2 blocks of 8x128
+
+
+def cyclic_rules(delay=1.0):
+    return [
+        LifecycleRule(
+            name="up",
+            resource=ResourceKind.POD,
+            from_phases=("Pending",),
+            selector=SEL_MANAGED,
+            delay=Delay.constant(delay),
+            effect=StatusEffect(to_phase="Running", conditions={"Ready": True}),
+        ),
+        LifecycleRule(
+            name="done",
+            resource=ResourceKind.POD,
+            from_phases=("Running",),
+            selector=SEL_MANAGED,
+            delay=Delay.constant(2 * delay),
+            effect=StatusEffect(
+                to_phase="Succeeded", conditions={"Ready": False}
+            ),
+        ),
+    ]
+
+
+def seeded(cap=CAP, frac=1.0):
+    rng = np.random.default_rng(42)
+    s = new_row_state(cap)
+    n_active = int(cap * frac)
+    s.active[:n_active] = True
+    s.sel_bits[:n_active] = 0b11
+    s.has_deletion[:] = rng.random(cap) < 0.1
+    return s
+
+
+def run_xla_sequential(table, state, steps, dt, hb_interval, hb_sel_bit):
+    """K sequential single-step XLA ticks == one K-step dispatch (pinned by
+    tests/test_multitick.py); this is the semantics oracle here."""
+    kern = TickKernel(
+        table, hb_interval=hb_interval, hb_phases=(), hb_sel_bit=hb_sel_bit
+    )
+    dirty = np.zeros(state.capacity, bool)
+    deleted = np.zeros(state.capacity, bool)
+    hbf = np.zeros(state.capacity, bool)
+    trans = hbs = 0
+    now = 0.0
+    for _ in range(steps):
+        out = kern(state, now)
+        state = out.state
+        host = to_host(out)
+        dirty |= host.dirty
+        deleted |= host.deleted
+        hbf |= host.hb_fired
+        trans += int(host.transitions)
+        hbs += int(host.heartbeats)
+        now += dt
+    return to_host(state), dirty, deleted, hbf, trans, hbs
+
+
+@pytest.mark.parametrize("steps,dt", [(1, 0.5), (6, 0.5), (12, 0.25)])
+def test_pallas_matches_xla_constant_delays(steps, dt):
+    table = compile_rules(cyclic_rules(), ResourceKind.POD)
+    state = seeded()
+    pk = PallasTickKernel(
+        table, hb_interval=5.0, hb_sel_bit=1, steps=steps, dt=dt,
+        interpret=True,
+    )
+    pout = pk(state, 0.0)
+    ph = to_host(pout)
+
+    xs, dirty, deleted, hbf, trans, hbs = run_xla_sequential(
+        table, seeded(), steps, dt, hb_interval=5.0, hb_sel_bit=1
+    )
+
+    np.testing.assert_array_equal(ph.state.phase, xs.phase)
+    np.testing.assert_array_equal(ph.state.cond_bits, xs.cond_bits)
+    np.testing.assert_array_equal(ph.state.pending_rule, xs.pending_rule)
+    np.testing.assert_array_equal(ph.state.fire_at, xs.fire_at)
+    np.testing.assert_array_equal(ph.state.hb_due, xs.hb_due)
+    np.testing.assert_array_equal(ph.state.gen, xs.gen)
+    np.testing.assert_array_equal(ph.dirty, dirty)
+    np.testing.assert_array_equal(ph.deleted, deleted)
+    np.testing.assert_array_equal(ph.hb_fired, hbf)
+    assert int(ph.transitions) == trans
+    assert int(ph.heartbeats) == hbs
+
+
+def test_pallas_delete_rules_match():
+    """Deletion-gated rules (the pod-delete path) through the kernel."""
+    table = compile_rules(default_rules(), ResourceKind.POD)
+    state = seeded()
+    pk = PallasTickKernel(
+        table, hb_interval=30.0, hb_sel_bit=-1, steps=4, dt=0.5,
+        interpret=True,
+    )
+    ph = to_host(pk(state, 0.0))
+    xs, dirty, deleted, hbf, trans, hbs = run_xla_sequential(
+        table, seeded(), 4, 0.5, hb_interval=30.0, hb_sel_bit=-1
+    )
+    np.testing.assert_array_equal(ph.state.phase, xs.phase)
+    np.testing.assert_array_equal(ph.deleted, deleted)
+    np.testing.assert_array_equal(ph.dirty, dirty)
+    assert int(ph.transitions) == trans
+
+
+def test_pallas_partial_activity_and_multiple_dispatches():
+    """Half-active population, two consecutive dispatches (state carries)."""
+    table = compile_rules(cyclic_rules(0.4), ResourceKind.POD)
+    state = seeded(frac=0.5)
+    pk = PallasTickKernel(
+        table, hb_interval=2.0, hb_sel_bit=1, steps=5, dt=0.5, interpret=True
+    )
+    out1 = pk(state, 0.0)
+    out2 = pk(out1.state, 2.5)
+    ph = to_host(out2)
+
+    xs, *_ = run_xla_sequential(
+        table, seeded(frac=0.5), 10, 0.5, hb_interval=2.0, hb_sel_bit=1
+    )
+    np.testing.assert_array_equal(ph.state.phase, xs.phase)
+    np.testing.assert_array_equal(ph.state.hb_due, xs.hb_due)
+    # inactive rows are untouched
+    inactive = ~np.asarray(state.active)
+    assert not ph.dirty[inactive].any()
+    assert (np.asarray(ph.state.phase)[inactive] == 0).all()
+
+
+def test_pallas_exponential_delays_distribution():
+    """Stochastic rules: different RNG stream than XLA, same distribution.
+    With Exp(mean) delays from Pending, the fraction transitioned by time T
+    approximates 1 - exp(-T/mean)."""
+    rules = [
+        LifecycleRule(
+            name="up",
+            resource=ResourceKind.POD,
+            from_phases=("Pending",),
+            selector=SEL_MANAGED,
+            delay=Delay.exponential(2.0),
+            effect=StatusEffect(to_phase="Running", conditions={"Ready": True}),
+        )
+    ]
+    table = compile_rules(rules, ResourceKind.POD)
+    cap = 8192
+    state = new_row_state(cap)
+    state.active[:] = True
+    state.sel_bits[:] = 0b11
+    pk = PallasTickKernel(
+        table, hb_interval=1e9, hb_sel_bit=-1, steps=20, dt=0.2,
+        interpret=True,
+    )
+    ph = to_host(pk(state, 0.0))
+    # T = 20 * 0.2 = 4.0s ... but the delay is sampled at step 0 and fires
+    # when now >= fire_at, so effective horizon is (steps-1)*dt = 3.8
+    frac = (np.asarray(ph.state.phase) == table.space.phase_id("Running")).mean()
+    expect = 1 - np.exp(-3.8 / 2.0)
+    assert abs(frac - expect) < 0.05, (frac, expect)
