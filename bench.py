@@ -167,19 +167,17 @@ def mesh_main(n_devices: int, n_pods: int, ticks: int) -> None:
     n_pods = pad_to_multiple(n_pods, mesh)
     n_nodes = pad_to_multiple(max(n_pods // 100, n_devices), mesh)
 
-    seeded = _seeded_state
-
     results = {}
     for label, m in (("1dev", None), (f"{n_devices}dev", mesh)):
         kern = MultiTickKernel(
             [(ptab, 30.0, (), -1), (ntab, 30.0, (), 1)], mesh=m, pack=True
         )
         if m is None:
-            pstate = to_device(seeded(n_pods))
-            nstate = to_device(seeded(n_nodes))
+            pstate = to_device(_seeded_state(n_pods))
+            nstate = to_device(_seeded_state(n_nodes))
         else:
-            pstate = kern.place(seeded(n_pods))
-            nstate = kern.place(seeded(n_nodes))
+            pstate = kern.place(_seeded_state(n_pods))
+            nstate = kern.place(_seeded_state(n_nodes))
         results[label] = round(
             _run(kern, pstate, nstate, n_pods, n_nodes, ticks), 1
         )

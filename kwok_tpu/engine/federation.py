@@ -62,16 +62,13 @@ def _pad_cluster_capacity(r: int, n_clusters: int, n_devices: int) -> int:
 
 def _table_bytes(tab) -> bytes:
     """Canonical bytes of a CompiledRules table (grouping key)."""
-    import io
-
-    buf = io.BytesIO()
-    for f in (
-        "from_mask", "deletion", "selector_bit", "delay_kind", "delay_a",
-        "delay_b", "to_phase", "cond_assign", "cond_value", "is_delete",
-    ):
-        buf.write(np.ascontiguousarray(getattr(tab, f)).tobytes())
-        buf.write(b"|")
-    return buf.getvalue()
+    return b"|".join(
+        np.ascontiguousarray(getattr(tab, f)).tobytes()
+        for f in (
+            "from_mask", "deletion", "selector_bit", "delay_kind", "delay_a",
+            "delay_b", "to_phase", "cond_assign", "cond_value", "is_delete",
+        )
+    )
 
 
 class _Group:
@@ -127,14 +124,13 @@ class FederatedEngine:
         self.mesh = mesh if mesh is not None else make_mesh()
         d = int(self.mesh.devices.size)
         cfgs = member_configs if member_configs is not None else [config] * len(clients)
+        base_capacity = max(int(config.initial_capacity), 1)
 
         self.engines = [
             ClusterEngine(
                 client,
                 dataclasses.replace(
-                    cfg,
-                    initial_capacity=max(int(config.initial_capacity), 1),
-                    use_mesh=False,
+                    cfg, initial_capacity=base_capacity, use_mesh=False
                 ),
             )
             for client, cfg in zip(clients, cfgs)
@@ -160,11 +156,7 @@ class FederatedEngine:
             g = _Group(
                 [self.engines[i] for i in members], cfgs[members[0]], self.mesh
             )
-            g.alloc(
-                _pad_cluster_capacity(
-                    max(int(config.initial_capacity), 1), len(members), d
-                )
-            )
+            g.alloc(_pad_cluster_capacity(base_capacity, len(members), d))
             self.groups.append(g)
         for g in self.groups:
             for e in g.engines:
