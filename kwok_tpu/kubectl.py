@@ -31,6 +31,7 @@ _KINDS: dict[str, tuple[tuple[str, ...], bool]] = {
     "rolebindings": (("rolebinding",), True),
     "clusterroles": (("clusterrole",), False),
     "clusterrolebindings": (("clusterrolebinding",), False),
+    "events": (("event", "ev"), True),
 }
 _ALIASES = {
     alias: kind
@@ -84,6 +85,18 @@ def _pod_row(o: dict) -> list[str]:
     return [o["metadata"]["name"], f"{ready}/{total}", phase, _age(o)]
 
 
+def _event_row(o: dict) -> list[str]:
+    obj = o.get("involvedObject") or o.get("regarding") or {}
+    target = f"{(obj.get('kind') or '').lower()}/{obj.get('name') or ''}".strip("/")
+    return [
+        _age(o),
+        o.get("type") or "Normal",
+        o.get("reason") or "",
+        target,
+        (o.get("message") or o.get("note") or "").replace("\n", " "),
+    ]
+
+
 def _print_table(kind: str, objs: list[dict], *, all_namespaces: bool,
                  no_headers: bool, out=None) -> None:
     out = out if out is not None else sys.stdout
@@ -91,6 +104,9 @@ def _print_table(kind: str, objs: list[dict], *, all_namespaces: bool,
         headers, row = ["NAME", "STATUS", "AGE"], _node_row
     elif kind == "pods":
         headers, row = ["NAME", "READY", "STATUS", "AGE"], _pod_row
+    elif kind == "events":
+        headers = ["LAST SEEN", "TYPE", "REASON", "OBJECT", "MESSAGE"]
+        row = _event_row
     else:
         headers, row = ["NAME", "AGE"], lambda o: [o["metadata"]["name"], _age(o)]
     if all_namespaces and _is_namespaced(kind):
@@ -129,6 +145,7 @@ _KIND_TO_PLURAL = {
     "RoleBinding": "rolebindings",
     "ClusterRole": "clusterroles",
     "ClusterRoleBinding": "clusterrolebindings",
+    "Event": "events",
 }
 
 

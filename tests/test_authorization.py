@@ -203,3 +203,54 @@ def test_mock_cluster_kube_authorization(tmp_path, monkeypatch):
             c.close()
     finally:
         assert main(["--name", name, "delete", "cluster"]) == 0
+
+
+def test_snapshot_restore_with_authn(tmp_path, monkeypatch):
+    """kwokctl snapshot save/restore against an authorization cluster: the
+    runtime must authenticate its own snapshot endpoints (they are
+    protected like everything else)."""
+    import json
+    import os
+
+    from kwok_tpu.kwokctl import netutil
+    from kwok_tpu.kwokctl.cli import main
+
+    monkeypatch.setenv("KWOK_WORKDIR", str(tmp_path))
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("KWOK_TPU_PLATFORM", "cpu")
+
+    name = "e2e-authz-snap"
+    port = netutil.get_unused_port()
+    assert main([
+        "--name", name, "create", "cluster",
+        "--runtime", "mock",
+        "--kube-apiserver-port", str(port),
+        "--kube-authorization", "true",
+        "--wait", "30s",
+    ]) == 0
+    snap = tmp_path / "snap.json"
+    try:
+        url = f"http://127.0.0.1:{port}"
+        token = None
+        kc = open(os.path.join(str(tmp_path), "clusters", name, "kubeconfig.yaml")).read()
+        token = kc.split("token:", 1)[1].strip().split()[0]
+        c = HttpKubeClient(url, token=token)
+        try:
+            c.create("nodes", {"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": "sn1"}})
+            assert main(["--name", name, "snapshot", "save",
+                         "--path", str(snap)]) == 0
+            data = json.loads(snap.read_text())
+            names = [o["metadata"]["name"]
+                     for o in data["objects"].get("nodes", [])]
+            assert "sn1" in names
+            c.delete("nodes", None, "sn1")
+            assert c.get("nodes", None, "sn1") is None
+            assert main(["--name", name, "snapshot", "restore",
+                         "--path", str(snap)]) == 0
+            assert c.get("nodes", None, "sn1") is not None
+        finally:
+            c.close()
+    finally:
+        assert main(["--name", name, "delete", "cluster"]) == 0

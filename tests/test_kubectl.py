@@ -201,3 +201,24 @@ def test_runtime_falls_back_to_builtin_shim(tmp_path, monkeypatch):
     )
     assert out.returncode == 0
     assert "built-in kubectl" in out.stdout
+
+
+def test_get_events_table(srv, kubeconfig, capsys):
+    """kubectl get events: the real column set (LAST SEEN TYPE REASON
+    OBJECT MESSAGE), including scheduler-shaped events."""
+    srv.store.create("events", {
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "ev1", "namespace": "default"},
+        "involvedObject": {"kind": "Pod", "name": "p1", "namespace": "default"},
+        "type": "Normal", "reason": "Scheduled",
+        "message": "Successfully assigned default/p1 to n1",
+    })
+    assert kubectl(kubeconfig, "get", "events") == 0
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert out[0].split() == ["LAST", "SEEN", "TYPE", "REASON", "OBJECT", "MESSAGE"]
+    assert "Scheduled" in out[1]
+    assert "pod/p1" in out[1]
+    assert "Successfully assigned" in out[1]
+    # alias works
+    assert kubectl(kubeconfig, "get", "ev", "-o", "name") == 0
+    assert capsys.readouterr().out.strip() == "event/ev1"
