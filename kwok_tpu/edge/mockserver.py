@@ -667,6 +667,9 @@ class HttpFakeApiserver:
         address: str = "127.0.0.1",
         audit_log_path: str | None = None,
         token: str | None = None,
+        tls_cert_file: str | None = None,
+        tls_key_file: str | None = None,
+        client_ca_file: str | None = None,
     ) -> None:
         self.store = store or FakeKube()
         # bearer-token authentication (kube-apiserver --token-auth-file):
@@ -677,6 +680,37 @@ class HttpFakeApiserver:
         handler = self._make_handler()
         self.httpd = _Server((address, port), handler)  # bind before open:
         # a bind failure must not leak the audit file handle
+        scheme = "http"
+        if tls_cert_file or tls_key_file or client_ca_file:
+            # the kube-apiserver secure port (--tls-cert-file /
+            # --tls-private-key-file); --client-ca-file turns on mTLS, the
+            # transport the binary runtime's secure mode uses. Half a TLS
+            # config must fail hard, not silently serve plaintext on what
+            # the operator believes is the secure port.
+            import ssl
+
+            if not (tls_cert_file and tls_key_file):
+                self.httpd.server_close()
+                raise ValueError(
+                    "TLS needs both tls_cert_file and tls_key_file"
+                )
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            try:
+                ctx.load_cert_chain(tls_cert_file, tls_key_file)
+                if client_ca_file:
+                    ctx.load_verify_locations(client_ca_file)
+                    ctx.verify_mode = ssl.CERT_REQUIRED
+            except (OSError, ssl.SSLError):
+                self.httpd.server_close()
+                raise
+            # handshake in the per-connection handler thread, NOT in the
+            # accept loop — a client stalling mid-handshake must not block
+            # every other accept (the engine's watch re-dials included)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
+            scheme = "https"
         if audit_log_path:
             try:
                 self._audit_file = open(audit_log_path, "a", encoding="utf-8")
@@ -685,7 +719,7 @@ class HttpFakeApiserver:
                 raise
         self.port = self.httpd.server_address[1]
         host = "127.0.0.1" if address in ("", "0.0.0.0") else address
-        self.url = f"http://{host}:{self.port}"
+        self.url = f"{scheme}://{host}:{self.port}"
         self._thread: threading.Thread | None = None
 
     @staticmethod
@@ -751,6 +785,13 @@ class HttpFakeApiserver:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            def setup(self):  # noqa: D401
+                # TLS handshake deferred out of the accept loop (see
+                # __init__): complete it here, in this connection's thread
+                if hasattr(self.request, "do_handshake"):
+                    self.request.do_handshake()
+                super().setup()
             # One TCP segment per response: Nagle on the server side holds
             # the body segment until the client ACKs the header segment, and
             # the client's delayed ACK turns every unary request into a
@@ -983,6 +1024,11 @@ def main(argv=None) -> int:
         help="CSV token file (token,user,uid[,groups]) as kube-apiserver's "
         "--token-auth-file; requests without the token get 401",
     )
+    p.add_argument("--tls-cert-file", default="",
+                   help="serve HTTPS with this certificate")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument("--client-ca-file", default="",
+                   help="require client certificates signed by this CA (mTLS)")
     args = p.parse_args(argv)
     token = None
     if args.token_auth_file:
@@ -1002,6 +1048,9 @@ def main(argv=None) -> int:
         address=args.address,
         audit_log_path=args.audit_log or None,
         token=token,
+        tls_cert_file=args.tls_cert_file or None,
+        tls_key_file=args.tls_private_key_file or None,
+        client_ca_file=args.client_ca_file or None,
     )
     if args.data_file:
         try:

@@ -88,8 +88,16 @@ def _pod_row(o: dict) -> list[str]:
 def _event_row(o: dict) -> list[str]:
     obj = o.get("involvedObject") or o.get("regarding") or {}
     target = f"{(obj.get('kind') or '').lower()}/{obj.get('name') or ''}".strip("/")
+    # LAST SEEN means the last occurrence: lastTimestamp (core/v1),
+    # series.lastObservedTime / eventTime (events.k8s.io), then creation
+    last = (
+        o.get("lastTimestamp")
+        or (o.get("series") or {}).get("lastObservedTime")
+        or o.get("eventTime")
+    )
+    ts_holder = {"metadata": {"creationTimestamp": last}} if last else o
     return [
-        _age(o),
+        _age(ts_holder),
         o.get("type") or "Normal",
         o.get("reason") or "",
         target,

@@ -137,20 +137,29 @@ class Cluster:
         scheme = "https" if conf.securePort else "http"
         return f"{scheme}://127.0.0.1:{conf.kubeApiserverPort}"
 
+    def client_ssl_context(self) -> "ssl.SSLContext | None":
+        """Client TLS context for the cluster's secure port: skip server
+        verification (self-signed CA, kubeconfig.yaml.tpl semantics) and
+        present the admin client cert when the PKI exists. None when the
+        cluster serves plain HTTP."""
+        if not self.config().options.securePort:
+            return None
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        pki = self.workdir_path(PKI_NAME)
+        admin_crt = os.path.join(pki, "admin.crt")
+        if os.path.exists(admin_crt):
+            ctx.load_cert_chain(admin_crt, os.path.join(pki, "admin.key"))
+        return ctx
+
     def ready(self) -> bool:
         """GET /healthz == b"ok" (cluster.go:164-182)."""
         url = self.apiserver_url() + "/healthz"
-        ctx = None
-        if url.startswith("https"):
-            ctx = ssl.create_default_context()
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
-            pki = self.workdir_path(PKI_NAME)
-            admin_crt = os.path.join(pki, "admin.crt")
-            if os.path.exists(admin_crt):
-                ctx.load_cert_chain(admin_crt, os.path.join(pki, "admin.key"))
         try:
-            with urllib.request.urlopen(url, timeout=2, context=ctx) as r:
+            with urllib.request.urlopen(
+                url, timeout=2, context=self.client_ssl_context()
+            ) as r:
                 return r.read() == b"ok"
         except (urllib.error.URLError, OSError):
             return False

@@ -18,7 +18,7 @@ import sys
 
 from kwok_tpu.config.ctl import Component
 from kwok_tpu.kwokctl import components as comp
-from kwok_tpu.kwokctl import k8s
+from kwok_tpu.kwokctl import consts, k8s
 from kwok_tpu.kwokctl.runtime import base
 from kwok_tpu.kwokctl.runtime.binary import BinaryCluster
 
@@ -43,25 +43,28 @@ exec {binary} "$@"
 class MockCluster(BinaryCluster):
     """BinaryCluster with downloads replaced by generated shims."""
 
-    RUNTIME = "mock"
+    RUNTIME = consts.RUNTIME_TYPE_MOCK
 
     def _download_binaries(self) -> None:
         conf = self.config().options
-        conf.securePort = False  # the mock server speaks plain HTTP
         conf.disableKubeControllerManager = True
         conf.disableKubeScheduler = True
         self._write_kwok_shim()
         self._write_apiserver_shim()
 
     def _write_apiserver_shim(self) -> None:
+        conf = self.config().options
         shim = self.bin_path("kube-apiserver")
         os.makedirs(os.path.dirname(shim), exist_ok=True)
         # Prefer the compiled apiserver (same wire protocol, native speed,
         # see native/apiserver.cc); fall back to the Python mockserver shim
-        # when no compiler is available or KWOK_TPU_NATIVE=0.
+        # when no compiler is available or KWOK_TPU_NATIVE=0. Secure mode
+        # always uses the Python server: it terminates TLS with the cluster
+        # PKI and requires client certs, like the binary runtime's
+        # kube-apiserver secure port (the native binary is plaintext-only).
         from kwok_tpu import native
 
-        binary = native.apiserver_binary()
+        binary = None if conf.securePort else native.apiserver_binary()
         if binary:
             content = _APISERVER_NATIVE.format(binary=binary)
         else:
@@ -78,6 +81,12 @@ class MockCluster(BinaryCluster):
         os.makedirs(self.workdir_path("logs"), exist_ok=True)
         if conf.kubeAuditPolicy:
             self._setup_audit_files(conf.kubeAuditPolicy)
+        if conf.securePort:
+            pki_dir = self.workdir_path(base.PKI_NAME)
+            if not os.path.exists(os.path.join(pki_dir, "ca.crt")):
+                from kwok_tpu.kwokctl import pki
+
+                pki.generate_pki(pki_dir)
 
     def _build_components(self) -> None:
         config = self.config()
@@ -102,6 +111,16 @@ class MockCluster(BinaryCluster):
             args += [
                 "--authorization",
                 f"--token-auth-file={self._ensure_token_file()}",
+            ]
+        if conf.securePort:
+            # serve HTTPS with the cluster PKI + require client certs
+            # (kube-apiserver secure-port semantics; PKI minted in
+            # _setup_workdir, reused as server cert like the reference)
+            pki_dir = self.workdir_path(base.PKI_NAME)
+            args += [
+                f"--tls-cert-file={os.path.join(pki_dir, 'admin.crt')}",
+                f"--tls-private-key-file={os.path.join(pki_dir, 'admin.key')}",
+                f"--client-ca-file={os.path.join(pki_dir, 'ca.crt')}",
             ]
         apiserver = Component(
             name="kube-apiserver",
@@ -146,17 +165,20 @@ class MockCluster(BinaryCluster):
         if conf.kubeAuthorization:
             self._ensure_token_file()
             token = self._admin_token() or ""
+        pki_dir = self.workdir_path(base.PKI_NAME)
         data = k8s.build_kubeconfig(
             project_name=self.name,
-            address=f"http://{LOCAL}:{conf.kubeApiserverPort}",
-            secure_port=False,
+            address=self._apiserver_url(),
+            secure_port=bool(conf.securePort),
+            admin_crt_path=os.path.join(pki_dir, "admin.crt"),
+            admin_key_path=os.path.join(pki_dir, "admin.key"),
             token=token,
         )
         with open(self.workdir_path(base.IN_HOST_KUBECONFIG_NAME), "w") as f:
             f.write(data)
 
     def _apiserver_url(self) -> str:
-        return f"http://{LOCAL}:{self.config().options.kubeApiserverPort}"
+        return self.apiserver_url()  # base: scheme follows securePort
 
     def _auth_headers(self) -> dict[str, str]:
         token = self._admin_token()
@@ -170,7 +192,7 @@ class MockCluster(BinaryCluster):
         req = urllib.request.Request(
             self._apiserver_url() + "/snapshot", headers=self._auth_headers()
         )
-        with urllib.request.urlopen(req) as r:
+        with urllib.request.urlopen(req, context=self.client_ssl_context()) as r:
             data = r.read()
         with open(path, "wb") as f:
             f.write(data)
@@ -188,4 +210,4 @@ class MockCluster(BinaryCluster):
             headers={"Content-Type": "application/json", **self._auth_headers()},
             method="POST",
         )
-        urllib.request.urlopen(req).read()
+        urllib.request.urlopen(req, context=self.client_ssl_context()).read()

@@ -65,13 +65,17 @@ def set_defaults(opts: KwokctlConfigurationOptions) -> KwokctlConfigurationOptio
         opts.kubeVersion = "v" + opts.kubeVersion
     release = k8s.parse_release(opts.kubeVersion)
 
+    opts.runtime = _env("RUNTIME", opts.runtime or consts.RUNTIME_TYPE_BINARY)
+
     if opts.securePort is None:
         # insecure serving was removed after 1.19; the reference's cutover
-        # (vars.go:118) keys on >1.12
-        opts.securePort = release > 12
+        # (vars.go:118) keys on >1.12. The mock runtime defaults to plain
+        # HTTP (the native lab apiserver is plaintext-only); an explicit
+        # --secure-port=true still turns on mTLS with the cluster PKI.
+        opts.securePort = (
+            release > 12 and opts.runtime != consts.RUNTIME_TYPE_MOCK
+        )
     opts.securePort = _env("SECURE_PORT", opts.securePort)
-
-    opts.runtime = _env("RUNTIME", opts.runtime or consts.RUNTIME_TYPE_BINARY)
     opts.mode = _env("MODE", opts.mode)
     opts.quietPull = _env("QUIET_PULL", opts.quietPull)
     opts.disableKubeScheduler = _env(
