@@ -652,11 +652,23 @@ def seed_bootstrap_rbac(store: FakeKube) -> None:
                 store.create(kind, doc)
 
 
+class _HandshakeFailed(Exception):
+    """TLS handshake rejected/timed out — normal under mTLS (cert-less
+    dials, mis-scheme probes); closed quietly, no traceback."""
+
+
 class _Server(ThreadingHTTPServer):
     # the default backlog of 5 drops connections under bursty load
     # (benchmark cases open ~1k sockets while patch workers hold 16 more)
     request_queue_size = 256
     daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        if isinstance(sys.exc_info()[1], _HandshakeFailed):
+            return
+        super().handle_error(request, client_address)
 
 
 class HttpFakeApiserver:
@@ -788,9 +800,18 @@ class HttpFakeApiserver:
 
             def setup(self):  # noqa: D401
                 # TLS handshake deferred out of the accept loop (see
-                # __init__): complete it here, in this connection's thread
+                # __init__): complete it here, in this connection's thread.
+                # Bounded, and rejections stay quiet — a silent or
+                # cert-less client must neither pin this thread forever nor
+                # spam the component log with tracebacks (ssl.SSLError and
+                # socket.timeout are both OSError).
                 if hasattr(self.request, "do_handshake"):
-                    self.request.do_handshake()
+                    self.request.settimeout(10)
+                    try:
+                        self.request.do_handshake()
+                    except OSError as e:
+                        raise _HandshakeFailed() from e
+                    self.request.settimeout(None)
                 super().setup()
             # One TCP segment per response: Nagle on the server side holds
             # the body segment until the client ACKs the header segment, and

@@ -187,3 +187,94 @@ def test_mock_cluster_secure_port(tmp_path, monkeypatch):
             c.close()
     finally:
         assert main(["--name", name, "delete", "cluster"]) == 0
+
+
+def test_in_cluster_client_path(pki_dir, tmp_path, monkeypatch):
+    """The kustomize Deployment's credential path: in-cluster env vars +
+    serviceaccount token/ca.crt (root.go rest.InClusterConfig parity).
+    The client VERIFIES the server certificate against the SA ca.crt
+    (hostname check included — the admin cert's 127.0.0.1 SAN) and
+    authenticates with the bearer token."""
+    import shutil
+
+    from kwok_tpu.edge import httpclient
+
+    store = FakeKube()
+    store.create("nodes", make_node("ic-n1"))
+    srv = HttpFakeApiserver(
+        store=store,
+        token="sa-token-123",
+        tls_cert_file=os.path.join(pki_dir, "admin.crt"),
+        tls_key_file=os.path.join(pki_dir, "admin.key"),
+    ).start()
+    try:
+        sa = tmp_path / "serviceaccount"
+        sa.mkdir()
+        (sa / "token").write_text("sa-token-123")
+        shutil.copyfile(os.path.join(pki_dir, "ca.crt"), sa / "ca.crt")
+        monkeypatch.setattr(httpclient, "_SA_DIR", str(sa))
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "127.0.0.1")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", str(srv.port))
+        monkeypatch.setenv("KUBECONFIG", str(tmp_path / "nonexistent"))
+        monkeypatch.setattr(
+            os.path, "expanduser",
+            lambda p: str(tmp_path / "nohome") if p.startswith("~") else p,
+        )
+
+        c = HttpKubeClient.from_kubeconfig()
+        try:
+            assert [n["metadata"]["name"] for n in c.list("nodes")] == ["ic-n1"]
+            assert c.healthz()
+        finally:
+            c.close()
+    finally:
+        srv.stop()
+
+
+def test_stalled_and_plaintext_clients_are_bounded(pki_dir):
+    """A client that never sends a ClientHello must not pin a handler
+    thread past the handshake timeout, and rejected handshakes must not
+    traceback-spam stderr (they are this feature's normal path)."""
+    import socket
+    import threading
+
+    srv = HttpFakeApiserver(
+        store=FakeKube(),
+        tls_cert_file=os.path.join(pki_dir, "admin.crt"),
+        tls_key_file=os.path.join(pki_dir, "admin.key"),
+        client_ca_file=os.path.join(pki_dir, "ca.crt"),
+    ).start()
+    try:
+        before = threading.active_count()
+        # silent client: connects, says nothing
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        # plaintext probe: speaks HTTP to the TLS port
+        p = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        p.sendall(b"GET /healthz HTTP/1.1\r\nHost: x\r\n\r\n")
+        time.sleep(0.5)
+        # the server must still serve a proper mTLS client meanwhile
+        import ssl
+
+        ctx = ssl.create_default_context(
+            cafile=os.path.join(pki_dir, "ca.crt")
+        )
+        ctx.check_hostname = False
+        ctx.load_cert_chain(
+            os.path.join(pki_dir, "admin.crt"),
+            os.path.join(pki_dir, "admin.key"),
+        )
+        with urllib.request.urlopen(
+            srv.url + "/healthz", context=ctx, timeout=5
+        ) as r:
+            assert r.read() == b"ok"
+        s.close()
+        p.close()
+        # handshake timeout is 10s; give the reaper a little slack
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if threading.active_count() <= before + 1:
+                break
+            time.sleep(0.5)
+        assert threading.active_count() <= before + 1, "stalled TLS threads leaked"
+    finally:
+        srv.stop()
