@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Secure-port e2e: `create cluster --secure-port` serves the apiserver over
+# TLS with the cluster PKI and REQUIRES client certificates; the engine and
+# the kubectl verb authenticate via the kubeconfig's admin cert pair. This
+# is the transport of the reference's binary runtime secure mode
+# (components/kube_apiserver.go secure args; kubeconfig.yaml.tpl client
+# certs), runnable without upstream binaries.
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+CLUSTER="e2e-secure"
+cleanup() {
+  kwokctl --name "${CLUSTER}" delete cluster >/dev/null 2>&1 || true
+}
+trap cleanup EXIT
+
+for runtime in ${KWOK_TPU_E2E_RUNTIMES:-mock}; do
+  echo "secure: runtime=${runtime}"
+  kwokctl --name "${CLUSTER}" create cluster --runtime "${runtime}" \
+    --secure-port=true --wait 60s
+
+  KC="$(kwokctl --name "${CLUSTER}" get kubeconfig)"
+  URL="$(awk '/server:/ {print $2; exit}' "${KC}")"
+  case "${URL}" in
+    https://*) ;;
+    *) echo "expected an https server in the kubeconfig, got ${URL}" >&2
+       exit 1 ;;
+  esac
+  grep -q "client-certificate:" "${KC}"
+
+  # a cert-less client is rejected at the TLS layer
+  if curl -ksS --max-time 5 "${URL}/api/v1/nodes" >/dev/null 2>&1; then
+    echo "cert-less request unexpectedly succeeded" >&2
+    exit 1
+  fi
+
+  # the kubectl verb authenticates via the kubeconfig certs
+  pyrun -m kwok_tpu.kubectl --kubeconfig "${KC}" apply -f - <<'EOF'
+apiVersion: v1
+kind: Node
+metadata:
+  name: secure-node
+EOF
+  node_ready_via_kubectl() {
+    pyrun -m kwok_tpu.kubectl --kubeconfig "${KC}" get nodes --no-headers \
+      | grep -q "secure-node *Ready"
+  }
+  retry 30 node_ready_via_kubectl
+
+  kwokctl --name "${CLUSTER}" delete cluster
+done
+
+echo "kwokctl_secure_test.sh passed"
