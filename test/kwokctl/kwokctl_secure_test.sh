@@ -48,6 +48,13 @@ EOF
   }
   retry 30 node_ready_via_kubectl
 
+  # stop/start: PKI and cmdlines persist in the workdir, the secure
+  # cluster comes back and the engine re-locks state (restart parity,
+  # kwokctl_restart_test.sh, over the TLS transport)
+  kwokctl --name "${CLUSTER}" stop cluster
+  kwokctl --name "${CLUSTER}" start cluster
+  retry 60 node_ready_via_kubectl
+
   kwokctl --name "${CLUSTER}" delete cluster
 done
 
