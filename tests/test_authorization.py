@@ -254,3 +254,60 @@ def test_snapshot_restore_with_authn(tmp_path, monkeypatch):
             c.close()
     finally:
         assert main(["--name", name, "delete", "cluster"]) == 0
+
+
+def test_federation_members_share_kubeconfig_credentials(tmp_path):
+    """`--master a,b` federation: every member client inherits the
+    kubeconfig's bearer token (the URL list only overrides the server),
+    so a federation over authorized apiservers authenticates end to end."""
+    import time
+
+    from kwok_tpu.engine import EngineConfig, FederatedEngine
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+
+    servers = [
+        HttpFakeApiserver(store=FakeKube(), token=TOKEN).start()
+        for _ in range(2)
+    ]
+    kc = tmp_path / "kc.yaml"
+    kc.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: t\n"
+        "contexts:\n  - name: t\n    context:\n      cluster: t\n"
+        "      user: t\n"
+        f"clusters:\n  - name: t\n    cluster:\n      server: {servers[0].url}\n"
+        f"users:\n  - name: t\n    user:\n      token: {TOKEN}\n"
+    )
+    clients = [
+        HttpKubeClient.from_kubeconfig(str(kc), master=s.url) for s in servers
+    ]
+    fed = FederatedEngine(
+        clients, EngineConfig(manage_all_nodes=True, tick_interval=0.05)
+    )
+    fed.start()
+    try:
+        for i, s in enumerate(servers):
+            s.store.create(
+                "nodes",
+                {"apiVersion": "v1", "kind": "Node",
+                 "metadata": {"name": f"fa-n{i}"}},
+            )
+        deadline = time.time() + 30
+        def ready(s, name):
+            n = s.store.get("nodes", None, name) or {}
+            conds = {
+                c.get("type"): c.get("status")
+                for c in (n.get("status") or {}).get("conditions", [])
+            }
+            return conds.get("Ready") == "True"
+        while time.time() < deadline:
+            if all(ready(s, f"fa-n{i}") for i, s in enumerate(servers)):
+                break
+            time.sleep(0.2)
+        for i, s in enumerate(servers):
+            assert ready(s, f"fa-n{i}"), f"member {i} never authenticated"
+    finally:
+        fed.stop()
+        for c in clients:
+            c.close()
+        for s in servers:
+            s.stop()
