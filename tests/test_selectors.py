@@ -1,0 +1,63 @@
+"""Label-selector grammar (edge/selectors.py): the full apimachinery
+labels.Parse surface the reference relies on for manage/disregard
+selectors (controller.go:81-111)."""
+
+from __future__ import annotations
+
+import pytest
+
+from kwok_tpu.edge.selectors import parse_selector
+
+
+def m(expr, labels):
+    sel = parse_selector(expr)
+    assert sel is not None
+    return sel.matches(labels)
+
+
+def test_equality_forms():
+    assert m("a=b", {"a": "b"})
+    assert m("a==b", {"a": "b"})
+    assert not m("a=b", {"a": "c"})
+    assert not m("a=b", {})
+
+
+def test_inequality_matches_absent_key():
+    # apimachinery semantics: != and notin also match when the key is absent
+    assert m("a!=b", {"a": "c"})
+    assert m("a!=b", {})
+    assert not m("a!=b", {"a": "b"})
+    assert m("a notin (b,c)", {})
+    assert m("a notin (b,c)", {"a": "d"})
+    assert not m("a notin (b,c)", {"a": "c"})
+
+
+def test_set_forms():
+    assert m("a in (x,y)", {"a": "x"})
+    assert not m("a in (x,y)", {"a": "z"})
+    assert not m("a in (x,y)", {})
+
+
+def test_existence_forms():
+    assert m("a", {"a": ""})
+    assert not m("a", {})
+    assert m("!a", {})
+    assert not m("!a", {"a": "v"})
+
+
+def test_comma_joined_requirements_are_anded():
+    expr = "tier=fake, region in (us,eu), !deprecated, env!=prod"
+    assert m(expr, {"tier": "fake", "region": "us"})
+    assert not m(expr, {"tier": "fake", "region": "ap"})
+    assert not m(expr, {"tier": "fake", "region": "us", "deprecated": "1"})
+    assert not m(expr, {"tier": "fake", "region": "us", "env": "prod"})
+
+
+def test_empty_selector_matches_everything():
+    sel = parse_selector("")
+    assert sel is None or sel.matches({"anything": "x"})
+
+
+def test_none_labels():
+    assert parse_selector("a!=b").matches(None)
+    assert not parse_selector("a=b").matches(None)
