@@ -61,3 +61,25 @@ def test_empty_selector_matches_everything():
 def test_none_labels():
     assert parse_selector("a!=b").matches(None)
     assert not parse_selector("a=b").matches(None)
+
+
+# ------------------------------------------------ field selectors
+
+
+def test_field_selector_forms():
+    from kwok_tpu.edge.kubeclient import match_field_selector
+
+    bound = {"spec": {"nodeName": "n1"}, "metadata": {"name": "p"}}
+    unbound = {"spec": {}, "metadata": {"name": "p"}}
+    # the engine's pushdown: spec.nodeName!= (non-empty)
+    assert match_field_selector(bound, "spec.nodeName!=")
+    assert not match_field_selector(unbound, "spec.nodeName!=")
+    # equality, == alias, dotted paths, comma-joined terms
+    assert match_field_selector(bound, "spec.nodeName=n1")
+    assert match_field_selector(bound, "spec.nodeName==n1")
+    assert not match_field_selector(bound, "spec.nodeName=n2")
+    assert match_field_selector(bound, "spec.nodeName=n1,metadata.name=p")
+    assert not match_field_selector(bound, "spec.nodeName=n1,metadata.name=q")
+    # empty/missing selector matches everything
+    assert match_field_selector(unbound, "")
+    assert match_field_selector(unbound, None)
