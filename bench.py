@@ -386,11 +386,16 @@ if __name__ == "__main__":
     if os.environ.get("KWOK_BENCH_CPU_FALLBACK"):
         # a single CPU core cannot turn over 1M rows in a sane bench
         # budget; the metric line reports the actual sizes + platform.
-        # STEPS too: per_window floors at 1 dispatch, so the TPU default of
-        # 120 fused steps would run 3*120 timed CPU ticks regardless of
-        # TICKS (large STEPS only pays where dispatch latency dominates)
-        N_PODS = 250_000
-        N_NODES = 2_500
+        # Explicit KWOK_BENCH_* knobs always win over the fallback's
+        # shrinking — the user asked for those sizes by name.
+        # STEPS shrinks too: per_window floors at 1 dispatch, so the TPU
+        # default of 120 fused steps would run 3*120 timed CPU ticks
+        # regardless of TICKS (large STEPS only pays where dispatch
+        # latency dominates)
+        if "KWOK_BENCH_PODS" not in os.environ:
+            N_PODS = 250_000
+        if "KWOK_BENCH_NODES" not in os.environ:
+            N_NODES = 2_500
         TICKS = 60
         if "KWOK_BENCH_STEPS" not in os.environ:
             STEPS = 10

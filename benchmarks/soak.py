@@ -33,6 +33,7 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 
@@ -205,8 +206,19 @@ def _load_worker_entry() -> None:
             "status": {"phase": "Pending"},
         })
         if bind == "1":
-            # bind the way the real scheduler does: POST .../binding
-            client.bind("default", f"soak-pod-{i}", f"soak-node-{i % nodes}")
+            # bind the way the real scheduler does: POST .../binding.
+            # Non-idempotent + the client's one-shot retry on dead
+            # keep-alive connections: if the first attempt was applied but
+            # its response lost, the retry 409s — that IS success (the
+            # target is ours; real schedulers treat bind conflicts the
+            # same way).
+            try:
+                client.bind(
+                    "default", f"soak-pod-{i}", f"soak-node-{i % nodes}"
+                )
+            except urllib.error.HTTPError as e:
+                if e.code != 409:
+                    raise
 
     list(pool.map(one, range(lo, hi)))
 

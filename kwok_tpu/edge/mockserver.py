@@ -448,8 +448,11 @@ def _match_path(path: str):
         or _RBAC_PATHS.match(path)
         or _EVENTS_PATHS.match(path)
     )
-    # the binding subresource exists only under pods (real apiserver: 404)
+    # subresources exist only where the real apiserver serves them:
+    # binding under pods, status under nodes/pods (404 otherwise)
     if m and m.group("sub") == "binding" and m.group("kind") != "pods":
+        return None
+    if m and m.group("sub") == "status" and m.group("kind") not in ("nodes", "pods"):
         return None
     return m
 
@@ -852,6 +855,12 @@ class HttpFakeApiserver:
                 got = self.headers.get("Authorization") or ""
                 if got == f"Bearer {server_obj.token}":
                     return True
+                # drain the unread request body before responding, or the
+                # next request on this keep-alive connection is parsed
+                # starting at the leftover body bytes
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    self.rfile.read(n)
                 self._send_json(
                     {
                         "kind": "Status",

@@ -145,7 +145,11 @@ class FederatedEngine:
                 _table_bytes(e.nodes.table),
                 _table_bytes(e.pods.table),
                 # everything _Group bakes into the jitted kernel must be in
-                # the key, or differing members would silently coalesce
+                # the key, or differing members would silently coalesce —
+                # including the heartbeat SELECTOR BIT: rule sets differing
+                # only in selector names compile to identical numeric
+                # tables but different bit assignments
+                int(e.node_bits[SEL_HEARTBEAT]),
                 float(cfg.heartbeat_interval),
                 float(cfg.tick_interval),
                 int(getattr(cfg, "tick_substeps", 1)),

@@ -276,6 +276,9 @@ def _run(args, client: HttpKubeClient) -> int:
         return 0
 
     if args.verb in ("apply", "create"):
+        # real kubectl processes EVERY document and aggregates the exit
+        # code rather than aborting at the first failure
+        rc = 0
         for doc in _load_docs(args.filename):
             kind, ns, name = _doc_target(doc)
             existing = client.get(kind, ns, name)
@@ -288,7 +291,7 @@ def _run(args, client: HttpKubeClient) -> int:
                     f'"{name}" already exists',
                     file=sys.stderr,
                 )
-                return 1
+                rc = 1
             else:
                 # kubectl apply updates the client-owned sections; the mock
                 # servers' merge-patch on metadata+spec models that (status
@@ -298,7 +301,7 @@ def _run(args, client: HttpKubeClient) -> int:
                     {k: doc[k] for k in ("metadata", "spec") if k in doc},
                 )
                 print(f"{_singular(kind)}/{name} configured")
-        return 0
+        return rc
 
     if args.verb == "delete":
         targets: list[tuple[str, str | None, str]] = []
@@ -310,6 +313,7 @@ def _run(args, client: HttpKubeClient) -> int:
             targets = [(kind, ns, n) for n in args.args[1:]]
         else:
             raise SystemExit("error: specify KIND NAME or -f FILE")
+        rc = 0
         for kind, ns, name in targets:
             if client.get(kind, ns, name) is None:
                 print(
@@ -317,10 +321,11 @@ def _run(args, client: HttpKubeClient) -> int:
                     f'"{name}" not found',
                     file=sys.stderr,
                 )
-                return 1
+                rc = 1
+                continue
             client.delete(kind, ns, name, grace_seconds=args.grace_period)
             print(f'{_singular(kind)} "{name}" deleted')
-        return 0
+        return rc
 
     raise SystemExit(f"error: unknown verb {args.verb}")
 

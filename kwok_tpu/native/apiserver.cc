@@ -1087,9 +1087,11 @@ static PathMatch match_path(const std::string& path) {
     i++;
   }
   if (i < parts.size()) {
-    if (parts[i] == "status") m.status = true;
+    // subresources exist only where the real apiserver serves them:
+    // status under nodes/pods, binding under pods (404 otherwise)
+    if (parts[i] == "status" && m.kind <= 1) m.status = true;
     else if (parts[i] == "binding" && m.kind == 1) m.binding = true;
-    else return m;  // binding exists only under pods (real apiserver: 404)
+    else return m;
     i++;
   }
   if (i != parts.size()) return m;

@@ -224,3 +224,50 @@ def test_federated_heterogeneous_rules():
         assert m["pods_managed"] == 3
     finally:
         fed.stop()
+
+
+def test_grouping_keys_on_selector_bits_not_just_tables():
+    """Rule sets differing only in SELECTOR NAMES compile to identical
+    numeric tables but different selector-bit assignments (the heartbeat
+    bit is appended after the table's own names). Such members must NOT
+    coalesce into one kernel group — the group bakes e0's heartbeat bit."""
+    import dataclasses as dc
+
+    from kwok_tpu.models.lifecycle import (
+        Delay,
+        LifecycleRule,
+        ResourceKind,
+        StatusEffect,
+    )
+
+    renamed_node_rules = [
+        LifecycleRule(
+            name="node-ready",
+            resource=ResourceKind.NODE,
+            from_phases=("Observed", "NotReady"),
+            selector="custom-managed",  # same table bytes, different bits
+            delay=Delay.constant(0.0),
+            effect=StatusEffect(
+                to_phase="Ready",
+                conditions={
+                    "Ready": True,
+                    "OutOfDisk": False,
+                    "MemoryPressure": False,
+                    "DiskPressure": False,
+                    "NetworkUnavailable": False,
+                    "PIDPressure": False,
+                },
+            ),
+        )
+    ]
+    base = EngineConfig(manage_all_nodes=True, tick_interval=0.05)
+    fed = FederatedEngine(
+        [FakeKube(), FakeKube()],
+        base,
+        member_configs=[base, dc.replace(base, node_rules=renamed_node_rules)],
+    )
+    hb_bits = {e.node_bits["heartbeat"] for e in fed.engines}
+    assert len(hb_bits) == 2, "precondition: the rename must shift the hb bit"
+    assert len(fed.groups) == 2, (
+        "members with different heartbeat bits coalesced into one group"
+    )
