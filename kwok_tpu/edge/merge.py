@@ -62,7 +62,17 @@ def _sanitize(v: Any, mk: dict[str, str], field: str | None, *, copies: bool) ->
     stored object must never contain $patch markers or nulls (the real
     apiserver discards unmatched nulls — strategicpatch IgnoreUnmatchedNulls
     — and directives are instructions, not data). Equivalent to merging the
-    subtree into an empty value, recursively."""
+    subtree into an empty value, recursively.
+
+    KNOWN DIVERGENCE from upstream strategicpatch removeDirectives (which
+    only strips the $patch key on fresh inserts and keeps all remaining
+    content): here a fresh-inserted map carrying `$patch: delete` becomes
+    {} (the directive is honored against the absent original), and
+    directive-carrying merge-list elements are dropped rather than kept
+    marker-stripped. Deliberate tolerant behavior, mirrored by the
+    independent oracle (tests/merge_oracle.py) and the C++ server
+    (native/apiserver.cc sanitize_patch); engine-rendered traffic never
+    contains directives, so only hand-crafted patches can observe it."""
     if _clean(v):
         return copy.deepcopy(v) if copies else v
     if isinstance(v, dict):

@@ -88,6 +88,13 @@ KINDS = (
     "events",
 )
 
+# the real apiserver expires events on a ~1h etcd lease (kube-apiserver
+# --event-ttl, re-leased on every write); the mock bounds the store by count
+# instead (least-recently-written evicted on insert) so long soaks with a
+# real scheduler can't grow it without bound. Overridable for tests;
+# <= 0 means unbounded.
+EVENTS_CAP = int(os.environ.get("KWOK_TPU_EVENTS_CAP", "4096"))
+
 
 class FakeKube:
     """kinds: "nodes"/"clusterroles"/"clusterrolebindings" (cluster-scoped),
@@ -159,6 +166,25 @@ class FakeKube:
         self._bump(obj, kind, key)
         self._store[kind][key] = obj
         self._emit(kind, ADDED, obj)
+        if (
+            kind == "events"
+            and EVENTS_CAP > 0
+            and len(self._store[kind]) > EVENTS_CAP
+        ):
+            # the real apiserver expires events on a ~1h etcd lease
+            # (re-leased on every write); an unbounded store would grow
+            # forever under a real scheduler's event stream and bloat every
+            # /snapshot. Evict the least-recently-written event (smallest
+            # resourceVersion — server-stamped on every mutation); never
+            # the just-created one, whose rv is the newest. Mirrors
+            # apiserver.cc. cap <= 0 means unbounded.
+            evs = self._store[kind]
+            old_key = min(
+                evs, key=lambda k: int(evs[k]["metadata"]["resourceVersion"])
+            )
+            old = evs.pop(old_key)
+            self._json[kind].pop(old_key, None)
+            self._emit(kind, DELETED, old)
         return key
 
     def create(self, kind: str, obj: dict) -> dict:
@@ -688,8 +714,13 @@ class HttpFakeApiserver:
     ) -> None:
         self.store = store or FakeKube()
         # bearer-token authentication (kube-apiserver --token-auth-file):
-        # when set, every request except /healthz must carry it
-        self.token = token
+        # when set, every request except /healthz must carry one of the
+        # accepted tokens. The real apiserver accepts every row of the CSV,
+        # so a str-or-iterable is normalized to a set here.
+        self.tokens: frozenset[str] | None = (
+            None if token is None
+            else frozenset([token] if isinstance(token, str) else token)
+        )
         self._audit_lock = threading.Lock()
         self._audit_file = None
         handler = self._make_handler()
@@ -850,10 +881,10 @@ class HttpFakeApiserver:
                 """kube-apiserver token authn: /healthz stays anonymous (the
                 components' --authorization-always-allow-paths contract);
                 everything else 401s without the bearer token."""
-                if server_obj.token is None:
+                if server_obj.tokens is None:
                     return True
                 got = self.headers.get("Authorization") or ""
-                if got == f"Bearer {server_obj.token}":
+                if got.startswith("Bearer ") and got[7:] in server_obj.tokens:
                     return True
                 # drain the unread request body before responding, or the
                 # next request on this keep-alive connection is parsed
@@ -1018,6 +1049,18 @@ class HttpFakeApiserver:
 
         return Handler
 
+def load_token_file(path: str) -> frozenset[str]:
+    """kube-apiserver --token-auth-file CSV (token,user,uid[,groups]):
+    every row is an accepted credential — the real apiserver authenticates
+    against the whole file, not just its first line. Blank rows are
+    skipped; an empty result means the file is unusable (callers fail
+    hard rather than degrade to anonymous)."""
+    with open(path) as f:
+        return frozenset(
+            tok for line in f if (tok := line.strip().split(",", 1)[0])
+        )
+
+
 def main(argv=None) -> int:
     """Standalone mock apiserver: `--port N` then serve forever."""
     import argparse
@@ -1062,9 +1105,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     token = None
     if args.token_auth_file:
-        with open(args.token_auth_file) as f:
-            first = f.readline().strip()
-        token = first.split(",", 1)[0] if first else ""
+        token = load_token_file(args.token_auth_file)
         if not token:
             # an unusable token file must fail hard, not degrade to
             # anonymous (the real kube-apiserver refuses to start too)

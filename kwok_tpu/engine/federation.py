@@ -124,7 +124,15 @@ class FederatedEngine:
         self.mesh = mesh if mesh is not None else make_mesh()
         d = int(self.mesh.devices.size)
         cfgs = member_configs if member_configs is not None else [config] * len(clients)
-        base_capacity = max(int(config.initial_capacity), 1)
+        # the stacked tick holds every member's rows in one [n_members, cap]
+        # array, so capacity must be uniform — honor heterogeneous
+        # member_configs by sizing for the largest request (a member asking
+        # for more capacity gets it; nobody is silently undersized)
+        base_capacity = max(
+            1,
+            int(config.initial_capacity),
+            *(int(c.initial_capacity) for c in cfgs),
+        )
 
         self.engines = [
             ClusterEngine(

@@ -271,7 +271,9 @@ def _run(args, client: HttpKubeClient) -> int:
                     all_namespaces=args.all_namespaces,
                     no_headers=args.no_headers,
                 )
-        if not per_kind:
+        if not per_kind and args.output not in ("json", "name"):
+            # real kubectl stays silent on empty results under -o json /
+            # -o name (scripts capture both streams)
             print("No resources found", file=sys.stderr)
         return 0
 

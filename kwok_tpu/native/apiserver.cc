@@ -43,6 +43,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <utility>
@@ -622,7 +623,11 @@ static bool patch_clean(const JVal& v) {
 
 // A patch subtree inserted where the original has no value: stored objects
 // must never contain $patch markers or nulls (mirrors merge.py _sanitize /
-// strategicpatch IgnoreUnmatchedNulls).
+// strategicpatch IgnoreUnmatchedNulls). Known divergence from upstream
+// removeDirectives, shared by all three in-repo implementations (see the
+// merge.py _sanitize docstring): a fresh-inserted $patch:delete map becomes
+// {} and directive-carrying merge-list elements are dropped, where upstream
+// merely strips the marker and keeps the content.
 static JVal sanitize_patch(const JVal& v, const std::string& field) {
   if (patch_clean(v)) return v;
   if (v.type == JVal::OBJ) {
@@ -830,6 +835,20 @@ static int kind_index(const std::string& kind) {
   for (int i = 0; i < NKINDS; i++)
     if (kind == KIND_NAMES[i]) return i;
   return -1;
+}
+
+// the real apiserver expires events on a ~1h etcd lease (--event-ttl,
+// re-leased on every write); the mock bounds the events store by count
+// instead — the least-recently-WRITTEN event (smallest resourceVersion) is
+// evicted on insert — so long soaks with a real scheduler can't grow it
+// without bound. Mirrors mockserver.py EVENTS_CAP; same env override;
+// cap <= 0 means unbounded.
+static int events_cap() {
+  static const int cap = [] {
+    const char* v = getenv("KWOK_TPU_EVENTS_CAP");
+    return v && *v ? atoi(v) : 4096;
+  }();
+  return cap;
 }
 
 struct Store {
@@ -1123,7 +1142,9 @@ struct App {
   std::mutex audit_mu;
   FILE* audit = nullptr;
   std::string data_file;
-  std::string auth_token;  // --token-auth-file bearer token ("" = authn off)
+  // --token-auth-file bearer tokens, one per CSV row (empty = authn off);
+  // kube-apiserver accepts every row of the file, not just the first
+  std::set<std::string> auth_tokens;
   int listen_fd = -1;
   std::atomic<bool> stopping{false};
 
@@ -1334,7 +1355,9 @@ bool App::handle_request(int fd, Request& req) {
     return respond(200, "ok");
   // bearer-token authn (--token-auth-file): /healthz stays anonymous (the
   // components' --authorization-always-allow-paths contract)
-  if (!auth_token.empty() && req.auth != "Bearer " + auth_token)
+  if (!auth_tokens.empty() &&
+      (req.auth.rfind("Bearer ", 0) != 0 ||
+       !auth_tokens.count(req.auth.substr(7))))
     return respond(401,
                    "{\"kind\":\"Status\",\"apiVersion\":\"v1\","
                    "\"status\":\"Failure\",\"reason\":\"Unauthorized\","
@@ -1618,6 +1641,30 @@ bool App::handle_request(int fd, Request& req) {
         e = publish(std::move(obj));
         store.kinds[m.kind][k] = e;
         store.emit(m.kind, "ADDED", e->obj, &e->bytes);
+        if (m.kind == kind_index("events") && events_cap() > 0) {
+          auto& evs = store.kinds[m.kind];
+          while ((int)evs.size() > events_cap()) {
+            // evict the least-recently-written event: smallest numeric
+            // resourceVersion (always server-stamped digits — bump()
+            // overwrites it on every mutation). O(cap) scan, paid only
+            // past the cap; never the just-created entry (its rv is the
+            // newest).
+            auto victim = evs.end();
+            long long best = 0;
+            for (auto it2 = evs.begin(); it2 != evs.end(); ++it2) {
+              const JVal* mv = it2->second->obj.find("metadata");
+              const JVal* rv = mv ? mv->find("resourceVersion") : nullptr;
+              long long n = rv ? atoll(rv->s.c_str()) : 0;
+              if (victim == evs.end() || n < best) {
+                victim = it2;
+                best = n;
+              }
+            }
+            EntryPtr oe = victim->second;
+            evs.erase(victim);
+            store.emit(m.kind, "DELETED", oe->obj, &oe->bytes);
+          }
+        }
       }
     }
     if (!e) return respond(400, "{\"kind\":\"Status\",\"code\":400}");
@@ -1809,16 +1856,22 @@ int main(int argc, char** argv) {
       fprintf(stderr, "cannot open token file %s\n", token_file.c_str());
       return 1;
     }
-    char line[4096];
-    if (fgets(line, sizeof line, f)) {
-      std::string first = line;
-      first.erase(first.find_last_not_of(" \t\r\n") + 1);
-      size_t comma = first.find(',');
-      app.auth_token =
-          comma == std::string::npos ? first : first.substr(0, comma);
+    // getline, not a fixed fgets buffer: a row longer than the buffer
+    // would be split into chunks and each chunk's prefix registered as a
+    // bogus accepted token — an authn loosening, not just a parse bug
+    char* lineptr = nullptr;
+    size_t linecap = 0;
+    while (getline(&lineptr, &linecap, f) != -1) {
+      std::string row = lineptr;
+      row.erase(row.find_last_not_of(" \t\r\n") + 1);
+      size_t comma = row.find(',');
+      std::string tok =
+          comma == std::string::npos ? row : row.substr(0, comma);
+      if (!tok.empty()) app.auth_tokens.insert(tok);
     }
+    free(lineptr);
     fclose(f);
-    if (app.auth_token.empty()) {
+    if (app.auth_tokens.empty()) {
       // an unusable token file must fail hard, not degrade to anonymous
       fprintf(stderr, "token file %s has no token\n", token_file.c_str());
       return 1;

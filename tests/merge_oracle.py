@@ -57,7 +57,13 @@ def _strip_markers(value: Any, field_name: str | None = None) -> Any:
     real apiserver never persists directives, and unmatched nulls are
     ignored (strategicpatch IgnoreUnmatchedNulls). Merge-list directives
     are no-ops against an absent original. Scalars and atomic lists are
-    opaque values, passed through verbatim."""
+    opaque values, passed through verbatim.
+
+    KNOWN DIVERGENCE from upstream removeDirectives, mirrored deliberately
+    by all three in-repo implementations (see merge.py _sanitize): upstream
+    keeps a fresh-inserted `$patch: delete` map's remaining content and
+    keeps directive-carrying list elements marker-stripped; this family
+    honors the delete (-> {}) and drops directive elements."""
     if isinstance(value, dict):
         if value.get(DIRECTIVE) == "delete":
             return {}

@@ -311,3 +311,48 @@ def test_federation_members_share_kubeconfig_credentials(tmp_path):
             c.close()
         for s in servers:
             s.stop()
+
+
+# ------------------------------------------- multi-row token files (r3)
+
+
+def test_python_server_accepts_every_token_row(tmp_path):
+    """--token-auth-file semantics: every CSV row is a credential (the
+    real kube-apiserver authenticates against the whole file)."""
+    from kwok_tpu.edge.mockserver import load_token_file
+
+    token_file = tmp_path / "tokens.csv"
+    token_file.write_text(
+        f'{TOKEN},kwok-admin,uid-1,"system:masters"\n'
+        "second-token,reader,uid-2\n"
+        "\n"  # blank rows are skipped
+        "third-token,other,uid-3\n"
+    )
+    tokens = load_token_file(str(token_file))
+    assert tokens == {TOKEN, "second-token", "third-token"}
+
+    srv = HttpFakeApiserver(store=FakeKube(), token=tokens).start()
+    try:
+        for tok in (TOKEN, "second-token", "third-token"):
+            assert _status_code(f"{srv.url}/api/v1/nodes", token=tok) == 200
+        assert _status_code(f"{srv.url}/api/v1/nodes", token="nope") == 401
+        assert _status_code(f"{srv.url}/api/v1/nodes") == 401
+    finally:
+        srv.stop()
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_native_server_accepts_every_token_row(tmp_path):
+    from tests.test_native_apiserver import NativeServer
+
+    token_file = tmp_path / "tokens.csv"
+    token_file.write_text(
+        f"{TOKEN},kwok-admin,uid-1\nsecond-token,reader,uid-2\n"
+    )
+    srv = NativeServer(args=("--token-auth-file", str(token_file)))
+    try:
+        for tok in (TOKEN, "second-token"):
+            assert _status_code(f"{srv.url}/api/v1/nodes", token=tok) == 200
+        assert _status_code(f"{srv.url}/api/v1/nodes", token="wrong") == 401
+    finally:
+        srv.stop()

@@ -271,3 +271,20 @@ def test_grouping_keys_on_selector_bits_not_just_tables():
     assert len(fed.groups) == 2, (
         "members with different heartbeat bits coalesced into one group"
     )
+
+
+def test_member_initial_capacity_honored():
+    """Heterogeneous member_configs: the stacked tick's uniform capacity is
+    sized for the LARGEST member request, so a member asking for more
+    capacity than the shared config is not silently undersized
+    (ADVICE r2: member initial_capacity was ignored)."""
+    servers = [FakeKube(), FakeKube()]
+    base = EngineConfig(
+        manage_all_nodes=True, tick_interval=0.02, initial_capacity=8
+    )
+    import dataclasses as dc
+
+    cfgs = [base, dc.replace(base, initial_capacity=512)]
+    fed = FederatedEngine(servers, base, member_configs=cfgs)
+    for e in fed.engines:
+        assert e.config.initial_capacity == 512

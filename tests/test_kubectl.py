@@ -222,3 +222,19 @@ def test_get_events_table(srv, kubeconfig, capsys):
     # alias works
     assert kubectl(kubeconfig, "get", "ev", "-o", "name") == 0
     assert capsys.readouterr().out.strip() == "event/ev1"
+
+
+def test_empty_get_silent_under_machine_output(srv, kubeconfig, capsys):
+    """Real kubectl prints "No resources found" only for the human table
+    view; -o json / -o name stay silent on both streams (scripts capture
+    stderr too — ADVICE r2)."""
+    assert kubectl(kubeconfig, "get", "pods", "-o", "json") == 0
+    cap = capsys.readouterr()
+    assert json.loads(cap.out)["items"] == []
+    assert cap.err == ""
+    assert kubectl(kubeconfig, "get", "pods", "-o", "name") == 0
+    cap = capsys.readouterr()
+    assert cap.out == "" and cap.err == ""
+    # the table view does warn
+    assert kubectl(kubeconfig, "get", "pods") == 0
+    assert "No resources found" in capsys.readouterr().err

@@ -32,12 +32,13 @@ pytestmark = pytest.mark.skipif(
 
 
 class NativeServer:
-    def __init__(self, args=()):
+    def __init__(self, args=(), env=None):
         self.proc = subprocess.Popen(
             [native.apiserver_binary(), "--port", "0", *args],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
+            env=None if env is None else {**os.environ, **env},
         )
         self.url = None
         deadline = time.time() + 10

@@ -224,3 +224,112 @@ def test_bound_pod_goes_running_via_binding(tmp_path):
             eng.kill()
         c.close()
         srv.stop()
+
+
+# --------------------------------------------- events store eviction (r3)
+
+
+def test_python_server_events_store_capped(monkeypatch):
+    """The events store is bounded (the real apiserver expires events on a
+    ~1h etcd lease; the mock evicts oldest-first at EVENTS_CAP so a real
+    scheduler's event stream can't grow it without bound)."""
+    from kwok_tpu.edge import mockserver
+
+    monkeypatch.setattr(mockserver, "EVENTS_CAP", 10)
+    kube = FakeKube()
+    w = kube.watch("events")
+    for i in range(25):
+        kube.create("events", {
+            "metadata": {"name": f"ev-{i:03d}", "namespace": "default"},
+            "reason": "Scheduled",
+        })
+    evs = kube.list("events")
+    assert len(evs) == 10
+    # survivors are the newest 10, evicted oldest-first
+    assert sorted(e["metadata"]["name"] for e in evs) == [
+        f"ev-{i:03d}" for i in range(15, 25)
+    ]
+    # watchers see the evictions as DELETED (the lease-expiry contract)
+    types = [w.q.get_nowait().type for _ in range(40)]
+    assert types.count("DELETED") == 15
+    w.stop()
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_native_server_events_store_capped():
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer(env={"KWOK_TPU_EVENTS_CAP": "10"})
+    c = HttpKubeClient(srv.url)
+    try:
+        for i in range(25):
+            c.create(
+                "events",
+                {"apiVersion": "v1", "kind": "Event",
+                 "metadata": {"name": f"ev-{i:03d}", "namespace": "default"},
+                 "reason": "Scheduled"},
+                namespace="default",
+            )
+        evs = c.list("events")
+        assert len(evs) == 10
+        assert sorted(e["metadata"]["name"] for e in evs) == [
+            f"ev-{i:03d}" for i in range(15, 25)
+        ]
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_events_cap_ignores_explicit_deletes(monkeypatch):
+    """Explicit DELETEs must not distort eviction accounting: after
+    deleting under-cap events and re-creating a same-named one, nothing
+    live is evicted while the store is under cap (code-review r3)."""
+    from kwok_tpu.edge import mockserver
+
+    monkeypatch.setattr(mockserver, "EVENTS_CAP", 10)
+    kube = FakeKube()
+    for i in range(10):
+        kube.create("events", {
+            "metadata": {"name": f"ev-{i:03d}", "namespace": "default"}})
+    for i in range(5, 10):
+        kube.delete("events", "default", f"ev-{i:03d}")
+    # re-create a previously deleted name, then one more: still under cap
+    kube.create("events", {
+        "metadata": {"name": "ev-005", "namespace": "default"}})
+    kube.create("events", {
+        "metadata": {"name": "ev-new", "namespace": "default"}})
+    names = sorted(e["metadata"]["name"] for e in kube.list("events"))
+    assert names == [f"ev-{i:03d}" for i in range(6)] + ["ev-new"]
+
+
+def test_events_cap_zero_is_unbounded(monkeypatch):
+    from kwok_tpu.edge import mockserver
+
+    monkeypatch.setattr(mockserver, "EVENTS_CAP", 0)
+    kube = FakeKube()
+    for i in range(20):
+        kube.create("events", {
+            "metadata": {"name": f"ev-{i}", "namespace": "default"}})
+    assert len(kube.list("events")) == 20
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_native_events_cap_ignores_explicit_deletes():
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer(env={"KWOK_TPU_EVENTS_CAP": "10"})
+    c = HttpKubeClient(srv.url)
+    try:
+        mk = lambda n: {"apiVersion": "v1", "kind": "Event",
+                        "metadata": {"name": n, "namespace": "default"}}
+        for i in range(10):
+            c.create("events", mk(f"ev-{i:03d}"), namespace="default")
+        for i in range(5, 10):
+            c.delete("events", "default", f"ev-{i:03d}", grace_seconds=0)
+        c.create("events", mk("ev-005"), namespace="default")
+        c.create("events", mk("ev-new"), namespace="default")
+        names = sorted(e["metadata"]["name"] for e in c.list("events"))
+        assert names == [f"ev-{i:03d}" for i in range(6)] + ["ev-new"]
+    finally:
+        c.close()
+        srv.stop()
