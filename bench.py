@@ -367,6 +367,8 @@ def _device_reachable(
         timeout_s = float(os.environ.get("KWOK_BENCH_PROBE_TIMEOUT", "120"))
     if retries is None:
         retries = int(os.environ.get("KWOK_BENCH_PROBE_RETRIES", "3"))
+    retries = max(1, retries)  # 0/negative would skip probing entirely and
+    # wrongly demote a healthy TPU run to CPU
 
     # the axon plugin is activated by PALLAS_AXON_POOL_IPS (sitecustomize
     # calls jax.config.update, which outranks JAX_PLATFORMS — see

@@ -264,15 +264,28 @@ class HttpKubeClient:
         items: list[dict] = []
         cont = None
         while True:
-            doc = self._json(
-                "GET",
-                self._url(kind, query={
-                    "fieldSelector": field_selector,
-                    "labelSelector": label_selector,
-                    "limit": LIST_PAGE_SIZE,
-                    "continue": cont,
-                }),
-            ) or {}
+            try:
+                doc = self._json(
+                    "GET",
+                    self._url(kind, query={
+                        "fieldSelector": field_selector,
+                        "labelSelector": label_selector,
+                        "limit": LIST_PAGE_SIZE,
+                        "continue": cont,
+                    }),
+                ) or {}
+            except urllib.error.HTTPError as e:
+                if e.code == 410 and cont:
+                    # continue token compacted away mid-pagination:
+                    # restart the list from scratch (client-go pager's
+                    # fallback on Expired)
+                    logger.warning(
+                        "list %s continue token expired; restarting", kind
+                    )
+                    items.clear()
+                    cont = None
+                    continue
+                raise
             for item in doc.get("items") or []:
                 item.setdefault("apiVersion", "v1")
                 items.append(item)
@@ -280,8 +293,11 @@ class HttpKubeClient:
             if not cont:
                 return items
 
-    def watch(self, kind, *, field_selector=None, label_selector=None):
-        return _HttpWatch(self, kind, field_selector, label_selector)
+    def watch(self, kind, *, field_selector=None, label_selector=None,
+              resource_version=None):
+        return _HttpWatch(
+            self, kind, field_selector, label_selector, resource_version
+        )
 
     def get(self, kind, namespace, name):
         return self._json("GET", self._url(kind, namespace, name))
@@ -344,13 +360,20 @@ class _HttpWatch:
     server closes the stream or stop() is called. The engine's watch loop
     handles reconnect+resync."""
 
-    def __init__(self, client: HttpKubeClient, kind: str, field_selector, label_selector):
+    def __init__(self, client: HttpKubeClient, kind: str, field_selector,
+                 label_selector, resource_version=None):
         self.client = client
         self._stopped = threading.Event()
+        #: set when the stream ended with an ERROR event carrying a 410
+        #: Status — the resume revision was compacted; caller must re-list
+        self.expired = False
         url = client._url(kind, query={
             "watch": "true",
             "fieldSelector": field_selector,
             "labelSelector": label_selector,
+            "resourceVersion": (
+                str(resource_version) if resource_version else None
+            ),
             "allowWatchBookmarks": "false",
         })
         # no read timeout: watch connections idle legitimately
@@ -373,7 +396,10 @@ class _HttpWatch:
                 if type_ in ("ADDED", "MODIFIED", "DELETED"):
                     yield WatchEvent(type_, doc.get("object") or {})
                 elif type_ == "ERROR":
-                    logger.warning("watch error event: %s", doc.get("object"))
+                    obj = doc.get("object") or {}
+                    if obj.get("code") == 410:
+                        self.expired = True
+                    logger.warning("watch error event: %s", obj)
                     return
         finally:
             try:

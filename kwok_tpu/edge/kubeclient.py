@@ -28,6 +28,14 @@ class WatchEvent:
     object: dict
 
 
+class WatchExpired(Exception):
+    """The requested resourceVersion has been compacted away (HTTP 410
+    Gone / watch ERROR event with code 410, reason "Expired"). The caller
+    must fall back to a full re-list + fresh watch — the client-go
+    reflector's ListAndWatch recovery (node_controller.go:241-254 re-watch
+    semantics ride on it)."""
+
+
 class WatchHandle(Protocol):
     def __iter__(self) -> Iterator[WatchEvent]: ...
     def stop(self) -> None: ...
@@ -50,7 +58,13 @@ class KubeClient(Protocol):
         *,
         field_selector: str | None = None,
         label_selector: str | None = None,
-    ) -> WatchHandle: ...
+        resource_version: int | str | None = None,
+    ) -> WatchHandle:
+        """resource_version > 0 resumes the stream strictly after that
+        revision (the server replays its watch cache); raises WatchExpired
+        — or the stream yields an ERROR event with code 410 — when the
+        revision has been compacted away."""
+        ...
 
     def get(self, kind: str, namespace: str | None, name: str) -> dict | None: ...
 

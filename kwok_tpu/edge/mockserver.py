@@ -16,6 +16,9 @@ environments where real control-plane binaries cannot be downloaded.
 
 from __future__ import annotations
 
+import base64
+import binascii
+import collections
 import copy
 import json
 import os
@@ -31,6 +34,7 @@ from kwok_tpu.edge.kubeclient import (
     DELETED,
     MODIFIED,
     WatchEvent,
+    WatchExpired,
     match_field_selector,
 )
 from kwok_tpu.edge.merge import strategic_merge
@@ -40,6 +44,12 @@ from kwok_tpu.edge.selectors import parse_selector
 
 class BindConflict(Exception):
     """pods/binding on an already-bound pod (HTTP 409)."""
+
+
+class MalformedContinue(Exception):
+    """An undecodable list continue token (HTTP 400, like the real
+    apiserver's "continue key is not valid"; distinct from the 410 an
+    EXPIRED token gets)."""
 
 
 class _Watch:
@@ -95,6 +105,13 @@ KINDS = (
 # <= 0 means unbounded.
 EVENTS_CAP = int(os.environ.get("KWOK_TPU_EVENTS_CAP", "4096"))
 
+# watch-cache window: how many recent events are retained for
+# resourceVersion-resumed watches. Resuming below the window gets the real
+# apiserver's 410 Gone ("too old resource version", etcd compaction
+# semantics); <= 0 disables the cache so every resume expires. Mirrored by
+# apiserver.cc; same env override.
+RV_WINDOW = int(os.environ.get("KWOK_TPU_RV_WINDOW", "4096"))
+
 
 class FakeKube:
     """kinds: "nodes"/"clusterroles"/"clusterrolebindings" (cluster-scoped),
@@ -109,6 +126,12 @@ class FakeKube:
         self._json: dict[str, dict[tuple[str, str], bytes]] = {k: {} for k in KINDS}
         self._rv = 0
         self._watches: list[_Watch] = []
+        # watch cache: recent (rv, kind, type, obj) for resumed watches;
+        # everything at or below _compacted_rv has been compacted away
+        # (resume -> 410 Gone, like etcd compaction under the real
+        # apiserver)
+        self._history: collections.deque = collections.deque()
+        self._compacted_rv = 0
         # observability for tests
         self.patch_count = 0
         self.delete_count = 0
@@ -135,12 +158,40 @@ class FakeKube:
             self._json[kind][key] = b
         return b
 
-    def _emit(self, kind: str, type_: str, obj: dict) -> None:
+    def _emit(self, kind: str, type_: str, obj: dict, key=None) -> None:
+        if RV_WINDOW > 0:
+            # ring position is the store clock (self._rv); snapshots are
+            # the per-object serialized bytes — for live objects that cache
+            # entry is computed once and shared with every subsequent
+            # read, so recording history is amortized-free (deleted
+            # objects pay one dumps). Replay json.loads a fresh dict, so
+            # no defensive copies are needed anywhere on this path.
+            if key is not None and type_ != DELETED and key in self._store[kind]:
+                data = self._obj_bytes(kind, key)
+            else:
+                data = json.dumps(obj, separators=(",", ":")).encode()
+            self._history.append((self._rv, kind, type_, data))
+            while len(self._history) > RV_WINDOW:
+                self._compacted_rv = max(
+                    self._compacted_rv, self._history.popleft()[0]
+                )
         for w in list(self._watches):
             if w.stopped or w.kind != kind:
                 continue
             if w._matches(obj):
                 w.q.put(WatchEvent(type_, copy.deepcopy(obj)))
+
+    def compact(self) -> int:
+        """Force watch-cache compaction NOW: any watch resuming from a
+        revision BELOW the current one gets 410 Gone (resuming at exactly
+        the compacted revision is still gap-free, matching etcd, whose
+        compaction at X drops revisions below X), and continue tokens
+        below it expire. Returns the compacted revision. (Ops/test hook;
+        the real apiserver compacts every 5 minutes.)"""
+        with self._lock:
+            self._history.clear()
+            self._compacted_rv = self._rv
+            return self._compacted_rv
 
     # -- test-side API ------------------------------------------------------
 
@@ -165,7 +216,7 @@ class FakeKube:
         key = self._key(meta.get("namespace"), meta["name"])
         self._bump(obj, kind, key)
         self._store[kind][key] = obj
-        self._emit(kind, ADDED, obj)
+        self._emit(kind, ADDED, obj, key=key)
         if (
             kind == "events"
             and EVENTS_CAP > 0
@@ -184,7 +235,11 @@ class FakeKube:
             )
             old = evs.pop(old_key)
             self._json[kind].pop(old_key, None)
-            self._emit(kind, DELETED, old)
+            # deletion is a write: bump like the explicit DELETE path, so
+            # the DELETED event gets its own revision (rv-resuming watchers
+            # would otherwise never see the eviction)
+            self._bump(old)
+            self._emit(kind, DELETED, old, key=old_key)
         return key
 
     def create(self, kind: str, obj: dict) -> dict:
@@ -216,7 +271,7 @@ class FakeKube:
                 )
             spec["nodeName"] = node
             self._bump(obj, "pods", key)
-            self._emit("pods", MODIFIED, obj)
+            self._emit("pods", MODIFIED, obj, key=key)
             return copy.deepcopy(obj)
 
     def update(self, kind: str, obj: dict) -> dict:
@@ -228,7 +283,7 @@ class FakeKube:
                 raise KeyError(key)
             self._bump(obj, kind, key)
             self._store[kind][key] = obj
-            self._emit(kind, MODIFIED, obj)
+            self._emit(kind, MODIFIED, obj, key=key)
             return copy.deepcopy(obj)
 
     # -- KubeClient protocol ------------------------------------------------
@@ -262,12 +317,33 @@ class FakeKube:
         Pagination follows the kube-apiserver chunking protocol
         (limit/continue, staging/src/k8s.io/apiserver pagination): objects
         are returned in stable key order and `metadata.continue` is an
-        opaque token resuming strictly after the last returned key."""
+        opaque token resuming strictly after the last returned key. The
+        token carries the revision of the FIRST page; a compaction while
+        paginating expires it (raises WatchExpired -> HTTP 410, the real
+        apiserver's "continue token too old" contract)."""
         sel = parse_selector(label_selector)
         with self._lock:
             keys = sorted(self._store[kind].keys())
+            list_rv = self._rv
             if continue_:
-                ns, _, name = continue_.partition("\x00")
+                # opaque url-safe token (the real apiserver's continue is
+                # base64 too): rv \0 ns \0 name
+                try:
+                    tok_rv, _, rest = (
+                        base64.urlsafe_b64decode(continue_.encode())
+                        .decode()
+                        .partition("\x00")
+                    )
+                    rv_val = int(tok_rv)
+                except (ValueError, UnicodeDecodeError,
+                        binascii.Error) as e:
+                    raise MalformedContinue(str(e)) from e
+                ns, _, name = rest.partition("\x00")
+                if rv_val < self._compacted_rv:
+                    raise WatchExpired(
+                        f"continue token revision {tok_rv} has been compacted"
+                    )
+                list_rv = rv_val  # consistency marker of page 1
                 last = (ns, name)
                 # binary search would be nicer; linear is fine at mock scale
                 keys = [k for k in keys if k > last]
@@ -293,7 +369,9 @@ class FakeKube:
                     continue
                 chunks.append(self._obj_bytes(kind, key))
                 if limit and len(chunks) >= limit and pos + 1 < len(keys):
-                    token = f"{key[0]}\x00{key[1]}"
+                    token = base64.urlsafe_b64encode(
+                        f"{list_rv}\x00{key[0]}\x00{key[1]}".encode()
+                    ).decode()
             rv = str(self._rv)
         meta = f'{{"resourceVersion":"{rv}"'.encode()
         if token and (remaining if count_rest else True):
@@ -310,9 +388,32 @@ class FakeKube:
         with self._lock:
             return self._obj_bytes(kind, self._key(namespace, name))
 
-    def watch(self, kind, *, field_selector=None, label_selector=None):
+    def watch(
+        self,
+        kind,
+        *,
+        field_selector=None,
+        label_selector=None,
+        resource_version=None,
+    ):
+        """resource_version > 0 resumes strictly after that revision: the
+        watch cache replays the gap, then the watch goes live. A revision
+        below the compaction floor (or ahead of the store) raises
+        WatchExpired — the client must re-list (410 Gone semantics). A
+        non-numeric revision raises ValueError (the HTTP facade answers
+        400, like the real apiserver)."""
         w = _Watch(self, kind, field_selector, label_selector)
+        rv = int(resource_version or 0)
         with self._lock:
+            if rv:
+                if rv < self._compacted_rv or rv > self._rv or RV_WINDOW <= 0:
+                    raise WatchExpired(f"too old resource version: {rv}")
+                for hrv, hkind, htype, hdata in self._history:
+                    if hrv <= rv or hkind != kind:
+                        continue
+                    hobj = json.loads(hdata)  # fresh dict: no copy needed
+                    if w._matches(hobj):
+                        w.q.put(WatchEvent(htype, hobj))
             self._watches.append(w)
         return w
 
@@ -329,7 +430,7 @@ class FakeKube:
         obj["status"] = strategic_merge(status, patch.get("status", patch))
         self._bump(obj, kind, key)
         self.patch_count += 1
-        self._emit(kind, MODIFIED, obj)
+        self._emit(kind, MODIFIED, obj, key=key)
         return obj
 
     def patch_status(self, kind, namespace, name, patch):
@@ -379,7 +480,7 @@ class FakeKube:
                 else:
                     sec[k] = copy.deepcopy(v)
         self._bump(obj, kind, key)
-        self._emit(kind, MODIFIED, obj)
+        self._emit(kind, MODIFIED, obj, key=key)
         return obj
 
     def dump(self) -> dict:
@@ -408,6 +509,10 @@ class FakeKube:
                     key = self._key(meta.get("namespace"), meta.get("name"))
                     self._store[kind][key] = copy.deepcopy(obj)
             self._rv = max(self._rv, int(data.get("resourceVersion") or 0)) + 1
+            # history predates the restore: compact so resumed watches and
+            # continue tokens from the old world get 410 and re-list
+            self._history.clear()
+            self._compacted_rv = self._rv
             watches, self._watches = self._watches, []
         for w in watches:
             w.stop()
@@ -437,13 +542,13 @@ class FakeKube:
                     meta["deletionTimestamp"] = now_rfc3339()
                 meta["deletionGracePeriodSeconds"] = grace_seconds
                 self._bump(obj, kind, key)
-                self._emit(kind, MODIFIED, obj)
+                self._emit(kind, MODIFIED, obj, key=key)
                 return
             del self._store[kind][key]
             self._json[kind].pop(key, None)
             self.delete_count += 1
             self._bump(obj)
-            self._emit(kind, DELETED, obj)
+            self._emit(kind, DELETED, obj, key=key)
 
 
 
@@ -679,6 +784,19 @@ def seed_bootstrap_rbac(store: FakeKube) -> None:
                     **copy.deepcopy(obj),
                 }
                 store.create(kind, doc)
+
+
+def _expired_status(message: str) -> dict:
+    """The kube-apiserver 410 Status body (reason Expired) shared by the
+    expired-watch ERROR event and the expired-continue list response."""
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "message": message,
+        "reason": "Expired",
+        "code": 410,
+    }
 
 
 class _HandshakeFailed(Exception):
@@ -938,18 +1056,68 @@ class HttpFakeApiserver:
                 fs = (q.get("fieldSelector") or [None])[0]
                 ls = (q.get("labelSelector") or [None])[0]
                 if (q.get("watch") or ["false"])[0] in ("true", "1"):
-                    self._stream_watch(kind, fs, ls)
+                    self._stream_watch(
+                        kind, fs, ls,
+                        (q.get("resourceVersion") or [None])[0],
+                    )
                     return
-                self._send_body(store.list_bytes(
-                    kind,
-                    field_selector=fs,
-                    label_selector=ls,
-                    limit=int((q.get("limit") or [0])[0] or 0),
-                    continue_=(q.get("continue") or [None])[0],
-                ))
+                try:
+                    body = store.list_bytes(
+                        kind,
+                        field_selector=fs,
+                        label_selector=ls,
+                        limit=int((q.get("limit") or [0])[0] or 0),
+                        continue_=(q.get("continue") or [None])[0],
+                    )
+                except WatchExpired as e:
+                    # expired continue token: 410 Gone, client restarts
+                    # the list (kube-apiserver "continue too old" answer)
+                    self._send_json(_expired_status(str(e)), 410)
+                    return
+                except MalformedContinue:
+                    self._send_json(
+                        {"kind": "Status", "apiVersion": "v1",
+                         "status": "Failure",
+                         "message": "continue key is not valid",
+                         "reason": "BadRequest", "code": 400},
+                        400,
+                    )
+                    return
+                self._send_body(body)
 
-            def _stream_watch(self, kind, fs, ls):
-                w = store.watch(kind, field_selector=fs, label_selector=ls)
+            def _stream_watch(self, kind, fs, ls, rv):
+                try:
+                    w = store.watch(
+                        kind, field_selector=fs, label_selector=ls,
+                        resource_version=rv,
+                    )
+                except ValueError:
+                    # non-numeric resourceVersion: 400, like the real
+                    # apiserver (and the C++ mirror)
+                    self._send_json(
+                        {"kind": "Status", "apiVersion": "v1",
+                         "status": "Failure",
+                         "message": f"invalid resourceVersion: {rv!r}",
+                         "reason": "BadRequest", "code": 400},
+                        400,
+                    )
+                    return
+                except WatchExpired as e:
+                    # the real apiserver answers an expired watch resume
+                    # with 200 + one ERROR event carrying a 410 Status,
+                    # then closes the stream
+                    payload = json.dumps(
+                        {"type": "ERROR", "object": _expired_status(str(e))},
+                        separators=(",", ":"),
+                    ).encode() + b"\n"
+                    self.close_connection = True
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -1013,6 +1181,13 @@ class HttpFakeApiserver:
                     # the mock's `etcdctl snapshot restore` + etcd restart
                     store.load(self._body() or {})
                     self._send_json({"kind": "Status", "status": "Success"})
+                    return
+                if parsed.path == "/compact":
+                    # the mock's `etcdctl compact`: expire the watch cache
+                    # and in-flight continue tokens NOW (test/ops hook;
+                    # the real apiserver compacts every 5 minutes)
+                    self._body()  # drain
+                    self._send_json({"compactedRevision": store.compact()})
                     return
                 m = _match_path(parsed.path)
                 if not m:

@@ -32,7 +32,7 @@ import numpy as np
 
 from kwok_tpu import cni
 from kwok_tpu.edge.ippool import IPPool
-from kwok_tpu.edge.kubeclient import ADDED, DELETED, KubeClient
+from kwok_tpu.edge.kubeclient import ADDED, DELETED, KubeClient, WatchExpired
 from kwok_tpu.edge.merge import node_status_patch_needed, pod_status_patch_needed
 from kwok_tpu.edge.render import (
     _NODE_CONDITION_META,
@@ -112,6 +112,24 @@ class EngineConfig:
         ):
             # controller.go:98 "no nodes are managed"
             raise ValueError("no nodes are managed")
+
+
+_RV_MARK = b'"resourceVersion":"'
+
+
+def _rv_of_line(line: bytes) -> int:
+    """metadata.resourceVersion from a raw watch line (native-ingest path,
+    which doesn't json-decode). The first occurrence is the object's own
+    metadata — nested structures in core/v1 status never carry the field."""
+    i = line.find(_RV_MARK)
+    if i < 0:
+        return 0
+    i += len(_RV_MARK)
+    j = line.find(b'"', i)
+    try:
+        return int(line[i:j])
+    except ValueError:
+        return 0
 
 
 def _ctr_blob(containers) -> bytes:
@@ -388,16 +406,41 @@ class ClusterEngine:
                     parser = self._codec.EventParser()
                 except Exception:
                     parser = None
+            # client-go reflector semantics: list once, then watch with the
+            # last-seen resourceVersion; a broken stream resumes from that
+            # revision (server replays the gap — no re-list); a 410
+            # Expired/WatchExpired answer falls back to the full
+            # list+RESYNC path, which is gap-free by construction
+            resume_rv = 0
             while self._running:
                 try:
-                    w = self.client.watch(kind, **opts)
+                    try:
+                        w = self.client.watch(
+                            kind,
+                            **opts,
+                            **(
+                                {"resource_version": resume_rv}
+                                if resume_rv
+                                else {}
+                            ),
+                        )
+                    except WatchExpired:
+                        logger.warning(
+                            "watch %s resume rv=%d expired; re-listing",
+                            kind, resume_rv,
+                        )
+                        resume_rv = 0
+                        continue
                     self._watches[kind] = w  # replaces any dead handle
-                    # list AFTER the watch registers: the snapshot + resync
-                    # marker covers anything missed before/while down
-                    objs = self.client.list(kind, **opts)
-                    for obj in objs:
-                        self._q.put((kind, ADDED, obj, time.monotonic()))
-                    self._q.put((kind, "RESYNC", objs, time.monotonic()))
+                    if not resume_rv:
+                        # list AFTER the watch registers: the snapshot +
+                        # resync marker covers anything missed before/while
+                        # down
+                        objs = self.client.list(kind, **opts)
+                        for obj in objs:
+                            self._q.put((kind, ADDED, obj, time.monotonic()))
+                        self._q.put((kind, "RESYNC", objs, time.monotonic()))
+                    expired = False
                     raw_iter = getattr(w, "raw_lines", None)
                     if parser is not None and callable(raw_iter):
                         # native ingest: one C++ parse per line; the tick
@@ -406,22 +449,39 @@ class ClusterEngine:
                         for line in raw_iter():
                             rec = parser.parse(line)
                             if rec.type == "ERROR":
-                                # terminate this watch like __iter__ does:
-                                # re-watch + re-list (410 Gone semantics)
+                                # terminate this watch like __iter__ does
+                                expired = b'"code":410' in line
                                 logger.warning(
                                     "watch error event: %.200r", line
                                 )
                                 break
+                            rv = _rv_of_line(line)
+                            if rv:
+                                resume_rv = rv
                             self._q.put(
                                 (kind, "REC", rec, time.monotonic())
                             )
                     else:
                         for ev in w:
+                            rv = int(
+                                (ev.object.get("metadata") or {}).get(
+                                    "resourceVersion"
+                                )
+                                or 0
+                            )
+                            if rv:
+                                resume_rv = rv
                             self._q.put(
                                 (kind, ev.type, ev.object, time.monotonic())
                             )
+                        expired = getattr(w, "expired", False)
+                    if expired:
+                        resume_rv = 0
+                        continue  # immediate re-list, no backoff
                     if not self._running:
                         return
+                except WatchExpired:
+                    resume_rv = 0
                 except Exception as e:  # re-watch with backoff
                     if not self._running:
                         return

@@ -851,11 +851,95 @@ static int events_cap() {
   return cap;
 }
 
+// watch-cache window: recent events retained for resourceVersion-resumed
+// watches. Resuming below the window gets the real apiserver's 410 Gone
+// ("too old resource version", etcd compaction semantics); <= 0 disables
+// the cache so every resume expires. Mirrors mockserver.py RV_WINDOW.
+static int rv_window() {
+  static const int w = [] {
+    const char* v = getenv("KWOK_TPU_RV_WINDOW");
+    return v && *v ? atoi(v) : 4096;
+  }();
+  return w;
+}
+
+// watch-cache entry: ring position is the store clock at emit time (NOT
+// the object's own rv — events-cap evictions re-emit old objects and the
+// replay filter needs monotonic positions)
+struct Hist {
+  int64_t rv;
+  int kind;
+  std::string type;
+  EntryPtr e;
+};
+
+// url-safe base64 for the opaque list continue token (the real
+// apiserver's continue is base64 too; raw NULs don't survive shells/JSON)
+static const char B64URL[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_";
+
+static std::string b64url_encode(const std::string& in) {
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 3 <= in.size()) {
+    uint32_t v = (uint8_t)in[i] << 16 | (uint8_t)in[i + 1] << 8 |
+                 (uint8_t)in[i + 2];
+    out += B64URL[v >> 18];
+    out += B64URL[(v >> 12) & 63];
+    out += B64URL[(v >> 6) & 63];
+    out += B64URL[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = (uint8_t)in[i] << 16;
+    out += B64URL[v >> 18];
+    out += B64URL[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t v = (uint8_t)in[i] << 16 | (uint8_t)in[i + 1] << 8;
+    out += B64URL[v >> 18];
+    out += B64URL[(v >> 12) & 63];
+    out += B64URL[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+static bool b64url_decode(const std::string& in, std::string& out) {
+  static int8_t rev[256];
+  static bool init = [] {
+    for (int i = 0; i < 256; i++) rev[i] = -1;
+    for (int i = 0; i < 64; i++) rev[(uint8_t)B64URL[i]] = (int8_t)i;
+    return true;
+  }();
+  (void)init;
+  out.clear();
+  uint32_t acc = 0;
+  int bits = 0;
+  for (char c : in) {
+    if (c == '=') break;
+    int8_t v = rev[(uint8_t)c];
+    if (v < 0) return false;
+    acc = acc << 6 | (uint32_t)v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += (char)((acc >> bits) & 0xff);
+    }
+  }
+  return true;
+}
+
 struct Store {
   std::mutex mu;
   std::map<Key, EntryPtr> kinds[NKINDS];
   int64_t rv = 0;
   std::vector<std::shared_ptr<Watch>> watches;
+  // everything at or below compacted_rv is gone from history: resumes
+  // below it answer 410, expired continue tokens too
+  std::deque<Hist> history;
+  int64_t compacted_rv = 0;
 
   // caller holds mu
   void bump(JVal& obj) {
@@ -864,10 +948,17 @@ struct Store {
         .set("resourceVersion", JVal::str(std::to_string(rv)));
   }
 
-  // caller holds mu; serializes the event once (reusing the entry's
-  // published bytes when available), fans out to matching watches
-  void emit(int kind, const char* type, const JVal& obj,
-            const std::string* pre_bytes = nullptr) {
+  // caller holds mu; records the event in the watch cache, then fans out
+  // to matching live watches (the entry's published bytes serialize the
+  // event line once)
+  void emit(int kind, const char* type, const EntryPtr& e) {
+    if (rv_window() > 0) {
+      history.push_back({rv, kind, type, e});
+      while ((int)history.size() > rv_window()) {
+        compacted_rv = std::max(compacted_rv, history.front().rv);
+        history.pop_front();
+      }
+    }
     bool any = false;
     for (const auto& w : watches)
       if (w->kind == kind) {
@@ -878,19 +969,21 @@ struct Store {
     std::shared_ptr<const std::string> line;
     for (const auto& w : watches) {
       if (w->kind != kind) continue;
-      if (!match_field_selector(obj, w->field_sel)) continue;
-      if (!w->label_sel.matches(obj)) continue;
-      if (!line) {
-        std::string ev = "{\"type\":\"";
-        ev += type;
-        ev += "\",\"object\":";
-        if (pre_bytes) ev += *pre_bytes;
-        else serialize(obj, ev);
-        ev += "}\n";
-        line = std::make_shared<const std::string>(std::move(ev));
-      }
+      if (!match_field_selector(e->obj, w->field_sel)) continue;
+      if (!w->label_sel.matches(e->obj)) continue;
+      if (!line) line = event_line(type, e);
       w->push(line);
     }
+  }
+
+  static std::shared_ptr<const std::string> event_line(const char* type,
+                                                       const EntryPtr& e) {
+    std::string ev = "{\"type\":\"";
+    ev += type;
+    ev += "\",\"object\":";
+    ev += e->bytes;
+    ev += "}\n";
+    return std::make_shared<const std::string>(std::move(ev));
   }
 
   static Key obj_key(const JVal& obj) {
@@ -1253,6 +1346,10 @@ void App::restore_load(const JVal& data) {
     const JVal* rvv = data.find("resourceVersion");
     if (rvv && rvv->type == JVal::NUM) rv = atoll(rvv->s.c_str());
     store.rv = std::max(store.rv, rv) + 1;
+    // history predates the restore: compact so resumed watches and
+    // continue tokens from the old world get 410 and re-list
+    store.history.clear();
+    store.compacted_rv = store.rv;
     old.swap(store.watches);
   }
   for (auto& w : old) w->close();
@@ -1374,6 +1471,20 @@ bool App::handle_request(int fd, Request& req) {
     restore_load(data);
     return respond(200, "{\"kind\":\"Status\",\"status\":\"Success\"}");
   }
+  if (req.method == "POST" && req.path == "/compact") {
+    // the mock's `etcdctl compact`: expire the watch cache and in-flight
+    // continue tokens NOW (test/ops hook; the real apiserver compacts
+    // every 5 minutes)
+    int64_t crv;
+    {
+      std::lock_guard<std::mutex> lk(store.mu);
+      store.history.clear();
+      store.compacted_rv = store.rv;
+      crv = store.compacted_rv;
+    }
+    return respond(200,
+                   "{\"compactedRevision\":" + std::to_string(crv) + "}");
+  }
 
   PathMatch m = match_path(req.path);
   if (m.binding && req.method != "POST")
@@ -1405,9 +1516,56 @@ bool App::handle_request(int fd, Request& req) {
       w->kind = m.kind;
       w->field_sel = fs;
       w->label_sel = LabelSel::parse(lsq);
+      long long wrv = 0;
+      if (q.count("resourceVersion")) {
+        const std::string& rvs = q["resourceVersion"];
+        if (rvs.find_first_not_of("0123456789") != std::string::npos)
+          // non-numeric resourceVersion: 400, like the real apiserver
+          // (and the Python mirror)
+          return respond(
+              400,
+              "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+              "\"Failure\",\"message\":\"invalid resourceVersion\","
+              "\"reason\":\"BadRequest\",\"code\":400}");
+        wrv = atoll(rvs.c_str());
+      }
+      bool expired = false;
       {
         std::lock_guard<std::mutex> lk(store.mu);
-        store.watches.push_back(w);
+        if (wrv > 0) {
+          if (wrv < store.compacted_rv || wrv > store.rv ||
+              rv_window() <= 0) {
+            expired = true;
+          } else {
+            // replay the gap from the watch cache BEFORE registering:
+            // emits hold mu too, so ordering is airtight
+            for (const auto& h : store.history) {
+              if (h.rv <= wrv || h.kind != m.kind) continue;
+              if (!match_field_selector(h.e->obj, fs)) continue;
+              if (!w->label_sel.matches(h.e->obj)) continue;
+              w->push(Store::event_line(h.type.c_str(), h.e));
+            }
+          }
+        }
+        if (!expired) store.watches.push_back(w);
+      }
+      if (expired) {
+        // the real apiserver answers an expired watch resume with 200 +
+        // one ERROR event carrying a 410 Status, then closes the stream
+        audit_line(req.method, uri, 200);
+        std::string ev =
+            "{\"type\":\"ERROR\",\"object\":{\"kind\":\"Status\","
+            "\"apiVersion\":\"v1\",\"status\":\"Failure\","
+            "\"message\":\"too old resource version: " +
+            std::to_string(wrv) +
+            "\",\"reason\":\"Expired\",\"code\":410}}\n";
+        std::string head =
+            "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            "Content-Length: " +
+            std::to_string(ev.size()) + "\r\nConnection: close\r\n\r\n";
+        head += ev;
+        send_all(fd, head.data(), head.size());
+        return false;
       }
       audit_line(req.method, uri, 200);
       const char* head =
@@ -1459,14 +1617,35 @@ bool App::handle_request(int fd, Request& req) {
     std::vector<EntryPtr> snap;
     bool more_after = false;
     int64_t rv_now;
+    int64_t token_rv = 0;  // consistency marker: rv of the FIRST page
     {
       std::lock_guard<std::mutex> lk(store.mu);
       auto& kindmap = store.kinds[m.kind];
       auto it = kindmap.begin();
       if (!cont.empty()) {
-        size_t nul = cont.find('\0');
-        Key last{cont.substr(0, nul),
-                 nul == std::string::npos ? "" : cont.substr(nul + 1)};
+        // opaque url-safe token (like the real apiserver's base64
+        // continue): rv \0 ns \0 name — resumes strictly after the key;
+        // the rv is the first page's revision and expires on compaction
+        std::string raw;
+        size_t p1;
+        if (!b64url_decode(cont, raw) ||
+            (p1 = raw.find('\0')) == std::string::npos)
+          return respond(
+              400,
+              "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+              "\"Failure\",\"message\":\"continue key is not valid\","
+              "\"reason\":\"BadRequest\",\"code\":400}");
+        token_rv = atoll(raw.substr(0, p1).c_str());
+        if (token_rv < store.compacted_rv)
+          return respond(
+              410,
+              "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+              "\"Failure\",\"message\":\"the provided continue parameter "
+              "is too old\",\"reason\":\"Expired\",\"code\":410}");
+        std::string rest = raw.substr(p1 + 1);
+        size_t nul = rest.find('\0');
+        Key last{rest.substr(0, nul),
+                 nul == std::string::npos ? "" : rest.substr(nul + 1)};
         it = kindmap.upper_bound(last);
       }
       snap.reserve(std::min(kindmap.size(), snap_cap));
@@ -1478,17 +1657,20 @@ bool App::handle_request(int fd, Request& req) {
         snap.push_back(it->second);
       }
       rv_now = store.rv;
+      if (!token_rv) token_rv = rv_now;  // first page stamps its revision
     }
     // The continue token is rebuilt from the entry's own (immutable)
     // metadata — map keys may be erased concurrently once the lock drops.
-    auto key_of = [](const JVal& obj, std::string& out) {
+    auto key_of = [token_rv](const JVal& obj, std::string& out) {
       const JVal* meta = obj.find("metadata");
       const JVal* ns = meta ? meta->find("namespace") : nullptr;
       const JVal* name = meta ? meta->find("name") : nullptr;
-      out.clear();
-      if (ns && ns->type == JVal::STR) out += ns->s;
-      out += '\0';
-      if (name && name->type == JVal::STR) out += name->s;
+      std::string raw = std::to_string(token_rv);
+      raw += '\0';
+      if (ns && ns->type == JVal::STR) raw += ns->s;
+      raw += '\0';
+      if (name && name->type == JVal::STR) raw += name->s;
+      out = b64url_encode(raw);
     };
     // Continuation pages break at the cut (counting the remainder on every
     // page would make a full re-list quadratic); only the FIRST page scans
@@ -1572,7 +1754,7 @@ bool App::handle_request(int fd, Request& req) {
           store.bump(obj);
           EntryPtr e = publish(std::move(obj));
           it->second = e;
-          store.emit(1, "MODIFIED", e->obj, &e->bytes);
+          store.emit(1, "MODIFIED", e);
         }
       }
     }
@@ -1640,7 +1822,7 @@ bool App::handle_request(int fd, Request& req) {
         store.bump(obj);
         e = publish(std::move(obj));
         store.kinds[m.kind][k] = e;
-        store.emit(m.kind, "ADDED", e->obj, &e->bytes);
+        store.emit(m.kind, "ADDED", e);
         if (m.kind == kind_index("events") && events_cap() > 0) {
           auto& evs = store.kinds[m.kind];
           while ((int)evs.size() > events_cap()) {
@@ -1660,9 +1842,13 @@ bool App::handle_request(int fd, Request& req) {
                 best = n;
               }
             }
-            EntryPtr oe = victim->second;
+            // deletion is a write: bump like the explicit DELETE path,
+            // so the DELETED event gets its own revision (rv-resuming
+            // watchers would otherwise never see the eviction)
+            JVal vobj = victim->second->obj;  // copy-on-write
             evs.erase(victim);
-            store.emit(m.kind, "DELETED", oe->obj, &oe->bytes);
+            store.bump(vobj);
+            store.emit(m.kind, "DELETED", publish(std::move(vobj)));
           }
         }
       }
@@ -1714,7 +1900,7 @@ bool App::handle_request(int fd, Request& req) {
         store.bump(obj);
         EntryPtr e = publish(std::move(obj));
         it->second = e;
-        store.emit(m.kind, "MODIFIED", e->obj, &e->bytes);
+        store.emit(m.kind, "MODIFIED", e);
         body = e->bytes;
       }
     }
@@ -1763,11 +1949,12 @@ bool App::handle_request(int fd, Request& req) {
           store.bump(obj);
           EntryPtr e = publish(std::move(obj));
           it->second = e;
-          store.emit(m.kind, "MODIFIED", e->obj, &e->bytes);
+          store.emit(m.kind, "MODIFIED", e);
         } else {
           store.kinds[m.kind].erase(it);
           store.bump(obj);
-          store.emit(m.kind, "DELETED", obj);
+          EntryPtr de = publish(std::move(obj));
+          store.emit(m.kind, "DELETED", de);
         }
       }
     }

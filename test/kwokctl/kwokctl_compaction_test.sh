@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# ResourceVersion-expiry e2e (VERDICT r2 #5): real apiservers compact their
+# watch cache (410 Gone on stale resumes / expired continue tokens). Force
+# compactions against a live cluster mid-churn and assert the engine's
+# re-watch + re-list recovery loses nothing: every pod still converges to
+# Running (reference semantics: client-go reflector relist on Expired,
+# node_controller.go:241-254 re-watch).
+
+set -o errexit -o nounset -o pipefail
+source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
+
+CLUSTER="e2e-compaction"
+cleanup() {
+  kwokctl --name "${CLUSTER}" delete cluster >/dev/null 2>&1 || true
+}
+trap cleanup EXIT
+
+kwokctl --name "${CLUSTER}" create cluster --runtime mock --wait 60s
+URL="$(apiserver_url "${CLUSTER}")"
+
+create_node "${URL}" fake-node
+retry 30 ready_nodes_equal "${URL}" 1
+
+# churn with compactions interleaved: the engine's watch streams lose
+# their resume window each time
+for i in $(seq 0 29); do
+  create_pod "${URL}" default "pod-${i}" fake-node
+  if [ $((i % 10)) -eq 5 ]; then
+    curl -fsS -X POST "${URL}/compact" >/dev/null
+  fi
+done
+retry 60 running_pods_equal "${URL}" 30
+
+# a compaction with the cluster quiet must not disturb steady state:
+# new work after it still converges
+curl -fsS -X POST "${URL}/compact" | grep -q compactedRevision
+create_pod "${URL}" default post-compact-pod fake-node
+retry 30 running_pods_equal "${URL}" 31
+
+# wire contract: a stale continue token answers 410 Expired
+TOKEN="$(curl -fsS "${URL}/api/v1/pods?limit=2" | pyrun -c \
+  'import json,sys; print(json.load(sys.stdin)["metadata"]["continue"])')"
+create_pod "${URL}" default floor-mover fake-node
+curl -fsS -X POST "${URL}/compact" >/dev/null
+CODE="$(curl -s -o /dev/null -w '%{http_code}' \
+  --data-urlencode "continue=${TOKEN}" --data-urlencode "limit=2" -G \
+  "${URL}/api/v1/pods")"
+if [ "${CODE}" != "410" ]; then
+  echo "expired continue token answered ${CODE}, want 410" >&2
+  exit 1
+fi
+
+echo "kwokctl_compaction_test.sh passed"

@@ -1,0 +1,515 @@
+"""ResourceVersion expiry conformance (410 Gone / watch compaction).
+
+Real apiservers compact their watch cache: a watch resuming from a
+revision below the compaction floor gets `410 Gone` (an ERROR event with a
+Status code 410, reason Expired), and expired list `continue` tokens get an
+HTTP 410. Clients — client-go's reflector, and this repo's engine
+(engine.py _spawn_watch) — must recover with a full re-list. These tests
+pin that contract on both mock apiservers and prove the engine recovers
+gap-free when a compaction lands mid-churn (VERDICT r2 #5).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kwok_tpu import native
+from kwok_tpu.edge.httpclient import HttpKubeClient
+from kwok_tpu.edge.kubeclient import WatchExpired
+from kwok_tpu.edge.mockserver import FakeKube, HttpFakeApiserver
+from kwok_tpu.engine import ClusterEngine, EngineConfig
+from tests.test_engine import make_node, make_pod
+
+
+# ------------------------------------------------------- store semantics
+
+
+def test_watch_resume_replays_gap():
+    kube = FakeKube()
+    kube.create("nodes", make_node("a"))
+    rv = kube.list_bytes("nodes")  # any read; rv comes from the store
+    rv = kube._rv
+    kube.create("nodes", make_node("b"))
+    kube.patch_status("nodes", None, "a", {"status": {"phase": "x"}})
+    w = kube.watch("nodes", resource_version=rv)
+    ev1 = w.q.get_nowait()
+    ev2 = w.q.get_nowait()
+    assert (ev1.type, ev1.object["metadata"]["name"]) == ("ADDED", "b")
+    assert (ev2.type, ev2.object["metadata"]["name"]) == ("MODIFIED", "a")
+    assert w.q.empty()
+    # the watch is live after the replay
+    kube.create("nodes", make_node("c"))
+    assert w.q.get_nowait().object["metadata"]["name"] == "c"
+    w.stop()
+
+
+def test_watch_resume_respects_selectors():
+    kube = FakeKube()
+    kube.create("nodes", make_node("clock"))  # rv=0 means live-only
+    rv = kube._rv
+    kube.create("pods", make_pod("bound", node="n1"))
+    unbound = make_pod("unbound", node="")
+    unbound["spec"].pop("nodeName")
+    kube.create("pods", unbound)
+    w = kube.watch("pods", field_selector="spec.nodeName!=",
+                   resource_version=rv)
+    assert w.q.get_nowait().object["metadata"]["name"] == "bound"
+    assert w.q.empty()
+    w.stop()
+
+
+def test_watch_resume_expired_after_compact():
+    kube = FakeKube()
+    kube.create("nodes", make_node("a"))
+    rv = kube._rv
+    kube.create("nodes", make_node("b"))
+    floor = kube.compact()
+    assert floor == kube._rv
+    with pytest.raises(WatchExpired):
+        kube.watch("nodes", resource_version=rv)
+    # a revision from the future is expired too (fresh-server restart case)
+    with pytest.raises(WatchExpired):
+        kube.watch("nodes", resource_version=kube._rv + 100)
+    # rv-less watches are untouched by compaction
+    kube.watch("nodes").stop()
+
+
+def test_window_overflow_compacts_oldest(monkeypatch):
+    from kwok_tpu.edge import mockserver
+
+    monkeypatch.setattr(mockserver, "RV_WINDOW", 8)
+    kube = FakeKube()
+    kube.create("nodes", make_node("first"))
+    rv_old = kube._rv
+    for i in range(12):  # push the first event out of the window
+        kube.create("nodes", make_node(f"n{i}"))
+    with pytest.raises(WatchExpired):
+        kube.watch("nodes", resource_version=rv_old)
+    # a revision still inside the window resumes fine
+    rv_new = kube._rv - 3
+    w = kube.watch("nodes", resource_version=rv_new)
+    assert w.q.qsize() == 3
+    w.stop()
+
+
+def test_continue_token_expires_on_compact():
+    kube = FakeKube()
+    for i in range(6):
+        kube.create("pods", make_pod(f"p{i}"))
+    page1 = json.loads(kube.list_bytes("pods", limit=2))
+    token = page1["metadata"]["continue"]
+    # token works before compaction
+    page2 = json.loads(kube.list_bytes("pods", limit=2, continue_=token))
+    assert len(page2["items"]) == 2
+    # move the store past the token's revision, then compact: the floor is
+    # now above the token (resuming AT the floor is still gap-free — etcd
+    # compaction at X drops revisions below X)
+    kube.create("pods", make_pod("extra"))
+    kube.compact()
+    with pytest.raises(WatchExpired):
+        kube.list_bytes("pods", limit=2, continue_=token)
+
+
+# ------------------------------------------------------------ HTTP wire
+
+
+@pytest.fixture
+def http_srv():
+    s = HttpFakeApiserver().start()
+    yield s
+    s.stop()
+
+
+def test_http_watch_resume_and_expired(http_srv):
+    c = HttpKubeClient(http_srv.url)
+    try:
+        c.create("nodes", make_node("a"))
+        rv = http_srv.store._rv
+        c.create("nodes", make_node("b"))
+        w = c.watch("nodes", resource_version=rv)
+        it = iter(w)
+        ev = next(it)
+        assert ev.object["metadata"]["name"] == "b"  # replayed
+        w.stop()
+
+        http_srv.store.compact()
+        w2 = c.watch("nodes", resource_version=rv)
+        assert list(w2) == []  # ERROR event terminates the stream
+        assert w2.expired
+    finally:
+        c.close()
+
+
+def test_http_expired_continue_is_410_and_client_restarts(http_srv, monkeypatch):
+    from kwok_tpu.edge import httpclient
+
+    c = HttpKubeClient(http_srv.url)
+    try:
+        for i in range(6):
+            c.create("pods", make_pod(f"p{i}"))
+        # raw wire: a compacted continue token answers HTTP 410 Expired
+        page1 = json.loads(
+            urllib.request.urlopen(http_srv.url + "/api/v1/pods?limit=2")
+            .read()
+        )
+        token = page1["metadata"]["continue"]
+        c.create("pods", make_pod("extra"))  # move the floor past the token
+        http_srv.store.compact()
+        q = urllib.parse.urlencode({"limit": 2, "continue": token})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{http_srv.url}/api/v1/pods?{q}")
+        assert ei.value.code == 410
+        assert json.loads(ei.value.read())["reason"] == "Expired"
+
+        # the client restarts an expired pagination transparently
+        monkeypatch.setattr(httpclient, "LIST_PAGE_SIZE", 2)
+        store = http_srv.store
+        orig = store.list_bytes
+        calls = {"n": 0}
+
+        def compact_between_pages(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                # a write moves the floor past page 1's token, so the
+                # compaction genuinely expires it
+                store.create("pods", make_pod("late"))
+                store.compact()
+            return orig(*a, **k)
+
+        monkeypatch.setattr(store, "list_bytes", compact_between_pages)
+        items = c.list("pods")
+        assert sorted(o["metadata"]["name"] for o in items) == (
+            ["extra", "late"] + [f"p{i}" for i in range(6)]
+        )
+        assert calls["n"] > 5  # page1, expired page2, then a full restart
+    finally:
+        c.close()
+
+
+import urllib.parse  # noqa: E402  (used above)
+
+
+# ------------------------------------------------- engine gap-free recovery
+
+
+class GatedClient:
+    """FakeKube passthrough whose watch() can be held at a gate — lets a
+    test force mutations + compaction into the window between a broken
+    stream and the engine's re-watch (deterministically, no sleeps)."""
+
+    def __init__(self, store: FakeKube):
+        self._store = store
+        self.gate = threading.Event()
+        self.gate.set()
+        self.list_calls = 0
+
+    def list(self, *a, **k):
+        self.list_calls += 1
+        return self._store.list(*a, **k)
+
+    def watch(self, *a, **k):
+        self.gate.wait()
+        return self._store.watch(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+
+def _wait(pred, timeout=15.0, every=0.03):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _running_count(store):
+    return sum(
+        1
+        for p in store.list("pods")
+        if (p.get("status") or {}).get("phase") == "Running"
+    )
+
+
+def _break_streams(store):
+    for w in list(store._watches):
+        w.stop()
+
+
+def test_engine_recovers_gap_free_after_compaction():
+    """The VERDICT r2 #5 headline: while the engine's watch streams are
+    down, the cluster churns (creates + deletes) AND the server compacts
+    its watch cache past the engine's resume revision. The engine's
+    resume gets WatchExpired and must fall back to list+RESYNC; afterwards
+    every surviving pod is Running and every deleted pod is pruned — zero
+    missed transitions."""
+    store = FakeKube()
+    client = GatedClient(store)
+    eng = ClusterEngine(
+        client, EngineConfig(manage_all_nodes=True, tick_interval=0.02)
+    )
+    eng.start()
+    try:
+        for n in range(3):
+            store.create("nodes", make_node(f"n{n}"))
+        for i in range(20):
+            store.create("pods", make_pod(f"p{i}", node=f"n{i % 3}"))
+        assert _wait(lambda: _running_count(store) == 20)
+
+        client.gate.clear()
+        _break_streams(store)  # engine re-watch now blocks at the gate
+        # churn in the dark: 30 creates, 5 grace-0 deletes, a new node
+        for i in range(20, 50):
+            store.create("pods", make_pod(f"p{i}", node=f"n{i % 3}"))
+        for i in range(5):
+            store.delete("pods", "default", f"p{i}", grace_seconds=0)
+        store.create("nodes", make_node("n3"))
+        store.compact()  # resume revision is now below the floor
+        lists_before = client.list_calls
+        client.gate.set()
+
+        assert _wait(lambda: _running_count(store) == 45)
+        assert _wait(
+            lambda: (store.get("nodes", None, "n3") or {})
+            .get("status", {})
+            .get("conditions")
+        )
+        assert client.list_calls > lists_before  # recovery re-listed
+        assert len(store.list("pods")) == 45
+    finally:
+        client.gate.set()
+        eng.stop()
+
+
+def test_engine_resume_skips_relist():
+    """Without a compaction the engine resumes from its last revision and
+    the server replays the gap — no re-list (the client-go reflector's
+    steady-state reconnect)."""
+    store = FakeKube()
+    client = GatedClient(store)
+    eng = ClusterEngine(
+        client, EngineConfig(manage_all_nodes=True, tick_interval=0.02)
+    )
+    eng.start()
+    try:
+        store.create("nodes", make_node("n0"))
+        for i in range(5):
+            store.create("pods", make_pod(f"p{i}", node="n0"))
+        assert _wait(lambda: _running_count(store) == 5)
+
+        client.gate.clear()
+        _break_streams(store)
+        for i in range(5, 15):
+            store.create("pods", make_pod(f"p{i}", node="n0"))
+        store.delete("pods", "default", "p0", grace_seconds=0)
+        lists_before = client.list_calls
+        client.gate.set()
+
+        assert _wait(lambda: _running_count(store) == 14)
+        assert client.list_calls == lists_before  # replay, not re-list
+    finally:
+        client.gate.set()
+        eng.stop()
+
+
+def test_engine_recovers_over_http_after_restore_compaction(http_srv):
+    """End-to-end over real HTTP (native ingest path when available): a
+    snapshot restore closes the watches AND compacts, so the engine's
+    resume is answered with the 410 ERROR event; it must re-list and drive
+    the restored world's new pod to Running."""
+    client = HttpKubeClient.from_kubeconfig(None, http_srv.url)
+    loader = HttpKubeClient.from_kubeconfig(None, http_srv.url)
+    eng = ClusterEngine(
+        client, EngineConfig(manage_all_nodes=True, tick_interval=0.02)
+    )
+    eng.start()
+    try:
+        loader.create("nodes", make_node("n1"))
+        loader.create("pods", make_pod("p1", node="n1"))
+        assert _wait(lambda: _running_count(http_srv.store) == 1)
+
+        snap = http_srv.store.dump()
+        snap["objects"]["pods"].append(make_pod("p2", node="n1"))
+        req = urllib.request.Request(
+            http_srv.url + "/restore",
+            data=json.dumps(snap).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req).read()
+
+        assert _wait(lambda: _running_count(http_srv.store) == 2, timeout=20)
+    finally:
+        loader.close()
+        eng.stop()
+        client.close()
+
+
+# ----------------------------------------------------- native server parity
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_native_watch_resume_replay_and_410():
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer()
+    c = HttpKubeClient(srv.url)
+    try:
+        a = c.create("nodes", make_node("a"))
+        rv = int(a["metadata"]["resourceVersion"])
+        c.create("nodes", make_node("b"))
+        w = c.watch("nodes", resource_version=rv)
+        ev = next(iter(w))
+        assert ev.object["metadata"]["name"] == "b"
+        w.stop()
+
+        # compact, then the same resume answers ERROR 410
+        req = urllib.request.Request(srv.url + "/compact", method="POST")
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["compactedRevision"] >= rv
+        w2 = c.watch("nodes", resource_version=rv)
+        assert list(w2) == []
+        assert w2.expired
+    finally:
+        c.close()
+        srv.stop()
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_native_continue_token_410_after_compact():
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer()
+    c = HttpKubeClient(srv.url)
+    try:
+        for i in range(6):
+            c.create("pods", make_pod(f"p{i}"))
+        page1 = json.loads(
+            urllib.request.urlopen(
+                srv.url + "/api/v1/pods?limit=2"
+            ).read()
+        )
+        token = page1["metadata"]["continue"]
+        # valid before compaction
+        q = urllib.parse.urlencode({"limit": 2, "continue": token})
+        page2 = json.loads(
+            urllib.request.urlopen(f"{srv.url}/api/v1/pods?{q}").read()
+        )
+        assert len(page2["items"]) == 2
+        c.create("pods", make_pod("extra"))  # move the floor past the token
+        urllib.request.urlopen(
+            urllib.request.Request(srv.url + "/compact", method="POST")
+        ).read()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{srv.url}/api/v1/pods?{q}")
+        assert ei.value.code == 410
+        assert json.loads(ei.value.read())["reason"] == "Expired"
+    finally:
+        c.close()
+        srv.stop()
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_native_engine_churn_through_compactions():
+    """Engine vs the C++ server under churn with compactions forced every
+    few moments: the population must still converge with zero missed
+    transitions (the offline stand-in for a real apiserver's 5-minute
+    compaction loop)."""
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer(env={"KWOK_TPU_RV_WINDOW": "64"})
+    client = HttpKubeClient.from_kubeconfig(None, srv.url)
+    loader = HttpKubeClient.from_kubeconfig(None, srv.url)
+    eng = ClusterEngine(
+        client, EngineConfig(manage_all_nodes=True, tick_interval=0.02)
+    )
+    eng.start()
+    try:
+        loader.create("nodes", make_node("n0"))
+        # churn: the tiny RV window (64) self-compacts continuously under
+        # 200 pod creates + engine patches; sprinkle explicit compactions
+        for i in range(200):
+            loader.create("pods", make_pod(f"p{i}", node="n0"))
+            if i % 50 == 25:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        srv.url + "/compact", method="POST"
+                    )
+                ).read()
+
+        def all_running():
+            doc = json.loads(
+                urllib.request.urlopen(
+                    srv.url + "/api/v1/pods?fieldSelector="
+                    + urllib.parse.quote("status.phase=Running")
+                    + "&limit=1"
+                ).read()
+            )
+            n = len(doc["items"]) + int(
+                (doc["metadata"] or {}).get("remainingItemCount") or 0
+            )
+            return n == 200
+
+        assert _wait(all_running, timeout=30)
+    finally:
+        loader.close()
+        eng.stop()
+        client.close()
+        srv.stop()
+
+
+# ------------------------------------------- code-review r3 regressions
+
+
+def test_eviction_delete_is_a_revision(monkeypatch):
+    """Events-cap evictions bump the store revision, so an rv-resuming
+    watcher replays the DELETED instead of believing the evicted event
+    still exists."""
+    from kwok_tpu.edge import mockserver
+
+    monkeypatch.setattr(mockserver, "EVENTS_CAP", 2)
+    kube = FakeKube()
+    for i in range(2):
+        kube.create("events", {
+            "metadata": {"name": f"ev-{i}", "namespace": "default"}})
+    rv = kube._rv  # watcher saw both events
+    kube.create("events", {
+        "metadata": {"name": "ev-2", "namespace": "default"}})  # evicts ev-0
+    w = kube.watch("events", resource_version=rv)
+    got = [(w.q.get_nowait()) for _ in range(2)]
+    assert {(e.type, e.object["metadata"]["name"]) for e in got} == {
+        ("ADDED", "ev-2"), ("DELETED", "ev-0"),
+    }
+    # the DELETED carries its own (newer) revision, not the victim's old one
+    deleted = next(e for e in got if e.type == "DELETED")
+    assert int(deleted.object["metadata"]["resourceVersion"]) > rv
+    w.stop()
+
+
+def test_http_non_numeric_rv_is_400(http_srv):
+    import urllib.parse as up
+
+    q = up.urlencode({"watch": "true", "resourceVersion": "abc"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{http_srv.url}/api/v1/pods?{q}", timeout=5)
+    assert ei.value.code == 400
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_native_non_numeric_rv_is_400():
+    import urllib.parse as up
+
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer()
+    try:
+        q = up.urlencode({"watch": "true", "resourceVersion": "abc"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{srv.url}/api/v1/pods?{q}", timeout=5)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
