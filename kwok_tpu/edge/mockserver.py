@@ -338,6 +338,8 @@ class FakeKube:
                 except (ValueError, UnicodeDecodeError,
                         binascii.Error) as e:
                     raise MalformedContinue(str(e)) from e
+                if rv_val < 0:
+                    raise MalformedContinue(f"negative revision {rv_val}")
                 ns, _, name = rest.partition("\x00")
                 if rv_val < self._compacted_rv:
                     raise WatchExpired(
@@ -404,6 +406,11 @@ class FakeKube:
         400, like the real apiserver)."""
         w = _Watch(self, kind, field_selector, label_selector)
         rv = int(resource_version or 0)
+        if rv < 0:
+            # the real apiserver rejects negative revisions as invalid
+            # (400), it does not claim they expired; the C++ mirror's
+            # digit check does the same
+            raise ValueError(f"invalid resourceVersion: {rv}")
         with self._lock:
             if rv:
                 if rv < self._compacted_rv or rv > self._rv or RV_WINDOW <= 0:

@@ -1629,7 +1629,11 @@ bool App::handle_request(int fd, Request& req) {
         std::string raw;
         size_t p1;
         if (!b64url_decode(cont, raw) ||
-            (p1 = raw.find('\0')) == std::string::npos)
+            (p1 = raw.find('\0')) == std::string::npos || p1 == 0 ||
+            raw.find_first_not_of("0123456789") < p1)
+          // undecodable token OR a non-numeric rv segment: 400, like the
+          // real apiserver's "continue key is not valid" (and the Python
+          // mirror's MalformedContinue)
           return respond(
               400,
               "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"

@@ -1,0 +1,36 @@
+"""Shared HTTP client for e2e-case python loaders (imported from pyrun
+heredocs as `from test.e2e_client import request`).
+
+Speaks both transports a cluster can serve: plain HTTP, and the secure
+port's mTLS using the cluster PKI exported by the case as
+KWOK_E2E_PKI_DIR (see test/helper.sh kcurl, the curl-side twin)."""
+
+import json
+import os
+import ssl
+import urllib.request
+
+_CTX = {}
+
+
+def _ctx(url):
+    if not url.startswith("https"):
+        return None
+    if url not in _CTX:
+        d = os.environ["KWOK_E2E_PKI_DIR"]
+        ctx = ssl.create_default_context(cafile=os.path.join(d, "ca.crt"))
+        ctx.check_hostname = False
+        ctx.load_cert_chain(
+            os.path.join(d, "admin.crt"), os.path.join(d, "admin.key")
+        )
+        _CTX[url] = ctx
+    return _CTX[url]
+
+
+def request(url, path, obj=None, method=None):
+    data = json.dumps(obj).encode() if obj is not None else None
+    req = urllib.request.Request(url + path, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=10, context=_ctx(url)) as r:
+        return r.read()

@@ -28,14 +28,28 @@ kwokctl() {
   pyrun -m kwok_tpu.kwokctl "$@"
 }
 
-# curl with the cluster's bearer token when KWOK_E2E_TOKEN is set (the
-# authorization e2e case exports it from the cluster's kubeconfig)
+# curl with cluster credentials:
+# - KWOK_E2E_TOKEN: bearer token (the authorization e2e case exports it
+#   from the cluster's kubeconfig)
+# - KWOK_E2E_PKI_DIR: the cluster's pki dir -> mTLS with the admin cert
+#   pair (secure-port clusters; real kube-apiserver v1.20+ has no
+#   insecure port, so the conformance quartet rides this)
 kcurl() {
-  if [ -n "${KWOK_E2E_TOKEN:-}" ]; then
-    curl -H "Authorization: Bearer ${KWOK_E2E_TOKEN}" "$@"
-  else
-    curl "$@"
+  local args=()
+  if [ -n "${KWOK_E2E_PKI_DIR:-}" ] && [ -f "${KWOK_E2E_PKI_DIR}/ca.crt" ]; then
+    args+=(--cacert "${KWOK_E2E_PKI_DIR}/ca.crt"
+           --cert "${KWOK_E2E_PKI_DIR}/admin.crt"
+           --key "${KWOK_E2E_PKI_DIR}/admin.key")
   fi
+  if [ -n "${KWOK_E2E_TOKEN:-}" ]; then
+    args+=(-H "Authorization: Bearer ${KWOK_E2E_TOKEN}")
+  fi
+  curl ${args[@]+"${args[@]}"} "$@"
+}
+
+cluster_pki_dir() { # CLUSTER_NAME -> the cluster workdir's pki dir
+  pyrun -c "import sys; from kwok_tpu.kwokctl import vars as v; \
+print(v.cluster_workdir(sys.argv[1]) + '/pki')" "$1"
 }
 
 apiserver_url() { # CLUSTER_NAME -> http://127.0.0.1:PORT

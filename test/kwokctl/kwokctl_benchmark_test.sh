@@ -14,8 +14,12 @@ cleanup() {
 }
 trap cleanup EXIT
 
-kwokctl --name "${CLUSTER}" create cluster --runtime mock --wait 60s
+kwokctl --name "${CLUSTER}" create cluster --runtime "${KWOK_TPU_E2E_RUNTIME:-mock}" --wait 60s
 URL="$(apiserver_url "${CLUSTER}")"
+# secure clusters (real kube-apiserver v1.20+ has no insecure port):
+# kcurl picks up the cluster's admin cert pair automatically
+KWOK_E2E_PKI_DIR="$(cluster_pki_dir "${CLUSTER}")"
+export KWOK_E2E_PKI_DIR
 
 create_node "${URL}" bench-node
 retry 30 node_is_ready "${URL}" bench-node
@@ -23,20 +27,17 @@ retry 30 node_is_ready "${URL}" bench-node
 # --- create 1,000 pods ---------------------------------------------------
 start="$(date +%s)"
 pyrun - "${URL}" <<'EOF'
-import json, sys, urllib.request
+import json, sys
+from test.e2e_client import request
 url = sys.argv[1]
 for i in range(1000):
-    body = json.dumps({
+    request(url, "/api/v1/namespaces/default/pods", {
         "apiVersion": "v1", "kind": "Pod",
         "metadata": {"name": f"bench-pod-{i}", "namespace": "default"},
         "spec": {"nodeName": "bench-node",
                  "containers": [{"name": "c", "image": "busybox"}]},
         "status": {"phase": "Pending"},
-    }).encode()
-    req = urllib.request.Request(
-        url + "/api/v1/namespaces/default/pods", data=body,
-        headers={"Content-Type": "application/json"}, method="POST")
-    urllib.request.urlopen(req).read()
+    }, method="POST")
 EOF
 retry 110 running_pods_equal "${URL}" 1000
 elapsed=$(($(date +%s) - start))
@@ -46,14 +47,12 @@ echo "create 1000 pods -> Running: ${elapsed}s"
 # --- delete 1,000 pods (grace 1) -----------------------------------------
 start="$(date +%s)"
 pyrun - "${URL}" <<'EOF'
-import json, sys, urllib.request
+import json, sys
+from test.e2e_client import request
 url = sys.argv[1]
 for i in range(1000):
-    req = urllib.request.Request(
-        f"{url}/api/v1/namespaces/default/pods/bench-pod-{i}",
-        data=json.dumps({"gracePeriodSeconds": 1}).encode(),
-        headers={"Content-Type": "application/json"}, method="DELETE")
-    urllib.request.urlopen(req).read()
+    request(url, f"/api/v1/namespaces/default/pods/bench-pod-{i}",
+            {"gracePeriodSeconds": 1}, method="DELETE")
 EOF
 retry 110 pods_equal "${URL}" 0
 elapsed=$(($(date +%s) - start))
@@ -63,17 +62,14 @@ echo "delete 1000 pods: ${elapsed}s"
 # --- create 1,000 nodes ---------------------------------------------------
 start="$(date +%s)"
 pyrun - "${URL}" <<'EOF'
-import json, sys, urllib.request
+import json, sys
+from test.e2e_client import request
 url = sys.argv[1]
 for i in range(1000):
-    body = json.dumps({
+    request(url, "/api/v1/nodes", {
         "apiVersion": "v1", "kind": "Node",
         "metadata": {"name": f"bench-node-{i}"},
-    }).encode()
-    req = urllib.request.Request(
-        url + "/api/v1/nodes", data=body,
-        headers={"Content-Type": "application/json"}, method="POST")
-    urllib.request.urlopen(req).read()
+    }, method="POST")
 EOF
 retry 110 ready_nodes_equal "${URL}" 1001
 elapsed=$(($(date +%s) - start))

@@ -13,8 +13,12 @@ cleanup() {
 }
 trap cleanup EXIT
 
-kwokctl --name "${CLUSTER}" create cluster --runtime mock --wait 60s
+kwokctl --name "${CLUSTER}" create cluster --runtime "${KWOK_TPU_E2E_RUNTIME:-mock}" --wait 60s
 URL="$(apiserver_url "${CLUSTER}")"
+# secure clusters (real kube-apiserver v1.20+ has no insecure port):
+# kcurl picks up the cluster's admin cert pair automatically
+KWOK_E2E_PKI_DIR="$(cluster_pki_dir "${CLUSTER}")"
+export KWOK_E2E_PKI_DIR
 
 create_node "${URL}" fake-node
 create_pod "${URL}" default fake-pod fake-node
@@ -22,13 +26,13 @@ retry 30 node_is_ready "${URL}" fake-node
 retry 30 running_pods_equal "${URL}" 1
 
 kwokctl --name "${CLUSTER}" stop cluster
-if curl -fsS --max-time 2 "${URL}/healthz" >/dev/null 2>&1; then
+if kcurl -fsS --max-time 2 "${URL}/healthz" >/dev/null 2>&1; then
   echo "apiserver still answering after stop" >&2
   exit 1
 fi
 
 kwokctl --name "${CLUSTER}" start cluster
-retry 30 curl -fsS "${URL}/healthz"
+retry 30 kcurl -fsS "${URL}/healthz"
 
 # state survived: the node and pod are still there and still simulated
 retry 30 node_is_ready "${URL}" fake-node

@@ -14,8 +14,12 @@ cleanup() {
 }
 trap cleanup EXIT
 
-kwokctl --name "${CLUSTER}" create cluster --runtime mock --wait 60s
+kwokctl --name "${CLUSTER}" create cluster --runtime "${KWOK_TPU_E2E_RUNTIME:-mock}" --wait 60s
 URL="$(apiserver_url "${CLUSTER}")"
+# secure clusters (real kube-apiserver v1.20+ has no insecure port):
+# kcurl picks up the cluster's admin cert pair automatically
+KWOK_E2E_PKI_DIR="$(cluster_pki_dir "${CLUSTER}")"
+export KWOK_E2E_PKI_DIR
 
 create_node "${URL}" fake-node
 create_pod "${URL}" default keep-pod fake-node
@@ -33,8 +37,8 @@ kwokctl --name "${CLUSTER}" snapshot restore --path "${SNAP}"
 
 # restored: the mutation is gone, the saved objects are back
 retry 30 pods_equal "${URL}" 1
-curl -fsS "${URL}/api/v1/namespaces/default/pods/keep-pod" >/dev/null
-if curl -fsS "${URL}/api/v1/nodes/drop-node" >/dev/null 2>&1; then
+kcurl -fsS "${URL}/api/v1/namespaces/default/pods/keep-pod" >/dev/null
+if kcurl -fsS "${URL}/api/v1/nodes/drop-node" >/dev/null 2>&1; then
   echo "drop-node survived the restore" >&2
   exit 1
 fi

@@ -18,6 +18,10 @@ for runtime in ${KWOK_TPU_E2E_RUNTIMES:-mock}; do
   kwokctl --name "${CLUSTER}" create cluster --runtime "${runtime}" --wait 60s
 
   URL="$(apiserver_url "${CLUSTER}")"
+# secure clusters (real kube-apiserver v1.20+ has no insecure port):
+# kcurl picks up the cluster's admin cert pair automatically
+KWOK_E2E_PKI_DIR="$(cluster_pki_dir "${CLUSTER}")"
+export KWOK_E2E_PKI_DIR
   create_node "${URL}" fake-node
   retry 30 node_is_ready "${URL}" fake-node
   for i in 0 1 2 3 4; do

@@ -31,8 +31,7 @@ from tests.test_engine import make_node, make_pod
 def test_watch_resume_replays_gap():
     kube = FakeKube()
     kube.create("nodes", make_node("a"))
-    rv = kube.list_bytes("nodes")  # any read; rv comes from the store
-    rv = kube._rv
+    rv = kube._rv  # the revision a client's first LIST would report
     kube.create("nodes", make_node("b"))
     kube.patch_status("nodes", None, "a", {"status": {"phase": "x"}})
     w = kube.watch("nodes", resource_version=rv)
@@ -513,3 +512,49 @@ def test_native_non_numeric_rv_is_400():
         assert ei.value.code == 400
     finally:
         srv.stop()
+
+
+def _b64token(raw: bytes) -> str:
+    import base64
+
+    return base64.urlsafe_b64encode(raw).decode()
+
+
+def test_malformed_continue_is_400_python(http_srv):
+    import urllib.parse as up
+
+    for token in ("not-base64!!", _b64token(b"abc\x00ns\x00nm"),
+                  _b64token(b"-3\x00ns\x00nm")):
+        q = up.urlencode({"limit": 2, "continue": token})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{http_srv.url}/api/v1/pods?{q}",
+                                   timeout=5)
+        assert ei.value.code == 400, token
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_malformed_continue_is_400_native():
+    import urllib.parse as up
+
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer()
+    try:
+        for token in ("not-base64!!", _b64token(b"abc\x00ns\x00nm"),
+                      _b64token(b"-3\x00ns\x00nm")):
+            q = up.urlencode({"limit": 2, "continue": token})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{srv.url}/api/v1/pods?{q}",
+                                       timeout=5)
+            assert ei.value.code == 400, token
+    finally:
+        srv.stop()
+
+
+def test_negative_rv_watch_is_400_python(http_srv):
+    import urllib.parse as up
+
+    q = up.urlencode({"watch": "true", "resourceVersion": "-1"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{http_srv.url}/api/v1/pods?{q}", timeout=5)
+    assert ei.value.code == 400
