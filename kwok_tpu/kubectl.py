@@ -297,12 +297,27 @@ def _run(args, client: HttpKubeClient) -> int:
             else:
                 # kubectl apply updates the client-owned sections; the mock
                 # servers' merge-patch on metadata+spec models that (status
-                # stays the kubelet's/engine's)
-                client.patch_meta(
-                    kind, ns, name,
-                    {k: doc[k] for k in ("metadata", "spec") if k in doc},
-                )
-                print(f"{_singular(kind)}/{name} configured")
+                # stays the kubelet's/engine's). A no-op patch prints
+                # "unchanged", like real kubectl.
+                changed = False
+                for section in ("metadata", "spec"):
+                    sec_patch = doc.get(section)
+                    if not sec_patch:
+                        continue
+                    cur = existing.get(section) or {}
+                    for k, v in sec_patch.items():
+                        if (v is None and k in cur) or (
+                            v is not None and cur.get(k) != v
+                        ):
+                            changed = True
+                if changed:
+                    client.patch_meta(
+                        kind, ns, name,
+                        {k: doc[k] for k in ("metadata", "spec") if k in doc},
+                    )
+                    print(f"{_singular(kind)}/{name} configured")
+                else:
+                    print(f"{_singular(kind)}/{name} unchanged")
         return rc
 
     if args.verb == "delete":

@@ -5,6 +5,7 @@ passthrough + runtime/cluster.go download-or-find)."""
 from __future__ import annotations
 
 import json
+import re
 
 import pytest
 
@@ -238,3 +239,91 @@ def test_empty_get_silent_under_machine_output(srv, kubeconfig, capsys):
     # the table view does warn
     assert kubectl(kubeconfig, "get", "pods") == 0
     assert "No resources found" in capsys.readouterr().err
+
+
+# ----------------------------------------- golden dialect pins (VERDICT #7)
+#
+# The shim's tables and error framing ARE its dialect; until a real kubectl
+# exists to diff against (hack/diff-kubectl.sh does that the moment one
+# appears), these goldens freeze the exact bytes so the dialect can only
+# change deliberately. AGE cells are normalized (they depend on wall clock).
+
+
+def _golden(capsys):
+    out = capsys.readouterr()
+    def norm(s):
+        # normalize the AGE column and trailing per-line padding
+        s = re.sub(r"\b\d+[smhd]\b", "<AGE>", s)
+        return "\n".join(ln.rstrip() for ln in s.splitlines())
+    return norm(out.out), norm(out.err)
+
+
+def _seed_world(srv):
+    srv.store.create("nodes", make_node("n1"))
+    srv.store.patch_status(
+        "nodes", None, "n1",
+        {"status": {"conditions": [{"type": "Ready", "status": "True"}]}},
+    )
+    srv.store.create("pods", make_pod("p1", node="n1"))
+    srv.store.patch_status(
+        "pods", "default", "p1",
+        {"status": {"phase": "Running",
+                    "containerStatuses": [{"name": "c", "ready": True}]}},
+    )
+
+
+def test_golden_tables(srv, kubeconfig, capsys):
+    _seed_world(srv)
+    assert kubectl(kubeconfig, "get", "nodes") == 0
+    assert _golden(capsys) == (
+        "NAME   STATUS   AGE\n"
+        "n1     Ready    <AGE>",
+        "",
+    )
+    assert kubectl(kubeconfig, "get", "pods") == 0
+    assert _golden(capsys) == (
+        "NAME   READY   STATUS    AGE\n"
+        "p1     1/1     Running   <AGE>",
+        "",
+    )
+    assert kubectl(kubeconfig, "get", "pods", "-A") == 0
+    assert _golden(capsys) == (
+        "NAMESPACE   NAME   READY   STATUS    AGE\n"
+        "default     p1     1/1     Running   <AGE>",
+        "",
+    )
+    assert kubectl(kubeconfig, "get", "nodes", "-o", "name") == 0
+    assert _golden(capsys) == ("node/n1", "")
+
+
+def test_golden_errors_and_mutations(srv, kubeconfig, tmp_path, capsys):
+    # NotFound error framing
+    assert kubectl(kubeconfig, "get", "node", "nope") == 1
+    assert _golden(capsys) == (
+        "",
+        'Error from server (NotFound): node "nope" not found',
+    )
+    # apply/create/delete messages; a byte-identical re-apply is
+    # "unchanged" like real kubectl, a changed doc is "configured"
+    doc = tmp_path / "n2.yaml"
+    doc.write_text("apiVersion: v1\nkind: Node\nmetadata:\n  name: n2\n")
+    assert kubectl(kubeconfig, "apply", "-f", str(doc)) == 0
+    assert _golden(capsys) == ("node/n2 created", "")
+    assert kubectl(kubeconfig, "apply", "-f", str(doc)) == 0
+    assert _golden(capsys) == ("node/n2 unchanged", "")
+    doc.write_text(
+        "apiVersion: v1\nkind: Node\nmetadata:\n  name: n2\n"
+        "  labels: {tier: a}\n"
+    )
+    assert kubectl(kubeconfig, "apply", "-f", str(doc)) == 0
+    assert _golden(capsys) == ("node/n2 configured", "")
+    assert kubectl(kubeconfig, "create", "-f", str(doc)) == 1
+    assert _golden(capsys) == (
+        "",
+        'Error from server (AlreadyExists): node "n2" already exists',
+    )
+    assert kubectl(kubeconfig, "delete", "node", "n2") == 0
+    assert _golden(capsys) == ('node "n2" deleted', "")
+    # empty table warns on stderr only
+    assert kubectl(kubeconfig, "get", "events") == 0
+    assert _golden(capsys) == ("", "No resources found")
