@@ -1,0 +1,252 @@
+"""Hour-scale endurance soak with asserted ceilings (VERDICT r2 #8).
+
+Real topology (native mock apiserver process + engine process + this
+monitor): N nodes heartbeat at a fast interval while a modest pod churn
+keeps transitions flowing. The engine's f32 epoch is shrunk via
+KWOK_TPU_REBASE_AFTER so several epoch rebases land inside the run, and
+the monitor samples engine RSS + counters throughout. At the end it
+asserts:
+  - >= --min-rebases epoch rebases observed (kwok_epoch_rebases_total)
+  - heartbeat delivery >= --hb-floor of line rate over the WHOLE run
+  - RSS slope ~ 0: the last-quarter mean RSS within --rss-tolerance of
+    the second-quarter mean (the first quarter is warmup)
+Prints ONE JSON line; exit 1 if any ceiling is violated.
+
+Usage (the SOAK_r03.json entry runs):
+    python benchmarks/endurance.py --nodes 2000 --pods 6000 \
+        --heartbeat-interval 2 --duration 3600 --rebase-after 1200
+Short smoke (CI): --duration 120 --rebase-after 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("KWOK_TPU_SOAK_PLATFORM", "cpu")
+
+from benchmarks.soak import _child_env, _scrape_metrics, _wait_http  # noqa: E402
+
+
+def _rss_mb(pid: int) -> float:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=2000)
+    p.add_argument("--pods", type=int, default=6000)
+    p.add_argument("--heartbeat-interval", type=float, default=2.0)
+    p.add_argument("--duration", type=float, default=3600.0)
+    p.add_argument("--rebase-after", type=float, default=1200.0,
+                   help="KWOK_TPU_REBASE_AFTER for the engine process")
+    p.add_argument("--min-rebases", type=int, default=2)
+    p.add_argument("--hb-floor", type=float, default=0.99)
+    p.add_argument("--rss-tolerance", type=float, default=0.05,
+                   help="allowed relative RSS growth, last vs second quarter")
+    p.add_argument("--churn-every", type=float, default=60.0,
+                   help="every N seconds delete+recreate --churn-pods pods")
+    p.add_argument("--churn-pods", type=int, default=50)
+    p.add_argument("--sample-every", type=float, default=20.0)
+    p.add_argument("--tick-interval", type=float, default=0.02)
+    args = p.parse_args()
+
+    from kwok_tpu import native
+    from kwok_tpu.kwokctl import netutil
+
+    logdir = os.environ.get("KWOK_TPU_SOAK_LOGDIR", "/tmp/kwok-tpu-endurance")
+    os.makedirs(logdir, exist_ok=True)
+    procs: list[subprocess.Popen] = []
+    try:
+        api_port = netutil.get_unused_port()
+        url = f"http://127.0.0.1:{api_port}"
+        apiserver_bin = native.apiserver_binary()
+        api_cmd = (
+            [apiserver_bin, "--port", str(api_port)]
+            if apiserver_bin
+            else [sys.executable, "-m", "kwok_tpu.edge.mockserver",
+                  "--port", str(api_port)]
+        )
+        api_log = open(os.path.join(logdir, "apiserver.log"), "wb")
+        procs.append(subprocess.Popen(
+            api_cmd, env=_child_env(), stdout=api_log, stderr=api_log
+        ))
+        _wait_http(url, "/healthz", timeout=60.0)
+
+        metrics_port = netutil.get_unused_port()
+        metrics_url = f"http://127.0.0.1:{metrics_port}"
+        eng_env = _child_env()
+        eng_env["KWOK_TPU_REBASE_AFTER"] = str(args.rebase_after)
+        eng_log = open(os.path.join(logdir, "engine.log"), "wb")
+        engine = subprocess.Popen(
+            [sys.executable, "-m", "kwok_tpu.kwok",
+             "--master", url,
+             "--manage-all-nodes", "true",
+             "--tick-interval", str(args.tick_interval),
+             "--heartbeat-interval", str(args.heartbeat_interval),
+             "--initial-capacity",
+             str(max(4096, args.pods + args.churn_pods, args.nodes)),
+             "--server-address", f"127.0.0.1:{metrics_port}"],
+            env=eng_env, stdout=eng_log, stderr=eng_log,
+        )
+        procs.append(engine)
+        _wait_http(metrics_url, "/healthz", timeout=60.0)
+
+        def req(path, obj=None, method=None):
+            data = json.dumps(obj).encode() if obj is not None else None
+            r = urllib.request.Request(url + path, data=data, method=method)
+            if data is not None:
+                r.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                return resp.read()
+
+        for n in range(args.nodes):
+            req("/api/v1/nodes", {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": f"en-{n}"}}, method="POST")
+        for i in range(args.pods):
+            req("/api/v1/namespaces/default/pods", {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"ep-{i}", "namespace": "default"},
+                "spec": {"nodeName": f"en-{i % args.nodes}",
+                         "containers": [{"name": "c", "image": "i"}]},
+            }, method="POST")
+
+        # wait for full steady state before the measured window opens
+        def running() -> int:
+            q = urllib.parse.quote("status.phase=Running")
+            doc = json.loads(req(f"/api/v1/pods?fieldSelector={q}&limit=1"))
+            return len(doc["items"]) + int(
+                (doc["metadata"] or {}).get("remainingItemCount") or 0
+            )
+
+        deadline = time.monotonic() + 300
+        while running() < args.pods:
+            if time.monotonic() > deadline:
+                raise SystemExit("pods never reached steady state")
+            time.sleep(1.0)
+
+        m0 = _scrape_metrics(metrics_url)
+        hb0 = m0.get("kwok_heartbeats_total", 0)
+        t0 = time.monotonic()
+        samples = []  # (t, rss_mb, heartbeats_total, rebases_total)
+        next_churn = t0 + args.churn_every
+        churn_gen = 0
+        while True:
+            now = time.monotonic()
+            if now - t0 >= args.duration:
+                break
+            m = _scrape_metrics(metrics_url)
+            samples.append((
+                now - t0,
+                _rss_mb(engine.pid),
+                m.get("kwok_heartbeats_total", 0),
+                m.get("kwok_epoch_rebases_total", 0),
+            ))
+            if engine.poll() is not None:
+                raise SystemExit("engine process died mid-run")
+            if now >= next_churn:
+                # graceful delete + recreate a block of pods: the full
+                # delete->finalize->recreate->Running path stays exercised
+                blocks = max(args.pods // max(args.churn_pods, 1), 1)
+                base = churn_gen % blocks
+                for i in range(args.churn_pods):
+                    idx = base * args.churn_pods + i
+                    if idx >= args.pods:
+                        break
+                    req(f"/api/v1/namespaces/default/pods/ep-{idx}",
+                        {"gracePeriodSeconds": 1}, method="DELETE")
+                time.sleep(3.0)
+                for i in range(args.churn_pods):
+                    idx = base * args.churn_pods + i
+                    if idx >= args.pods:
+                        break
+                    req("/api/v1/namespaces/default/pods", {
+                        "apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": f"ep-{idx}",
+                                     "namespace": "default"},
+                        "spec": {"nodeName": f"en-{idx % args.nodes}",
+                                 "containers": [{"name": "c", "image": "i"}]},
+                    }, method="POST")
+                churn_gen += 1
+                next_churn += args.churn_every
+            time.sleep(args.sample_every)
+
+        elapsed = time.monotonic() - t0
+        m1 = _scrape_metrics(metrics_url)
+        hb_total = m1.get("kwok_heartbeats_total", 0) - hb0
+        line_rate = args.nodes / args.heartbeat_interval
+        hb_delivery = hb_total / (line_rate * elapsed)
+        rebases = int(m1.get("kwok_epoch_rebases_total", 0))
+
+        n_s = len(samples)
+        if n_s >= 8:
+            # second-quarter mean vs last-quarter mean (first quarter is
+            # warmup)
+            q = n_s // 4
+            ref_s, last_s = samples[q:2 * q], samples[3 * q:]
+        else:
+            # too few samples for quartiles: halves, so short smokes can't
+            # divide by an empty window
+            ref_s = samples[: max(n_s // 2, 1)]
+            last_s = samples[n_s // 2:] or samples[-1:]
+        rss_ref = sum(s[1] for s in ref_s) / len(ref_s)
+        rss_last = sum(s[1] for s in last_s) / len(last_s)
+        rss_growth = (rss_last - rss_ref) / max(rss_ref, 1e-9)
+
+        ok_rebases = rebases >= args.min_rebases
+        ok_hb = hb_delivery >= args.hb_floor
+        ok_rss = rss_growth <= args.rss_tolerance
+        print(json.dumps({
+            "metric": (
+                f"endurance: {args.nodes} nodes x {args.pods} pods, "
+                f"{elapsed:.0f}s steady state, heartbeat every "
+                f"{args.heartbeat_interval}s, churn "
+                f"{args.churn_pods}/{args.churn_every:.0f}s, "
+                f"rebase epoch every {args.rebase_after:.0f}s"
+            ),
+            "elapsed_s": round(elapsed, 1),
+            "heartbeats_total": int(hb_total),
+            "heartbeat_delivery": round(hb_delivery, 4),
+            "heartbeat_floor": args.hb_floor,
+            "epoch_rebases": rebases,
+            "min_rebases": args.min_rebases,
+            "rss_ref_mb": round(rss_ref, 1),
+            "rss_last_mb": round(rss_last, 1),
+            "rss_growth": round(rss_growth, 4),
+            "rss_tolerance": args.rss_tolerance,
+            "churn_cycles": churn_gen,
+            "pass": ok_rebases and ok_hb and ok_rss,
+            "failures": [
+                name
+                for ok, name in ((ok_rebases, "rebases"), (ok_hb, "heartbeats"),
+                                 (ok_rss, "rss"))
+                if not ok
+            ],
+        }))
+        return 0 if (ok_rebases and ok_hb and ok_rss) else 1
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

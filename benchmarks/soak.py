@@ -258,6 +258,11 @@ def main() -> None:
                    help="N apiserver processes federated onto ONE engine "
                    "(--master a,b,..., BASELINE config 5); nodes/pods are "
                    "split evenly across members")
+    p.add_argument("--member-config", action="append", default=[],
+                   help="per-member kwok config YAML passed through to the "
+                   "engine's --member-config (heterogeneous federation: "
+                   "the i-th file's Stage docs replace the i-th member's "
+                   "rules; empty value / missing tail inherit)")
     args = p.parse_args()
 
     from kwok_tpu.edge.httpclient import HttpKubeClient
@@ -323,8 +328,12 @@ def main() -> None:
             (args.pods * nodes_per_member + args.nodes - 1) // max(args.nodes, 1)
         )
         per_member_cap = max(4096, pods_per_member, nodes_per_member)
+        member_cfg_flags = []
+        for mc in args.member_config:
+            member_cfg_flags += ["--member-config", mc]
         procs.append(subprocess.Popen(
             [sys.executable, *prof_args, "-m", "kwok_tpu.kwok",
+             *member_cfg_flags,
              "--master", ",".join(member_urls),
              "--manage-all-nodes", "true",
              "--tick-interval", str(args.tick_interval),
@@ -667,6 +676,16 @@ def main() -> None:
                     breakdown[k_out] = m[k_in]
             if breakdown:
                 out["engine"] = breakdown
+            # heterogeneous federation: one kernel-launch counter per
+            # rule-set group (VERDICT r3: record per-group dispatches)
+            groups = {
+                k.removeprefix("kwok_"): int(v)
+                for k, v in m.items()
+                if k.startswith("kwok_group")
+                and k.endswith("_dispatches_total")
+            }
+            if groups:
+                out["group_dispatches"] = groups
         if srv is not None:
             srv.stop()
         print(json.dumps(out))

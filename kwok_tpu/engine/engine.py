@@ -68,7 +68,6 @@ _NODE_READY_BITS = 1 << NODE_PHASES.condition_bit("Ready")
 # status keys whose strategic merge is plain replacement — when the current
 # status has only these, merge(current, rendered) == rendered exactly
 _SCALAR_STATUS_KEYS = frozenset({"phase", "hostIP", "podIP", "startTime"})
-_POD_PHASE_IDS = {name: i for i, name in enumerate(POD_PHASES.phases)}
 _PENDING = POD_PHASES.phase_id("Pending")
 _NODE_READY = NODE_PHASES.phase_id("Ready")
 _NODE_OBSERVED = NODE_PHASES.phase_id("Observed")
@@ -203,6 +202,12 @@ class ClusterEngine:
         ptab = compile_rules(pod_rules, ResourceKind.POD)
         self.node_bits = _selector_bits(ntab, (SEL_MANAGED, SEL_HEARTBEAT))
         self.pod_bits = _selector_bits(ptab, (SEL_MANAGED, SEL_ON_MANAGED_NODE))
+        # phase vocabulary comes from the compiled table (Stage docs may
+        # extend it past the canonical prefix; compiler.compile_rules)
+        self._pod_phases = ptab.space.phases
+        self._pod_phase_ids = {
+            name: i for i, name in enumerate(ptab.space.phases)
+        }
 
         hb_bit = self.node_bits[SEL_HEARTBEAT]
         self._mesh = None
@@ -277,6 +282,7 @@ class ClusterEngine:
             "status_patches_total": 0,
             "heartbeats_total": 0,
             "deletes_total": 0,
+            "epoch_rebases_total": 0,
             "watch_events_total": 0,
             "patch_errors_total": 0,
             "ticks_total": 0,
@@ -779,7 +785,9 @@ class ClusterEngine:
         bits = self._pod_bits(m)
         self.pods_by_node.setdefault(node_name, set()).add(key)
         if new_row:
-            phase = _POD_PHASE_IDS.get(status.get("phase") or "Pending", _PENDING)
+            phase = self._pod_phase_ids.get(
+                status.get("phase") or "Pending", _PENDING
+            )
             cond = 0
             for c in status.get("conditions") or []:
                 t = c.get("type")
@@ -838,7 +846,9 @@ class ClusterEngine:
         new_row = idx is None
         if not new_row and int(k.phase_h[idx]) != _PENDING:
             return False  # LockPod repair needs the full object
-        if new_row and _POD_PHASE_IDS.get(rec.phase or "Pending", _PENDING) != _PENDING:
+        if new_row and self._pod_phase_ids.get(
+            rec.phase or "Pending", _PENDING
+        ) != _PENDING:
             # first sighting already past Pending: the reference would run
             # the repair render+merge against the real status right away
             return False
@@ -873,7 +883,7 @@ class ClusterEngine:
         bits = self._pod_bits(m)
         self.pods_by_node.setdefault(node_name, set()).add(key)
         if new_row:
-            phase = _POD_PHASE_IDS.get(rec.phase or "Pending", _PENDING)
+            phase = self._pod_phase_ids.get(rec.phase or "Pending", _PENDING)
             cond = 0
             if rec.true_conditions:
                 for t in rec.true_conditions.split(b"\x1f"):
@@ -1059,6 +1069,8 @@ class ClusterEngine:
             self._epoch += now
             for k in (self.nodes, self.pods):
                 k.state = rebase_times(k.state, now)
+            self._inc("epoch_rebases_total")
+            logger.info("epoch rebase at engine time %.1fs", now)
             now = 0.0
         now_str = now_rfc3339()
         work = False
@@ -1280,7 +1292,7 @@ class ClusterEngine:
         meta = k.pool.meta
         phase_h = k.phase_h
         cond_h = k.cond_h
-        all_phases = POD_PHASES.phases
+        all_phases = self._pod_phases
         for idx in idxs:
             key = pool_key_of(idx)
             m = meta[idx]
@@ -1445,7 +1457,7 @@ class ClusterEngine:
         m = k.pool.meta[idx]
         if not m or self._pod_obj(m) is None:
             return None
-        phase_name = POD_PHASES.phases[int(k.phase_h[idx])]
+        phase_name = self._pod_phases[int(k.phase_h[idx])]
         if phase_name == "Gone":
             return None
         ip = m.get("podIP")

@@ -61,13 +61,24 @@ def _pad_cluster_capacity(r: int, n_clusters: int, n_devices: int) -> int:
 
 
 def _table_bytes(tab) -> bytes:
-    """Canonical bytes of a CompiledRules table (grouping key)."""
+    """Canonical bytes of a CompiledRules table (grouping key). The phase
+    vocabulary is part of the key: Stage docs can extend the space past the
+    canonical prefix (compiler.compile_rules), and two numerically identical
+    tables whose extra ids name DIFFERENT phases must not share a kernel —
+    the rendered phase strings would be wrong for one member."""
     return b"|".join(
-        np.ascontiguousarray(getattr(tab, f)).tobytes()
-        for f in (
-            "from_mask", "deletion", "selector_bit", "delay_kind", "delay_a",
-            "delay_b", "to_phase", "cond_assign", "cond_value", "is_delete",
-        )
+        [
+            np.ascontiguousarray(getattr(tab, f)).tobytes()
+            for f in (
+                "from_mask", "deletion", "selector_bit", "delay_kind",
+                "delay_a", "delay_b", "to_phase", "cond_assign",
+                "cond_value", "is_delete",
+            )
+        ]
+        + [
+            "\x1f".join(tab.space.phases).encode(),
+            "\x1f".join(tab.space.conditions).encode(),
+        ]
     )
 
 
@@ -78,6 +89,7 @@ class _Group:
     def __init__(self, engines, cfg, mesh):
         self.engines = engines  # ClusterEngines, federation order preserved
         self.r = 0  # rows per cluster; set by alloc
+        self.dispatches = 0  # fused-kernel launches (one per active tick)
         e0 = engines[0]
         hb_bit = e0.node_bits[SEL_HEARTBEAT]
         steps = max(1, int(getattr(cfg, "tick_substeps", 1)))
@@ -297,9 +309,11 @@ class FederatedEngine:
             self._epoch += now
             for e in self.engines:
                 e._epoch = self._epoch
+                e._inc("epoch_rebases_total")
             for g in self.groups:
                 for kind in ("nodes", "pods"):
                     g.stacked[kind] = rebase_times(g.stacked[kind], now)
+            logger.info("federated epoch rebase at engine time %.1fs", now)
             now = 0.0
         now_str = now_rfc3339()
         wake: float | None = None
@@ -336,6 +350,7 @@ class FederatedEngine:
             return None  # empty group: sleep until events
         # with substeps, anchor the LAST scan step at wall-now
         now_base = now - (g.fused.steps - 1) * g.fused.dt
+        g.dispatches += 1
         (nout, pout), wire = g.fused(
             (g.stacked["nodes"], g.stacked["pods"]), now_base
         )
@@ -422,6 +437,11 @@ class FederatedEngine:
         if self.engines:
             n = len(self.engines)
             # every member records the same shared-tick values; un-sum them
-            for name in ("ticks_total", "tick_seconds_sum", "tick_seconds_last"):
+            for name in ("ticks_total", "tick_seconds_sum",
+                         "tick_seconds_last", "epoch_rebases_total"):
                 agg[name] = agg[name] / n
+        # per-rule-set-group kernel launches: a heterogeneous federation
+        # shows one live counter per group, a homogeneous one exactly one
+        for i, g in enumerate(self.groups):
+            agg[f"group{i}_dispatches_total"] = g.dispatches
         return agg

@@ -41,7 +41,16 @@ def build_parser(defaults) -> argparse.ArgumentParser:
                    help="config file (multi-doc YAML, kwok.x-k8s.io/v1alpha1)")
     p.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG", ""))
     p.add_argument("--master", default="",
-                   help="apiserver URL override (like kube --master)")
+                   help="apiserver URL override (like kube --master); a "
+                   "comma-separated list federates N apiservers onto one "
+                   "stacked tick")
+    p.add_argument("--member-config", action="append", default=[],
+                   help="per-member kwok config YAML for --master "
+                   "federation, repeatable and positional: the i-th flag "
+                   "applies to the i-th master (its Stage documents "
+                   "replace that member's lifecycle rules — heterogeneous "
+                   "federation). An empty value inherits --config. Fewer "
+                   "flags than masters: the remainder inherit.")
     p.add_argument("--cidr", default=o.cidr)
     p.add_argument("--node-ip", default=o.nodeIP)
     p.add_argument("--manage-all-nodes", type=_bool, default=o.manageAllNodes)
@@ -152,6 +161,22 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
     # --master takes a comma-separated list: N apiservers federate onto one
     # stacked mesh-sharded tick (BASELINE config 5, engine/federation.py)
     masters = [m.strip() for m in (args.master or "").split(",") if m.strip()]
+    # validate BEFORE any network waiting: misconfiguration must fail fast
+    if args.member_config and len(masters) < 2:
+        raise SystemExit(
+            "--member-config is a federation flag: it needs a multi-master "
+            "--master list (use --config for a single cluster)"
+        )
+    if len(args.member_config) > len(masters):
+        raise SystemExit(
+            f"--member-config given {len(args.member_config)} times "
+            f"for {len(masters)} masters"
+        )
+    for mc in args.member_config:
+        if mc and not os.path.exists(mc):
+            # a typo'd path must not silently fall back to default rules
+            # (the member would quietly run a homogeneous federation)
+            raise SystemExit(f"--member-config {mc}: no such file")
     if len(masters) > 1:
         from kwok_tpu.engine import FederatedEngine
 
@@ -165,7 +190,25 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
 
         with ThreadPoolExecutor(max_workers=len(clients)) as pool:
             list(pool.map(wait_for_apiserver, clients))
-        engine = FederatedEngine(clients, _engine_config(args, stages))
+        member_configs = None
+        if args.member_config:
+            member_configs = []
+            for i, _ in enumerate(masters):
+                path = (
+                    args.member_config[i]
+                    if i < len(args.member_config)
+                    else ""
+                )
+                if path:
+                    mdocs = load_documents(path)
+                    mstages = [d for d in mdocs if isinstance(d, Stage)]
+                    member_configs.append(_engine_config(args, mstages))
+                else:
+                    member_configs.append(_engine_config(args, stages))
+        engine = FederatedEngine(
+            clients, _engine_config(args, stages),
+            member_configs=member_configs,
+        )
     else:
         client = HttpKubeClient.from_kubeconfig(
             args.kubeconfig or None, masters[0] if masters else None

@@ -69,6 +69,22 @@ def compile_rules(
     space = space or PHASE_SPACES[resource]
     mine = [r for r in rules if r.resource == resource]
 
+    # Upstream Stage documents may name phases outside the canonical
+    # vocabulary (any string is a legal .status.phase). Extend the space by
+    # APPENDING the unknown names: the canonical prefix keeps its ids, so
+    # ingest/render constants (Pending, Gone, ...) stay valid, and two rule
+    # sets differ only where their rules do (federation grouping keys
+    # include the phase names).
+    extra: list[str] = []
+    for r in mine:
+        for p in (*r.from_phases, r.effect.to_phase):
+            if p and p not in space.phases and p not in extra:
+                extra.append(p)
+    if extra:
+        space = PhaseSpace(
+            phases=space.phases + tuple(extra), conditions=space.conditions
+        )
+
     selector_names: list[str] = []
 
     def selector_id(name: str | None) -> int:

@@ -27,6 +27,7 @@ stays bandwidth-bound on the row arrays, which is the right regime for TPU.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any
 
 import jax
@@ -43,7 +44,9 @@ INF = jnp.float32(jnp.inf)
 # beyond 2**-6 s and heartbeat/delay quantization would creep; the engine
 # rebases its epoch (rebase_times + epoch shift on the host clock) before
 # `now` ever crosses this, keeping sub-16ms resolution for unbounded uptimes.
-REBASE_AFTER = 131072.0
+# Env-overridable so endurance rigs can force several rebases per hour
+# (benchmarks/endurance.py) instead of waiting ~36h for the first.
+REBASE_AFTER = float(os.environ.get("KWOK_TPU_REBASE_AFTER", "") or 131072.0)
 
 
 @jax.jit

@@ -288,3 +288,96 @@ def test_member_initial_capacity_honored():
     fed = FederatedEngine(servers, base, member_configs=cfgs)
     for e in fed.engines:
         assert e.config.initial_capacity == 512
+
+
+def test_custom_phase_names_compile_and_render():
+    """Stage docs may name phases outside the canonical vocabulary
+    (upstream kwok: any string is a legal .status.phase). The compiler
+    appends them to the space — canonical ids keep their positions — and
+    the engine renders the custom name into the patched status."""
+    import dataclasses as dc
+    import time
+
+    from kwok_tpu.models import compile_rules, default_pod_rules
+    from kwok_tpu.models.defaults import SEL_MANAGED
+    from kwok_tpu.models.lifecycle import (
+        POD_PHASES,
+        Delay,
+        LifecycleRule,
+        ResourceKind,
+        StatusEffect,
+    )
+
+    rules = default_pod_rules() + [
+        LifecycleRule(
+            name="pod-warmup",
+            resource=ResourceKind.POD,
+            from_phases=("Running",),
+            selector=SEL_MANAGED,
+            delay=Delay.constant(0.05),
+            effect=StatusEffect(to_phase="Baking", conditions={}),
+        ),
+    ]
+    tab = compile_rules(rules, ResourceKind.POD)
+    assert tab.space.phases[: len(POD_PHASES.phases)] == POD_PHASES.phases
+    assert "Baking" in tab.space.phases
+
+    from kwok_tpu.engine import ClusterEngine
+
+    server = FakeKube()
+    base = EngineConfig(manage_all_nodes=True, tick_interval=0.02)
+    eng = ClusterEngine(server, dc.replace(base, pod_rules=rules))
+    eng.start()
+    try:
+        server.create("nodes", make_node("n0"))
+        server.create("pods", make_pod("p0", node="n0"))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            pod = server.get("pods", "default", "p0")
+            if (pod.get("status") or {}).get("phase") == "Baking":
+                break
+            time.sleep(0.05)
+        assert server.get("pods", "default", "p0")["status"]["phase"] == "Baking"
+    finally:
+        eng.stop()
+
+
+def test_heterogeneous_vocabularies_do_not_share_kernels():
+    """Two members whose tables are numerically identical but whose extra
+    phase ids NAME different phases must land in different kernel groups
+    (the rendered phase strings would be wrong for one member)."""
+    import dataclasses as dc
+
+    from kwok_tpu.models import default_pod_rules
+    from kwok_tpu.models.defaults import SEL_MANAGED
+    from kwok_tpu.models.lifecycle import (
+        Delay,
+        LifecycleRule,
+        ResourceKind,
+        StatusEffect,
+    )
+
+    def rules_to(phase):
+        return default_pod_rules() + [
+            LifecycleRule(
+                name="pod-custom",
+                resource=ResourceKind.POD,
+                from_phases=("Running",),
+                selector=SEL_MANAGED,
+                delay=Delay.constant(1.0),
+                effect=StatusEffect(to_phase=phase, conditions={}),
+            ),
+        ]
+
+    servers = [FakeKube(), FakeKube()]
+    base = EngineConfig(manage_all_nodes=True, tick_interval=0.02)
+    cfgs = [
+        dc.replace(base, pod_rules=rules_to("Baking")),
+        dc.replace(base, pod_rules=rules_to("Frying")),
+    ]
+    fed = FederatedEngine(servers, base, member_configs=cfgs)
+    assert len(fed.groups) == 2
+    # per-group dispatch counters are exposed through the metrics surface
+    assert {"group0_dispatches_total", "group1_dispatches_total"} <= set(
+        fed.metrics
+    )

@@ -202,3 +202,86 @@ def test_client_create_namespaced(api):
     pod = c.create("pods", make_pod("created-p", node="n1"))
     assert pod["metadata"]["uid"]
     assert api.store.get("pods", "default", "created-p") is not None
+
+
+def test_tpukwok_cli_member_config_heterogeneous(tmp_path):
+    """--member-config gives the i-th master its own Stage rules
+    (heterogeneous federation through the real CLI): member 1's pods take
+    a custom intermediate phase on the way to Running while member 0 runs
+    the defaults; too many --member-config flags is an argument error."""
+    from kwok_tpu.kwok.cli import main
+
+    member1 = tmp_path / "member1.yaml"
+    member1.write_text(
+        "apiVersion: kwok.x-k8s.io/v1alpha1\n"
+        "kind: Stage\n"
+        "metadata: {name: pod-init}\n"
+        "spec:\n"
+        "  resourceRef: {kind: Pod}\n"
+        "  selector: {matchPhases: ['Pending']}\n"
+        "  next:\n"
+        "    phase: Warming\n"
+        "    conditions: {Initialized: true}\n"
+        "---\n"
+        "apiVersion: kwok.x-k8s.io/v1alpha1\n"
+        "kind: Stage\n"
+        "metadata: {name: pod-start}\n"
+        "spec:\n"
+        "  resourceRef: {kind: Pod}\n"
+        "  selector: {matchPhases: ['Warming']}\n"
+        "  delay: {duration: 0.05s}\n"
+        "  next:\n"
+        "    phase: Running\n"
+        "    conditions: {Ready: true, ContainersReady: true}\n"
+    )
+
+    apis = [HttpFakeApiserver().start() for _ in range(2)]
+    try:
+        stop = threading.Event()
+        rc = []
+        t = threading.Thread(
+            target=lambda: rc.append(main([
+                "--master", ",".join(a.url for a in apis),
+                "--member-config", "",
+                "--member-config", str(member1),
+                "--kubeconfig", str(tmp_path / "nope"),
+                "--manage-all-nodes", "true",
+                "--tick-interval", "0.02",
+                "--server-address", "127.0.0.1:0",
+                "--config", str(tmp_path / "absent.yaml"),
+            ], stop_event=stop)),
+            daemon=True,
+        )
+        t.start()
+        for i, a in enumerate(apis):
+            a.store.create("nodes", make_node(f"m-node-{i}"))
+            a.store.create("pods", make_pod(f"m-pod-{i}", node=f"m-node-{i}"))
+
+        deadline = time.time() + 30
+        seen_warming = False
+
+        def phase(i):
+            pod = apis[i].store.get("pods", "default", f"m-pod-{i}")
+            return ((pod or {}).get("status") or {}).get("phase")
+
+        while time.time() < deadline:
+            seen_warming = seen_warming or phase(1) == "Warming"
+            if phase(0) == "Running" and phase(1) == "Running" and seen_warming:
+                break
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=15)
+        assert rc == [0]
+        assert phase(0) == "Running" and phase(1) == "Running"
+        assert seen_warming, "member 1 never showed its custom phase"
+    finally:
+        for a in apis:
+            a.stop()
+
+    # arity error: more --member-config flags than masters
+    with pytest.raises(SystemExit):
+        main([
+            "--master", "http://127.0.0.1:1",
+            "--member-config", "a", "--member-config", "b",
+            "--manage-all-nodes", "true",
+        ])

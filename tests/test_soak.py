@@ -72,3 +72,32 @@ def test_soak_gate():
                 "ticks", "watch_events"):
         assert key in eng, (key, eng)
     assert eng["ticks"] > 0
+
+
+def test_endurance_smoke():
+    """The endurance rig (benchmarks/endurance.py) as a fast red/green
+    gate: 60s steady state with the f32 epoch shrunk so >=2 rebases land
+    inside the window, heartbeat delivery and RSS ceilings asserted by the
+    rig itself (exit 1 on violation). The hour-scale run records its
+    result in SOAK artifacts; this pins the machinery."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "endurance.py"),
+         "--nodes", "100", "--pods", "300", "--heartbeat-interval", "1",
+         "--duration", "60", "--rebase-after", "20", "--min-rebases", "2",
+         "--churn-every", "25", "--churn-pods", "10", "--sample-every", "5"],
+        capture_output=True, text=True, timeout=360, env=env,
+    )
+    # no check=True: the rig exits 1 on a ceiling violation and its JSON
+    # verdict is the diagnostic we want in the failure message
+    assert out.returncode == 0, out.stdout + out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["pass"], result
+    assert result["epoch_rebases"] >= 2
+    assert result["heartbeat_delivery"] >= 0.99
