@@ -174,13 +174,26 @@ def main() -> int:
                     idx = base * args.churn_pods + i
                     if idx >= args.pods:
                         break
-                    req("/api/v1/namespaces/default/pods", {
+                    body = {
                         "apiVersion": "v1", "kind": "Pod",
                         "metadata": {"name": f"ep-{idx}",
                                      "namespace": "default"},
                         "spec": {"nodeName": f"en-{idx % args.nodes}",
                                  "containers": [{"name": "c", "image": "i"}]},
-                    }, method="POST")
+                    }
+                    # the graceful delete may still be finalizing under
+                    # load: retry 409 AlreadyExists until the engine's
+                    # strip+delete lands (an hour-scale rig must not die
+                    # on one slow churn boundary)
+                    for attempt in range(40):
+                        try:
+                            req("/api/v1/namespaces/default/pods", body,
+                                method="POST")
+                            break
+                        except urllib.error.HTTPError as e:
+                            if e.code != 409 or attempt == 39:
+                                raise
+                            time.sleep(0.5)
                 churn_gen += 1
                 next_churn += args.churn_every
             time.sleep(args.sample_every)

@@ -52,6 +52,12 @@ class MalformedContinue(Exception):
     EXPIRED token gets)."""
 
 
+class AlreadyExists(Exception):
+    """POST of an explicitly named object whose name is taken (HTTP 409,
+    reason AlreadyExists — the real apiserver never overwrites on create;
+    generateName collisions are retried server-side instead)."""
+
+
 class _Watch:
     def __init__(self, server: "FakeKube", kind: str, field_selector, label_selector):
         self.server = server
@@ -214,6 +220,9 @@ class FakeKube:
         meta.setdefault("creationTimestamp", now_rfc3339())
         meta.setdefault("uid", f"uid-{self._rv + 1}")
         key = self._key(meta.get("namespace"), meta["name"])
+        if key in self._store[kind]:
+            # the real apiserver never overwrites on create (HTTP 409)
+            raise AlreadyExists(f'{kind} "{meta["name"]}" already exists')
         self._bump(obj, kind, key)
         self._store[kind][key] = obj
         self._emit(kind, ADDED, obj, key=key)
@@ -1227,7 +1236,17 @@ class HttpFakeApiserver:
                     return
                 if m.group("ns"):
                     obj.setdefault("metadata", {})["namespace"] = m.group("ns")
-                self._send_body(store.create_bytes(m.group("kind"), obj), 201)
+                try:
+                    body = store.create_bytes(m.group("kind"), obj)
+                except AlreadyExists as e:
+                    self._send_json(
+                        {"kind": "Status", "apiVersion": "v1",
+                         "status": "Failure", "message": str(e),
+                         "reason": "AlreadyExists", "code": 409},
+                        409,
+                    )
+                    return
+                self._send_body(body, 201)
 
         return Handler
 

@@ -202,6 +202,13 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
                 if path:
                     mdocs = load_documents(path)
                     mstages = [d for d in mdocs if isinstance(d, Stage)]
+                    if not mstages:
+                        # a file with no Stage docs (typo'd kind/apiVersion)
+                        # must not silently run the default rules — same
+                        # guard as a typo'd path
+                        raise SystemExit(
+                            f"--member-config {path}: no Stage documents"
+                        )
                     member_configs.append(_engine_config(args, mstages))
                 else:
                     member_configs.append(_engine_config(args, stages))

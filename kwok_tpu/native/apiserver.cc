@@ -1787,6 +1787,7 @@ bool App::handle_request(int fd, Request& req) {
     JVal& meta = obj.get_or_insert_obj("metadata");
     if (!m.ns.empty()) meta.set("namespace", JVal::str(m.ns));
     EntryPtr e;
+    std::string exists_name;
     {
       std::lock_guard<std::mutex> lk(store.mu);
       if (!meta.find("name")) {
@@ -1817,6 +1818,12 @@ bool App::handle_request(int fd, Request& req) {
       }
       Key k = Store::obj_key(obj);
       if (k.second.empty()) {
+        e = nullptr;
+      } else if (store.kinds[m.kind].count(k)) {
+        // the real apiserver never overwrites on create (HTTP 409;
+        // mirrors mockserver.py AlreadyExists). Respond AFTER the lock
+        // drops (a stalled client must not wedge the store).
+        exists_name = k.second;
         e = nullptr;
       } else {
         if (!meta.find("creationTimestamp"))
@@ -1856,6 +1863,18 @@ bool App::handle_request(int fd, Request& req) {
           }
         }
       }
+    }
+    if (!exists_name.empty()) {
+      std::string body =
+          "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+          "\"Failure\",\"message\":\"";
+      json_escape(body, KIND_NAMES[m.kind]);
+      body += " \\\"";
+      json_escape(body, exists_name);
+      body +=
+          "\\\" already exists\",\"reason\":\"AlreadyExists\","
+          "\"code\":409}";
+      return respond(409, body);
     }
     if (!e) return respond(400, "{\"kind\":\"Status\",\"code\":400}");
     return respond(201, e->bytes);

@@ -333,3 +333,54 @@ def test_native_events_cap_ignores_explicit_deletes():
     finally:
         c.close()
         srv.stop()
+
+
+def test_duplicate_named_create_is_409_python(pysrv):
+    import urllib.error
+    import urllib.request
+
+    body = json.dumps({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "dup"}}).encode()
+
+    def post():
+        req = urllib.request.Request(
+            pysrv.url + "/api/v1/nodes", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        return urllib.request.urlopen(req, timeout=5)
+
+    assert post().status == 201
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post()
+    assert ei.value.code == 409
+    assert json.loads(ei.value.read())["reason"] == "AlreadyExists"
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_duplicate_named_create_is_409_native():
+    import urllib.error
+    import urllib.request
+
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer()
+    try:
+        body = json.dumps({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "dup"}}).encode()
+
+        def post():
+            req = urllib.request.Request(
+                srv.url + "/api/v1/nodes", data=body,
+                headers={"Content-Type": "application/json"}, method="POST")
+            return urllib.request.urlopen(req, timeout=5)
+
+        assert post().status == 201
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post()
+        assert ei.value.code == 409
+        doc = json.loads(ei.value.read())
+        assert doc["reason"] == "AlreadyExists"
+        assert 'nodes "dup" already exists' in doc["message"]
+    finally:
+        srv.stop()
