@@ -22,7 +22,9 @@ those templates are a degenerate case of, with a Stage-shaped YAML surface:
         phase: Succeeded
         conditions: {Ready: false, ContainersReady: false}
         delete: false
-      weight: 1
+      weight: 3   # optional; absent/0 = deterministic first-match, > 0 =
+                  # weighted-random among matching weighted stages
+                  # (LifecycleRule.weight has the full semantics)
 
 Stages for a resource REPLACE the default rule set for that resource.
 """
@@ -110,7 +112,10 @@ class Stage:
     to_phase: str
     conditions: dict[str, bool]
     delete: bool
-    weight: int = 1
+    # spec.weight: absent/0 = deterministic first-match ordering; > 0 opts
+    # the stage into weighted-random selection among matching weighted
+    # stages (see LifecycleRule.weight for the full semantics).
+    weight: int = 0
 
     KIND = "Stage"
 
@@ -151,6 +156,9 @@ class Stage:
                 f"Stage {name!r}: bad matchDeletion {deletion_name!r}; "
                 f"valid values: {sorted(_DELETION)}"
             )
+        weight = int(spec.get("weight", 0))
+        if weight < 0:
+            raise ValueError(f"Stage {name!r}: spec.weight must be >= 0")
         return cls(
             name=name,
             resource=resource,
@@ -161,7 +169,7 @@ class Stage:
             to_phase=to_phase,
             conditions=dict(nxt.get("conditions") or {}),
             delete=delete,
-            weight=int(spec.get("weight", 1)),
+            weight=weight,
         )
 
     def to_rule(self) -> LifecycleRule:

@@ -72,7 +72,7 @@ def _table_bytes(tab) -> bytes:
             for f in (
                 "from_mask", "deletion", "selector_bit", "delay_kind",
                 "delay_a", "delay_b", "to_phase", "cond_assign",
-                "cond_value", "is_delete",
+                "cond_value", "is_delete", "weight",
             )
         ]
         + [
@@ -317,8 +317,12 @@ class FederatedEngine:
             now = 0.0
         now_str = now_rfc3339()
         wake: float | None = None
+        flush_s = kernel_s = emit_s = 0.0
         for g in self.groups:
-            due = self._tick_group(g, now, now_str)
+            due, f_s, k_s, e_s = self._tick_group(g, now, now_str)
+            flush_s += f_s
+            kernel_s += k_s
+            emit_s += e_s
             if due is not None:
                 wake = due if wake is None else min(wake, due)
         self._idle_wake = wake
@@ -328,13 +332,24 @@ class FederatedEngine:
                 e.metrics["ticks_total"] += 1
                 e.metrics["tick_seconds_sum"] += elapsed
                 e.metrics["tick_seconds_last"] = elapsed
+                # shared-tick breakdown, mirrored to every member like
+                # tick_seconds_sum (un-summed in the aggregate) so SOAK
+                # artifacts attribute federated wall time, not zeros
+                e.metrics["tick_flush_seconds_sum"] += flush_s
+                e.metrics["tick_kernel_seconds_sum"] += kernel_s
+                e.metrics["tick_emit_seconds_sum"] += emit_s
                 e.metrics["nodes_managed"] = len(e.nodes.pool)
                 e.metrics["pods_managed"] = len(e.pods.pool)
 
-    def _tick_group(self, g: _Group, now: float, now_str: str) -> float | None:
-        """One fused dispatch for one rule-set group. Returns the monotonic
-        wake-up for the group's next device-scheduled event (None = none)."""
+    def _tick_group(
+        self, g: _Group, now: float, now_str: str
+    ) -> tuple[float | None, float, float, float]:
+        """One fused dispatch for one rule-set group. Returns (wake,
+        flush_s, kernel_s, emit_s): the monotonic wake-up for the group's
+        next device-scheduled event (None = none) plus the same per-phase
+        breakdown the solo tick records (engine.tick_once)."""
         r = g.r
+        t0 = time.perf_counter()
         any_rows = False
         for kind in ("nodes", "pods"):
             state = g.stacked[kind]
@@ -346,8 +361,10 @@ class FederatedEngine:
                 elif len(k.pool):
                     any_rows = True
             g.stacked[kind] = state
+        t_flush = time.perf_counter()
         if not any_rows:
-            return None  # empty group: sleep until events
+            # empty group: sleep until events
+            return None, t_flush - t0, 0.0, 0.0
         # with substeps, anchor the LAST scan step at wall-now
         now_base = now - (g.fused.steps - 1) * g.fused.dt
         g.dispatches += 1
@@ -364,6 +381,7 @@ class FederatedEngine:
             else time.monotonic() + max(0.0, nd - now)
         )
         masks = masks_fn() if counters.any() else None
+        t_kernel = time.perf_counter()
         for i, (kind, out) in enumerate((("nodes", nout), ("pods", pout))):
             if not (int(counters[i]) or int(counters[2 + i])):
                 continue
@@ -383,7 +401,12 @@ class FederatedEngine:
                     k.phase_h = phase[lo:hi].copy()
                     k.cond_h = cond[lo:hi].copy()
                     e._emit(kind, k, d_c, del_c, hb_c, now_str)
-        return wake
+        return (
+            wake,
+            t_flush - t0,
+            t_kernel - t_flush,
+            time.perf_counter() - t_kernel,
+        )
 
     # ------------------------------------------------------------------ grow
 
@@ -438,7 +461,9 @@ class FederatedEngine:
             n = len(self.engines)
             # every member records the same shared-tick values; un-sum them
             for name in ("ticks_total", "tick_seconds_sum",
-                         "tick_seconds_last", "epoch_rebases_total"):
+                         "tick_seconds_last", "epoch_rebases_total",
+                         "tick_flush_seconds_sum", "tick_kernel_seconds_sum",
+                         "tick_emit_seconds_sum"):
                 agg[name] = agg[name] / n
         # per-rule-set-group kernel launches: a heterogeneous federation
         # shows one live counter per group, a homogeneous one exactly one

@@ -52,6 +52,9 @@ class CompiledRules:
     cond_assign: np.ndarray  # uint32: which condition bits the rule writes
     cond_value: np.ndarray  # uint32: the values written for assigned bits
     is_delete: np.ndarray  # bool
+    # float32 Stage spec.weight; 0 = deterministic first-match rule, > 0 =
+    # member of the stochastic pool (see LifecycleRule.weight).
+    weight: np.ndarray
     # Host-side metadata (not shipped to device).
     names: tuple[str, ...]
     selector_names: tuple[str, ...]  # bit index -> selector name
@@ -107,8 +110,12 @@ def compile_rules(
     cond_assign = np.zeros(n, np.uint32)
     cond_value = np.zeros(n, np.uint32)
     is_delete = np.zeros(n, bool)
+    weight = np.zeros(n, np.float32)
 
     for i, r in enumerate(mine):
+        if r.weight < 0:
+            raise ValueError(f"rule {r.name!r}: weight must be >= 0")
+        weight[i] = float(r.weight)
         to_id = space.phase_id(r.effect.to_phase)
         if r.from_phases:
             mask = 0
@@ -153,23 +160,22 @@ def compile_rules(
         cond_assign=cond_assign,
         cond_value=cond_value,
         is_delete=is_delete,
+        weight=weight,
         names=tuple(r.name for r in mine),
         selector_names=tuple(selector_names),
     )
 
 
-def match_rule_host(
+def match_rules_host(
     table: CompiledRules,
     phase: int,
     sel_bits: int,
     has_deletion: bool,
-) -> int:
-    """Pure-python single-row rule matcher (the oracle for property tests).
-
-    Mirrors the device-side matching in kwok_tpu.ops.tick exactly: first rule
-    (lowest index) whose phase mask, deletion requirement, and selector bit
-    all match.
-    """
+) -> list[int]:
+    """All rule indices whose guards (phase mask, deletion requirement,
+    selector bit) match, in priority order. Pure-python oracle mirror of
+    the device-side [C, R] match in kwok_tpu.ops.tick."""
+    out = []
     for i in range(table.num_rules):
         if not (int(table.from_mask[i]) >> phase) & 1:
             continue
@@ -179,5 +185,48 @@ def match_rule_host(
         sb = int(table.selector_bit[i])
         if sb >= 0 and not (sel_bits >> sb) & 1:
             continue
-        return i
-    return -1
+        out.append(i)
+    return out
+
+
+def choose_rule_host(table: CompiledRules, matches: list[int], u2: float) -> int:
+    """Select among matched rules exactly like the tick kernel:
+
+    - no matches -> -1;
+    - first match unweighted (weight 0) -> first match (deterministic);
+    - first match weighted -> weighted-random among ALL matching weighted
+      rules, P(i) proportional to weight[i], via the caller's uniform u2 in
+      [0, 1) (the device uses its per-row PRNG draw).
+    """
+    if not matches:
+        return -1
+    first = matches[0]
+    if float(table.weight[first]) <= 0:
+        return first
+    pool = [i for i in matches if float(table.weight[i]) > 0]
+    total = sum(float(table.weight[i]) for i in pool)
+    target = u2 * total
+    acc = 0.0
+    for i in pool:
+        acc += float(table.weight[i])
+        if acc > target:
+            return i
+    return pool[-1]
+
+
+def match_rule_host(
+    table: CompiledRules,
+    phase: int,
+    sel_bits: int,
+    has_deletion: bool,
+    u2: float = 0.0,
+) -> int:
+    """Pure-python single-row rule matcher (the oracle for property tests).
+
+    Mirrors the device-side selection in kwok_tpu.ops.tick exactly: first
+    rule (lowest index) whose guards all match — or, when the first match
+    is weighted, the weighted draw made by `choose_rule_host` with `u2`
+    (the default 0.0 picks the lowest-index weighted match)."""
+    return choose_rule_host(
+        table, match_rules_host(table, phase, sel_bits, has_deletion), u2
+    )

@@ -91,9 +91,10 @@ class StatusEffect:
 class LifecycleRule:
     """selector + delay + next-state: one edge of the lifecycle state machine.
 
-    First matching rule wins (rules are ordered). A row re-enters matching
-    after every transition, so chains of rules express multi-step lifecycles
-    (Pending -> Running -> Succeeded).
+    First matching rule wins (rules are ordered), unless the first match is
+    weighted — see `weight` below for the stochastic-selection semantics. A
+    row re-enters matching after every transition, so chains of rules
+    express multi-step lifecycles (Pending -> Running -> Succeeded).
     """
 
     name: str
@@ -106,10 +107,15 @@ class LifecycleRule:
     # Name of a host-computed selector; resolved to a bit index by the
     # compiler. None => matches every row of the resource.
     selector: str | None = None
-    # Relative weight for weighted-random choice among equally-ranked rules
-    # (the Stage CRD's spec.weight; currently first-match-wins, weight kept
-    # for wire compatibility).
-    weight: int = 1
+    # The Stage CRD's spec.weight. 0 (the default, = absent in YAML) keeps
+    # the deterministic first-match-wins ordering. weight > 0 opts the rule
+    # into stochastic selection: when the FIRST matching rule is weighted,
+    # the row draws among ALL matching weighted rules with probability
+    # proportional to weight (upstream Stage semantics for weighted stage
+    # sets); a weight-0 rule at lower index still wins deterministically.
+    # An armed choice is sticky — re-drawn only when ingest invalidates it
+    # or the rule fires, never on a quiet tick.
+    weight: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

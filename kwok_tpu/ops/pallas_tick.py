@@ -252,6 +252,13 @@ class PallasTickKernel:
         interpret: bool = False,
     ) -> None:
         self.table = table
+        if bool((np.asarray(table.weight) > 0).any()):
+            # the in-kernel matcher is first-match-only; refusing beats
+            # silently ignoring a declared Stage spec.weight
+            raise NotImplementedError(
+                "PallasTickKernel does not implement weighted rule choice; "
+                "use the fused XLA tick for weighted Stage sets"
+            )
         self.steps = int(steps)
         self.dt = float(dt)
         self.block_rows = int(block_rows)

@@ -3,19 +3,24 @@
 The oracle for property tests (SURVEY.md section 4: "property-test the tick
 kernel against a reference Python interpreter of the rules"). Implements the
 same three steps as kwok_tpu.ops.tick.tick_body — match / fire / heartbeat —
-in scalar-friendly numpy, reusing the single-row matcher
-kwok_tpu.models.compiler.match_rule_host.
+in scalar-friendly numpy, reusing the single-row matcher and weighted-choice
+helpers kwok_tpu.models.compiler.match_rules_host / choose_rule_host.
 
-Randomness: the caller supplies the per-row uniform samples `u` so the oracle
-is deterministic; tests use constant delays (u irrelevant) for exact
-equivalence and statistical tests for the stochastic kinds.
+Randomness: the caller supplies the per-row uniform samples `u` (delay
+sampling) and `u2` (weighted rule choice) so the oracle is deterministic;
+tests use constant delays (u irrelevant) for exact equivalence and
+statistical tests for the stochastic kinds.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from kwok_tpu.models.compiler import CompiledRules, match_rule_host
+from kwok_tpu.models.compiler import (
+    CompiledRules,
+    choose_rule_host,
+    match_rules_host,
+)
 from kwok_tpu.models.lifecycle import DelayKind
 from kwok_tpu.ops.state import RowState, TickOutputs
 
@@ -42,10 +47,13 @@ def reference_tick(
     hb_phase_mask: int = 0,
     hb_sel_bit: int = -1,
     u: np.ndarray | None = None,
+    u2: np.ndarray | None = None,
 ) -> TickOutputs:
     c = state.capacity
     if u is None:
         u = np.full(c, 0.5)
+    if u2 is None:
+        u2 = np.zeros(c)
 
     phase = np.array(state.phase, np.int32)
     cond = np.array(state.cond_bits, np.uint32)
@@ -66,10 +74,22 @@ def reference_tick(
             fire_at[i] = np.inf
             hb_due[i] = np.inf
             continue
-        # 1. match / re-arm
-        best = match_rule_host(
-            table, int(phase[i]), int(state.sel_bits[i]), bool(state.has_deletion[i])
+        # 1. match / re-arm. Sticky weighted choice mirrors the kernel: an
+        # armed weighted rule that still matches is kept (no re-roll).
+        matches = match_rules_host(
+            table, int(phase[i]), int(state.sel_bits[i]),
+            bool(state.has_deletion[i]),
         )
+        p = int(pending[i])
+        if (
+            matches
+            and float(table.weight[matches[0]]) > 0
+            and p in matches
+            and float(table.weight[p]) > 0
+        ):
+            best = p
+        else:
+            best = choose_rule_host(table, matches, float(u2[i]))
         if best != int(pending[i]):
             if best >= 0:
                 pending[i] = best

@@ -72,6 +72,10 @@ def _rule_arrays(table: CompiledRules) -> dict[str, jnp.ndarray]:
         "cond_assign": jnp.asarray(table.cond_assign, jnp.uint32),
         "cond_value": jnp.asarray(table.cond_value, jnp.uint32),
         "is_delete": jnp.asarray(table.is_delete, bool),
+        "weight": jnp.asarray(table.weight, jnp.float32),
+        # python bool, decided at compile time: unweighted tables (every
+        # default set) trace to exactly the pre-weight program
+        "has_weights": bool(np.asarray(table.weight).max(initial=0.0) > 0),
     }
 
 
@@ -106,6 +110,39 @@ def tick_body(
         match = phase_ok & del_ok & sel_ok  # [C, R]
         any_match = match.any(axis=1)
         first = jnp.argmax(match, axis=1).astype(jnp.int32)  # first True
+
+        # Stage spec.weight (LifecycleRule.weight): when the FIRST matching
+        # rule is weighted, draw among ALL matching weighted rules with
+        # P(i) ~ weight[i]; an armed weighted choice is STICKY (kept while
+        # it still matches) so quiet ticks never re-roll. `has_weights` is
+        # a trace-time constant — unweighted tables (the default sets)
+        # compile to exactly the pre-weight program.
+        w = rules["weight"]
+        has_weights = rules["has_weights"]
+        key_delay = key
+        if has_weights:
+            key_delay = jax.random.fold_in(key, 0)
+            wm = match.astype(jnp.float32) * w[None, :]
+            cw = jnp.cumsum(wm, axis=1)
+            total = cw[:, -1]
+            u2 = jax.random.uniform(
+                jax.random.fold_in(key, 1), (capacity,), jnp.float32,
+                minval=1e-7, maxval=1.0,
+            )
+            # first index whose cumulative weight exceeds the target; a
+            # zero-mass rule can never be chosen (its cumsum step is flat)
+            chosen = jnp.argmax(
+                cw > (u2 * total)[:, None], axis=1
+            ).astype(jnp.int32)
+            use_weighted = any_match & (w[first] > 0)
+            pend = state.pending_rule
+            pidx = jnp.maximum(pend, 0)
+            pend_valid = (pend >= 0) & jnp.take_along_axis(
+                match, pidx[:, None], axis=1
+            )[:, 0] & (w[pidx] > 0)
+            first = jnp.where(
+                use_weighted, jnp.where(pend_valid, pend, chosen), first
+            )
         best = jnp.where(active & any_match, first, jnp.int32(-1))
 
         # Re-arm rows whose best rule changed (covers newly matched rows and
@@ -116,7 +153,7 @@ def tick_body(
         a = rules["delay_a"][rid]
         b = rules["delay_b"][rid]
         u = jax.random.uniform(
-            key, (capacity,), jnp.float32, minval=1e-7, maxval=1.0
+            key_delay, (capacity,), jnp.float32, minval=1e-7, maxval=1.0
         )
         d_uniform = a + (b - a) * u
         d_exp = -a * jnp.log(u)

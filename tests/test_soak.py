@@ -74,6 +74,24 @@ def test_soak_gate():
     assert eng["ticks"] > 0
 
 
+def test_soak_federated_breakdown():
+    """Federated ticks must report the same flush/kernel/emit breakdown the
+    solo path does (VERDICT r3 weak #2: SOAK_r03 shipped tick_kernel_s=0.0
+    for every federated run, making the soak's own breakdown meaningless
+    for exactly the configurations it measures). Red/green: a federation
+    whose engine blocks are zeroed — or don't sum to ~tick_s — fails."""
+    result = _run_soak("--members", "2", "--nodes", "200", "--pods", "1000",
+                       "--timeout", "180")
+    eng = result["engine"]
+    assert eng["tick_kernel_s"] > 0.0, eng
+    assert eng["tick_emit_s"] > 0.0, eng
+    parts = eng["tick_flush_s"] + eng["tick_kernel_s"] + eng["tick_emit_s"]
+    # the three blocks are disjoint sub-spans of the tick: they can never
+    # exceed the total, and in a busy soak they attribute most of it
+    assert parts <= eng["tick_s"] * 1.01, eng
+    assert parts >= eng["tick_s"] * 0.3, eng
+
+
 def test_endurance_smoke():
     """The endurance rig (benchmarks/endurance.py) as a fast red/green
     gate: 60s steady state with the f32 epoch shrunk so >=2 rebases land

@@ -1,0 +1,195 @@
+"""Stage spec.weight: weighted-random rule choice (VERDICT r3 missing #4).
+
+Semantics under test (LifecycleRule.weight):
+- weight 0 / absent   -> deterministic first-match-wins (the pre-weight
+  behavior, bit-for-bit: unweighted tables compile to the same program);
+- first match weighted -> draw among ALL matching weighted rules with
+  P(i) ~ weight[i] (upstream Stage semantics for weighted stage sets);
+- a weight-0 rule at lower index than every weighted match still wins
+  deterministically;
+- an armed weighted choice is STICKY: quiet ticks never re-roll it.
+
+The reference snapshot predates the Stage CRD entirely (SURVEY.md
+"Snapshot vintage"), so there is no Go counterpart to cite; the oracle is
+kwok_tpu.ops.reference with caller-supplied uniforms.
+"""
+
+import numpy as np
+import pytest
+
+from kwok_tpu.config.stages import Stage
+from kwok_tpu.models import compile_rules
+from kwok_tpu.models.compiler import choose_rule_host, match_rule_host
+from kwok_tpu.models.lifecycle import (
+    Delay,
+    LifecycleRule,
+    ResourceKind,
+    StatusEffect,
+)
+from kwok_tpu.ops import TickKernel, new_row_state, reference_tick
+from kwok_tpu.ops.tick import to_host
+
+
+def weighted_rules(weights, delay=Delay.constant(0.0), to=None):
+    """N pod rules with identical guards (from Pending, no selector) and
+    distinct target phases, one per weight."""
+    to = to or ["Running", "Succeeded", "Failed", "Terminating"]
+    return [
+        LifecycleRule(
+            name=f"w{i}",
+            resource=ResourceKind.POD,
+            from_phases=("Pending",),
+            effect=StatusEffect(to_phase=to[i]),
+            delay=delay,
+            weight=w,
+        )
+        for i, w in enumerate(weights)
+    ]
+
+
+def seed(n):
+    state = new_row_state(n)
+    state.active[:n] = True
+    state.sel_bits[:n] = 0b11
+    return state
+
+
+def test_unweighted_default_is_zero_and_first_match():
+    """Default rule sets carry weight 0 everywhere -> the deterministic
+    pre-weight program (golden: existing tick tests all still pass)."""
+    from kwok_tpu.models import default_rules
+
+    table = compile_rules(default_rules(), ResourceKind.POD)
+    assert (table.weight == 0).all()
+    # first-match even when several rules would match later
+    assert match_rule_host(table, 0, 0b11, False) == match_rule_host(
+        table, 0, 0b11, False, u2=0.999
+    )
+
+
+def test_weighted_distribution_matches_weights_10k_rows():
+    """Empirical transition distribution ~ weights at 10k rows (the VERDICT
+    acceptance bar). Weights 1:3 -> 25%/75%; tolerance 5 sigma
+    (sigma = sqrt(n*p*(1-p)) ~ 43)."""
+    n = 10_000
+    table = compile_rules(weighted_rules([1, 3]), ResourceKind.POD)
+    kern = TickKernel(table)
+    out = to_host(kern(seed(n), now=0.0))
+    run = int((out.state.phase == table.space.phase_id("Running")).sum())
+    suc = int((out.state.phase == table.space.phase_id("Succeeded")).sum())
+    assert run + suc == n
+    sigma = (n * 0.25 * 0.75) ** 0.5
+    assert abs(run - 0.25 * n) < 5 * sigma, (run, suc)
+
+
+def test_weight_zero_rule_shadowed_by_weighted_first():
+    """Pool = matching weighted rules only: a weight-0 rule BETWEEN weighted
+    ones has zero mass and is never chosen."""
+    n = 4_000
+    table = compile_rules(weighted_rules([2, 0, 6]), ResourceKind.POD)
+    kern = TickKernel(table)
+    out = to_host(kern(seed(n), now=0.0))
+    phases = np.asarray(out.state.phase)
+    assert (phases != table.space.phase_id("Succeeded")).all()  # rule 1
+    run = int((phases == table.space.phase_id("Running")).sum())
+    sigma = (n * 0.25 * 0.75) ** 0.5
+    assert abs(run - 0.25 * n) < 5 * sigma, run
+
+
+def test_weight_zero_first_match_stays_deterministic():
+    """A weight-0 rule at the lowest matching index wins every time, even
+    with weighted rules behind it (deterministic rules outrank the pool)."""
+    n = 512
+    table = compile_rules(weighted_rules([0, 5, 7]), ResourceKind.POD)
+    kern = TickKernel(table)
+    out = to_host(kern(seed(n), now=0.0))
+    assert (out.state.phase == table.space.phase_id("Running")).all()
+    # host oracle corner: identical for any u2
+    for u2 in (0.0, 0.31, 0.999):
+        assert match_rule_host(table, 0, 0b11, False, u2=u2) == 0
+
+
+def test_armed_weighted_choice_is_sticky():
+    """Quiet ticks must not re-roll an armed weighted rule: pending_rule and
+    fire_at stay fixed across ticks until the delay elapses."""
+    n = 256
+    table = compile_rules(
+        weighted_rules([1, 1], delay=Delay.constant(100.0)), ResourceKind.POD
+    )
+    kern = TickKernel(table)
+    out = to_host(kern(seed(n), now=0.0))
+    pend0 = np.asarray(out.state.pending_rule).copy()
+    fire0 = np.asarray(out.state.fire_at).copy()
+    assert set(np.unique(pend0[:n])) == {0, 1}  # both rules actually drawn
+    for t in (1.0, 7.0, 42.0):
+        out = to_host(kern(out.state, now=t))
+        assert (np.asarray(out.state.pending_rule) == pend0).all()
+        assert np.array_equal(np.asarray(out.state.fire_at), fire0)
+        assert int(out.transitions) == 0
+    out = to_host(kern(out.state, now=101.0))
+    assert int(out.transitions) == n
+
+
+def test_oracle_distribution_matches_weights():
+    """reference_tick with a u2 grid reproduces the weight distribution
+    exactly (deterministic oracle, no sampling noise)."""
+    n = 1_000
+    table = compile_rules(weighted_rules([1, 3]), ResourceKind.POD)
+    u2 = (np.arange(n) + 0.5) / n  # uniform grid over [0, 1)
+    out = reference_tick(seed(n), 0.0, table, u2=u2)
+    run = int((out.state.phase == table.space.phase_id("Running")).sum())
+    assert run == 250  # exactly weight_0 / total of the grid
+
+    # choose_rule_host boundary: mass boundaries fall at cumulative/total
+    assert choose_rule_host(table, [0, 1], 0.2499) == 0
+    assert choose_rule_host(table, [0, 1], 0.2501) == 1
+
+
+def test_oracle_sticky_matches_kernel_semantics():
+    """The oracle keeps an armed weighted rule even when u2 would now pick
+    the other one (mirrors the kernel's no-re-roll guarantee)."""
+    n = 8
+    table = compile_rules(
+        weighted_rules([1, 1], delay=Delay.constant(50.0)), ResourceKind.POD
+    )
+    out = reference_tick(seed(n), 0.0, table, u2=np.zeros(n))  # all arm rule 0
+    assert (np.asarray(out.state.pending_rule)[:n] == 0).all()
+    out2 = reference_tick(out.state, 10.0, table, u2=np.full(n, 0.99))
+    assert (np.asarray(out2.state.pending_rule)[:n] == 0).all()
+    assert np.array_equal(out2.state.fire_at, out.state.fire_at)
+
+
+def test_stage_weight_roundtrip_and_validation():
+    doc = {
+        "apiVersion": "kwok.x-k8s.io/v1alpha1",
+        "kind": "Stage",
+        "metadata": {"name": "maybe-fail"},
+        "spec": {
+            "resourceRef": {"apiGroup": "v1", "kind": "Pod"},
+            "selector": {"matchPhases": ["Pending"]},
+            "next": {"phase": "Failed"},
+            "weight": 3,
+        },
+    }
+    st = Stage.from_doc(doc)
+    assert st.weight == 3
+    assert Stage.from_doc(st.to_doc()).weight == 3
+    assert st.to_rule().weight == 3
+    # absent weight -> 0 (deterministic), round-trips as 0
+    del doc["spec"]["weight"]
+    assert Stage.from_doc(doc).weight == 0
+    # negative rejected at parse time
+    doc["spec"]["weight"] = -1
+    with pytest.raises(ValueError, match="weight"):
+        Stage.from_doc(doc)
+    # ... and at compile time
+    with pytest.raises(ValueError, match="weight"):
+        compile_rules(weighted_rules([1, -2]), ResourceKind.POD)
+
+
+def test_pallas_kernel_rejects_weighted_tables():
+    from kwok_tpu.ops.pallas_tick import PallasTickKernel
+
+    table = compile_rules(weighted_rules([1, 3]), ResourceKind.POD)
+    with pytest.raises(NotImplementedError, match="weighted"):
+        PallasTickKernel(table)
