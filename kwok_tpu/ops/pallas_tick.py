@@ -158,14 +158,18 @@ def _kernel(
             tp = jnp.zeros((block_rows, LANES), jnp.int32)
             ca = jnp.zeros((block_rows, LANES), jnp.uint32)
             cv = jnp.zeros((block_rows, LANES), jnp.uint32)
-            isdel = zero_b
+            # isdel stays int32 through the select chain: broadcasting the
+            # SMEM scalar as a bool (isdel_ref[r] != 0) makes Mosaic
+            # truncate i32->i1, which it cannot lower (first hardware run
+            # caught this; interpret mode doesn't lower through Mosaic)
+            isdel = jnp.zeros((block_rows, LANES), jnp.int32)
             for r in range(num_rules):
                 sel = frid == r
                 tp = jnp.where(sel, tp_ref[r], tp)
                 ca = jnp.where(sel, ca_ref[r].astype(jnp.uint32), ca)
                 cv = jnp.where(sel, cv_ref[r].astype(jnp.uint32), cv)
-                isdel = jnp.where(sel, isdel_ref[r] != 0, isdel)
-            fired_delete = can_fire & isdel
+                isdel = jnp.where(sel, isdel_ref[r], isdel)
+            fired_delete = can_fire & (isdel != 0)
             phase = jnp.where(can_fire, tp, phase)
             cond = jnp.where(can_fire, (cond & ~ca) | cv, cond)
             pend = jnp.where(can_fire, jnp.int32(-1), pend)
@@ -201,17 +205,24 @@ def _kernel(
             ),
         )
 
+        # accumulator masks travel as int32: Mosaic cannot legalize an
+        # scf.for whose carry holds i1 vectors (first hardware run caught
+        # this — "Unsupported target bitwidth for truncation"; interpret
+        # mode doesn't lower through Mosaic)
         return (
             phase, cond, pend, fire, hb_due, gen,
-            dirty_acc | dirty, del_acc | fired_delete, hbf_acc | hb_fired,
+            dirty_acc | dirty.astype(jnp.int32),
+            del_acc | fired_delete.astype(jnp.int32),
+            hbf_acc | hb_fired.astype(jnp.int32),
             trans + can_fire.sum(dtype=jnp.int32),
             hbs + hb_fired.sum(dtype=jnp.int32),
         )
 
+    zero_i = jnp.zeros((block_rows, LANES), jnp.int32)
     init = (
         phase_ref[:], cond_ref[:].astype(jnp.uint32), pend_ref[:],
         fire_ref[:], hb_ref[:], gen_ref[:],
-        zero_b, zero_b, zero_b, jnp.int32(0), jnp.int32(0),
+        zero_i, zero_i, zero_i, jnp.int32(0), jnp.int32(0),
     )
     (phase, cond, pend, fire, hb_due, gen,
      dirty, deleted, hbf, trans, hbs) = jax.lax.fori_loop(
@@ -224,11 +235,20 @@ def _kernel(
     o_fire[:] = fire
     o_hb[:] = hb_due
     o_gen[:] = gen
-    o_dirty[:] = dirty.astype(jnp.int32)
-    o_deleted[:] = deleted.astype(jnp.int32)
-    o_hbf[:] = hbf.astype(jnp.int32)
-    o_counts[0, 0] = trans
-    o_counts[0, 1] = hbs
+    o_dirty[:] = dirty
+    o_deleted[:] = deleted
+    o_hbf[:] = hbf
+    # counters ride out as a full (8, 128) i32 tile: Mosaic requires the
+    # last two block dims to be (8, 128)-divisible even in SMEM (first
+    # hardware run caught this; interpret mode doesn't lower through
+    # Mosaic), so the 2 scalars sit in lanes (0,0)/(0,1) of a padded tile
+    r_i = jax.lax.broadcasted_iota(jnp.int32, (8, LANES), 0)
+    c_i = jax.lax.broadcasted_iota(jnp.int32, (8, LANES), 1)
+    o_counts[0] = jnp.where(
+        (r_i == 0) & (c_i == 0),
+        trans,
+        jnp.where((r_i == 0) & (c_i == 1), hbs, jnp.int32(0)),
+    )
 
 
 class PallasTickKernel:
@@ -322,9 +342,12 @@ class PallasTickKernel:
             jax.ShapeDtypeStruct(shape2, i32),        # dirty
             jax.ShapeDtypeStruct(shape2, i32),        # deleted
             jax.ShapeDtypeStruct(shape2, i32),        # hbf
-            jax.ShapeDtypeStruct((grid, 2), i32),     # per-block counters
+            # per-block counters, padded to a full tile (see _kernel)
+            jax.ShapeDtypeStruct((grid, 8, LANES), i32),
         ]
-        out_specs = [row_spec] * 9 + [pl.BlockSpec((1, 2), lambda i: (i, 0))]
+        out_specs = [row_spec] * 9 + [
+            pl.BlockSpec((1, 8, LANES), lambda i: (i, 0, 0))
+        ]
         in_specs = (
             [spec_scalar(1)] * 2       # now, seed
             + [spec_scalar(R)] * 10    # rule arrays
@@ -387,8 +410,8 @@ class PallasTickKernel:
                 dirty=flat(dirty) != 0,
                 deleted=flat(deleted) != 0,
                 hb_fired=flat(hbf) != 0,
-                transitions=counts[:, 0].sum(dtype=jnp.int32),
-                heartbeats=counts[:, 1].sum(dtype=jnp.int32),
+                transitions=counts[:, 0, 0].sum(dtype=jnp.int32),
+                heartbeats=counts[:, 0, 1].sum(dtype=jnp.int32),
             )
 
         return run
