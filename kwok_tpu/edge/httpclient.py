@@ -13,20 +13,23 @@ from __future__ import annotations
 import atexit
 import base64
 import http.client
+import io
 import json
 import logging
 import os
+import re
 import socket
 import ssl
 import tempfile
 import threading
+import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Iterator
 
 import yaml
 
-from kwok_tpu.edge.kubeclient import WatchEvent
+from kwok_tpu.edge.kubeclient import TooLargeResourceVersion, WatchEvent
 
 logger = logging.getLogger("kwok_tpu.edge.http")
 
@@ -377,7 +380,43 @@ class _HttpWatch:
             "allowWatchBookmarks": "false",
         })
         # no read timeout: watch connections idle legitimately
-        self._resp = client._request("GET", url, timeout=3600.0)
+        try:
+            self._resp = client._request("GET", url, timeout=3600.0)
+        except urllib.error.HTTPError as e:
+            # a resume AHEAD of the server's store fails the watch
+            # handshake with 504 + a ResourceVersionTooLarge cause
+            # (storage.NewTooLargeResourceVersionError); surface it typed
+            # so the engine can retry-with-hint instead of re-listing
+            if e.code == 504:
+                body = e.read() if hasattr(e, "read") else b""
+                try:
+                    doc = json.loads(body or (e.reason or "{}"))
+                except (json.JSONDecodeError, TypeError):
+                    doc = {}
+                details = doc.get("details") or {}
+                causes = details.get("causes") or []
+                if any(
+                    c.get("reason") == "ResourceVersionTooLarge"
+                    for c in causes
+                ):
+                    # the server's current revision rides in the message
+                    # ("Too large resource version: X, current: Y")
+                    m = re.search(
+                        r"current: (\d+)", doc.get("message") or ""
+                    )
+                    raise TooLargeResourceVersion(
+                        int(resource_version or 0),
+                        int(m.group(1)) if m else 0,
+                        float(details.get("retryAfterSeconds") or 1),
+                    ) from e
+                # sniffing consumed the body; re-raise a generic 504 with
+                # the Status JSON re-attached so callers can still read
+                # the API's documented error shape (HTTPError.read binds
+                # the ORIGINAL fp — a fresh error is the only way back)
+                raise urllib.error.HTTPError(
+                    e.url, e.code, e.reason, e.headers, io.BytesIO(body)
+                ) from e
+            raise
 
     def __iter__(self) -> Iterator[WatchEvent]:
         try:

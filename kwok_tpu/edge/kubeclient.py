@@ -36,6 +36,25 @@ class WatchExpired(Exception):
     semantics ride on it)."""
 
 
+class TooLargeResourceVersion(Exception):
+    """The requested resourceVersion is AHEAD of the server's store (e.g.
+    the server restarted and its revision clock reset). The real apiserver
+    answers this with HTTP 504 reason "Timeout", message "Too large
+    resource version: X, current: Y", a ResourceVersionTooLarge cause and
+    retryAfterSeconds — NOT 410 Expired; client-go retries the same
+    revision after the hint instead of re-listing. The engine bounds those
+    retries and falls back to a re-list so a permanently-reset server
+    can't wedge it."""
+
+    def __init__(self, rv: int, current: int, retry_after: float = 1.0):
+        super().__init__(
+            f"Too large resource version: {rv}, current: {current}"
+        )
+        self.rv = int(rv)
+        self.current = int(current)
+        self.retry_after = float(retry_after)
+
+
 class WatchHandle(Protocol):
     def __iter__(self) -> Iterator[WatchEvent]: ...
     def stop(self) -> None: ...

@@ -33,6 +33,7 @@ from kwok_tpu.edge.kubeclient import (
     ADDED,
     DELETED,
     MODIFIED,
+    TooLargeResourceVersion,
     WatchEvent,
     WatchExpired,
     match_field_selector,
@@ -329,7 +330,17 @@ class FakeKube:
         opaque token resuming strictly after the last returned key. The
         token carries the revision of the FIRST page; a compaction while
         paginating expires it (raises WatchExpired -> HTTP 410, the real
-        apiserver's "continue token too old" contract)."""
+        apiserver's "continue token too old" contract).
+
+        KNOWN DIVERGENCE: continuation pages list the LIVE store, not a
+        snapshot at the token's revision (the real apiserver serves a
+        consistent snapshot at the continue revision from etcd). An object
+        created mid-pagination whose key sorts before the cursor is
+        omitted from that list entirely; one sorting after it appears even
+        though it postdates page 1. The rv inside the token is used ONLY
+        for expiry — do not read it as snapshot consistency. The engine is
+        shielded because it registers its watch before listing (the
+        RESYNC marker covers anything a paginated list misses)."""
         sel = parse_selector(label_selector)
         with self._lock:
             keys = sorted(self._store[kind].keys())
@@ -409,10 +420,14 @@ class FakeKube:
     ):
         """resource_version > 0 resumes strictly after that revision: the
         watch cache replays the gap, then the watch goes live. A revision
-        below the compaction floor (or ahead of the store) raises
-        WatchExpired — the client must re-list (410 Gone semantics). A
-        non-numeric revision raises ValueError (the HTTP facade answers
-        400, like the real apiserver)."""
+        below the compaction floor raises WatchExpired — the client must
+        re-list (410 Gone semantics). A revision AHEAD of the store raises
+        TooLargeResourceVersion (HTTP 504 "Too large resource version",
+        retry semantics — the real apiserver's watch cache blocks up to
+        ~3s waiting to catch up first; the mock answers immediately, a
+        documented timing divergence). A non-numeric revision raises
+        ValueError (the HTTP facade answers 400, like the real
+        apiserver)."""
         w = _Watch(self, kind, field_selector, label_selector)
         rv = int(resource_version or 0)
         if rv < 0:
@@ -422,7 +437,9 @@ class FakeKube:
             raise ValueError(f"invalid resourceVersion: {rv}")
         with self._lock:
             if rv:
-                if rv < self._compacted_rv or rv > self._rv or RV_WINDOW <= 0:
+                if rv > self._rv:
+                    raise TooLargeResourceVersion(rv, self._rv)
+                if rv < self._compacted_rv or RV_WINDOW <= 0:
                     raise WatchExpired(f"too old resource version: {rv}")
                 for hrv, hkind, htype, hdata in self._history:
                     if hrv <= rv or hkind != kind:
@@ -815,6 +832,30 @@ def _expired_status(message: str) -> dict:
     }
 
 
+def _too_large_rv_status(e: TooLargeResourceVersion) -> dict:
+    """The kube-apiserver's answer to a watch resume AHEAD of its store:
+    504 reason Timeout with a ResourceVersionTooLarge cause and a
+    retryAfterSeconds hint (storage.NewTooLargeResourceVersionError →
+    apierrors.NewTimeoutError) — retry semantics, not Expired."""
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "message": str(e),
+        "reason": "Timeout",
+        "details": {
+            "causes": [
+                {
+                    "reason": "ResourceVersionTooLarge",
+                    "message": "Too large resource version",
+                }
+            ],
+            "retryAfterSeconds": int(e.retry_after),
+        },
+        "code": 504,
+    }
+
+
 class _HandshakeFailed(Exception):
     """TLS handshake rejected/timed out — normal under mTLS (cert-less
     dials, mis-scheme probes); closed quietly, no traceback."""
@@ -1117,6 +1158,14 @@ class HttpFakeApiserver:
                          "reason": "BadRequest", "code": 400},
                         400,
                     )
+                    return
+                except TooLargeResourceVersion as e:
+                    # a resume AHEAD of the store (server restart reset the
+                    # revision clock): the real apiserver fails the watch
+                    # handshake with a plain 504 Timeout response carrying
+                    # a ResourceVersionTooLarge cause — retry semantics,
+                    # not a stream ERROR event
+                    self._send_json(_too_large_rv_status(e), 504)
                     return
                 except WatchExpired as e:
                     # the real apiserver answers an expired watch resume

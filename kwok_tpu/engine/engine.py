@@ -32,7 +32,13 @@ import numpy as np
 
 from kwok_tpu import cni
 from kwok_tpu.edge.ippool import IPPool
-from kwok_tpu.edge.kubeclient import ADDED, DELETED, KubeClient, WatchExpired
+from kwok_tpu.edge.kubeclient import (
+    ADDED,
+    DELETED,
+    KubeClient,
+    TooLargeResourceVersion,
+    WatchExpired,
+)
 from kwok_tpu.edge.merge import node_status_patch_needed, pod_status_patch_needed
 from kwok_tpu.edge.render import (
     _NODE_CONDITION_META,
@@ -111,24 +117,6 @@ class EngineConfig:
         ):
             # controller.go:98 "no nodes are managed"
             raise ValueError("no nodes are managed")
-
-
-_RV_MARK = b'"resourceVersion":"'
-
-
-def _rv_of_line(line: bytes) -> int:
-    """metadata.resourceVersion from a raw watch line (native-ingest path,
-    which doesn't json-decode). The first occurrence is the object's own
-    metadata — nested structures in core/v1 status never carry the field."""
-    i = line.find(_RV_MARK)
-    if i < 0:
-        return 0
-    i += len(_RV_MARK)
-    j = line.find(b'"', i)
-    try:
-        return int(line[i:j])
-    except ValueError:
-        return 0
 
 
 def _ctr_blob(containers) -> bytes:
@@ -418,6 +406,7 @@ class ClusterEngine:
             # Expired/WatchExpired answer falls back to the full
             # list+RESYNC path, which is gap-free by construction
             resume_rv = 0
+            too_large_tries = 0
             while self._running:
                 try:
                     try:
@@ -437,6 +426,33 @@ class ClusterEngine:
                         )
                         resume_rv = 0
                         continue
+                    except TooLargeResourceVersion as e:
+                        # server's store is BEHIND our resume revision
+                        # (restart reset its clock): client-go retries the
+                        # same revision after the server's hint; we bound
+                        # the retries so a permanently-reset server
+                        # degrades to the gap-free re-list instead of
+                        # wedging the watch loop
+                        too_large_tries += 1
+                        if too_large_tries >= 3:
+                            logger.warning(
+                                "watch %s resume rv=%d still ahead of "
+                                "server (current %d) after %d tries; "
+                                "re-listing",
+                                kind, resume_rv, e.current, too_large_tries,
+                            )
+                            resume_rv = 0
+                            too_large_tries = 0
+                            continue
+                        wait = min(e.retry_after, 5.0)
+                        logger.warning(
+                            "watch %s resume rv=%d ahead of server "
+                            "(current %d); retrying in %.1fs",
+                            kind, resume_rv, e.current, wait,
+                        )
+                        time.sleep(wait)
+                        continue
+                    too_large_tries = 0
                     self._watches[kind] = w  # replaces any dead handle
                     if not resume_rv:
                         # list AFTER the watch registers: the snapshot +
@@ -461,9 +477,13 @@ class ClusterEngine:
                                     "watch error event: %.200r", line
                                 )
                                 break
-                            rv = _rv_of_line(line)
-                            if rv:
-                                resume_rv = rv
+                            # the parser extracts metadata.resourceVersion
+                            # at metadata's own nesting depth — unlike a
+                            # raw substring scan, an annotation literally
+                            # named resourceVersion can't latch a bogus
+                            # resume revision
+                            if rec.rv:
+                                resume_rv = rec.rv
                             self._q.put(
                                 (kind, "REC", rec, time.monotonic())
                             )

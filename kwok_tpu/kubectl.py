@@ -21,6 +21,7 @@ import sys
 import time
 
 from kwok_tpu.edge.httpclient import HttpKubeClient
+from kwok_tpu.edge.merge import strategic_merge
 from kwok_tpu.edge.render import parse_rfc3339
 
 # canonical kind -> (aliases, namespaced)
@@ -297,24 +298,30 @@ def _run(args, client: HttpKubeClient) -> int:
             else:
                 # kubectl apply updates the client-owned sections; the mock
                 # servers' merge-patch on metadata+spec models that (status
-                # stays the kubelet's/engine's). A no-op patch prints
-                # "unchanged", like real kubectl.
-                changed = False
+                # stays the kubelet's/engine's). "unchanged" means the
+                # strategic-merge RESULT equals the live object (real
+                # kubectl's last-applied diff): a doc whose nested maps are
+                # a subset of the live ones is a merge no-op even though
+                # its top-level values differ shallowly.
+                # the patch must APPLY the same merge the detection
+                # predicted: the servers replace top-level section keys
+                # wholesale, so send each doc key's MERGED value (keeps
+                # sibling keys inside nested maps; a nested null deletes
+                # its key instead of storing a literal None)
+                patch: dict = {}
                 for section in ("metadata", "spec"):
                     sec_patch = doc.get(section)
                     if not sec_patch:
                         continue
                     cur = existing.get(section) or {}
-                    for k, v in sec_patch.items():
-                        if (v is None and k in cur) or (
-                            v is not None and cur.get(k) != v
-                        ):
-                            changed = True
-                if changed:
-                    client.patch_meta(
-                        kind, ns, name,
-                        {k: doc[k] for k in ("metadata", "spec") if k in doc},
-                    )
+                    merged = strategic_merge(cur, sec_patch)
+                    if merged != cur:
+                        patch[section] = {
+                            k: (merged[k] if k in merged else None)
+                            for k in sec_patch
+                        }
+                if patch:
+                    client.patch_meta(kind, ns, name, patch)
                     print(f"{_singular(kind)}/{name} configured")
                 else:
                     print(f"{_singular(kind)}/{name} unchanged")

@@ -27,7 +27,7 @@ _INGEST_SRC = os.path.join(_DIR, "ingest.cc")
 _LIB = os.path.join(_DIR, "libkwokcodec.so")
 _APISERVER_SRC = os.path.join(_DIR, "apiserver.cc")
 _APISERVER_BIN = os.path.join(_DIR, "kwok-mock-apiserver")
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -97,7 +97,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.kwok_parse_events.restype = ctypes.c_int64
     lib.kwok_parse_events.argtypes = [
         ctypes.c_char_p, i64p, ctypes.c_int32,
-        u64p, u64p, u64p, u64p, u8p,
+        u64p, u64p, u64p, u64p, u8p, i64p,
         ctypes.c_char_p, ctypes.c_int64, i64p,
     ]
     lib.kwok_fingerprint_statuses.restype = None
@@ -162,12 +162,12 @@ class EventRecord:
         "type", "namespace", "name", "node_name", "phase", "pod_ip",
         "host_ip", "creation", "containers", "init_containers",
         "true_conditions", "flags", "fp_status", "fp_status_nc",
-        "fp_spec", "fp_meta_sel", "raw",
+        "fp_spec", "fp_meta_sel", "rv", "raw",
     )
 
     def __init__(self, type_, ns, name, node, phase, pod_ip, host_ip,
                  creation, ctrs, ictrs, conds, flags, fp_s, fp_nc, fp_spec,
-                 fp_meta, raw):
+                 fp_meta, rv, raw):
         self.type = type_
         self.namespace = ns
         self.name = name
@@ -184,6 +184,10 @@ class EventRecord:
         self.fp_status_nc = fp_nc
         self.fp_spec = fp_spec
         self.fp_meta_sel = fp_meta
+        #: metadata.resourceVersion, parsed at metadata's own nesting depth
+        #: (a raw substring scan can latch an annotation named
+        #: resourceVersion); 0 when absent/non-numeric
+        self.rv = rv
         self.raw = raw
 
     @property
@@ -203,6 +207,7 @@ class EventParser:
         self._lib = lib
         self._fp = np.zeros(4, np.uint64)  # status, status_nc, spec, meta
         self._flags = np.zeros(1, np.uint8)
+        self._rv = np.zeros(1, np.int64)
         self._str_off = np.zeros(_REC_STRINGS + 1, np.int64)
         self._off = np.zeros(2, np.int64)
         self._cap = 4096
@@ -214,6 +219,7 @@ class EventParser:
         self._flags_p = self._flags.ctypes.data_as(
             ctypes.POINTER(ctypes.c_uint8)
         )
+        self._rv_p = _i64p(self._rv)
         self._off_p = _i64p(self._off)
         self._str_off_p = _i64p(self._str_off)
 
@@ -225,7 +231,7 @@ class EventParser:
             need = self._lib.kwok_parse_events(
                 line, self._off_p, 1,
                 p0, p1, p2, p3,
-                self._flags_p,
+                self._flags_p, self._rv_p,
                 (ctypes.c_char * self._cap).from_buffer(self._buf),
                 self._cap, self._str_off_p,
             )
@@ -260,7 +266,8 @@ class EventParser:
         return EventRecord(
             s(0), s(1), s(2), s(3), s(4), s(5), s(6), s(7),
             blob(8), blob(9), blob(10),
-            flags, int(fp[0]), int(fp[1]), int(fp[2]), int(fp[3]), line,
+            flags, int(fp[0]), int(fp[1]), int(fp[2]), int(fp[3]),
+            int(self._rv[0]), line,
         )
 
 

@@ -1530,11 +1530,19 @@ bool App::handle_request(int fd, Request& req) {
         wrv = atoll(rvs.c_str());
       }
       bool expired = false;
+      long long too_large_current = -1;
       {
         std::lock_guard<std::mutex> lk(store.mu);
         if (wrv > 0) {
-          if (wrv < store.compacted_rv || wrv > store.rv ||
-              rv_window() <= 0) {
+          if (wrv > store.rv) {
+            // a resume AHEAD of the store (server restart reset the
+            // revision clock): the real apiserver fails the handshake
+            // with 504 "Too large resource version" + retry hint, NOT
+            // 410 Expired (Python mirror: _too_large_rv_status). The
+            // real watch cache blocks ~3s waiting to catch up first;
+            // the mock answers immediately (documented divergence).
+            too_large_current = store.rv;
+          } else if (wrv < store.compacted_rv || rv_window() <= 0) {
             expired = true;
           } else {
             // replay the gap from the watch cache BEFORE registering:
@@ -1547,7 +1555,19 @@ bool App::handle_request(int fd, Request& req) {
             }
           }
         }
-        if (!expired) store.watches.push_back(w);
+        if (!expired && too_large_current < 0) store.watches.push_back(w);
+      }
+      if (too_large_current >= 0) {
+        return respond(
+            504,
+            "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+            "\"Failure\",\"message\":\"Too large resource version: " +
+                std::to_string(wrv) + ", current: " +
+                std::to_string(too_large_current) +
+                "\",\"reason\":\"Timeout\",\"details\":{\"causes\":[{"
+                "\"reason\":\"ResourceVersionTooLarge\",\"message\":"
+                "\"Too large resource version\"}],\"retryAfterSeconds\":1},"
+                "\"code\":504}");
       }
       if (expired) {
         // the real apiserver answers an expired watch resume with 200 +

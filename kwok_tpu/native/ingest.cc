@@ -27,6 +27,7 @@
 //
 // Build: part of libkwokcodec.so (see native/__init__.py _build).
 
+#include <climits>
 #include <cstdint>
 #include <cstring>
 #include <utility>
@@ -251,6 +252,7 @@ struct Event {
   uint64_t fp_status_nc = 0;  // status minus top-level "conditions"
   uint64_t fp_spec = 0;
   uint64_t fp_meta_sel = 0;   // labels+annotations+deletion+finalizers
+  int64_t rv = 0;             // metadata.resourceVersion (0 if absent)
   std::vector<std::pair<Span, Span>> containers;       // (name, image)
   std::vector<std::pair<Span, Span>> init_containers;  // (name, image)
   std::vector<Span> true_conditions;                   // types with status True
@@ -484,6 +486,29 @@ void walk_metadata(Cursor& c, Event& ev) {
       raw_string(c, &ev.ns.p, &ev.ns.n);
     } else if (span_eq(key, "creationTimestamp") && c.at('"')) {
       raw_string(c, &ev.creation.p, &ev.creation.n);
+    } else if (span_eq(key, "resourceVersion") && c.at('"')) {
+      // parsed HERE, at metadata's own nesting depth: a raw substring
+      // scan can latch an annotation literally named resourceVersion
+      // when annotations serialize before metadata.resourceVersion
+      // (insertion-ordered servers do this). Server-stamped digits;
+      // anything non-numeric stays 0.
+      Span rvs;
+      raw_string(c, &rvs.p, &rvs.n);
+      int64_t v = 0;
+      bool num = rvs.n > 0;
+      for (int64_t j = 0; j < rvs.n && num; j++) {
+        char ch = rvs.p[j];
+        if (ch < '0' || ch > '9' ||
+            v > (INT64_MAX - (ch - '0')) / 10) {
+          // non-digit, or the value would overflow int64 (etcd revisions
+          // are int64; anything wider is garbage): leave rv = 0 rather
+          // than latch a wrapped/negative resume revision
+          num = false;
+        } else {
+          v = v * 10 + (ch - '0');
+        }
+      }
+      if (num) ev.rv = v;
     } else if (span_eq(key, "deletionTimestamp")) {
       ev.has_deletion = !(c.p + 4 <= c.end && memcmp(c.p, "null", 4) == 0);
       skip_value(c);
@@ -661,7 +686,7 @@ extern "C" {
 int64_t kwok_parse_events(
     const char* blob, const int64_t* off, int32_t n,
     uint64_t* fp_status, uint64_t* fp_status_nc, uint64_t* fp_spec,
-    uint64_t* fp_meta_sel, uint8_t* flags,
+    uint64_t* fp_meta_sel, uint8_t* flags, int64_t* rv_out,
     char* str_out, int64_t str_cap, int64_t* str_off) {
   int64_t used = 0;
   auto put_bytes = [&](const char* p, int64_t len) {
@@ -691,6 +716,7 @@ int64_t kwok_parse_events(
     fp_status_nc[i] = ev.fp_status_nc;
     fp_spec[i] = ev.fp_spec;
     fp_meta_sel[i] = ev.fp_meta_sel;
+    rv_out[i] = ev.rv;
     flags[i] = (uint8_t)(ev.ok | (ev.has_deletion << 1) |
                          (ev.has_finalizers << 2) |
                          (ev.has_readiness_gates << 3) |

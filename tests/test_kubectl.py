@@ -317,6 +317,36 @@ def test_golden_errors_and_mutations(srv, kubeconfig, tmp_path, capsys):
     )
     assert kubectl(kubeconfig, "apply", "-f", str(doc)) == 0
     assert _golden(capsys) == ("node/n2 configured", "")
+    # a doc whose nested map is a strict SUBSET of the live object is a
+    # strategic-merge no-op: real kubectl prints "unchanged" (and issues
+    # no patch), even though the top-level labels value differs shallowly
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+
+    c = HttpKubeClient.from_kubeconfig(str(kubeconfig))
+    try:
+        c.patch_meta(
+            "nodes", None, "n2",
+            {"metadata": {"labels": {"tier": "a", "extra": "y"}}},
+        )
+    finally:
+        c.close()
+    assert kubectl(kubeconfig, "apply", "-f", str(doc)) == 0
+    assert _golden(capsys) == ("node/n2 unchanged", "")
+    # and no patch was issued: the superset labels survive
+    assert kubectl(kubeconfig, "get", "node", "n2", "-o", "json") == 0
+    live = json.loads(capsys.readouterr().out)
+    assert live["metadata"]["labels"] == {"tier": "a", "extra": "y"}
+    # a CHANGED doc applies the strategic-merge RESULT, not a wholesale
+    # section replace: sibling keys inside the nested map survive
+    doc.write_text(
+        "apiVersion: v1\nkind: Node\nmetadata:\n  name: n2\n"
+        "  labels: {tier: b}\n"
+    )
+    assert kubectl(kubeconfig, "apply", "-f", str(doc)) == 0
+    assert _golden(capsys) == ("node/n2 configured", "")
+    assert kubectl(kubeconfig, "get", "node", "n2", "-o", "json") == 0
+    live = json.loads(capsys.readouterr().out)
+    assert live["metadata"]["labels"] == {"tier": "b", "extra": "y"}
     assert kubectl(kubeconfig, "create", "-f", str(doc)) == 1
     assert _golden(capsys) == (
         "",

@@ -77,6 +77,53 @@ def test_field_extraction(parser):
     assert r.true_conditions == b"Ready"
 
 
+def test_rv_parsed_at_metadata_depth(parser):
+    """metadata.resourceVersion must win even when an annotation literally
+    named resourceVersion serializes FIRST (insertion-ordered servers emit
+    client-sent annotations before the server-stamped field) — the raw
+    substring scan this replaced latched the annotation."""
+    obj = {
+        "metadata": {
+            "name": "p",
+            "annotations": {"resourceVersion": "999999"},
+            "resourceVersion": "42",
+        },
+        "status": {"phase": "Running"},
+    }
+    r = parser.parse(ev_line("MODIFIED", obj))
+    assert r.ok
+    assert r.rv == 42
+    # absent rv -> 0; non-numeric (never server-stamped) -> 0
+    assert parser.parse(
+        ev_line("ADDED", {"metadata": {"name": "x"}, "status": {}})
+    ).rv == 0
+    assert parser.parse(
+        ev_line(
+            "ADDED",
+            {"metadata": {"name": "x", "resourceVersion": "abc"},
+             "status": {}},
+        )
+    ).rv == 0
+    # int64 bounds: the max etcd revision parses exactly; anything wider
+    # must stay 0 (never a wrapped/negative resume revision)
+    assert parser.parse(
+        ev_line(
+            "ADDED",
+            {"metadata": {"name": "x",
+                          "resourceVersion": "9223372036854775807"},
+             "status": {}},
+        )
+    ).rv == 9223372036854775807
+    for overflow in ("9223372036854775808", "99999999999999999999"):
+        assert parser.parse(
+            ev_line(
+                "ADDED",
+                {"metadata": {"name": "x", "resourceVersion": overflow},
+                 "status": {}},
+            )
+        ).rv == 0
+
+
 def test_scalar_only_flag(parser):
     obj = {"metadata": {"name": "p"}, "status": {"phase": "Pending"}}
     assert parser.parse(ev_line("ADDED", obj)).flags & native.REC_STATUS_SCALAR_ONLY

@@ -19,7 +19,7 @@ import pytest
 
 from kwok_tpu import native
 from kwok_tpu.edge.httpclient import HttpKubeClient
-from kwok_tpu.edge.kubeclient import WatchExpired
+from kwok_tpu.edge.kubeclient import TooLargeResourceVersion, WatchExpired
 from kwok_tpu.edge.mockserver import FakeKube, HttpFakeApiserver
 from kwok_tpu.engine import ClusterEngine, EngineConfig
 from tests.test_engine import make_node, make_pod
@@ -70,9 +70,14 @@ def test_watch_resume_expired_after_compact():
     assert floor == kube._rv
     with pytest.raises(WatchExpired):
         kube.watch("nodes", resource_version=rv)
-    # a revision from the future is expired too (fresh-server restart case)
-    with pytest.raises(WatchExpired):
+    # a revision from the future (fresh-server restart case) is NOT
+    # Expired: the real apiserver answers "Too large resource version"
+    # with retry semantics (504 Timeout + ResourceVersionTooLarge cause)
+    with pytest.raises(TooLargeResourceVersion) as ei:
         kube.watch("nodes", resource_version=kube._rv + 100)
+    assert ei.value.rv == kube._rv + 100
+    assert ei.value.current == kube._rv
+    assert "Too large resource version" in str(ei.value)
     # rv-less watches are untouched by compaction
     kube.watch("nodes").stop()
 
@@ -141,6 +146,90 @@ def test_http_watch_resume_and_expired(http_srv):
         assert w2.expired
     finally:
         c.close()
+
+
+def test_http_too_large_rv_is_504_with_retry_cause(http_srv):
+    """A watch resume AHEAD of the store fails the handshake with the real
+    apiserver's 504 Timeout + ResourceVersionTooLarge cause (retry
+    semantics), not 410 Expired — and the client surfaces it typed."""
+    c = HttpKubeClient(http_srv.url)
+    try:
+        c.create("nodes", make_node("a"))
+        future = http_srv.store._rv + 100
+        # raw wire shape
+        q = urllib.parse.urlencode(
+            {"watch": "true", "resourceVersion": str(future)}
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{http_srv.url}/api/v1/nodes?{q}")
+        assert ei.value.code == 504
+        doc = json.loads(ei.value.read())
+        assert doc["reason"] == "Timeout"
+        assert f"Too large resource version: {future}" in doc["message"]
+        causes = doc["details"]["causes"]
+        assert causes[0]["reason"] == "ResourceVersionTooLarge"
+        assert doc["details"]["retryAfterSeconds"] == 1
+        # typed client surface
+        with pytest.raises(TooLargeResourceVersion) as te:
+            c.watch("nodes", resource_version=future)
+        assert te.value.rv == future
+        assert te.value.retry_after == 1.0
+    finally:
+        c.close()
+
+
+def test_engine_bounded_retry_then_relist_on_too_large_rv():
+    """Engine watch loop vs a server whose revision clock went BACKWARDS
+    (restart): retries the resume with the server's hint, then falls back
+    to the gap-free re-list instead of wedging (client-go retries forever;
+    the engine bounds it — a deliberate, documented divergence)."""
+    kube = FakeKube()
+    kube.create("nodes", make_node("n1"))
+    # raising is restricted to NODES resumes so the pods loop's ordinary
+    # rv=0 re-list can't satisfy the assertions for us; EVERY nodes resume
+    # raises until the engine gives up, so the give-up branch is the only
+    # path to a fresh nodes list
+    calls = {"raises": 0, "nodes_lists": 0}
+    orig_watch, orig_list = kube.watch, kube.list
+
+    def counting_watch(kind, **kw):
+        rv = kw.get("resource_version") or 0
+        if kind == "nodes" and rv:
+            calls["raises"] += 1
+            raise TooLargeResourceVersion(int(rv), 1, retry_after=0.1)
+        return orig_watch(kind, **kw)
+
+    def counting_list(kind, **kw):
+        if kind == "nodes":
+            calls["nodes_lists"] += 1
+        return orig_list(kind, **kw)
+
+    kube.watch, kube.list = counting_watch, counting_list
+
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    eng.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            n = kube.get("nodes", None, "n1")
+            if any(
+                c.get("type") == "Ready" and c.get("status") == "True"
+                for c in (n.get("status") or {}).get("conditions") or []
+            ):
+                break
+            time.sleep(0.05)
+        # force the nodes stream shut so its loop resumes with its rv,
+        # hitting the too-large path on every attempt
+        lists_before = calls["nodes_lists"]
+        eng._watches["nodes"].stop()
+        deadline = time.time() + 15
+        while calls["nodes_lists"] <= lists_before and time.time() < deadline:
+            time.sleep(0.05)
+        # 3 bounded tries (2 sleeps + give-up) then the gap-free re-list
+        assert calls["nodes_lists"] > lists_before
+        assert calls["raises"] == 3
+    finally:
+        eng.stop()
 
 
 def test_http_expired_continue_is_410_and_client_restarts(http_srv, monkeypatch):
@@ -373,6 +462,37 @@ def test_native_watch_resume_replay_and_410():
         w2 = c.watch("nodes", resource_version=rv)
         assert list(w2) == []
         assert w2.expired
+    finally:
+        c.close()
+        srv.stop()
+
+
+@pytest.mark.skipif(native.apiserver_binary() is None, reason="no C++ compiler")
+def test_native_too_large_rv_is_504_with_retry_cause():
+    """C++ server parity for the too-large-rv dialect (see the Python
+    twin test_http_too_large_rv_is_504_with_retry_cause)."""
+    from tests.test_native_apiserver import NativeServer
+
+    srv = NativeServer()
+    c = HttpKubeClient(srv.url)
+    try:
+        a = c.create("nodes", make_node("a"))
+        future = int(a["metadata"]["resourceVersion"]) + 100
+        q = urllib.parse.urlencode(
+            {"watch": "true", "resourceVersion": str(future)}
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{srv.url}/api/v1/nodes?{q}")
+        assert ei.value.code == 504
+        doc = json.loads(ei.value.read())
+        assert doc["reason"] == "Timeout"
+        assert f"Too large resource version: {future}" in doc["message"]
+        assert (
+            doc["details"]["causes"][0]["reason"] == "ResourceVersionTooLarge"
+        )
+        assert doc["details"]["retryAfterSeconds"] == 1
+        with pytest.raises(TooLargeResourceVersion):
+            c.watch("nodes", resource_version=future)
     finally:
         c.close()
         srv.stop()
