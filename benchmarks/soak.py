@@ -223,6 +223,28 @@ def _load_worker_entry() -> None:
     list(pool.map(one, range(lo, hi)))
 
 
+_CLK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
+
+
+def _proc_cpu_s(pid: int) -> float:
+    """utime+stime of one process in seconds (0.0 if gone)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            parts = f.read().rsplit(b") ", 1)[-1].split()
+        return (int(parts[11]) + int(parts[12])) / _CLK
+    except (OSError, IndexError, ValueError):
+        return 0.0
+
+
+def _rig_cpu_s() -> float:
+    """This process + reaped children (loader procs)."""
+    import resource
+
+    a = resource.getrusage(resource.RUSAGE_SELF)
+    b = resource.getrusage(resource.RUSAGE_CHILDREN)
+    return a.ru_utime + a.ru_stime + b.ru_utime + b.ru_stime
+
+
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1].startswith("http"):
         _load_worker_entry()
@@ -394,8 +416,30 @@ def main() -> None:
 
         return sum(pool.map(one, reqs_by_member.items()))
 
+    # per-process CPU attribution (the edge roofline, SURVEY §7 hard part
+    # #1): on a 1-core host wall time ≈ Σ process CPU, so sampling every
+    # process's /proc stat at the phase boundaries attributes the wall.
+    # procs = [member apiservers..., engine]; loaders are rig children.
+    def cpu_snapshot() -> dict:
+        snap = {"rig": _rig_cpu_s()}
+        if procs:
+            snap["engine"] = _proc_cpu_s(procs[-1].pid)
+            snap["apiservers"] = [_proc_cpu_s(p.pid) for p in procs[:-1]]
+        return snap
+
+    def cpu_delta(a: dict, b: dict) -> dict:
+        d = {"rig_cpu_s": round(b["rig"] - a["rig"], 2)}
+        if "engine" in a:
+            d["engine_cpu_s"] = round(b["engine"] - a["engine"], 2)
+            d["apiservers_cpu_s"] = [
+                round(y - x, 2)
+                for x, y in zip(a["apiservers"], b["apiservers"])
+            ]
+        return d
+
     try:
         # --- nodes -> Ready ------------------------------------------------
+        cpu_t0 = cpu_snapshot()
         t_nodes = time.perf_counter()
         if multi:
             by_member: dict = {}
@@ -445,6 +489,7 @@ def main() -> None:
                 raise SystemExit("timeout waiting for nodes Ready")
             time.sleep(poll)
         nodes_s = time.perf_counter() - t_nodes
+        cpu_t1 = cpu_snapshot()
 
         # --- pods: create (Pending, unbound) -> bind -> Running ------------
         t_pods = time.perf_counter()
@@ -550,6 +595,7 @@ def main() -> None:
                 )
             time.sleep(poll)
         pods_s = time.perf_counter() - t_pods
+        cpu_t2 = cpu_snapshot()
 
         # --- steady state: heartbeat flood ---------------------------------
         hold_out = {}
@@ -669,13 +715,40 @@ def main() -> None:
                 ("tick_flush_s", "kwok_tick_flush_seconds_sum"),
                 ("tick_kernel_s", "kwok_tick_kernel_seconds_sum"),
                 ("tick_emit_s", "kwok_tick_emit_seconds_sum"),
+                ("ingest_drain_s", "kwok_ingest_drain_seconds_sum"),
+                ("ingest_parse_s", "kwok_ingest_parse_seconds_sum"),
+                ("pump_send_s", "kwok_pump_send_seconds_sum"),
+                ("pump_requests", "kwok_pump_requests_total"),
                 ("ticks", "kwok_ticks_total"),
                 ("watch_events", "kwok_watch_events_total"),
+                ("bookmarks", "kwok_watch_bookmarks_total"),
+                ("relists", "kwok_watch_relists_total"),
             ):
                 if k_in in m:
                     breakdown[k_out] = m[k_in]
             if breakdown:
                 out["engine"] = breakdown
+            # the edge roofline: per-process CPU per phase; on a 1-core
+            # host Σ CPU ≈ wall, so coverage says how much of the wall is
+            # attributed (VERDICT r3 #1: ≥90% or it's not a roofline)
+            nodes_cpu = cpu_delta(cpu_t0, cpu_t1)
+            pods_cpu = cpu_delta(cpu_t1, cpu_t2)
+            ncpu = os.cpu_count() or 1
+            accounted = (
+                pods_cpu.get("engine_cpu_s", 0.0)
+                + sum(pods_cpu.get("apiservers_cpu_s", []))
+                + pods_cpu["rig_cpu_s"]
+            )
+            out["roofline"] = {
+                "host_cores": ncpu,
+                "nodes_phase_cpu": nodes_cpu,
+                "pods_phase_cpu": pods_cpu,
+                "pods_phase_wall_s": round(pods_s, 2),
+                "pods_phase_cpu_accounted_s": round(accounted, 2),
+                "pods_phase_attribution_pct": round(
+                    100.0 * accounted / max(pods_s * ncpu, 1e-9), 1
+                ),
+            }
             # heterogeneous federation: one kernel-launch counter per
             # rule-set group (VERDICT r3: record per-group dispatches)
             groups = {

@@ -297,9 +297,10 @@ class HttpKubeClient:
                 return items
 
     def watch(self, kind, *, field_selector=None, label_selector=None,
-              resource_version=None):
+              resource_version=None, allow_bookmarks=False):
         return _HttpWatch(
-            self, kind, field_selector, label_selector, resource_version
+            self, kind, field_selector, label_selector, resource_version,
+            allow_bookmarks,
         )
 
     def get(self, kind, namespace, name):
@@ -364,7 +365,8 @@ class _HttpWatch:
     handles reconnect+resync."""
 
     def __init__(self, client: HttpKubeClient, kind: str, field_selector,
-                 label_selector, resource_version=None):
+                 label_selector, resource_version=None,
+                 allow_bookmarks=False):
         self.client = client
         self._stopped = threading.Event()
         #: set when the stream ended with an ERROR event carrying a 410
@@ -377,7 +379,9 @@ class _HttpWatch:
             "resourceVersion": (
                 str(resource_version) if resource_version else None
             ),
-            "allowWatchBookmarks": "false",
+            "allowWatchBookmarks": (
+                "true" if allow_bookmarks else "false"
+            ),
         })
         # no read timeout: watch connections idle legitimately
         try:
@@ -432,7 +436,9 @@ class _HttpWatch:
                     logger.warning("bad watch line: %.120r", line)
                     continue
                 type_ = doc.get("type")
-                if type_ in ("ADDED", "MODIFIED", "DELETED"):
+                if type_ in ("ADDED", "MODIFIED", "DELETED", "BOOKMARK"):
+                    # BOOKMARK objects carry only metadata.resourceVersion;
+                    # callers advance their resume revision and move on
                     yield WatchEvent(type_, doc.get("object") or {})
                 elif type_ == "ERROR":
                     obj = doc.get("object") or {}

@@ -78,11 +78,16 @@ class KubeClient(Protocol):
         field_selector: str | None = None,
         label_selector: str | None = None,
         resource_version: int | str | None = None,
+        allow_bookmarks: bool = False,
     ) -> WatchHandle:
         """resource_version > 0 resumes the stream strictly after that
         revision (the server replays its watch cache); raises WatchExpired
         — or the stream yields an ERROR event with code 410 — when the
-        revision has been compacted away."""
+        revision has been compacted away. allow_bookmarks opts into
+        periodic BOOKMARK events (objects carrying only
+        metadata.resourceVersion) so a quiet stream's resume revision
+        keeps advancing past compactions — client-go's reflector always
+        opts in; so does the engine."""
         ...
 
     def get(self, kind: str, namespace: str | None, name: str) -> dict | None: ...

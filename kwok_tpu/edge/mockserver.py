@@ -31,6 +31,7 @@ from typing import Iterator
 
 from kwok_tpu.edge.kubeclient import (
     ADDED,
+    BOOKMARK,
     DELETED,
     MODIFIED,
     TooLargeResourceVersion,
@@ -67,6 +68,8 @@ class _Watch:
         self.label_selector = parse_selector(label_selector)
         self.q: "queue.Queue[WatchEvent | None]" = queue.Queue()
         self.stopped = False
+        #: opted into periodic BOOKMARK events (allowWatchBookmarks=true)
+        self.bookmarks = False
 
     def _matches(self, obj: dict) -> bool:
         if not match_field_selector(obj, self.field_selector):
@@ -118,6 +121,26 @@ EVENTS_CAP = int(os.environ.get("KWOK_TPU_EVENTS_CAP", "4096"))
 # semantics); <= 0 disables the cache so every resume expires. Mirrored by
 # apiserver.cc; same env override.
 RV_WINDOW = int(os.environ.get("KWOK_TPU_RV_WINDOW", "4096"))
+
+# BOOKMARK cadence for opted-in watches (allowWatchBookmarks=true): a
+# periodic event carrying only metadata.resourceVersion so a QUIET watch's
+# resume revision keeps advancing and compaction can't strand it into a
+# 410 + full re-list (client-go reflector's bookmark purpose; the real
+# apiserver's watch cache sends them roughly every minute). <= 0 disables
+# the timer; tests drive emit_bookmarks() directly. Mirrored by
+# apiserver.cc; same env override.
+BOOKMARK_INTERVAL = float(os.environ.get("KWOK_TPU_BOOKMARK_INTERVAL", "60"))
+
+#: plural resource -> object kind, for bookmark objects and snapshots
+KIND_SINGULAR = {
+    "nodes": "Node",
+    "pods": "Pod",
+    "roles": "Role",
+    "rolebindings": "RoleBinding",
+    "clusterroles": "ClusterRole",
+    "clusterrolebindings": "ClusterRoleBinding",
+    "events": "Event",
+}
 
 
 class FakeKube:
@@ -199,6 +222,35 @@ class FakeKube:
             self._history.clear()
             self._compacted_rv = self._rv
             return self._compacted_rv
+
+    def emit_bookmarks(self) -> int:
+        """Push one BOOKMARK event (current store revision) to every
+        opted-in live watch — the watch cache's periodic rv-advance for
+        quiet watchers. The bookmark object carries ONLY kind/apiVersion/
+        metadata.resourceVersion, like the real apiserver's. Called by the
+        HTTP servers' interval timer (BOOKMARK_INTERVAL) and by tests
+        directly. Returns how many watches were bookmarked."""
+        sent = 0
+        with self._lock:
+            rv = str(self._rv)
+            for w in list(self._watches):
+                if w.stopped or not w.bookmarks:
+                    continue
+                api = (
+                    "rbac.authorization.k8s.io/v1"
+                    if w.kind in (
+                        "roles", "rolebindings",
+                        "clusterroles", "clusterrolebindings",
+                    )
+                    else "v1"
+                )
+                w.q.put(WatchEvent(BOOKMARK, {
+                    "kind": KIND_SINGULAR.get(w.kind, "Object"),
+                    "apiVersion": api,
+                    "metadata": {"resourceVersion": rv},
+                }))
+                sent += 1
+        return sent
 
     # -- test-side API ------------------------------------------------------
 
@@ -417,6 +469,7 @@ class FakeKube:
         field_selector=None,
         label_selector=None,
         resource_version=None,
+        allow_bookmarks=False,
     ):
         """resource_version > 0 resumes strictly after that revision: the
         watch cache replays the gap, then the watch goes live. A revision
@@ -429,6 +482,7 @@ class FakeKube:
         ValueError (the HTTP facade answers 400, like the real
         apiserver)."""
         w = _Watch(self, kind, field_selector, label_selector)
+        w.bookmarks = bool(allow_bookmarks)
         rv = int(resource_version or 0)
         if rv < 0:
             # the real apiserver rejects negative revisions as invalid
@@ -990,9 +1044,25 @@ class HttpFakeApiserver:
             target=self.httpd.serve_forever, daemon=True, name="fake-apiserver"
         )
         self._thread.start()
+        if BOOKMARK_INTERVAL > 0:
+            # periodic rv-advance for quiet opted-in watches (the watch
+            # cache's bookmark timer); Event-based so stop() is prompt
+            self._bookmark_stop = threading.Event()
+
+            def _bookmark_loop():
+                while not self._bookmark_stop.wait(BOOKMARK_INTERVAL):
+                    self.store.emit_bookmarks()
+
+            self._bookmark_thread = threading.Thread(
+                target=_bookmark_loop, daemon=True, name="bookmark-timer"
+            )
+            self._bookmark_thread.start()
         return self
 
     def stop(self):
+        if getattr(self, "_bookmark_stop", None) is not None:
+            self._bookmark_stop.set()
+            self._bookmark_thread.join(timeout=5)
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
@@ -1116,6 +1186,8 @@ class HttpFakeApiserver:
                     self._stream_watch(
                         kind, fs, ls,
                         (q.get("resourceVersion") or [None])[0],
+                        (q.get("allowWatchBookmarks") or ["false"])[0]
+                        in ("true", "1"),
                     )
                     return
                 try:
@@ -1142,11 +1214,11 @@ class HttpFakeApiserver:
                     return
                 self._send_body(body)
 
-            def _stream_watch(self, kind, fs, ls, rv):
+            def _stream_watch(self, kind, fs, ls, rv, bookmarks=False):
                 try:
                     w = store.watch(
                         kind, field_selector=fs, label_selector=ls,
-                        resource_version=rv,
+                        resource_version=rv, allow_bookmarks=bookmarks,
                     )
                 except ValueError:
                     # non-numeric resourceVersion: 400, like the real

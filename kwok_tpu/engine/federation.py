@@ -258,6 +258,8 @@ class FederatedEngine:
         back to one normal interval; consecutive empty polls back off
         exponentially so idling costs ~no wakeups."""
         lag: dict[int, float] = {}
+        drain: dict[int, float] = {}
+        bufs: dict[int, dict] = {}
         interval = self.config.tick_interval
         idle_sleep = 0.002
         got_event = False
@@ -279,7 +281,11 @@ class FederatedEngine:
                         lag[i] = max(
                             lag.get(i, 0.0), time.monotonic() - item[3]
                         )
-                        e._ingest_safe(*item[:3])
+                        _t = time.perf_counter()
+                        e._drain_apply(item, bufs.setdefault(i, {}))
+                        drain[i] = drain.get(i, 0.0) + (
+                            time.perf_counter() - _t
+                        )
                 if drained_any:
                     idle_sleep = 0.002
                     if not got_event:
@@ -291,11 +297,19 @@ class FederatedEngine:
                     time.sleep(min(remaining, idle_sleep))
                     idle_sleep = min(idle_sleep * 2, 0.1)
         finally:
+            for i, e in enumerate(self.engines):
+                if i in bufs and bufs[i]:
+                    _t = time.perf_counter()
+                    e._drain_flush(bufs[i])
+                    drain[i] = drain.get(i, 0.0) + (
+                        time.perf_counter() - _t
+                    )
             # slowest enqueue->processing delay this tick; 0 on a quiet tick
             for i, e in enumerate(self.engines):
                 with e._metrics_lock:
                     e.metrics["watch_lag_seconds"] = lag.get(i, 0.0)
                     e.metrics["ingest_queue_depth"] = e._q.qsize()
+                    e.metrics["ingest_drain_seconds_sum"] += drain.get(i, 0.0)
 
     # ------------------------------------------------------------------ tick
 

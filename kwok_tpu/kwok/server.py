@@ -16,6 +16,12 @@ _METRIC_HELP = {
     "heartbeats_total": "Node heartbeat patches sent",
     "deletes_total": "Pod deletes issued",
     "watch_events_total": "Watch events ingested",
+    "watch_bookmarks_total": "BOOKMARK events consumed (rv advanced, no ingest)",
+    "watch_relists_total": "Full re-lists performed by the watch loops",
+    "ingest_drain_seconds_sum": "Tick-thread seconds applying ingested events",
+    "ingest_parse_seconds_sum": "Seconds in the batched C++ line parser (subset of drain)",
+    "pump_send_seconds_sum": "Executor seconds inside native pump batches",
+    "pump_requests_total": "Requests shipped through the native pump",
     "patch_errors_total": "Patch/delete jobs that raised",
     "ticks_total": "Engine ticks executed",
     "tick_seconds_sum": "Total seconds spent in tick_once",

@@ -195,6 +195,93 @@ class EventRecord:
         return bool(self.flags & REC_OK)
 
 
+class ParsedBatch:
+    """One batched kwok_parse_events result; `record(i)` returns a LAZY
+    view over the arrays (same attribute surface as EventRecord)."""
+
+    __slots__ = ("lines", "buf", "off", "fp", "flags_arr", "rvs", "n")
+
+    def __init__(self, lines, buf, off, fp, flags_arr, rvs):
+        self.lines = lines
+        self.buf = buf
+        self.off = off
+        self.fp = fp
+        self.flags_arr = flags_arr
+        self.rvs = rvs
+        self.n = len(lines)
+
+    def rv(self, i: int) -> int:
+        return self.rvs[i]
+
+    def type_bytes(self, i: int) -> bytes:
+        base = i * _REC_STRINGS
+        return self.buf[self.off[base]: self.off[base + 1]]
+
+    def record(self, i: int) -> "_LazyRecord":
+        return _LazyRecord(self, i)
+
+
+class _LazyRecord:
+    """EventRecord-compatible lazy view into a ParsedBatch. Fields
+    materialize on FIRST attribute access via __getattr__ and are then
+    cached as plain instance attributes (no per-access property dispatch —
+    survivor records touch fields many times; echo-dropped records touch
+    almost none). The string fields decode in one pass on first touch:
+    once a record survives the fingerprint drop it will need most of them,
+    and a single slicing loop beats eleven lazy slices."""
+
+    def __init__(self, batch: ParsedBatch, i: int):
+        self._b = batch
+        self._i = i
+
+    _STR_FIELDS = (
+        "type", "namespace", "name", "node_name", "phase", "pod_ip",
+        "host_ip", "creation",
+    )
+
+    def _materialize(self) -> None:
+        b = self._b
+        i = self._i
+        base = i * _REC_STRINGS
+        off = b.off
+        buf = b.buf
+        flag = b.flags_arr[i]
+        d = self.__dict__
+        for j, fname in enumerate(self._STR_FIELDS):
+            raw = buf[off[base + j]: off[base + j + 1]]
+            if b"\\" in raw:
+                flag &= ~REC_OK
+            d[fname] = raw.decode("utf-8", "surrogateescape")
+        for j, fname in ((8, "containers"), (9, "init_containers"),
+                        (10, "true_conditions")):
+            raw = buf[off[base + j]: off[base + j + 1]]
+            if b"\\" in raw:
+                flag &= ~REC_OK
+                flag &= ~REC_STATUS_SCALAR_ONLY
+            d[fname] = raw
+        d["flags"] = flag
+        d["fp_status"] = b.fp[0][i]
+        d["fp_status_nc"] = b.fp[1][i]
+        d["fp_spec"] = b.fp[2][i]
+        d["fp_meta_sel"] = b.fp[3][i]
+        d["rv"] = b.rvs[i]
+
+    def __getattr__(self, name: str):
+        if name == "raw":
+            v = bytes(self._b.lines[self._i])
+            self.__dict__["raw"] = v
+            return v
+        if name == "ok":
+            return bool(self.flags & REC_OK)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        self._materialize()
+        try:
+            return self.__dict__[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
 class EventParser:
     """Reusable single-line parser: one ctypes call per watch line, with
     preallocated output buffers (the watch threads run this per event, so
@@ -222,6 +309,53 @@ class EventParser:
         self._rv_p = _i64p(self._rv)
         self._off_p = _i64p(self._off)
         self._str_off_p = _i64p(self._str_off)
+
+    def parse_raw_batch(self, lines: list) -> "ParsedBatch | None":
+        """Parse N watch lines in ONE C call. The per-line path pays a
+        ctypes transition + GIL handoff per event; on a busy 1-core host
+        that ping-pong (watch thread vs tick thread) dominated the parse
+        term of the edge roofline. Batching amortizes it to one call per
+        drain — the tick thread parses everything queued since its last
+        tick in a single GIL release. Records come back as LAZY views
+        (ParsedBatch.record): fingerprints/flags/rv are array reads, and
+        string fields decode only on first access — the steady-state echo
+        flood is dropped by fingerprint after touching just ns+name."""
+        n = len(lines)
+        if n == 0:
+            return None
+        blob, off = _blob([bytes(x) for x in lines])
+        fp = np.zeros((4, n), np.uint64)
+        flags = np.zeros(n, np.uint8)
+        rvs = np.zeros(n, np.int64)
+        str_off = np.zeros(_REC_STRINGS * n + 1, np.int64)
+        cap = max(4096, len(blob))
+        buf = bytearray(cap)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        for _ in range(2):
+            need = self._lib.kwok_parse_events(
+                blob, _i64p(off), n,
+                fp[0].ctypes.data_as(u64p), fp[1].ctypes.data_as(u64p),
+                fp[2].ctypes.data_as(u64p), fp[3].ctypes.data_as(u64p),
+                flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                _i64p(rvs),
+                (ctypes.c_char * cap).from_buffer(buf), cap, _i64p(str_off),
+            )
+            if need <= cap:
+                break
+            cap = int(need) + 1024
+            buf = bytearray(cap)
+        # numpy scalar indexing costs ~10x a list index and the lazy
+        # records index per field: one tolist() per batch beats 11 numpy
+        # reads per record (profiled at 18us/event before this)
+        return ParsedBatch(
+            lines, bytes(buf[:min(cap, int(need))]), str_off.tolist(),
+            [row.tolist() for row in fp], flags.tolist(), rvs.tolist(),
+        )
+
+    def parse_batch(self, lines: list) -> "list[EventRecord]":
+        """Eager variant of parse_raw_batch (parity tests; small batches)."""
+        b = self.parse_raw_batch(lines)
+        return [] if b is None else [b.record(i) for i in range(b.n)]
 
     def parse(self, line: bytes) -> EventRecord:
         self._off[1] = len(line)

@@ -45,6 +45,7 @@
 #include <mutex>
 #include <set>
 #include <string>
+#include <chrono>
 #include <thread>
 #include <utility>
 #include <vector>
@@ -793,6 +794,8 @@ struct Watch {
   std::condition_variable cv;
   std::deque<std::shared_ptr<const std::string>> q;
   bool closed = false;
+  // opted into periodic BOOKMARK events (allowWatchBookmarks=true)
+  bool bookmarks = false;
 
   // A consumer that stops reading must not pin unbounded memory: past the
   // cap the watch closes and the client re-lists (410-Gone semantics).
@@ -984,6 +987,37 @@ struct Store {
     ev += e->bytes;
     ev += "}\n";
     return std::make_shared<const std::string>(std::move(ev));
+  }
+
+  // One BOOKMARK event (current store revision) to every opted-in live
+  // watch — the watch cache's periodic rv-advance for quiet watchers.
+  // Object carries ONLY kind/apiVersion/metadata.resourceVersion, like
+  // the real apiserver's (mirrors mockserver.py emit_bookmarks).
+  int emit_bookmarks() {
+    // object kind names + groups by KIND_NAMES index
+    static const char* OBJ_KINDS[NKINDS] = {
+        "Node",        "Pod",         "Role",    "RoleBinding",
+        "ClusterRole", "ClusterRoleBinding",     "Event",
+    };
+    int sent = 0;
+    std::lock_guard<std::mutex> lk(mu);
+    std::string rvs = std::to_string(rv);
+    std::shared_ptr<const std::string> lines[NKINDS];
+    for (const auto& w : watches) {
+      if (!w->bookmarks) continue;
+      if (!lines[w->kind]) {
+        bool rbac = w->kind >= 2 && w->kind <= 5;
+        std::string ev = "{\"type\":\"BOOKMARK\",\"object\":{\"kind\":\"";
+        ev += OBJ_KINDS[w->kind];
+        ev += rbac ? "\",\"apiVersion\":\"rbac.authorization.k8s.io/v1\""
+                   : "\",\"apiVersion\":\"v1\"";
+        ev += ",\"metadata\":{\"resourceVersion\":\"" + rvs + "\"}}}\n";
+        lines[w->kind] = std::make_shared<const std::string>(std::move(ev));
+      }
+      w->push(lines[w->kind]);
+      sent++;
+    }
+    return sent;
   }
 
   static Key obj_key(const JVal& obj) {
@@ -1516,6 +1550,10 @@ bool App::handle_request(int fd, Request& req) {
       w->kind = m.kind;
       w->field_sel = fs;
       w->label_sel = LabelSel::parse(lsq);
+      if (q.count("allowWatchBookmarks")) {
+        const std::string& ab = q["allowWatchBookmarks"];
+        w->bookmarks = (ab == "true" || ab == "1");
+      }
       long long wrv = 0;
       if (q.count("resourceVersion")) {
         const std::string& rvs = q["resourceVersion"];
@@ -2144,6 +2182,29 @@ int main(int argc, char** argv) {
   signal(SIGTERM, on_term);
   signal(SIGINT, on_term);
 
+  // BOOKMARK cadence for opted-in watches (mirrors mockserver.py
+  // BOOKMARK_INTERVAL; same env override; <= 0 disables). Sleeps in
+  // short slices so shutdown stays prompt. Joinable — a detached thread
+  // could dereference `app` (a stack local) after main returns.
+  std::thread bookmark_thread;
+  {
+    const char* v = getenv("KWOK_TPU_BOOKMARK_INTERVAL");
+    double interval = v && *v ? atof(v) : 60.0;
+    if (interval > 0) {
+      bookmark_thread = std::thread([&app, interval] {
+        double slept = 0;
+        while (!app.stopping.load()) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(100));
+          slept += 0.1;
+          if (slept + 1e-9 >= interval) {
+            slept = 0;
+            app.store.emit_bookmarks();
+          }
+        }
+      });
+    }
+  }
+
   while (!app.stopping.load()) {
     int cfd = accept(lfd, nullptr, nullptr);
     if (cfd < 0) {
@@ -2152,6 +2213,7 @@ int main(int argc, char** argv) {
     }
     std::thread(&App::handle_conn, &app, cfd).detach();
   }
+  if (bookmark_thread.joinable()) bookmark_thread.join();
   app.persist();
   return 0;
 }

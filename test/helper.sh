@@ -58,6 +58,16 @@ apiserver_url() { # CLUSTER_NAME -> http://127.0.0.1:PORT
   awk '/server:/ {print $2; exit}' "${kc}"
 }
 
+component_metrics_url() { # CLUSTER_NAME -> engine healthz/metrics base URL
+  pyrun -c "
+import sys
+from kwok_tpu.kwokctl import vars as v
+from kwok_tpu.kwokctl.runtime import load
+rt = load(sys.argv[1], v.cluster_workdir(sys.argv[1]))
+print(f'http://127.0.0.1:{rt.config().options.kwokControllerPort}')
+" "$1"
+}
+
 retry() { # TIMEOUT_SECONDS CMD ARGS... — poll every second
   local timeout="$1"
   shift

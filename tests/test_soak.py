@@ -69,9 +69,32 @@ def test_soak_gate():
     # the engine breakdown must be present so a regression is attributable
     eng = result["engine"]
     for key in ("engine_cpu_s", "tick_s", "tick_kernel_s", "tick_emit_s",
+                "ingest_drain_s", "ingest_parse_s", "pump_send_s",
                 "ticks", "watch_events"):
         assert key in eng, (key, eng)
     assert eng["ticks"] > 0
+    # batched ingest actually ran (drain applies events, parse is its
+    # batched C++ sub-term)
+    assert eng["ingest_drain_s"] > 0.0, eng
+    assert eng["ingest_parse_s"] > 0.0, eng
+    # the per-process CPU roofline (VERDICT r3 #1): wall attribution must
+    # be high enough to act on. At this small scale the pods phase is
+    # ~1.5s and the rig's 0.2s progress-poll quantization alone can idle
+    # >15% of it; the full-scale soak artifact
+    # records 94-97%. An unattributed CPU sink still trips this. The
+    # percentage divides by wall*cores, so the floor only holds where
+    # wall ≈ Σ process CPU — the 1-core CI host; a multi-core dev box
+    # legitimately idles most of its cores during a 3-process soak. The
+    # floor is 60%: broken accounting (zeroed /proc sampling) reads
+    # ~0-20%, while neighbors on a shared core can dent an honest 90%
+    # by tens of points.
+    roof = result["roofline"]
+    if roof["host_cores"] == 1:
+        assert roof["pods_phase_attribution_pct"] >= 60.0, roof
+    else:
+        assert roof["pods_phase_attribution_pct"] > 0.0, roof
+    assert roof["pods_phase_cpu"]["engine_cpu_s"] > 0.0, roof
+    assert len(roof["pods_phase_cpu"]["apiservers_cpu_s"]) == 1, roof
 
 
 def test_soak_federated_breakdown():
