@@ -10,7 +10,10 @@ get --raw) against any apiserver this framework speaks to.
 
 Deliberately NOT a full kubectl: printers are table/json/name only, no
 server-side apply, no openapi validation, no exec/logs (the reference
-snapshot's fake pods have no streaming endpoints either).
+snapshot's fake pods have no streaming endpoints either). `get -w`
+streams row-per-event like real kubectl (bounded by --request-timeout),
+and `wait --for=condition=...|delete` covers the polling loops the
+reference's e2e scripts hand-roll (test/kwok/kwok.test.sh:40-56).
 """
 
 from __future__ import annotations
@@ -184,6 +187,19 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("-o", "--output", default="",
                    choices=["", "json", "name"])
     g.add_argument("--no-headers", action="store_true")
+    g.add_argument("-w", "--watch", action="store_true",
+                   help="after listing, stream a row per watch event")
+    g.add_argument("--watch-only", action="store_true",
+                   help="stream events without the initial list")
+    g.add_argument("--request-timeout", default="0",
+                   help='bound the watch, e.g. "5s" (0 = no bound)')
+
+    w = sub.add_parser("wait")
+    w.add_argument("args", nargs="+", help="KIND/NAME | KIND NAME...")
+    w.add_argument("--for", dest="for_", required=True,
+                   help="condition=NAME[=VALUE] | delete")
+    w.add_argument("-n", "--namespace", default=None)
+    w.add_argument("--timeout", default="30s")
 
     a = sub.add_parser("apply")
     a.add_argument("-f", "--filename", required=True)
@@ -214,7 +230,193 @@ def main(argv: list[str] | None = None) -> int:
         client.close()
 
 
+def _parse_duration(s: str) -> float:
+    """kubectl-style duration: "30s", "2m", "1h", bare seconds; 0 = none."""
+    s = (s or "0").strip()
+    mult = {"s": 1.0, "m": 60.0, "h": 3600.0}.get(s[-1:], None)
+    return float(s[:-1]) * mult if mult else float(s or 0)
+
+
+def _emit_watch_row(kind, obj, args) -> None:
+    if args.output == "json":
+        json.dump(obj, sys.stdout, indent=2)
+        print()
+    elif args.output == "name":
+        print(f"{_singular(kind)}/{obj['metadata']['name']}")
+    else:
+        # real kubectl appends one UNPADDED-consistent row per event; it
+        # prints headers once (unless --no-headers/--watch-only)
+        _print_table(
+            kind, [obj], all_namespaces=args.all_namespaces,
+            no_headers=True,
+        )
+    sys.stdout.flush()
+
+
+def _get_watch(args, client, kind, ns, name, start_rv=None) -> int:
+    """`get -w`: stream a row per ADDED/MODIFIED/DELETED event until
+    interrupted or --request-timeout elapses (real kubectl's bound). A
+    reader thread feeds a queue so the deadline fires even on a QUIET
+    stream (a blocking read would hold the process past the bound).
+    `start_rv` is the initial list's resourceVersion: the watch resumes
+    from it so events landing between list and watch registration are
+    replayed, not dropped (real kubectl threads it the same way);
+    re-watches resume from the last event seen."""
+    import queue as _queue
+    import threading
+
+    bound = _parse_duration(args.request_timeout)
+    deadline = time.monotonic() + bound if bound > 0 else None
+    field_selector = f"metadata.name={name}" if name else None
+    q: "_queue.Queue" = _queue.Queue()
+    stop = threading.Event()
+    rv_box = [start_rv]
+
+    def reader():
+        from kwok_tpu.edge.kubeclient import (
+            TooLargeResourceVersion,
+            WatchExpired,
+        )
+
+        while not stop.is_set():
+            try:
+                w = client.watch(kind, field_selector=field_selector,
+                                 allow_bookmarks=False,
+                                 resource_version=rv_box[0])
+            except (WatchExpired, TooLargeResourceVersion):
+                rv_box[0] = None  # compacted/reset: rejoin live
+                continue
+            handles.append(w)
+            try:
+                for ev in w:
+                    rv = (ev.object.get("metadata") or {}).get(
+                        "resourceVersion"
+                    )
+                    if rv:
+                        rv_box[0] = rv
+                    q.put(ev)
+                    if stop.is_set():
+                        return
+                if getattr(w, "expired", False):
+                    rv_box[0] = None
+            except Exception:
+                if stop.is_set():
+                    return
+            finally:
+                w.stop()
+            if stop.wait(0.2):  # stream ended; re-watch like real kubectl
+                return
+
+    handles: list = []
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        while True:
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                return 0
+            try:
+                ev = q.get(timeout=remaining)
+            except _queue.Empty:
+                return 0
+            obj = ev.object
+            if name and (obj.get("metadata") or {}).get("name") != name:
+                continue
+            if (
+                _is_namespaced(kind)
+                and not args.all_namespaces
+                and ((obj.get("metadata") or {}).get("namespace")
+                     or "default") != ns
+            ):
+                continue
+            _emit_watch_row(kind, obj, args)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        stop.set()
+        for w in handles:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
+def _condition_met(obj: dict, cond: str, want: str) -> bool:
+    for c in (obj.get("status") or {}).get("conditions") or []:
+        if (c.get("type") or "").lower() == cond.lower():
+            return (c.get("status") or "") == want
+    return False
+
+
+def _wait(args, client: HttpKubeClient) -> int:
+    """`kubectl wait --for=condition=NAME[=VALUE] | --for=delete`: the
+    polling loop the reference's e2e scripts hand-roll
+    (test/kwok/kwok.test.sh:40-56 retry-until-Ready)."""
+    spec = args.for_
+    if spec == "delete":
+        mode, cond, want = "delete", "", ""
+    elif spec.startswith("condition="):
+        mode = "condition"
+        rest = spec[len("condition="):]
+        cond, _, want = rest.partition("=")
+        want = want or "True"
+    else:
+        raise SystemExit(
+            f'error: unrecognized condition: "{spec}" (supported: '
+            f"condition=NAME[=VALUE], delete)"
+        )
+    # targets: "kind/name" forms, or "KIND NAME [NAME...]"
+    targets: list[tuple[str, str | None, str]] = []
+    if "/" in args.args[0]:
+        for a in args.args:
+            kindw, _, nm = a.partition("/")
+            kind = _resolve_kind(kindw)
+            ns = args.namespace or ("default" if _is_namespaced(kind) else None)
+            targets.append((kind, ns, nm))
+    else:
+        kind = _resolve_kind(args.args[0])
+        ns = args.namespace or ("default" if _is_namespaced(kind) else None)
+        targets = [(kind, ns, nm) for nm in args.args[1:]]
+    if not targets:
+        raise SystemExit("error: resource name is required")
+    deadline = time.monotonic() + _parse_duration(args.timeout)
+    pending = dict.fromkeys(range(len(targets)))
+    rc = 0
+    while pending:
+        for i in list(pending):
+            kind, ns, nm = targets[i]
+            obj = client.get(kind, ns, nm)
+            ok = (
+                obj is None
+                if mode == "delete"
+                else obj is not None and _condition_met(obj, cond, want)
+            )
+            if ok:
+                print(
+                    f"{_singular(kind)}/{nm} "
+                    + ("deleted" if mode == "delete" else "condition met")
+                )
+                del pending[i]
+        if not pending:
+            return rc
+        if time.monotonic() >= deadline:
+            for i in pending:
+                kind, ns, nm = targets[i]
+                print(
+                    f"error: timed out waiting for the condition on "
+                    f"{_singular(kind)}/{nm}",
+                    file=sys.stderr,
+                )
+            return 1
+        time.sleep(0.2)
+    return rc
+
+
 def _run(args, client: HttpKubeClient) -> int:
+    if args.verb == "wait":
+        return _wait(args, client)
     if args.verb == "get":
         if args.raw:
             # client._request applies the TLS context, CA, client cert and
@@ -230,29 +432,63 @@ def _run(args, client: HttpKubeClient) -> int:
         if name and len(kinds) > 1:
             raise SystemExit("error: a resource name cannot combine with "
                              "multiple resource types")
+        watching = args.watch or args.watch_only
+        if watching and len(kinds) > 1:
+            # real kubectl: watch is only supported on individual
+            # resources and resource collections
+            raise SystemExit("error: you may only specify a single "
+                             "resource type when using --watch")
         per_kind: list[tuple[str, list[dict]]] = []
-        for kind in kinds:
+        start_rv = None
+        if watching:
+            # ONE raw list captures items + the List resourceVersion; the
+            # watch then resumes from that exact revision, so events
+            # landing between list and watch registration replay instead
+            # of dropping (real kubectl threads the rv the same way)
+            kind = kinds[0]
             ns = args.namespace or ("default" if _is_namespaced(kind) else None)
+            doc = client._json("GET", client._url(kind)) or {}
+            start_rv = (doc.get("metadata") or {}).get("resourceVersion")
+            objs = doc.get("items") or []
             if name:
-                obj = client.get(kind, ns, name)
-                if obj is None:
-                    print(
-                        f'Error from server (NotFound): {_singular(kind)} '
-                        f'"{name}" not found',
-                        file=sys.stderr,
-                    )
-                    return 1
-                objs = [obj]
-            else:
-                objs = client.list(kind)
-                if _is_namespaced(kind) and not args.all_namespaces:
-                    objs = [
-                        o for o in objs
-                        if (o["metadata"].get("namespace") or "default") == ns
-                    ]
-            if objs:
-                per_kind.append((kind, objs))
-        if args.output == "json":
+                objs = [
+                    o for o in objs
+                    if (o.get("metadata") or {}).get("name") == name
+                ]
+            if _is_namespaced(kind) and not args.all_namespaces:
+                objs = [
+                    o for o in objs
+                    if (o["metadata"].get("namespace") or "default") == ns
+                ]
+            per_kind = [(kind, objs)] if objs else []
+        else:
+            for kind in kinds:
+                ns = args.namespace or (
+                    "default" if _is_namespaced(kind) else None
+                )
+                if name:
+                    obj = client.get(kind, ns, name)
+                    if obj is None:
+                        print(
+                            f'Error from server (NotFound): '
+                            f'{_singular(kind)} "{name}" not found',
+                            file=sys.stderr,
+                        )
+                        return 1
+                    objs = [obj]
+                else:
+                    objs = client.list(kind)
+                    if _is_namespaced(kind) and not args.all_namespaces:
+                        objs = [
+                            o for o in objs
+                            if (o["metadata"].get("namespace") or "default")
+                            == ns
+                        ]
+                if objs:
+                    per_kind.append((kind, objs))
+        if args.watch_only:
+            pass  # stream only; no initial listing
+        elif args.output == "json" and not watching:
             # one parseable document even across comma-separated kinds
             # (real kubectl merges everything into a single v1 List)
             items = [o for _, objs in per_kind for o in objs]
@@ -261,6 +497,12 @@ def _run(args, client: HttpKubeClient) -> int:
             }
             json.dump(doc, sys.stdout, indent=2)
             print()
+        elif args.output == "json":
+            # -o json -w streams one document per object/event
+            for _, objs in per_kind:
+                for o in objs:
+                    json.dump(o, sys.stdout, indent=2)
+                    print()
         elif args.output == "name":
             for kind, objs in per_kind:
                 for o in objs:
@@ -272,6 +514,11 @@ def _run(args, client: HttpKubeClient) -> int:
                     all_namespaces=args.all_namespaces,
                     no_headers=args.no_headers,
                 )
+        if watching:
+            sys.stdout.flush()
+            kind = kinds[0]
+            ns = args.namespace or ("default" if _is_namespaced(kind) else None)
+            return _get_watch(args, client, kind, ns, name, start_rv)
         if not per_kind and args.output not in ("json", "name"):
             # real kubectl stays silent on empty results under -o json /
             # -o name (scripts capture both streams)
