@@ -10,13 +10,28 @@ set -o errexit -o nounset -o pipefail
 source "$(dirname "${BASH_SOURCE[0]}")/../helper.sh"
 
 CLUSTER="e2e-compaction"
+RUNTIME="${KWOK_TPU_E2E_RUNTIME:-mock}"
 cleanup() {
   kwokctl --name "${CLUSTER}" delete cluster >/dev/null 2>&1 || true
 }
 trap cleanup EXIT
 
-kwokctl --name "${CLUSTER}" create cluster --runtime mock --wait 60s
+kwokctl --name "${CLUSTER}" create cluster --runtime "${RUNTIME}" --wait 60s
 URL="$(apiserver_url "${CLUSTER}")"
+
+# Force a compaction NOW. Mock runtime: the apiserver's POST /compact test
+# hook. Binary runtime (real control plane): etcdctl compact at the
+# current revision — the real apiserver's watch cache then expires stale
+# resumes exactly like the 5-minute production compactor.
+compact_now() {
+  if [ "${RUNTIME}" = "mock" ]; then
+    kcurl -fsS -X POST "${URL}/compact" >/dev/null
+  else
+    local rev
+    rev="$(kcurl -fsS "${URL}/api/v1/nodes" | pyrun -c       'import json,sys; print(json.load(sys.stdin)["metadata"]["resourceVersion"])')"
+    kwokctl --name "${CLUSTER}" etcdctl compact "${rev}" --physical >/dev/null
+  fi
+}
 
 create_node "${URL}" fake-node
 retry 30 ready_nodes_equal "${URL}" 1
@@ -26,23 +41,26 @@ retry 30 ready_nodes_equal "${URL}" 1
 for i in $(seq 0 29); do
   create_pod "${URL}" default "pod-${i}" fake-node
   if [ $((i % 10)) -eq 5 ]; then
-    curl -fsS -X POST "${URL}/compact" >/dev/null
+    compact_now
   fi
 done
 retry 60 running_pods_equal "${URL}" 30
 
 # a compaction with the cluster quiet must not disturb steady state:
 # new work after it still converges
-curl -fsS -X POST "${URL}/compact" | grep -q compactedRevision
+compact_now
+if [ "${RUNTIME}" = "mock" ]; then
+  kcurl -fsS -X POST "${URL}/compact" | grep -q compactedRevision
+fi
 create_pod "${URL}" default post-compact-pod fake-node
 retry 30 running_pods_equal "${URL}" 31
 
 # wire contract: a stale continue token answers 410 Expired
-TOKEN="$(curl -fsS "${URL}/api/v1/pods?limit=2" | pyrun -c \
+TOKEN="$(kcurl -fsS "${URL}/api/v1/pods?limit=2" | pyrun -c \
   'import json,sys; print(json.load(sys.stdin)["metadata"]["continue"])')"
 create_pod "${URL}" default floor-mover fake-node
-curl -fsS -X POST "${URL}/compact" >/dev/null
-CODE="$(curl -s -o /dev/null -w '%{http_code}' \
+compact_now
+CODE="$(kcurl -s -o /dev/null -w '%{http_code}' \
   --data-urlencode "continue=${TOKEN}" --data-urlencode "limit=2" -G \
   "${URL}/api/v1/pods")"
 if [ "${CODE}" != "410" ]; then
