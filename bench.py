@@ -114,9 +114,12 @@ def _best_of_windows(tick, consume, per_window: int, n_windows: int = 3) -> floa
     return max(rates)
 
 
-def _run(kern, pstate, nstate, n_pods, n_nodes, ticks) -> float:
+def _run(kern, pstate, nstate, n_pods, n_nodes, ticks,
+         dt_per_tick: float = DT) -> float:
     """Tick `ticks` times and return transitions/s (counters + masks
-    materialized host-side, exactly what the engine's egress consumes)."""
+    materialized host-side, exactly what the engine's egress consumes).
+    `dt_per_tick` is the simulated-time advance per DISPATCH — DT for
+    single-substep kernels, DT*steps for fused ones."""
     import numpy as np
 
     from kwok_tpu.ops.tick import prefetch, unpack_wire
@@ -125,7 +128,7 @@ def _run(kern, pstate, nstate, n_pods, n_nodes, ticks) -> float:
     for _ in range(WARMUP):
         (pout, nout), wire = kern((pstate, nstate), now)
         pstate, nstate = pout.state, nout.state
-        now += DT
+        now += dt_per_tick
     _ = np.asarray(wire)  # sync
 
     wires = []
@@ -135,13 +138,61 @@ def _run(kern, pstate, nstate, n_pods, n_nodes, ticks) -> float:
         pstate, nstate = pout.state, nout.state
         prefetch(wire)
         wires.append(wire)
-        now += DT
+        now += dt_per_tick
     total = 0
     for wire in wires:
         counters, masks_fn, _ = unpack_wire(np.asarray(wire), [n_pods, n_nodes])
         total += int(counters[0]) + int(counters[1])
         masks_fn()
     return total / (time.perf_counter() - t0)
+
+
+def mesh_device_main(ticks: int) -> None:
+    """1-device-MESH vs plain-jit overhead on the REAL device (VERDICT r3
+    #5): the sharded path (shard_map + packed wire over a Mesh of one TPU
+    chip) against the plain fused tick at identical shapes. The ratio is
+    the per-dispatch cost of the mesh machinery alone — the number that
+    predicts what fraction of an N-chip pod's ideal speedup survives."""
+    import jax
+
+    from kwok_tpu.models import compile_rules, default_rules
+    from kwok_tpu.models.lifecycle import ResourceKind
+    from kwok_tpu.ops.tick import MultiTickKernel, to_device
+    from kwok_tpu.parallel import make_mesh
+    from kwok_tpu.parallel.mesh import pad_to_multiple
+
+    platform = jax.devices()[0].platform
+    ptab = compile_rules(make_cyclic_rules(), ResourceKind.POD)
+    ntab = compile_rules(default_rules(), ResourceKind.NODE)
+    mesh = make_mesh(1)
+    pods = pad_to_multiple(N_PODS, mesh)
+    nodes = pad_to_multiple(N_NODES, mesh)
+
+    results = {}
+    for label, m in (("jit", None), ("mesh1", mesh)):
+        kern = MultiTickKernel(
+            [(ptab, 30.0, (), -1), (ntab, 30.0, (), 1)],
+            mesh=m, pack=True, steps=STEPS, dt=DT,
+        )
+        if m is None:
+            pstate = to_device(_seeded_state(pods))
+            nstate = to_device(_seeded_state(nodes))
+        else:
+            pstate = kern.place(_seeded_state(pods))
+            nstate = kern.place(_seeded_state(nodes))
+        results[label] = round(
+            _run(kern, pstate, nstate, pods, nodes, ticks,
+                 dt_per_tick=DT * STEPS), 1
+        )
+    print(json.dumps({
+        "metric": (
+            f"fused-tick 1-device mesh vs jit at {pods}x{nodes} rows, "
+            f"{STEPS} substeps ({platform}): sharded-path overhead"
+        ),
+        "transitions_per_s": results,
+        "relative": round(results["mesh1"] / max(results["jit"], 1e-9), 3),
+        "unit": "transitions/s",
+    }))
 
 
 def mesh_main(n_devices: int, n_pods: int, ticks: int,
@@ -442,6 +493,9 @@ if __name__ == "__main__":
     _p.add_argument("--weak", action="store_true",
                     help="--mesh weak scaling: hold per-device rows "
                     "constant so the ratio isolates collective+wire cost")
+    _p.add_argument("--mesh-device", action="store_true",
+                    help="1-device mesh vs plain jit on the REAL device: "
+                    "the sharded path's per-dispatch overhead")
     _a = _p.parse_args()
     if os.environ.get("KWOK_BENCH_CPU_FALLBACK"):
         # a single CPU core cannot turn over 1M rows in a sane bench
@@ -462,6 +516,12 @@ if __name__ == "__main__":
             WARMUP = 5
     if _a.mesh:
         mesh_main(_a.mesh, _a.pods, _a.ticks, weak=_a.weak)
+    elif _a.mesh_device:
+        if not _device_reachable():
+            print("accelerator unreachable; --mesh-device needs the real "
+                  "chip — skipping", file=sys.stderr, flush=True)
+            sys.exit(3)
+        mesh_device_main(_a.ticks)
     else:
         if not _device_reachable():
             print(

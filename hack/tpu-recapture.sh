@@ -1,0 +1,95 @@
+#!/usr/bin/env bash
+# One-command on-chip benchmark recapture (VERDICT r3 #5).
+#
+# The TPU tunnel comes and goes; when it returns, a single invocation of
+# this script regenerates every on-chip number the framework claims,
+# with zero human judgment:
+#
+#   1. headline        — fused XLA tick, 1M pods x 10k nodes, 120 substeps
+#   2. steps sweep     — dispatch-amortization curve (STEPS in 10/30/60/120)
+#   3. pallas          — the VMEM-resident kernel vs the XLA path
+#   4. mesh-device     — 1-device Mesh vs plain jit (sharded-path overhead)
+#
+# Output: BENCH_TPU_<stamp>.json at the repo root — one JSON object with a
+# section per probe plus the raw stderr probe logs, so a failed/partial
+# recapture still leaves evidence of WHAT ran and what the tunnel did.
+# Exit 0 if the headline number landed on a real accelerator; exit 3 if
+# the device was unreachable for the whole bounded retry window (the
+# artifact then records the probe log — that IS the round's evidence).
+#
+# Usage: hack/tpu-recapture.sh [label]     (label defaults to r$(date +%m%d))
+# Env:   KWOK_RECAPTURE_BUDGET  per-run timeout seconds   (default 580)
+#        KWOK_RECAPTURE_SWEEP   "10 30 60 120" steps list (default; "" skips)
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+LABEL="${1:-$(date +%Y%m%d)}"
+OUT="BENCH_TPU_${LABEL}.json"
+BUDGET="${KWOK_RECAPTURE_BUDGET:-580}"
+SWEEP="${KWOK_RECAPTURE_SWEEP:-10 30 60 120}"
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+
+run_bench() { # name, timeout, extra env/args...
+  local name="$1" ; shift
+  local out="$TMP/$name.out" err="$TMP/$name.err"
+  echo ">> $name" >&2
+  timeout "$BUDGET" "$@" >"$out" 2>"$err"
+  local rc=$?
+  echo "$rc" > "$TMP/$name.rc"
+  tail -c 2000 "$err" > "$TMP/$name.errtail" || true
+  return $rc
+}
+
+# 1. headline (also the reachability gate: bench.py probes with bounded
+#    retries and falls back to CPU with an honest label + probe log)
+run_bench headline python bench.py || true
+
+# 2. steps sweep (smaller row count keeps the sweep inside the budget
+#    while still device-bound; the curve's SHAPE is the deliverable)
+for s in $SWEEP; do
+  run_bench "steps$s" env KWOK_BENCH_STEPS="$s" python bench.py || true
+done
+
+# 3. pallas vs XLA
+run_bench pallas env KWOK_BENCH_PALLAS=1 python bench.py || true
+
+# 4. 1-device mesh vs jit on the chip
+run_bench meshdev python bench.py --mesh-device || true
+
+python - "$OUT" "$TMP" "$LABEL" <<'EOF'
+import json, os, sys
+
+out, tmp, label = sys.argv[1:4]
+doc = {"label": label,
+       "generated_by": "hack/tpu-recapture.sh",
+       "budget_s_per_run": int(os.environ.get("KWOK_RECAPTURE_BUDGET", "580")),
+       "runs": {}}
+on_chip = False
+for name in sorted(os.listdir(tmp)):
+    if not name.endswith(".rc"):
+        continue
+    base = name[:-3]
+    rec = {"exit": int(open(os.path.join(tmp, name)).read().strip() or -1)}
+    try:
+        line = open(os.path.join(tmp, base + ".out")).read().strip()
+        rec["result"] = json.loads(line) if line else None
+    except (OSError, json.JSONDecodeError) as e:
+        rec["result"] = None
+        rec["result_error"] = str(e)
+    try:
+        rec["stderr_tail"] = open(os.path.join(tmp, base + ".errtail")).read()
+    except OSError:
+        rec["stderr_tail"] = ""
+    doc["runs"][base] = rec
+    metric = (rec.get("result") or {}).get("metric", "")
+    if base == "headline" and rec["exit"] == 0 and ", tpu)" in metric:
+        on_chip = True
+doc["on_chip"] = on_chip
+with open(out, "w") as f:
+    json.dump(doc, f, indent=1, sort_keys=True)
+    f.write("\n")
+print(f"wrote {out} (on_chip={on_chip})")
+sys.exit(0 if on_chip else 3)
+EOF
