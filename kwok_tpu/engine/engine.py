@@ -71,6 +71,12 @@ from kwok_tpu.engine.rowpool import RowPool
 
 logger = logging.getLogger("kwok_tpu.engine")
 
+# URL-escape k8s names/namespaces in patch paths. quote() already
+# short-circuits all-safe strings through a C-level rstrip, so a
+# hand-rolled "fast path" only loses (measured 2.2x slower); the alias
+# just keeps the hot emit loops free of attribute lookups.
+from urllib.parse import quote as _q  # noqa: E402
+
 _NODE_READY_BITS = 1 << NODE_PHASES.condition_bit("Ready")
 # status keys whose strategic merge is plain replacement — when the current
 # status has only these, merge(current, rendered) == rendered exactly
@@ -1325,8 +1331,6 @@ class ClusterEngine:
         """Render node status patches in Python (cold-ish: node transitions
         are rare relative to pods) but ship them in ONE pump batch instead
         of a round-trip per node."""
-        import urllib.parse
-
         now = now_rfc3339()
         reqs, sent = [], []
         for idx in idxs:
@@ -1346,7 +1350,7 @@ class ClusterEngine:
             reqs.append((
                 "PATCH",
                 f"{self._pump_base}/api/v1/nodes/"
-                f"{urllib.parse.quote(name)}/status",
+                f"{_q(name)}/status",
                 body,
                 "application/strategic-merge-patch+json",
             ))
@@ -1409,8 +1413,6 @@ class ClusterEngine:
         (readiness gates, CNI, suppression checks, missing state). Runs on
         the tick thread — the only row mutator — so rows cannot vanish
         mid-batch."""
-        import urllib.parse
-
         slow: list[int] = []
         sent_idx: list[int] = []
         kinds_l: list[int] = []
@@ -1424,7 +1426,6 @@ class ClusterEngine:
         paths: list[str] = []
         phase_names: list[str] = []
         cni_live = self.config.enable_cni and cni.available()
-        quote = urllib.parse.quote
         base = self._pump_base
         node_ip = self.config.node_ip
         pod_kind = self._POD_KIND
@@ -1468,8 +1469,8 @@ class ClusterEngine:
             ctrs.append(m.get("ctrs") or b"")
             ictrs.append(m.get("ictrs") or b"")
             paths.append(
-                f"{base}/api/v1/namespaces/{quote(ns)}/pods/"
-                f"{quote(name)}/status"
+                f"{base}/api/v1/namespaces/{_q(ns)}/pods/"
+                f"{_q(name)}/status"
             )
         if not sent_idx:
             return slow
@@ -1573,13 +1574,12 @@ class ClusterEngine:
                 self._submit(self._heartbeat_node, name, idx, now_str)
             return
         if self._get_pump() is not None:
-            import urllib.parse
 
             reqs = [
                 (
                     "PATCH",
                     f"{self._pump_base}/api/v1/nodes/"
-                    f"{urllib.parse.quote(name)}/status",
+                    f"{_q(name)}/status",
                     body,
                     "application/strategic-merge-patch+json",
                 )
@@ -1694,14 +1694,13 @@ class ClusterEngine:
         """Batch the DeletePod flow: all finalizer strips in one pump call,
         then all grace-0 deletes (global order preserves each pod's
         strip-before-delete)."""
-        import urllib.parse
 
         strips, strip_rows, deletes = [], [], []
         for (ns, name), idx in del_rows:
             m = k.pool.meta[idx]
             path = (
                 f"{self._pump_base}/api/v1/namespaces/"
-                f"{urllib.parse.quote(ns)}/pods/{urllib.parse.quote(name)}"
+                f"{_q(ns)}/pods/{_q(name)}"
             )
             if m and m.get("finalizers"):
                 strips.append((

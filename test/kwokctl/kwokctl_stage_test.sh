@@ -67,7 +67,7 @@ spec:
       ContainersReady: false
 EOF
 
-kwokctl --name "${CLUSTER}" create cluster --runtime mock \
+kwokctl --name "${CLUSTER}" create cluster --runtime "${KWOK_TPU_E2E_RUNTIME:-mock}" \
   --config "${CONF}" --wait 60s
 URL="$(apiserver_url "${CLUSTER}")"
 
