@@ -44,17 +44,26 @@ sys.path.insert(0, REPO)
 # and the spawned engine/apiserver) runs CPU JAX so nothing claims the
 # (single, tunneled) TPU chip. The build environment exports
 # JAX_PLATFORMS=axon (the TPU tunnel), which only works for ONE process at
-# a time, so an inherited value is overridden, not respected; set
-# KWOK_TPU_SOAK_PLATFORM=tpu explicitly to bench the device path end to end.
-os.environ["JAX_PLATFORMS"] = os.environ.get("KWOK_TPU_SOAK_PLATFORM", "cpu")
+# a time, so an inherited value is overridden, not respected.
+# KWOK_TPU_SOAK_PLATFORM=axon puts the ENGINE (and only the engine) on the
+# tunneled TPU chip — the full watch -> device tick -> patch loop against
+# real hardware; every other process stays CPU (the relay grants ONE
+# process). Any other value is passed through as JAX_PLATFORMS verbatim.
+_SOAK_PLATFORM = os.environ.get("KWOK_TPU_SOAK_PLATFORM", "cpu")
+_AXON_POOL = os.environ.get("PALLAS_AXON_POOL_IPS")
+os.environ["JAX_PLATFORMS"] = "cpu" if _SOAK_PLATFORM == "axon" else _SOAK_PLATFORM
 
 
-def _child_env() -> dict:
+def _child_env(engine: bool = False) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = os.environ.get("JAX_PLATFORMS", "cpu")
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     # concurrent processes deadlock waiting for the single-TPU relay grant
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    if engine and _SOAK_PLATFORM == "axon" and _AXON_POOL:
+        # the engine is the single process allowed to claim the chip
+        env["JAX_PLATFORMS"] = "axon"
+        env["PALLAS_AXON_POOL_IPS"] = _AXON_POOL
     return env
 
 
@@ -363,7 +372,7 @@ def main() -> None:
              "--parallelism", str(args.engine_parallelism),
              "--initial-capacity", str(per_member_cap),
              "--server-address", f"127.0.0.1:{srv_port}"],
-            env=_child_env(), stdout=eng_log, stderr=eng_log,
+            env=_child_env(engine=True), stdout=eng_log, stderr=eng_log,
         ))
         _wait_http(metrics_url, "/healthz", timeout=60.0)
 

@@ -37,9 +37,13 @@ pyspawn -m kwok_tpu.kwok \
   >"${WORK}/kwok.log" 2>&1 &
 KWOK_PID="$!"
 
-# 1. fake node Ready within 30s
+# 1. fake node Ready within 30s — through the shim's `kubectl wait`,
+# the verb the reference's script emulates with its polling loop
+# (kwok.test.sh:40-56)
 create_node "${URL}" fake-node
-retry 30 node_is_ready "${URL}" fake-node
+pyrun -m kwok_tpu.kubectl -s "${URL}" wait node/fake-node \
+  --for=condition=Ready --timeout 30s
+retry 5 node_is_ready "${URL}" fake-node
 
 # 2. five pods Running
 for i in 0 1 2 3 4; do
