@@ -51,6 +51,13 @@ sys.path.insert(0, REPO)
 # process). Any other value is passed through as JAX_PLATFORMS verbatim.
 _SOAK_PLATFORM = os.environ.get("KWOK_TPU_SOAK_PLATFORM", "cpu")
 _AXON_POOL = os.environ.get("PALLAS_AXON_POOL_IPS")
+if _SOAK_PLATFORM == "axon" and not _AXON_POOL:
+    # never let an axon request silently degrade to a CPU run that then
+    # gets recorded as a TPU number
+    raise SystemExit(
+        "KWOK_TPU_SOAK_PLATFORM=axon needs PALLAS_AXON_POOL_IPS in the "
+        "launching environment (the TPU relay address)"
+    )
 os.environ["JAX_PLATFORMS"] = "cpu" if _SOAK_PLATFORM == "axon" else _SOAK_PLATFORM
 
 
@@ -295,6 +302,14 @@ def main() -> None:
                    "the i-th file's Stage docs replace the i-th member's "
                    "rules; empty value / missing tail inherit)")
     args = p.parse_args()
+
+    if _SOAK_PLATFORM == "axon" and (args.in_process or args.apiserver):
+        # those modes spawn no engine child, so nothing would claim the
+        # chip — the "TPU" run would silently measure CPU
+        raise SystemExit(
+            "KWOK_TPU_SOAK_PLATFORM=axon requires the spawned-engine "
+            "topology (no --in-process / --apiserver)"
+        )
 
     from kwok_tpu.edge.httpclient import HttpKubeClient
     from kwok_tpu.kwokctl import netutil
