@@ -62,6 +62,7 @@ from kwok_tpu.ops.state import RowState, grow as grow_state, new_row_state
 from kwok_tpu.ops.tick import (
     REBASE_AFTER,
     MultiTickKernel,
+    prefetch,
     rebase_times,
     to_host,
     unpack_wire,
@@ -108,6 +109,13 @@ class EngineConfig:
     heartbeat_interval: float = 30.0
     parallelism: int = 16
     initial_capacity: int = 4096
+    # Max device dispatches in flight before the tick loop blocks on the
+    # oldest. >1 pipelines the loop: tick N+1 (and the ingest drain feeding
+    # it) is dispatched while tick N's wire is still crossing the device
+    # link, so per-tick wall is max(RTT, host work) instead of their sum —
+    # the difference between TPU-helped and TPU-penalized on a remote/
+    # tunneled chip. 1 = the old fully-synchronous loop.
+    pipeline_depth: int = 8
     node_rules: list[LifecycleRule] | None = None
     pod_rules: list[LifecycleRule] | None = None
     use_mesh: bool = False
@@ -147,6 +155,21 @@ def _selector_bits(table, extra: tuple[str, ...]) -> dict[str, int]:
     return {n: i for i, n in enumerate(names)}
 
 
+@dataclasses.dataclass
+class _PendingTick:
+    """A dispatched-but-unconsumed tick in the pipelined loop."""
+
+    wire: object  # device array; self-contained (pack_rows wire)
+    caps: list  # per-kind capacities AT DISPATCH (grow may change them)
+    seq: int  # engine._release_seq at dispatch (stale-mask filtering)
+    now: float  # engine time of the dispatch (idle-wake arithmetic)
+    mono: float  # monotonic clock at dispatch — idle-wake must anchor
+    # here, NOT at consume time, or every timer cycle stretches by the
+    # dispatch->consume pipeline lag (measured: ~one tick_interval of
+    # heartbeat drift per cycle)
+    host_s: float  # host seconds spent in the dispatch half
+
+
 class _Kind:
     """Per-resource-kind engine state (device arrays + host bookkeeping)."""
 
@@ -158,6 +181,10 @@ class _Kind:
         self.buffer = UpdateBuffer()
         self.phase_h = np.zeros(capacity, np.int32)
         self.cond_h = np.zeros(capacity, np.uint32)
+        # row -> release generation (engine._release_seq at release time):
+        # lets a pipelined consume skip mask bits of rows freed (and maybe
+        # re-acquired) after that tick was dispatched
+        self.released_at: dict[int, int] = {}
 
     def grow(self, new_capacity: int) -> None:
         host = to_host(self.state)
@@ -278,6 +305,9 @@ class ClusterEngine:
         # monotonic wake-up for the idle tick loop; 0 = tick immediately,
         # None = nothing scheduled on device (sleep until an event arrives)
         self._idle_wake: float | None = 0.0
+        # bumped on every row release; _PendingTick.seq snapshots it at
+        # dispatch so consume can tell which mask bits went stale
+        self._release_seq = 0
         self._hb_cond_meta = [
             (name, *_NODE_CONDITION_META.get(name, ("KwokRule", name)))
             for name in NODE_PHASES.conditions
@@ -304,6 +334,7 @@ class ClusterEngine:
             "pump_requests_total": 0,
             "watch_lag_seconds": 0.0,
             "ingest_queue_depth": 0,
+            "tick_inflight": 0,
             "nodes_managed": 0,
             "pods_managed": 0,
         }
@@ -377,7 +408,7 @@ class ClusterEngine:
         if self._fused is None:
             steps = max(1, int(self.config.tick_substeps))
             self._fused = MultiTickKernel(
-                self._fused_specs, mesh=self._mesh, pack=True,
+                self._fused_specs, mesh=self._mesh, pack=True, pack_rows=True,
                 steps=steps, dt=self.config.tick_interval / steps,
             )
         return self._fused
@@ -837,6 +868,9 @@ class ClusterEngine:
         k = self.nodes
         idx = k.pool.release(name)
         if idx is not None:
+            if self._owns_tick:  # federation consumes synchronously
+                self._release_seq += 1
+                k.released_at[idx] = self._release_seq
             k.buffer.stage_init(idx, False)
         if name in self.node_has:
             self.node_has.discard(name)
@@ -1055,6 +1089,9 @@ class ClusterEngine:
             # either lands before (we see m["cni"] and remove) or its
             # liveness check sees the released row and undoes itself
             k.pool.release(key)
+            if self._owns_tick:  # federation consumes synchronously
+                self._release_seq += 1
+                k.released_at[idx] = self._release_seq
             cni_owned = bool(m.get("cni"))
             ip = m.get("podIP") or (pod.get("status") or {}).get("podIP")
         if cni_owned:
@@ -1109,75 +1146,125 @@ class ClusterEngine:
     _IDLE_MAX = 60.0
 
     def _tick_loop(self) -> None:
+        """Pipelined tick loop (pipeline_depth > 1, the default).
+
+        Each iteration drains ingest, consumes any in-flight ticks whose
+        wire has already landed on host, then dispatches the next tick. The
+        device round-trip of tick N therefore overlaps the drain/dispatch/
+        emit work of ticks N+1..N+depth-1 instead of serializing in front
+        of it — on a remote/tunneled TPU this is the difference between the
+        engine being RTT-bound and host-bound. Consume order is FIFO, so
+        per-object patch order is exactly the synchronous loop's."""
         interval = self.config.tick_interval
-        while self._running:
-            deadline = time.monotonic() + interval
-            # Nothing staged and no timer due before the next tick? Sleep
-            # until the device-reported deadline (ops/tick.next_due): an
-            # idle engine — even at 1M rows — dispatches nothing. Incoming
-            # watch events wake the queue and pull the deadline back in.
-            if (
-                self._q.empty()
-                and not self.nodes.buffer.pending
-                and not self.pods.buffer.pending
-            ):
-                wake = self._idle_wake
-                if wake is None:
-                    deadline = time.monotonic() + self._IDLE_MAX
-                elif wake > deadline:
-                    deadline = min(wake, time.monotonic() + self._IDLE_MAX)
-            lag_max = 0.0
-            drain_s = 0.0
-            got_event = False
-            raw_buf: dict = {}
-            # drain ingest until the next tick is due
-            while True:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
-                    break
-                try:
-                    item = self._q.get(timeout=timeout)
-                except queue.Empty:
-                    break
-                if item is None:
-                    if not self._running:
-                        return
-                    continue
-                if not got_event:
-                    got_event = True
-                    # an event arriving during an idle sleep must be ticked
-                    # within one normal interval
-                    deadline = min(deadline, time.monotonic() + interval)
-                lag_max = max(lag_max, time.monotonic() - item[3])
-                _t = time.perf_counter()
-                self._drain_apply(item, raw_buf)
-                drain_s += time.perf_counter() - _t
-                # keep draining whatever is immediately available
+        depth = max(1, int(self.config.pipeline_depth))
+        from collections import deque
+
+        pending: "deque" = deque()
+        try:
+            while self._running:
+                deadline = time.monotonic() + interval
+                # Nothing staged, nothing in flight, and no timer due before
+                # the next tick? Sleep until the device-reported deadline
+                # (ops/tick.next_due): an idle engine — even at 1M rows —
+                # dispatches nothing. Incoming watch events wake the queue
+                # and pull the deadline back in.
+                if (
+                    not pending
+                    and self._q.empty()
+                    and not self.nodes.buffer.pending
+                    and not self.pods.buffer.pending
+                ):
+                    wake = self._idle_wake
+                    if wake is None:
+                        deadline = time.monotonic() + self._IDLE_MAX
+                    elif wake > deadline:
+                        deadline = min(wake, time.monotonic() + self._IDLE_MAX)
+                lag_max = 0.0
+                drain_s = 0.0
+                got_event = False
+                raw_buf: dict = {}
+                # drain ingest until the next tick is due
                 while True:
+                    timeout = deadline - time.monotonic()
+                    if timeout <= 0:
+                        break
                     try:
-                        item = self._q.get_nowait()
+                        item = self._q.get(timeout=timeout)
                     except queue.Empty:
                         break
                     if item is None:
                         if not self._running:
                             return
                         continue
+                    if not got_event:
+                        got_event = True
+                        # an event arriving during an idle sleep must be
+                        # ticked within one normal interval
+                        deadline = min(deadline, time.monotonic() + interval)
                     lag_max = max(lag_max, time.monotonic() - item[3])
                     _t = time.perf_counter()
                     self._drain_apply(item, raw_buf)
                     drain_s += time.perf_counter() - _t
-            _t = time.perf_counter()
-            self._drain_flush(raw_buf)
-            drain_s += time.perf_counter() - _t
-            with self._metrics_lock:
-                # enqueue -> processing delay of the slowest event this tick
-                self.metrics["watch_lag_seconds"] = lag_max
-                self.metrics["ingest_queue_depth"] = self._q.qsize()
-                self.metrics["ingest_drain_seconds_sum"] += drain_s
-            try:
-                self.tick_once()
-            except Exception:
-                logger.exception("tick failed")
+                    # keep draining whatever is immediately available
+                    while True:
+                        try:
+                            item = self._q.get_nowait()
+                        except queue.Empty:
+                            break
+                        if item is None:
+                            if not self._running:
+                                return
+                            continue
+                        lag_max = max(lag_max, time.monotonic() - item[3])
+                        _t = time.perf_counter()
+                        self._drain_apply(item, raw_buf)
+                        drain_s += time.perf_counter() - _t
+                _t = time.perf_counter()
+                self._drain_flush(raw_buf)
+                drain_s += time.perf_counter() - _t
+                with self._metrics_lock:
+                    # enqueue -> processing delay of the slowest event
+                    self.metrics["watch_lag_seconds"] = lag_max
+                    self.metrics["ingest_queue_depth"] = self._q.qsize()
+                    self.metrics["ingest_drain_seconds_sum"] += drain_s
+                    self.metrics["tick_inflight"] = len(pending)
+                try:
+                    # consume every tick whose wire has landed (free);
+                    # a full pipeline blocks on the oldest, so `depth`
+                    # bounds in-flight memory and mirror staleness
+                    while pending and (
+                        len(pending) >= depth or self._wire_ready(pending[0])
+                    ):
+                        self._tick_consume(pending.popleft())
+                        self._prune_released(
+                            pending[0].seq if pending else self._release_seq
+                        )
+                    # dispatch only when something calls for a tick: an
+                    # event drained, writes staged, or a device timer due.
+                    # Without this gate the pipeline keeps itself awake
+                    # (one tick always in flight -> the idle sleep never
+                    # engages) and an idle engine would tick forever.
+                    wake = self._idle_wake
+                    if (
+                        got_event
+                        or self.nodes.buffer.pending
+                        or self.pods.buffer.pending
+                        or (wake is not None and time.monotonic() >= wake)
+                    ):
+                        p = self._tick_dispatch()
+                        if p is not None:
+                            pending.append(p)
+                except Exception:
+                    logger.exception("tick failed")
+        finally:
+            # stopping: flush in-flight ticks so patches already computed
+            # on device are not dropped (stop() joins us, then shuts the
+            # executor down with wait=True)
+            while pending:
+                try:
+                    self._tick_consume(pending.popleft())
+                except Exception:
+                    logger.exception("final tick consume failed")
 
     def _ingest_safe(self, kind, type_, obj) -> None:
         """One malformed event must not kill the tick thread."""
@@ -1202,9 +1289,33 @@ class ClusterEngine:
             logger.info("profiler trace written to %s", self.config.profile_dir)
 
     def tick_once(self) -> None:
-        """One engine step: flush staged writes, run ONE fused kernel over
-        both kinds, emit. Host fetches are started async right after the
-        dispatch so the D2H copies overlap the counter sync."""
+        """One synchronous engine step: dispatch the fused kernel and
+        consume its wire immediately. The pipelined loop (_tick_loop) calls
+        the two halves separately with up to pipeline_depth ticks in
+        flight; semantics per tick are identical."""
+        p = self._tick_dispatch()
+        if p is not None:
+            self._tick_consume(p)
+        self._prune_released(self._release_seq)
+
+    @staticmethod
+    def _wire_ready(p) -> bool:
+        ready = getattr(p.wire, "is_ready", None)
+        return ready() if callable(ready) else True
+
+    def _prune_released(self, min_seq: int) -> None:
+        """Drop release-log entries no in-flight tick can still consult
+        (everything at or before the oldest pending dispatch's seq)."""
+        for k in (self.nodes, self.pods):
+            if k.released_at:
+                k.released_at = {
+                    idx: s for idx, s in k.released_at.items() if s > min_seq
+                }
+
+    def _tick_dispatch(self) -> "_PendingTick | None":
+        """First half of a tick: flush staged ingest writes and dispatch the
+        fused kernel. Returns a _PendingTick whose wire materializes on host
+        asynchronously (prefetch), or None when nothing is on device."""
         if self.config.profile_dir:
             self._maybe_profile()
         t0 = time.perf_counter()
@@ -1218,7 +1329,6 @@ class ClusterEngine:
             self._inc("epoch_rebases_total")
             logger.info("epoch rebase at engine time %.1fs", now)
             now = 0.0
-        now_str = now_rfc3339()
         work = False
         for k in (self.nodes, self.pods):
             if k.buffer.pending:
@@ -1227,58 +1337,102 @@ class ClusterEngine:
             elif len(k.pool):
                 work = True
         t_flush = time.perf_counter()
-        t_kernel = t_flush
+        with self._metrics_lock:
+            self.metrics["nodes_managed"] = len(self.nodes.pool)
+            self.metrics["pods_managed"] = len(self.pods.pool)
+            self.metrics["ticks_total"] += 1
+            self.metrics["tick_flush_seconds_sum"] += t_flush - t0
+        if not work:
+            self._idle_wake = None  # empty engine: sleep until events
+            return None
+        fused = self._get_fused()
+        # with substeps, the scan runs at now_base + i*dt; anchor the
+        # LAST substep at wall-now so firing never runs ahead of time
+        now_base = now - (fused.steps - 1) * fused.dt
+        (nout, pout), wire = fused(
+            (self.nodes.state, self.pods.state), now_base
+        )
+        self.nodes.state = nout.state
+        self.pods.state = pout.state
+        # the whole tick summary — counters, bit-packed masks, AND the
+        # post-tick phase/cond rows (pack_rows) — in ONE self-contained D2H
+        # transfer whose copy starts now and overlaps everything until
+        # consume. Output states are never read on host, so the next
+        # dispatch is free to donate them.
+        prefetch(wire)
+        return _PendingTick(
+            wire=wire,
+            caps=[self.nodes.capacity, self.pods.capacity],
+            seq=self._release_seq,
+            now=now,
+            mono=time.monotonic(),
+            host_s=time.perf_counter() - t0,
+        )
+
+    def _tick_consume(self, p: "_PendingTick") -> None:
+        """Second half of a tick: block until p's wire is on host (free when
+        it landed during the pipeline window), refresh the fired rows'
+        phase/cond mirrors, and emit patches."""
+        t0 = time.perf_counter()
+        counters, masks_fn, dues, rows_fn = unpack_wire(
+            np.asarray(p.wire), p.caps, rows=True
+        )
+        t_wire = time.perf_counter()
+        nd = float(dues.min())
+        self._idle_wake = (
+            None if nd == float("inf")
+            else p.mono + max(0.0, nd - p.now)
+        )
         emit_s = 0.0
-        if work:
-            fused = self._get_fused()
-            # with substeps, the scan runs at now_base + i*dt; anchor the
-            # LAST substep at wall-now so firing never runs ahead of time
-            now_base = now - (fused.steps - 1) * fused.dt
-            (nout, pout), wire = fused(
-                (self.nodes.state, self.pods.state), now_base
-            )
-            self.nodes.state = nout.state
-            self.pods.state = pout.state
-            # the whole tick summary (counters + bit-packed masks) in ONE
-            # D2H transfer (latency is per-array on remote devices; bytes
-            # are 1/8 of bool masks)
-            counters, masks_fn, dues = unpack_wire(
-                np.asarray(wire), [self.nodes.capacity, self.pods.capacity]
-            )
-            nd = float(dues.min())
-            self._idle_wake = (
-                None if nd == float("inf")
-                else time.monotonic() + max(0.0, nd - now)
-            )
-            masks = masks_fn() if counters.any() else None
-            t_kernel = time.perf_counter()
-            for i, (k, kind, out) in enumerate(
-                ((self.nodes, "nodes", nout), (self.pods, "pods", pout))
+        if counters.any():
+            now_str = now_rfc3339()
+            masks = masks_fn()
+            rows = None
+            for i, (k, kind) in enumerate(
+                ((self.nodes, "nodes"), (self.pods, "pods"))
             ):
                 n_trans = int(counters[i])
                 n_hb = int(counters[2 + i])
                 if n_trans:
                     self._inc("transitions_total", n_trans)
-                if n_trans or n_hb:
-                    # full phase/cond mirrors refresh only when something
-                    # actually fired: phase/cond change exclusively via
-                    # transitions, so the mirrors stay valid on quiet ticks
-                    dirty, deleted, hb = masks[i]
-                    k.phase_h = np.array(out.state.phase)
-                    k.cond_h = np.array(out.state.cond_bits)
-                    self._emit(kind, k, dirty, deleted, hb, now_str)
-            emit_s = time.perf_counter() - t_kernel
-        else:
-            self._idle_wake = None  # empty engine: sleep until events
-        elapsed = time.perf_counter() - t0
+                if not (n_trans or n_hb):
+                    continue
+                dirty, deleted, hb = masks[i]
+                # mask bits of rows released since this tick's dispatch
+                # describe the OLD occupant (the row may already belong to
+                # a new object): the release path did their teardown.
+                # Rows at indices beyond this dispatch's capacity (pool
+                # grew mid-window, then the new occupant was released)
+                # have no mask bits to clear.
+                cap = dirty.shape[0]
+                stale = [
+                    idx for idx, s in k.released_at.items()
+                    if s > p.seq and idx < cap
+                ]
+                if stale:
+                    dirty[stale] = False
+                    deleted[stale] = False
+                    hb[stale] = False
+                if n_trans:
+                    idxs = np.nonzero(dirty | deleted)[0]
+                    if idxs.size:
+                        if rows is None:
+                            rows = rows_fn()
+                        ph, cb = rows[i]
+                        # refresh ONLY the fired rows: rows acquired after
+                        # this dispatch already hold their ingest-time
+                        # mirror values and are absent from this tick's
+                        # state; quiet rows cannot have changed
+                        k.phase_h[idxs] = ph[idxs]
+                        k.cond_h[idxs] = cb[idxs]
+                _t = time.perf_counter()
+                self._emit(kind, k, dirty, deleted, hb, now_str)
+                emit_s += time.perf_counter() - _t
+        elapsed = time.perf_counter() - t0 + p.host_s
         with self._metrics_lock:
-            self.metrics["nodes_managed"] = len(self.nodes.pool)
-            self.metrics["pods_managed"] = len(self.pods.pool)
-            self.metrics["ticks_total"] += 1
             self.metrics["tick_seconds_sum"] += elapsed
             self.metrics["tick_seconds_last"] = elapsed
-            self.metrics["tick_flush_seconds_sum"] += t_flush - t0
-            self.metrics["tick_kernel_seconds_sum"] += t_kernel - t_flush
+            self.metrics["tick_kernel_seconds_sum"] += t_wire - t0
             self.metrics["tick_emit_seconds_sum"] += emit_s
 
     # ------------------------------------------------------------------ emit

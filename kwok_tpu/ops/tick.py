@@ -314,6 +314,16 @@ class MultiTickKernel:
     transfer instead of 2+3K — D2H latency is per-array on remote devices).
     Split with `unpack_wire`.
 
+    With pack_rows=True (implies pack), the wire additionally carries every
+    kind's post-tick phase (uint8) and cond_bits (uint32) arrays. That makes
+    the wire SELF-CONTAINED: the host can update its phase/cond mirrors and
+    emit patches for tick N without ever touching N's output state — which
+    the donate_argnums dispatch of tick N+1 has already invalidated. This is
+    what lets the engine keep several ticks in flight (pipelined tick loop)
+    instead of blocking a full device round-trip per tick. Cost: 5 bytes/row
+    /kind/tick of extra D2H — negligible at engine populations; benches that
+    only need counters+masks keep pack_rows=False.
+
     With steps>1, ONE dispatch advances `steps` inner ticks via lax.scan
     (simulated time advancing `dt` per step): counters sum over the steps
     and masks OR together, so a row that transitioned twice within one
@@ -325,7 +335,7 @@ class MultiTickKernel:
 
     def __init__(
         self, specs, mesh=None, pack: bool = False,
-        steps: int = 1, dt: float = 0.0,
+        steps: int = 1, dt: float = 0.0, pack_rows: bool = False,
     ) -> None:
         self._metas = []
         for table, hb_interval, hb_phases, hb_sel_bit in specs:
@@ -421,9 +431,11 @@ class MultiTickKernel:
                     for s, a in zip(sts, acc)
                 )
 
-        self.pack = bool(pack)
+        self.pack_rows = bool(pack_rows)
+        self.pack = bool(pack) or self.pack_rows
         if self.pack:
             inner = _step
+            with_rows = self.pack_rows
 
             def _step(states, now, keys):  # noqa: F811
                 outs = inner(states, now, keys)
@@ -443,8 +455,17 @@ class MultiTickKernel:
                     )
                     for o in outs
                 ]
+                rows = []
+                if with_rows:
+                    for o in outs:
+                        rows.append(o.state.phase.astype(jnp.uint8))
+                        rows.append(
+                            jax.lax.bitcast_convert_type(
+                                o.state.cond_bits, jnp.uint8
+                            ).reshape(-1)
+                        )
                 return outs, jnp.concatenate(
-                    [counter_bytes, due_bytes] + bits
+                    [counter_bytes, due_bytes] + bits + rows
                 )
 
         self._tick = jax.jit(_step, donate_argnums=(0,))
@@ -467,7 +488,10 @@ class MultiTickKernel:
         return self._tick(tuple(states), jnp.float32(now), keys)
 
 
-def unpack_wire(blob: np.ndarray, capacities: list[int], lazy: bool = True):
+def unpack_wire(
+    blob: np.ndarray, capacities: list[int], lazy: bool = True,
+    rows: bool = False,
+):
     """Invert the pack=True wire blob.
 
     Returns (counters, masks_fn, next_dues): counters is int32[2K]
@@ -475,10 +499,16 @@ def unpack_wire(blob: np.ndarray, capacities: list[int], lazy: bool = True):
     (earliest pending timer per kind, +inf = nothing scheduled — the tick
     loop sleeps until then); masks_fn() materializes, per kind, (dirty,
     deleted, hb_fired) boolean arrays — deferred so quiet ticks never pay
-    the unpack."""
+    the unpack.
+
+    With rows=True (a pack_rows=True blob), returns a 4th element rows_fn:
+    rows_fn() materializes, per kind, (phase uint8[cap], cond uint32[cap])
+    — the post-tick mirror values, so the caller never needs the (already
+    donated) output state."""
     n = len(capacities)
     counters = blob[: 8 * n].view(np.int32)
     next_dues = blob[8 * n : 12 * n].view(np.float32)
+    mask_end = 12 * n + sum((3 * cap + 7) // 8 for cap in capacities)
 
     def masks_fn():
         out = []
@@ -491,7 +521,23 @@ def unpack_wire(blob: np.ndarray, capacities: list[int], lazy: bool = True):
             off += seg_bytes
         return out
 
-    return counters, (masks_fn if lazy else masks_fn()), next_dues
+    if not rows:
+        return counters, (masks_fn if lazy else masks_fn()), next_dues
+
+    def rows_fn():
+        out = []
+        off = mask_end
+        for cap in capacities:
+            phase = blob[off : off + cap]
+            off += cap
+            # copy before the u32 view: the slice's byte offset is not
+            # 4-aligned in general and numpy rejects misaligned views
+            cond = blob[off : off + 4 * cap].copy().view(np.uint32)
+            off += 4 * cap
+            out.append((phase, cond))
+        return out
+
+    return counters, (masks_fn if lazy else masks_fn()), next_dues, rows_fn
 
 
 def prefetch(tree) -> None:

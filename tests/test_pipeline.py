@@ -1,0 +1,253 @@
+"""Pipelined tick loop regressions (the round-4 verdict's #1 ask).
+
+The dispatch/consume split (`ClusterEngine._tick_dispatch` /
+`_tick_consume`) lets ingest run between a tick's device dispatch and the
+consumption of its wire. These tests pin the semantics that window must
+preserve:
+
+- a row released mid-window must not be patched from the stale mask
+  (the release path already did its teardown),
+- a row released AND re-acquired by a new object mid-window must keep the
+  new object's mirrors and converge normally,
+- consume order is FIFO, so per-object patch order matches the
+  synchronous loop,
+- the pack_rows wire carries exactly the post-tick phase/cond values
+  (what makes the wire self-contained under buffer donation).
+"""
+
+import numpy as np
+
+from kwok_tpu.engine import EngineConfig
+from kwok_tpu.models.defaults import (
+    SEL_MANAGED,
+    default_pod_rules,
+)
+from kwok_tpu.models.lifecycle import (
+    Delay,
+    LifecycleRule,
+    ResourceKind,
+    StatusEffect,
+)
+from tests.fake_apiserver import FakeKube
+from tests.test_engine import SyncEngine, make_node, make_pod
+
+
+def _drain(eng):
+    while not eng._q.empty():
+        item = eng._q.get_nowait()
+        if item:
+            eng._ingest(*item)
+
+
+def _rig(**cfg):
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True, **cfg))
+    server.create("nodes", make_node("n0"))
+    eng.feed_all(server)
+    eng.pump(2)  # node managed + Ready
+    return server, eng
+
+
+def test_release_between_dispatch_and_consume_skips_emit():
+    server, eng = _rig()
+    server.create("pods", make_pod("p0", node="n0"))
+    eng._ingest("pods", "ADDED", server.get("pods", "default", "p0"))
+    # this dispatch arms AND fires the 0-delay Pending->Running rule
+    p = eng._tick_dispatch()
+    assert p is not None
+    # watch DELETED lands before the wire is consumed: the row is freed
+    eng._ingest(
+        "pods", "DELETED",
+        {"metadata": {"namespace": "default", "name": "p0"}},
+    )
+    before = eng.metrics["status_patches_total"]
+    eng._tick_consume(p)
+    assert eng.metrics["status_patches_total"] == before
+    # the server copy was never patched with the dead row's transition
+    assert server.get("pods", "default", "p0")["status"]["phase"] == "Pending"
+
+
+def test_reacquired_row_is_not_patched_with_stale_mask():
+    server, eng = _rig()
+    server.create("pods", make_pod("p0", node="n0"))
+    eng._ingest("pods", "ADDED", server.get("pods", "default", "p0"))
+    idx_old = eng.pods.pool.lookup(("default", "p0"))
+    p = eng._tick_dispatch()  # fires p0's transition on device
+    # mid-window: p0 deleted, a NEW pod recycles the same row index
+    eng._ingest(
+        "pods", "DELETED",
+        {"metadata": {"namespace": "default", "name": "p0"}},
+    )
+    server.create("pods", make_pod("pnew", node="n0"))
+    eng._ingest("pods", "ADDED", server.get("pods", "default", "pnew"))
+    idx_new = eng.pods.pool.lookup(("default", "pnew"))
+    assert idx_new == idx_old  # LIFO free list recycles the slot
+    eng._tick_consume(p)
+    # the stale mask bit must not have patched pnew with p0's transition…
+    assert server.get("pods", "default", "pnew")["status"]["phase"] == "Pending"
+    # …nor clobbered pnew's ingest-time mirror
+    assert int(eng.pods.phase_h[idx_new]) == eng._pod_phase_ids["Pending"]
+    # and pnew still converges normally on the next ticks
+    eng.pump(2)
+    assert server.get("pods", "default", "pnew")["status"]["phase"] == "Running"
+
+
+def _two_step_rules():
+    """Pending->Running then Running->Succeeded, both 0-delay — one
+    transition per tick, two ticks in flight => two ordered patches."""
+    return default_pod_rules() + [
+        LifecycleRule(
+            name="pod-complete",
+            resource=ResourceKind.POD,
+            from_phases=("Running",),
+            selector=SEL_MANAGED,
+            delay=Delay.constant(0.0),
+            effect=StatusEffect(to_phase="Succeeded"),
+        ),
+    ]
+
+
+def test_inflight_ticks_emit_in_fifo_order():
+    server = FakeKube()
+    eng = SyncEngine(
+        server,
+        EngineConfig(manage_all_nodes=True, pod_rules=_two_step_rules()),
+    )
+    server.create("nodes", make_node("n0"))
+    eng.feed_all(server)
+    eng.pump(2)
+    server.create("pods", make_pod("p0", node="n0"))
+    eng._ingest("pods", "ADDED", server.get("pods", "default", "p0"))
+
+    seen = []
+    orig = server.patch_status
+
+    def record(kind, ns, name, body):
+        if kind == "pods":
+            seen.append(body["status"]["phase"])
+        return orig(kind, ns, name, body)
+
+    server.patch_status = record
+    # two ticks in flight: tick1 fires Running, tick2 (dispatched before
+    # tick1 is consumed) fires Succeeded
+    p1 = eng._tick_dispatch()
+    p2 = eng._tick_dispatch()
+    eng._tick_consume(p1)
+    eng._tick_consume(p2)
+    assert seen == ["Running", "Succeeded"]
+    assert server.get("pods", "default", "p0")["status"]["phase"] == "Succeeded"
+
+
+def test_grow_and_release_mid_window():
+    """Pool grows between dispatch and consume, and the new high-index row
+    is released before consume: the stale filter must not index past the
+    dispatch-time mask size (review finding: IndexError dropped the whole
+    tick's patches)."""
+    server = FakeKube()
+    eng = SyncEngine(
+        server,
+        EngineConfig(manage_all_nodes=True, initial_capacity=4),
+    )
+    server.create("nodes", make_node("n0"))
+    eng.feed_all(server)
+    eng.pump(2)
+    for i in range(4):  # fills the 4-row pool
+        server.create("pods", make_pod(f"g{i}", node="n0"))
+        eng._ingest("pods", "ADDED", server.get("pods", "default", f"g{i}"))
+    p = eng._tick_dispatch()  # caps snapshot at 4
+    # mid-window: a 5th pod forces _grow past the dispatch capacity…
+    server.create("pods", make_pod("g4", node="n0"))
+    eng._ingest("pods", "ADDED", server.get("pods", "default", "g4"))
+    assert eng.pods.capacity > p.caps[1]
+    idx_hi = eng.pods.pool.lookup(("default", "g4"))
+    assert idx_hi >= p.caps[1]  # landed beyond the dispatch-time edge
+    # …and is deleted again before the wire is consumed
+    eng._ingest(
+        "pods", "DELETED",
+        {"metadata": {"namespace": "default", "name": "g4"}},
+    )
+    eng._tick_consume(p)  # must not raise / drop the tick
+    eng.pump(2)
+    for i in range(4):
+        assert (
+            server.get("pods", "default", f"g{i}")["status"]["phase"]
+            == "Running"
+        )
+
+
+def test_threaded_pipeline_converges_and_idles():
+    """End-to-end through the real threaded loop at default pipeline_depth:
+    everything converges, and the released-row bookkeeping drains (no
+    unbounded release-log growth once quiet)."""
+    import time
+
+    server = FakeKube()
+    eng = SyncEngine(
+        server, EngineConfig(manage_all_nodes=True, tick_interval=0.01)
+    )
+    eng.start()
+    try:
+        server.create("nodes", make_node("tn0"))
+        for i in range(20):
+            server.create("pods", make_pod(f"tp{i}", node="tn0"))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            pods = server.list("pods")
+            if pods and all(
+                (p.get("status") or {}).get("phase") == "Running" for p in pods
+            ):
+                break
+            time.sleep(0.05)
+        for i in range(20):
+            assert (
+                server.get("pods", "default", f"tp{i}")["status"]["phase"]
+                == "Running"
+            )
+        for i in range(10):
+            server.delete("pods", "default", f"tp{i}", grace_seconds=0)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if len(server.list("pods")) == 10:
+                break
+            time.sleep(0.05)
+        assert len(server.list("pods")) == 10
+        time.sleep(0.3)  # a few quiet ticks: prune runs
+        assert len(eng.pods.released_at) == 0
+    finally:
+        eng.stop()
+
+
+def test_wire_rows_match_state_mirrors():
+    """pack_rows wire == post-tick phase/cond (the self-contained-wire
+    contract that consume's mirror refresh relies on)."""
+    from kwok_tpu.models import compile_rules, default_node_rules
+    from kwok_tpu.models.lifecycle import ResourceKind
+    from kwok_tpu.ops.state import new_row_state
+    from kwok_tpu.ops.tick import (
+        MultiTickKernel,
+        to_device,
+        to_host,
+        unpack_wire,
+    )
+
+    ntab = compile_rules(default_node_rules(), ResourceKind.NODE)
+    ptab = compile_rules(default_pod_rules(), ResourceKind.POD)
+    caps = [64, 96]
+    states = []
+    for cap, bits in ((caps[0], 0b11), (caps[1], 0b11)):
+        s = to_host(new_row_state(cap))
+        s.active[: cap // 2] = True
+        s.sel_bits[: cap // 2] = bits
+        states.append(to_device(s))
+    kern = MultiTickKernel(
+        [(ntab, 30.0, (), 1), (ptab, 30.0, (), -1)],
+        pack=True, pack_rows=True,
+    )
+    outs, wire = kern(tuple(states), 10.0)
+    _c, _m, _d, rows_fn = unpack_wire(np.asarray(wire), caps, rows=True)
+    rows = rows_fn()
+    for out, (ph, cb), cap in zip(outs, rows, caps):
+        host = to_host(out.state)
+        assert ph.shape == (cap,)
+        np.testing.assert_array_equal(ph, host.phase.astype(np.uint8))
+        np.testing.assert_array_equal(cb, host.cond_bits)
