@@ -604,6 +604,20 @@ class FakeKube:
         for w in watches:
             w.stop()
 
+    def stop_watches(self) -> None:
+        """Close every open watch stream (apiserver shutdown semantics):
+        list swapped out under the lock, then each stopped — the same
+        pattern load() uses, so a concurrently-registering watch either
+        lands before the swap (and is stopped) or after (and belongs to
+        whatever serves the store next)."""
+        with self._lock:
+            watches, self._watches = self._watches, []
+        for w in watches:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
     def delete(self, kind, namespace, name, grace_seconds: int | None = 0):
         """grace_seconds=None applies the server default: for pods,
         spec.terminationGracePeriodSeconds or 30 (real apiserver
@@ -1065,6 +1079,13 @@ class HttpFakeApiserver:
             self._bookmark_thread.join(timeout=5)
         self.httpd.shutdown()
         self.httpd.server_close()
+        # a stopping apiserver terminates its watch streams; without this
+        # the per-connection handler threads blocked on a quiet store
+        # watch would keep their sockets open and clients would never see
+        # the shutdown. (With a store shared across servers this closes
+        # the other servers' streams too — their clients re-watch, the
+        # same recovery as an apiserver restart.)
+        self.store.stop_watches()
         if self._thread:
             self._thread.join(timeout=5)
         if self._audit_file is not None:

@@ -295,6 +295,18 @@ class ClusterEngine:
             except Exception:
                 self._batch_parser = None
         self._watch_rv: dict[str, int] = {}
+        # per-kind watch-stream generation, bumped whenever a stream is
+        # known compacted (410): RAW lines still queued from the dead
+        # stream belong to the old generation and must not repopulate
+        # _watch_rv with pre-compaction revisions (advisor r4: a resume
+        # that died before parsing any NEW line would resurrect the stale
+        # rv and eat a second 410 + full re-list). The watch thread
+        # enqueues ONE "GEN" marker per stream instead of tagging every
+        # line (zero per-line cost on the batched ingest path); the tick
+        # thread mirrors it into _drain_gen as markers drain.
+        self._stream_gen: dict[str, int] = {}
+        self._drain_gen: dict[str, int] = {}
+        self._gen_lock = threading.Lock()
         # Batched pipelined egress (native/pump.cc): one C++ call sends a
         # whole tick's status patches over pooled keep-alive connections,
         # GIL-free. Plain-HTTP apiservers only (the mock/lab edge); TLS
@@ -434,7 +446,10 @@ class ClusterEngine:
                 pass
         self._q.put(None)
         for t in self._threads:
-            t.join(timeout=5)
+            # the tick thread's shutdown path flushes up to pipeline_depth
+            # in-flight device ticks (wire waits included) — give it real
+            # time before the executor below is torn down under it
+            t.join(timeout=60 if t.name == "kwok-tick" else 5)
         if self._executor:
             self._executor.shutdown(wait=True)
         if self._pump is not None:
@@ -485,7 +500,7 @@ class ClusterEngine:
                         # compaction too: a reconnect that dies before any
                         # NEW line is parsed must not resurrect it and eat
                         # a second 410 + re-list
-                        self._watch_rv.pop(kind, None)
+                        self._expire_stream(kind)
                         continue
                     except TooLargeResourceVersion as e:
                         # server's store is BEHIND our resume revision
@@ -536,6 +551,10 @@ class ClusterEngine:
                         # 1-core host. ERROR lines are the one thing
                         # detected here, by prefix (both mock servers and
                         # the real apiserver serialize "type" first).
+                        self._q.put((
+                            kind, "GEN", self._stream_gen.get(kind, 0),
+                            time.monotonic(),
+                        ))
                         for line in raw_iter():
                             if line.startswith(b'{"type":"ERROR"'):
                                 expired = b'"code":410' in line
@@ -572,7 +591,7 @@ class ClusterEngine:
                         expired = getattr(w, "expired", False)
                     if expired:
                         resume_rv = 0
-                        self._watch_rv.pop(kind, None)  # see WatchExpired
+                        self._expire_stream(kind)  # see WatchExpired
                         continue  # immediate re-list, no backoff
                     if not self._running:
                         return
@@ -609,16 +628,60 @@ class ClusterEngine:
             return
         if kind in raw_buf:
             self._drain_flush_kind(kind, raw_buf)
+        if type_ == "GEN":
+            # stream boundary: lines after this belong to generation `obj`
+            self._drain_gen[kind] = obj
+            return
         self._ingest_safe(kind, type_, obj)
 
     def _drain_flush(self, raw_buf: dict) -> None:
         for kind in list(raw_buf):
             self._drain_flush_kind(kind, raw_buf)
 
+    def _expire_stream(self, kind: str) -> None:
+        """Mark kind's watch stream compacted: the resume revision AND the
+        pre-compaction lines' right to set it die together, atomically —
+        a flush committing its batch rv concurrently either lands before
+        (and is discarded here) or sees the bumped generation (and does
+        not commit). Callers: the kind's watch thread (410 on handshake or
+        stream) and the tick thread (stale-ERROR defense)."""
+        with self._gen_lock:
+            self._watch_rv.pop(kind, None)
+            self._stream_gen[kind] = self._stream_gen.get(kind, 0) + 1
+
+    def _drain_error_line(self, kind: str, raw: bytes, gen: int) -> None:
+        """Defense in depth (advisor r4): an ERROR event that slipped past
+        the watch thread's byte-prefix check (a re-serializing intermediary
+        could reorder keys) must not flow into ingest as a bogus record; a
+        410 from the CURRENT stream additionally invalidates the kind's
+        resume revision now instead of deferring to the next reconnect. A
+        stale-generation ERROR (its stream already replaced) must not
+        clobber the live stream's state."""
+        logger.warning("watch error event in drain: %.200r", raw)
+        if b'"code":410' in raw:
+            with self._gen_lock:
+                if gen == self._stream_gen.get(kind, 0):
+                    self._watch_rv.pop(kind, None)
+                    self._stream_gen[kind] = gen + 1
+
+    def _commit_rv(self, kind: str, gen: int, rv: int) -> None:
+        """Advance the kind's resume revision iff its stream is still the
+        live one. One locked commit per flushed batch — atomic against a
+        concurrent 410 on the watch thread (_expire_stream), which would
+        otherwise race the per-line updates and let pre-compaction
+        revisions resurrect."""
+        with self._gen_lock:
+            if gen == self._stream_gen.get(kind, 0):
+                self._watch_rv[kind] = rv
+
     def _drain_flush_kind(self, kind: str, raw_buf: dict) -> None:
         lines = raw_buf.pop(kind, None)
         if not lines:
             return
+        # one generation per buffer: a GEN marker flushes before updating
+        # _drain_gen, so every buffered line shares the marker-time value
+        gen = self._drain_gen.get(kind, 0)
+        latest_rv = 0
         _t = time.perf_counter()
         try:
             batch = self._batch_parser.parse_raw_batch(lines)
@@ -640,12 +703,18 @@ class ClusterEngine:
                 except Exception:
                     logger.warning("unparseable watch line: %.120r", line)
                     continue
+                if rec.type == "ERROR":
+                    self._drain_error_line(kind, line, gen)
+                    latest_rv = 0  # nothing after a stream error counts
+                    continue
                 if rec.rv:
-                    self._watch_rv[kind] = rec.rv
+                    latest_rv = rec.rv
                 if rec.type == "BOOKMARK":
                     self._inc("watch_bookmarks_total")
                     continue
                 self._ingest_safe(kind, "REC", rec)
+            if latest_rv:
+                self._commit_rv(kind, gen, latest_rv)
             self._inc(
                 "ingest_parse_seconds_sum", time.perf_counter() - _t
             )
@@ -653,17 +722,24 @@ class ClusterEngine:
         self._inc("ingest_parse_seconds_sum", time.perf_counter() - _t)
         bookmarks = 0
         for i in range(batch.n):
+            tb = batch.type_bytes(i)
+            if tb == b"ERROR":
+                self._drain_error_line(kind, batch.record(i).raw, gen)
+                latest_rv = 0  # nothing after a stream error counts
+                continue
             # metadata-depth resourceVersion: the watch loop reads this
             # on reconnect (resuming early only duplicates, never skips)
             rv = batch.rv(i)
             if rv:
-                self._watch_rv[kind] = rv
-            if batch.type_bytes(i) == b"BOOKMARK":
+                latest_rv = rv
+            if tb == b"BOOKMARK":
                 bookmarks += 1
                 continue
             # lazy record: the fingerprint echo-drop in _ingest_record
             # touches only ns/name before dropping the steady-state flood
             self._ingest_safe(kind, "REC", batch.record(i))
+        if latest_rv:
+            self._commit_rv(kind, gen, latest_rv)
         if bookmarks:
             self._inc("watch_bookmarks_total", bookmarks)
 
@@ -1183,15 +1259,30 @@ class ClusterEngine:
                 drain_s = 0.0
                 got_event = False
                 raw_buf: dict = {}
-                # drain ingest until the next tick is due
+                # drain ingest until the next tick is due; while ticks are
+                # in flight, wait in short slices so a wire landing
+                # mid-drain is consumed (and its patches emitted) promptly
+                # instead of after the full drain window
                 while True:
                     timeout = deadline - time.monotonic()
                     if timeout <= 0:
                         break
                     try:
-                        item = self._q.get(timeout=timeout)
+                        item = self._q.get(
+                            timeout=min(timeout, 0.005) if pending
+                            else timeout
+                        )
                     except queue.Empty:
-                        break
+                        if pending and self._wire_ready(pending[0]):
+                            try:
+                                self._tick_consume(pending.popleft())
+                                self._prune_released(
+                                    pending[0].seq if pending
+                                    else self._release_seq
+                                )
+                            except Exception:
+                                logger.exception("tick consume failed")
+                        continue
                     if item is None:
                         if not self._running:
                             return
@@ -1256,6 +1347,11 @@ class ClusterEngine:
                             pending.append(p)
                 except Exception:
                     logger.exception("tick failed")
+                    # re-arm: staged work may already be flushed into
+                    # device state with no event left to trigger the
+                    # gate — without a wake the engine would idle-sleep
+                    # on it until an unrelated event arrives
+                    self._idle_wake = time.monotonic() + interval
         finally:
             # stopping: flush in-flight ticks so patches already computed
             # on device are not dropped (stop() joins us, then shuts the
@@ -1444,9 +1540,12 @@ class ClusterEngine:
         try:
             self._executor.submit(self._safe, fn, *args)
         except RuntimeError:
-            # executor shut down while a (federated) tick was still in
-            # flight — we are stopping; drop the patch job
-            pass
+            # executor shut down while a tick was still in flight — we
+            # are stopping; the patch job is dropped, but never silently
+            logger.warning(
+                "patch job dropped during shutdown: %s%r",
+                getattr(fn, "__name__", fn), args[:1],
+            )
 
     def _safe(self, fn, *args) -> None:
         try:

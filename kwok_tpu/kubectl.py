@@ -231,10 +231,16 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def _parse_duration(s: str) -> float:
-    """kubectl-style duration: "30s", "2m", "1h", bare seconds; 0 = none."""
-    s = (s or "0").strip()
-    mult = {"s": 1.0, "m": 60.0, "h": 3600.0}.get(s[-1:], None)
-    return float(s[:-1]) * mult if mult else float(s or 0)
+    """kubectl-style duration via the shared Go-duration parser
+    (config/stages.parse_duration: "30s", "1m30s", "300ms", "0.5s", bare
+    seconds). Invalid input is a clean usage error, not a traceback
+    (advisor r4)."""
+    from kwok_tpu.config.stages import parse_duration
+
+    try:
+        return parse_duration(s or "0")
+    except ValueError:
+        raise SystemExit(f'error: invalid duration "{s}"') from None
 
 
 def _emit_watch_row(kind, obj, args) -> None:
@@ -251,6 +257,14 @@ def _emit_watch_row(kind, obj, args) -> None:
             no_headers=True,
         )
     sys.stdout.flush()
+
+
+class _WatchFailed:
+    """Error sentinel the `get -w` reader thread pushes onto the event
+    queue when the watch cannot be (re-)established."""
+
+    def __init__(self, cause: Exception) -> None:
+        self.cause = cause
 
 
 def _get_watch(args, client, kind, ns, name, start_rv=None) -> int:
@@ -286,6 +300,14 @@ def _get_watch(args, client, kind, ns, name, start_rv=None) -> int:
             except (WatchExpired, TooLargeResourceVersion):
                 rv_box[0] = None  # compacted/reset: rejoin live
                 continue
+            except Exception as e:
+                # server unreachable/dead: surface the failure instead of
+                # dying silently and leaving the main loop blocked on an
+                # empty queue (advisor r4; real kubectl reports watch
+                # errors and exits nonzero)
+                if not stop.is_set():
+                    q.put(_WatchFailed(e))
+                return
             handles.append(w)
             try:
                 for ev in w:
@@ -321,6 +343,9 @@ def _get_watch(args, client, kind, ns, name, start_rv=None) -> int:
                 ev = q.get(timeout=remaining)
             except _queue.Empty:
                 return 0
+            if isinstance(ev, _WatchFailed):
+                print(f"error: watch failed: {ev.cause}", file=sys.stderr)
+                return 1
             obj = ev.object
             if name and (obj.get("metadata") or {}).get("name") != name:
                 continue
@@ -460,6 +485,16 @@ def _run(args, client: HttpKubeClient) -> int:
                     o for o in objs
                     if (o["metadata"].get("namespace") or "default") == ns
                 ]
+            if name and not objs:
+                # fail fast like real kubectl (and our non-watch branch)
+                # instead of silently waiting for events on a name that
+                # does not exist (advisor r4)
+                print(
+                    f'Error from server (NotFound): '
+                    f'{_singular(kind)} "{name}" not found',
+                    file=sys.stderr,
+                )
+                return 1
             per_kind = [(kind, objs)] if objs else []
         else:
             for kind in kinds:

@@ -197,11 +197,21 @@ def _kernel(
                 )
         entered = hb_on & jnp.isinf(hb_due)
         hb_fired = hb_on & (now >= hb_due)
+        # schedule-anchored cadence, matching tick_body (Go time.Ticker
+        # semantics): late-by-<interval fires keep their schedule
+        ivl = jnp.float32(hb_interval)
+        on_schedule = now - hb_due < ivl
         hb_due = jnp.where(
             ~hb_on,
             INF,
             jnp.where(
-                hb_fired | entered, now + jnp.float32(hb_interval), hb_due
+                entered,
+                now + ivl,
+                jnp.where(
+                    hb_fired,
+                    jnp.where(on_schedule, hb_due + ivl, now + ivl),
+                    hb_due,
+                ),
             ),
         )
 

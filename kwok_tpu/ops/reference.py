@@ -126,7 +126,12 @@ def reference_tick(
                 hb_due[i] = np.float32(now + hb_interval)
             elif now >= hb_due[i]:
                 hb_fired[i] = True
-                hb_due[i] = np.float32(now + hb_interval)
+                # schedule-anchored (Go time.Ticker): keep cadence when
+                # late by < interval; re-anchor after a full-interval stall
+                if now - hb_due[i] < hb_interval:
+                    hb_due[i] = np.float32(hb_due[i] + hb_interval)
+                else:
+                    hb_due[i] = np.float32(now + hb_interval)
 
     new_state = RowState(
         active=np.array(state.active, bool),

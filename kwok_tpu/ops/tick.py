@@ -211,10 +211,26 @@ def tick_body(
             )
     entered = hb_on & jnp.isinf(state.hb_due)
     hb_fired = hb_on & (now >= state.hb_due)
+    # Schedule-anchored cadence (Go time.Ticker semantics, matching the
+    # reference's heartbeat loop): a fire that ran late by < interval
+    # keeps its original schedule (due += interval) so per-dispatch
+    # jitter does not accumulate into cadence drift; a stall of >= one
+    # interval re-anchors at now + interval instead of bursting catch-up
+    # beats.
+    ivl = jnp.float32(hb_interval)
+    on_schedule = now - state.hb_due < ivl
     hb_due = jnp.where(
         ~hb_on,
         INF,
-        jnp.where(hb_fired | entered, now + jnp.float32(hb_interval), state.hb_due),
+        jnp.where(
+            entered,
+            now + ivl,
+            jnp.where(
+                hb_fired,
+                jnp.where(on_schedule, state.hb_due + ivl, now + ivl),
+                state.hb_due,
+            ),
+        ),
     )
 
     new_state = RowState(

@@ -503,3 +503,47 @@ def test_get_watch_replays_events_between_list_and_watch(
     lines = [ln.split()[:2] for ln in out.splitlines()[1:]]
     assert ["race", "NotReady"] in lines  # the initial listing
     assert ["race", "Ready"] in lines  # replayed via the list's rv
+
+
+def test_parse_duration_compound_and_invalid():
+    from kwok_tpu.kubectl import _parse_duration
+
+    assert _parse_duration("30s") == 30.0
+    assert _parse_duration("2m") == 120.0
+    assert _parse_duration("1h") == 3600.0
+    assert _parse_duration("1m30s") == 90.0
+    assert _parse_duration("1h2m3s") == 3723.0
+    assert _parse_duration("45") == 45.0
+    assert _parse_duration("") == 0.0
+    with pytest.raises(SystemExit) as e:
+        _parse_duration("1x30")
+    assert "invalid duration" in str(e.value)
+
+
+def test_get_watch_missing_name_fails_fast(srv, kubeconfig, capsys):
+    """`get pod NAME -w` on a nonexistent object must report NotFound and
+    exit 1, not hang waiting for events (advisor r4)."""
+    rc = kubectl(kubeconfig, "get", "pods", "no-such-pod", "-w",
+                 "--request-timeout", "5s")
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "NotFound" in err and "no-such-pod" in err
+
+
+def test_get_watch_surfaces_server_death(srv, kubeconfig, capsys):
+    """If the server dies mid-watch and cannot be re-dialed, `get -w`
+    must print the failure and exit nonzero instead of blocking until the
+    request timeout and exiting 0 (advisor r4)."""
+    import threading
+
+    srv.store.create("pods", make_pod("w1", node="n"))
+    t = threading.Timer(0.5, srv.stop)
+    t.start()
+    try:
+        rc = kubectl(kubeconfig, "get", "pods", "-w",
+                     "--request-timeout", "30s")
+    finally:
+        t.cancel()
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "watch failed" in out.err

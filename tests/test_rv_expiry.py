@@ -678,3 +678,75 @@ def test_negative_rv_watch_is_400_python(http_srv):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(f"{http_srv.url}/api/v1/pods?{q}", timeout=5)
     assert ei.value.code == 400
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native codec")
+def test_stale_generation_raw_lines_do_not_resurrect_rv():
+    """Advisor r4: RAW lines queued from a stream that later 410'd must
+    not repopulate _watch_rv with pre-compaction revisions after the
+    watch loop popped it — or the next resume eats a second 410 + full
+    re-list."""
+    eng = ClusterEngine(FakeKube(), EngineConfig(manage_all_nodes=True))
+    assert eng._batch_parser is not None
+    line = json.dumps({
+        "type": "ADDED",
+        "object": {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "stale", "namespace": "default",
+                         "resourceVersion": "123"},
+            "spec": {"nodeName": "n0",
+                     "containers": [{"name": "c", "image": "x"}]},
+            "status": {"phase": "Pending"},
+        },
+    }, separators=(",", ":")).encode()
+    eng._drain_gen["pods"] = eng._stream_gen.get("pods", 0)
+    raw_buf = {"pods": [line]}
+    # the stream 410s while this line is still buffered
+    eng._expire_stream("pods")
+    eng._watch_rv.pop("pods", None)
+    eng._drain_flush_kind("pods", raw_buf)
+    assert "pods" not in eng._watch_rv  # stale rv NOT resurrected
+    # a line from the CURRENT stream generation does advance the rv
+    line2 = line.replace(b'"resourceVersion":"123"',
+                         b'"resourceVersion":"456"')
+    eng._drain_gen["pods"] = eng._stream_gen["pods"]  # GEN marker drained
+    raw_buf = {"pods": [line2]}
+    eng._drain_flush_kind("pods", raw_buf)
+    assert eng._watch_rv.get("pods") == 456
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native codec")
+def test_reordered_error_line_handled_in_drain():
+    """Advisor r4 defense in depth: an ERROR event whose keys a
+    re-serializing intermediary reordered (so the watch thread's
+    byte-prefix check missed it) must be routed to expired handling by
+    the batch drain, not ingested as a bogus record."""
+    eng = ClusterEngine(FakeKube(), EngineConfig(manage_all_nodes=True))
+    assert eng._batch_parser is not None
+    eng._watch_rv["pods"] = 999
+    gen0 = eng._stream_gen.get("pods", 0)
+    # "object" serialized before "type": prefix check can't see ERROR
+    line = json.dumps({
+        "object": {"kind": "Status", "apiVersion": "v1",
+                   "status": "Failure", "reason": "Expired", "code": 410},
+        "type": "ERROR",
+    }, separators=(",", ":")).encode()
+    assert not line.startswith(b'{"type":"ERROR"')
+    before = eng.metrics["watch_events_total"]
+    eng._drain_gen["pods"] = gen0
+    raw_buf = {"pods": [line]}
+    eng._drain_flush_kind("pods", raw_buf)
+    # 410 routed to expiry: resume revision dropped, generation bumped,
+    # and nothing was ingested
+    assert "pods" not in eng._watch_rv
+    assert eng._stream_gen.get("pods", 0) == gen0 + 1
+    assert eng.metrics["watch_events_total"] == before
+    # a STALE-generation ERROR (its stream already replaced) must NOT
+    # clobber the live stream's state (review finding): rv survives and
+    # the generation stays put
+    eng._watch_rv["pods"] = 1000
+    eng._drain_gen["pods"] = gen0  # still the old stream's lines
+    raw_buf = {"pods": [line]}
+    eng._drain_flush_kind("pods", raw_buf)
+    assert eng._watch_rv.get("pods") == 1000
+    assert eng._stream_gen.get("pods", 0) == gen0 + 1

@@ -73,7 +73,9 @@ def test_heartbeat_fires_on_schedule():
     assert not out.hb_fired.any()
     out = to_host(kern(to_host(out).state, 30.5))
     assert out.hb_fired[:4].all()
-    assert np.allclose(out.state.hb_due[:4], 60.5)
+    # schedule-anchored: firing 0.5s late keeps the 30s cadence (due
+    # 60.0, not 60.5) — dispatch jitter must not accumulate into drift
+    assert np.allclose(out.state.hb_due[:4], 60.0)
 
 
 def test_pod_lifecycle_run_then_delete():
