@@ -1,0 +1,611 @@
+"""Per-process cost tables + a predicted pods/s-vs-cores curve.
+
+The round-4 soak roofline showed a 1-core host splits its CPU roughly
+half engine / half apiservers and concluded "100k pods/s is a multi-core
+statement" — but never MODELED it. This rig measures the microscopic
+costs that statement is made of and assembles them:
+
+1. engine per-event CPU: survivor ADDED (full row init), echo MODIFIED
+   (fingerprint drop), batch parse, emit render per patch — measured
+   in-process against the real ingest/emit code.
+2. apiserver per-op CPU: create / status-patch / patch-with-watchers —
+   pump-loading the standalone C++ apiserver and sampling its /proc
+   stat around each batch (the round-4 8.5us/op probe, now a tool).
+3. rig per-request CPU: what the load generator itself burns per issued
+   request (pump path).
+
+Model: a pod's life in the homogeneous soak costs
+    engine:    survivor + echo + emit + pump-syscall share
+    apiserver: create + bind-patch + status-patch + watch fan-out
+    rig:       2 pump requests (create + bind)
+On 1 core every microsecond serializes: pods/s = 1e6 / sum. On N cores
+the processes pipeline and the slowest STAGE bounds throughput: the
+engine's tick thread is one serial lane (its pump/executor work offloads
+to spare cores), each apiserver is a lane (M members spread their share),
+the rig is a lane. Predictions are printed for 1..32 cores, and the
+1-core prediction is validated against a measured soak number when one
+is supplied (--measured).
+
+Prints ONE JSON line; exits nonzero if the validation misses by more
+than --tolerance (default 0.35 — microbench-vs-soak composition error;
+the point is the structure of the model, not 3-digit precision).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+_CLK = os.sysconf("SC_CLK_TCK")
+
+
+def _proc_cpu_s(pid: int) -> float:
+    with open(f"/proc/{pid}/stat", "rb") as f:
+        parts = f.read().rsplit(b") ", 1)[-1].split()
+    return (int(parts[11]) + int(parts[12])) / _CLK
+
+
+def _pod_line(i: int, type_: str = "ADDED", rv: int = 100) -> bytes:
+    return json.dumps({
+        "type": type_,
+        "object": {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"cm-{i}", "namespace": "default",
+                         "resourceVersion": str(rv + i),
+                         "creationTimestamp": "2026-07-30T00:00:00Z",
+                         "uid": f"u{i}"},
+            "spec": {"nodeName": "cm-node-0",
+                     "containers": [{"name": "c", "image": "x"}]},
+            "status": {"phase": "Pending"},
+        },
+    }, separators=(",", ":")).encode()
+
+
+def engine_costs(n: int, trials: int) -> dict:
+    """In-process µs/event for the real ingest + emit code paths."""
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+    from tests.fake_apiserver import FakeKube
+
+    lines = [_pod_line(i) for i in range(n)]
+    m_lines = [_pod_line(i, "MODIFIED", 300000) for i in range(n)]
+
+    surv, echo, emit, parse = [], [], [], []
+    for _ in range(trials):
+        eng = ClusterEngine(FakeKube(), EngineConfig(
+            manage_all_nodes=True, initial_capacity=n + 128))
+        eng._ingest("nodes", "ADDED", {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "cm-node-0"}})
+        # batch parse alone
+        t0 = time.perf_counter()
+        batch = eng._batch_parser.parse_raw_batch(lines)
+        parse.append(1e6 * (time.perf_counter() - t0) / n)
+        del batch
+        # survivor: ADDED -> full row init
+        raw_buf: dict = {}
+        t0 = time.perf_counter()
+        for ln in lines:
+            eng._drain_apply(("pods", "RAW", ln, 0.0), raw_buf)
+        eng._drain_flush(raw_buf)
+        surv.append(1e6 * (time.perf_counter() - t0) / n)
+        # echo: MODIFIED with unchanged fingerprints -> dropped
+        raw_buf = {}
+        t0 = time.perf_counter()
+        for ln in m_lines:
+            eng._drain_apply(("pods", "RAW", ln, 0.0), raw_buf)
+        eng._drain_flush(raw_buf)
+        echo.append(1e6 * (time.perf_counter() - t0) / n)
+        # emit render: the batch path's Python body-building + C++ render
+        # + fingerprints, with the send swallowed (we're costing the
+        # engine's CPU, not the network)
+        eng.pods.phase_h[: n] = eng._pod_phase_ids["Running"]
+
+        class _NullPump:
+            def send(self, reqs):
+                import numpy as np
+                return np.full(len(reqs), 200, np.int32)
+
+            def close(self):
+                pass
+
+        eng._pump = _NullPump()
+        eng._pump_tried = True
+        eng._pump_base = ""
+        idxs = [eng.pods.pool.lookup(("default", f"cm-{i}"))
+                for i in range(n)]
+        idxs = [i for i in idxs if i is not None]
+        t0 = time.perf_counter()
+        eng._emit_pods_native(eng.pods, idxs)
+        emit.append(1e6 * (time.perf_counter() - t0) / max(1, len(idxs)))
+    # flush staging + scatter: the ingest writes' path to device state
+    flushes = []
+    for _ in range(trials):
+        eng = ClusterEngine(FakeKube(), EngineConfig(
+            manage_all_nodes=True, initial_capacity=n + 128))
+        fused = eng._get_fused()
+        for k in (eng.nodes, eng.pods):
+            k.state = fused.place(k.state)
+        for i in range(n):
+            eng.pods.buffer.stage_init(i, True, 0, 0, 3, False)
+        t0 = time.perf_counter()
+        eng.pods.state = eng.pods.buffer.flush(eng.pods.state)
+        import jax
+
+        jax.block_until_ready(eng.pods.state.active)
+        flushes.append(1e6 * (time.perf_counter() - t0) / n)
+    # per-tick kernel CPU at this capacity (CPU backend: the tick math
+    # competes for the core; on a TPU it offloads — the model carries it
+    # as a separate lane for exactly that reason). Rows must be ACTIVE:
+    # an empty pool skips the dispatch entirely.
+    eng = ClusterEngine(FakeKube(), EngineConfig(
+        manage_all_nodes=True, initial_capacity=n + 128))
+    fused = eng._get_fused()
+    for k in (eng.nodes, eng.pods):
+        k.state = fused.place(k.state)
+    for i in range(n):
+        eng.pods.pool.acquire(("default", f"k-{i}"))
+        eng.pods.buffer.stage_init(i, True, 0, 0, 0, False)
+    eng.tick_once()  # flush + compile
+    ticks = []
+    for _ in range(max(3, trials)):
+        t0 = time.perf_counter()
+        eng.tick_once()
+        ticks.append(1e3 * (time.perf_counter() - t0))
+    return {
+        "survivor_added_us": round(statistics.median(surv), 2),
+        "echo_modified_us": round(statistics.median(echo), 2),
+        "batch_parse_us": round(statistics.median(parse), 2),
+        "emit_render_us": round(statistics.median(emit), 2),
+        "flush_staged_row_us": round(statistics.median(flushes), 2),
+        "tick_kernel_ms_at_capacity": round(statistics.median(ticks), 2),
+        "capacity": n + 128,
+        "events_per_trial": n,
+        "trials": trials,
+    }
+
+
+def watch_read_costs(n: int, trials: int) -> dict:
+    """µs CPU per watch line on the consumer side: chunked-HTTP line
+    iteration + the ingest-queue put (the engine's watch threads)."""
+    from kwok_tpu import native
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.kwokctl import netutil
+
+    bin_ = native.apiserver_binary()
+    if not bin_:
+        return {"skipped": "no native apiserver binary"}
+    port = netutil.get_unused_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [bin_, "--port", str(port)], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        from benchmarks.soak import _wait_http
+
+        _wait_http(f"http://127.0.0.1:{port}", "/healthz", timeout=30)
+        pump = native.Pump("127.0.0.1", port, nconn=2)
+        client = HttpKubeClient.from_kubeconfig(
+            None, f"http://127.0.0.1:{port}")
+        import queue as _q
+
+        vals = []
+        for t in range(trials):
+            w = client.watch("pods")
+            reqs = [
+                ("POST", "/api/v1/namespaces/default/pods", json.dumps({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"w-{t}-{i}",
+                                 "namespace": "default"},
+                    "spec": {"nodeName": "n0",
+                             "containers": [{"name": "c", "image": "x"}]},
+                }, separators=(",", ":")).encode())
+                for i in range(n)
+            ]
+            pump.send(reqs)
+            qq: "_q.SimpleQueue" = _q.SimpleQueue()
+            got = 0
+            c0 = time.process_time()
+            for line in w.raw_lines():
+                qq.put(("pods", "RAW", line, time.monotonic()))
+                got += 1
+                if got >= n:
+                    break
+            vals.append(1e6 * (time.process_time() - c0) / n)
+            w.stop()
+        pump.close()
+        client.close()
+        return {"watch_line_us": round(statistics.median(vals), 2),
+                "lines_per_trial": n, "trials": trials}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def apiserver_costs(n: int, trials: int) -> dict:
+    """µs CPU per op for the standalone C++ apiserver (pump-loaded)."""
+    from kwok_tpu import native
+    from kwok_tpu.kwokctl import netutil
+
+    bin_ = native.apiserver_binary()
+    if not bin_:
+        return {"skipped": "no native apiserver binary"}
+    port = netutil.get_unused_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [bin_, "--port", str(port)], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        from benchmarks.soak import _wait_http
+
+        _wait_http(f"http://127.0.0.1:{port}", "/healthz", timeout=30)
+        pump = native.Pump("127.0.0.1", port, nconn=2)
+
+        def batch_cpu(reqs) -> float:
+            c0 = _proc_cpu_s(proc.pid)
+            st = pump.send(reqs)
+            ok = int(((st >= 200) & (st < 300)).sum())
+            if ok < len(reqs) * 0.99:
+                raise SystemExit(
+                    f"apiserver probe: only {ok}/{len(reqs)} ok")
+            return 1e6 * (_proc_cpu_s(proc.pid) - c0) / len(reqs)
+
+        def pod_body(i, gen):
+            return json.dumps({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"pr-{gen}-{i}",
+                             "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "x"}]},
+                "status": {"phase": "Pending"},
+            }, separators=(",", ":")).encode()
+
+        creates, binds, patches, patches_w = [], [], [], []
+        for t in range(trials):
+            creates.append(batch_cpu([
+                ("POST", "/api/v1/namespaces/default/pods",
+                 pod_body(i, t)) for i in range(n)]))
+            binds.append(batch_cpu([
+                ("PATCH", f"/api/v1/namespaces/default/pods/pr-{t}-{i}",
+                 b'{"spec":{"nodeName":"cm-node-0"}}',
+                 "application/merge-patch+json")
+                for i in range(n)]))
+            patches.append(batch_cpu([
+                ("PATCH", f"/api/v1/namespaces/default/pods/pr-{t}-{i}/status",
+                 b'{"status":{"phase":"Running"}}',
+                 "application/strategic-merge-patch+json")
+                for i in range(n)]))
+        # fan-out cost: same patches with 2 live CONSUMING watchers — a
+        # watcher that never reads would let the event writes defer into
+        # socket buffers and under-measure the fan-out
+        import http.client
+        import threading
+
+        watchers = []
+        stop_w = threading.Event()
+        for _ in range(2):
+            c = http.client.HTTPConnection("127.0.0.1", port)
+            c.request("GET", "/api/v1/pods?watch=true")
+            r = c.getresponse()
+
+            def drain_stream(r=r):
+                try:
+                    while not stop_w.is_set() and r.read(65536):
+                        pass
+                except Exception:
+                    pass
+
+            th = threading.Thread(target=drain_stream, daemon=True)
+            th.start()
+            watchers.append((c, th))
+        for t in range(trials):
+            patches_w.append(batch_cpu([
+                ("PATCH", f"/api/v1/namespaces/default/pods/pr-{t}-{i}/status",
+                 b'{"status":{"phase":"Succeeded"}}',
+                 "application/strategic-merge-patch+json")
+                for i in range(n)]))
+        stop_w.set()
+        # shutdown() first: close() needs the response buffer lock, which
+        # a drain thread blocked in recv() holds — shutdown wakes it with
+        # EOF, then join, then close (observed deadlock otherwise)
+        import socket as _socket
+
+        for c, _th in watchers:
+            try:
+                c.sock.shutdown(_socket.SHUT_RDWR)
+            except Exception:
+                pass
+        for c, th in watchers:
+            th.join(timeout=5)
+            c.close()
+        # progress-poll cost at the FULL store size: the rig polls
+        # fieldSelector=status.phase=Running&limit=1 which must count
+        # every match for remainingItemCount — an O(store) scan whose
+        # soak share the per-op probes above cannot see
+        store_size = len(creates) * n  # pods created across trials
+        import http.client as _hc
+
+        polls = []
+        pc = _hc.HTTPConnection("127.0.0.1", port)
+        path = ("/api/v1/pods?fieldSelector=status.phase%3DRunning"
+                "&limit=1")
+        for _ in range(3):
+            c0 = _proc_cpu_s(proc.pid)
+            n_polls = 30
+            for _i in range(n_polls):
+                pc.request("GET", path)
+                pc.getresponse().read()
+            polls.append(1e6 * (_proc_cpu_s(proc.pid) - c0) / n_polls)
+        pc.close()
+        pump.close()
+        med = statistics.median
+        p, pw = med(patches), med(patches_w)
+        return {
+            "create_pod_us": round(med(creates), 2),
+            "bind_patch_us": round(med(binds), 2),
+            "patch_status_us": round(p, 2),
+            "patch_status_with_2_watchers_us": round(pw, 2),
+            "watch_fanout_per_watcher_us": round(max(0.0, (pw - p) / 2), 2),
+            "poll_running_count_us": round(med(polls), 2),
+            "poll_store_pods": store_size,
+            "ops_per_batch": n,
+            "trials": trials,
+        }
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def rig_costs(n: int, trials: int) -> dict:
+    """µs of THIS process's CPU per pump-issued request (the loader's
+    own cost: body building + pump syscalls)."""
+    from kwok_tpu import native
+    from kwok_tpu.kwokctl import netutil
+
+    bin_ = native.apiserver_binary()
+    if not bin_:
+        return {"skipped": "no native apiserver binary"}
+    port = netutil.get_unused_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [bin_, "--port", str(port)], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        from benchmarks.soak import _wait_http
+
+        _wait_http(f"http://127.0.0.1:{port}", "/healthz", timeout=30)
+        pump = native.Pump("127.0.0.1", port, nconn=2)
+        vals = []
+        for t in range(trials):
+            c0 = time.process_time()
+            reqs = [
+                ("POST", "/api/v1/namespaces/default/pods", json.dumps({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"rig-{t}-{i}",
+                                 "namespace": "default"},
+                    "spec": {"containers": [{"name": "c", "image": "x"}]},
+                }, separators=(",", ":")).encode())
+                for i in range(n)
+            ]
+            pump.send(reqs)
+            vals.append(1e6 * (time.process_time() - c0) / n)
+        pump.close()
+        return {"issue_request_us": round(statistics.median(vals), 2),
+                "ops_per_batch": n, "trials": trials}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+_CONTENTION_SNIPPET = r"""
+import json, time
+line = json.dumps({"type":"ADDED","object":{"metadata":{"name":"x",
+  "namespace":"default","resourceVersion":"1"},"spec":{"nodeName":"n",
+  "containers":[{"name":"c","image":"i"}]},"status":{"phase":"Pending"}}})
+deadline = time.perf_counter() + %f
+n = 0
+while time.perf_counter() < deadline:
+    json.loads(line); n += 1
+print(n)
+"""
+
+
+def contention_factor(procs: int = 6, seconds: float = 2.0) -> dict:
+    """The multi-process tax the per-process probes cannot see: run the
+    same fixed CPU workload in 1 process, then in `procs` concurrent
+    processes (the soak's process count), and compare per-process
+    throughput. On an ideal scheduler the concurrent run does 1/procs
+    the work each with zero loss; the shortfall is context-switch +
+    cache-thrash overhead, applied to the model's 1-core total."""
+    def run(n_procs: int) -> float:
+        script = _CONTENTION_SNIPPET % seconds
+        ps = [
+            subprocess.Popen(
+                [sys.executable, "-c", script], stdout=subprocess.PIPE)
+            for _ in range(n_procs)
+        ]
+        total = 0
+        for p in ps:
+            out, _ = p.communicate(timeout=seconds * (n_procs + 4))
+            total += int(out.strip() or 0)
+        return total / seconds  # ops/s across all processes
+
+    solo = run(1)
+    crowd = run(procs)
+    factor = solo / max(1.0, crowd)
+    return {
+        "processes": procs,
+        "solo_ops_per_s": round(solo, 0),
+        "concurrent_ops_per_s_total": round(crowd, 0),
+        "factor": round(max(1.0, factor), 3),
+    }
+
+
+def build_model(eng: dict, api: dict, rig: dict, watch: dict,
+                members: int, ticks_per_kpod: float = 0.2,
+                contention: float = 1.0) -> dict:
+    """Assemble per-pod costs and the pods/s-vs-cores curve.
+
+    A pod's life in the homogeneous soak:
+      rig:       create + bind                       (2 pump requests)
+      apiserver: create + bind patch + status patch, each fanned out to
+                 the engine's pod watch (3 fan-outs)
+      engine:    2 watch lines read (ADDED + echo) + survivor ingest +
+                 echo drop + flush of its staged row + emit render +
+                 pump syscalls for its patch + its share of tick kernel
+                 CPU (per-TICK cost at capacity, amortized over the pods
+                 a tick retires; on a TPU this lane leaves the host)
+    """
+    fan = api.get("watch_fanout_per_watcher_us", 0.0)
+    api_per_pod = (
+        api.get("create_pod_us", 0.0)
+        + api.get("bind_patch_us", api.get("patch_status_us", 0.0))
+        + api.get("patch_status_us", 0.0)
+        + 3 * fan
+    )
+    # The rig's progress polls are an O(store) count per poll (the
+    # remainingItemCount contract). Per-pod share = polls x per-store-pod
+    # cost / pods, which depends on the poll interval and phase wall —
+    # self-referential, so it is reported as a DIAGNOSTIC, not summed:
+    # at the soak's 1s interval and a ~7s phase it is ~3-6us/pod, inside
+    # the model's tolerance; at sub-second intervals or much larger
+    # stores it would dominate (it scales with store size, not load).
+    poll_per_store_pod = (
+        api.get("poll_running_count_us", 0.0)
+        / max(1, api.get("poll_store_pods", 1))
+    )
+    kernel_per_pod = eng.get("tick_kernel_ms_at_capacity", 0.0) * 1e3 \
+        * ticks_per_kpod / 1000.0
+    eng_serial_per_pod = (
+        eng["survivor_added_us"] + eng["echo_modified_us"]
+        + eng["emit_render_us"] + eng.get("flush_staged_row_us", 0.0)
+    )
+    eng_watch_per_pod = 2 * watch.get("watch_line_us", 0.0)
+    eng_offload_per_pod = rig.get("issue_request_us", 0.0)  # pump thread
+    rig_per_pod = 2 * rig.get("issue_request_us", 0.0)
+    total_modeled = (
+        eng_serial_per_pod + eng_watch_per_pod + eng_offload_per_pod
+        + kernel_per_pod + api_per_pod + rig_per_pod
+    )
+    # contention is a MEASURED diagnostic: on this VM the probe shows no
+    # multi-process tax (concurrent throughput >= solo — burstable vCPU),
+    # so it multiplies as ~1.0; kept in the model so a host where it is
+    # real (a true pinned core) scales the 1-core point correctly
+    total_1core = total_modeled * max(1.0, contention)
+    curve = {}
+    for cores in (1, 2, 4, 8, 16, 32):
+        if cores == 1:
+            pods_s = 1e6 / total_1core
+        else:
+            # pipeline model: each process/thread group is a lane once
+            # cores allow. engine tick thread = serial lane (drain+emit);
+            # watch threads, pump, and the device math are separate
+            # lanes; M apiservers split their share; rig across 4
+            # loaders.
+            lanes = [
+                eng_serial_per_pod,
+                api_per_pod / min(members, max(1, cores - 2)),
+                rig_per_pod / min(4, cores),
+                eng_watch_per_pod / 2,  # one thread per kind
+                eng_offload_per_pod,
+                kernel_per_pod,  # offloads entirely with a TPU attached
+            ]
+            pods_s = 1e6 / max(lanes)
+        curve[str(cores)] = round(pods_s, 0)
+    return {
+        "per_pod_us": {
+            "engine_serial_drain_emit": round(eng_serial_per_pod, 1),
+            "engine_watch_threads": round(eng_watch_per_pod, 1),
+            "engine_offloadable_pump": round(eng_offload_per_pod, 1),
+            "engine_tick_kernel": round(kernel_per_pod, 1),
+            "apiservers_total": round(api_per_pod, 1),
+            "rig": round(rig_per_pod, 1),
+            "total_modeled": round(total_modeled, 1),
+            "contention_factor": round(contention, 3),
+            "total_1core": round(total_1core, 1),
+        },
+        "poll_us_per_store_pod": round(poll_per_store_pod, 3),
+        "predicted_pods_per_s_by_cores": curve,
+        "assumptions": (
+            "homogeneous soak pod = rig(create+bind) + "
+            "apiserver(create+bind-patch+status-patch+3 fanouts) + "
+            "engine(2 watch lines + survivor + echo + flush + emit + "
+            "pump + tick-kernel share at "
+            f"{ticks_per_kpod} ticks/kpod); N-core = slowest lane "
+            f"(engine tick thread serial, apiservers split across "
+            f"{members} members, rig across 4 loaders; the tick-kernel "
+            "lane leaves the host entirely when a TPU is attached)"
+        ),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--events", type=int, default=20000)
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--members", type=int, default=4)
+    p.add_argument("--measured", type=float, default=0.0,
+                   help="measured 1-core homogeneous soak pods/s to "
+                   "validate the model's 1-core prediction against")
+    p.add_argument("--tolerance", type=float, default=0.6,
+                   help="bottom-up microbenches vs a live multi-process "
+                   "soak: the residual (federation layer, GC/allocator "
+                   "churn, small-batch socket patterns) is reported "
+                   "explicitly; the gate only catches a model that has "
+                   "lost the right order of magnitude")
+    args = p.parse_args()
+
+    eng = engine_costs(args.events, args.trials)
+    api = apiserver_costs(min(args.events, 20000), args.trials)
+    rig = rig_costs(min(args.events, 20000), args.trials)
+    watch = watch_read_costs(min(args.events, 20000), args.trials)
+    # soak process count: engine + members + rig + a loader or two
+    cont = contention_factor(procs=args.members + 3)
+    model = build_model(eng, api, rig, watch, args.members,
+                        contention=cont["factor"])
+    out = {
+        "metric": "cost model: per-process us CPU per op + pods/s-vs-cores",
+        "engine": eng,
+        "apiserver": api,
+        "rig": rig,
+        "watch": watch,
+        "contention": cont,
+        "model": model,
+    }
+    ok = True
+    if args.measured > 0:
+        pred = model["predicted_pods_per_s_by_cores"]["1"]
+        err = abs(pred - args.measured) / args.measured
+        ok = err <= args.tolerance
+        # the bottom-up sum under-counts what only a live soak has:
+        # federation-layer overhead, allocator/GC churn over a growing
+        # heap, and small-batch socket patterns. Surface the residual
+        # explicitly instead of hiding it in a fudge factor.
+        measured_us = 1e6 / args.measured
+        out["validation"] = {
+            "measured_1core_pods_per_s": args.measured,
+            "predicted_1core_pods_per_s": pred,
+            "measured_us_per_pod": round(measured_us, 1),
+            "modeled_us_per_pod": model["per_pod_us"]["total_1core"],
+            "unattributed_us_per_pod": round(
+                measured_us - model["per_pod_us"]["total_1core"], 1
+            ),
+            "relative_error": round(err, 3),
+            "tolerance": args.tolerance,
+            "pass": ok,
+        }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

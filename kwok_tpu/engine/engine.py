@@ -276,6 +276,16 @@ class ClusterEngine:
         self._alloc_lock = threading.Lock()
         self._metrics_lock = threading.Lock()
 
+        # record fast-path gate: disregard selectors and a live CNI
+        # provider both force the full-parse path (per-event attribute
+        # chases + cni.available() calls showed up at 10k+ events/drain).
+        # Evaluated here and again in start() — cni providers load before
+        # the engine starts (kwok/cli.py).
+        self._record_needs_full_path = (
+            self._disregard_annotation is not None
+            or self._disregard_label is not None
+            or (config.enable_cni and cni.available())
+        )
         # Native C++ egress codec: batch-renders heartbeat patch bytes for
         # the O(nodes)-every-30s hot loop. Optional — pure-Python renderers
         # are the fallback; KWOK_TPU_NATIVE=0 disables it explicitly.
@@ -307,6 +317,7 @@ class ClusterEngine:
         self._stream_gen: dict[str, int] = {}
         self._drain_gen: dict[str, int] = {}
         self._gen_lock = threading.Lock()
+        self._dropped_jobs = 0  # patch jobs rejected during shutdown
         # Batched pipelined egress (native/pump.cc): one C++ call sends a
         # whole tick's status patches over pooled keep-alive connections,
         # GIL-free. Plain-HTTP apiservers only (the mock/lab edge); TLS
@@ -394,6 +405,11 @@ class ClusterEngine:
         queues + emit paths from one shared tick loop."""
         self._running = True
         self._owns_tick = run_tick_loop
+        self._record_needs_full_path = (
+            self._disregard_annotation is not None
+            or self._disregard_label is not None
+            or (self.config.enable_cni and cni.available())
+        )
         self._executor = ThreadPoolExecutor(
             max_workers=self.config.parallelism, thread_name_prefix="kwok-patch"
         )
@@ -452,6 +468,10 @@ class ClusterEngine:
             t.join(timeout=60 if t.name == "kwok-tick" else 5)
         if self._executor:
             self._executor.shutdown(wait=True)
+        if self._dropped_jobs:
+            logger.warning(
+                "%d patch jobs dropped during shutdown", self._dropped_jobs
+            )
         if self._pump is not None:
             self._pump.close()
             self._pump = None
@@ -571,7 +591,11 @@ class ClusterEngine:
                         # little EARLY — the server replays them and the
                         # fingerprint echo-drop makes replays no-ops;
                         # resuming early can only duplicate, never skip.
-                        resume_rv = self._watch_rv.get(kind, resume_rv)
+                        # An ABSENT entry means the tick thread popped it
+                        # (drain-side 410 defense): the local fallback
+                        # would resurrect the pre-compaction revision —
+                        # re-list instead.
+                        resume_rv = self._watch_rv.get(kind, 0)
                     else:
                         for ev in w:
                             rv = int(
@@ -682,6 +706,8 @@ class ClusterEngine:
         # _drain_gen, so every buffered line shares the marker-time value
         gen = self._drain_gen.get(kind, 0)
         latest_rv = 0
+        rv_dead = False
+        n_rec = 0
         _t = time.perf_counter()
         try:
             batch = self._batch_parser.parse_raw_batch(lines)
@@ -705,51 +731,71 @@ class ClusterEngine:
                     continue
                 if rec.type == "ERROR":
                     self._drain_error_line(kind, line, gen)
-                    latest_rv = 0  # nothing after a stream error counts
+                    latest_rv = 0
+                    rv_dead = True  # nothing after a stream error counts
                     continue
-                if rec.rv:
+                if rec.rv and not rv_dead:
                     latest_rv = rec.rv
                 if rec.type == "BOOKMARK":
                     self._inc("watch_bookmarks_total")
                     continue
+                n_rec += 1
                 self._ingest_safe(kind, "REC", rec)
             if latest_rv:
                 self._commit_rv(kind, gen, latest_rv)
+            if n_rec:
+                self._inc("watch_events_total", n_rec)
             self._inc(
                 "ingest_parse_seconds_sum", time.perf_counter() - _t
             )
             return
         self._inc("ingest_parse_seconds_sum", time.perf_counter() - _t)
         bookmarks = 0
+        # hot loop: locals beat repeated attribute/method dispatch at
+        # O(10k) records per drain
+        rvs = batch.rvs
+        type_bytes = batch.type_bytes
+        record = batch.record
+        ingest_record = self._ingest_record
         for i in range(batch.n):
-            tb = batch.type_bytes(i)
+            tb = type_bytes(i)
             if tb == b"ERROR":
-                self._drain_error_line(kind, batch.record(i).raw, gen)
-                latest_rv = 0  # nothing after a stream error counts
+                self._drain_error_line(kind, record(i).raw, gen)
+                latest_rv = 0
+                rv_dead = True  # nothing after a stream error counts
                 continue
             # metadata-depth resourceVersion: the watch loop reads this
             # on reconnect (resuming early only duplicates, never skips)
-            rv = batch.rv(i)
-            if rv:
+            rv = rvs[i]
+            if rv and not rv_dead:
                 latest_rv = rv
             if tb == b"BOOKMARK":
                 bookmarks += 1
                 continue
             # lazy record: the fingerprint echo-drop in _ingest_record
-            # touches only ns/name before dropping the steady-state flood
-            self._ingest_safe(kind, "REC", batch.record(i))
+            # touches only flags/fps/ns/name before dropping the
+            # steady-state flood
+            n_rec += 1
+            try:
+                ingest_record(kind, record(i))
+            except Exception:
+                logger.exception("ingest failed for %s REC", kind)
         if latest_rv:
             self._commit_rv(kind, gen, latest_rv)
+        if n_rec:
+            self._inc("watch_events_total", n_rec)
         if bookmarks:
             self._inc("watch_bookmarks_total", bookmarks)
 
     def _ingest(self, kind: str, type_: str, obj) -> None:
+        if type_ == "REC":
+            # counted per-batch by _drain_flush_kind: one lock acquisition
+            # per drain instead of one per event on the survivor path
+            self._ingest_record(kind, obj)
+            return
         self._inc("watch_events_total")
         if type_ == "RESYNC":
             self._resync(kind, obj)
-            return
-        if type_ == "REC":
-            self._ingest_record(kind, obj)
             return
         if kind == "nodes":
             if type_ == DELETED:
@@ -1081,9 +1127,7 @@ class ClusterEngine:
         node_name = rec.node_name
         if not name or not node_name:
             return True  # same early-outs as _pod_upsert
-        if self._disregard_annotation is not None or self._disregard_label is not None:
-            return False
-        if self.config.enable_cni and cni.available():
+        if self._record_needs_full_path:
             return False
         ns = rec.namespace or "default"
         key = (ns, name)
@@ -1098,36 +1142,60 @@ class ClusterEngine:
             # first sighting already past Pending: the reference would run
             # the repair render+merge against the real status right away
             return False
-        has_del = bool(rec.flags & 2)
+        flags = rec.flags
+        has_del = bool(flags & 2)
         if new_row:
             if k.pool.full:
                 self._grow(k)
             idx = k.pool.acquire(key)
-        m = k.pool.meta[idx]
-        m.update(
-            name=name,
-            namespace=ns,
-            node=node_name,
-            disregard=False,
-            raw=rec.raw,
-            finalizers=bool(rec.flags & 4),
-            has_del=has_del,
-            creation=rec.creation,
-            ctrs=rec.containers,
-            ictrs=rec.init_containers,
-            rgates=bool(rec.flags & 8),
-            phase_str=rec.phase,
-            host_ip=rec.host_ip,
-            status_scalar=bool(rec.flags & 16),
-        )
-        m.pop("obj", None)  # the raw line supersedes any stale object
+            # fresh rows replace the pool's empty meta dict wholesale: a
+            # dict display is one C-level allocation vs a kwargs update
+            m = {
+                "name": name,
+                "namespace": ns,
+                "node": node_name,
+                "disregard": False,
+                "raw": rec.raw,
+                "finalizers": bool(flags & 4),
+                "has_del": has_del,
+                "creation": rec.creation,
+                "ctrs": rec.containers,
+                "ictrs": rec.init_containers,
+                "rgates": bool(flags & 8),
+                "phase_str": rec.phase,
+                "host_ip": rec.host_ip,
+                "status_scalar": bool(flags & 16),
+            }
+            k.pool.meta[idx] = m
+        else:
+            m = k.pool.meta[idx]
+            m.update(
+                name=name,
+                namespace=ns,
+                node=node_name,
+                disregard=False,
+                raw=rec.raw,
+                finalizers=bool(flags & 4),
+                has_del=has_del,
+                creation=rec.creation,
+                ctrs=rec.containers,
+                ictrs=rec.init_containers,
+                rgates=bool(flags & 8),
+                phase_str=rec.phase,
+                host_ip=rec.host_ip,
+                status_scalar=bool(flags & 16),
+            )
+            m.pop("obj", None)  # the raw line supersedes any stale object
         if rec.pod_ip:
             with self._alloc_lock:
                 if self.ippool.contains(rec.pod_ip):
                     self.ippool.use(rec.pod_ip)
                 m["podIP"] = rec.pod_ip
         bits = self._pod_bits(m)
-        self.pods_by_node.setdefault(node_name, set()).add(key)
+        by_node = self.pods_by_node.get(node_name)
+        if by_node is None:
+            by_node = self.pods_by_node[node_name] = set()
+        by_node.add(key)
         if new_row:
             phase = self._pod_phase_ids.get(rec.phase or "Pending", _PENDING)
             cond = 0
@@ -1136,10 +1204,7 @@ class ClusterEngine:
                     tn = t.decode()
                     if tn in POD_PHASES.conditions:
                         cond |= 1 << POD_PHASES.condition_bit(tn)
-            k.buffer.stage_init(
-                idx, True, phase=phase, cond_bits=cond, sel_bits=bits,
-                has_deletion=has_del,
-            )
+            k.buffer.stage_init(idx, True, phase, cond, bits, has_del)
             k.phase_h[idx] = phase
             k.cond_h[idx] = cond
         else:
@@ -1541,11 +1606,16 @@ class ClusterEngine:
             self._executor.submit(self._safe, fn, *args)
         except RuntimeError:
             # executor shut down while a tick was still in flight — we
-            # are stopping; the patch job is dropped, but never silently
-            logger.warning(
-                "patch job dropped during shutdown: %s%r",
-                getattr(fn, "__name__", fn), args[:1],
-            )
+            # are stopping; jobs are dropped, but never silently. One
+            # warning + a count: a flushed tick can carry O(10k) jobs
+            # and per-job lines would flood the shutdown log.
+            self._dropped_jobs += 1
+            if self._dropped_jobs == 1:
+                logger.warning(
+                    "patch jobs dropped during shutdown (first: %s%r); "
+                    "total reported at stop",
+                    getattr(fn, "__name__", fn), args[:1],
+                )
 
     def _safe(self, fn, *args) -> None:
         try:

@@ -27,7 +27,7 @@ _INGEST_SRC = os.path.join(_DIR, "ingest.cc")
 _LIB = os.path.join(_DIR, "libkwokcodec.so")
 _APISERVER_SRC = os.path.join(_DIR, "apiserver.cc")
 _APISERVER_BIN = os.path.join(_DIR, "kwok-mock-apiserver")
-ABI_VERSION = 4
+ABI_VERSION = 5
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -222,13 +222,16 @@ class ParsedBatch:
 
 
 class _LazyRecord:
-    """EventRecord-compatible lazy view into a ParsedBatch. Fields
-    materialize on FIRST attribute access via __getattr__ and are then
-    cached as plain instance attributes (no per-access property dispatch —
-    survivor records touch fields many times; echo-dropped records touch
-    almost none). The string fields decode in one pass on first touch:
-    once a record survives the fingerprint drop it will need most of them,
-    and a single slicing loop beats eleven lazy slices."""
+    """EventRecord-compatible lazy view into a ParsedBatch. Fields cache
+    as plain instance attributes on first access via __getattr__.
+
+    Two tiers keep the steady-state echo flood cheap: flags/ok, the four
+    fingerprints, rv, and the identity strings (type/namespace/name)
+    resolve individually from the batch arrays — the C parser already
+    downgraded escape-carrying records, so `flags` is authoritative
+    without scanning any string. Everything else triggers one full
+    materialization pass (a survivor will need most fields anyway, and a
+    single slicing loop beats eleven lazy slices)."""
 
     def __init__(self, batch: ParsedBatch, i: int):
         self._b = batch
@@ -238,6 +241,10 @@ class _LazyRecord:
         "type", "namespace", "name", "node_name", "phase", "pod_ip",
         "host_ip", "creation",
     )
+    # identity strings the echo-drop path touches; decoded singly so a
+    # dropped record never pays the full 11-field pass
+    _CHEAP_STR = {"type": 0, "namespace": 1, "name": 2}
+    _FP_FIELDS = ("fp_status", "fp_status_nc", "fp_spec", "fp_meta_sel")
 
     def _materialize(self) -> None:
         b = self._b
@@ -245,39 +252,59 @@ class _LazyRecord:
         base = i * _REC_STRINGS
         off = b.off
         buf = b.buf
-        flag = b.flags_arr[i]
         d = self.__dict__
         for j, fname in enumerate(self._STR_FIELDS):
-            raw = buf[off[base + j]: off[base + j + 1]]
-            if b"\\" in raw:
-                flag &= ~REC_OK
-            d[fname] = raw.decode("utf-8", "surrogateescape")
-        for j, fname in ((8, "containers"), (9, "init_containers"),
-                        (10, "true_conditions")):
-            raw = buf[off[base + j]: off[base + j + 1]]
-            if b"\\" in raw:
-                flag &= ~REC_OK
-                flag &= ~REC_STATUS_SCALAR_ONLY
-            d[fname] = raw
+            d[fname] = buf[off[base + j]: off[base + j + 1]].decode(
+                "utf-8", "surrogateescape"
+            )
+        d["containers"] = buf[off[base + 8]: off[base + 9]]
+        d["init_containers"] = buf[off[base + 9]: off[base + 10]]
+        d["true_conditions"] = buf[off[base + 10]: off[base + 11]]
+        flag = b.flags_arr[i]
         d["flags"] = flag
-        d["fp_status"] = b.fp[0][i]
-        d["fp_status_nc"] = b.fp[1][i]
-        d["fp_spec"] = b.fp[2][i]
-        d["fp_meta_sel"] = b.fp[3][i]
+        d["ok"] = bool(flag & REC_OK)
+        fp = b.fp
+        d["fp_status"] = fp[0][i]
+        d["fp_status_nc"] = fp[1][i]
+        d["fp_spec"] = fp[2][i]
+        d["fp_meta_sel"] = fp[3][i]
         d["rv"] = b.rvs[i]
 
     def __getattr__(self, name: str):
-        if name == "raw":
-            v = bytes(self._b.lines[self._i])
-            self.__dict__["raw"] = v
+        b = self._b
+        i = self._i
+        d = self.__dict__
+        if name == "flags":
+            d["flags"] = v = b.flags_arr[i]
             return v
         if name == "ok":
-            return bool(self.flags & REC_OK)
+            d["ok"] = v = bool(b.flags_arr[i] & REC_OK)
+            return v
+        j = self._CHEAP_STR.get(name)
+        if j is not None:
+            base = i * _REC_STRINGS
+            d[name] = v = b.buf[b.off[base + j]: b.off[base + j + 1]].decode(
+                "utf-8", "surrogateescape"
+            )
+            return v
+        if name in self._FP_FIELDS:
+            fp = b.fp
+            d["fp_status"] = fp[0][i]
+            d["fp_status_nc"] = fp[1][i]
+            d["fp_spec"] = fp[2][i]
+            d["fp_meta_sel"] = fp[3][i]
+            return d[name]
+        if name == "rv":
+            d["rv"] = v = b.rvs[i]
+            return v
+        if name == "raw":
+            d["raw"] = v = bytes(b.lines[i])
+            return v
         if name.startswith("_"):
             raise AttributeError(name)
         self._materialize()
         try:
-            return self.__dict__[name]
+            return d[name]
         except KeyError:
             raise AttributeError(name) from None
 
@@ -377,25 +404,16 @@ class EventParser:
         buf = self._buf
         flags = int(self._flags[0])
 
+        # escape downgrades (REC_OK / REC_STATUS_SCALAR_ONLY cleared for
+        # escape-carrying fields) happen in kwok_parse_events (ABI 5) —
+        # ONE authoritative copy of the rule, shared with the batch path
         def s(i: int) -> str:
-            b = bytes(buf[off[i] : off[i + 1]])
-            if b"\\" in b:
-                # raw JSON string bytes with escapes: routing strings must
-                # match Python-decoded values, so force the slow path
-                nonlocal flags
-                flags &= ~REC_OK
-            return b.decode("utf-8", "surrogateescape")
+            return bytes(buf[off[i] : off[i + 1]]).decode(
+                "utf-8", "surrogateescape"
+            )
 
         def blob(i: int) -> bytes:
-            b = bytes(buf[off[i] : off[i + 1]])
-            if b"\\" in b:
-                # escaped container/condition strings: the pre-formatted
-                # blob would not match Python-decoded values — the engine's
-                # fast row-init must not trust it
-                nonlocal flags
-                flags &= ~REC_STATUS_SCALAR_ONLY
-                flags &= ~REC_OK
-            return b
+            return bytes(buf[off[i] : off[i + 1]])
 
         return EventRecord(
             s(0), s(1), s(2), s(3), s(4), s(5), s(6), s(7),

@@ -709,6 +709,9 @@ int64_t kwok_parse_events(
       put_bytes(cs[j].second.p, cs[j].second.n);
     }
   };
+  auto has_esc = [](const Span& s) {
+    return s.p && s.n > 0 && memchr(s.p, '\\', (size_t)s.n) != nullptr;
+  };
   for (int32_t i = 0; i < n; i++) {
     Event ev;
     parse_event(blob + off[i], off[i + 1] - off[i], ev);
@@ -717,10 +720,30 @@ int64_t kwok_parse_events(
     fp_spec[i] = ev.fp_spec;
     fp_meta_sel[i] = ev.fp_meta_sel;
     rv_out[i] = ev.rv;
-    flags[i] = (uint8_t)(ev.ok | (ev.has_deletion << 1) |
-                         (ev.has_finalizers << 2) |
-                         (ev.has_readiness_gates << 3) |
-                         (ev.status_scalar_only << 4));
+    // JSON escapes in any extracted string downgrade the record: the
+    // fast path ships raw token bytes, which would mis-render escaped
+    // values (the Python side used to re-scan every field for this;
+    // doing it here keeps `flags` authoritative so echo-dropped events
+    // never materialize their strings at all). Escapes in the container/
+    // condition blobs additionally invalidate the scalar-status claim.
+    bool esc_str = has_esc(ev.type) || has_esc(ev.ns) || has_esc(ev.name) ||
+                   has_esc(ev.node_name) || has_esc(ev.phase) ||
+                   has_esc(ev.pod_ip) || has_esc(ev.host_ip) ||
+                   has_esc(ev.creation);
+    bool esc_blob = false;
+    for (const auto& pr : ev.containers)
+      esc_blob = esc_blob || has_esc(pr.first) || has_esc(pr.second);
+    for (const auto& pr : ev.init_containers)
+      esc_blob = esc_blob || has_esc(pr.first) || has_esc(pr.second);
+    for (const auto& s : ev.true_conditions)
+      esc_blob = esc_blob || has_esc(s);
+    uint8_t f = (uint8_t)(ev.ok | (ev.has_deletion << 1) |
+                          (ev.has_finalizers << 2) |
+                          (ev.has_readiness_gates << 3) |
+                          (ev.status_scalar_only << 4));
+    if (esc_str || esc_blob) f = (uint8_t)(f & ~1u);
+    if (esc_blob) f = (uint8_t)(f & ~16u);
+    flags[i] = f;
     int64_t base = (int64_t)i * 11;
     put(ev.type, base + 0);
     put(ev.ns, base + 1);

@@ -305,6 +305,7 @@ def test_drain_raw_batch_flushes_before_non_raw_items():
     try:
         applied: list[tuple[str, str]] = []
         orig = eng._ingest_safe
+        orig_rec = eng._ingest_record
 
         def spy(kind, type_, obj):
             name = ""
@@ -315,7 +316,13 @@ def test_drain_raw_batch_flushes_before_non_raw_items():
             applied.append((type_, name))
             return orig(kind, type_, obj)
 
+        def spy_rec(kind, rec):
+            # the batched drain calls _ingest_record directly (hot loop)
+            applied.append(("REC", rec.name))
+            return orig_rec(kind, rec)
+
         eng._ingest_safe = spy
+        eng._ingest_record = spy_rec
 
         def line(name):
             return _json.dumps({

@@ -142,3 +142,31 @@ def test_endurance_smoke():
     assert result["pass"], result
     assert result["epoch_rebases"] >= 2
     assert result["heartbeat_delivery"] >= 0.99
+
+
+def test_cost_model_smoke():
+    """benchmarks/cost_model.py (VERDICT r4 #3): the per-process cost
+    tables + pods/s-vs-cores curve must produce sane, structured output.
+    Small sizes — this pins the machinery, not the numbers."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "cost_model.py"),
+         "--events", "2000", "--trials", "2"],
+        capture_output=True, text=True, timeout=300, check=True, env=env,
+    )
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["engine"]["survivor_added_us"] > 0
+    assert d["engine"]["echo_modified_us"] > 0
+    # the echo drop must stay cheaper than full ingest
+    assert d["engine"]["echo_modified_us"] < d["engine"]["survivor_added_us"]
+    assert d["apiserver"]["create_pod_us"] > 0
+    assert d["apiserver"]["poll_running_count_us"] > 0
+    curve = d["model"]["predicted_pods_per_s_by_cores"]
+    assert curve["1"] > 0 and curve["4"] >= curve["1"]
+    assert d["model"]["per_pod_us"]["total_1core"] > 0
