@@ -1,0 +1,73 @@
+"""Weighted-Pallas hardware check: the Mosaic lowering of the weighted
+rule draw, exercised on the REAL chip.
+
+Interpret-mode tests (tests/test_weight.py) pin the weighted-draw
+semantics but prove nothing about Mosaic lowering — the bug class that
+bit three times in round 4 (i1 carries, sub-tile outputs, SMEM scalar
+broadcasts) only appears on hardware. This check runs an 8192-row 1:3
+weighted table through PallasTickKernel on the default device and
+verifies the empirical distribution at 5 sigma. Wired into
+hack/tpu-recapture.sh so every on-chip recapture re-proves the lowering.
+
+Prints ONE JSON line; exit 0 on pass, 1 on distribution failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from kwok_tpu.models import compile_rules
+    from kwok_tpu.models.lifecycle import (
+        Delay,
+        LifecycleRule,
+        ResourceKind,
+        StatusEffect,
+    )
+    from kwok_tpu.ops import new_row_state
+    from kwok_tpu.ops.pallas_tick import PallasTickKernel
+    from kwok_tpu.ops.tick import to_device, to_host
+
+    platform = jax.devices()[0].platform
+    rules = [
+        LifecycleRule(
+            name=f"w{i}", resource=ResourceKind.POD,
+            from_phases=("Pending",), effect=StatusEffect(to_phase=to),
+            delay=Delay.constant(0.0), weight=w,
+        )
+        for i, (w, to) in enumerate([(1, "Running"), (3, "Succeeded")])
+    ]
+    table = compile_rules(rules, ResourceKind.POD)
+    n = 8192
+    s = new_row_state(n)
+    s.active[:] = True
+    s.sel_bits[:] = 0b11
+    kern = PallasTickKernel(table, interpret=platform == "cpu")
+    out = to_host(kern(to_device(s), now=0.0))
+    run = int((out.state.phase == table.space.phase_id("Running")).sum())
+    suc = int((out.state.phase == table.space.phase_id("Succeeded")).sum())
+    sigma = (n * 0.25 * 0.75) ** 0.5
+    ok = (run + suc == n) and abs(run - 0.25 * n) < 5 * sigma
+    print(json.dumps({
+        "metric": (
+            f"pallas weighted draw on {platform}: 1:3 weights at {n} rows"
+        ),
+        "running": run,
+        "succeeded": suc,
+        "expected_running": n // 4,
+        "five_sigma": round(5 * sigma, 1),
+        "pass": ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

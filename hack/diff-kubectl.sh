@@ -68,5 +68,10 @@ compare "get pods -o name"          get pods -o name
 compare "get node missing"          get node nope
 compare "get pods empty -o json"    get pods -n empty-ns -o json
 compare "get no-headers"            get nodes --no-headers
+compare "get nodes -o wide"         get nodes -o wide
+compare "get pods -o wide"          get pods -o wide
+compare "describe node"             describe node diff-node
+compare "describe pod"              describe pod diff-pod
+compare "describe pod missing"      describe pod nope
 
 exit "${fail}"

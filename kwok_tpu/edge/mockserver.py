@@ -161,6 +161,12 @@ class FakeKube:
         # (resume -> 410 Gone, like etcd compaction under the real
         # apiserver)
         self._history: collections.deque = collections.deque()
+        # undo log: (rv, kind, key, prev_bytes|None) — each write's state
+        # BEFORE the event, same window as the watch cache. Lets a
+        # paginated LIST serve continuation pages from a consistent
+        # snapshot at the continue token's revision (what the real
+        # apiserver reads from etcd MVCC) by rolling the live view back.
+        self._undo: collections.deque = collections.deque()
         self._compacted_rv = 0
         # observability for tests
         self.patch_count = 0
@@ -176,6 +182,16 @@ class FakeKube:
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
         if kind is not None:
             self._json[kind].pop(key, None)
+
+    def _undo_push(self, kind: str, key, prev: bytes | None) -> None:
+        """Record a write's pre-state (caller holds the lock, called right
+        after _bump so self._rv is the event's revision). prev=None means
+        the key did not exist before the event."""
+        if RV_WINDOW <= 0:
+            return
+        self._undo.append((self._rv, kind, key, prev))
+        while self._undo and self._undo[0][0] <= self._compacted_rv:
+            self._undo.popleft()
 
     def _obj_bytes(self, kind: str, key) -> bytes | None:
         """Serialized form of a stored object (caller holds the lock)."""
@@ -220,6 +236,7 @@ class FakeKube:
         the real apiserver compacts every 5 minutes.)"""
         with self._lock:
             self._history.clear()
+            self._undo.clear()
             self._compacted_rv = self._rv
             return self._compacted_rv
 
@@ -277,6 +294,7 @@ class FakeKube:
             # the real apiserver never overwrites on create (HTTP 409)
             raise AlreadyExists(f'{kind} "{meta["name"]}" already exists')
         self._bump(obj, kind, key)
+        self._undo_push(kind, key, None)
         self._store[kind][key] = obj
         self._emit(kind, ADDED, obj, key=key)
         if (
@@ -295,12 +313,14 @@ class FakeKube:
             old_key = min(
                 evs, key=lambda k: int(evs[k]["metadata"]["resourceVersion"])
             )
+            old_bytes = self._obj_bytes(kind, old_key)
             old = evs.pop(old_key)
             self._json[kind].pop(old_key, None)
             # deletion is a write: bump like the explicit DELETE path, so
             # the DELETED event gets its own revision (rv-resuming watchers
             # would otherwise never see the eviction)
             self._bump(old)
+            self._undo_push(kind, old_key, old_bytes)
             self._emit(kind, DELETED, old, key=old_key)
         return key
 
@@ -331,8 +351,10 @@ class FakeKube:
                 raise BindConflict(
                     f'pod {name} is already assigned to node {current}'
                 )
+            prev = self._obj_bytes("pods", key)
             spec["nodeName"] = node
             self._bump(obj, "pods", key)
+            self._undo_push("pods", key, prev)
             self._emit("pods", MODIFIED, obj, key=key)
             return copy.deepcopy(obj)
 
@@ -343,7 +365,9 @@ class FakeKube:
             key = self._key(meta.get("namespace"), meta.get("name"))
             if key not in self._store[kind]:
                 raise KeyError(key)
+            prev = self._obj_bytes(kind, key)
             self._bump(obj, kind, key)
+            self._undo_push(kind, key, prev)
             self._store[kind][key] = obj
             self._emit(kind, MODIFIED, obj, key=key)
             return copy.deepcopy(obj)
@@ -384,19 +408,19 @@ class FakeKube:
         paginating expires it (raises WatchExpired -> HTTP 410, the real
         apiserver's "continue token too old" contract).
 
-        KNOWN DIVERGENCE: continuation pages list the LIVE store, not a
-        snapshot at the token's revision (the real apiserver serves a
-        consistent snapshot at the continue revision from etcd). An object
-        created mid-pagination whose key sorts before the cursor is
-        omitted from that list entirely; one sorting after it appears even
-        though it postdates page 1. The rv inside the token is used ONLY
-        for expiry — do not read it as snapshot consistency. The engine is
-        shielded because it registers its watch before listing (the
-        RESYNC marker covers anything a paginated list misses)."""
+        Continuation pages serve a CONSISTENT SNAPSHOT at the token's
+        revision (what the real apiserver reads from etcd MVCC): the live
+        view is rolled back through the undo log, so an object created
+        mid-pagination is excluded no matter where its key sorts, one
+        deleted mid-pagination still appears, and every page reports the
+        first page's resourceVersion. With the watch cache disabled
+        (RV_WINDOW <= 0) there is no undo log and continuation pages fall
+        back to the live view."""
         sel = parse_selector(label_selector)
         with self._lock:
-            keys = sorted(self._store[kind].keys())
+            live = self._store[kind]
             list_rv = self._rv
+            overlay: dict = {}
             if continue_:
                 # opaque url-safe token (the real apiserver's continue is
                 # base64 too): rv \0 ns \0 name
@@ -419,8 +443,37 @@ class FakeKube:
                     )
                 list_rv = rv_val  # consistency marker of page 1
                 last = (ns, name)
-                # binary search would be nicer; linear is fine at mock scale
-                keys = [k for k in keys if k > last]
+                # roll the live view back to the token's revision:
+                # newest-to-oldest, so a key's final overlay value is the
+                # prev of its EARLIEST post-token event = its state at
+                # the token revision (None = absent then)
+                for rv_u, k_u, key_u, prev in reversed(self._undo):
+                    if rv_u <= rv_val:
+                        break
+                    if k_u == kind:
+                        overlay[key_u] = prev
+                view = set(live.keys())
+                for k_, prev in overlay.items():
+                    if prev is None:
+                        view.discard(k_)
+                    else:
+                        view.add(k_)
+                keys = sorted(k_ for k_ in view if k_ > last)
+            else:
+                keys = sorted(live.keys())
+
+            def view_obj(key):
+                prev = overlay.get(key)
+                if prev is not None:
+                    return json.loads(prev)
+                return live[key]
+
+            def view_bytes(key):
+                prev = overlay.get(key)
+                if prev is not None:
+                    return prev
+                return self._obj_bytes(kind, key)
+
             chunks: list[bytes] = []
             token = ""
             remaining = 0
@@ -431,7 +484,7 @@ class FakeKube:
             for pos, key in enumerate(keys):
                 if limit and len(chunks) >= limit and not count_rest:
                     break
-                obj = self._store[kind][key]
+                obj = view_obj(key)
                 if not match_field_selector(obj, field_selector):
                     continue
                 if sel is not None:
@@ -441,12 +494,14 @@ class FakeKube:
                 if limit and len(chunks) >= limit:
                     remaining += 1
                     continue
-                chunks.append(self._obj_bytes(kind, key))
+                chunks.append(view_bytes(key))
                 if limit and len(chunks) >= limit and pos + 1 < len(keys):
                     token = base64.urlsafe_b64encode(
                         f"{list_rv}\x00{key[0]}\x00{key[1]}".encode()
                     ).decode()
-            rv = str(self._rv)
+            # every page of one paginated list reports page 1's revision
+            # (the real apiserver's paged LIST contract)
+            rv = str(list_rv)
         meta = f'{{"resourceVersion":"{rv}"'.encode()
         if token and (remaining if count_rest else True):
             meta += b',"continue":' + json.dumps(token).encode()
@@ -513,9 +568,11 @@ class FakeKube:
         obj = self._store[kind].get(key)
         if obj is None:
             return None
+        prev = self._obj_bytes(kind, key)
         status = obj.get("status") or {}
         obj["status"] = strategic_merge(status, patch.get("status", patch))
         self._bump(obj, kind, key)
+        self._undo_push(kind, key, prev)
         self.patch_count += 1
         self._emit(kind, MODIFIED, obj, key=key)
         return obj
@@ -556,6 +613,7 @@ class FakeKube:
         obj = self._store[kind].get(key)
         if obj is None:
             return None
+        prev = self._obj_bytes(kind, key)
         for section in ("metadata", "spec"):
             sec_patch = (patch or {}).get(section)
             if not sec_patch:
@@ -567,6 +625,7 @@ class FakeKube:
                 else:
                     sec[k] = copy.deepcopy(v)
         self._bump(obj, kind, key)
+        self._undo_push(kind, key, prev)
         self._emit(kind, MODIFIED, obj, key=key)
         return obj
 
@@ -599,6 +658,7 @@ class FakeKube:
             # history predates the restore: compact so resumed watches and
             # continue tokens from the old world get 410 and re-list
             self._history.clear()
+            self._undo.clear()
             self._compacted_rv = self._rv
             watches, self._watches = self._watches, []
         for w in watches:
@@ -634,6 +694,7 @@ class FakeKube:
                         "terminationGracePeriodSeconds"
                     )
                     grace_seconds = int(tgps) if tgps is not None else 30
+            prev = self._obj_bytes(kind, key)
             meta = obj.setdefault("metadata", {})
             finalizers = meta.get("finalizers") or []
             if kind == "pods" and (grace_seconds > 0 or finalizers):
@@ -643,12 +704,14 @@ class FakeKube:
                     meta["deletionTimestamp"] = now_rfc3339()
                 meta["deletionGracePeriodSeconds"] = grace_seconds
                 self._bump(obj, kind, key)
+                self._undo_push(kind, key, prev)
                 self._emit(kind, MODIFIED, obj, key=key)
                 return
             del self._store[kind][key]
             self._json[kind].pop(key, None)
             self.delete_count += 1
             self._bump(obj)
+            self._undo_push(kind, key, prev)
             self._emit(kind, DELETED, obj, key=key)
 
 

@@ -69,6 +69,15 @@ def _age(obj: dict) -> str:
     return f"{secs}s"
 
 
+def _node_roles(o: dict) -> str:
+    roles = sorted(
+        k.split("/", 1)[1]
+        for k in (o.get("metadata") or {}).get("labels") or {}
+        if k.startswith("node-role.kubernetes.io/")
+    )
+    return ",".join(r for r in roles if r) or "<none>"
+
+
 def _node_row(o: dict) -> list[str]:
     conds = {
         c.get("type"): c.get("status")
@@ -76,6 +85,24 @@ def _node_row(o: dict) -> list[str]:
     }
     status = "Ready" if conds.get("Ready") == "True" else "NotReady"
     return [o["metadata"]["name"], status, _age(o)]
+
+
+def _node_row_wide(o: dict) -> list[str]:
+    st = o.get("status") or {}
+    info = st.get("nodeInfo") or {}
+    addrs = {
+        a.get("type"): a.get("address") for a in st.get("addresses") or []
+    }
+    return [
+        *_node_row(o),
+        _node_roles(o),
+        info.get("kubeletVersion") or "<unknown>",
+        addrs.get("InternalIP") or "<none>",
+        addrs.get("ExternalIP") or "<none>",
+        info.get("osImage") or "<unknown>",
+        info.get("kernelVersion") or "<unknown>",
+        info.get("containerRuntimeVersion") or "<unknown>",
+    ]
 
 
 def _pod_row(o: dict) -> list[str]:
@@ -87,6 +114,29 @@ def _pod_row(o: dict) -> list[str]:
     if (o.get("metadata") or {}).get("deletionTimestamp"):
         phase = "Terminating"
     return [o["metadata"]["name"], f"{ready}/{total}", phase, _age(o)]
+
+
+def _pod_row_wide(o: dict) -> list[str]:
+    st = o.get("status") or {}
+    gates = (o.get("spec") or {}).get("readinessGates") or []
+    if gates:
+        conds = {
+            c.get("type"): c.get("status")
+            for c in st.get("conditions") or []
+        }
+        gates_ok = sum(
+            1 for g in gates if conds.get(g.get("conditionType")) == "True"
+        )
+        gates_cell = f"{gates_ok}/{len(gates)}"
+    else:
+        gates_cell = "<none>"
+    return [
+        *_pod_row(o),
+        st.get("podIP") or "<none>",
+        (o.get("spec") or {}).get("nodeName") or "<none>",
+        st.get("nominatedNodeName") or "<none>",
+        gates_cell,
+    ]
 
 
 def _event_row(o: dict) -> list[str]:
@@ -110,10 +160,19 @@ def _event_row(o: dict) -> list[str]:
 
 
 def _print_table(kind: str, objs: list[dict], *, all_namespaces: bool,
-                 no_headers: bool, out=None) -> None:
+                 no_headers: bool, out=None, wide: bool = False) -> None:
     out = out if out is not None else sys.stdout
-    if kind == "nodes":
+    if kind == "nodes" and wide:
+        headers = ["NAME", "STATUS", "AGE", "ROLES", "VERSION",
+                   "INTERNAL-IP", "EXTERNAL-IP", "OS-IMAGE",
+                   "KERNEL-VERSION", "CONTAINER-RUNTIME"]
+        row = _node_row_wide
+    elif kind == "nodes":
         headers, row = ["NAME", "STATUS", "AGE"], _node_row
+    elif kind == "pods" and wide:
+        headers = ["NAME", "READY", "STATUS", "AGE", "IP", "NODE",
+                   "NOMINATED NODE", "READINESS GATES"]
+        row = _pod_row_wide
     elif kind == "pods":
         headers, row = ["NAME", "READY", "STATUS", "AGE"], _pod_row
     elif kind == "events":
@@ -185,7 +244,7 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("-n", "--namespace", default=None)
     g.add_argument("-A", "--all-namespaces", action="store_true")
     g.add_argument("-o", "--output", default="",
-                   choices=["", "json", "name"])
+                   choices=["", "json", "name", "wide"])
     g.add_argument("--no-headers", action="store_true")
     g.add_argument("-w", "--watch", action="store_true",
                    help="after listing, stream a row per watch event")
@@ -193,6 +252,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="stream events without the initial list")
     g.add_argument("--request-timeout", default="0",
                    help='bound the watch, e.g. "5s" (0 = no bound)')
+
+    ds = sub.add_parser("describe")
+    ds.add_argument("args", nargs="+", help="KIND [NAME...] | KIND/NAME")
+    ds.add_argument("-n", "--namespace", default=None)
 
     w = sub.add_parser("wait")
     w.add_argument("args", nargs="+", help="KIND/NAME | KIND NAME...")
@@ -254,7 +317,7 @@ def _emit_watch_row(kind, obj, args) -> None:
         # prints headers once (unless --no-headers/--watch-only)
         _print_table(
             kind, [obj], all_namespaces=args.all_namespaces,
-            no_headers=True,
+            no_headers=True, wide=args.output == "wide",
         )
     sys.stdout.flush()
 
@@ -368,6 +431,240 @@ def _get_watch(args, client, kind, ns, name, start_rv=None) -> int:
                 pass
 
 
+def _kv_block(d: dict | None) -> str:
+    if not d:
+        return "<none>"
+    return ",".join(f"{k}={v}" for k, v in sorted(d.items()))
+
+
+def _events_for(events: list[dict], kind: str, ns: str | None,
+                name: str) -> list[dict]:
+    """Events whose involvedObject matches, from a PRE-FETCHED list
+    (client-side filter: the mock servers store events but do not index
+    them; one fetch serves every described object)."""
+    want_kind = {"nodes": "Node", "pods": "Pod"}.get(kind, "")
+    out = []
+    for ev in events:
+        obj = ev.get("involvedObject") or ev.get("regarding") or {}
+        if (obj.get("kind") or "") != want_kind:
+            continue
+        if (obj.get("name") or "") != name:
+            continue
+        if ns and (obj.get("namespace") or "default") != ns:
+            continue
+        out.append(ev)
+    return out
+
+
+def _events_section(events: list[dict]) -> list[str]:
+    if not events:
+        return ["Events:              <none>"]
+    lines = ["Events:",
+             "  Type     Reason     Age    From     Message",
+             "  ----     ------     ----   ----     -------"]
+    for ev in events:
+        lines.append(
+            "  {:<8} {:<10} {:<6} {:<8} {}".format(
+                ev.get("type") or "Normal",
+                ev.get("reason") or "",
+                _age({"metadata": {"creationTimestamp":
+                                   ev.get("lastTimestamp")
+                                   or ev.get("eventTime")}}),
+                ((ev.get("source") or {}).get("component")
+                 or ev.get("reportingController") or ""),
+                (ev.get("message") or ev.get("note") or "").replace(
+                    "\n", " "),
+            ).rstrip()
+        )
+    return lines
+
+
+def _describe_node(events: list[dict], o: dict) -> str:
+    meta = o.get("metadata") or {}
+    st = o.get("status") or {}
+    info = st.get("nodeInfo") or {}
+    taints = (o.get("spec") or {}).get("taints") or []
+    taints_cell = ",".join(
+        f"{t.get('key')}:{t.get('effect')}" for t in taints
+    ) or "<none>"
+    lines = [
+        f"Name:               {meta.get('name')}",
+        f"Roles:              {_node_roles(o)}",
+        f"Labels:             {_kv_block(meta.get('labels'))}",
+        f"Annotations:        {_kv_block(meta.get('annotations'))}",
+        f"CreationTimestamp:  {meta.get('creationTimestamp') or '<unknown>'}",
+        f"Taints:             {taints_cell}",
+        f"Unschedulable:      "
+        f"{str(bool((o.get('spec') or {}).get('unschedulable'))).lower()}",
+    ]
+    conds = st.get("conditions") or []
+    if conds:
+        lines.append("Conditions:")
+        rows = [["Type", "Status", "LastHeartbeatTime",
+                 "LastTransitionTime", "Reason", "Message"],
+                ["----", "------", "-----------------",
+                 "------------------", "------", "-------"]]
+        for c in conds:
+            rows.append([
+                c.get("type") or "", c.get("status") or "",
+                c.get("lastHeartbeatTime") or "",
+                c.get("lastTransitionTime") or "",
+                c.get("reason") or "", c.get("message") or "",
+            ])
+        widths = [max(len(r[i]) for r in rows) for i in range(6)]
+        for r in rows:
+            lines.append(
+                "  " + "  ".join(
+                    c.ljust(w) for c, w in zip(r, widths)
+                ).rstrip()
+            )
+    addrs = st.get("addresses") or []
+    if addrs:
+        lines.append("Addresses:")
+        for a in addrs:
+            lines.append(f"  {a.get('type')}:  {a.get('address')}")
+    for section, key in (("Capacity", "capacity"),
+                         ("Allocatable", "allocatable")):
+        vals = st.get(key) or {}
+        if vals:
+            lines.append(f"{section}:")
+            width = max(len(k) for k in vals) + 1
+            for k in sorted(vals):
+                lines.append(f"  {k + ':':<{width}}  {vals[k]}")
+    if info:
+        lines.append("System Info:")
+        for label, key in (
+            ("Machine ID", "machineID"),
+            ("Kernel Version", "kernelVersion"),
+            ("OS Image", "osImage"),
+            ("Operating System", "operatingSystem"),
+            ("Architecture", "architecture"),
+            ("Container Runtime Version", "containerRuntimeVersion"),
+            ("Kubelet Version", "kubeletVersion"),
+        ):
+            if info.get(key):
+                lines.append(f"  {label + ':':<27} {info[key]}")
+    lines += _events_section(
+        _events_for(events, "nodes", None, meta.get("name") or "")
+    )
+    return "\n".join(lines)
+
+
+def _describe_pod(events: list[dict], o: dict) -> str:
+    meta = o.get("metadata") or {}
+    spec = o.get("spec") or {}
+    st = o.get("status") or {}
+    ns = meta.get("namespace") or "default"
+    node_cell = spec.get("nodeName") or "<none>"
+    if st.get("hostIP"):
+        node_cell = f"{node_cell}/{st['hostIP']}"
+    phase = st.get("phase") or "Unknown"
+    if meta.get("deletionTimestamp"):
+        phase = "Terminating"
+    lines = [
+        f"Name:         {meta.get('name')}",
+        f"Namespace:    {ns}",
+        f"Node:         {node_cell}",
+        f"Start Time:   {st.get('startTime') or '<unknown>'}",
+        f"Labels:       {_kv_block(meta.get('labels'))}",
+        f"Annotations:  {_kv_block(meta.get('annotations'))}",
+        f"Status:       {phase}",
+        f"IP:           {st.get('podIP') or '<none>'}",
+    ]
+    statuses = {
+        c.get("name"): c for c in st.get("containerStatuses") or []
+    }
+    containers = spec.get("containers") or []
+    if containers:
+        lines.append("Containers:")
+        for c in containers:
+            cs = statuses.get(c.get("name")) or {}
+            state = cs.get("state") or {}
+            state_name = next(iter(state), "waiting").capitalize()
+            lines.append(f"  {c.get('name')}:")
+            lines.append(f"    Image:   {c.get('image') or '<none>'}")
+            lines.append(f"    State:   {state_name}")
+            started = (state.get("running") or {}).get("startedAt")
+            if started:
+                lines.append(f"      Started:  {started}")
+            lines.append(
+                f"    Ready:   {str(bool(cs.get('ready'))).capitalize()}"
+            )
+    conds = st.get("conditions") or []
+    if conds:
+        lines.append("Conditions:")
+        width = max(len(c.get("type") or "") for c in conds) + 2
+        lines.append(f"  {'Type':<{width}}Status")
+        for c in conds:
+            lines.append(
+                f"  {(c.get('type') or ''):<{width}}{c.get('status') or ''}"
+            )
+    lines += _events_section(
+        _events_for(events, "pods", ns, meta.get("name") or "")
+    )
+    return "\n".join(lines)
+
+
+def _describe(args, client) -> int:
+    """`kubectl describe nodes|pods [NAME...]` — the sectioned report the
+    reference's e2e scripts grep (conditions + events), dialect-pinned by
+    goldens + hack/diff-kubectl.sh."""
+    targets: list[tuple[str, str | None, str | None]] = []
+    if "/" in args.args[0]:
+        for a in args.args:
+            kindw, _, nm = a.partition("/")
+            kind = _resolve_kind(kindw)
+            ns = args.namespace or ("default" if _is_namespaced(kind) else None)
+            targets.append((kind, ns, nm))
+    else:
+        kind = _resolve_kind(args.args[0])
+        ns = args.namespace or ("default" if _is_namespaced(kind) else None)
+        names = args.args[1:] or [None]
+        targets = [(kind, ns, nm) for nm in names]
+    render = {"nodes": _describe_node, "pods": _describe_pod}
+    # ONE events fetch serves every described object (describe-all over
+    # hundreds of pods must not re-list the events store per pod)
+    try:
+        all_events = client.list("events")
+    except Exception:
+        all_events = []
+    blocks: list[str] = []
+    rc = 0
+    for kind, ns, nm in targets:
+        fn = render.get(kind)
+        if fn is None:
+            raise SystemExit(
+                f"error: describe is not supported for {kind} "
+                "(nodes and pods only)"
+            )
+        if nm is None:
+            objs = client.list(kind)
+            if _is_namespaced(kind):
+                objs = [
+                    o for o in objs
+                    if ((o.get("metadata") or {}).get("namespace")
+                        or "default") == ns
+                ]
+        else:
+            obj = client.get(kind, ns, nm)
+            if obj is None:
+                print(
+                    f'Error from server (NotFound): {_singular(kind)} '
+                    f'"{nm}" not found',
+                    file=sys.stderr,
+                )
+                rc = 1
+                continue
+            objs = [obj]
+        for o in objs:
+            blocks.append(fn(all_events, o))
+    if blocks:
+        print("\n\n\n".join(blocks))
+    elif rc == 0:
+        print("No resources found", file=sys.stderr)
+    return rc
+
+
 def _condition_met(obj: dict, cond: str, want: str) -> bool:
     for c in (obj.get("status") or {}).get("conditions") or []:
         if (c.get("type") or "").lower() == cond.lower():
@@ -442,6 +739,8 @@ def _wait(args, client: HttpKubeClient) -> int:
 def _run(args, client: HttpKubeClient) -> int:
     if args.verb == "wait":
         return _wait(args, client)
+    if args.verb == "describe":
+        return _describe(args, client)
     if args.verb == "get":
         if args.raw:
             # client._request applies the TLS context, CA, client cert and
@@ -548,6 +847,7 @@ def _run(args, client: HttpKubeClient) -> int:
                     kind, objs,
                     all_namespaces=args.all_namespaces,
                     no_headers=args.no_headers,
+                    wide=args.output == "wide",
                 )
         if watching:
             sys.stdout.flush()

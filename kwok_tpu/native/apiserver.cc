@@ -934,6 +934,17 @@ static bool b64url_decode(const std::string& in, std::string& out) {
   return true;
 }
 
+// Undo record: the entry's state BEFORE the event at `rv` (nullptr =
+// absent). Bounded by the same window as the watch cache, it lets a
+// paginated LIST reconstruct the store as of a continue token's revision
+// — the consistent snapshot the real apiserver reads from etcd MVCC.
+struct Undo {
+  int64_t rv;
+  int kind;
+  Key key;
+  EntryPtr prev;
+};
+
 struct Store {
   std::mutex mu;
   std::map<Key, EntryPtr> kinds[NKINDS];
@@ -942,6 +953,7 @@ struct Store {
   // everything at or below compacted_rv is gone from history: resumes
   // below it answer 410, expired continue tokens too
   std::deque<Hist> history;
+  std::deque<Undo> undo;
   int64_t compacted_rv = 0;
 
   // caller holds mu
@@ -951,16 +963,21 @@ struct Store {
         .set("resourceVersion", JVal::str(std::to_string(rv)));
   }
 
-  // caller holds mu; records the event in the watch cache, then fans out
-  // to matching live watches (the entry's published bytes serialize the
-  // event line once)
-  void emit(int kind, const char* type, const EntryPtr& e) {
+  // caller holds mu; records the event in the watch cache + undo log,
+  // then fans out to matching live watches (the entry's published bytes
+  // serialize the event line once). `prev` is the key's entry BEFORE
+  // this event (nullptr for creates).
+  void emit(int kind, const char* type, const EntryPtr& e, const Key& key,
+            EntryPtr prev) {
     if (rv_window() > 0) {
       history.push_back({rv, kind, type, e});
+      undo.push_back({rv, kind, key, std::move(prev)});
       while ((int)history.size() > rv_window()) {
         compacted_rv = std::max(compacted_rv, history.front().rv);
         history.pop_front();
       }
+      while (!undo.empty() && undo.front().rv <= compacted_rv)
+        undo.pop_front();
     }
     bool any = false;
     for (const auto& w : watches)
@@ -1383,6 +1400,7 @@ void App::restore_load(const JVal& data) {
     // history predates the restore: compact so resumed watches and
     // continue tokens from the old world get 410 and re-list
     store.history.clear();
+    store.undo.clear();
     store.compacted_rv = store.rv;
     old.swap(store.watches);
   }
@@ -1513,6 +1531,7 @@ bool App::handle_request(int fd, Request& req) {
     {
       std::lock_guard<std::mutex> lk(store.mu);
       store.history.clear();
+      store.undo.clear();
       store.compacted_rv = store.rv;
       crv = store.compacted_rv;
     }
@@ -1709,17 +1728,58 @@ bool App::handle_request(int fd, Request& req) {
         Key last{rest.substr(0, nul),
                  nul == std::string::npos ? "" : rest.substr(nul + 1)};
         it = kindmap.upper_bound(last);
-      }
-      snap.reserve(std::min(kindmap.size(), snap_cap));
-      for (; it != kindmap.end(); ++it) {
-        if (snap.size() >= snap_cap) {
-          more_after = true;
-          break;
+        // Consistent snapshot at the token's revision (what the real
+        // apiserver reads from etcd MVCC): roll the live view back by
+        // overlaying each affected key's state BEFORE its first event
+        // after token_rv. Newest-to-oldest walk, so the final overlay
+        // value for a key is the prev of its EARLIEST post-token event
+        // — exactly its state at the token revision. Window guarantees:
+        // token_rv >= compacted_rv (checked above), so every later
+        // event is still in the undo deque. rv_window()==0 disables
+        // the cache entirely and keeps the old live-view behavior.
+        std::map<Key, EntryPtr> overlay;
+        for (auto u = store.undo.rbegin(); u != store.undo.rend(); ++u) {
+          if (u->rv <= token_rv) break;
+          if (u->kind != m.kind) continue;
+          overlay[u->key] = u->prev;
         }
-        snap.push_back(it->second);
+        auto ov = overlay.upper_bound(last);
+        snap.reserve(std::min(kindmap.size(), snap_cap));
+        while (it != kindmap.end() || ov != overlay.end()) {
+          if (snap.size() >= snap_cap) {
+            more_after = true;
+            break;
+          }
+          bool use_ov;
+          if (ov == overlay.end()) use_ov = false;
+          else if (it == kindmap.end()) use_ov = true;
+          else if (ov->first < it->first) use_ov = true;
+          else if (it->first < ov->first) use_ov = false;
+          else {  // same key: the snapshot's state wins over the live one
+            use_ov = true;
+            ++it;
+          }
+          if (use_ov) {
+            if (ov->second) snap.push_back(ov->second);
+            ++ov;
+          } else {
+            snap.push_back(it->second);
+            ++it;
+          }
+        }
+        rv_now = token_rv;  // pages of one list share page 1's revision
+      } else {
+        snap.reserve(std::min(kindmap.size(), snap_cap));
+        for (; it != kindmap.end(); ++it) {
+          if (snap.size() >= snap_cap) {
+            more_after = true;
+            break;
+          }
+          snap.push_back(it->second);
+        }
+        rv_now = store.rv;
+        token_rv = rv_now;  // first page stamps its revision
       }
-      rv_now = store.rv;
-      if (!token_rv) token_rv = rv_now;  // first page stamps its revision
     }
     // The continue token is rebuilt from the entry's own (immutable)
     // metadata — map keys may be erased concurrently once the lock drops.
@@ -1815,8 +1875,9 @@ bool App::handle_request(int fd, Request& req) {
           spec.set("nodeName", JVal::str(node));
           store.bump(obj);
           EntryPtr e = publish(std::move(obj));
+          EntryPtr prev = it->second;
           it->second = e;
-          store.emit(1, "MODIFIED", e);
+          store.emit(1, "MODIFIED", e, key, std::move(prev));
         }
       }
     }
@@ -1891,7 +1952,7 @@ bool App::handle_request(int fd, Request& req) {
         store.bump(obj);
         e = publish(std::move(obj));
         store.kinds[m.kind][k] = e;
-        store.emit(m.kind, "ADDED", e);
+        store.emit(m.kind, "ADDED", e, k, nullptr);
         if (m.kind == kind_index("events") && events_cap() > 0) {
           auto& evs = store.kinds[m.kind];
           while ((int)evs.size() > events_cap()) {
@@ -1915,9 +1976,12 @@ bool App::handle_request(int fd, Request& req) {
             // so the DELETED event gets its own revision (rv-resuming
             // watchers would otherwise never see the eviction)
             JVal vobj = victim->second->obj;  // copy-on-write
+            Key vkey = victim->first;
+            EntryPtr vprev = victim->second;
             evs.erase(victim);
             store.bump(vobj);
-            store.emit(m.kind, "DELETED", publish(std::move(vobj)));
+            store.emit(m.kind, "DELETED", publish(std::move(vobj)), vkey,
+                       std::move(vprev));
           }
         }
       }
@@ -1980,8 +2044,9 @@ bool App::handle_request(int fd, Request& req) {
         }
         store.bump(obj);
         EntryPtr e = publish(std::move(obj));
+        EntryPtr prev = it->second;
         it->second = e;
-        store.emit(m.kind, "MODIFIED", e);
+        store.emit(m.kind, "MODIFIED", e, key, std::move(prev));
         body = e->bytes;
       }
     }
@@ -2029,13 +2094,15 @@ bool App::handle_request(int fd, Request& req) {
                    JVal::num_raw(std::to_string(grace)));
           store.bump(obj);
           EntryPtr e = publish(std::move(obj));
+          EntryPtr prev = it->second;
           it->second = e;
-          store.emit(m.kind, "MODIFIED", e);
+          store.emit(m.kind, "MODIFIED", e, key, std::move(prev));
         } else {
+          EntryPtr prev = it->second;
           store.kinds[m.kind].erase(it);
           store.bump(obj);
           EntryPtr de = publish(std::move(obj));
-          store.emit(m.kind, "DELETED", de);
+          store.emit(m.kind, "DELETED", de, key, std::move(prev));
         }
       }
     }

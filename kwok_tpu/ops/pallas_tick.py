@@ -6,11 +6,13 @@ the scan carry round-trips the full SoA row state through HBM every step —
 ~60 MB per step at 1M rows, ~7 GB per dispatch at K=120 (~9 ms of HBM
 traffic at v5e bandwidth). This kernel instead grids over row blocks and
 keeps each block in VMEM across ALL K substeps: one HBM read + one write
-per row per dispatch, K× less state traffic. On the tunneled bench chip the
-dispatch RTT (~70 ms) dwarfs that 9 ms, so this is OPT-IN
-(`KWOK_BENCH_PALLAS=1 python bench.py`) and disabled by default; on
-locally-attached TPUs (µs-scale dispatch) it is the next step up — see
-docs/architecture.md "Why Pallas is opt-in".
+per row per dispatch, K× less state traffic. OPT-IN
+(`KWOK_BENCH_PALLAS=1 python bench.py`) and RETIRED as a production path:
+the round-5 on-chip crossover sweep (BENCH_TPU_r05.json) measured it at
+0.30-0.48x the XLA scan even in its best-case regimes (small populations,
+deep substeps) — the workload is dispatch-dominated and HBM-light, so
+VMEM residency has nothing to win. Kept as hardware-validated reference
+material — see docs/architecture.md "Why Pallas is opt-in".
 
 Semantics are `ops/tick.py tick_body` exactly (match → re-arm → fire →
 heartbeat wheel), with one documented divergence: delay sampling uses an
@@ -71,7 +73,7 @@ def _kernel(
     # --- SMEM scalars -----------------------------------------------------
     now_ref, seed_ref,
     fm_ref, del_ref, selbit_ref, dk_ref, da_ref, db_ref,
-    tp_ref, ca_ref, cv_ref, isdel_ref,
+    tp_ref, ca_ref, cv_ref, isdel_ref, w_ref,
     # --- row blocks (VMEM) ------------------------------------------------
     active_ref, phase_ref, cond_ref, selb_ref, hasdel_ref,
     pend_ref, fire_ref, hb_ref, gen_ref,
@@ -86,6 +88,7 @@ def _kernel(
     hb_phase_mask: int,
     hb_sel_bit: int,
     block_rows: int,
+    has_weights: bool,
 ):
     import jax.experimental.pallas as pl
 
@@ -115,6 +118,7 @@ def _kernel(
         if num_rules > 0:
             phase_u = phase.astype(jnp.uint32)
             best = jnp.full((block_rows, LANES), -1, jnp.int32)
+            matches = []
             # R is static and tiny: unrolled first-match-wins scan
             for r in range(num_rules):
                 phase_ok = ((fm_ref[r].astype(jnp.uint32) >> phase_u) & 1) == 1
@@ -126,7 +130,43 @@ def _kernel(
                     == 1
                 )
                 m = active & phase_ok & del_ok & sel_ok
+                matches.append(m)
                 best = jnp.where((best < 0) & m, jnp.int32(r), best)
+
+            if has_weights:
+                # Stage spec.weight (mirrors tick_body): when the FIRST
+                # matching rule is weighted, draw among ALL matching
+                # weighted rules with P(i) ~ w[i]; an armed weighted
+                # choice is STICKY while it still matches. Two unrolled
+                # passes (total, then cumulative-vs-target); a zero-mass
+                # rule can never be chosen (its cumsum step is flat).
+                zf = jnp.zeros((block_rows, LANES), jnp.float32)
+                total = zf
+                for r in range(num_rules):
+                    total = total + jnp.where(matches[r], w_ref[r], 0.0)
+                u2 = _uniform01(gid, s, seed ^ jnp.uint32(0x55AA55AA))
+                target = u2 * total
+                cum = zf
+                chosen = jnp.full((block_rows, LANES), -1, jnp.int32)
+                wbest = zf
+                wpend = zf
+                pend_m = zero_b
+                for r in range(num_rules):
+                    cum = cum + jnp.where(matches[r], w_ref[r], 0.0)
+                    chosen = jnp.where(
+                        (chosen < 0) & (cum > target), jnp.int32(r), chosen
+                    )
+                    wbest = jnp.where(best == r, w_ref[r], wbest)
+                    psel = pend == r
+                    pend_m = pend_m | (psel & matches[r])
+                    wpend = jnp.where(psel, w_ref[r], wpend)
+                use_weighted = (best >= 0) & (wbest > 0)
+                pend_valid = (pend >= 0) & pend_m & (wpend > 0)
+                best = jnp.where(
+                    use_weighted,
+                    jnp.where(pend_valid, pend, chosen),
+                    best,
+                )
 
             rearm = active & (best != pend) & (best >= 0)
             # delay sampling: gather rule params by best (tiny R: select)
@@ -282,13 +322,9 @@ class PallasTickKernel:
         interpret: bool = False,
     ) -> None:
         self.table = table
-        if bool((np.asarray(table.weight) > 0).any()):
-            # the in-kernel matcher is first-match-only; refusing beats
-            # silently ignoring a declared Stage spec.weight
-            raise NotImplementedError(
-                "PallasTickKernel does not implement weighted rule choice; "
-                "use the fused XLA tick for weighted Stage sets"
-            )
+        # trace-time constant: unweighted tables (every default set)
+        # compile to exactly the pre-weight program, like tick_body
+        self.has_weights = bool((np.asarray(table.weight) > 0).any())
         self.steps = int(steps)
         self.dt = float(dt)
         self.block_rows = int(block_rows)
@@ -340,6 +376,7 @@ class PallasTickKernel:
             hb_phase_mask=self.hb_phase_mask,
             hb_sel_bit=self.hb_sel_bit,
             block_rows=br,
+            has_weights=self.has_weights,
         )
         i32 = jnp.int32
         out_shapes = [
@@ -360,7 +397,7 @@ class PallasTickKernel:
         ]
         in_specs = (
             [spec_scalar(1)] * 2       # now, seed
-            + [spec_scalar(R)] * 10    # rule arrays
+            + [spec_scalar(R)] * 11    # rule arrays (incl. weight)
             + [row_spec] * 9           # state blocks
         )
         call = pl.pallas_call(
@@ -383,6 +420,7 @@ class PallasTickKernel:
             jnp.asarray(t.cond_assign, jnp.uint32),
             jnp.asarray(t.cond_value, jnp.uint32),
             jnp.asarray(t.is_delete, jnp.int32),
+            jnp.asarray(t.weight, jnp.float32),
         )
 
         def run(state: RowState, now, seed):

@@ -547,3 +547,121 @@ def test_get_watch_surfaces_server_death(srv, kubeconfig, capsys):
     out = capsys.readouterr()
     assert rc == 1
     assert "watch failed" in out.err
+
+
+def test_get_wide_tables(srv, kubeconfig, capsys):
+    """-o wide columns, dialect-pinned (advisor/verdict r4 #7)."""
+    srv.store.create("nodes", make_node(
+        "wn1", labels={"node-role.kubernetes.io/worker": ""}))
+    srv.store.patch_status("nodes", None, "wn1", {"status": {
+        "conditions": [{"type": "Ready", "status": "True"}],
+        "addresses": [{"type": "InternalIP", "address": "196.168.0.1"}],
+        "nodeInfo": {"kubeletVersion": "fake", "osImage": "kwok",
+                     "kernelVersion": "4.19", "containerRuntimeVersion": ""},
+    }})
+    assert kubectl(kubeconfig, "get", "nodes", "-o", "wide") == 0
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert out[0].split() == [
+        "NAME", "STATUS", "AGE", "ROLES", "VERSION", "INTERNAL-IP",
+        "EXTERNAL-IP", "OS-IMAGE", "KERNEL-VERSION", "CONTAINER-RUNTIME"]
+    cells = out[1].split()
+    assert cells[0] == "wn1" and cells[1] == "Ready"
+    assert cells[3] == "worker" and cells[4] == "fake"
+    assert cells[5] == "196.168.0.1" and cells[6] == "<none>"
+
+    srv.store.create("pods", make_pod("wp1", node="wn1"))
+    srv.store.patch_status("pods", "default", "wp1", {"status": {
+        "phase": "Running", "podIP": "10.0.0.7",
+        "containerStatuses": [{"name": "c", "ready": True}]}})
+    assert kubectl(kubeconfig, "get", "pods", "-o", "wide") == 0
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert out[0].split() == [
+        "NAME", "READY", "STATUS", "AGE", "IP", "NODE",
+        "NOMINATED", "NODE", "READINESS", "GATES"]
+    cells = out[1].split()
+    assert cells[:3] == ["wp1", "1/1", "Running"]
+    assert cells[4] == "10.0.0.7" and cells[5] == "wn1"
+    assert cells[6] == "<none>" and cells[7] == "<none>"
+
+
+def test_describe_node_golden(srv, kubeconfig, capsys):
+    srv.store.create("nodes", make_node("dn1", labels={"a": "b"}))
+    srv.store.patch_status("nodes", None, "dn1", {"status": {
+        "conditions": [{"type": "Ready", "status": "True",
+                        "reason": "KubeletReady",
+                        "message": "kubelet is posting ready status"}],
+        "addresses": [{"type": "InternalIP", "address": "196.168.0.1"}],
+        "capacity": {"cpu": "1k", "pods": "1M"},
+        "allocatable": {"cpu": "1k", "pods": "1M"},
+        "nodeInfo": {"kubeletVersion": "fake"},
+    }})
+    assert kubectl(kubeconfig, "describe", "node", "dn1") == 0
+    out = capsys.readouterr().out
+    for needle in (
+        "Name:               dn1",
+        "Roles:              <none>",
+        "Labels:             a=b",
+        "Taints:             <none>",
+        "Unschedulable:      false",
+        "Conditions:",
+        "Ready",
+        "KubeletReady",
+        "Addresses:",
+        "  InternalIP:  196.168.0.1",
+        "Capacity:",
+        "  cpu:   1k",
+        "Allocatable:",
+        "System Info:",
+        "  Kubelet Version:            fake",
+        "Events:              <none>",
+    ):
+        assert needle in out, (needle, out)
+
+
+def test_describe_pod_golden_with_events(srv, kubeconfig, capsys):
+    srv.store.create("pods", make_pod("dp1", node="dn1"))
+    srv.store.patch_status("pods", "default", "dp1", {"status": {
+        "phase": "Running", "podIP": "10.0.0.9", "hostIP": "196.168.0.1",
+        "startTime": "2026-07-30T00:00:00Z",
+        "conditions": [
+            {"type": "Initialized", "status": "True"},
+            {"type": "Ready", "status": "True"},
+        ],
+        "containerStatuses": [{
+            "name": "c", "ready": True,
+            "state": {"running": {"startedAt": "2026-07-30T00:00:00Z"}},
+        }],
+    }})
+    srv.store.create("events", {
+        "metadata": {"name": "dp1.ev1", "namespace": "default"},
+        "involvedObject": {"kind": "Pod", "namespace": "default",
+                           "name": "dp1"},
+        "type": "Normal", "reason": "Scheduled",
+        "message": "assigned to dn1",
+        "source": {"component": "kwok-scheduler"},
+    })
+    assert kubectl(kubeconfig, "describe", "pods", "dp1") == 0
+    out = capsys.readouterr().out
+    for needle in (
+        "Name:         dp1",
+        "Namespace:    default",
+        "Node:         dn1/196.168.0.1",
+        "Status:       Running",
+        "IP:           10.0.0.9",
+        "Containers:",
+        "  c:",
+        "    Image:   busybox",
+        "    State:   Running",
+        "    Ready:   True",
+        "Conditions:",
+        "Initialized",
+        "Events:",
+        "Scheduled",
+        "assigned to dn1",
+        "kwok-scheduler",
+    ):
+        assert needle in out, (needle, out)
+    # NotFound dialect
+    rc = kubectl(kubeconfig, "describe", "pod", "absent")
+    err = capsys.readouterr().err
+    assert rc == 1 and "NotFound" in err

@@ -187,9 +187,74 @@ def test_stage_weight_roundtrip_and_validation():
         compile_rules(weighted_rules([1, -2]), ResourceKind.POD)
 
 
-def test_pallas_kernel_rejects_weighted_tables():
-    from kwok_tpu.ops.pallas_tick import PallasTickKernel
+def _pallas_seed(n):
+    s = new_row_state(n)
+    s.active[:] = True
+    s.sel_bits[:] = 0b11
+    return s
 
+
+def test_pallas_weighted_distribution_matches_weights():
+    """The Pallas kernel's weighted draw (VERDICT r4 #5: parity with the
+    XLA kernel's Stage spec.weight): weights 1:3 -> 25%/75% at 8k rows,
+    5-sigma tolerance. Interpret mode — the Mosaic LOWERING of this same
+    scenario is exercised on the real chip by
+    benchmarks/pallas_weighted_check.py (wired into
+    hack/tpu-recapture.sh; BENCH_TPU_r05 carries its first pass)."""
+    from kwok_tpu.ops.pallas_tick import PallasTickKernel
+    from kwok_tpu.ops.tick import to_device
+
+    n = 8192  # multiple of block_rows*128
     table = compile_rules(weighted_rules([1, 3]), ResourceKind.POD)
-    with pytest.raises(NotImplementedError, match="weighted"):
-        PallasTickKernel(table)
+    kern = PallasTickKernel(table, interpret=True)
+    out = to_host(kern(to_device(_pallas_seed(n)), now=0.0))
+    run = int((out.state.phase == table.space.phase_id("Running")).sum())
+    suc = int((out.state.phase == table.space.phase_id("Succeeded")).sum())
+    assert run + suc == n
+    sigma = (n * 0.25 * 0.75) ** 0.5
+    assert abs(run - 0.25 * n) < 5 * sigma, (run, suc)
+
+
+def test_pallas_weight_zero_rule_never_chosen():
+    """Zero-mass rules are invisible to the weighted draw, and a weight-0
+    FIRST match stays deterministic — same contract as the XLA kernel."""
+    from kwok_tpu.ops.pallas_tick import PallasTickKernel
+    from kwok_tpu.ops.tick import to_device
+
+    n = 4096
+    table = compile_rules(weighted_rules([2, 0, 6]), ResourceKind.POD)
+    kern = PallasTickKernel(table, interpret=True)
+    out = to_host(kern(to_device(_pallas_seed(n)), now=0.0))
+    phases = np.asarray(out.state.phase)
+    assert (phases != table.space.phase_id("Succeeded")).all()  # rule 1
+    run = int((phases == table.space.phase_id("Running")).sum())
+    sigma = (n * 0.25 * 0.75) ** 0.5
+    assert abs(run - 0.25 * n) < 5 * sigma, run
+    # weight-0 first match deterministic
+    table0 = compile_rules(weighted_rules([0, 5]), ResourceKind.POD)
+    kern0 = PallasTickKernel(table0, interpret=True)
+    out0 = to_host(kern0(to_device(_pallas_seed(1024)), now=0.0))
+    assert (
+        np.asarray(out0.state.phase) == table0.space.phase_id("Running")
+    ).all()
+
+
+def test_pallas_armed_weighted_choice_is_sticky():
+    """A weighted choice armed with a nonzero delay must survive quiet
+    ticks un-rerolled (sticky pending), exactly like the XLA kernel."""
+    from kwok_tpu.ops.pallas_tick import PallasTickKernel
+    from kwok_tpu.ops.tick import to_device
+
+    n = 2048
+    table = compile_rules(
+        weighted_rules([1, 1], delay=Delay.constant(100.0)),
+        ResourceKind.POD,
+    )
+    kern = PallasTickKernel(table, interpret=True)
+    out = kern(to_device(_pallas_seed(n)), now=0.0)
+    pend1 = np.asarray(out.state.pending_rule).copy()
+    assert set(np.unique(pend1)) <= {0, 1}
+    for now in (1.0, 2.0, 3.0):
+        out = kern(out.state, now=now)
+    pend2 = np.asarray(out.state.pending_rule)
+    np.testing.assert_array_equal(pend1, pend2)
