@@ -361,16 +361,39 @@ def pallas_main() -> None:
             np.asarray(nout.transitions)
         )
 
-    rate = _best_of_windows(tick, consume, max(1, TICKS // (3 * STEPS)))
+    # BOTH methodologies, exactly like the XLA headline (a crossover
+    # comparison of a per-dispatch pallas rate against a pipelined XLA
+    # rate measured tunnel serialization, not the kernels — review
+    # finding, round 5)
+    per_dispatch = _best_of_windows(tick, consume, 1)
+
+    def run_pipelined(n_ticks: int) -> float:
+        items = []
+        t0 = time.perf_counter()
+        for _ in range(n_ticks):
+            items.append(tick())  # tick() prefetches its outputs
+        total = 0
+        for item in items:
+            total += consume(item)
+        return total / (time.perf_counter() - t0)
+
+    pipelined = max(
+        run_pipelined(max(4, TICKS // STEPS * 4)) for _ in range(3)
+    )
     print(json.dumps({
         "metric": (
             f"pod-phase transitions/sec at {n_pods} pods x {n_nodes} nodes "
             f"(PALLAS VMEM-resident {STEPS}-substep kernel, {platform}"
             f"{', interpret' if interpret else ''})"
         ),
-        "value": round(rate, 1),
+        "value": round(pipelined, 1),
         "unit": "transitions/s",
-        "vs_baseline": round(rate / REFERENCE_RATE, 1),
+        "vs_baseline": round(pipelined / REFERENCE_RATE, 1),
+        "methodology": {
+            "pipelined_transitions_per_s": round(pipelined, 1),
+            "per_dispatch_transitions_per_s": round(per_dispatch, 1),
+            "note": "same definitions as the XLA headline run",
+        },
     }))
 
 

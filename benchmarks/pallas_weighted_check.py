@@ -56,6 +56,7 @@ def main() -> int:
     suc = int((out.state.phase == table.space.phase_id("Succeeded")).sum())
     sigma = (n * 0.25 * 0.75) ** 0.5
     ok = (run + suc == n) and abs(run - 0.25 * n) < 5 * sigma
+    on_chip = platform != "cpu"
     print(json.dumps({
         "metric": (
             f"pallas weighted draw on {platform}: 1:3 weights at {n} rows"
@@ -64,8 +65,14 @@ def main() -> int:
         "succeeded": suc,
         "expected_running": n // 4,
         "five_sigma": round(5 * sigma, 1),
+        "on_chip": on_chip,
         "pass": ok,
     }))
+    if not on_chip:
+        # interpret mode proves nothing about Mosaic lowering — this
+        # script's whole purpose. A tunnel-down recapture must record a
+        # SKIP (exit 3, like bench.py's device gate), not a phantom pass.
+        return 3
     return 0 if ok else 1
 
 

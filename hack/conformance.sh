@@ -46,7 +46,9 @@ conformance artifact set (seed any ONE source per artifact):
   kube-controller-manager   env KWOK_KUBE_CONTROLLER_MANAGER_BINARY | cache | PATH
   kube-scheduler            env KWOK_KUBE_SCHEDULER_BINARY | cache | PATH
   etcd (+etcdctl sibling)   env KWOK_ETCD_BINARY[_TAR] | cache (tarball)
+  prometheus (optional)     env KWOK_PROMETHEUS_BINARY[_TAR] | cache (tarball)
 cache dir: ~/.kwok/cache/<sha256(url)>  (exact per-URL paths: run without --list)
+seeding layout + one-liners: docs/preseed.md
 EOL
   echo "case matrix:"
   printf '  %s\n' "${CASES[@]}"

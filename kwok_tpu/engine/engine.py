@@ -418,6 +418,7 @@ class ClusterEngine:
             fused = self._get_fused()
             for k in (self.nodes, self.pods):
                 k.state = fused.place(k.state)
+            self._warm_scatters()
 
         node_label_sel = self.config.manage_nodes_with_label_selector or None
         # Each watch thread registers its watch FIRST, then lists and emits a
@@ -431,6 +432,38 @@ class ClusterEngine:
             t = threading.Thread(target=self._tick_loop, name="kwok-tick", daemon=True)
             t.start()
             self._threads.append(t)
+
+    def _warm_scatters(self) -> None:
+        """Pre-compile both ingest-scatter widths with all-pad no-op
+        batches so the first real ingest wave never pays jit compilation
+        inside the serving path (through a tunneled device one compile is
+        seconds, and it would land in the middle of a load burst)."""
+        from kwok_tpu.ops.updates import (
+            BATCH,
+            BATCH_LARGE,
+            InitBatch,
+            UpdateBatch,
+            init_rows,
+            update_rows,
+        )
+
+        for k in (self.nodes, self.pods):
+            cap = k.capacity
+            for width in (BATCH, BATCH_LARGE):
+                idx = np.full(width, cap, np.int32)  # every lane padded
+                k.state = init_rows(k.state, InitBatch(
+                    idx=idx,
+                    active=np.zeros(width, bool),
+                    phase=np.zeros(width, np.int32),
+                    cond_bits=np.zeros(width, np.uint32),
+                    sel_bits=np.zeros(width, np.uint32),
+                    has_deletion=np.zeros(width, bool),
+                ))
+                k.state = update_rows(k.state, UpdateBatch(
+                    idx=idx,
+                    sel_bits=np.zeros(width, np.uint32),
+                    has_deletion=np.zeros(width, bool),
+                ))
 
     def _get_fused(self) -> MultiTickKernel:
         if self._fused is None:

@@ -212,10 +212,48 @@ class FederatedEngine:
         self._running = True
         for e in self.engines:
             e.start(run_tick_loop=False)
+        # pre-compile both ingest-scatter widths against the STACKED state
+        # shapes (member engines skip their own warm-up under
+        # run_tick_loop=False): the first federated ingest wave through a
+        # tunneled device must not block on jit compilation mid-burst
+        self._warm_scatters()
         self._thread = threading.Thread(
             target=self._tick_loop, name="kwok-fed-tick", daemon=True
         )
         self._thread.start()
+
+    def _warm_scatters(self) -> None:
+        import numpy as np
+
+        from kwok_tpu.ops.updates import (
+            BATCH,
+            BATCH_LARGE,
+            InitBatch,
+            UpdateBatch,
+            init_rows,
+            update_rows,
+        )
+
+        for g in self.groups:
+            for kind in ("nodes", "pods"):
+                state = g.stacked[kind]
+                cap = state.capacity
+                for width in (BATCH, BATCH_LARGE):
+                    idx = np.full(width, cap, np.int32)  # every lane padded
+                    state = init_rows(state, InitBatch(
+                        idx=idx,
+                        active=np.zeros(width, bool),
+                        phase=np.zeros(width, np.int32),
+                        cond_bits=np.zeros(width, np.uint32),
+                        sel_bits=np.zeros(width, np.uint32),
+                        has_deletion=np.zeros(width, bool),
+                    ))
+                    state = update_rows(state, UpdateBatch(
+                        idx=idx,
+                        sel_bits=np.zeros(width, np.uint32),
+                        has_deletion=np.zeros(width, bool),
+                    ))
+                g.stacked[kind] = state
 
     def stop(self) -> None:
         self._running = False

@@ -1746,10 +1746,6 @@ bool App::handle_request(int fd, Request& req) {
         auto ov = overlay.upper_bound(last);
         snap.reserve(std::min(kindmap.size(), snap_cap));
         while (it != kindmap.end() || ov != overlay.end()) {
-          if (snap.size() >= snap_cap) {
-            more_after = true;
-            break;
-          }
           bool use_ov;
           if (ov == overlay.end()) use_ov = false;
           else if (it == kindmap.end()) use_ov = true;
@@ -1759,13 +1755,24 @@ bool App::handle_request(int fd, Request& req) {
             use_ov = true;
             ++it;
           }
+          EntryPtr e;
           if (use_ov) {
-            if (ov->second) snap.push_back(ov->second);
+            e = ov->second;
             ++ov;
           } else {
-            snap.push_back(it->second);
+            e = it->second;
             ++it;
           }
+          if (!e) continue;  // hidden at the token revision (created later)
+          if (snap.size() >= snap_cap) {
+            // only a VISIBLE leftover earns a continue token: keys hidden
+            // by the snapshot must not fabricate a trailing empty page
+            // (the Python server paginates over the rolled-back view and
+            // would end here)
+            more_after = true;
+            break;
+          }
+          snap.push_back(std::move(e));
         }
         rv_now = token_rv;  // pages of one list share page 1's revision
       } else {

@@ -7,12 +7,15 @@ the scan carry round-trips the full SoA row state through HBM every step —
 traffic at v5e bandwidth). This kernel instead grids over row blocks and
 keeps each block in VMEM across ALL K substeps: one HBM read + one write
 per row per dispatch, K× less state traffic. OPT-IN
-(`KWOK_BENCH_PALLAS=1 python bench.py`) and RETIRED as a production path:
-the round-5 on-chip crossover sweep (BENCH_TPU_r05.json) measured it at
-0.30-0.48x the XLA scan even in its best-case regimes (small populations,
-deep substeps) — the workload is dispatch-dominated and HBM-light, so
-VMEM residency has nothing to win. Kept as hardware-validated reference
-material — see docs/architecture.md "Why Pallas is opt-in".
+(`KWOK_BENCH_PALLAS=1 python bench.py`). The round-5 like-for-like
+crossover sweep on the real chip (BENCH_TPU_r05.json) measured this
+kernel at 1.27-1.36x the XLA scan in its design regime — 16k-131k rows
+at 120-240 substeps, where VMEM residency eliminates the scan carry's
+HBM round-trips — and 0.84x at 1M rows (unpacked-mask D2H + grid
+overhead outgrow the savings). The default path stays XLA for the 1M
+headline; this kernel is the documented faster choice for small-to-mid
+populations at deep substeps — see docs/architecture.md "Why Pallas is
+opt-in".
 
 Semantics are `ops/tick.py tick_body` exactly (match → re-arm → fire →
 heartbeat wheel), with one documented divergence: delay sampling uses an

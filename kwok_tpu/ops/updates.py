@@ -16,6 +16,7 @@ Padding uses idx = capacity (one past the end) with scatter mode='drop'.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -24,9 +25,13 @@ import numpy as np
 
 from kwok_tpu.ops.state import RowState
 
-# Fixed batch width: batches larger than this are applied in several calls;
-# smaller ones are padded (avoids one recompile per batch size).
-BATCH = 4096
+# Two fixed batch widths: each chunk pads to one of them (static shapes —
+# at most two compiled variants per scatter). The LARGE width exists for
+# remote/tunneled devices, where every dispatch pays client-side
+# serialization + RPC: a 50k-row ingest wave costs 4 calls instead of 13.
+# The SMALL width keeps single-event ticks from shipping a 16k-lane pad.
+BATCH = int(os.environ.get("KWOK_TPU_FLUSH_BATCH", "4096"))
+BATCH_LARGE = int(os.environ.get("KWOK_TPU_FLUSH_BATCH_LARGE", "16384"))
 
 
 class InitBatch(NamedTuple):
@@ -103,9 +108,10 @@ class UpdateBuffer:
         cap = state.capacity
         off = np.int32(offset)
         while self._init:
-            chunk, self._init = self._init[:BATCH], self._init[BATCH:]
+            width = BATCH_LARGE if len(self._init) > BATCH else BATCH
+            chunk, self._init = self._init[:width], self._init[width:]
             n = len(chunk)
-            pad = BATCH - n
+            pad = width - n
             b = InitBatch(
                 idx=np.concatenate(
                     [np.fromiter((c[0] for c in chunk), np.int32, n) + off,
@@ -132,9 +138,10 @@ class UpdateBuffer:
             )
             state = init_rows(state, b)
         while self._upd:
-            chunk, self._upd = self._upd[:BATCH], self._upd[BATCH:]
+            width = BATCH_LARGE if len(self._upd) > BATCH else BATCH
+            chunk, self._upd = self._upd[:width], self._upd[width:]
             n = len(chunk)
-            pad = BATCH - n
+            pad = width - n
             b = UpdateBatch(
                 idx=np.concatenate(
                     [np.fromiter((c[0] for c in chunk), np.int32, n) + off,

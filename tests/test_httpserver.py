@@ -335,3 +335,27 @@ def test_paginated_list_is_consistent_snapshot(api):
     assert live == sorted(
         ["snap-a", "snap-b", "snap-c", "snap-d", "snap-g"]
     )
+
+
+def test_paginated_list_no_trailing_empty_page(api):
+    """Python-server twin of the C++ trailing-empty-page pin."""
+    import urllib.parse
+
+    c = client_for(api)
+    api.store.create("nodes", make_node("tp-a"))
+    api.store.create("nodes", make_node("tp-b"))
+    raw = c._json("GET", api.url + "/api/v1/nodes?limit=1")
+    token = raw["metadata"]["continue"]
+    api.store.create("nodes", make_node("tp-y"))
+    api.store.create("nodes", make_node("tp-z"))
+    pages = []
+    while token:
+        raw = c._json(
+            "GET",
+            api.url + "/api/v1/nodes?limit=1&continue="
+            + urllib.parse.quote(token),
+        )
+        pages.append([n["metadata"]["name"] for n in raw["items"]])
+        assert raw["items"], "token led to an empty trailing page"
+        token = (raw.get("metadata") or {}).get("continue")
+    assert pages == [["tp-b"]]

@@ -425,3 +425,28 @@ def test_paginated_list_is_consistent_snapshot(client):
     assert live == sorted(
         ["snap-a", "snap-b", "snap-c", "snap-d", "snap-g"]
     )
+
+
+def test_paginated_list_no_trailing_empty_page(client):
+    """Keys hidden by the snapshot (created mid-pagination) must not earn
+    a continue token for a trailing empty page — the Python server ends
+    pagination at the last visible key (review finding, round 5)."""
+    client.create("nodes", make_node("tp-a"))
+    client.create("nodes", make_node("tp-b"))
+    raw = client._json("GET", client.server + "/api/v1/nodes?limit=1")
+    assert [n["metadata"]["name"] for n in raw["items"]] == ["tp-a"]
+    token = raw["metadata"]["continue"]
+    # mid-pagination creations that sort AFTER every visible key
+    client.create("nodes", make_node("tp-y"))
+    client.create("nodes", make_node("tp-z"))
+    pages = []
+    while token:
+        raw = client._json(
+            "GET",
+            client.server + "/api/v1/nodes?limit=1&continue="
+            + urllib.parse.quote(token),
+        )
+        pages.append([n["metadata"]["name"] for n in raw["items"]])
+        assert raw["items"], "token led to an empty trailing page"
+        token = (raw.get("metadata") or {}).get("continue")
+    assert pages == [["tp-b"]]
