@@ -70,6 +70,9 @@ compare "get pods empty -o json"    get pods -n empty-ns -o json
 compare "get no-headers"            get nodes --no-headers
 compare "get nodes -o wide"         get nodes -o wide
 compare "get pods -o wide"          get pods -o wide
+compare "get node -o yaml"          get node diff-node -o yaml
+compare "get pods -l none"          get pods -l no=match --no-headers
+compare "get name+selector error"   get pod diff-pod -l a=b
 compare "describe node"             describe node diff-node
 compare "describe pod"              describe pod diff-pod
 compare "describe pod missing"      describe pod nope

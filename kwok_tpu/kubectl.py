@@ -8,12 +8,15 @@ exists, so the base runtime falls back to this shim: enough of kubectl's
 surface for the reference's e2e assertions (get / apply / delete /
 get --raw) against any apiserver this framework speaks to.
 
-Deliberately NOT a full kubectl: printers are table/json/name only, no
-server-side apply, no openapi validation, no exec/logs (the reference
+Deliberately NOT a full kubectl: printers are table/wide/json/yaml/name,
+no server-side apply, no openapi validation, no exec/logs (the reference
 snapshot's fake pods have no streaming endpoints either). `get -w`
 streams row-per-event like real kubectl (bounded by --request-timeout),
-and `wait --for=condition=...|delete` covers the polling loops the
-reference's e2e scripts hand-roll (test/kwok/kwok.test.sh:40-56).
+`-l` label selectors scope lists and watches server-side, `describe
+nodes|pods` renders the sectioned report (conditions, capacity, system
+info, containers, events), and `wait --for=condition=...|delete` covers
+the polling loops the reference's e2e scripts hand-roll
+(test/kwok/kwok.test.sh:40-56).
 """
 
 from __future__ import annotations
@@ -244,7 +247,9 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("-n", "--namespace", default=None)
     g.add_argument("-A", "--all-namespaces", action="store_true")
     g.add_argument("-o", "--output", default="",
-                   choices=["", "json", "name", "wide"])
+                   choices=["", "json", "yaml", "name", "wide"])
+    g.add_argument("-l", "--selector", default=None,
+                   help="label selector, e.g. a=b,c!=d")
     g.add_argument("--no-headers", action="store_true")
     g.add_argument("-w", "--watch", action="store_true",
                    help="after listing, stream a row per watch event")
@@ -306,10 +311,21 @@ def _parse_duration(s: str) -> float:
         raise SystemExit(f'error: invalid duration "{s}"') from None
 
 
-def _emit_watch_row(kind, obj, args) -> None:
-    if args.output == "json":
+def _emit_machine_doc(obj: dict, fmt: str) -> None:
+    if fmt == "yaml":
+        import yaml
+
+        # successive documents separated like real kubectl's yaml stream
+        yaml.safe_dump(obj, sys.stdout, default_flow_style=False,
+                       sort_keys=True, explicit_start=True)
+    else:
         json.dump(obj, sys.stdout, indent=2)
         print()
+
+
+def _emit_watch_row(kind, obj, args) -> None:
+    if args.output in ("json", "yaml"):
+        _emit_machine_doc(obj, args.output)
     elif args.output == "name":
         print(f"{_singular(kind)}/{obj['metadata']['name']}")
     else:
@@ -358,6 +374,8 @@ def _get_watch(args, client, kind, ns, name, start_rv=None) -> int:
         while not stop.is_set():
             try:
                 w = client.watch(kind, field_selector=field_selector,
+                                 label_selector=getattr(
+                                     args, "selector", None),
                                  allow_bookmarks=False,
                                  resource_version=rv_box[0])
             except (WatchExpired, TooLargeResourceVersion):
@@ -756,6 +774,10 @@ def _run(args, client: HttpKubeClient) -> int:
         if name and len(kinds) > 1:
             raise SystemExit("error: a resource name cannot combine with "
                              "multiple resource types")
+        if name and args.selector:
+            # real kubectl's exact refusal
+            raise SystemExit("error: name cannot be provided when a "
+                             "selector is specified")
         watching = args.watch or args.watch_only
         if watching and len(kinds) > 1:
             # real kubectl: watch is only supported on individual
@@ -771,7 +793,10 @@ def _run(args, client: HttpKubeClient) -> int:
             # of dropping (real kubectl threads the rv the same way)
             kind = kinds[0]
             ns = args.namespace or ("default" if _is_namespaced(kind) else None)
-            doc = client._json("GET", client._url(kind)) or {}
+            query = (
+                {"labelSelector": args.selector} if args.selector else None
+            )
+            doc = client._json("GET", client._url(kind, query=query)) or {}
             start_rv = (doc.get("metadata") or {}).get("resourceVersion")
             objs = doc.get("items") or []
             if name:
@@ -811,7 +836,7 @@ def _run(args, client: HttpKubeClient) -> int:
                         return 1
                     objs = [obj]
                 else:
-                    objs = client.list(kind)
+                    objs = client.list(kind, label_selector=args.selector)
                     if _is_namespaced(kind) and not args.all_namespaces:
                         objs = [
                             o for o in objs
@@ -822,21 +847,26 @@ def _run(args, client: HttpKubeClient) -> int:
                     per_kind.append((kind, objs))
         if args.watch_only:
             pass  # stream only; no initial listing
-        elif args.output == "json" and not watching:
+        elif args.output in ("json", "yaml") and not watching:
             # one parseable document even across comma-separated kinds
             # (real kubectl merges everything into a single v1 List)
             items = [o for _, objs in per_kind for o in objs]
             doc = items[0] if name else {
                 "kind": "List", "apiVersion": "v1", "items": items
             }
-            json.dump(doc, sys.stdout, indent=2)
-            print()
-        elif args.output == "json":
-            # -o json -w streams one document per object/event
+            if args.output == "yaml":
+                import yaml
+
+                yaml.safe_dump(doc, sys.stdout, default_flow_style=False,
+                               sort_keys=True)
+            else:
+                json.dump(doc, sys.stdout, indent=2)
+                print()
+        elif args.output in ("json", "yaml"):
+            # -o json/yaml -w streams one document per object/event
             for _, objs in per_kind:
                 for o in objs:
-                    json.dump(o, sys.stdout, indent=2)
-                    print()
+                    _emit_machine_doc(o, args.output)
         elif args.output == "name":
             for kind, objs in per_kind:
                 for o in objs:
@@ -854,9 +884,9 @@ def _run(args, client: HttpKubeClient) -> int:
             kind = kinds[0]
             ns = args.namespace or ("default" if _is_namespaced(kind) else None)
             return _get_watch(args, client, kind, ns, name, start_rv)
-        if not per_kind and args.output not in ("json", "name"):
-            # real kubectl stays silent on empty results under -o json /
-            # -o name (scripts capture both streams)
+        if not per_kind and args.output not in ("json", "yaml", "name"):
+            # real kubectl stays silent on empty results under machine
+            # outputs (scripts capture both streams)
             print("No resources found", file=sys.stderr)
         return 0
 

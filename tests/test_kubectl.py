@@ -665,3 +665,37 @@ def test_describe_pod_golden_with_events(srv, kubeconfig, capsys):
     rc = kubectl(kubeconfig, "describe", "pod", "absent")
     err = capsys.readouterr().err
     assert rc == 1 and "NotFound" in err
+
+
+def test_get_yaml_output(srv, kubeconfig, capsys):
+    import yaml
+
+    srv.store.create("nodes", make_node("y1"))
+    assert kubectl(kubeconfig, "get", "nodes", "-o", "yaml") == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    assert doc["kind"] == "List"
+    assert doc["items"][0]["metadata"]["name"] == "y1"
+    # single object: the bare document, like real kubectl
+    assert kubectl(kubeconfig, "get", "node", "y1", "-o", "yaml") == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    assert doc["metadata"]["name"] == "y1"
+
+
+def test_get_label_selector(srv, kubeconfig, capsys):
+    srv.store.create("nodes", make_node("l1", labels={"tier": "a"}))
+    srv.store.create("nodes", make_node("l2", labels={"tier": "b"}))
+    assert kubectl(kubeconfig, "get", "nodes", "-l", "tier=a",
+                   "--no-headers") == 0
+    out = [ln.split()[0] for ln in
+           capsys.readouterr().out.splitlines() if ln.strip()]
+    assert out == ["l1"]
+    # name + selector is a real-kubectl refusal
+    with pytest.raises(SystemExit) as e:
+        kubectl(kubeconfig, "get", "node", "l1", "-l", "tier=a")
+    assert "selector" in str(e.value)
+    # -l also scopes a watch's initial list + stream
+    assert kubectl(kubeconfig, "get", "nodes", "-l", "tier=b",
+                   "--no-headers", "-w", "--request-timeout", "1s") == 0
+    out = [ln.split()[0] for ln in
+           capsys.readouterr().out.splitlines() if ln.strip()]
+    assert out == ["l2"]
