@@ -311,13 +311,24 @@ def _parse_duration(s: str) -> float:
         raise SystemExit(f'error: invalid duration "{s}"') from None
 
 
-def _emit_machine_doc(obj: dict, fmt: str) -> None:
+def _no_resources_msg(kind: str, ns: str | None,
+                      all_namespaces: bool = False) -> str:
+    """Real kubectl's empty-result dialect: namespace-qualified (with the
+    period) for namespaced kinds, bare otherwise."""
+    if _is_namespaced(kind) and not all_namespaces and ns:
+        return f"No resources found in {ns} namespace."
+    return "No resources found"
+
+
+def _emit_machine_doc(obj: dict, fmt: str,
+                      explicit_start: bool = True) -> None:
     if fmt == "yaml":
         import yaml
 
-        # successive documents separated like real kubectl's yaml stream
+        # explicit_start separates successive documents like real
+        # kubectl's yaml stream; a single merged List omits it
         yaml.safe_dump(obj, sys.stdout, default_flow_style=False,
-                       sort_keys=True, explicit_start=True)
+                       sort_keys=True, explicit_start=explicit_start)
     else:
         json.dump(obj, sys.stdout, indent=2)
         print()
@@ -374,8 +385,7 @@ def _get_watch(args, client, kind, ns, name, start_rv=None) -> int:
         while not stop.is_set():
             try:
                 w = client.watch(kind, field_selector=field_selector,
-                                 label_selector=getattr(
-                                     args, "selector", None),
+                                 label_selector=args.selector,
                                  allow_bookmarks=False,
                                  resource_version=rv_box[0])
             except (WatchExpired, TooLargeResourceVersion):
@@ -679,7 +689,8 @@ def _describe(args, client) -> int:
     if blocks:
         print("\n\n\n".join(blocks))
     elif rc == 0:
-        print("No resources found", file=sys.stderr)
+        kind0, ns0, _nm = targets[0]
+        print(_no_resources_msg(kind0, ns0), file=sys.stderr)
     return rc
 
 
@@ -854,14 +865,7 @@ def _run(args, client: HttpKubeClient) -> int:
             doc = items[0] if name else {
                 "kind": "List", "apiVersion": "v1", "items": items
             }
-            if args.output == "yaml":
-                import yaml
-
-                yaml.safe_dump(doc, sys.stdout, default_flow_style=False,
-                               sort_keys=True)
-            else:
-                json.dump(doc, sys.stdout, indent=2)
-                print()
+            _emit_machine_doc(doc, args.output, explicit_start=False)
         elif args.output in ("json", "yaml"):
             # -o json/yaml -w streams one document per object/event
             for _, objs in per_kind:
@@ -887,7 +891,13 @@ def _run(args, client: HttpKubeClient) -> int:
         if not per_kind and args.output not in ("json", "yaml", "name"):
             # real kubectl stays silent on empty results under machine
             # outputs (scripts capture both streams)
-            print("No resources found", file=sys.stderr)
+            ns0 = args.namespace or (
+                "default" if _is_namespaced(kinds[0]) else None
+            )
+            print(
+                _no_resources_msg(kinds[0], ns0, args.all_namespaces),
+                file=sys.stderr,
+            )
         return 0
 
     if args.verb in ("apply", "create"):

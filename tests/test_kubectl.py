@@ -354,9 +354,10 @@ def test_golden_errors_and_mutations(srv, kubeconfig, tmp_path, capsys):
     )
     assert kubectl(kubeconfig, "delete", "node", "n2") == 0
     assert _golden(capsys) == ('node "n2" deleted', "")
-    # empty table warns on stderr only
+    # empty table warns on stderr only, namespace-qualified like real
+    # kubectl for namespaced kinds
     assert kubectl(kubeconfig, "get", "events") == 0
-    assert _golden(capsys) == ("", "No resources found")
+    assert _golden(capsys) == ("", "No resources found in default namespace.")
 
 
 # ------------------------------------------------- watch + wait (VERDICT r3 #8)
