@@ -247,7 +247,7 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("-n", "--namespace", default=None)
     g.add_argument("-A", "--all-namespaces", action="store_true")
     g.add_argument("-o", "--output", default="",
-                   choices=["", "json", "yaml", "name", "wide"])
+                   help='"", json, yaml, name, wide, or jsonpath={...}')
     g.add_argument("-l", "--selector", default=None,
                    help="label selector, e.g. a=b,c!=d")
     g.add_argument("--no-headers", action="store_true")
@@ -309,6 +309,65 @@ def _parse_duration(s: str) -> float:
         return parse_duration(s or "0")
     except ValueError:
         raise SystemExit(f'error: invalid duration "{s}"') from None
+
+
+def _jsonpath_eval(obj, path: str) -> list:
+    """Evaluate a dotted jsonpath expression (the subset the reference's
+    e2e scripts use: `.a.b`, `.items[*].x`, `.items.*.x`, `.items[2].x`)
+    against obj, returning the matched values in document order."""
+    values = [obj]
+    for raw in path.strip().lstrip(".").replace("[", ".[").split("."):
+        tok = raw.strip()
+        if not tok:
+            continue
+        out = []
+        for v in values:
+            if tok in ("*", "[*]"):
+                if isinstance(v, list):
+                    out.extend(v)
+                elif isinstance(v, dict):
+                    out.extend(v.values())
+            elif tok.startswith("[") and tok.endswith("]"):
+                idx = tok[1:-1].strip()
+                if idx == "*":
+                    if isinstance(v, list):
+                        out.extend(v)
+                elif isinstance(v, list):
+                    try:
+                        out.append(v[int(idx)])
+                    except (ValueError, IndexError):
+                        pass
+            elif isinstance(v, dict) and tok in v:
+                out.append(v[tok])
+        values = out
+    return values
+
+
+def _print_jsonpath(doc, template: str) -> None:
+    """kubectl-style jsonpath printer for the common template shapes:
+    `{.expr}` segments evaluate (lists join with spaces), `{"literal"}`
+    segments emit verbatim (so `{"\\n"}` works), text outside braces
+    passes through."""
+    import re as _re
+
+    out: list[str] = []
+    pos = 0
+    for m in _re.finditer(r"\{([^{}]*)\}", template):
+        out.append(template[pos:m.start()])
+        inner = m.group(1).strip()
+        if len(inner) >= 2 and inner[0] == inner[-1] == '"':
+            out.append(inner[1:-1].encode().decode("unicode_escape"))
+        else:
+            vals = _jsonpath_eval(doc, inner)
+            out.append(" ".join(
+                v if isinstance(v, str)
+                else json.dumps(v, separators=(",", ":"))
+                for v in vals
+            ))
+        pos = m.end()
+    out.append(template[pos:])
+    sys.stdout.write("".join(out))
+    sys.stdout.flush()
 
 
 def _no_resources_msg(kind: str, ns: str | None,
@@ -789,6 +848,19 @@ def _run(args, client: HttpKubeClient) -> int:
             # real kubectl's exact refusal
             raise SystemExit("error: name cannot be provided when a "
                              "selector is specified")
+        jsonpath = None
+        if args.output.startswith("jsonpath="):
+            jsonpath = args.output[len("jsonpath="):]
+        elif args.output not in ("", "json", "yaml", "name", "wide"):
+            raise SystemExit(
+                "error: unable to match a printer suitable for the "
+                f'output format "{args.output}"'
+            )
+        if jsonpath is not None and (args.watch or args.watch_only):
+            raise SystemExit(
+                "error: jsonpath output is not supported with --watch "
+                "in this kubectl shim"
+            )
         watching = args.watch or args.watch_only
         if watching and len(kinds) > 1:
             # real kubectl: watch is only supported on individual
@@ -858,6 +930,12 @@ def _run(args, client: HttpKubeClient) -> int:
                     per_kind.append((kind, objs))
         if args.watch_only:
             pass  # stream only; no initial listing
+        elif jsonpath is not None:
+            items = [o for _, objs in per_kind for o in objs]
+            doc = items[0] if name else {
+                "kind": "List", "apiVersion": "v1", "items": items
+            }
+            _print_jsonpath(doc, jsonpath)
         elif args.output in ("json", "yaml") and not watching:
             # one parseable document even across comma-separated kinds
             # (real kubectl merges everything into a single v1 List)
@@ -888,7 +966,9 @@ def _run(args, client: HttpKubeClient) -> int:
             kind = kinds[0]
             ns = args.namespace or ("default" if _is_namespaced(kind) else None)
             return _get_watch(args, client, kind, ns, name, start_rv)
-        if not per_kind and args.output not in ("json", "yaml", "name"):
+        if not per_kind and jsonpath is None and args.output not in (
+            "json", "yaml", "name"
+        ):
             # real kubectl stays silent on empty results under machine
             # outputs (scripts capture both streams)
             ns0 = args.namespace or (

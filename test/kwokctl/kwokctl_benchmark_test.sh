@@ -23,6 +23,13 @@ export KWOK_E2E_PKI_DIR
 
 create_node "${URL}" bench-node
 retry 30 node_is_ready "${URL}" bench-node
+# pick the node the way the reference's benchmark script does
+# (kwokctl_benchmark_test.sh:122: kubectl get node -o jsonpath)
+picked="$(pyrun -m kwok_tpu.kubectl -s "${URL}" get nodes \
+  -o 'jsonpath={.items.*.metadata.name}' | tr ' ' '\n' \
+  | grep bench- | head -n 1)"
+[ "${picked}" = "bench-node" ] || {
+  echo "jsonpath node pick failed: ${picked}" >&2; exit 1; }
 
 # --- create 1,000 pods ---------------------------------------------------
 start="$(date +%s)"

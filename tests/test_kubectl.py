@@ -700,3 +700,37 @@ def test_get_label_selector(srv, kubeconfig, capsys):
     out = [ln.split()[0] for ln in
            capsys.readouterr().out.splitlines() if ln.strip()]
     assert out == ["l2"]
+
+
+def test_get_jsonpath_output(srv, kubeconfig, capsys):
+    """The jsonpath subset the reference's e2e uses
+    (kwokctl_benchmark_test.sh:122: '{.items.*.metadata.name}')."""
+    srv.store.create("nodes", make_node("jp1"))
+    srv.store.create("nodes", make_node("jp2"))
+    assert kubectl(kubeconfig, "get", "nodes",
+                   "-o", "jsonpath={.items.*.metadata.name}") == 0
+    assert capsys.readouterr().out == "jp1 jp2"
+    assert kubectl(kubeconfig, "get", "nodes",
+                   "-o", "jsonpath={.items[*].metadata.name}") == 0
+    assert capsys.readouterr().out == "jp1 jp2"
+    # single object + literal segments
+    srv.store.create("pods", make_pod("jpp", node="jp1"))
+    srv.store.patch_status("pods", "default", "jpp",
+                           {"status": {"phase": "Running"}})
+    assert kubectl(kubeconfig, "get", "pod", "jpp",
+                   "-o", 'jsonpath={.metadata.name}{" "}{.status.phase}'
+                   '{"\\n"}') == 0
+    assert capsys.readouterr().out == "jpp Running\n"
+    # indexing
+    assert kubectl(kubeconfig, "get", "nodes",
+                   "-o", "jsonpath={.items[1].metadata.name}") == 0
+    assert capsys.readouterr().out == "jp2"
+    # empty result: silent like machine outputs
+    assert kubectl(kubeconfig, "get", "events",
+                   "-o", "jsonpath={.items.*.metadata.name}") == 0
+    cap = capsys.readouterr()
+    assert cap.out == "" and cap.err == ""
+    # unknown formats refuse with real kubectl's message shape
+    with pytest.raises(SystemExit) as e:
+        kubectl(kubeconfig, "get", "nodes", "-o", "bogus")
+    assert "unable to match a printer" in str(e.value)
