@@ -1023,9 +1023,8 @@ class ClusterEngine:
         k = self.nodes
         idx = k.pool.release(name)
         if idx is not None:
-            if self._owns_tick:  # federation consumes synchronously
-                self._release_seq += 1
-                k.released_at[idx] = self._release_seq
+            self._release_seq += 1
+            k.released_at[idx] = self._release_seq
             k.buffer.stage_init(idx, False)
         if name in self.node_has:
             self.node_has.discard(name)
@@ -1263,9 +1262,8 @@ class ClusterEngine:
             # either lands before (we see m["cni"] and remove) or its
             # liveness check sees the released row and undoes itself
             k.pool.release(key)
-            if self._owns_tick:  # federation consumes synchronously
-                self._release_seq += 1
-                k.released_at[idx] = self._release_seq
+            self._release_seq += 1
+            k.released_at[idx] = self._release_seq
             cni_owned = bool(m.get("cni"))
             ip = m.get("podIP") or (pod.get("status") or {}).get("podIP")
         if cni_owned:

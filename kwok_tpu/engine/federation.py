@@ -82,6 +82,20 @@ def _table_bytes(tab) -> bytes:
     )
 
 
+@dataclasses.dataclass
+class _FedPending:
+    """A dispatched-but-unconsumed group tick in the pipelined loop."""
+
+    group: "_Group"
+    wire: object  # device array; self-contained (pack_rows)
+    r: int  # rows per cluster AT DISPATCH (regrow may change it)
+    cap: int  # stacked capacity at dispatch
+    seqs: list  # per-member release seq at dispatch (stale-mask filter)
+    now: float  # engine time of the dispatch
+    mono: float  # monotonic clock at dispatch (idle-wake anchor)
+    flush_s: float
+
+
 class _Group:
     """Members sharing one compiled rule set: one stacked state and one
     fused kernel (the round-1 whole-federation layout, now per group)."""
@@ -100,6 +114,7 @@ class _Group:
             ],
             mesh=mesh,
             pack=True,
+            pack_rows=True,  # self-contained wire: pipelined consume
             steps=steps,
             dt=cfg.tick_interval / steps,
         )
@@ -269,32 +284,78 @@ class FederatedEngine:
     _IDLE_MAX = 60.0
 
     def _tick_loop(self) -> None:
-        interval = self.config.tick_interval
-        while self._running:
-            deadline = time.monotonic() + interval
-            if all(e._q.empty() for e in self.engines) and not any(
-                k.buffer.pending
-                for e in self.engines
-                for k in (e.nodes, e.pods)
-            ):
-                # idle: sleep toward the device-reported next deadline
-                # (ops/tick.next_due); arriving events shorten the drain
-                wake = self._idle_wake
-                if wake is None:
-                    deadline = time.monotonic() + self._IDLE_MAX
-                elif wake > deadline:
-                    deadline = min(wake, time.monotonic() + self._IDLE_MAX)
-            self._drain_ingest(deadline)
-            try:
-                self.tick_once()
-            except Exception:
-                logger.exception("federated tick failed")
+        """Pipelined federated loop, mirroring ClusterEngine._tick_loop:
+        every iteration drains member queues, consumes in-flight group
+        wires that have landed, and dispatches the next tick of every
+        group — so the device round trip overlaps drain + emit instead of
+        serializing in front of them. Per-group consume order is FIFO."""
+        from collections import deque
 
-    def _drain_ingest(self, deadline: float) -> None:
-        """Round-robin the members' ingest queues until the tick is due.
-        An arriving event during an extended idle sleep pulls the deadline
-        back to one normal interval; consecutive empty polls back off
-        exponentially so idling costs ~no wakeups."""
+        interval = self.config.tick_interval
+        depth = max(1, int(getattr(self.config, "pipeline_depth", 8)))
+        pending: "deque" = deque()
+        try:
+            while self._running:
+                deadline = time.monotonic() + interval
+                if (
+                    not pending
+                    and all(e._q.empty() for e in self.engines)
+                    and not any(
+                        k.buffer.pending
+                        for e in self.engines
+                        for k in (e.nodes, e.pods)
+                    )
+                ):
+                    # idle: sleep toward the device-reported deadline
+                    # (ops/tick.next_due); events shorten the drain
+                    wake = self._idle_wake
+                    if wake is None:
+                        deadline = time.monotonic() + self._IDLE_MAX
+                    elif wake > deadline:
+                        deadline = min(
+                            wake, time.monotonic() + self._IDLE_MAX
+                        )
+                got_event = self._drain_ingest(deadline, pending)
+                try:
+                    while pending and (
+                        len(pending) >= depth * max(1, len(self.groups))
+                        or ClusterEngine._wire_ready(pending[0])
+                    ):
+                        self._consume_one(pending)
+                    # dispatch only when something calls for a tick (see
+                    # the solo loop's gate: an always-in-flight pipeline
+                    # would otherwise never idle)
+                    wake = self._idle_wake
+                    if (
+                        got_event
+                        or any(
+                            k.buffer.pending
+                            for e in self.engines
+                            for k in (e.nodes, e.pods)
+                        )
+                        or (wake is not None
+                            and time.monotonic() >= wake)
+                    ):
+                        self._tick_dispatch_all(pending)
+                except Exception:
+                    logger.exception("federated tick failed")
+                    self._idle_wake = time.monotonic() + interval
+        finally:
+            # stopping: flush in-flight group wires so computed patches
+            # are not dropped (stop() joins us before member teardown)
+            while pending:
+                try:
+                    self._consume_one(pending)
+                except Exception:
+                    logger.exception("final federated consume failed")
+
+    def _drain_ingest(self, deadline: float, pending=None) -> bool:
+        """Round-robin the members' ingest queues until the tick is due;
+        returns whether any event was drained. An arriving event during an
+        extended idle sleep pulls the deadline back to one normal
+        interval; consecutive empty polls back off exponentially so idling
+        costs ~no wakeups — capped at 5ms while group wires are in flight
+        so a wire landing mid-drain is consumed promptly."""
         lag: dict[int, float] = {}
         drain: dict[int, float] = {}
         bufs: dict[int, dict] = {}
@@ -305,7 +366,7 @@ class FederatedEngine:
             while self._running:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return
+                    return got_event
                 drained_any = False
                 for i, e in enumerate(self.engines):
                     while True:
@@ -332,8 +393,15 @@ class FederatedEngine:
                             deadline, time.monotonic() + interval
                         )
                 else:
+                    if pending and ClusterEngine._wire_ready(pending[0]):
+                        try:
+                            self._consume_one(pending)
+                        except Exception:
+                            logger.exception("mid-drain consume failed")
+                        continue
+                    cap = 0.005 if pending else 0.1
                     time.sleep(min(remaining, idle_sleep))
-                    idle_sleep = min(idle_sleep * 2, 0.1)
+                    idle_sleep = min(idle_sleep * 2, cap)
         finally:
             for i, e in enumerate(self.engines):
                 if i in bufs and bufs[i]:
@@ -348,16 +416,30 @@ class FederatedEngine:
                     e.metrics["watch_lag_seconds"] = lag.get(i, 0.0)
                     e.metrics["ingest_queue_depth"] = e._q.qsize()
                     e.metrics["ingest_drain_seconds_sum"] += drain.get(i, 0.0)
+        return got_event
 
     # ------------------------------------------------------------------ tick
 
     def tick_once(self) -> None:
+        """One synchronous federated step: dispatch every group, then
+        consume every wire — the pipelined loop calls the halves with up
+        to pipeline_depth * groups wires in flight."""
+        from collections import deque
+
+        pending: "deque" = deque()
+        self._tick_dispatch_all(pending)
+        while pending:
+            self._consume_one(pending)
+
+    def _tick_dispatch_all(self, pending) -> None:
+        """Dispatch one tick of every group, appending _FedPending records
+        whose wires materialize asynchronously."""
         self._maybe_regrow()
         t0 = time.perf_counter()
         now = time.time() - self._epoch
         if now >= REBASE_AFTER:
-            # shared-epoch rebase (see ClusterEngine.tick_once): shift every
-            # group's stacked time fields and every member's epoch together
+            # shared-epoch rebase (see ClusterEngine): shift every group's
+            # stacked time fields and every member's epoch together
             self._epoch += now
             for e in self.engines:
                 e._epoch = self._epoch
@@ -367,39 +449,31 @@ class FederatedEngine:
                     g.stacked[kind] = rebase_times(g.stacked[kind], now)
             logger.info("federated epoch rebase at engine time %.1fs", now)
             now = 0.0
-        now_str = now_rfc3339()
-        wake: float | None = None
-        flush_s = kernel_s = emit_s = 0.0
+        any_dispatch = False
+        flush_s = 0.0
         for g in self.groups:
-            due, f_s, k_s, e_s = self._tick_group(g, now, now_str)
-            flush_s += f_s
-            kernel_s += k_s
-            emit_s += e_s
-            if due is not None:
-                wake = due if wake is None else min(wake, due)
-        self._idle_wake = wake
-        elapsed = time.perf_counter() - t0
+            p = self._tick_group_dispatch(g, now)
+            if p is not None:
+                pending.append(p)
+                any_dispatch = True
+                flush_s += p.flush_s
+        if not any_dispatch:
+            self._idle_wake = None  # empty federation: sleep until events
+        host_s = time.perf_counter() - t0
         for e in self.engines:
             with e._metrics_lock:
                 e.metrics["ticks_total"] += 1
-                e.metrics["tick_seconds_sum"] += elapsed
-                e.metrics["tick_seconds_last"] = elapsed
-                # shared-tick breakdown, mirrored to every member like
-                # tick_seconds_sum (un-summed in the aggregate) so SOAK
-                # artifacts attribute federated wall time, not zeros
                 e.metrics["tick_flush_seconds_sum"] += flush_s
-                e.metrics["tick_kernel_seconds_sum"] += kernel_s
-                e.metrics["tick_emit_seconds_sum"] += emit_s
+                e.metrics["tick_seconds_sum"] += host_s
                 e.metrics["nodes_managed"] = len(e.nodes.pool)
                 e.metrics["pods_managed"] = len(e.pods.pool)
 
-    def _tick_group(
-        self, g: _Group, now: float, now_str: str
-    ) -> tuple[float | None, float, float, float]:
-        """One fused dispatch for one rule-set group. Returns (wake,
-        flush_s, kernel_s, emit_s): the monotonic wake-up for the group's
-        next device-scheduled event (None = none) plus the same per-phase
-        breakdown the solo tick records (engine.tick_once)."""
+    def _tick_group_dispatch(self, g: _Group, now: float):
+        """Flush members' staged writes into the group's stacked state and
+        dispatch its fused kernel. Returns a _FedPending (wire in flight)
+        or None when the group is empty."""
+        from kwok_tpu.ops.tick import prefetch
+
         r = g.r
         t0 = time.perf_counter()
         any_rows = False
@@ -415,8 +489,7 @@ class FederatedEngine:
             g.stacked[kind] = state
         t_flush = time.perf_counter()
         if not any_rows:
-            # empty group: sleep until events
-            return None, t_flush - t0, 0.0, 0.0
+            return None  # empty group: nothing on device
         # with substeps, anchor the LAST scan step at wall-now
         now_base = now - (g.fused.steps - 1) * g.fused.dt
         g.dispatches += 1
@@ -425,40 +498,96 @@ class FederatedEngine:
         )
         g.stacked["nodes"] = nout.state
         g.stacked["pods"] = pout.state
-        cap = r * len(g.engines)
-        counters, masks_fn, dues = unpack_wire(np.asarray(wire), [cap, cap])
+        prefetch(wire)  # self-contained pack_rows wire (see ClusterEngine)
+        return _FedPending(
+            group=g,
+            wire=wire,
+            r=r,
+            cap=r * len(g.engines),
+            seqs=[e._release_seq for e in g.engines],
+            now=now,
+            mono=time.monotonic(),
+            flush_s=t_flush - t0,
+        )
+
+    def _consume_one(self, pending) -> None:
+        """Consume the oldest in-flight group wire: refresh fired rows'
+        mirrors per member (skipping rows released since that dispatch)
+        and emit patches. FIFO preserves per-object patch order."""
+        p = pending.popleft()
+        g = p.group
+        t0 = time.perf_counter()
+        counters, masks_fn, dues, rows_fn = unpack_wire(
+            np.asarray(p.wire), [p.cap, p.cap], rows=True
+        )
+        t_wire = time.perf_counter()
         nd = float(dues.min())
         wake = (
             None if nd == float("inf")
-            else time.monotonic() + max(0.0, nd - now)
+            else p.mono + max(0.0, nd - p.now)
         )
-        masks = masks_fn() if counters.any() else None
-        t_kernel = time.perf_counter()
-        for i, (kind, out) in enumerate((("nodes", nout), ("pods", pout))):
-            if not (int(counters[i]) or int(counters[2 + i])):
-                continue
-            dirty, deleted, hb = masks[i]
-            phase = np.asarray(out.state.phase)
-            cond = np.asarray(out.state.cond_bits)
-            for c, e in enumerate(g.engines):
-                k = e.nodes if kind == "nodes" else e.pods
-                lo, hi = c * r, (c + 1) * r
-                d_c, del_c, hb_c = dirty[lo:hi], deleted[lo:hi], hb[lo:hi]
-                trans_c = int(
-                    np.count_nonzero(d_c) + np.count_nonzero(del_c)
-                )
-                if trans_c:
-                    e._inc("transitions_total", trans_c)
-                if trans_c or hb_c.any():
-                    k.phase_h = phase[lo:hi].copy()
-                    k.cond_h = cond[lo:hi].copy()
-                    e._emit(kind, k, d_c, del_c, hb_c, now_str)
-        return (
-            wake,
-            t_flush - t0,
-            t_kernel - t_flush,
-            time.perf_counter() - t_kernel,
+        # group wakes merge: the earliest in-flight deadline wins
+        cur = self._idle_wake
+        if wake is not None:
+            self._idle_wake = wake if cur is None else min(cur, wake)
+        emit_s = 0.0
+        if counters.any():
+            now_str = now_rfc3339()
+            masks = masks_fn()
+            rows = rows_fn()
+            r = p.r
+            for i, kind in enumerate(("nodes", "pods")):
+                if not (int(counters[i]) or int(counters[2 + i])):
+                    continue
+                dirty, deleted, hb = masks[i]
+                ph, cb = rows[i]
+                for c, e in enumerate(g.engines):
+                    k = e.nodes if kind == "nodes" else e.pods
+                    lo, hi = c * r, (c + 1) * r
+                    d_c, del_c, hb_c = (
+                        dirty[lo:hi], deleted[lo:hi], hb[lo:hi]
+                    )
+                    # rows released since this dispatch: the mask bits
+                    # describe the old occupant (see ClusterEngine)
+                    seq = p.seqs[c]
+                    stale = [
+                        li for li, s in k.released_at.items()
+                        if s > seq and li < r
+                    ]
+                    if stale:
+                        d_c[stale] = False
+                        del_c[stale] = False
+                        hb_c[stale] = False
+                    trans_c = int(
+                        np.count_nonzero(d_c) + np.count_nonzero(del_c)
+                    )
+                    if trans_c:
+                        e._inc("transitions_total", trans_c)
+                        idxs = np.nonzero(d_c | del_c)[0]
+                        # fired rows only: freshly acquired rows keep
+                        # their ingest-time mirror values
+                        k.phase_h[idxs] = ph[lo:hi][idxs]
+                        k.cond_h[idxs] = cb[lo:hi][idxs]
+                    if trans_c or hb_c.any():
+                        _t = time.perf_counter()
+                        e._emit(kind, k, d_c, del_c, hb_c, now_str)
+                        emit_s += time.perf_counter() - _t
+        # prune each member's release log against its oldest still-in-
+        # flight dispatch (members belong to exactly one group)
+        next_p = next(
+            (q for q in pending if q.group is g), None
         )
+        for c, e in enumerate(g.engines):
+            e._prune_released(
+                next_p.seqs[c] if next_p is not None else e._release_seq
+            )
+        elapsed = time.perf_counter() - t0
+        for e in g.engines:
+            with e._metrics_lock:
+                e.metrics["tick_seconds_sum"] += elapsed
+                e.metrics["tick_seconds_last"] = elapsed
+                e.metrics["tick_kernel_seconds_sum"] += t_wire - t0
+                e.metrics["tick_emit_seconds_sum"] += emit_s
 
     # ------------------------------------------------------------------ grow
 

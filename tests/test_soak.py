@@ -108,11 +108,14 @@ def test_soak_federated_breakdown():
     eng = result["engine"]
     assert eng["tick_kernel_s"] > 0.0, eng
     assert eng["tick_emit_s"] > 0.0, eng
+    assert eng["tick_flush_s"] > 0.0, eng
     parts = eng["tick_flush_s"] + eng["tick_kernel_s"] + eng["tick_emit_s"]
-    # the three blocks are disjoint sub-spans of the tick: they can never
-    # exceed the total, and in a busy soak they attribute most of it
+    # the blocks are sub-spans of the tick accounting and can never exceed
+    # it. (The old >=30% coverage floor died with the pipelined loop: the
+    # kernel block now measures the host's WAIT on the wire, which
+    # pipelining drives toward zero by design — near-zero kernel_s next to
+    # nonzero flush/emit is the success condition, not missing data.)
     assert parts <= eng["tick_s"] * 1.01, eng
-    assert parts >= eng["tick_s"] * 0.3, eng
 
 
 def test_endurance_smoke():
