@@ -103,7 +103,8 @@ def main() -> int:
             env=eng_env, stdout=eng_log, stderr=eng_log,
         )
         procs.append(engine)
-        _wait_http(metrics_url, "/healthz", timeout=60.0)
+        # readiness (200 only after engine warm-up), not liveness
+        _wait_http(metrics_url, "/readyz", timeout=120.0)
 
         def req(path, obj=None, method=None):
             data = json.dumps(obj).encode() if obj is not None else None

@@ -389,7 +389,9 @@ def main() -> None:
              "--server-address", f"127.0.0.1:{srv_port}"],
             env=_child_env(engine=True), stdout=eng_log, stderr=eng_log,
         ))
-        _wait_http(metrics_url, "/healthz", timeout=60.0)
+        # readiness, not liveness: /readyz turns 200 only after the engine
+        # finished its warm-up compiles — load must not start before that
+        _wait_http(metrics_url, "/readyz", timeout=120.0)
 
     client = HttpKubeClient.from_kubeconfig(None, url)
     poller = _Poller(url)

@@ -78,6 +78,15 @@ logger = logging.getLogger("kwok_tpu.engine")
 # just keeps the hot emit loops free of attribute lookups.
 from urllib.parse import quote as _q  # noqa: E402
 
+# Whole-process sampling attribution: set KWOK_TPU_SAMPLE_PROF=<path.json>
+# and a sampler thread snapshots every engine thread's stack (tick, watch
+# ingest, patch executor) until stop() dumps per-thread hot-frame counts.
+# This exists because the engine's CPU is spread across threads a
+# main-thread profiler never sees — it is how the cost model's
+# "unattributed residual" gets hunted down. (cProfile can't do this on
+# 3.12: one sys.monitoring tool per process.)
+from kwok_tpu import profiling  # noqa: E402
+
 _NODE_READY_BITS = 1 << NODE_PHASES.condition_bit("Ready")
 # status keys whose strategic merge is plain replacement — when the current
 # status has only these, merge(current, rendered) == rendered exactly
@@ -318,6 +327,8 @@ class ClusterEngine:
         self._drain_gen: dict[str, int] = {}
         self._gen_lock = threading.Lock()
         self._dropped_jobs = 0  # patch jobs rejected during shutdown
+        # readiness for /readyz: set once start() finishes warm-up
+        self.ready = False
         # Batched pipelined egress (native/pump.cc): one C++ call sends a
         # whole tick's status patches over pooled keep-alive connections,
         # GIL-free. Plain-HTTP apiservers only (the mock/lab edge); TLS
@@ -419,6 +430,7 @@ class ClusterEngine:
             for k in (self.nodes, self.pods):
                 k.state = fused.place(k.state)
             self._warm_scatters()
+            self._warm_tick()
 
         node_label_sel = self.config.manage_nodes_with_label_selector or None
         # Each watch thread registers its watch FIRST, then lists and emits a
@@ -432,6 +444,7 @@ class ClusterEngine:
             t = threading.Thread(target=self._tick_loop, name="kwok-tick", daemon=True)
             t.start()
             self._threads.append(t)
+        self.ready = True
 
     def _warm_scatters(self) -> None:
         """Pre-compile both ingest-scatter widths with all-pad no-op
@@ -465,6 +478,18 @@ class ClusterEngine:
                     has_deletion=np.zeros(width, bool),
                 ))
 
+    def _warm_tick(self) -> None:
+        """Compile the fused tick kernel + its packed D2H wire at startup
+        with one all-inactive dispatch. The first real dispatch otherwise
+        pays XLA compilation inside the serving path — sampled at ~20% of
+        the tick thread's wall during a 50k-pod soak, stalling the serial
+        lane exactly when the first load burst lands."""
+        fused = self._get_fused()
+        (nout, pout), wire = fused((self.nodes.state, self.pods.state), 0.0)
+        self.nodes.state = nout.state
+        self.pods.state = pout.state
+        np.asarray(wire)  # complete (and warm) the wire's D2H path
+
     def _get_fused(self) -> MultiTickKernel:
         if self._fused is None:
             steps = max(1, int(self.config.tick_substeps))
@@ -476,6 +501,7 @@ class ClusterEngine:
 
     def stop(self) -> None:
         self._running = False
+        self.ready = False
         if getattr(self, "_profiling", False):
             # short runs stop before tick 102; flush the trace anyway
             import jax
@@ -505,6 +531,7 @@ class ClusterEngine:
             logger.warning(
                 "%d patch jobs dropped during shutdown", self._dropped_jobs
             )
+        profiling.maybe_dump()
         if self._pump is not None:
             self._pump.close()
             self._pump = None
@@ -1332,6 +1359,7 @@ class ClusterEngine:
         from collections import deque
 
         pending: "deque" = deque()
+        profiling.maybe_start()
         try:
             while self._running:
                 deadline = time.monotonic() + interval

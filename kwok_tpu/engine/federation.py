@@ -104,6 +104,9 @@ class _Group:
         self.engines = engines  # ClusterEngines, federation order preserved
         self.r = 0  # rows per cluster; set by alloc
         self.dispatches = 0  # fused-kernel launches (one per active tick)
+        # monotonic device-timer deadline from this group's newest consumed
+        # tick (None = nothing scheduled); the loop gate takes the min
+        self.wake: float | None = 0.0
         e0 = engines[0]
         hb_bit = e0.node_bits[SEL_HEARTBEAT]
         steps = max(1, int(getattr(cfg, "tick_substeps", 1)))
@@ -210,6 +213,7 @@ class FederatedEngine:
 
         self.config = config
         self._running = False
+        self.ready = False  # /readyz gate; set once start() finishes warm-up
         self._thread: threading.Thread | None = None
         # monotonic wake-up for the idle tick loop (see ClusterEngine):
         # 0 = tick immediately, None = nothing scheduled on device
@@ -232,10 +236,12 @@ class FederatedEngine:
         # run_tick_loop=False): the first federated ingest wave through a
         # tunneled device must not block on jit compilation mid-burst
         self._warm_scatters()
+        self._warm_ticks()
         self._thread = threading.Thread(
             target=self._tick_loop, name="kwok-fed-tick", daemon=True
         )
         self._thread.start()
+        self.ready = True
 
     def _warm_scatters(self) -> None:
         import numpy as np
@@ -270,8 +276,25 @@ class FederatedEngine:
                     ))
                 g.stacked[kind] = state
 
+    def _warm_ticks(self) -> None:
+        """Compile every group's fused kernel + packed wire at startup
+        with one all-inactive dispatch (see ClusterEngine._warm_tick:
+        first-dispatch XLA compilation otherwise lands mid-load inside
+        the serial tick lane). Homogeneous groups share one compile via
+        the jit cache; heterogeneous rule sets each pay their own here."""
+        import numpy as np
+
+        for g in self.groups:
+            (nout, pout), wire = g.fused(
+                (g.stacked["nodes"], g.stacked["pods"]), 0.0
+            )
+            g.stacked["nodes"] = nout.state
+            g.stacked["pods"] = pout.state
+            np.asarray(wire)
+
     def stop(self) -> None:
         self._running = False
+        self.ready = False
         # join the shared tick first so it cannot submit patch jobs to
         # members whose executors are already shut down
         if self._thread is not None:
@@ -294,6 +317,9 @@ class FederatedEngine:
         interval = self.config.tick_interval
         depth = max(1, int(getattr(self.config, "pipeline_depth", 8)))
         pending: "deque" = deque()
+        from kwok_tpu import profiling
+
+        profiling.maybe_start()
         try:
             while self._running:
                 deadline = time.monotonic() + interval
@@ -457,8 +483,14 @@ class FederatedEngine:
                 pending.append(p)
                 any_dispatch = True
                 flush_s += p.flush_s
+            else:
+                # empty group: clear its wake so a stale deadline cannot
+                # keep the gate firing (its in-flight wires, if any, still
+                # refresh the wake at consume)
+                g.wake = None
         if not any_dispatch:
-            self._idle_wake = None  # empty federation: sleep until events
+            wakes = [g.wake for g in self.groups if g.wake is not None]
+            self._idle_wake = min(wakes) if wakes else None
         host_s = time.perf_counter() - t0
         for e in self.engines:
             with e._metrics_lock:
@@ -526,21 +558,24 @@ class FederatedEngine:
             None if nd == float("inf")
             else p.mono + max(0.0, nd - p.now)
         )
-        # group wakes merge: the earliest in-flight deadline wins
-        cur = self._idle_wake
-        if wake is not None:
-            self._idle_wake = wake if cur is None else min(cur, wake)
+        # Per-group wake, newest consume wins (the solo engine's overwrite
+        # semantics, per group); the loop's gate reads the min across
+        # groups. A plain min-merge on one shared field can only ever
+        # decrease — it would pin the gate at its 0.0 start value and keep
+        # an idle federation dispatching through the device forever.
+        g.wake = wake
+        wakes = [q.wake for q in self.groups if q.wake is not None]
+        self._idle_wake = min(wakes) if wakes else None
         emit_s = 0.0
         if counters.any():
             now_str = now_rfc3339()
             masks = masks_fn()
-            rows = rows_fn()
+            rows = None  # decoded lazily: heartbeat-only wires never need it
             r = p.r
             for i, kind in enumerate(("nodes", "pods")):
                 if not (int(counters[i]) or int(counters[2 + i])):
                     continue
                 dirty, deleted, hb = masks[i]
-                ph, cb = rows[i]
                 for c, e in enumerate(g.engines):
                     k = e.nodes if kind == "nodes" else e.pods
                     lo, hi = c * r, (c + 1) * r
@@ -564,6 +599,9 @@ class FederatedEngine:
                     if trans_c:
                         e._inc("transitions_total", trans_c)
                         idxs = np.nonzero(d_c | del_c)[0]
+                        if rows is None:
+                            rows = rows_fn()
+                        ph, cb = rows[i]
                         # fired rows only: freshly acquired rows keep
                         # their ingest-time mirror values
                         k.phase_h[idxs] = ph[lo:hi][idxs]

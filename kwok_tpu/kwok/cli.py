@@ -222,6 +222,12 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
         )
         wait_for_apiserver(client)
         engine = ClusterEngine(client, _engine_config(args, stages))
+    # liveness first, readiness after: the server comes up immediately
+    # (so /healthz//livez probes never kill the process mid-warm-up) but
+    # /readyz answers 503 until engine.start() finishes pre-compiling the
+    # fused tick kernel — anything gating load on readiness (kwokctl
+    # WaitReady, rigs) must not see "ready" while the serial tick lane
+    # would still stall on first-dispatch compilation
     server = None
     if args.server_address:
         server = EngineServer(engine, args.server_address)

@@ -69,7 +69,17 @@ class EngineServer:
     def _make_handler(self, engine):
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
-                if self.path in ("/healthz", "/readyz", "/livez"):
+                if self.path == "/readyz":
+                    # readiness is gated on engine warm-up (start()
+                    # pre-compiles the fused tick kernel, seconds through a
+                    # tunneled device); liveness endpoints stay 200 the
+                    # whole time so restart probes don't kill the warm-up
+                    if not getattr(engine, "ready", True):
+                        self.send_error(503, "engine warming up")
+                        return
+                    body = b"ok"
+                    ctype = "text/plain"
+                elif self.path in ("/healthz", "/livez"):
                     body = b"ok"
                     ctype = "text/plain"
                 elif self.path == "/metrics":

@@ -1057,27 +1057,57 @@ struct Request {
   bool close = false;   // Connection: close
 };
 
-static bool read_exact(int fd, std::string& buf, size_t need) {
-  while (buf.size() < need) {
-    char tmp[65536];
-    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
-    if (n <= 0) return false;
-    buf.append(tmp, n);
+static bool send_all(int fd, const char* data, size_t n) {
+  while (n > 0) {
+    ssize_t w = send(fd, data, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    data += w;
+    n -= (size_t)w;
   }
   return true;
 }
 
-// Reads one HTTP/1.1 request; `buf` carries leftover pipelined bytes.
-static bool read_request(int fd, std::string& buf, Request& req) {
-  size_t hdr_end;
-  while ((hdr_end = buf.find("\r\n\r\n")) == std::string::npos) {
+// Per-connection buffered IO. `in` carries pipelined request bytes behind
+// a consumed-prefix offset (erasing the prefix per request is O(buffered)
+// — quadratic under the pump's deep pipelines); `out` accumulates queued
+// responses that flush in ONE send when the pipeline drains (the syscall-
+// per-response pattern dominated apiserver CPU in live soaks).
+struct ConnIO {
+  int fd;
+  std::string in;
+  size_t off = 0;  // start of unconsumed bytes in `in`
+  std::string out;
+
+  bool flush() {
+    if (out.empty()) return true;
+    bool ok = send_all(fd, out.data(), out.size());
+    out.clear();
+    return ok;
+  }
+  // flush queued responses, then read more: only called when `in` lacks a
+  // complete request, i.e. exactly when the pipeline has drained
+  bool fill() {
+    if (!flush()) return false;
     char tmp[65536];
     ssize_t n = recv(fd, tmp, sizeof tmp, 0);
     if (n <= 0) return false;
-    buf.append(tmp, n);
-    if (buf.size() > (32u << 20)) return false;
+    in.append(tmp, n);
+    return true;
   }
-  std::string head = buf.substr(0, hdr_end);
+};
+
+// Reads one HTTP/1.1 request from the connection's pipelined buffer.
+static bool read_request(ConnIO& io, Request& req) {
+  size_t hdr_end;
+  while ((hdr_end = io.in.find("\r\n\r\n", io.off)) == std::string::npos) {
+    if (io.off) {  // compact the consumed prefix before growing
+      io.in.erase(0, io.off);
+      io.off = 0;
+    }
+    if (io.in.size() > (32u << 20)) return false;
+    if (!io.fill()) return false;
+  }
+  std::string head = io.in.substr(io.off, hdr_end - io.off);
   size_t line_end = head.find("\r\n");
   std::string line = head.substr(0, line_end);
   size_t sp1 = line.find(' ');
@@ -1110,24 +1140,25 @@ static bool read_request(int fd, std::string& buf, Request& req) {
       if (v == "close") req.close = true;
     }
   }
-  size_t total = hdr_end + 4 + content_len;
-  if (!read_exact(fd, buf, total)) return false;
-  req.body = buf.substr(hdr_end + 4, content_len);
-  buf.erase(0, total);
-  return true;
-}
-
-static bool send_all(int fd, const char* data, size_t n) {
-  while (n > 0) {
-    ssize_t w = send(fd, data, n, MSG_NOSIGNAL);
-    if (w <= 0) return false;
-    data += w;
-    n -= (size_t)w;
+  size_t total = hdr_end + 4 + content_len;  // absolute index into io.in
+  while (io.in.size() < total) {
+    if (!io.fill()) return false;
+  }
+  req.body = io.in.substr(hdr_end + 4, content_len);
+  io.off = total;
+  if (io.off == io.in.size()) {
+    io.in.clear();
+    io.off = 0;
+  } else if (io.off > (1u << 20)) {
+    io.in.erase(0, io.off);
+    io.off = 0;
   }
   return true;
 }
 
-static bool send_response(int fd, int code, const std::string& body) {
+// Queues one response on the connection's out-buffer; flushed in one send
+// when the request pipeline drains (ConnIO::fill) or past the size cap.
+static bool queue_response(ConnIO& io, int code, const std::string& body) {
   const char* reason = code == 200   ? "OK"
                        : code == 201 ? "Created"
                        : code == 401 ? "Unauthorized"
@@ -1138,11 +1169,11 @@ static bool send_response(int fd, int code, const std::string& body) {
                     "HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
                     "Content-Length: %zu\r\n\r\n",
                     code, reason, body.size());
-  std::string out;
-  out.reserve(hn + body.size());
-  out.append(head, hn);
-  out += body;
-  return send_all(fd, out.data(), out.size());
+  io.out.append(head, hn);
+  io.out += body;
+  // bound queued-response memory (large LIST pages): flush early
+  if (io.out.size() > (4u << 20)) return io.flush();
+  return true;
 }
 
 static std::string url_decode(const std::string& s) {
@@ -1294,7 +1325,7 @@ struct App {
 
   void audit_line(const std::string& method, const std::string& uri, int code);
   void handle_conn(int fd);
-  bool handle_request(int fd, Request& req);
+  bool handle_request(ConnIO& io, Request& req);
   std::string snapshot_dump();
   void restore_load(const JVal& data);
   void seed_rbac();
@@ -1490,14 +1521,20 @@ void App::persist() {
 }
 
 // returns false when the connection must close
-bool App::handle_request(int fd, Request& req) {
+bool App::handle_request(ConnIO& io, Request& req) {
+  int fd = io.fd;  // streaming paths (watch) write directly
   auto q = parse_query(req.query);
   std::string uri = req.path;
   if (!req.query.empty()) uri += "?" + req.query;
 
   auto respond = [&](int code, const std::string& body) {
     audit_line(req.method, uri, code);
-    return send_response(fd, code, body) && !req.close;
+    bool ok = queue_response(io, code, body);
+    if (req.close) {
+      io.flush();
+      return false;
+    }
+    return ok;
   };
 
   if (req.method == "GET" && req.path == "/healthz")
@@ -1564,7 +1601,10 @@ bool App::handle_request(int fd, Request& req) {
     std::string lsq = q.count("labelSelector") ? q["labelSelector"] : "";
     auto wq = q.find("watch");
     if (wq != q.end() && (wq->second == "true" || wq->second == "1")) {
-      // ---- watch stream: headers now, then chunked events forever
+      // ---- watch stream: headers now, then chunked events forever.
+      // Responses to earlier pipelined requests must leave first — the
+      // stream writes to the socket directly from here on.
+      if (!io.flush()) return false;
       auto w = std::make_shared<Watch>();
       w->kind = m.kind;
       w->field_sel = fs;
@@ -1649,22 +1689,33 @@ bool App::handle_request(int fd, Request& req) {
           "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
           "Transfer-Encoding: chunked\r\n\r\n";
       bool alive = send_all(fd, head, strlen(head));
+      // Batched writer: drain everything queued per wakeup and ship it
+      // as one send (bounded per write) — a 50k-pod soak fans out tens
+      // of thousands of events per stream, and a syscall per event was
+      // a top apiserver CPU term.
+      std::vector<std::shared_ptr<const std::string>> evs;
+      std::string out;
       while (alive && !stopping.load()) {
-        std::shared_ptr<const std::string> ev;
+        evs.clear();
         {
           std::unique_lock<std::mutex> lk(w->mu);
           w->cv.wait(lk, [&] { return w->closed || !w->q.empty(); });
           if (w->closed && w->q.empty()) break;
-          ev = std::move(w->q.front());
-          w->q.pop_front();
+          size_t take = std::min(w->q.size(), (size_t)8192);
+          for (size_t i = 0; i < take; i++) {
+            evs.push_back(std::move(w->q.front()));
+            w->q.pop_front();
+          }
         }
-        char chunk_head[32];
-        int hn = snprintf(chunk_head, sizeof chunk_head, "%zx\r\n", ev->size());
-        std::string out;
-        out.reserve(hn + ev->size() + 2);
-        out.append(chunk_head, hn);
-        out += *ev;
-        out += "\r\n";
+        out.clear();
+        for (const auto& ev : evs) {
+          char chunk_head[32];
+          int hn =
+              snprintf(chunk_head, sizeof chunk_head, "%zx\r\n", ev->size());
+          out.append(chunk_head, hn);
+          out += *ev;
+          out += "\r\n";
+        }
         alive = send_all(fd, out.data(), out.size());
       }
       {
@@ -2122,11 +2173,13 @@ bool App::handle_request(int fd, Request& req) {
 void App::handle_conn(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-  std::string buf;
+  ConnIO io;
+  io.fd = fd;
   Request req;
-  while (!stopping.load() && read_request(fd, buf, req)) {
-    if (!handle_request(fd, req)) break;
+  while (!stopping.load() && read_request(io, req)) {
+    if (!handle_request(io, req)) break;
   }
+  io.flush();  // peer may close after its last response arrives
   close(fd);
 }
 

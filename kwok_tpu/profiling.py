@@ -1,0 +1,120 @@
+"""In-process sampling profiler for multi-threaded attribution.
+
+Set ``KWOK_TPU_SAMPLE_PROF=<path.json>`` and the engine starts a daemon
+thread that snapshots every Python thread's stack (``sys._current_frames``)
+on a fixed cadence and dumps per-thread flat/cumulative hot-function counts
+as JSON at engine stop.
+
+Why not cProfile: on CPython 3.12 ``cProfile`` registers a process-wide
+``sys.monitoring`` tool, so only ONE thread can be deterministically
+profiled per process — useless for an engine whose CPU is spread across a
+tick thread, watch threads, and a patch executor.  Sampling sees them all
+at once, costs ~nothing at the default 2 ms cadence, and the counts are
+directly proportional to wall time spent per frame.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+
+ENV = "KWOK_TPU_SAMPLE_PROF"
+
+
+class Sampler:
+    def __init__(self, out_path: str, interval_s: float = 0.002) -> None:
+        self.out_path = out_path
+        self.interval_s = interval_s
+        # per thread-name: leaf frame counts (self time) and
+        # anywhere-on-stack counts (cumulative time)
+        self.leaf: dict[str, collections.Counter] = collections.defaultdict(
+            collections.Counter
+        )
+        self.cum: dict[str, collections.Counter] = collections.defaultdict(
+            collections.Counter
+        )
+        self.samples = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "Sampler":
+        t = threading.Thread(target=self._run, name="kwok-sampler", daemon=True)
+        t.start()
+        self._thread = t
+        return self
+
+    def _run(self) -> None:
+        names = {}  # thread ident -> name (refreshed per sample)
+        while not self._stop.is_set():
+            for th in threading.enumerate():
+                names[th.ident] = th.name
+            me = threading.get_ident()
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue
+                name = names.get(ident, str(ident))
+                leaf = True
+                seen = set()
+                while frame is not None:
+                    code = frame.f_code
+                    key = (
+                        f"{os.path.basename(code.co_filename)}:"
+                        f"{frame.f_lineno}:{code.co_name}"
+                        if leaf
+                        else f"{os.path.basename(code.co_filename)}:"
+                        f"{code.co_firstlineno}:{code.co_name}"
+                    )
+                    if leaf:
+                        self.leaf[name][key] += 1
+                        leaf = False
+                    if key not in seen:  # recursion: count once per sample
+                        seen.add(key)
+                        self.cum[name][key] += 1
+                    frame = frame.f_back
+            self.samples += 1
+            self._stop.wait(self.interval_s)
+
+    def stop_and_dump(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        out = {
+            "samples": self.samples,
+            "interval_s": self.interval_s,
+            "threads": {},
+        }
+        for name in sorted(self.leaf):
+            out["threads"][name] = {
+                "self": dict(self.leaf[name].most_common(40)),
+                "cumulative": dict(self.cum[name].most_common(60)),
+            }
+        tmp = self.out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(tmp, self.out_path)
+
+
+_sampler: Sampler | None = None
+_lock = threading.Lock()
+
+
+def maybe_start() -> None:
+    """Idempotent: starts the process-wide sampler if ENV is set."""
+    global _sampler
+    path = os.environ.get(ENV, "")
+    if not path:
+        return
+    with _lock:
+        if _sampler is None:
+            _sampler = Sampler(path).start()
+
+
+def maybe_dump() -> None:
+    global _sampler
+    with _lock:
+        s, _sampler = _sampler, None
+    if s is not None:
+        s.stop_and_dump()

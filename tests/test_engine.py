@@ -429,3 +429,37 @@ def test_metrics_exposition_grammar_strict(rig):
             assert typed[name] == "counter", name
     # every declared family produced a sample
     assert set(typed) == sampled
+
+
+def test_readyz_gates_on_engine_warmup():
+    """/healthz and /livez answer 200 from the moment the server is up
+    (liveness probes must not kill a process mid-warm-up), but /readyz is
+    503 until ClusterEngine.start() finishes its warm-up compiles — the
+    signal rigs and WaitReady gate load on."""
+    import http.client
+
+    from kwok_tpu.kwok.server import EngineServer
+
+    class NotReadyEngine:
+        ready = False
+        metrics = {"ticks_total": 0}
+
+    eng = NotReadyEngine()
+    server = EngineServer(eng, "127.0.0.1:0")
+    server.start()
+    try:
+        def status(path):
+            c = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+            try:
+                c.request("GET", path)
+                return c.getresponse().status
+            finally:
+                c.close()
+
+        assert status("/healthz") == 200
+        assert status("/livez") == 200
+        assert status("/readyz") == 503
+        eng.ready = True
+        assert status("/readyz") == 200
+    finally:
+        server.stop()

@@ -381,3 +381,49 @@ def test_heterogeneous_vocabularies_do_not_share_kernels():
     assert {"group0_dispatches_total", "group1_dispatches_total"} <= set(
         fed.metrics
     )
+
+
+def test_idle_federation_stops_dispatching():
+    """A quiescent federation must go idle: once every object has settled
+    and the next device timer (heartbeat) is far away, the tick loop's
+    gate must stop dispatching fused kernels. Regression: the shared
+    _idle_wake was only ever min-merged from its 0.0 start, so the gate
+    read 'a timer is due' forever and an idle federation kept paying a
+    device round-trip every tick_interval."""
+    servers = [FakeKube(), FakeKube()]
+    fed = FederatedEngine(
+        servers,
+        EngineConfig(
+            manage_all_nodes=True,
+            tick_interval=0.02,
+            # park the only recurring device timer far in the future
+            heartbeat_interval=3600.0,
+        ),
+    )
+    fed.start()
+    try:
+        for c, server in enumerate(servers):
+            server.create("nodes", make_node(f"c{c}-node0"))
+            server.create("pods", make_pod(f"c{c}-pod0", node=f"c{c}-node0"))
+
+        def converged():
+            return all(
+                (o.get("status") or {}).get("phase") == "Running"
+                for server in servers
+                for o in server.list("pods", field_selector="spec.nodeName!=")
+            )
+
+        assert wait_until(converged), "federation did not converge"
+        # let in-flight wires drain, then watch the dispatch counter
+        time.sleep(0.5)
+        d0 = sum(g.dispatches for g in fed.groups)
+        time.sleep(1.0)
+        d1 = sum(g.dispatches for g in fed.groups)
+        # a busy-gate loop would add ~50 dispatches/s here; allow a couple
+        # for wires that were still pipelined when we snapshotted
+        assert d1 - d0 <= 2, (
+            f"idle federation dispatched {d1 - d0} ticks in 1s "
+            f"(gate never disengaged)"
+        )
+    finally:
+        fed.stop()
