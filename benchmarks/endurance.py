@@ -253,9 +253,9 @@ def main() -> int:
         }))
         return 0 if (ok_rebases and ok_hb and ok_rss) else 1
     finally:
-        for proc in procs:
+        # engine (procs[-1]) before its apiserver: see soak.py teardown
+        for proc in reversed(procs):
             proc.terminate()
-        for proc in procs:
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:

@@ -501,9 +501,14 @@ def main() -> None:
             ))
         create_nodes_s = time.perf_counter() - t_nodes
         deadline = time.monotonic() + args.timeout
-        # pod-progress polls count via limit=1 + remainingItemCount (O(1)
-        # payload); only the node-Ready poll still parses a full list
-        poll = max(0.2, min(2.0, args.pods / 50000))
+        # Pod-progress polls are limit=1 + remainingItemCount, answered
+        # from the C++ server's incremental status.phase index (O(1)
+        # payload AND ~O(1) server work) — so the cadence can be tight:
+        # a coarse poll adds up to one full interval of phantom tail to
+        # every measured phase. The node-Ready poll parses a full list,
+        # so it keeps a coarser cadence.
+        poll = max(0.1, min(2.0, args.pods / 500000))
+        node_poll = max(0.25, min(2.0, args.nodes / 20000))
 
         def ready_nodes() -> int:
             if multi:
@@ -513,7 +518,7 @@ def main() -> None:
         while ready_nodes() < args.nodes:
             if time.monotonic() > deadline:
                 raise SystemExit("timeout waiting for nodes Ready")
-            time.sleep(poll)
+            time.sleep(node_poll)
         nodes_s = time.perf_counter() - t_nodes
         cpu_t1 = cpu_snapshot()
 
@@ -793,9 +798,11 @@ def main() -> None:
             pump.close()
         for mp in member_pumps:
             mp.close()
-        for proc in procs:
+        # engine first (procs[-1]): killing the apiservers under it sends
+        # every watch thread + the final-tick patch flush into retry/log
+        # storms for the whole shutdown window
+        for proc in reversed(procs):
             proc.terminate()
-        for proc in procs:
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:

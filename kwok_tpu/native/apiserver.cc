@@ -955,6 +955,22 @@ struct Store {
   std::deque<Hist> history;
   std::deque<Undo> undo;
   int64_t compacted_rv = 0;
+  // incremental status.phase counts per kind: lets a limit=1 progress
+  // poll (fieldSelector=status.phase=X) report remainingItemCount without
+  // the O(store) post-cut scan — at 50k pods a rig polling every 200 ms
+  // was a measurable apiserver CPU term
+  std::map<std::string, long> phase_idx[NKINDS];
+
+  // caller holds mu; from/to are the entry leaving/entering the store
+  void idx_adjust(int kind, const EntryPtr& from, const EntryPtr& to) {
+    if (from) {
+      std::string p = field_str(from->obj, "status.phase");
+      auto it = phase_idx[kind].find(p);
+      if (it != phase_idx[kind].end() && --it->second <= 0)
+        phase_idx[kind].erase(it);
+    }
+    if (to) phase_idx[kind][field_str(to->obj, "status.phase")]++;
+  }
 
   // caller holds mu
   void bump(JVal& obj) {
@@ -969,6 +985,7 @@ struct Store {
   // this event (nullptr for creates).
   void emit(int kind, const char* type, const EntryPtr& e, const Key& key,
             EntryPtr prev) {
+    idx_adjust(kind, prev, strcmp(type, "DELETED") == 0 ? nullptr : e);
     if (rv_window() > 0) {
       history.push_back({rv, kind, type, e});
       undo.push_back({rv, kind, key, std::move(prev)});
@@ -1411,7 +1428,10 @@ void App::restore_load(const JVal& data) {
   std::vector<std::shared_ptr<Watch>> old;
   {
     std::lock_guard<std::mutex> lk(store.mu);
-    for (int k = 0; k < NKINDS; k++) store.kinds[k].clear();
+    for (int k = 0; k < NKINDS; k++) {
+      store.kinds[k].clear();
+      store.phase_idx[k].clear();
+    }
     const JVal* objects = data.find("objects");
     if (objects && objects->type == JVal::OBJ) {
       for (int k = 0; k < NKINDS; k++) {
@@ -1420,7 +1440,9 @@ void App::restore_load(const JVal& data) {
         for (const JVal& obj : list->arr) {
           Key key = Store::obj_key(obj);
           if (key.second.empty()) continue;
-          store.kinds[k][key] = publish(obj);
+          EntryPtr e = publish(obj);
+          store.idx_adjust(k, store.kinds[k][key], e);
+          store.kinds[k][key] = e;
         }
       }
     }
@@ -1503,7 +1525,9 @@ void App::seed_rbac() {
       meta.set("creationTimestamp", JVal::str(now_rfc3339()));
       meta.set("uid", JVal::str("uid-" + std::to_string(store.rv + 1)));
       store.bump(obj);
-      store.kinds[k][key] = publish(std::move(obj));
+      EntryPtr e = publish(std::move(obj));
+      store.idx_adjust(k, nullptr, e);
+      store.kinds[k][key] = e;
       // no emit: seeding happens before the listener accepts watchers
     }
   }
@@ -1701,8 +1725,11 @@ bool App::handle_request(ConnIO& io, Request& req) {
           std::unique_lock<std::mutex> lk(w->mu);
           w->cv.wait(lk, [&] { return w->closed || !w->q.empty(); });
           if (w->closed && w->q.empty()) break;
-          size_t take = std::min(w->q.size(), (size_t)8192);
-          for (size_t i = 0; i < take; i++) {
+          size_t take_bytes = 0;
+          // cap the batch by BYTES, not events: one send buffer must stay
+          // bounded even when a stalled reader let large objects pile up
+          while (!w->q.empty() && take_bytes < (4u << 20)) {
+            take_bytes += w->q.front()->size();
             evs.push_back(std::move(w->q.front()));
             w->q.pop_front();
           }
@@ -1733,6 +1760,25 @@ bool App::handle_request(ConnIO& io, Request& req) {
     LabelSel ls = LabelSel::parse(lsq);
     long limit = q.count("limit") ? atol(q["limit"].c_str()) : 0;
     std::string cont = q.count("continue") ? q["continue"] : "";
+    // Indexed count for the progress-poll shape (limit=N +
+    // fieldSelector=status.phase=X, no label selector): the post-cut
+    // remainder comes from phase_idx instead of matching every stored
+    // object. -1 = no index applies; the slow scan is authoritative.
+    // Resolved inside the snapshot's lock so count and snapshot agree.
+    long idx_total = -1;
+    std::string idx_phase;  // the selector's phase value when eligible
+    bool idx_eligible = false;
+    if (limit > 0 && cont.empty() && lsq.empty() &&
+        fs.rfind("status.phase=", 0) == 0 && fs.find(',') == std::string::npos &&
+        fs.find("!=") == std::string::npos) {
+      idx_phase = fs.substr(13);
+      if (!idx_phase.empty() && idx_phase[0] == '=')
+        idx_phase.erase(0, 1);  // the '==' dialect match_field_selector takes
+      // any further '=' or whitespace means a dialect the exact-key index
+      // cannot answer — leave it to the authoritative scan
+      idx_eligible = !idx_phase.empty() &&
+                     idx_phase.find_first_of("= \t") == std::string::npos;
+    }
     // Continuation pages snapshot a BOUNDED slice (each page must be O(page)
     // lock work, or a full paginated re-list at 1M objects goes quadratic in
     // pointer copies); a short page with a continue token is protocol-legal,
@@ -1837,6 +1883,15 @@ bool App::handle_request(ConnIO& io, Request& req) {
         }
         rv_now = store.rv;
         token_rv = rv_now;  // first page stamps its revision
+        if (idx_eligible) {
+          auto pit = store.phase_idx[m.kind].find(idx_phase);
+          idx_total =
+              pit == store.phase_idx[m.kind].end() ? 0 : pit->second;
+        } else if (limit > 0 && lsq.empty() && fs.empty()) {
+          // selector-less count (limit=1 population polls): every
+          // stored entry matches, so the map size IS the total
+          idx_total = (long)kindmap.size();
+        }
       }
     }
     // The continue token is rebuilt from the entry's own (immutable)
@@ -1863,8 +1918,17 @@ bool App::handle_request(ConnIO& io, Request& req) {
     bool first = true;
     for (size_t i = 0; i < snap.size(); i++) {
       const JVal& obj = snap[i]->obj;
+      // the index knows no further entry can match: stop scanning (a
+      // zero-match poll — e.g. phase=Running before any transition —
+      // would otherwise walk the whole store)
+      if (idx_total >= 0 && count >= idx_total) break;
       if (limit && count >= limit) {
         if (!count_rest) break;  // continuation pages stop at the cut
+        if (idx_total >= 0) {
+          // indexed remainder: total matches minus those already emitted
+          remaining = std::max(0L, idx_total - count);
+          break;
+        }
         if (!match_field_selector(obj, fs)) continue;
         if (!ls.matches(obj)) continue;
         remaining++;

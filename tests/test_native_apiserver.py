@@ -450,3 +450,68 @@ def test_paginated_list_no_trailing_empty_page(client):
         assert raw["items"], "token led to an empty trailing page"
         token = (raw.get("metadata") or {}).get("continue")
     assert pages == [["tp-b"]]
+
+
+def test_phase_index_counts_stay_consistent(client):
+    """The incremental status.phase index answers limit=1 +
+    fieldSelector=status.phase=X polls; its counts must track creates,
+    status patches, graceful+force deletes, and stay identical to what a
+    full selector scan reports (larger limit disables the index cut)."""
+    def count(phase, limit=1):
+        q = urllib.parse.quote(f"status.phase={phase}")
+        raw = client._json(
+            "GET",
+            client.server + f"/api/v1/pods?fieldSelector={q}&limit={limit}",
+        )
+        return len(raw["items"]) + int(
+            (raw.get("metadata") or {}).get("remainingItemCount") or 0
+        )
+
+    for i in range(7):
+        client.create("pods", make_pod(f"pi-{i}", node="n0"))
+    assert count("Pending") == 7
+    assert count("Running") == 0
+    for i in range(4):
+        client.patch_status(
+            "pods", "default", f"pi-{i}", {"status": {"phase": "Running"}}
+        )
+    assert count("Pending") == 3
+    assert count("Running") == 4
+    # indexed (limit=1) and scan (limit high enough to emit everything)
+    # must agree exactly
+    assert count("Running", limit=50) == 4
+    # force delete (grace 0) drops the count
+    client.delete("pods", "default", "pi-0", grace_seconds=0)
+    assert count("Running") == 3
+    # graceful delete only marks deletionTimestamp: still Running until
+    # the engine's force-delete lands
+    client.delete("pods", "default", "pi-1", grace_seconds=1)
+    assert count("Running") == 3
+    client.delete("pods", "default", "pi-1", grace_seconds=0)
+    assert count("Running") == 2
+    # selector-less population count uses the map-size fast path
+    raw = client._json("GET", client.server + "/api/v1/pods?limit=1")
+    assert len(raw["items"]) + int(
+        raw["metadata"].get("remainingItemCount") or 0
+    ) == 5
+
+
+def test_phase_index_double_equals_dialect(client):
+    """fieldSelector supports both '=' and '==' — the indexed count path
+    must answer the '==' spelling identically to the scan (regression:
+    the index key once included the second '=', returning items:[])."""
+    for i in range(3):
+        client.create("pods", make_pod(f"de-{i}", node="n0"))
+    client.patch_status(
+        "pods", "default", "de-0", {"status": {"phase": "Running"}}
+    )
+    for sel in ("status.phase=Running", "status.phase==Running"):
+        q = urllib.parse.quote(sel)
+        raw = client._json(
+            "GET", client.server + f"/api/v1/pods?fieldSelector={q}&limit=1"
+        )
+        n = len(raw["items"]) + int(
+            (raw.get("metadata") or {}).get("remainingItemCount") or 0
+        )
+        assert n == 1, (sel, raw)
+        assert raw["items"][0]["metadata"]["name"] == "de-0"

@@ -169,7 +169,9 @@ def test_cost_model_smoke():
     # the echo drop must stay cheaper than full ingest
     assert d["engine"]["echo_modified_us"] < d["engine"]["survivor_added_us"]
     assert d["apiserver"]["create_pod_us"] > 0
-    assert d["apiserver"]["poll_running_count_us"] > 0
+    # the phase index answers a zero-match Running poll in ~0 CPU at this
+    # scale (below /proc's tick resolution) — only non-negativity is pinned
+    assert d["apiserver"]["poll_running_count_us"] >= 0
     curve = d["model"]["predicted_pods_per_s_by_cores"]
     assert curve["1"] > 0 and curve["4"] >= curve["1"]
     assert d["model"]["per_pod_us"]["total_1core"] > 0
