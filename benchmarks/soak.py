@@ -317,6 +317,7 @@ def main() -> None:
     engine = srv = None
     procs: list[subprocess.Popen] = []
     metrics_url = ""
+    native_api = False  # spawned C++ apiservers (indexed progress polls)
     if args.apiserver:
         url = args.apiserver
     elif args.in_process:
@@ -347,6 +348,7 @@ def main() -> None:
         from kwok_tpu import native
 
         apiserver_bin = native.apiserver_binary()
+        native_api = bool(apiserver_bin)
         member_urls = []
         for m in range(n_members):
             api_port = netutil.get_unused_port()
@@ -501,13 +503,19 @@ def main() -> None:
             ))
         create_nodes_s = time.perf_counter() - t_nodes
         deadline = time.monotonic() + args.timeout
-        # Pod-progress polls are limit=1 + remainingItemCount, answered
-        # from the C++ server's incremental status.phase index (O(1)
-        # payload AND ~O(1) server work) — so the cadence can be tight:
-        # a coarse poll adds up to one full interval of phantom tail to
-        # every measured phase. The node-Ready poll parses a full list,
-        # so it keeps a coarser cadence.
-        poll = max(0.1, min(2.0, args.pods / 500000))
+        # Pod-progress polls are limit=1 + remainingItemCount. Against the
+        # C++ apiserver they are answered from its incremental status.phase
+        # index (O(1) payload AND ~O(1) server work), so the cadence can be
+        # tight — a coarse poll adds up to one full interval of phantom
+        # tail to every measured phase. The Python mockserver (and unknown
+        # --apiserver targets) scan O(store) per poll: there the old
+        # store-scaled cadence stands, or the poller itself would inflate
+        # the apiserver CPU the soak measures. Node-Ready polls parse a
+        # full list, so they always keep a coarser cadence.
+        if native_api:
+            poll = max(0.1, min(2.0, args.pods / 500000))
+        else:
+            poll = max(0.2, min(2.0, args.pods / 50000))
         node_poll = max(0.25, min(2.0, args.nodes / 20000))
 
         def ready_nodes() -> int:

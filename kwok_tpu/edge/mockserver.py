@@ -719,7 +719,7 @@ class FakeKube:
 
 _PATHS = re.compile(
     r"^/api/v1(?:/namespaces/(?P<ns>[^/]+))?/(?P<kind>nodes|pods|events)"
-    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|binding))?$"
+    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|binding|log))?$"
 )
 _RBAC_PATHS = re.compile(
     r"^/apis/rbac\.authorization\.k8s\.io/v1"
@@ -749,7 +749,52 @@ def _match_path(path: str):
         return None
     if m and m.group("sub") == "status" and m.group("kind") not in ("nodes", "pods"):
         return None
+    if m and m.group("sub") == "log" and m.group("kind") != "pods":
+        return None
     return m
+
+
+def pod_log_status(
+    store, ns: str | None, name: str, container: str | None
+) -> tuple[dict, int]:
+    """The apiserver's answer to GET pods/NAME/log against a kwok cluster.
+
+    Fake pods have no kubelet: the real apiserver proxies the request to
+    the node's InternalIP:10250 and surfaces the dial failure as a 500
+    Status — that exact dialect is what kubectl users see on upstream
+    kwok, so both mock apiservers reproduce it (an unscheduled pod gets
+    the 400 'not have a host assigned' answer instead)."""
+    pod = store.get("pods", ns, name)
+    if pod is None:
+        return {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "message": f'pods "{name}" not found',
+            "reason": "NotFound", "code": 404,
+        }, 404
+    node_name = (pod.get("spec") or {}).get("nodeName") or ""
+    if not node_name:
+        return {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "message": f"pod {name} does not have a host assigned",
+            "reason": "BadRequest", "code": 400,
+        }, 400
+    if not container:
+        containers = (pod.get("spec") or {}).get("containers") or []
+        container = (containers[0].get("name") if containers else "") or ""
+    node = store.get("nodes", None, node_name)
+    ip = node_name
+    for addr in ((node or {}).get("status") or {}).get("addresses") or []:
+        if addr.get("type") == "InternalIP" and addr.get("address"):
+            ip = addr["address"]
+            break
+    url = f"https://{ip}:10250/containerLogs/{ns or ''}/{name}/{container}"
+    return {
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "message": (
+            f'Get "{url}": dial tcp {ip}:10250: connect: connection refused'
+        ),
+        "code": 500,
+    }, 500
 
 
 def _api_resource(name: str, kind: str, namespaced: bool, subs=()):
@@ -1257,6 +1302,15 @@ class HttpFakeApiserver:
                     return
                 q = urllib.parse.parse_qs(parsed.query)
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
+                if m.group("sub") == "log":
+                    # ns passed verbatim (no defaulting): a namespace-less
+                    # pods/NAME/log matches neither server's store key —
+                    # the C++ mirror behaves identically
+                    doc, code = pod_log_status(
+                        store, ns, name, (q.get("container") or [None])[0]
+                    )
+                    self._send_json(doc, code)
+                    return
                 if name:
                     body = store.get_bytes(kind, ns, name)
                     if body is None:
@@ -1364,8 +1418,12 @@ class HttpFakeApiserver:
                     return
                 parsed = urllib.parse.urlparse(self.path)
                 m = _match_path(parsed.path)
-                if not m or not m.group("name") or m.group("sub") == "binding":
-                    self.send_error(404)  # binding is create-only
+                if (
+                    not m
+                    or not m.group("name")
+                    or m.group("sub") in ("binding", "log")
+                ):
+                    self.send_error(404)  # binding create-only, log GET-only
                     return
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
                 patch = self._body()
@@ -1383,8 +1441,12 @@ class HttpFakeApiserver:
                     return
                 parsed = urllib.parse.urlparse(self.path)
                 m = _match_path(parsed.path)
-                if not m or not m.group("name") or m.group("sub") == "binding":
-                    self.send_error(404)  # binding is create-only
+                if (
+                    not m
+                    or not m.group("name")
+                    or m.group("sub") in ("binding", "log")
+                ):
+                    self.send_error(404)  # binding create-only, log GET-only
                     return
                 body = self._body() or {}
                 grace = body.get("gracePeriodSeconds")

@@ -9,8 +9,11 @@ surface for the reference's e2e assertions (get / apply / delete /
 get --raw) against any apiserver this framework speaks to.
 
 Deliberately NOT a full kubectl: printers are table/wide/json/yaml/name,
-no server-side apply, no openapi validation, no exec/logs (the reference
-snapshot's fake pods have no streaming endpoints either). `get -w`
+no server-side apply, no openapi validation, no exec/attach/port-forward
+(the reference snapshot's fake pods have no streaming endpoints either).
+`logs` is wired and surfaces the kwok reality: the apiserver's log proxy
+dials the fake node's kubelet and fails, so users get real kubectl's
+`Error from server: ... connection refused` dialect. `get -w`
 streams row-per-event like real kubectl (bounded by --request-timeout),
 `-l` label selectors scope lists and watches server-side, `describe
 nodes|pods` renders the sectioned report (conditions, capacity, system
@@ -25,6 +28,7 @@ import argparse
 import json
 import sys
 import time
+from urllib.parse import quote as _q
 
 from kwok_tpu.edge.httpclient import HttpKubeClient
 from kwok_tpu.edge.merge import strategic_merge
@@ -281,6 +285,11 @@ def main(argv: list[str] | None = None) -> int:
     # None = omit DeleteOptions.gracePeriodSeconds (server-side default,
     # like real kubectl); 0 = force delete
     d.add_argument("--grace-period", type=int, default=None)
+
+    lg = sub.add_parser("logs")
+    lg.add_argument("pod", help="POD name")
+    lg.add_argument("-n", "--namespace", default=None)
+    lg.add_argument("-c", "--container", default=None)
 
     v = sub.add_parser("version")
     v.add_argument("--client", action="store_true")
@@ -824,11 +833,46 @@ def _wait(args, client: HttpKubeClient) -> int:
     return rc
 
 
+def _logs(args, client: HttpKubeClient) -> int:
+    """`kubectl logs POD [-c C]` — on a kwok cluster the apiserver's log
+    proxy dials the fake node's kubelet and fails; real kubectl surfaces
+    that Status message as `Error from server: ...` and exits 1. The shim
+    reproduces exactly that (and passes real logs through, should the
+    server actually serve some)."""
+    import urllib.error
+
+    ns = args.namespace or "default"
+    path = f"/api/v1/namespaces/{_q(ns)}/pods/{_q(args.pod)}/log"
+    if args.container:
+        path += f"?container={_q(args.container)}"
+    try:
+        with client._request("GET", client.server + path) as r:
+            sys.stdout.write(r.read().decode())
+        return 0
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            doc = None
+        if not isinstance(doc, dict):
+            doc = {}
+        msg = doc.get("message") or body
+        r = doc.get("reason")
+        # real kubectl prints the parenthesized reason for 4xx Status
+        # answers but a bare "Error from server:" for 500s
+        reason = f" ({r})" if r and e.code != 500 else ""
+        print(f"Error from server{reason}: {msg}", file=sys.stderr)
+        return 1
+
+
 def _run(args, client: HttpKubeClient) -> int:
     if args.verb == "wait":
         return _wait(args, client)
     if args.verb == "describe":
         return _describe(args, client)
+    if args.verb == "logs":
+        return _logs(args, client)
     if args.verb == "get":
         if args.raw:
             # client._request applies the TLS context, CA, client cert and

@@ -1240,6 +1240,7 @@ struct PathMatch {
   std::string ns, name;
   bool status = false;
   bool binding = false;
+  bool log = false;  // pods/NAME/log (GET-only; answers the kwok dialect)
 };
 
 static PathMatch match_path(const std::string& path) {
@@ -1302,6 +1303,7 @@ static PathMatch match_path(const std::string& path) {
     // status under nodes/pods, binding under pods (404 otherwise)
     if (parts[i] == "status" && m.kind <= 1) m.status = true;
     else if (parts[i] == "binding" && m.kind == 1) m.binding = true;
+    else if (parts[i] == "log" && m.kind == 1) m.log = true;
     else return m;
     i++;
   }
@@ -1603,10 +1605,78 @@ bool App::handle_request(ConnIO& io, Request& req) {
   PathMatch m = match_path(req.path);
   if (m.binding && req.method != "POST")
     return respond(404, "{\"kind\":\"Status\",\"code\":404}");
+  if (m.log && req.method != "GET")
+    return respond(404, "{\"kind\":\"Status\",\"code\":404}");
   if (!m.ok || (req.method != "GET" && m.name.empty() && req.method != "POST"))
     return respond(404, "{\"kind\":\"Status\",\"code\":404}");
 
   Key key{m.ns, m.name};
+
+  if (m.log) {
+    // GET pods/NAME/log on a kwok cluster: fake pods have no kubelet, so
+    // the real apiserver's proxy to InternalIP:10250 fails and users see
+    // the dial error as a 500 Status (mirrors mockserver.pod_log_status;
+    // an unscheduled pod gets 400 'not have a host assigned').
+    std::string node_name, container = q.count("container") ? q["container"] : "";
+    bool found = false;
+    std::string node_ip;
+    {
+      std::lock_guard<std::mutex> lk(store.mu);
+      auto it = store.kinds[1].find(key);
+      if (it != store.kinds[1].end()) {
+        found = true;
+        node_name = field_str(it->second->obj, "spec.nodeName");
+        if (container.empty()) {
+          const JVal* spec = it->second->obj.find("spec");
+          const JVal* ctrs = spec && spec->is_obj() ? spec->find("containers") : nullptr;
+          if (ctrs && ctrs->type == JVal::ARR && !ctrs->arr.empty())
+            container = field_str(ctrs->arr[0], "name");
+        }
+      }
+      if (!node_name.empty()) {
+        auto nit = store.kinds[0].find(Key{"", node_name});
+        node_ip = node_name;
+        if (nit != store.kinds[0].end()) {
+          const JVal* st = nit->second->obj.find("status");
+          const JVal* addrs = st && st->is_obj() ? st->find("addresses") : nullptr;
+          if (addrs && addrs->type == JVal::ARR)
+            for (const JVal& a : addrs->arr)
+              if (field_str(a, "type") == "InternalIP" &&
+                  !field_str(a, "address").empty()) {
+                node_ip = field_str(a, "address");
+                break;
+              }
+        }
+      }
+    }
+    if (!found) {
+      std::string body =
+          "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":\"Failure\","
+          "\"message\":\"pods \\\"";
+      json_escape(body, m.name);
+      body += "\\\" not found\",\"reason\":\"NotFound\",\"code\":404}";
+      return respond(404, body);
+    }
+    if (node_name.empty()) {
+      std::string body =
+          "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":\"Failure\","
+          "\"message\":\"pod ";
+      json_escape(body, m.name);
+      body += " does not have a host assigned\",\"reason\":\"BadRequest\","
+              "\"code\":400}";
+      return respond(400, body);
+    }
+    std::string url = "https://" + node_ip + ":10250/containerLogs/" + m.ns +
+                      "/" + m.name + "/" + container;
+    std::string msg = "Get \"" + url + "\": dial tcp " + node_ip +
+                      ":10250: connect: connection refused";
+    std::string body =
+        "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":\"Failure\","
+        "\"message\":\"";
+    json_escape(body, msg);
+    body += "\",\"code\":500}";
+    return respond(500, body);
+  }
 
   if (req.method == "GET") {
     if (!m.name.empty()) {

@@ -734,3 +734,34 @@ def test_get_jsonpath_output(srv, kubeconfig, capsys):
     with pytest.raises(SystemExit) as e:
         kubectl(kubeconfig, "get", "nodes", "-o", "bogus")
     assert "unable to match a printer" in str(e.value)
+
+
+def test_logs_fake_pod_dialect(srv, kubeconfig, capsys):
+    """`kubectl logs` on a kwok cluster: fake pods have no kubelet, so the
+    apiserver's log proxy fails with the dial error — the shim surfaces it
+    as `Error from server: ...` and exits 1, exactly like real kubectl
+    against upstream kwok. Unscheduled pods get the host-assignment error;
+    missing pods the NotFound dialect."""
+    node = make_node("ln-1")
+    srv.store.create("nodes", node)
+    srv.store.patch_status("nodes", None, "ln-1", {"status": {
+        "addresses": [{"type": "InternalIP", "address": "10.9.8.7"}]}})
+    srv.store.create("pods", make_pod("lp-1", node="ln-1"))
+    assert kubectl(kubeconfig, "logs", "lp-1") == 1
+    err = capsys.readouterr().err
+    assert "Error from server: " in err
+    assert '"https://10.9.8.7:10250/containerLogs/default/lp-1/c"' in err
+    assert "connect: connection refused" in err
+    # container flag lands in the proxied path
+    assert kubectl(kubeconfig, "logs", "lp-1", "-c", "side") == 1
+    assert "/containerLogs/default/lp-1/side" in capsys.readouterr().err
+    # unscheduled pod
+    unbound = make_pod("lp-2")
+    unbound["spec"]["nodeName"] = ""
+    srv.store.create("pods", unbound)
+    assert kubectl(kubeconfig, "logs", "lp-2") == 1
+    assert "does not have a host assigned" in capsys.readouterr().err
+    # missing pod
+    assert kubectl(kubeconfig, "logs", "absent") == 1
+    err = capsys.readouterr().err
+    assert "(NotFound)" in err and '"absent" not found' in err

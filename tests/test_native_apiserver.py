@@ -515,3 +515,48 @@ def test_phase_index_double_equals_dialect(client):
         )
         assert n == 1, (sel, raw)
         assert raw["items"][0]["metadata"]["name"] == "de-0"
+
+
+def test_pod_log_proxy_dialect(client):
+    """GET pods/NAME/log: both mock apiservers answer with the kwok
+    dialect — the apiserver's kubelet-proxy dial failure (fake nodes run
+    no kubelet), host-not-assigned for unscheduled pods, NotFound
+    otherwise. Python-parity-pinned via mockserver.pod_log_status."""
+    import urllib.error
+
+    from kwok_tpu.edge.mockserver import FakeKube, pod_log_status
+
+    node = make_node("log-n")
+    client.create("nodes", node)
+    client.patch_status("nodes", None, "log-n", {"status": {
+        "addresses": [{"type": "InternalIP", "address": "10.1.2.3"}]}})
+    client.create("pods", make_pod("log-p", node="log-n"))
+    unbound = make_pod("log-u")
+    unbound["spec"]["nodeName"] = ""
+    client.create("pods", unbound)
+
+    py = FakeKube()
+    py.create("nodes", node)
+    py.patch_status("nodes", None, "log-n", {"status": {
+        "addresses": [{"type": "InternalIP", "address": "10.1.2.3"}]}})
+    py.create("pods", make_pod("log-p", node="log-n"))
+    py.create("pods", unbound)
+
+    def native_status(name, container=None):
+        path = f"{client.server}/api/v1/namespaces/default/pods/{name}/log"
+        if container:
+            path += f"?container={container}"
+        try:
+            with client._request("GET", path) as r:
+                return json.loads(r.read()), r.status
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read()), e.code
+
+    for name, container in (
+        ("log-p", None), ("log-p", "side"), ("log-u", None), ("gone", None)
+    ):
+        got, code = native_status(name, container)
+        want, want_code = pod_log_status(py, "default", name, container)
+        assert code == want_code, (name, got)
+        assert got["message"] == want["message"], (name, got, want)
+        assert got["code"] == want["code"]
