@@ -1,0 +1,300 @@
+"""One-command SOAK evidence recapture (the soak-side sibling of
+hack/tpu-recapture.sh): regenerates every leg of SOAK_r{N}.json with zero
+human judgment, all trials recorded, medians reported.
+
+Legs (each skippable via --skip):
+  homogeneous    3x federated soak (50k pods x 10k nodes, 4 C++ apiservers)
+  heterogeneous  2x with per-member rule sets (--member-config)
+  hold           heartbeat hold at the reference 30s cadence + 10k churn
+  tpu            N interleaved engine-on-TPU vs CPU pairs (solo topology),
+                 needs the axon tunnel (KWOK_TPU_SOAK_PLATFORM=axon)
+  fedtpu         1 federated-on-TPU vs CPU pair
+  hbmicro        device heartbeat wheel at 1M rows (on chip)
+  costmodel      per-op cost tables, validated against the homogeneous
+                 median measured THIS run
+  endurance      45-min full-topology steady state (longest; runs last)
+
+Usage:
+  python benchmarks/compose_soak.py --out SOAK_r05.json
+  python benchmarks/compose_soak.py --skip endurance --skip tpu ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def run_json(args: list[str], timeout: float, env: dict | None = None):
+    """Run a rig and parse its final stdout line as JSON; returns (doc,
+    raw-tail) — doc None on failure, with the tail kept as evidence."""
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    try:
+        p = subprocess.run(
+            args, capture_output=True, text=True, timeout=timeout, env=e,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s"
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+    if p.returncode != 0:
+        # a rig that printed a result line but exited nonzero is a FAILED
+        # trial — it must not enter the medians as clean evidence
+        return None, (
+            f"exit {p.returncode}: "
+            + (lines[-1][:300] if lines else "")
+            + "\n" + (p.stderr or "")[-1000:]
+        )
+    if not lines:
+        return None, (p.stderr or "")[-1500:]
+    try:
+        return json.loads(lines[-1]), None
+    except json.JSONDecodeError:
+        return None, (lines[-1][:500] + "\n" + (p.stderr or "")[-1000:])
+
+
+def soak(extra: list[str], timeout: float = 420, env: dict | None = None):
+    return run_json(
+        [PY, "benchmarks/soak.py", "--nodes", "10000", "--pods", "50000",
+         *extra],
+        timeout, env,
+    )
+
+
+def med(vals: list[float]) -> float:
+    return round(statistics.median(vals), 1) if vals else 0.0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="SOAK_r05.json")
+    p.add_argument("--skip", action="append", default=[],
+                   help="leg name to skip (repeatable)")
+    p.add_argument("--tpu-pairs", type=int, default=6)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--endurance-duration", type=float, default=2700.0)
+    args = p.parse_args()
+    skip = set(args.skip)
+    t_start = time.time()
+    # round number + sibling artifact names derive from --out so a future
+    # round's capture never overwrites this round's evidence files
+    import re
+
+    m_round = re.search(r"r(\d+)", os.path.basename(args.out))
+    round_no = int(m_round.group(1)) if m_round else 0
+    costmodel_name = f"COSTMODEL_r{round_no:02d}.json"
+
+    doc: dict = {
+        "round": round_no,
+        "config": "50000 pods x 10000 nodes over HTTP, federated over 4 "
+                  "C++ apiservers, 1-core burstable-vCPU host",
+        "method": "benchmarks/compose_soak.py — all trials recorded, "
+                  "medians reported, runs strictly serial; TPU legs "
+                  "interleaved with same-topology CPU runs (the host's "
+                  "burstable vCPU makes non-interleaved cross-platform "
+                  "comparison meaningless)",
+        "failures": {},
+    }
+
+    def fail(leg, err):
+        if err:
+            doc["failures"][leg] = err
+
+    # ---- homogeneous -----------------------------------------------------
+    if "homogeneous" not in skip:
+        trials, best = [], None
+        for _ in range(args.trials):
+            d, err = soak(["--members", "4"])
+            fail("homogeneous", err)
+            if d:
+                trials.append(d["pods_per_s"])
+                if best is None or d["pods_per_s"] > best["pods_per_s"]:
+                    best = d
+        doc["homogeneous_trials_pods_per_s"] = trials
+        doc["homogeneous_median_pods_per_s"] = med(trials)
+        doc["homogeneous_best"] = best
+
+    # ---- heterogeneous ---------------------------------------------------
+    if "heterogeneous" not in skip:
+        het_flags = [
+            "--members", "4",
+            "--member-config", "",
+            "--member-config", "benchmarks/configs/member1.yaml",
+            "--member-config", "",
+            "--member-config", "benchmarks/configs/member3.yaml",
+        ]
+        trials, best = [], None
+        for _ in range(2):
+            d, err = soak(het_flags)
+            fail("heterogeneous", err)
+            if d:
+                trials.append(d["pods_per_s"])
+                if best is None or d["pods_per_s"] > best["pods_per_s"]:
+                    best = d
+        doc["heterogeneous_trials_pods_per_s"] = trials
+        doc["heterogeneous"] = best
+
+    # ---- hold + churn at reference cadence -------------------------------
+    if "hold" not in skip:
+        d, err = soak(
+            ["--members", "4", "--heartbeat-interval", "30",
+             "--hold", "330", "--churn", "10000"],
+            timeout=900,
+        )
+        fail("hold", err)
+        if d:
+            line = round(10000 / 30.0, 1)
+            doc["hold_steady_state"] = {
+                "what": "reference cadence at soak scale: 10k nodes "
+                        "heartbeating every 30s, held >=330s after 50k "
+                        "pods Running, then 10k graceful churn deletes",
+                "pods_per_s": d["pods_per_s"],
+                "hold_s": d.get("hold_s"),
+                "heartbeats_per_s": d.get("heartbeats_per_s"),
+                "line_rate_per_s": line,
+                "delivery_vs_line_rate": round(
+                    (d.get("heartbeats_per_s") or 0) / line, 4
+                ),
+                "churn_deletes_per_s": d.get("churn_deletes_per_s"),
+                "churn_elapsed_s": d.get("churn_elapsed_s"),
+            }
+
+    # ---- engine on TPU (interleaved pairs, solo topology) ----------------
+    axon = {"KWOK_TPU_SOAK_PLATFORM": "axon"}
+    if "tpu" not in skip:
+        tpu_t, cpu_t, tpu_detail = [], [], []
+        for i in range(args.tpu_pairs):
+            # a pair enters the stats only when BOTH halves succeeded —
+            # one-sided appends would zip rates from different host
+            # windows, exactly what interleaving exists to prevent
+            d_t, err = soak([], env=axon)
+            fail("tpu", err)
+            d_c, err = soak([])
+            fail("tpu_cpu_pair", err)
+            if d_t and d_c:
+                e = d_t.get("engine", {})
+                tpu_t.append(d_t["pods_per_s"])
+                cpu_t.append(d_c["pods_per_s"])
+                tpu_detail.append({
+                    "pods_per_s": d_t["pods_per_s"],
+                    "ticks": e.get("ticks"),
+                    "tick_kernel_wait_s": round(e.get("tick_kernel_s", 0), 3),
+                })
+        doc["engine_on_tpu"] = {
+            "what": "KWOK_TPU_SOAK_PLATFORM=axon: the ENGINE process (and "
+                    "only it) claims the tunneled v5e chip; full watch -> "
+                    "pipelined device tick -> strategic-merge patch loop "
+                    "on real hardware, interleaved with same-topology CPU "
+                    "runs",
+            "topology": "50k pods x 10k nodes, 1 C++ apiserver, separate procs",
+            "tpu_trials_pods_per_s": tpu_t,
+            "cpu_trials_pods_per_s_same_topology": cpu_t,
+            "tpu_median": med(tpu_t),
+            "cpu_median": med(cpu_t),
+            "tpu_detail": tpu_detail,
+            "pairs_won_by_tpu": sum(
+                1 for a, b in zip(tpu_t, cpu_t) if a > b
+            ),
+            "note": "first-grant runs after the chip changes hands are "
+                    "consistently slow (relay warm-up; visible as high "
+                    "tick counts) — all trials recorded regardless",
+        }
+
+    # ---- federated on TPU ------------------------------------------------
+    if "fedtpu" not in skip:
+        d_t, err = soak(["--members", "4"], env=axon)
+        fail("fedtpu", err)
+        d_c, err = soak(["--members", "4"])
+        fail("fedtpu_cpu_pair", err)
+        if d_t and d_c:
+            e = d_t.get("engine", {})
+            doc["federated_engine_on_tpu"] = {
+                "what": "4-member FederatedEngine — one stacked state per "
+                        "kind, one fused kernel, four apiservers — ticking "
+                        "on the tunneled v5e with the pipelined loop",
+                "topology": "50k pods x 10k nodes federated over 4 C++ "
+                            "apiservers",
+                "tpu_pods_per_s": d_t["pods_per_s"],
+                "cpu_pods_per_s_paired": d_c["pods_per_s"],
+                "tick_kernel_wait_s_total_tpu": round(
+                    e.get("tick_kernel_s", 0), 3
+                ),
+                "ticks": e.get("ticks"),
+            }
+
+    # ---- device heartbeat micro -----------------------------------------
+    if "hbmicro" not in skip:
+        d, err = run_json([PY, "benchmarks/hb_micro.py"], 600)
+        fail("hbmicro", err)
+        if d:
+            doc["heartbeat_device_micro"] = d
+
+    # ---- cost model, validated against THIS run's median -----------------
+    if "costmodel" not in skip:
+        measured = doc.get("homogeneous_median_pods_per_s") or 0
+        cm_args = [PY, "benchmarks/cost_model.py"]
+        if measured:
+            cm_args += ["--measured", str(measured)]
+        env = {"JAX_PLATFORMS": "cpu"}
+        e = dict(os.environ)
+        e.pop("PALLAS_AXON_POOL_IPS", None)
+        e.update(env)
+        try:
+            p2 = subprocess.run(cm_args, capture_output=True, text=True,
+                                timeout=1200, env=e, cwd=REPO)
+            d = json.loads(p2.stdout.strip().splitlines()[-1])
+            with open(os.path.join(REPO, costmodel_name), "w") as f:
+                json.dump(d, f)
+                f.write("\n")
+            doc["cost_model"] = {
+                "see": costmodel_name,
+                "validation": d.get("validation"),
+                "summary": "per-process per-op CPU tables + pods/s-vs-"
+                           "cores curve; 1-core prediction validated "
+                           "against the homogeneous median measured in "
+                           "THIS capture",
+            }
+            if p2.returncode != 0:
+                fail("costmodel", "validation tolerance gate failed "
+                     f"(see {costmodel_name})")
+        except (subprocess.TimeoutExpired, json.JSONDecodeError,
+                IndexError) as exc:
+            fail("costmodel", str(exc))
+
+    # ---- endurance (longest leg last) ------------------------------------
+    if "endurance" not in skip:
+        d, err = run_json(
+            [PY, "benchmarks/endurance.py", "--nodes", "10000",
+             "--pods", "50000", "--heartbeat-interval", "30",
+             "--duration", str(args.endurance_duration),
+             "--rebase-after", "600", "--churn-every", "60",
+             "--churn-pods", "200", "--sample-every", "60"],
+            timeout=args.endurance_duration + 1800,
+        )
+        fail("endurance", err)
+        if d:
+            doc["endurance"] = d
+
+    doc["capture_elapsed_s"] = round(time.time() - t_start, 1)
+    out = os.path.join(REPO, args.out)
+    with open(out + ".tmp", "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(out + ".tmp", out)
+    print(f"wrote {args.out} "
+          f"(failures: {list(doc['failures']) or 'none'})", file=sys.stderr)
+    return 0 if not doc["failures"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

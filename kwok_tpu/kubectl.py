@@ -859,9 +859,9 @@ def _logs(args, client: HttpKubeClient) -> int:
             doc = {}
         msg = doc.get("message") or body
         r = doc.get("reason")
-        # real kubectl prints the parenthesized reason for 4xx Status
-        # answers but a bare "Error from server:" for 500s
-        reason = f" ({r})" if r and e.code != 500 else ""
+        # real kubectl parenthesizes Status.reason whenever present; the
+        # kwok dial-failure 500 carries none, yielding the bare form
+        reason = f" ({r})" if r else ""
         print(f"Error from server{reason}: {msg}", file=sys.stderr)
         return 1
 
