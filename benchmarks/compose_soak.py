@@ -80,6 +80,11 @@ def main() -> int:
     p.add_argument("--out", default="SOAK_r05.json")
     p.add_argument("--skip", action="append", default=[],
                    help="leg name to skip (repeatable)")
+    p.add_argument("--merge", action="store_true",
+                   help="start from the existing --out artifact and only "
+                   "replace the legs actually run (skipped legs keep "
+                   "their previous sections — re-run a failed leg "
+                   "without discarding an hour-long endurance result)")
     p.add_argument("--tpu-pairs", type=int, default=6)
     p.add_argument("--trials", type=int, default=3)
     p.add_argument("--endurance-duration", type=float, default=2700.0)
@@ -94,7 +99,11 @@ def main() -> int:
     round_no = int(m_round.group(1)) if m_round else 0
     costmodel_name = f"COSTMODEL_r{round_no:02d}.json"
 
-    doc: dict = {
+    doc: dict = {}
+    if args.merge and os.path.exists(os.path.join(REPO, args.out)):
+        with open(os.path.join(REPO, args.out)) as f:
+            doc = json.load(f)
+    doc.update({
         "round": round_no,
         "config": "50000 pods x 10000 nodes over HTTP, federated over 4 "
                   "C++ apiservers, 1-core burstable-vCPU host",
@@ -103,15 +112,30 @@ def main() -> int:
                   "interleaved with same-topology CPU runs (the host's "
                   "burstable vCPU makes non-interleaved cross-platform "
                   "comparison meaningless)",
-        "failures": {},
+    })
+    doc["failures"] = {
+        k: v for k, v in (doc.get("failures") or {}).items()
+        if k.split("_")[0] in skip  # kept legs keep their recorded failures
     }
 
     def fail(leg, err):
+        # accumulate: a multi-trial leg may fail more than once for
+        # different reasons, and "all trials recorded" includes errors
         if err:
-            doc["failures"][leg] = err
+            doc["failures"].setdefault(leg, []).append(err)
+
+    def reset(*sections):
+        # a leg that RUNS first drops its previous sections: a failed
+        # re-run must leave a hole + failure log, never stale numbers
+        # under the new capture's label (and conversely no leg fabricates
+        # a zero-filled section when nothing succeeded)
+        for s in sections:
+            doc.pop(s, None)
 
     # ---- homogeneous -----------------------------------------------------
     if "homogeneous" not in skip:
+        reset("homogeneous_trials_pods_per_s",
+              "homogeneous_median_pods_per_s", "homogeneous_best")
         trials, best = [], None
         for _ in range(args.trials):
             d, err = soak(["--members", "4"])
@@ -126,6 +150,7 @@ def main() -> int:
 
     # ---- heterogeneous ---------------------------------------------------
     if "heterogeneous" not in skip:
+        reset("heterogeneous_trials_pods_per_s", "heterogeneous")
         het_flags = [
             "--members", "4",
             "--member-config", "",
@@ -146,6 +171,7 @@ def main() -> int:
 
     # ---- hold + churn at reference cadence -------------------------------
     if "hold" not in skip:
+        reset("hold_steady_state")
         d, err = soak(
             ["--members", "4", "--heartbeat-interval", "30",
              "--hold", "330", "--churn", "10000"],
@@ -172,6 +198,7 @@ def main() -> int:
     # ---- engine on TPU (interleaved pairs, solo topology) ----------------
     axon = {"KWOK_TPU_SOAK_PLATFORM": "axon"}
     if "tpu" not in skip:
+        reset("engine_on_tpu")
         tpu_t, cpu_t, tpu_detail = [], [], []
         for i in range(args.tpu_pairs):
             # a pair enters the stats only when BOTH halves succeeded —
@@ -190,28 +217,32 @@ def main() -> int:
                     "ticks": e.get("ticks"),
                     "tick_kernel_wait_s": round(e.get("tick_kernel_s", 0), 3),
                 })
-        doc["engine_on_tpu"] = {
-            "what": "KWOK_TPU_SOAK_PLATFORM=axon: the ENGINE process (and "
-                    "only it) claims the tunneled v5e chip; full watch -> "
-                    "pipelined device tick -> strategic-merge patch loop "
-                    "on real hardware, interleaved with same-topology CPU "
-                    "runs",
-            "topology": "50k pods x 10k nodes, 1 C++ apiserver, separate procs",
-            "tpu_trials_pods_per_s": tpu_t,
-            "cpu_trials_pods_per_s_same_topology": cpu_t,
-            "tpu_median": med(tpu_t),
-            "cpu_median": med(cpu_t),
-            "tpu_detail": tpu_detail,
-            "pairs_won_by_tpu": sum(
-                1 for a, b in zip(tpu_t, cpu_t) if a > b
-            ),
-            "note": "first-grant runs after the chip changes hands are "
-                    "consistently slow (relay warm-up; visible as high "
-                    "tick counts) — all trials recorded regardless",
-        }
+        if tpu_t:  # no section (just the failure log) when no pair ran
+            doc["engine_on_tpu"] = {
+                "what": "KWOK_TPU_SOAK_PLATFORM=axon: the ENGINE process "
+                        "(and only it) claims the tunneled v5e chip; full "
+                        "watch -> pipelined device tick -> strategic-merge "
+                        "patch loop on real hardware, interleaved with "
+                        "same-topology CPU runs",
+                "topology": "50k pods x 10k nodes, 1 C++ apiserver, "
+                            "separate procs",
+                "tpu_trials_pods_per_s": tpu_t,
+                "cpu_trials_pods_per_s_same_topology": cpu_t,
+                "tpu_median": med(tpu_t),
+                "cpu_median": med(cpu_t),
+                "tpu_detail": tpu_detail,
+                "pairs_won_by_tpu": sum(
+                    1 for a, b in zip(tpu_t, cpu_t) if a > b
+                ),
+                "note": "first-grant runs after the chip changes hands "
+                        "are consistently slow (relay warm-up; visible "
+                        "as high tick counts) — all trials recorded "
+                        "regardless",
+            }
 
     # ---- federated on TPU ------------------------------------------------
     if "fedtpu" not in skip:
+        reset("federated_engine_on_tpu")
         d_t, err = soak(["--members", "4"], env=axon)
         fail("fedtpu", err)
         d_c, err = soak(["--members", "4"])
@@ -234,6 +265,7 @@ def main() -> int:
 
     # ---- device heartbeat micro -----------------------------------------
     if "hbmicro" not in skip:
+        reset("heartbeat_device_micro")
         d, err = run_json([PY, "benchmarks/hb_micro.py"], 600)
         fail("hbmicro", err)
         if d:
@@ -241,6 +273,7 @@ def main() -> int:
 
     # ---- cost model, validated against THIS run's median -----------------
     if "costmodel" not in skip:
+        reset("cost_model")
         measured = doc.get("homogeneous_median_pods_per_s") or 0
         cm_args = [PY, "benchmarks/cost_model.py"]
         if measured:
@@ -273,6 +306,7 @@ def main() -> int:
 
     # ---- endurance (longest leg last) ------------------------------------
     if "endurance" not in skip:
+        reset("endurance")
         d, err = run_json(
             [PY, "benchmarks/endurance.py", "--nodes", "10000",
              "--pods", "50000", "--heartbeat-interval", "30",

@@ -279,6 +279,11 @@ def main() -> None:
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--engine-parallelism", type=int, default=64)
     p.add_argument("--tick-interval", type=float, default=0.02)
+    p.add_argument("--tick-substeps", type=int, default=1,
+                   help="simulated substeps fused per device dispatch "
+                   "(engine --tick-substeps): amortizes dispatch-client "
+                   "cost on remote/tunneled TPUs without coarsening the "
+                   "timer resolution (dt = interval/substeps)")
     p.add_argument("--in-process", action="store_true",
                    help="single-interpreter mode (tests); GIL-bound")
     p.add_argument("--no-native-load", action="store_true",
@@ -331,6 +336,7 @@ def main() -> None:
             EngineConfig(
                 manage_all_nodes=True,
                 tick_interval=args.tick_interval,
+                tick_substeps=args.tick_substeps,
                 heartbeat_interval=args.heartbeat_interval,
                 parallelism=args.engine_parallelism,
                 initial_capacity=max(args.pods, args.nodes, 4096),
@@ -385,6 +391,7 @@ def main() -> None:
              "--master", ",".join(member_urls),
              "--manage-all-nodes", "true",
              "--tick-interval", str(args.tick_interval),
+             "--tick-substeps", str(args.tick_substeps),
              "--heartbeat-interval", str(args.heartbeat_interval),
              "--parallelism", str(args.engine_parallelism),
              "--initial-capacity", str(per_member_cap),
