@@ -68,6 +68,21 @@ echo "${ndesc}" | grep -Eq "Ready +True" || {
   echo "describe node: Ready condition missing" >&2
   printf '%s\n' "${ndesc}" >&2; exit 1; }
 
+# 2c. `kubectl logs` on a fake pod surfaces the kwok reality: the
+# apiserver's log proxy dials the fake node's kubelet and fails — exit 1
+# with real kubectl's dial-error dialect, never a hang or a traceback
+logs_rc=0
+logs_err="$(pyrun -m kwok_tpu.kubectl -s "${URL}" logs fake-pod-0 2>&1)" \
+  || logs_rc=$?
+[ "${logs_rc}" -eq 1 ] || {
+  echo "logs: expected exit 1, got ${logs_rc}" >&2; exit 1; }
+echo "${logs_err}" | grep -q "Error from server: " || {
+  echo "logs: missing 'Error from server' dialect" >&2
+  printf '%s\n' "${logs_err}" >&2; exit 1; }
+echo "${logs_err}" | grep -q "connect: connection refused" || {
+  echo "logs: missing kubelet dial failure" >&2
+  printf '%s\n' "${logs_err}" >&2; exit 1; }
+
 # 3. manual status patch on a disregard-annotated node sticks
 create_node "${URL}" custom-node '{"kwok.x-k8s.io/status":"custom"}'
 sleep 2 # give the engine a chance to (wrongly) lock it
