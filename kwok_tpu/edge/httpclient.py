@@ -452,6 +452,50 @@ class _HttpWatch:
             except Exception:
                 pass
 
+    def native_reader(self):
+        """Hand the stream to the native batched line reader (ingest.cc
+        watch IO) AFTER the Python HTTP handshake: plain-HTTP responses
+        backed by a real socket only. Returns a native.WatchReader (its
+        read_batch() yields packed line batches for
+        EventParser.parse_blob) or None — callers fall back to
+        raw_lines(). Bytes http.client already read ahead are drained
+        from its buffer non-blockingly and handed over, so the reader
+        starts exactly where the handshake left off."""
+        if os.environ.get("KWOK_TPU_NATIVE_WATCH", "1") == "0":
+            return None
+        try:
+            from kwok_tpu import native
+        except ImportError:
+            return None
+        if not native.available():
+            return None
+        resp = self._resp
+        try:
+            fp = resp.fp
+            sock = fp.raw._sock  # http.client internals (same as stop())
+            if not isinstance(sock, socket.socket) or isinstance(
+                sock, ssl.SSLSocket
+            ):
+                return None  # TLS bytes are not readable off the raw fd
+            chunked = bool(getattr(resp, "chunked", False))
+            sock.setblocking(False)
+            buffered = b""
+            try:
+                while True:
+                    try:
+                        part = fp.read1(1 << 20)
+                    except (BlockingIOError, ssl.SSLWantReadError):
+                        break
+                    if not part:
+                        break
+                    buffered += part
+            finally:
+                sock.setblocking(True)
+            return native.WatchReader(sock.fileno(), buffered, chunked)
+        except Exception:
+            logger.debug("native watch reader unavailable", exc_info=True)
+            return None
+
     def raw_lines(self) -> Iterator[bytes]:
         """Undecoded event lines — the engine's native ingest parses them in
         C++ (kwok_tpu.native.EventParser) instead of json.loads per event."""

@@ -620,8 +620,47 @@ class ClusterEngine:
                             self._q.put((kind, ADDED, obj, time.monotonic()))
                         self._q.put((kind, "RESYNC", objs, time.monotonic()))
                     expired = False
+                    reader = None
+                    if parser is not None:
+                        make_reader = getattr(w, "native_reader", None)
+                        if callable(make_reader):
+                            reader = make_reader()
                     raw_iter = getattr(w, "raw_lines", None)
-                    if parser is not None and callable(raw_iter):
+                    if reader is not None:
+                        # fully native ingest edge: C++ reads + de-chunks
+                        # the stream and returns PACKED line batches; one
+                        # queue item per batch, zero per-line Python
+                        # objects. Parsing still happens on the tick
+                        # thread (parse_blob); ERROR/expired handling is
+                        # identical to the per-line path below.
+                        self._q.put((
+                            kind, "GEN", self._stream_gen.get(kind, 0),
+                            time.monotonic(),
+                        ))
+                        try:
+                            while self._running:
+                                out = reader.read_batch(timeout_s=1.0)
+                                if out is None:
+                                    break
+                                buf, off = out
+                                if len(off) > 1:
+                                    self._q.put((
+                                        kind, "RAWB", (buf, off),
+                                        time.monotonic(),
+                                    ))
+                                if reader.error is not None:
+                                    expired = b'"code":410' in reader.error
+                                    logger.warning(
+                                        "watch error event: %.200r",
+                                        reader.error,
+                                    )
+                                    break
+                        finally:
+                            reader.close()
+                        # same resume contract as the per-line path: the
+                        # tick thread maintains _watch_rv as it parses
+                        resume_rv = self._watch_rv.get(kind, 0)
+                    elif parser is not None and callable(raw_iter):
                         # native ingest, BATCHED: this thread only queues
                         # raw lines; the tick thread batch-parses a whole
                         # drain's worth in ONE C call (EventParser.
@@ -710,6 +749,16 @@ class ClusterEngine:
             if len(buf) >= self._RAW_FLUSH_AT:
                 self._drain_flush_kind(kind, raw_buf)
             return
+        if type_ == "RAWB":
+            # a packed native-reader batch (buf, off): one entry, many
+            # lines — the flush bound counts LINES, same contract as the
+            # per-line path (a reconnect flood of full batches must not
+            # buffer an unbounded blob for one giant parse)
+            buf = raw_buf.setdefault(kind, [])
+            buf.append(obj)
+            if sum(len(o) - 1 for _, o in buf) >= self._RAW_FLUSH_AT:
+                self._drain_flush_kind(kind, raw_buf)
+            return
         if kind in raw_buf:
             self._drain_flush_kind(kind, raw_buf)
         if type_ == "GEN":
@@ -759,8 +808,8 @@ class ClusterEngine:
                 self._watch_rv[kind] = rv
 
     def _drain_flush_kind(self, kind: str, raw_buf: dict) -> None:
-        lines = raw_buf.pop(kind, None)
-        if not lines:
+        entries = raw_buf.pop(kind, None)
+        if not entries:
             return
         # one generation per buffer: a GEN marker flushes before updating
         # _drain_gen, so every buffered line shares the marker-time value
@@ -769,13 +818,53 @@ class ClusterEngine:
         rv_dead = False
         n_rec = 0
         _t = time.perf_counter()
-        try:
-            batch = self._batch_parser.parse_raw_batch(lines)
-        except Exception:
-            logger.exception(
-                "batch parse failed; falling back to per-line parse"
-            )
-            batch = None
+        if any(isinstance(x, tuple) for x in entries):
+            # packed native-reader batches: concatenate segments and parse
+            # straight from the blob (no per-line objects, no _blob loop).
+            # A kind's stream is either packed or per-line per connection
+            # (and a GEN marker flushes between streams), so entries never
+            # actually mix — but this branch normalizes stray line entries
+            # in either position, so a mix could only cost speed, never
+            # drop events.
+            blob_parts: list[bytes] = []
+            offs: list[int] = [0]
+            base = 0
+            for x in entries:
+                if isinstance(x, tuple):
+                    b, o = x
+                    blob_parts.append(b)
+                    offs.extend(v + base for v in o[1:])
+                    base += o[-1]
+                else:
+                    blob_parts.append(x)
+                    base += len(x)
+                    offs.append(base)
+            blob = b"".join(blob_parts)
+            lines: "list[bytes] | None" = None
+
+            def fallback_lines():
+                return [
+                    blob[offs[i]: offs[i + 1]] for i in range(len(offs) - 1)
+                ]
+
+            try:
+                batch = self._batch_parser.parse_blob(blob, offs)
+            except Exception:
+                logger.exception(
+                    "batch parse failed; falling back to per-line parse"
+                )
+                batch = None
+            if batch is None:
+                lines = fallback_lines()
+        else:
+            lines = entries
+            try:
+                batch = self._batch_parser.parse_raw_batch(lines)
+            except Exception:
+                logger.exception(
+                    "batch parse failed; falling back to per-line parse"
+                )
+                batch = None
         if batch is None:
             # silently losing up to a whole drain's lines would let
             # _watch_rv advance past them on the next good batch; parse

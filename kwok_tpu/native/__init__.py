@@ -27,7 +27,7 @@ _INGEST_SRC = os.path.join(_DIR, "ingest.cc")
 _LIB = os.path.join(_DIR, "libkwokcodec.so")
 _APISERVER_SRC = os.path.join(_DIR, "apiserver.cc")
 _APISERVER_BIN = os.path.join(_DIR, "kwok-mock-apiserver")
-ABI_VERSION = 5
+ABI_VERSION = 6
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -104,6 +104,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.kwok_fingerprint_statuses.argtypes = [
         ctypes.c_char_p, i64p, ctypes.c_int32, u64p,
     ]
+    lib.kwok_watch_open.restype = ctypes.c_void_p
+    lib.kwok_watch_open.argtypes = [
+        ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.kwok_watch_read.restype = ctypes.c_int64
+    lib.kwok_watch_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
+        i64p, ctypes.c_int64, i32p, i64p,
+    ]
+    lib.kwok_watch_close.restype = None
+    lib.kwok_watch_close.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -309,6 +320,93 @@ class _LazyRecord:
             raise AttributeError(name) from None
 
 
+class _BlobLines:
+    """Sequence view over lines packed as (buf, off) — the raw backing a
+    ParsedBatch needs for `.raw` without materializing per-line bytes."""
+
+    __slots__ = ("bbuf", "boff")
+
+    def __init__(self, buf: bytes, off) -> None:
+        self.bbuf = buf
+        self.boff = off
+
+    def __len__(self) -> int:
+        return len(self.boff) - 1
+
+    def __getitem__(self, i: int) -> bytes:
+        return self.bbuf[self.boff[i]: self.boff[i + 1]]
+
+
+class WatchReader:
+    """Batched native watch-line reader (ingest.cc watch IO) over a socket
+    fd handed off AFTER the Python HTTP handshake. read_batch() returns
+    the packed-lines (buf, off) form EventParser.parse_blob consumes —
+    skipping both the per-line chunked-read Python loop and the per-line
+    bytes objects — or None at end of stream. When a batch was cut short
+    by an ERROR event line, `error` carries that line (excluded from the
+    returned batch)."""
+
+    def __init__(self, fd: int, initial: bytes = b"",
+                 chunked: bool = True) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.kwok_watch_open(
+            int(fd), bytes(initial), len(initial), 0 if chunked else 1
+        )
+        self._cap = 1 << 20
+        self._buf = ctypes.create_string_buffer(self._cap)
+        self._max_lines = 16384
+        self._off = np.zeros(self._max_lines + 1, np.int64)
+        self._err = np.zeros(1, np.int32)
+        self._need = np.zeros(1, np.int64)
+        self.error: bytes | None = None
+
+    def read_batch(self, timeout_s: float = 1.0):
+        """(buf, off) with len(off)-1 >= 0 lines (0 = poll timeout; call
+        again), or None when the stream is over."""
+        self.error = None
+        errp = self._err.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        while True:
+            n = self._lib.kwok_watch_read(
+                self._h, 1000 if timeout_s is None
+                else max(0, int(timeout_s * 1000)),
+                self._buf, self._cap,
+                _i64p(self._off), self._max_lines, errp, _i64p(self._need),
+            )
+            if n == -2:  # one line larger than the buffer: grow, retry
+                self._cap = max(self._cap * 2, int(self._need[0]) + 4096)
+                self._buf = ctypes.create_string_buffer(self._cap)
+                continue
+            break
+        if n < 0:
+            return None
+        n = int(n)
+        off = self._off[: n + 1].tolist()
+        # slice the ctypes array directly: ._buf.raw would materialize the
+        # FULL capacity (>=1MiB) per call, a real cost on the steady-state
+        # one-event-per-poll trickle
+        buf = self._buf[: off[-1]] if n else b""
+        if self._err[0] and n:
+            # the last line is the stream-ending ERROR event
+            self.error = buf[off[n - 1]: off[n]]
+            off = off[:n]
+            buf = buf[: off[-1]] if n > 1 else b""
+        return buf, off
+
+    def close(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._lib.kwok_watch_close(h)
+
+    def __del__(self):  # daemon-thread cleanup safety net
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class EventParser:
     """Reusable single-line parser: one ctypes call per watch line, with
     preallocated output buffers (the watch threads run this per event, so
@@ -351,6 +449,20 @@ class EventParser:
         if n == 0:
             return None
         blob, off = _blob([bytes(x) for x in lines])
+        return self._parse_packed(lines, blob, off, n)
+
+    def parse_blob(self, blob: bytes, off) -> "ParsedBatch | None":
+        """parse_raw_batch over lines already packed as (blob, offsets) —
+        the native WatchReader's wire format. Skips the per-line list and
+        the _blob marshalling loop entirely; `.raw` on records slices the
+        source blob lazily."""
+        n = len(off) - 1
+        if n <= 0:
+            return None
+        off_arr = np.ascontiguousarray(off, np.int64)
+        return self._parse_packed(_BlobLines(blob, off), blob, off_arr, n)
+
+    def _parse_packed(self, lines, blob: bytes, off: np.ndarray, n: int):
         fp = np.zeros((4, n), np.uint64)
         flags = np.zeros(n, np.uint8)
         rvs = np.zeros(n, np.int64)

@@ -803,3 +803,190 @@ void kwok_fingerprint_statuses(const char* blob, const int64_t* off,
 }
 
 }  // extern "C"
+
+// --------------------------------------------------------------- watch IO
+// Native watch-line reader: owns the socket AFTER the Python client has
+// completed the HTTP handshake (headers consumed; any body bytes already
+// buffered on the Python side are handed over verbatim). De-chunks the
+// transfer encoding and returns BATCHES of newline-delimited event lines
+// per call — the Python per-line chunked-read loop (http.client readline,
+// one lock dance + several method calls per event) was the largest
+// remaining per-event Python term on the ingest edge. Parsing semantics
+// are untouched: lines go to the same EventParser, ERROR handling and
+// resume-revision bookkeeping stay in the engine.
+
+#include <errno.h>
+#include <poll.h>
+#include <sys/socket.h>
+
+#include <string>
+
+namespace {
+
+struct WatchReader {
+  int fd;
+  std::string in;    // raw socket bytes, not yet de-chunked
+  size_t in_off = 0;
+  std::string body;  // de-chunked bytes pending line split
+  size_t body_off = 0;
+  // -1: awaiting a chunk-size line; -2: awaiting the CRLF after a chunk
+  // payload; >=0: payload bytes left in the current chunk
+  long long chunk_left = -1;
+  bool identity = false;  // no Transfer-Encoding: body runs to EOF
+  bool eof = false;
+};
+
+// moves complete chunks from `in` to `body`; tolerant of any chunk/event
+// alignment (an event may span chunks; a chunk may carry many events)
+void dechunk(WatchReader& r) {
+  if (r.identity) {
+    r.body.append(r.in, r.in_off, std::string::npos);
+    r.in.clear();
+    r.in_off = 0;
+    return;
+  }
+  while (r.in_off < r.in.size()) {
+    if (r.chunk_left == -1) {
+      size_t crlf = r.in.find("\r\n", r.in_off);
+      if (crlf == std::string::npos) break;  // size line incomplete
+      long long size = 0;
+      bool any = false;
+      for (size_t p = r.in_off; p < crlf; p++) {
+        char c = r.in[p];
+        int v;
+        if (c >= '0' && c <= '9') v = c - '0';
+        else if (c >= 'a' && c <= 'f') v = c - 'a' + 10;
+        else if (c >= 'A' && c <= 'F') v = c - 'A' + 10;
+        else break;  // chunk extension (";...") or junk: stop at it
+        size = size * 16 + v;
+        any = true;
+      }
+      r.in_off = crlf + 2;
+      if (!any || size == 0) {
+        // malformed size line or the terminating 0-chunk (trailers
+        // ignored): the stream is over either way
+        r.eof = true;
+        r.in.clear();
+        r.in_off = 0;
+        return;
+      }
+      r.chunk_left = size;
+    } else if (r.chunk_left > 0) {
+      size_t avail = r.in.size() - r.in_off;
+      size_t take = avail < (size_t)r.chunk_left ? avail : (size_t)r.chunk_left;
+      r.body.append(r.in, r.in_off, take);
+      r.in_off += take;
+      r.chunk_left -= (long long)take;
+      if (r.chunk_left == 0) r.chunk_left = -2;
+      if (r.in_off >= r.in.size()) break;
+    } else {  // -2: CRLF after payload
+      if (r.in.size() - r.in_off < 2) break;
+      r.in_off += 2;
+      r.chunk_left = -1;
+    }
+  }
+  if (r.in_off) {
+    r.in.erase(0, r.in_off);
+    r.in_off = 0;
+  }
+}
+
+constexpr const char* kErrPrefix = "{\"type\":\"ERROR\"";
+constexpr size_t kErrPrefixLen = 15;
+
+}  // namespace
+
+extern "C" {
+
+void* kwok_watch_open(int fd, const char* initial, int64_t n, int identity) {
+  auto* r = new WatchReader();
+  r->fd = fd;
+  r->identity = identity != 0;
+  if (initial && n > 0) r->in.assign(initial, (size_t)n);
+  return r;
+}
+
+void kwok_watch_close(void* h) { delete static_cast<WatchReader*>(h); }
+
+// Returns: >0 = number of lines written to out/out_off (off has n+1
+// entries, lines are \n- and \r-stripped); 0 = timeout, nothing ready;
+// -1 = end of stream (no more lines will ever come; a partial trailing
+// line is dropped — the resume revision replays it); -2 = a single line
+// exceeds out_cap, *need holds the required capacity. *err is set to 1
+// when the LAST returned line matched the ERROR-event prefix (no further
+// lines are consumed past it this call).
+int64_t kwok_watch_read(void* h, int timeout_ms, char* out, int64_t out_cap,
+                        int64_t* out_off, int64_t max_lines, int32_t* err,
+                        int64_t* need) {
+  auto* r = static_cast<WatchReader*>(h);
+  *err = 0;
+  *need = 0;
+  int64_t n = 0;
+  int64_t used = 0;
+  out_off[0] = 0;
+  for (;;) {
+    dechunk(*r);
+    // split body into lines
+    while (n < max_lines) {
+      size_t nl = r->body.find('\n', r->body_off);
+      if (nl == std::string::npos) break;
+      size_t start = r->body_off;
+      size_t end = nl;
+      if (end > start && r->body[end - 1] == '\r') end--;
+      size_t len = end - start;
+      if (len == 0) {  // blank keep-alive line
+        r->body_off = nl + 1;
+        continue;
+      }
+      if (used + (int64_t)len > out_cap) {
+        if (n == 0) {
+          *need = (int64_t)len;
+          return -2;
+        }
+        goto done;  // deliver what fits; rest next call
+      }
+      bool is_err = len >= kErrPrefixLen &&
+                    memcmp(r->body.data() + start, kErrPrefix,
+                           kErrPrefixLen) == 0;
+      memcpy(out + used, r->body.data() + start, len);
+      used += len;
+      n++;
+      out_off[n] = used;
+      r->body_off = nl + 1;
+      if (is_err) {
+        *err = 1;
+        goto done;  // nothing past a stream error is consumed this call
+      }
+    }
+    if (n > 0) goto done;
+    if (r->eof) return -1;
+    // nothing complete buffered: wait for the socket
+    struct pollfd pfd{r->fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, timeout_ms);
+    if (pr == 0) return 0;  // timeout
+    if (pr < 0) {
+      if (errno == EINTR) return 0;  // PEP-475: a signal is not a hangup
+      r->eof = true;
+      return -1;
+    }
+    char tmp[65536];
+    ssize_t got = recv(r->fd, tmp, sizeof tmp, 0);
+    if (got < 0 && errno == EINTR) continue;
+    if (got <= 0) {
+      r->eof = true;
+      // fall through once more: the final dechunk may complete lines
+      dechunk(*r);
+      continue;
+    }
+    r->in.append(tmp, (size_t)got);
+  }
+done:
+  if (r->body_off > (1u << 20) ||
+      (r->body_off && r->body_off == r->body.size())) {
+    r->body.erase(0, r->body_off);
+    r->body_off = 0;
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -350,3 +350,100 @@ def test_drain_raw_batch_flushes_before_non_raw_items():
         assert eng.metrics["nodes_managed"] >= 0
     finally:
         eng.stop()
+
+
+def test_watch_reader_batches_and_parse_blob():
+    """The native watch reader (ingest.cc watch IO): handshake in Python,
+    then batched de-chunked line reads off the raw fd; parse_blob consumes
+    the packed form directly. ERROR events cut the batch and surface via
+    .error — identical semantics to the per-line path."""
+    import threading
+    import time as _time
+
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import FakeKube, HttpFakeApiserver
+    from tests.test_engine import make_pod
+
+    srv = HttpFakeApiserver(store=FakeKube()).start()
+    try:
+        client = HttpKubeClient(srv.url)
+        w = client.watch("pods", field_selector="spec.nodeName!=")
+        reader = w.native_reader()
+        assert reader is not None, "plain-HTTP watch must get the reader"
+        for i in range(40):
+            srv.store.create("pods", make_pod(f"wr-{i}", node="n0"))
+        parser = native.EventParser()
+        seen = []
+        deadline = _time.monotonic() + 10
+        while len(seen) < 40 and _time.monotonic() < deadline:
+            out = reader.read_batch(timeout_s=0.5)
+            assert out is not None, "stream ended early"
+            buf, off = out
+            if len(off) <= 1:
+                continue
+            batch = parser.parse_blob(buf, off)
+            for i in range(batch.n):
+                rec = batch.record(i)
+                assert rec.type == "ADDED"
+                seen.append(rec.name)
+                assert rec.raw.startswith(b'{"type":"ADDED"')
+        assert sorted(seen) == sorted(f"wr-{i}" for i in range(40))
+        # server closes the stream: reader reports end, not a hang
+        stopper = threading.Thread(target=w.stop, daemon=True)
+        stopper.start()
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            if reader.read_batch(timeout_s=0.5) is None:
+                break
+        else:
+            raise AssertionError("reader did not observe stream end")
+        reader.close()
+    finally:
+        srv.stop()
+
+
+def test_watch_reader_error_event_cuts_batch():
+    """A 410 ERROR line ends the stream: preceding lines still parse,
+    .error carries the event, and nothing past it is consumed."""
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import FakeKube, HttpFakeApiserver
+    from tests.test_engine import make_pod
+
+    srv = HttpFakeApiserver(store=FakeKube()).start()
+    try:
+        # build up history, compact, then resume BELOW the compaction point
+        for i in range(5):
+            srv.store.create("pods", make_pod(f"er-{i}", node="n0"))
+        srv.store.compact()
+        client = HttpKubeClient(srv.url)
+        import urllib.request
+
+        # wire-level watch with an expired rv: server answers 200 + one
+        # ERROR event (the real apiserver dialect)
+        resp = urllib.request.urlopen(
+            f"{srv.url}/api/v1/pods?watch=true&resourceVersion=1", timeout=10
+        )
+
+        from kwok_tpu.edge.httpclient import _HttpWatch
+
+        w = _HttpWatch.__new__(_HttpWatch)
+        w._resp = resp
+        reader = _HttpWatch.native_reader(w)
+        assert reader is not None
+        got_error = None
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            out = reader.read_batch(timeout_s=0.5)
+            if reader.error is not None:
+                got_error = reader.error
+                break
+            if out is None:
+                break
+        assert got_error is not None, "ERROR event not surfaced"
+        assert b'"code":410' in got_error
+        reader.close()
+        client.close()
+    finally:
+        srv.stop()
