@@ -200,8 +200,15 @@ def watch_read_costs(n: int, trials: int) -> dict:
         import queue as _q
 
         vals = []
+        native_mode = None
         for t in range(trials):
             w = client.watch("pods")
+            # the engine's actual default: native batched reader when
+            # available (one queue item per packed batch), else the
+            # per-line Python path — cost whichever the engine would run
+            reader = w.native_reader()
+            if native_mode is None:
+                native_mode = reader is not None
             reqs = [
                 ("POST", "/api/v1/namespaces/default/pods", json.dumps({
                     "apiVersion": "v1", "kind": "Pod",
@@ -216,16 +223,37 @@ def watch_read_costs(n: int, trials: int) -> dict:
             qq: "_q.SimpleQueue" = _q.SimpleQueue()
             got = 0
             c0 = time.process_time()
-            for line in w.raw_lines():
-                qq.put(("pods", "RAW", line, time.monotonic()))
-                got += 1
-                if got >= n:
-                    break
+            if reader is not None:
+                while got < n:
+                    out = reader.read_batch(timeout_s=5.0)
+                    if out is None or reader.error is not None:
+                        break
+                    buf, off = out
+                    if len(off) > 1:
+                        qq.put(("pods", "RAWB", (buf, off),
+                                time.monotonic()))
+                        got += len(off) - 1
+            else:
+                for line in w.raw_lines():
+                    qq.put(("pods", "RAW", line, time.monotonic()))
+                    got += 1
+                    if got >= n:
+                        break
+            if got < n:
+                # a short trial must fail loudly, not deflate the per-line
+                # cost by dividing a partial read by the full n
+                raise SystemExit(
+                    f"watch probe: stream ended at {got}/{n} lines "
+                    f"(reader error: {getattr(reader, 'error', None)!r})"
+                )
             vals.append(1e6 * (time.process_time() - c0) / n)
+            if reader is not None:
+                reader.close()
             w.stop()
         pump.close()
         client.close()
         return {"watch_line_us": round(statistics.median(vals), 2),
+                "native_reader": bool(native_mode),
                 "lines_per_trial": n, "trials": trials}
     finally:
         proc.terminate()
