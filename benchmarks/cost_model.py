@@ -224,10 +224,13 @@ def watch_read_costs(n: int, trials: int) -> dict:
             got = 0
             c0 = time.process_time()
             if reader is not None:
+                deadline = time.monotonic() + 60.0
                 while got < n:
                     out = reader.read_batch(timeout_s=5.0)
                     if out is None or reader.error is not None:
                         break
+                    if time.monotonic() > deadline:
+                        break  # stalled stream: fail loudly below
                     buf, off = out
                     if len(off) > 1:
                         qq.put(("pods", "RAWB", (buf, off),
@@ -243,10 +246,12 @@ def watch_read_costs(n: int, trials: int) -> dict:
                 # a short trial must fail loudly, not deflate the per-line
                 # cost by dividing a partial read by the full n
                 raise SystemExit(
-                    f"watch probe: stream ended at {got}/{n} lines "
+                    f"watch probe: stream ended/stalled at {got}/{n} lines "
                     f"(reader error: {getattr(reader, 'error', None)!r})"
                 )
-            vals.append(1e6 * (time.process_time() - c0) / n)
+            # the native path reads whole batches and can overshoot n:
+            # divide by the lines actually processed
+            vals.append(1e6 * (time.process_time() - c0) / got)
             if reader is not None:
                 reader.close()
             w.stop()
