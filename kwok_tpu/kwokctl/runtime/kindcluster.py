@@ -146,7 +146,9 @@ spec:
     readinessProbe:
       failureThreshold: 5
       httpGet:
-        path: /healthz
+        # readiness is gated on engine warm-up (503 until the fused tick
+        # kernel compiled); liveness above stays on the ungated /healthz
+        path: /readyz
         port: 8080
         scheme: HTTP
       initialDelaySeconds: 2
@@ -475,15 +477,16 @@ class KindCluster(Cluster):
     # --- readiness --------------------------------------------------------
 
     def ready(self) -> bool:
-        """Apiserver healthy AND every kube-system pod Running
-        (cluster.go:327-372)."""
+        """Apiserver healthy AND every kube-system pod Running AND Ready
+        (cluster.go:327-372 checks the phase; the Ready condition is what
+        the kwok-controller's /readyz-gated readiness probe feeds, so a
+        Running pod still warming up must hold WaitReady back)."""
         if not super().ready():
             return False
         res = self._run(
             [self.kubectl_path(), "--kubeconfig",
              self.workdir_path(base.IN_HOST_KUBECONFIG_NAME),
-             "get", "pod", "--namespace=kube-system",
-             "--field-selector=status.phase!=Running", "--output=json"],
+             "get", "pod", "--namespace=kube-system", "--output=json"],
             capture=True, check=False,
         )
         if res.returncode != 0:
@@ -492,7 +495,17 @@ class KindCluster(Cluster):
             data = json.loads(res.stdout)
         except json.JSONDecodeError:
             return False
-        return not data.get("items")
+        for pod in data.get("items") or []:
+            status = pod.get("status") or {}
+            if status.get("phase") != "Running":
+                return False
+            conds = {
+                c.get("type"): c.get("status")
+                for c in status.get("conditions") or []
+            }
+            if conds.get("Ready") != "True":
+                return False
+        return True
 
     # --- logs -------------------------------------------------------------
 

@@ -136,3 +136,47 @@ def test_kind_install_up_stop_snapshot(fake_clis, tmp_path):
 
     rt.down()
     assert any(c.startswith("kind delete cluster") for c in _calls(fake_clis))
+
+
+def test_ready_requires_ready_condition(tmp_path, monkeypatch):
+    """ready() must hold back while a kube-system pod is Running but not
+    yet Ready (the kwok-controller's readiness probe is /readyz-gated:
+    warm-up shows exactly this state) — regression for the gate having no
+    consumer in the kind runtime."""
+    import json as _json
+
+    from kwok_tpu.kwokctl.runtime import base
+
+    def make_cluster(pods_json):
+        c = KindCluster.__new__(KindCluster)
+        calls = []
+
+        def run(args, capture=False, check=True):
+            calls.append(" ".join(args))
+            class R:
+                returncode = 0
+                stdout = _json.dumps(pods_json)
+            return R()
+
+        c._run = run
+        c.kubectl_path = lambda: "kubectl"
+        c.workdir_path = lambda n: str(tmp_path / n)
+        return c
+
+    running_not_ready = {"items": [{"status": {
+        "phase": "Running",
+        "conditions": [{"type": "Ready", "status": "False"}],
+    }}]}
+    running_ready = {"items": [{"status": {
+        "phase": "Running",
+        "conditions": [{"type": "Ready", "status": "True"}],
+    }}]}
+    pending = {"items": [{"status": {"phase": "Pending"}}]}
+
+    monkeypatch.setattr(
+        base.Cluster, "ready", lambda self: True, raising=True
+    )
+    assert make_cluster(running_ready).ready() is True
+    assert make_cluster(running_not_ready).ready() is False
+    assert make_cluster(pending).ready() is False
+    assert make_cluster({"items": []}).ready() is True
