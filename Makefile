@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify bench image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify bench obs-check image cluster-image clean
 
 all: build
 
@@ -26,6 +26,9 @@ verify:
 
 bench: ## the headline benchmark on the real device (ONE process, owns the TPU)
 	python3 bench.py
+
+obs-check: ## exposition-format + trace-schema oracle (docs/observability.md)
+	$(PYENV) python3 -m pytest tests/test_metrics_exposition.py -q
 
 image:
 	./images/kwok/build.sh

@@ -92,6 +92,51 @@ def _seeded_state(n):
     return s
 
 
+_BENCH_TEL = None
+
+
+def _bench_telemetry():
+    """Lazy process-wide telemetry slice for the bench itself: window
+    timings + transition totals land in a registry whose /metrics-format
+    snapshot rides in the BENCH json, so future rounds can diff counter
+    trajectories instead of only the headline rate."""
+    global _BENCH_TEL
+    if _BENCH_TEL is None:
+        from kwok_tpu.telemetry import MetricsRegistry, register_build_info
+
+        reg = MetricsRegistry()
+        register_build_info(reg)
+        _BENCH_TEL = {
+            "registry": reg,
+            "dispatch": reg.histogram(
+                "kwok_bench_window_dispatch_seconds",
+                "Wall seconds per timed dispatch window",
+                buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                         30.0, 60.0),
+            ),
+            "consume": reg.histogram(
+                "kwok_bench_window_consume_seconds",
+                "Wall seconds per timed consume (wire fetch) phase",
+                buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                         30.0, 60.0),
+            ),
+            "transitions": reg.counter(
+                "kwok_bench_transitions_total",
+                "Transitions counted across all timed windows",
+            ),
+            "ticks": reg.counter(
+                "kwok_bench_ticks_total", "Timed dispatches across all windows"
+            ),
+        }
+    return _BENCH_TEL
+
+
+def _metrics_snapshot() -> str:
+    """The bench registry rendered as Prometheus text (one string field in
+    the BENCH json; split on newlines to diff)."""
+    return _bench_telemetry()["registry"].render()
+
+
 def _best_of_windows(tick, consume, per_window: int, n_windows: int = 3) -> float:
     """The shared timing harness: the device is reached through a shared
     tunnel whose latency has multi-second transients, so a single long
@@ -101,6 +146,7 @@ def _best_of_windows(tick, consume, per_window: int, n_windows: int = 3) -> floa
     returns an opaque item; `consume(item)` materializes its host-visible
     summary and returns the transition count (clock stops after the last
     consume, exactly what the engine's egress pays)."""
+    tel = _bench_telemetry()
     rates = []
     for _ in range(n_windows):
         items = []
@@ -111,6 +157,8 @@ def _best_of_windows(tick, consume, per_window: int, n_windows: int = 3) -> floa
         for item in items:
             total += consume(item)
         rates.append(total / (time.perf_counter() - t0))
+        tel["transitions"].inc(total)
+        tel["ticks"].inc(per_window)
     return max(rates)
 
 
@@ -138,6 +186,7 @@ def _run(kern, pstate, nstate, n_pods, n_nodes, ticks,
     if n_warm:
         _ = np.asarray(wire)  # sync
 
+    tel = _bench_telemetry()
     wires = []
     t0 = time.perf_counter()
     for _ in range(ticks):
@@ -146,12 +195,20 @@ def _run(kern, pstate, nstate, n_pods, n_nodes, ticks,
         prefetch(wire)
         wires.append(wire)
         now += dt_per_tick
+    t_disp = time.perf_counter()
     total = 0
     for wire in wires:
         counters, masks_fn, _ = unpack_wire(np.asarray(wire), [n_pods, n_nodes])
         total += int(counters[0]) + int(counters[1])
         masks_fn()
-    return total / (time.perf_counter() - t0), pstate, nstate, now
+    t_end = time.perf_counter()
+    # window-granular telemetry: zero per-tick instrumentation inside the
+    # timed loops, so the measured rate is unchanged
+    tel["dispatch"].observe(t_disp - t0)
+    tel["consume"].observe(t_end - t_disp)
+    tel["transitions"].inc(total)
+    tel["ticks"].inc(ticks)
+    return total / (t_end - t0), pstate, nstate, now
 
 
 def mesh_device_main(ticks: int) -> None:
@@ -198,6 +255,7 @@ def mesh_device_main(ticks: int) -> None:
         "transitions_per_s": results,
         "relative": round(results["mesh1"] / max(results["jit"], 1e-9), 3),
         "unit": "transitions/s",
+        "metrics_snapshot": _metrics_snapshot(),
     }))
 
 
@@ -293,6 +351,7 @@ def mesh_main(n_devices: int, n_pods: int, ticks: int,
         out["relative"] = round(
             results[f"{n_devices}dev"] / max(results["1dev"], 1e-9), 3
         )
+    out["metrics_snapshot"] = _metrics_snapshot()
     print(json.dumps(out))
 
 
@@ -394,6 +453,7 @@ def pallas_main() -> None:
             "per_dispatch_transitions_per_s": round(per_dispatch, 1),
             "note": "same definitions as the XLA headline run",
         },
+        "metrics_snapshot": _metrics_snapshot(),
     }))
 
 
@@ -488,6 +548,7 @@ def main() -> None:
                         "tunneled device)"
                     ),
                 },
+                "metrics_snapshot": _metrics_snapshot(),
             }
         )
     )

@@ -55,35 +55,88 @@ def main() -> None:
         now += INTERVAL
     np.asarray(wire)
 
-    wires = []
-    t0 = time.perf_counter()
-    for _ in range(TICKS):
-        (out,), wire = kern((state,), now)
-        state = out.state
-        prefetch(wire)
-        wires.append(wire)
-        now += INTERVAL
-    total_hb = 0
-    for wire in wires:
-        counters, masks_fn, _ = unpack_wire(np.asarray(wire), [N])
-        masks_fn()  # materialize the hb mask like the engine's emit
-        total_hb += int(counters[1])
-    elapsed = time.perf_counter() - t0
-    rate = total_hb / elapsed
-    print(json.dumps({
+    def timed_loop(state, now, tracer=None, hist=None):
+        """One timed window; optionally instrumented exactly like the
+        engine's tick loop (one histogram observe + two spans per tick):
+        the with-telemetry rate divided by the bare rate is the tracer's
+        real overhead on the hot path (budget: <2%)."""
+        wires = []
+        t0 = time.perf_counter()
+        for _ in range(TICKS):
+            _d0 = time.perf_counter() if tracer else 0.0
+            (out,), wire = kern((state,), now)
+            state = out.state
+            prefetch(wire)
+            if tracer is not None:
+                _d1 = time.perf_counter()
+                tracer.span("tick.dispatch", _d0, _d1, "dispatch")
+            wires.append(wire)
+            now += INTERVAL
+        total_hb = 0
+        for wire in wires:
+            _c0 = time.perf_counter() if tracer else 0.0
+            counters, masks_fn, _ = unpack_wire(np.asarray(wire), [N])
+            masks_fn()  # materialize the hb mask like the engine's emit
+            total_hb += int(counters[1])
+            if tracer is not None:
+                _c1 = time.perf_counter()
+                tracer.span("tick.consume", _c0, _c1, "consume")
+                hist.observe(_c1 - _c0)
+        elapsed = time.perf_counter() - t0
+        return total_hb, elapsed, state, now
+
+    with_trace = os.environ.get("KWOK_HB_TRACE", "1") != "0"
+    tracer = hist = None
+    if with_trace:
+        from kwok_tpu.telemetry import MetricsRegistry, Tracer
+
+        tracer = Tracer()
+        hist = MetricsRegistry().histogram(
+            "kwok_hb_consume_seconds", "per-tick consume wall"
+        )
+    # Interleaved best-of-N pairs: single windows on this host swing
+    # +-25% (shared CPU / tunnel transients), far above any tracer cost —
+    # the max of each arm is the honest capability, and their ratio is
+    # the instrumentation overhead (bench.py's best-of-windows rationale).
+    n_windows = max(1, int(os.environ.get("KWOK_HB_WINDOWS", "3")))
+    bare_rates, traced_rates = [], []
+    total_hb = elapsed = 0
+    for _ in range(n_windows):
+        hb, el, state, now = timed_loop(state, now)
+        total_hb += hb
+        elapsed += el
+        bare_rates.append(hb / el)
+        if with_trace:
+            hb2, el2, state, now = timed_loop(state, now, tracer, hist)
+            traced_rates.append(hb2 / el2)
+    rate = max(bare_rates)
+    out = {
         "metric": (
             f"device heartbeat wheel at {N} rows ({platform}): firings/s "
-            f"with every row due each dispatch"
+            f"with every row due each dispatch (best of {n_windows})"
         ),
         "heartbeats_per_s": round(rate, 1),
         "heartbeats_total": total_hb,
-        "ticks": TICKS,
+        "ticks": TICKS * n_windows,
         "elapsed_s": round(elapsed, 3),
         "reference_equivalent": (
             f"{round(rate * INTERVAL / 1e6, 1)}M nodes sustainable at the "
             f"reference's {INTERVAL:.0f}s cadence, device side"
         ),
-    }))
+    }
+    if with_trace:
+        traced_rate = max(traced_rates)
+        out["tracer"] = {
+            "traced_heartbeats_per_s": round(traced_rate, 1),
+            "spans_recorded": tracer.recorded,
+            # <1.0 means tracing cost throughput; overhead_pct is the
+            # cost of always-on spans + histogram observes (budget: <2%)
+            "relative": round(traced_rate / max(rate, 1e-9), 4),
+            "overhead_pct": round(
+                max(0.0, (1 - traced_rate / max(rate, 1e-9)) * 100), 2
+            ),
+        }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -183,9 +183,33 @@ def _wait_http(url: str, path: str, timeout: float = 30.0) -> None:
     raise SystemExit(f"timeout waiting for {url}{path}")
 
 
+# stage-labeled histogram sums -> the legacy flat keys this breakdown
+# reports (the engine now exports kwok_tick_stage_seconds{stage=...})
+_STAGE_KEYS = {
+    "flush": "kwok_tick_flush_seconds_sum",
+    "kernel": "kwok_tick_kernel_seconds_sum",
+    "emit": "kwok_tick_emit_seconds_sum",
+    "drain": "kwok_ingest_drain_seconds_sum",
+    "parse": "kwok_ingest_parse_seconds_sum",
+}
+# shared-tick families: every federation shard records the same value, so
+# the cross-shard sum must be un-summed (FederatedEngine.metrics semantics)
+_SHARED_TICK = (
+    "kwok_ticks_total", "kwok_tick_seconds_sum",
+    "kwok_tick_kernel_seconds_sum", "kwok_tick_flush_seconds_sum",
+)
+
+
 def _scrape_metrics(url: str) -> dict:
-    """Prometheus text -> {name: value} (the kwok server's /metrics)."""
+    """Prometheus text -> {name: value} (the kwok server's /metrics).
+
+    The exposition is labeled (shard= under federation, kind=, stage=,
+    path=); series are summed into their base name — the old
+    strip-and-overwrite kept whichever label combination rendered last —
+    with histogram ``_bucket`` lines skipped (cumulative, never summable)
+    and the stage/group schemas flattened back to the legacy flat keys."""
     out: dict[str, float] = {}
+    shards: set[str] = set()
     try:
         split = urllib.parse.urlsplit(url)
         c = http.client.HTTPConnection(split.hostname, split.port, timeout=5)
@@ -193,12 +217,36 @@ def _scrape_metrics(url: str) -> dict:
         text = c.getresponse().read().decode()
         c.close()
         for line in text.splitlines():
-            if line and not line.startswith("#"):
-                name, _, val = line.partition(" ")
-                try:
-                    out[name.partition("{")[0]] = float(val)
-                except ValueError:
-                    pass
+            if not line or line.startswith("#"):
+                continue
+            try:
+                head, val = line.rsplit(" ", 1)
+                v = float(val)
+            except ValueError:
+                continue
+            base, _, blob = head.partition("{")
+            labels: dict[str, str] = {}
+            for part in blob.rstrip("}").split(","):
+                k, eq, q = part.partition("=")
+                if eq:
+                    labels[k] = q.strip('"')
+            if "le" in labels:
+                continue  # histogram buckets: cumulative per label set
+            if "shard" in labels:
+                shards.add(labels["shard"])
+            if base == "kwok_tick_stage_seconds_sum" and "stage" in labels:
+                key = _STAGE_KEYS.get(labels["stage"])
+                if key is None:
+                    continue
+            elif base == "kwok_group_dispatches_total" and "group" in labels:
+                key = f"kwok_group{labels['group']}_dispatches_total"
+            else:
+                key = base
+            out[key] = out.get(key, 0.0) + v
+        if len(shards) > 1:
+            for key in _SHARED_TICK:
+                if key in out:
+                    out[key] /= len(shards)
     except OSError:
         pass
     return out
@@ -756,7 +804,8 @@ def main() -> None:
             # just the headline number)
             breakdown = {}
             for k_out, k_in in (
-                ("engine_cpu_s", "kwok_process_cpu_seconds_total"),
+                # the process collector uses the standard unprefixed name
+                ("engine_cpu_s", "process_cpu_seconds_total"),
                 ("tick_s", "kwok_tick_seconds_sum"),
                 ("tick_flush_s", "kwok_tick_flush_seconds_sum"),
                 ("tick_kernel_s", "kwok_tick_kernel_seconds_sum"),

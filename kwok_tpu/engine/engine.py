@@ -69,6 +69,7 @@ from kwok_tpu.ops.tick import (
 )
 from kwok_tpu.ops.updates import UpdateBuffer
 from kwok_tpu.engine.rowpool import RowPool
+from kwok_tpu.telemetry import EngineTelemetry
 
 logger = logging.getLogger("kwok_tpu.engine")
 
@@ -132,6 +133,14 @@ class EngineConfig:
     # (SURVEY.md §5.1: the reference has no tracing at all; we add device
     # traces + the per-tick timing counters in `metrics`)
     profile_dir: str = ""
+    # when set, the engine's span tracer (telemetry.trace) dumps its ring
+    # as Chrome trace-event JSON here at stop(); KWOK_TPU_TRACE=<path>
+    # works too. The tracer itself is always on — this only controls the
+    # at-exit dump (the live view is the HTTP /debug/trace endpoint).
+    trace_dump: str = ""
+    # 1-in-N sampling for per-event ingest->patch spans (the end-to-end
+    # per-pod attribution the cost model cannot see); 0 disables
+    trace_sample_every: int = 256
 
     def validate(self) -> None:
         if not (
@@ -207,11 +216,22 @@ class _Kind:
 
 
 class ClusterEngine:
-    def __init__(self, client: KubeClient, config: EngineConfig) -> None:
+    def __init__(
+        self,
+        client: KubeClient,
+        config: EngineConfig,
+        *,
+        telemetry: EngineTelemetry | None = None,
+    ) -> None:
         config.validate()
         self.client = client
         self.config = config
         self.ippool = IPPool(config.cidr)
+        # Telemetry: labeled registry + span tracer. A FederatedEngine
+        # passes a shard-labeled slice of its shared registry so /metrics
+        # exports per-shard series instead of last-writer-wins scalars.
+        self.telemetry = telemetry if telemetry is not None else EngineTelemetry()
+        self.tracer = self.telemetry.tracer
 
         self._manage_annotation = parse_selector(
             config.manage_nodes_with_annotation_selector
@@ -283,7 +303,6 @@ class ClusterEngine:
         # tractable; it is NEVER held across provider calls (cni.setup may
         # do netns/network I/O) or any other blocking work.
         self._alloc_lock = threading.Lock()
-        self._metrics_lock = threading.Lock()
 
         # record fast-path gate: disregard selectors and a live CNI
         # provider both force the full-parse path (per-event attribute
@@ -346,36 +365,28 @@ class ClusterEngine:
             (name, *_NODE_CONDITION_META.get(name, ("KwokRule", name)))
             for name in NODE_PHASES.conditions
         ]
-        self.metrics = {
-            "transitions_total": 0,
-            "status_patches_total": 0,
-            "heartbeats_total": 0,
-            "deletes_total": 0,
-            "epoch_rebases_total": 0,
-            "watch_events_total": 0,
-            "watch_bookmarks_total": 0,
-            "watch_relists_total": 0,
-            "patch_errors_total": 0,
-            "ticks_total": 0,
-            "tick_seconds_sum": 0.0,
-            "tick_seconds_last": 0.0,
-            "tick_flush_seconds_sum": 0.0,
-            "tick_kernel_seconds_sum": 0.0,
-            "tick_emit_seconds_sum": 0.0,
-            "ingest_drain_seconds_sum": 0.0,
-            "ingest_parse_seconds_sum": 0.0,
-            "pump_send_seconds_sum": 0.0,
-            "pump_requests_total": 0,
-            "watch_lag_seconds": 0.0,
-            "ingest_queue_depth": 0,
-            "tick_inflight": 0,
-            "nodes_managed": 0,
-            "pods_managed": 0,
-        }
+        # 1-in-N ingest->patch trace sampling (0 disables); the counter is
+        # tick-thread-only, so plain int arithmetic is race-free
+        self._trace_every = max(0, int(config.trace_sample_every))
+        self._trace_n = 0
+
+    @property
+    def metrics(self) -> dict:
+        """Legacy flat view of the registry (tests, cost model, tooling).
+        The authoritative surface is ``telemetry.registry`` — labeled
+        families with real histograms — rendered by ``metrics_text()``."""
+        return self.telemetry.legacy_dict()
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the full labeled registry."""
+        return self.telemetry.registry.render()
+
+    def trace_chrome(self) -> dict:
+        """The span ring as a Chrome trace-event document."""
+        return self.tracer.chrome_trace()
 
     def _inc(self, name: str, v=1) -> None:
-        with self._metrics_lock:
-            self.metrics[name] += v
+        self.telemetry.inc(name, v)
 
     # ------------------------------------------------------------------ time
 
@@ -416,6 +427,10 @@ class ClusterEngine:
         queues + emit paths from one shared tick loop."""
         self._running = True
         self._owns_tick = run_tick_loop
+        # start the sampling profiler from the CALLER's thread (usually
+        # main): its SIGTERM crash-dump hook can only install there — the
+        # tick thread's own maybe_start() is then an idempotent no-op
+        profiling.maybe_start()
         self._record_needs_full_path = (
             self._disregard_annotation is not None
             or self._disregard_label is not None
@@ -532,6 +547,17 @@ class ClusterEngine:
                 "%d patch jobs dropped during shutdown", self._dropped_jobs
             )
         profiling.maybe_dump()
+        trace_path = self.config.trace_dump or os.environ.get(
+            "KWOK_TPU_TRACE", ""
+        )
+        if trace_path and self._owns_tick:
+            # at-stop dump (a crashed scrape target still leaves evidence);
+            # the live view is /debug/trace
+            try:
+                self.tracer.dump(trace_path)
+                logger.info("span trace written to %s", trace_path)
+            except Exception:
+                logger.exception("span trace dump failed")
         if self._pump is not None:
             self._pump.close()
             self._pump = None
@@ -893,12 +919,12 @@ class ClusterEngine:
             if latest_rv:
                 self._commit_rv(kind, gen, latest_rv)
             if n_rec:
-                self._inc("watch_events_total", n_rec)
-            self._inc(
-                "ingest_parse_seconds_sum", time.perf_counter() - _t
+                self.telemetry.inc_kind("watch_events_total", kind, n_rec)
+            self.telemetry.observe_stage(
+                "parse", time.perf_counter() - _t
             )
             return
-        self._inc("ingest_parse_seconds_sum", time.perf_counter() - _t)
+        self.telemetry.observe_stage("parse", time.perf_counter() - _t)
         bookmarks = 0
         # hot loop: locals beat repeated attribute/method dispatch at
         # O(10k) records per drain
@@ -932,7 +958,7 @@ class ClusterEngine:
         if latest_rv:
             self._commit_rv(kind, gen, latest_rv)
         if n_rec:
-            self._inc("watch_events_total", n_rec)
+            self.telemetry.inc_kind("watch_events_total", kind, n_rec)
         if bookmarks:
             self._inc("watch_bookmarks_total", bookmarks)
 
@@ -942,7 +968,7 @@ class ClusterEngine:
             # per drain instead of one per event on the survivor path
             self._ingest_record(kind, obj)
             return
-        self._inc("watch_events_total")
+        self.telemetry.inc_kind("watch_events_total", kind)
         if type_ == "RESYNC":
             self._resync(kind, obj)
             return
@@ -1200,6 +1226,11 @@ class ClusterEngine:
             status_scalar=set(status) <= _SCALAR_STATUS_KEYS,
         )
         m.pop("raw", None)  # the parsed object supersedes any raw line
+        if self._trace_every:
+            self._trace_n += 1
+            if self._trace_n % self._trace_every == 0:
+                # sampled end-to-end trace: the patch ack closes the span
+                m["_trace_t0"] = time.perf_counter()
         # fingerprints describe the record-path state; this dict-path event
         # (list/resync or fallback) may carry different content, so stale
         # fingerprints must never justify dropping a later revert-to-known
@@ -1334,6 +1365,10 @@ class ClusterEngine:
                 status_scalar=bool(flags & 16),
             )
             m.pop("obj", None)  # the raw line supersedes any stale object
+        if self._trace_every:
+            self._trace_n += 1
+            if self._trace_n % self._trace_every == 0:
+                m["_trace_t0"] = time.perf_counter()
         if rec.pod_ip:
             with self._alloc_lock:
                 if self.ippool.contains(rec.pod_ip):
@@ -1470,6 +1505,7 @@ class ClusterEngine:
                         deadline = min(wake, time.monotonic() + self._IDLE_MAX)
                 lag_max = 0.0
                 drain_s = 0.0
+                drain_t0 = 0.0  # perf_counter of the first drained item
                 got_event = False
                 raw_buf: dict = {}
                 # drain ingest until the next tick is due; while ticks are
@@ -1507,6 +1543,8 @@ class ClusterEngine:
                         deadline = min(deadline, time.monotonic() + interval)
                     lag_max = max(lag_max, time.monotonic() - item[3])
                     _t = time.perf_counter()
+                    if not drain_t0:
+                        drain_t0 = _t
                     self._drain_apply(item, raw_buf)
                     drain_s += time.perf_counter() - _t
                     # keep draining whatever is immediately available
@@ -1523,15 +1561,28 @@ class ClusterEngine:
                         _t = time.perf_counter()
                         self._drain_apply(item, raw_buf)
                         drain_s += time.perf_counter() - _t
-                _t = time.perf_counter()
-                self._drain_flush(raw_buf)
-                drain_s += time.perf_counter() - _t
-                with self._metrics_lock:
+                if raw_buf:
+                    _t = time.perf_counter()
+                    if not drain_t0:
+                        drain_t0 = _t
+                    self._drain_flush(raw_buf)
+                    drain_s += time.perf_counter() - _t
+                tel = self.telemetry
+                if got_event:
                     # enqueue -> processing delay of the slowest event
-                    self.metrics["watch_lag_seconds"] = lag_max
-                    self.metrics["ingest_queue_depth"] = self._q.qsize()
-                    self.metrics["ingest_drain_seconds_sum"] += drain_s
-                    self.metrics["tick_inflight"] = len(pending)
+                    tel.observe_watch_lag(lag_max)
+                else:
+                    tel.set_gauge("watch_lag_seconds", lag_max)
+                tel.set_gauge("ingest_queue_depth", self._q.qsize())
+                tel.set_gauge("tick_inflight", len(pending))
+                if drain_t0:  # real drain work happened this window
+                    tel.observe_stage("drain", drain_s)
+                    # one span per drain window: start anchored at the
+                    # first drained item, duration = active drain time
+                    # (the waits between bursts are excluded)
+                    tel.span(
+                        "tick.drain", drain_t0, drain_t0 + drain_s, "drain"
+                    )
                 try:
                     # consume every tick whose wire has landed (free);
                     # a full pipeline blocks on the oldest, so `depth`
@@ -1583,7 +1634,7 @@ class ClusterEngine:
             logger.exception("ingest failed for %s %s", kind, type_)
 
     def _maybe_profile(self) -> None:
-        ticks = self.metrics["ticks_total"]
+        ticks = self.telemetry.ticks_total
         if ticks == 2 and not getattr(self, "_profiling", False):
             import jax
 
@@ -1646,11 +1697,11 @@ class ClusterEngine:
             elif len(k.pool):
                 work = True
         t_flush = time.perf_counter()
-        with self._metrics_lock:
-            self.metrics["nodes_managed"] = len(self.nodes.pool)
-            self.metrics["pods_managed"] = len(self.pods.pool)
-            self.metrics["ticks_total"] += 1
-            self.metrics["tick_flush_seconds_sum"] += t_flush - t0
+        tel = self.telemetry
+        tel.set_gauge("nodes_managed", len(self.nodes.pool))
+        tel.set_gauge("pods_managed", len(self.pods.pool))
+        tel.inc("ticks_total")
+        tel.observe_stage("flush", t_flush - t0)
         if not work:
             self._idle_wake = None  # empty engine: sleep until events
             return None
@@ -1669,13 +1720,15 @@ class ClusterEngine:
         # consume. Output states are never read on host, so the next
         # dispatch is free to donate them.
         prefetch(wire)
+        t_end = time.perf_counter()
+        tel.span("tick.dispatch", t0, t_end, "dispatch")
         return _PendingTick(
             wire=wire,
             caps=[self.nodes.capacity, self.pods.capacity],
             seq=self._release_seq,
             now=now,
             mono=time.monotonic(),
-            host_s=time.perf_counter() - t0,
+            host_s=t_end - t0,
         )
 
     def _tick_consume(self, p: "_PendingTick") -> None:
@@ -1703,7 +1756,9 @@ class ClusterEngine:
                 n_trans = int(counters[i])
                 n_hb = int(counters[2 + i])
                 if n_trans:
-                    self._inc("transitions_total", n_trans)
+                    self.telemetry.inc_kind(
+                        "transitions_total", kind, n_trans
+                    )
                 if not (n_trans or n_hb):
                     continue
                 dirty, deleted, hb = masks[i]
@@ -1736,13 +1791,21 @@ class ClusterEngine:
                         k.cond_h[idxs] = cb[idxs]
                 _t = time.perf_counter()
                 self._emit(kind, k, dirty, deleted, hb, now_str)
-                emit_s += time.perf_counter() - _t
+                _t1 = time.perf_counter()
+                emit_s += _t1 - _t
+                self.telemetry.span(
+                    "tick.emit", _t, _t1, "emit", {"kind": kind}
+                )
         elapsed = time.perf_counter() - t0 + p.host_s
-        with self._metrics_lock:
-            self.metrics["tick_seconds_sum"] += elapsed
-            self.metrics["tick_seconds_last"] = elapsed
-            self.metrics["tick_kernel_seconds_sum"] += t_wire - t0
-            self.metrics["tick_emit_seconds_sum"] += emit_s
+        tel = self.telemetry
+        tel.observe_tick(elapsed)
+        tel.observe_stage("kernel", t_wire - t0)
+        if emit_s:
+            tel.observe_stage("emit", emit_s)
+        tel.span(
+            "tick.consume", t0, time.perf_counter(), "consume",
+            {"wire_wait_us": round((t_wire - t0) * 1e6, 1)},
+        )
 
     # ------------------------------------------------------------------ emit
 
@@ -1983,16 +2046,29 @@ class ClusterEngine:
         _t = time.perf_counter()
         with self._pump_lock:
             status = self._pump.send(reqs)
-        with self._metrics_lock:
-            self.metrics["pump_send_seconds_sum"] += time.perf_counter() - _t
-            self.metrics["pump_requests_total"] += len(reqs)
+        _t1 = time.perf_counter()
+        tel = self.telemetry
+        tel.pump_hist.observe(_t1 - _t)
+        tel.inc("pump_requests_total", len(reqs))
+        tel.span(
+            "pump.send", _t, _t1, "pump", {"kind": kind, "n": len(reqs)}
+        )
         ok = int(((status >= 200) & (status < 300)).sum())
         if kind == "heartbeat":
             self._inc("heartbeats_total", ok)
         else:
             self._inc("status_patches_total", ok)
+        _now = time.perf_counter()
+        # sampled end-to-end traces: only pay the per-ack meta lookup when
+        # sampling is on (ingest can only have stamped _trace_t0 then)
+        want_trace = self._trace_every and kind == "pods"
         for st, idx in zip(status.tolist(), idxs):
             if 200 <= st < 300 or st == 404:
+                if want_trace:
+                    m = self.pods.pool.meta[idx]
+                    t0e = m.pop("_trace_t0", None) if m else None
+                    if t0e is not None:
+                        tel.span("pod.ingest_to_patch", t0e, _now, "event")
                 continue  # 404 = object deleted server-side; Python path
                 # treats that as a no-op too
             if kind == "pods":
@@ -2022,13 +2098,21 @@ class ClusterEngine:
         )
         if not node_status_patch_needed(current, rendered):
             return
+        _t = time.perf_counter()
         self.client.patch_status("nodes", None, name, {"status": rendered})
+        self.telemetry.observe_patch_rtt(
+            "node_status", time.perf_counter() - _t
+        )
         self._inc("status_patches_total")
 
     def _heartbeat_node(self, name: str, idx: int, now_str: str) -> None:
         k = self.nodes
         rendered = render_node_heartbeat(int(k.cond_h[idx]), now_str, self.start_time)
+        _t = time.perf_counter()
         self.client.patch_status("nodes", None, name, {"status": rendered})
+        self.telemetry.observe_patch_rtt(
+            "heartbeat", time.perf_counter() - _t
+        )
         self._inc("heartbeats_total")
 
     def _emit_heartbeats_native(self, k, hb_rows, now_str: str) -> None:
@@ -2064,7 +2148,11 @@ class ClusterEngine:
             self._submit(self._send_heartbeat_bytes, name, body)
 
     def _send_heartbeat_bytes(self, name: str, body: bytes) -> None:
+        _t = time.perf_counter()
         self.client.patch_status("nodes", None, name, body)
+        self.telemetry.observe_patch_rtt(
+            "heartbeat", time.perf_counter() - _t
+        )
         self._inc("heartbeats_total")
 
     def _render_pod(self, idx: int):
@@ -2141,6 +2229,10 @@ class ClusterEngine:
         m = k.pool.meta[idx]
         if not m:
             return
+        # consume any sampled ingest stamp up front: a suppressed/skipped
+        # patch must not leave it behind for a later unrelated patch to
+        # close with an arbitrarily inflated duration
+        t0e = m.pop("_trace_t0", None) if self._trace_every else None
         rendered = self._render_pod(idx)
         if rendered is None:
             return
@@ -2148,7 +2240,15 @@ class ClusterEngine:
         if not pod_status_patch_needed(current, rendered):
             return
         ns, name = key
+        _t = time.perf_counter()
         self.client.patch_status("pods", ns, name, {"status": rendered})
+        _t1 = time.perf_counter()
+        self.telemetry.observe_patch_rtt("pod_status", _t1 - _t)
+        if t0e is not None:  # sampled ingest->patch end-to-end span
+            self.telemetry.span(
+                "pod.ingest_to_patch", t0e, _t1, "event",
+                {"ns": ns, "name": name},
+            )
         self._inc("status_patches_total")
 
     def _delete_pod(self, key, idx: int) -> None:
@@ -2158,7 +2258,11 @@ class ClusterEngine:
         m = self.pods.pool.meta[idx]
         if m and m.get("finalizers"):
             self.client.patch_meta("pods", ns, name, {"metadata": {"finalizers": None}})
+        _t = time.perf_counter()
         self.client.delete("pods", ns, name, grace_seconds=0)
+        self.telemetry.observe_patch_rtt(
+            "pod_delete", time.perf_counter() - _t
+        )
         self._inc("deletes_total")
 
     def _emit_deletes_native(self, k, del_rows) -> None:

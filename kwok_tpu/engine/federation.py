@@ -50,6 +50,12 @@ from kwok_tpu.ops.tick import (
     unpack_wire,
 )
 from kwok_tpu.parallel import make_mesh
+from kwok_tpu.telemetry import (
+    EngineTelemetry,
+    MetricsRegistry,
+    Tracer,
+    merge_chrome_traces,
+)
 
 logger = logging.getLogger("kwok_tpu.federation")
 
@@ -103,7 +109,10 @@ class _Group:
     def __init__(self, engines, cfg, mesh):
         self.engines = engines  # ClusterEngines, federation order preserved
         self.r = 0  # rows per cluster; set by alloc
-        self.dispatches = 0  # fused-kernel launches (one per active tick)
+        # fused-kernel launch counter: the registry child (set by
+        # FederatedEngine right after group construction) is the single
+        # source of truth; `dispatches` below is the legacy read view
+        self.dispatch_counter = None
         # monotonic device-timer deadline from this group's newest consumed
         # tick (None = nothing scheduled); the loop gate takes the min
         self.wake: float | None = 0.0
@@ -122,6 +131,11 @@ class _Group:
             dt=cfg.tick_interval / steps,
         )
         self.stacked: dict[str, RowState] = {}
+
+    @property
+    def dispatches(self) -> int:
+        """Fused-kernel launches so far (legacy view of the counter)."""
+        return self.dispatch_counter.value if self.dispatch_counter else 0
 
     def alloc(self, r: int) -> None:
         self.r = r
@@ -164,14 +178,24 @@ class FederatedEngine:
             *(int(c.initial_capacity) for c in cfgs),
         )
 
+        # ONE registry for the whole federation: every member registers the
+        # same families and writes its own shard-labeled children, so
+        # /metrics exports per-shard series (shard="0".."N-1") instead of
+        # whichever member's scalar was written last. The fed loop itself
+        # records its spans in its own tracer; /debug/trace merges all.
+        self.registry = MetricsRegistry()
+        self.tracer = Tracer()
         self.engines = [
             ClusterEngine(
                 client,
                 dataclasses.replace(
                     cfg, initial_capacity=base_capacity, use_mesh=False
                 ),
+                telemetry=EngineTelemetry(
+                    registry=self.registry, shard=str(i)
+                ),
             )
-            for client, cfg in zip(clients, cfgs)
+            for i, (client, cfg) in enumerate(zip(clients, cfgs))
         ]
 
         # Group members by compiled rule set + heartbeat cadence: the rule
@@ -210,6 +234,30 @@ class FederatedEngine:
         self._epoch = time.time()
         for e in self.engines:
             e._epoch = self._epoch
+
+        # per-group kernel-launch counters (labeled series), plus
+        # cross-shard aggregate gauges refreshed on every /metrics render
+        disp_fam = self.registry.counter(
+            "kwok_group_dispatches_total",
+            "Fused-kernel launches per rule-set group",
+            ("group",),
+        )
+        for i, g in enumerate(self.groups):
+            g.dispatch_counter = disp_fam.labels(group=str(i))
+        self._agg_lag = self.registry.gauge(
+            "kwok_fed_watch_lag_seconds_max",
+            "Worst per-shard watch lag in the last drain window",
+        )
+        self._agg_depth = self.registry.gauge(
+            "kwok_fed_ingest_queue_depth",
+            "Watch events waiting to be ingested, summed across shards",
+        )
+        self._agg_nodes = self.registry.gauge(
+            "kwok_fed_nodes_managed", "Nodes managed across all shards"
+        )
+        self._agg_pods = self.registry.gauge(
+            "kwok_fed_pods_managed", "Pods tracked across all shards"
+        )
 
         self.config = config
         self._running = False
@@ -301,6 +349,21 @@ class FederatedEngine:
             self._thread.join(timeout=5)
         for e in self.engines:
             e.stop()
+        import json as _json
+        import os as _os
+
+        trace_path = self.config.trace_dump or _os.environ.get(
+            "KWOK_TPU_TRACE", ""
+        )
+        if trace_path:
+            # members skip their own dump (run_tick_loop=False); the
+            # federation writes ONE merged document
+            try:
+                with open(trace_path, "w") as f:
+                    _json.dump(self.trace_chrome(), f)
+                logger.info("federated span trace written to %s", trace_path)
+            except Exception:
+                logger.exception("federated span trace dump failed")
 
     # ------------------------------------------------------------- tick loop
 
@@ -436,12 +499,21 @@ class FederatedEngine:
                     drain[i] = drain.get(i, 0.0) + (
                         time.perf_counter() - _t
                     )
-            # slowest enqueue->processing delay this tick; 0 on a quiet tick
+            # slowest enqueue->processing delay this tick; 0 on a quiet
+            # tick. Each member writes its OWN shard-labeled children —
+            # the old flat dict let whichever shard drained last overwrite
+            # watch_lag_seconds/ingest_queue_depth for the whole federation
             for i, e in enumerate(self.engines):
-                with e._metrics_lock:
-                    e.metrics["watch_lag_seconds"] = lag.get(i, 0.0)
-                    e.metrics["ingest_queue_depth"] = e._q.qsize()
-                    e.metrics["ingest_drain_seconds_sum"] += drain.get(i, 0.0)
+                tel = e.telemetry
+                lag_i = lag.get(i, 0.0)
+                if i in lag:
+                    tel.observe_watch_lag(lag_i)
+                else:
+                    tel.set_gauge("watch_lag_seconds", 0.0)
+                tel.set_gauge("ingest_queue_depth", e._q.qsize())
+                drain_i = drain.get(i, 0.0)
+                if drain_i:
+                    tel.observe_stage("drain", drain_i)
         return got_event
 
     # ------------------------------------------------------------------ tick
@@ -491,14 +563,17 @@ class FederatedEngine:
         if not any_dispatch:
             wakes = [g.wake for g in self.groups if g.wake is not None]
             self._idle_wake = min(wakes) if wakes else None
-        host_s = time.perf_counter() - t0
+        t_end = time.perf_counter()
+        host_s = t_end - t0
+        if any_dispatch:
+            self.tracer.span("tick.dispatch", t0, t_end, "dispatch")
         for e in self.engines:
-            with e._metrics_lock:
-                e.metrics["ticks_total"] += 1
-                e.metrics["tick_flush_seconds_sum"] += flush_s
-                e.metrics["tick_seconds_sum"] += host_s
-                e.metrics["nodes_managed"] = len(e.nodes.pool)
-                e.metrics["pods_managed"] = len(e.pods.pool)
+            tel = e.telemetry
+            tel.inc("ticks_total")
+            tel.observe_stage("flush", flush_s)
+            tel.tick_hist.observe(host_s)
+            tel.set_gauge("nodes_managed", len(e.nodes.pool))
+            tel.set_gauge("pods_managed", len(e.pods.pool))
 
     def _tick_group_dispatch(self, g: _Group, now: float):
         """Flush members' staged writes into the group's stacked state and
@@ -524,7 +599,7 @@ class FederatedEngine:
             return None  # empty group: nothing on device
         # with substeps, anchor the LAST scan step at wall-now
         now_base = now - (g.fused.steps - 1) * g.fused.dt
-        g.dispatches += 1
+        g.dispatch_counter.inc()
         (nout, pout), wire = g.fused(
             (g.stacked["nodes"], g.stacked["pods"]), now_base
         )
@@ -597,7 +672,9 @@ class FederatedEngine:
                         np.count_nonzero(d_c) + np.count_nonzero(del_c)
                     )
                     if trans_c:
-                        e._inc("transitions_total", trans_c)
+                        e.telemetry.inc_kind(
+                            "transitions_total", kind, trans_c
+                        )
                         idxs = np.nonzero(d_c | del_c)[0]
                         if rows is None:
                             rows = rows_fn()
@@ -609,7 +686,13 @@ class FederatedEngine:
                     if trans_c or hb_c.any():
                         _t = time.perf_counter()
                         e._emit(kind, k, d_c, del_c, hb_c, now_str)
-                        emit_s += time.perf_counter() - _t
+                        _t1 = time.perf_counter()
+                        emit_s += _t1 - _t
+                        e.telemetry.observe_stage("emit", _t1 - _t)
+                        self.tracer.span(
+                            "tick.emit", _t, _t1, "emit",
+                            {"kind": kind, "shard": c},
+                        )
         # prune each member's release log against its oldest still-in-
         # flight dispatch (members belong to exactly one group)
         next_p = next(
@@ -619,13 +702,16 @@ class FederatedEngine:
             e._prune_released(
                 next_p.seqs[c] if next_p is not None else e._release_seq
             )
-        elapsed = time.perf_counter() - t0
+        t_end = time.perf_counter()
+        elapsed = t_end - t0
+        self.tracer.span(
+            "tick.consume", t0, t_end, "consume",
+            {"wire_wait_us": round((t_wire - t0) * 1e6, 1)},
+        )
         for e in g.engines:
-            with e._metrics_lock:
-                e.metrics["tick_seconds_sum"] += elapsed
-                e.metrics["tick_seconds_last"] = elapsed
-                e.metrics["tick_kernel_seconds_sum"] += t_wire - t0
-                e.metrics["tick_emit_seconds_sum"] += emit_s
+            tel = e.telemetry
+            tel.observe_tick(elapsed)
+            tel.observe_stage("kernel", t_wire - t0)
 
     # ------------------------------------------------------------------ grow
 
@@ -666,26 +752,54 @@ class FederatedEngine:
     @property
     def metrics(self) -> dict:
         """Aggregated counters across members (gauges are summed too —
-        nodes/pods managed are totals across the federation)."""
+        nodes/pods managed are totals across the federation). The labeled
+        per-shard series live in ``self.registry``; this flat view keeps
+        the legacy surface (tests, cost model) working."""
         agg: dict[str, float] = {}
         for e in self.engines:
-            with e._metrics_lock:
-                for name, v in e.metrics.items():
-                    if name == "watch_lag_seconds":
-                        # worst-case lag, not a sum over members
-                        agg[name] = max(agg.get(name, 0.0), v)
-                    else:
-                        agg[name] = agg.get(name, 0) + v
+            for name, v in e.telemetry.legacy_dict().items():
+                if name == "watch_lag_seconds":
+                    # worst-case lag, not a sum over members
+                    agg[name] = max(agg.get(name, 0.0), v)
+                else:
+                    agg[name] = agg.get(name, 0) + v
         if self.engines:
             n = len(self.engines)
-            # every member records the same shared-tick values; un-sum them
+            # every member records the same shared-tick values; un-sum
+            # them (emit/drain are per-member work and stay summed)
             for name in ("ticks_total", "tick_seconds_sum",
                          "tick_seconds_last", "epoch_rebases_total",
-                         "tick_flush_seconds_sum", "tick_kernel_seconds_sum",
-                         "tick_emit_seconds_sum"):
+                         "tick_flush_seconds_sum",
+                         "tick_kernel_seconds_sum"):
                 agg[name] = agg[name] / n
         # per-rule-set-group kernel launches: a heterogeneous federation
         # shows one live counter per group, a homogeneous one exactly one
         for i, g in enumerate(self.groups):
-            agg[f"group{i}_dispatches_total"] = g.dispatches
+            agg[f"group{i}_dispatches_total"] = g.dispatch_counter.value
         return agg
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition of the shared registry: per-shard labeled
+        series plus the cross-shard aggregates (refreshed here so a scrape
+        always sees a consistent view)."""
+        lags, depths, nn, pp = [], [], 0, 0
+        for e in self.engines:
+            d = e.telemetry.legacy_dict()
+            lags.append(d["watch_lag_seconds"])
+            depths.append(d["ingest_queue_depth"])
+            nn += d["nodes_managed"]
+            pp += d["pods_managed"]
+        self._agg_lag.set(max(lags) if lags else 0.0)
+        self._agg_depth.set(sum(depths))
+        self._agg_nodes.set(nn)
+        self._agg_pods.set(pp)
+        return self.registry.render()
+
+    def trace_chrome(self) -> dict:
+        """Chrome trace-event doc merging the fed loop's spans with every
+        member's (pump/patch/event spans land member-side)."""
+        return merge_chrome_traces(
+            [self.tracer] + [e.tracer for e in self.engines],
+            labels=["federation"]
+            + [f"shard{i}" for i in range(len(self.engines))],
+        )

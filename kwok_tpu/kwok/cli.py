@@ -76,6 +76,13 @@ def build_parser(defaults) -> argparse.ArgumentParser:
                    help="shard cluster state across all local devices")
     p.add_argument("--profile-dir", default="",
                    help="write a JAX profiler trace of ticks 2-102 here")
+    p.add_argument("--trace-dump", default="",
+                   help="write the engine's span trace (Chrome trace-event "
+                   "JSON, same document as /debug/trace) here at stop; "
+                   "KWOK_TPU_TRACE=<path> works too")
+    p.add_argument("--trace-sample-every", type=int, default=256,
+                   help="sample 1-in-N watch events for end-to-end "
+                   "ingest->patch spans (0 disables)")
     from kwok_tpu import log
 
     log.add_flags(p)
@@ -104,6 +111,8 @@ def _engine_config(args, stages: list[Stage]):
         initial_capacity=args.initial_capacity,
         use_mesh=args.use_mesh,
         profile_dir=args.profile_dir,
+        trace_dump=args.trace_dump,
+        trace_sample_every=args.trace_sample_every,
         node_rules=stages_to_rules(stages, ResourceKind.NODE),
         pod_rules=stages_to_rules(stages, ResourceKind.POD),
     )

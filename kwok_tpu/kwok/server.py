@@ -1,12 +1,21 @@
-"""HTTP server: /healthz /readyz /livez + Prometheus /metrics.
+"""HTTP server: /healthz /readyz /livez + Prometheus /metrics + /debug/trace.
 
-Mirrors Serve in pkg/kwok/cmd/root.go:173-202, with real engine counters
+Mirrors Serve in pkg/kwok/cmd/root.go:173-202, with real engine telemetry
 instead of only Go runtime collectors (SURVEY.md section 5.5: the counters
 that matter are transitions/sec, patches/sec, tick latency, watch lag).
+
+Engines that carry a telemetry registry (ClusterEngine, FederatedEngine)
+serve the full labeled exposition — real histograms with ``_bucket``/
+``_sum``/``_count`` series, per-shard labels under federation, and a
+``kwok_build_info`` gauge — via their ``metrics_text()``. ``/debug/trace``
+returns the span ring as Chrome trace-event JSON (open it in Perfetto /
+``chrome://tracing``). Plain dict-``metrics`` objects (tests, stubs) fall
+back to the legacy flat renderer below.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -33,17 +42,35 @@ _METRIC_HELP = {
 }
 
 
-def render_metrics(metrics: dict) -> str:
-    metrics = dict(metrics)
-    try:  # standard process collector subset (user+sys CPU of this process)
+def _process_block() -> str:
+    """Standard process collector subset (user+sys CPU of this process),
+    appended to both exposition paths."""
+    try:
         import resource
 
         ru = resource.getrusage(resource.RUSAGE_SELF)
-        metrics["process_cpu_seconds_total"] = round(
-            ru.ru_utime + ru.ru_stime, 2
-        )
+        cpu = round(ru.ru_utime + ru.ru_stime, 2)
     except (ImportError, OSError):
-        pass
+        return ""
+    return (
+        "# HELP process_cpu_seconds_total Total user and system CPU time "
+        "spent in seconds\n"
+        "# TYPE process_cpu_seconds_total counter\n"
+        f"process_cpu_seconds_total {cpu}\n"
+    )
+
+
+def render_metrics(metrics) -> str:
+    """Render /metrics text. Accepts an engine carrying a telemetry
+    registry (the full labeled exposition) or a flat name->value dict (the
+    legacy surface; kept for stub engines and old tooling). The legacy
+    path types strictly by suffix — ``*_total``/``*_sum`` are counters,
+    everything else (including ``*_seconds_last``) is a gauge — so its
+    output also passes the strict-parser oracle."""
+    text_fn = getattr(metrics, "metrics_text", None)
+    if callable(text_fn):
+        return text_fn() + _process_block()
+    metrics = dict(getattr(metrics, "metrics", metrics))
     lines = []
     for name, value in sorted(metrics.items()):
         full = f"kwok_{name}"
@@ -52,7 +79,7 @@ def render_metrics(metrics: dict) -> str:
         kind = "counter" if name.endswith(("_total", "_sum")) else "gauge"
         lines.append(f"# TYPE {full} {kind}")
         lines.append(f"{full} {value}")
-    return "\n".join(lines) + "\n"
+    return "\n".join(lines) + "\n" + _process_block()
 
 
 class EngineServer:
@@ -83,8 +110,15 @@ class EngineServer:
                     body = b"ok"
                     ctype = "text/plain"
                 elif self.path == "/metrics":
-                    body = render_metrics(dict(engine.metrics)).encode()
+                    body = render_metrics(engine).encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path == "/debug/trace":
+                    trace_fn = getattr(engine, "trace_chrome", None)
+                    if not callable(trace_fn):
+                        self.send_error(404, "engine has no tracer")
+                        return
+                    body = json.dumps(trace_fn()).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return

@@ -11,15 +11,25 @@ profiled per process — useless for an engine whose CPU is spread across a
 tick thread, watch threads, and a patch executor.  Sampling sees them all
 at once, costs ~nothing at the default 2 ms cadence, and the counts are
 directly proportional to wall time spent per frame.
+
+Crash-proofing: ``maybe_start`` registers an ``atexit`` hook and (from the
+main thread) chains onto SIGTERM, so a killed or crashed engine that never
+reaches ``stop()`` still leaves its sample data on disk. The dump also
+carries an ``overruns`` count — sampling intervals missed because one
+snapshot took longer than the cadence — so a report whose wall-clock
+coverage is thinner than ``samples * interval_s`` says so itself.
 """
 
 from __future__ import annotations
 
+import atexit
 import collections
 import json
 import os
+import signal
 import sys
 import threading
+import time
 
 ENV = "KWOK_TPU_SAMPLE_PROF"
 
@@ -37,6 +47,9 @@ class Sampler:
             collections.Counter
         )
         self.samples = 0
+        # intervals missed because a snapshot ran longer than the cadence
+        # (GIL stalls, huge stacks): coverage = samples / (samples+overruns)
+        self.overruns = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -49,6 +62,7 @@ class Sampler:
     def _run(self) -> None:
         names = {}  # thread ident -> name (refreshed per sample)
         while not self._stop.is_set():
+            t0 = time.perf_counter()
             for th in threading.enumerate():
                 names[th.ident] = th.name
             me = threading.get_ident()
@@ -75,15 +89,19 @@ class Sampler:
                         self.cum[name][key] += 1
                     frame = frame.f_back
             self.samples += 1
+            took = time.perf_counter() - t0
+            if took > self.interval_s:
+                self.overruns += int(took / self.interval_s)
             self._stop.wait(self.interval_s)
 
     def stop_and_dump(self) -> None:
         self._stop.set()
-        if self._thread is not None:
+        if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join(timeout=5)
         out = {
             "samples": self.samples,
             "interval_s": self.interval_s,
+            "overruns": self.overruns,
             "threads": {},
         }
         for name in sorted(self.leaf):
@@ -99,6 +117,32 @@ class Sampler:
 
 _sampler: Sampler | None = None
 _lock = threading.Lock()
+_hooks_installed = False
+
+
+def _install_dump_hooks() -> None:
+    """atexit always; SIGTERM only when callable from the main thread and
+    only by CHAINING the existing handler (the CLI installs its own
+    graceful-stop handler — both must run). Idempotent."""
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    atexit.register(maybe_dump)
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def on_term(signum, frame):
+            maybe_dump()
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, on_term)
+    except ValueError:
+        pass  # not the main thread: atexit alone still covers clean exits
 
 
 def maybe_start() -> None:
@@ -110,6 +154,7 @@ def maybe_start() -> None:
     with _lock:
         if _sampler is None:
             _sampler = Sampler(path).start()
+            _install_dump_hooks()
 
 
 def maybe_dump() -> None:

@@ -1,0 +1,38 @@
+"""kwok_tpu.telemetry: metrics registry + span tracing for the engine.
+
+Three pieces (ISSUE 1 tentpole):
+
+- ``registry``: a lock-light Prometheus-style registry — counters, gauges,
+  fixed-bucket histograms with label support — rendering the text
+  exposition format with real ``_bucket``/``_sum``/``_count`` series.
+- ``trace``: a bounded ring-buffer span tracer exporting Chrome
+  trace-event JSON (``/debug/trace``), attributing per-tick wall time to
+  named stages (dispatch → consume → emit → pump ack).
+- ``engine_metrics``: the engine's named handles over both, plus the
+  legacy flat-dict view older tooling still reads.
+"""
+
+from kwok_tpu.telemetry.engine_metrics import (
+    EngineTelemetry,
+    register_build_info,
+)
+from kwok_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    CounterFamily,
+    GaugeFamily,
+    HistogramFamily,
+    MetricsRegistry,
+)
+from kwok_tpu.telemetry.trace import Tracer, merge_chrome_traces
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "CounterFamily",
+    "EngineTelemetry",
+    "GaugeFamily",
+    "HistogramFamily",
+    "MetricsRegistry",
+    "Tracer",
+    "merge_chrome_traces",
+    "register_build_info",
+]

@@ -1,0 +1,227 @@
+"""Named metric handles for the engine + the legacy flat-dict view.
+
+``EngineTelemetry`` owns one ``MetricsRegistry`` slice (optionally shared
+across federation members, each under its own ``shard`` label) and one
+``Tracer``. The engine writes through typed handles (counter children,
+histogram children) — no name lookup, no global lock on the hot path — and
+``legacy_dict()`` reconstructs the pre-telemetry ``engine.metrics`` dict
+(flat ``*_sum`` floats and last-tick gauges) that tests, the cost model,
+and older tooling still read.
+"""
+
+from __future__ import annotations
+
+from kwok_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+)
+from kwok_tpu.telemetry.trace import Tracer
+
+# Tick stages: every histogram child is pre-created so exposition is stable
+# from the first scrape and observe never takes the family lock.
+STAGES = ("flush", "kernel", "emit", "drain", "parse")
+
+_HELP = {
+    "kwok_transitions_total": "Lifecycle phase transitions applied by the tick kernel",
+    "kwok_status_patches_total": "Status patches sent to the apiserver",
+    "kwok_heartbeats_total": "Node heartbeat patches sent",
+    "kwok_deletes_total": "Pod deletes issued",
+    "kwok_epoch_rebases_total": "f32 time-epoch rebases performed",
+    "kwok_watch_events_total": "Watch events ingested",
+    "kwok_watch_bookmarks_total": "BOOKMARK events consumed (rv advanced, no ingest)",
+    "kwok_watch_relists_total": "Full re-lists performed by the watch loops",
+    "kwok_patch_errors_total": "Patch/delete jobs that raised",
+    "kwok_ticks_total": "Engine ticks executed",
+    "kwok_pump_requests_total": "Requests shipped through the native pump",
+    "kwok_tick_seconds": "Wall seconds per engine tick (dispatch + consume halves)",
+    "kwok_tick_stage_seconds": "Per-tick wall seconds by stage "
+    "(flush=staged-write flush, kernel=device wire wait, emit=patch-job "
+    "fan-out, drain=ingest drain, parse=batched C++ line parse)",
+    "kwok_pump_send_seconds": "Wall seconds per native pump batch send",
+    "kwok_patch_rtt_seconds": "Apiserver round-trip seconds per patch/delete, by path",
+    "kwok_watch_lag_seconds": "Enqueue-to-processing delay of drained watch events",
+    "kwok_tick_seconds_last": "Duration of the most recent tick",
+    "kwok_watch_lag_seconds_last": "Slowest event lag observed in the last drain window",
+    "kwok_ingest_queue_depth": "Watch events waiting to be ingested",
+    "kwok_tick_inflight": "Device ticks dispatched but not yet consumed",
+    "kwok_nodes_managed": "Nodes currently managed",
+    "kwok_pods_managed": "Pods currently tracked",
+    "kwok_build_info": "Build/version info (value is always 1)",
+    "kwok_trace_spans_total": "Spans recorded into the trace ring",
+}
+
+# legacy counter name -> (family name, has kind label)
+_COUNTERS = {
+    "transitions_total": ("kwok_transitions_total", True),
+    "status_patches_total": ("kwok_status_patches_total", False),
+    "heartbeats_total": ("kwok_heartbeats_total", False),
+    "deletes_total": ("kwok_deletes_total", False),
+    "epoch_rebases_total": ("kwok_epoch_rebases_total", False),
+    "watch_events_total": ("kwok_watch_events_total", True),
+    "watch_bookmarks_total": ("kwok_watch_bookmarks_total", False),
+    "watch_relists_total": ("kwok_watch_relists_total", False),
+    "patch_errors_total": ("kwok_patch_errors_total", False),
+    "ticks_total": ("kwok_ticks_total", False),
+    "pump_requests_total": ("kwok_pump_requests_total", False),
+}
+
+_GAUGES = {
+    "tick_seconds_last": "kwok_tick_seconds_last",
+    "watch_lag_seconds": "kwok_watch_lag_seconds_last",
+    "ingest_queue_depth": "kwok_ingest_queue_depth",
+    "tick_inflight": "kwok_tick_inflight",
+    "nodes_managed": "kwok_nodes_managed",
+    "pods_managed": "kwok_pods_managed",
+}
+
+_KINDS = ("nodes", "pods")
+
+
+def register_build_info(registry: MetricsRegistry) -> None:
+    """kwok_build_info{version=...} 1 — registered once per registry
+    (idempotent: federation members share one)."""
+    import platform
+
+    from kwok_tpu import __version__
+
+    fam = registry.gauge(
+        "kwok_build_info", _HELP["kwok_build_info"], ("version", "python")
+    )
+    fam.labels(version=__version__, python=platform.python_version()).set(1)
+
+
+class EngineTelemetry:
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        shard: str | None = None,
+        tracer: Tracer | None = None,
+        trace_capacity: int = 65536,
+    ):
+        self.registry = registry or MetricsRegistry()
+        self.shard = shard
+        self.tracer = tracer if tracer is not None else Tracer(trace_capacity)
+        r = self.registry
+        base = ("shard",) if shard is not None else ()
+        sl = {"shard": shard} if shard is not None else {}
+
+        def child(fam):
+            return fam.labels(**sl) if shard is not None else fam.child
+
+        self._counters = {}
+        self._kind_counters = {}
+        for legacy, (name, by_kind) in _COUNTERS.items():
+            if by_kind:
+                fam = r.counter(name, _HELP[name], base + ("kind",))
+                self._kind_counters[legacy] = {
+                    k: fam.labels(**sl, kind=k) for k in _KINDS
+                }
+            else:
+                self._counters[legacy] = child(
+                    r.counter(name, _HELP[name], base)
+                )
+        self._gauges = {
+            legacy: child(r.gauge(name, _HELP[name], base))
+            for legacy, name in _GAUGES.items()
+        }
+        self.tick_hist = child(
+            r.histogram("kwok_tick_seconds", _HELP["kwok_tick_seconds"], base)
+        )
+        stage_fam = r.histogram(
+            "kwok_tick_stage_seconds",
+            _HELP["kwok_tick_stage_seconds"],
+            base + ("stage",),
+        )
+        self.stage_hists = {
+            s: stage_fam.labels(**sl, stage=s) for s in STAGES
+        }
+        self.pump_hist = child(
+            r.histogram(
+                "kwok_pump_send_seconds", _HELP["kwok_pump_send_seconds"], base
+            )
+        )
+        self._rtt_fam = r.histogram(
+            "kwok_patch_rtt_seconds",
+            _HELP["kwok_patch_rtt_seconds"],
+            base + ("path",),
+        )
+        self._rtt_labels = sl
+        self._rtt_children: dict[str, object] = {}
+        self.lag_hist = child(
+            r.histogram(
+                "kwok_watch_lag_seconds",
+                _HELP["kwok_watch_lag_seconds"],
+                base,
+                buckets=DEFAULT_LATENCY_BUCKETS,
+            )
+        )
+        self._spans = child(
+            r.counter(
+                "kwok_trace_spans_total", _HELP["kwok_trace_spans_total"], base
+            )
+        )
+        register_build_info(r)
+
+    # ------------------------------------------------------------- writes
+
+    def inc(self, name: str, v=1) -> None:
+        c = self._counters.get(name)
+        if c is not None:
+            c.inc(v)
+        else:
+            # kind-labeled family incremented without a kind (legacy call
+            # sites that lost the context): attribute to pods, the dominant
+            # kind — only the SyncEngine test path reaches this
+            self._kind_counters[name]["pods"].inc(v)
+
+    def inc_kind(self, name: str, kind: str, v=1) -> None:
+        self._kind_counters[name][kind].inc(v)
+
+    def set_gauge(self, name: str, v) -> None:
+        self._gauges[name].set(v)
+
+    def observe_tick(self, seconds: float) -> None:
+        self.tick_hist.observe(seconds)
+        self._gauges["tick_seconds_last"].set(seconds)
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        self.stage_hists[stage].observe(seconds)
+
+    def observe_watch_lag(self, seconds: float) -> None:
+        self.lag_hist.observe(seconds)
+        self._gauges["watch_lag_seconds"].set(seconds)
+
+    def observe_patch_rtt(self, path: str, seconds: float) -> None:
+        c = self._rtt_children.get(path)
+        if c is None:
+            c = self._rtt_fam.labels(**self._rtt_labels, path=path)
+            self._rtt_children[path] = c
+        c.observe(seconds)
+
+    def span(self, name, t0, t1, lane="drain", args=None) -> None:
+        self.tracer.span(name, t0, t1, lane, args)
+        self._spans.inc()
+
+    # ------------------------------------------------------------- reads
+
+    @property
+    def ticks_total(self) -> int:
+        return self._counters["ticks_total"].value
+
+    def legacy_dict(self) -> dict:
+        """The pre-telemetry ``engine.metrics`` surface: flat names, plain
+        numbers. ``*_seconds_sum`` keys come from histogram sums, so the
+        old cost-model arithmetic keeps working unchanged."""
+        d = {name: c.value for name, c in self._counters.items()}
+        for name, by_kind in self._kind_counters.items():
+            d[name] = sum(c.value for c in by_kind.values())
+        for name, g in self._gauges.items():
+            d[name] = g.value
+        d["tick_seconds_sum"] = self.tick_hist.sum
+        d["tick_flush_seconds_sum"] = self.stage_hists["flush"].sum
+        d["tick_kernel_seconds_sum"] = self.stage_hists["kernel"].sum
+        d["tick_emit_seconds_sum"] = self.stage_hists["emit"].sum
+        d["ingest_drain_seconds_sum"] = self.stage_hists["drain"].sum
+        d["ingest_parse_seconds_sum"] = self.stage_hists["parse"].sum
+        d["pump_send_seconds_sum"] = self.pump_hist.sum
+        return d

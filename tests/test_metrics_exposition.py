@@ -1,0 +1,392 @@
+"""Exposition-format oracle: parse /metrics line-by-line against the
+Prometheus text format 0.0.4 rules (HELP/TYPE placement, name charsets,
+label escaping, histogram bucket monotonicity and _count/_sum consistency)
+and round-trip /debug/trace JSON against the Chrome trace-event schema.
+
+A real Prometheus server cannot scrape in CI (no binary, zero egress), so
+this parser IS the scrape: anything it rejects, a real scraper would."""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import pytest
+
+from tests.fake_apiserver import FakeKube
+from tests.test_engine import SyncEngine, make_node, make_pod
+
+from kwok_tpu.engine import EngineConfig
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _parse_labels(blob: str) -> dict:
+    """Parse `a="x",b="y"` honoring \\\\, \\" and \\n escapes."""
+    labels = {}
+    i = 0
+    while i < len(blob):
+        m = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', blob[i:])
+        assert m, f"bad label syntax at {blob[i:]!r}"
+        name = m.group(1)
+        i += m.end()
+        val = []
+        while True:
+            assert i < len(blob), f"unterminated label value in {blob!r}"
+            ch = blob[i]
+            if ch == "\\":
+                esc = blob[i + 1]
+                assert esc in ('\\', '"', "n"), f"bad escape \\{esc}"
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[esc])
+                i += 2
+            elif ch == '"':
+                i += 1
+                break
+            else:
+                assert ch != "\n", "raw newline in label value"
+                val.append(ch)
+                i += 1
+        labels[name] = "".join(val)
+        if i < len(blob):
+            assert blob[i] == ",", f"expected , at {blob[i:]!r}"
+            i += 1
+    return labels
+
+
+def parse_exposition(text: str) -> dict:
+    """Strict parse. Returns {family: {"type": t, "samples":
+    [(sample_name, labels, value)]}} and raises AssertionError on any
+    format violation a real scraper would reject."""
+    assert text.endswith("\n"), "missing trailing newline"
+    families: dict[str, dict] = {}
+    helped: set[str] = set()
+
+    def family_of(sample_name: str) -> str:
+        # histogram/summary samples attach to their declared parent family
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample_name.endswith(suffix):
+                parent = sample_name[: -len(suffix)]
+                if families.get(parent, {}).get("type") in (
+                    "histogram", "summary"
+                ):
+                    return parent
+        return sample_name
+
+    seen_series = set()
+    for line in text.splitlines():
+        assert line == line.strip(), f"stray whitespace: {line!r}"
+        assert line, "blank line"
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            assert len(parts) == 4 and NAME_RE.match(parts[2]), line
+            assert parts[2] not in helped, f"duplicate HELP {parts[2]}"
+            helped.add(parts[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, line
+            _, _, name, kind = parts
+            assert NAME_RE.match(name), line
+            assert kind in ("counter", "gauge", "histogram", "summary"), line
+            assert name not in families, f"duplicate TYPE for {name}"
+            families[name] = {"type": kind, "samples": []}
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$", line)
+        assert m, f"unparseable sample: {line!r}"
+        sample_name, _, label_blob, value = m.groups()
+        labels = _parse_labels(label_blob) if label_blob else {}
+        for ln in labels:
+            assert LABEL_NAME_RE.match(ln), f"bad label name {ln}"
+        v = float(value)  # must parse as a Prometheus float
+        fam = family_of(sample_name)
+        assert fam in families, f"sample before TYPE: {sample_name}"
+        ftype = families[fam]["type"]
+        if ftype == "histogram":
+            assert sample_name[len(fam):] in ("_bucket", "_sum", "_count"), (
+                f"bad histogram sample {sample_name}"
+            )
+            if sample_name.endswith("_bucket"):
+                assert "le" in labels, f"_bucket without le: {line!r}"
+        else:
+            assert sample_name == fam, (
+                f"sample {sample_name} does not match family {fam}"
+            )
+        series = (sample_name, tuple(sorted(labels.items())))
+        assert series not in seen_series, f"duplicate series: {series}"
+        seen_series.add(series)
+        families[fam]["samples"].append((sample_name, labels, v))
+
+    for name, fam in families.items():
+        assert fam["samples"], f"declared family {name} has no samples"
+        # counter suffix convention (the old surface violated this with
+        # bare *_seconds_sum counters that had no _count)
+        if fam["type"] == "counter":
+            assert name.endswith("_total") or name.endswith("_sum"), (
+                f"counter {name} missing _total suffix"
+            )
+        if fam["type"] == "histogram":
+            _check_histogram(name, fam["samples"])
+        if fam["type"] in ("counter", "histogram"):
+            for _, _, v in fam["samples"]:
+                assert v >= 0, f"negative {fam['type']} sample in {name}"
+    return families
+
+
+def _check_histogram(name: str, samples) -> None:
+    """Bucket monotonicity + _count/_sum consistency per label set."""
+    by_labelset: dict[tuple, dict] = {}
+    for sample_name, labels, v in samples:
+        key = tuple(
+            sorted((k, val) for k, val in labels.items() if k != "le")
+        )
+        d = by_labelset.setdefault(key, {"buckets": [], "count": None,
+                                         "sum": None})
+        if sample_name.endswith("_bucket"):
+            le = labels["le"]
+            d["buckets"].append((math.inf if le == "+Inf" else float(le), v))
+        elif sample_name.endswith("_count"):
+            d["count"] = v
+        else:
+            d["sum"] = v
+    for key, d in by_labelset.items():
+        assert d["count"] is not None, f"{name}{key}: no _count"
+        assert d["sum"] is not None, f"{name}{key}: no _sum"
+        buckets = sorted(d["buckets"])
+        assert buckets, f"{name}{key}: no buckets"
+        assert buckets[-1][0] == math.inf, f"{name}{key}: no +Inf bucket"
+        prev = 0.0
+        for le, v in buckets:
+            assert v >= prev, (
+                f"{name}{key}: bucket le={le} not monotonic ({v} < {prev})"
+            )
+            prev = v
+        assert buckets[-1][1] == d["count"], (
+            f"{name}{key}: +Inf bucket != _count"
+        )
+
+
+def check_chrome_trace(doc: dict) -> None:
+    """Chrome trace-event schema: the subset chrome://tracing / Perfetto
+    requires of the JSON object format."""
+    assert isinstance(doc, dict)
+    events = doc["traceEvents"]
+    assert isinstance(events, list)
+    for ev in events:
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert ev["ph"] in ("X", "M"), ev
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        if "args" in ev:
+            assert isinstance(ev["args"], dict)
+
+
+@pytest.fixture
+def rig():
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True))
+    return server, eng
+
+
+def test_engine_exposition_strict(rig):
+    server, eng = rig
+    server.create("nodes", make_node("n0"))
+    server.create("pods", make_pod("p0", node="n0"))
+    eng.feed_all(server)
+    eng.pump(3)
+    fams = parse_exposition(eng.metrics_text())
+    # the headline families exist with the right types
+    assert fams["kwok_transitions_total"]["type"] == "counter"
+    assert fams["kwok_tick_seconds"]["type"] == "histogram"
+    assert fams["kwok_tick_stage_seconds"]["type"] == "histogram"
+    assert fams["kwok_patch_rtt_seconds"]["type"] == "histogram"
+    assert fams["kwok_tick_seconds_last"]["type"] == "gauge"
+    assert fams["kwok_build_info"]["type"] == "gauge"
+    # transitions are kind-labeled and real work was recorded
+    kinds = {s[1]["kind"] for s in fams["kwok_transitions_total"]["samples"]}
+    assert kinds == {"nodes", "pods"}
+    assert sum(s[2] for s in fams["kwok_transitions_total"]["samples"]) > 0
+    # tick histogram actually observed the pumps
+    count = [
+        v for n, _, v in fams["kwok_tick_seconds"]["samples"]
+        if n.endswith("_count")
+    ]
+    assert count and count[0] >= 3
+    # patch RTT is path-labeled
+    paths = {
+        s[1]["path"]
+        for s in fams["kwok_patch_rtt_seconds"]["samples"]
+        if s[1].get("path")
+    }
+    assert "pod_status" in paths
+
+
+def test_http_metrics_and_debug_trace(rig):
+    import http.client
+
+    from kwok_tpu.kwok.server import EngineServer
+
+    server, eng = rig
+    server.create("nodes", make_node("n0"))
+    server.create("pods", make_pod("p0", node="n0"))
+    eng.feed_all(server)
+    eng.pump(2)
+    http_srv = EngineServer(eng, "127.0.0.1:0")
+    http_srv.start()
+    try:
+        def get(path):
+            c = http.client.HTTPConnection(
+                "127.0.0.1", http_srv.port, timeout=5
+            )
+            try:
+                c.request("GET", path)
+                r = c.getresponse()
+                return r.status, r.read(), r.getheader("Content-Type")
+            finally:
+                c.close()
+
+        st, body, ctype = get("/metrics")
+        assert st == 200 and ctype.startswith("text/plain")
+        fams = parse_exposition(body.decode())
+        assert "kwok_build_info" in fams
+        assert fams["process_cpu_seconds_total"]["type"] == "counter"
+
+        st, body, ctype = get("/debug/trace")
+        assert st == 200 and ctype == "application/json"
+        doc = json.loads(body)  # round-trip: serialize -> parse
+        check_chrome_trace(doc)
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        # pumps ran: the tick stages must be attributed
+        assert "tick.dispatch" in names and "tick.consume" in names
+    finally:
+        http_srv.stop()
+
+
+def test_trace_chrome_roundtrip_and_ring_bound():
+    from kwok_tpu.telemetry import Tracer
+
+    tr = Tracer(capacity=8)
+    ep = tr.epoch_perf
+    for i in range(20):
+        tr.span(f"s{i}", ep + i, ep + i + 0.5, "drain", {"i": i})
+    doc = json.loads(json.dumps(tr.chrome_trace()))
+    check_chrome_trace(doc)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 8  # ring bounded
+    assert doc["otherData"]["spans_recorded"] == 20
+    # the ring keeps the NEWEST spans
+    assert {e["name"] for e in xs} == {f"s{i}" for i in range(12, 20)}
+
+
+def test_shard_labels_do_not_clobber():
+    """The federation fix: two shards writing the same family land as two
+    labeled series (the old flat dict let the last drainer overwrite)."""
+    from kwok_tpu.telemetry import EngineTelemetry, MetricsRegistry
+
+    reg = MetricsRegistry()
+    t0 = EngineTelemetry(registry=reg, shard="0")
+    t1 = EngineTelemetry(registry=reg, shard="1")
+    t0.set_gauge("watch_lag_seconds", 0.25)
+    t1.set_gauge("watch_lag_seconds", 0.75)
+    t0.observe_watch_lag(0.25)
+    t1.observe_watch_lag(0.75)
+    fams = parse_exposition(reg.render())
+    lag_last = {
+        s[1]["shard"]: s[2]
+        for s in fams["kwok_watch_lag_seconds_last"]["samples"]
+    }
+    assert lag_last == {"0": 0.25, "1": 0.75}
+    counts = {
+        s[1]["shard"]: s[2]
+        for s in fams["kwok_watch_lag_seconds"]["samples"]
+        if s[0].endswith("_count")
+    }
+    assert counts == {"0": 1.0, "1": 1.0}
+
+
+def test_label_escaping_roundtrip():
+    from kwok_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    g = reg.gauge("weird_gauge", 'help with \\ and\nnewline', ("tag",))
+    nasty = 'a"b\\c\nd'
+    g.labels(tag=nasty).set(1)
+    fams = parse_exposition(reg.render())
+    (name, labels, v), = fams["weird_gauge"]["samples"]
+    assert labels["tag"] == nasty and v == 1
+
+
+def test_histogram_edge_values():
+    from kwok_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", "h", buckets=(0.1, 1.0))
+    for v in (0.0, 0.1, 0.5, 1.0, 99.0):  # on-boundary and overflow
+        h.observe(v)
+    fams = parse_exposition(reg.render())
+    samples = {
+        (n, s.get("le")): v for n, s, v in fams["h_seconds"]["samples"]
+    }
+    # le is inclusive: a 0.1 observation lands in the 0.1 bucket
+    assert samples[("h_seconds_bucket", "0.1")] == 2
+    assert samples[("h_seconds_bucket", "1")] == 4
+    assert samples[("h_seconds_bucket", "+Inf")] == 5
+    assert samples[("h_seconds_count", None)] == 5
+    assert abs(samples[("h_seconds_sum", None)] - 100.6) < 1e-9
+
+
+def test_legacy_flat_render_still_strict(rig):
+    """The flat-dict fallback (stub engines, old tooling) also passes the
+    oracle — with the suffix-typing rule it always had."""
+    from kwok_tpu.kwok.server import render_metrics
+
+    server, eng = rig
+    server.create("nodes", make_node("n0"))
+    eng.feed_all(server)
+    eng.pump(2)
+    parse_exposition(render_metrics(dict(eng.metrics)))
+
+
+def test_engine_stop_dumps_trace(tmp_path):
+    from kwok_tpu.engine import ClusterEngine
+
+    server = FakeKube()
+    path = tmp_path / "trace.json"
+    eng = ClusterEngine(
+        server,
+        EngineConfig(manage_all_nodes=True, trace_dump=str(path)),
+    )
+    eng.start()
+    try:
+        server.create("nodes", make_node("dump-n"))
+    finally:
+        eng.stop()
+    doc = json.loads(path.read_text())
+    check_chrome_trace(doc)
+
+
+def test_profiling_overruns_and_hooks(tmp_path, monkeypatch):
+    """Sampler dumps carry the overrun counter, and the crash-dump hooks
+    install idempotently."""
+    import time
+
+    from kwok_tpu import profiling
+
+    out = tmp_path / "prof.json"
+    s = profiling.Sampler(str(out), interval_s=0.001)
+    s.start()
+    time.sleep(0.05)
+    s.stop_and_dump()
+    doc = json.loads(out.read_text())
+    assert doc["samples"] > 0
+    assert "overruns" in doc and doc["overruns"] >= 0
+
+    monkeypatch.setattr(profiling, "_hooks_installed", False)
+    profiling._install_dump_hooks()
+    profiling._install_dump_hooks()  # second call is a no-op
+    assert profiling._hooks_installed
