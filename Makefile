@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify bench obs-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify bench obs-check lane-check image cluster-image clean
 
 all: build
 
@@ -29,6 +29,13 @@ bench: ## the headline benchmark on the real device (ONE process, owns the TPU)
 
 obs-check: ## exposition-format + trace-schema oracle (docs/observability.md)
 	$(PYENV) python3 -m pytest tests/test_metrics_exposition.py -q
+
+# lane-check: the per-key patch-order oracle plus the engine tier-1 subset
+# under PYTHONDEVMODE, with test_lanes' threading.excepthook fixture failing
+# any test whose worker thread swallowed an exception.
+lane-check: ## sharded-lane ordering oracle + thread-sanity pass
+	$(PYENV) PYTHONDEVMODE=1 python3 -m pytest \
+	    tests/test_lanes.py tests/test_engine.py tests/test_pipeline.py -q
 
 image:
 	./images/kwok/build.sh

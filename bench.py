@@ -137,6 +137,52 @@ def _metrics_snapshot() -> str:
     return _bench_telemetry()["registry"].render()
 
 
+def _lane_cost_model() -> "dict | None":
+    """The sharded drain+emit lane's predicted pods/s-vs-cores curve,
+    recomputed from the newest COSTMODEL_r*.json artifact's measured
+    per-op costs and embedded in every BENCH json — the trajectory then
+    shows the host-lane ceiling moving round over round, next to the
+    device headline it used to cap.
+
+    The measurement rig lives in benchmarks/cost_model.py; the shared
+    pipeline math in benchmarks/lane_model.py (import-safe by contract —
+    cost_model itself pops PALLAS_AXON_POOL_IPS and pins JAX_PLATFORMS at
+    import, which would break a TPU bench run)."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(glob.glob(os.path.join(here, "COSTMODEL_r*.json")))
+    if not paths:
+        return None
+    try:
+        with open(paths[-1]) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    eng = doc.get("engine") or {}
+    if "survivor_added_us" not in eng:
+        return None
+    from benchmarks.lane_model import lane_model
+
+    lm = lane_model(
+        eng,
+        doc.get("apiserver") or {},
+        doc.get("rig") or {},
+        doc.get("watch") or {},
+        members=4,
+        contention=(doc.get("contention") or {}).get("factor", 1.0),
+        drain_shards=0,  # auto: an N-core host runs min(8, N) lanes
+    )
+    return {
+        "source": os.path.basename(paths[-1]),
+        "drain_shards": "auto (min(8, cores))",
+        "predicted_pods_per_s_by_cores":
+            lm["predicted_pods_per_s_by_cores"],
+        "predicted_pods_per_s_by_cores_single_lane":
+            lm["predicted_pods_per_s_by_cores_single_lane"],
+    }
+
+
 def _best_of_windows(tick, consume, per_window: int, n_windows: int = 3) -> float:
     """The shared timing harness: the device is reached through a shared
     tunnel whose latency has multi-second transients, so a single long
@@ -453,6 +499,7 @@ def pallas_main() -> None:
             "per_dispatch_transitions_per_s": round(per_dispatch, 1),
             "note": "same definitions as the XLA headline run",
         },
+        "cost_model": _lane_cost_model(),
         "metrics_snapshot": _metrics_snapshot(),
     }))
 
@@ -548,6 +595,9 @@ def main() -> None:
                         "tunneled device)"
                     ),
                 },
+                # host-lane model rider: the device headline next to the
+                # predicted host ceiling it feeds (sliced-lane split)
+                "cost_model": _lane_cost_model(),
                 "metrics_snapshot": _metrics_snapshot(),
             }
         )

@@ -485,7 +485,7 @@ def contention_factor(procs: int = 6, seconds: float = 2.0) -> dict:
 
 def build_model(eng: dict, api: dict, rig: dict, watch: dict,
                 members: int, ticks_per_kpod: float = 0.2,
-                contention: float = 1.0) -> dict:
+                contention: float = 1.0, drain_shards: int = 1) -> dict:
     """Assemble per-pod costs and the pods/s-vs-cores curve.
 
     A pod's life in the homogeneous soak:
@@ -498,13 +498,6 @@ def build_model(eng: dict, api: dict, rig: dict, watch: dict,
                  CPU (per-TICK cost at capacity, amortized over the pods
                  a tick retires; on a TPU this lane leaves the host)
     """
-    fan = api.get("watch_fanout_per_watcher_us", 0.0)
-    api_per_pod = (
-        api.get("create_pod_us", 0.0)
-        + api.get("bind_patch_us", api.get("patch_status_us", 0.0))
-        + api.get("patch_status_us", 0.0)
-        + 3 * fan
-    )
     # The rig's progress polls are an O(store) count per poll (the
     # remainingItemCount contract). Per-pod share = polls x per-store-pod
     # cost / pods, which depends on the poll interval and phase wall —
@@ -516,67 +509,39 @@ def build_model(eng: dict, api: dict, rig: dict, watch: dict,
         api.get("poll_running_count_us", 0.0)
         / max(1, api.get("poll_store_pods", 1))
     )
-    kernel_per_pod = eng.get("tick_kernel_ms_at_capacity", 0.0) * 1e3 \
-        * ticks_per_kpod / 1000.0
-    eng_serial_per_pod = (
-        eng["survivor_added_us"] + eng["echo_modified_us"]
-        + eng["emit_render_us"] + eng.get("flush_staged_row_us", 0.0)
-    )
-    eng_watch_per_pod = 2 * watch.get("watch_line_us", 0.0)
-    eng_offload_per_pod = rig.get("issue_request_us", 0.0)  # pump thread
-    rig_per_pod = 2 * rig.get("issue_request_us", 0.0)
-    total_modeled = (
-        eng_serial_per_pod + eng_watch_per_pod + eng_offload_per_pod
-        + kernel_per_pod + api_per_pod + rig_per_pod
-    )
-    # contention is a MEASURED diagnostic: on this VM the probe shows no
+    # the lane-split pipeline math is shared with bench.py's BENCH-json
+    # rider — ONE source of truth (benchmarks/lane_model.py); contention
+    # is a MEASURED diagnostic: on this VM the probe shows no
     # multi-process tax (concurrent throughput >= solo — burstable vCPU),
     # so it multiplies as ~1.0; kept in the model so a host where it is
     # real (a true pinned core) scales the 1-core point correctly
-    total_1core = total_modeled * max(1.0, contention)
-    curve = {}
-    for cores in (1, 2, 4, 8, 16, 32):
-        if cores == 1:
-            pods_s = 1e6 / total_1core
-        else:
-            # pipeline model: each process/thread group is a lane once
-            # cores allow. engine tick thread = serial lane (drain+emit);
-            # watch threads, pump, and the device math are separate
-            # lanes; M apiservers split their share; rig across 4
-            # loaders.
-            lanes = [
-                eng_serial_per_pod,
-                api_per_pod / min(members, max(1, cores - 2)),
-                rig_per_pod / min(4, cores),
-                eng_watch_per_pod / 2,  # one thread per kind
-                eng_offload_per_pod,
-                kernel_per_pod,  # offloads entirely with a TPU attached
-            ]
-            pods_s = 1e6 / max(lanes)
-        curve[str(cores)] = round(pods_s, 0)
+    from benchmarks.lane_model import lane_model
+
+    lm = lane_model(eng, api, rig, watch, members=members,
+                    contention=contention, drain_shards=drain_shards,
+                    ticks_per_kpod=ticks_per_kpod)
     return {
-        "per_pod_us": {
-            "engine_serial_drain_emit": round(eng_serial_per_pod, 1),
-            "engine_watch_threads": round(eng_watch_per_pod, 1),
-            "engine_offloadable_pump": round(eng_offload_per_pod, 1),
-            "engine_tick_kernel": round(kernel_per_pod, 1),
-            "apiservers_total": round(api_per_pod, 1),
-            "rig": round(rig_per_pod, 1),
-            "total_modeled": round(total_modeled, 1),
-            "contention_factor": round(contention, 3),
-            "total_1core": round(total_1core, 1),
-        },
+        "per_pod_us": lm["per_pod_us"],
         "poll_us_per_store_pod": round(poll_per_store_pod, 3),
-        "predicted_pods_per_s_by_cores": curve,
+        "drain_shards": (
+            drain_shards if drain_shards > 0 else "auto (min(8, cores))"
+        ),
+        "predicted_pods_per_s_by_cores":
+            lm["predicted_pods_per_s_by_cores"],
+        "predicted_pods_per_s_by_cores_single_lane":
+            lm["predicted_pods_per_s_by_cores_single_lane"],
         "assumptions": (
             "homogeneous soak pod = rig(create+bind) + "
             "apiserver(create+bind-patch+status-patch+3 fanouts) + "
             "engine(2 watch lines + survivor + echo + flush + emit + "
             "pump + tick-kernel share at "
             f"{ticks_per_kpod} ticks/kpod); N-core = slowest lane "
-            f"(engine tick thread serial, apiservers split across "
-            f"{members} members, rig across 4 loaders; the tick-kernel "
-            "lane leaves the host entirely when a TPU is attached)"
+            "(engine drain+emit hash-partitioned over "
+            f"{drain_shards if drain_shards > 0 else 'min(8, cores)'} "
+            "shard lanes with the parse+flush router serial, apiservers "
+            f"split across {members} members, rig across 4 loaders; the "
+            "tick-kernel lane leaves the host entirely when a TPU is "
+            "attached)"
         ),
     }
 
@@ -589,6 +554,10 @@ def main() -> int:
     p.add_argument("--measured", type=float, default=0.0,
                    help="measured 1-core homogeneous soak pods/s to "
                    "validate the model's 1-core prediction against")
+    p.add_argument("--drain-shards", type=int, default=0,
+                   help="model the drain+emit lane hash-partitioned over "
+                   "N shard lanes (engine --drain-shards); 0 = auto, "
+                   "min(8, cpu_count) — the engine's production default")
     p.add_argument("--tolerance", type=float, default=0.6,
                    help="bottom-up microbenches vs a live multi-process "
                    "soak: the residual (federation layer, GC/allocator "
@@ -603,8 +572,11 @@ def main() -> int:
     watch = watch_read_costs(min(args.events, 20000), args.trials)
     # soak process count: engine + members + rig + a loader or two
     cont = contention_factor(procs=args.members + 3)
+    # 0 = auto: the curve's N-core point models the engine default on an
+    # N-core host, min(8, N) lanes (config.types.resolve_drain_shards)
     model = build_model(eng, api, rig, watch, args.members,
-                        contention=cont["factor"])
+                        contention=cont["factor"],
+                        drain_shards=args.drain_shards)
     out = {
         "metric": "cost model: per-process us CPU per op + pods/s-vs-cores",
         "engine": eng,

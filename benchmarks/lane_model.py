@@ -1,0 +1,104 @@
+"""Pure sliced-lane prediction math — import-safe: no env mutation, no jax.
+
+One source of truth for the pods/s-vs-cores pipeline model, shared by
+benchmarks/cost_model.py (which MEASURES the per-op inputs and validates
+against a live soak) and bench.py (which embeds the predicted curve in
+every BENCH json). bench.py must not import cost_model itself: that
+module pins JAX_PLATFORMS and pops PALLAS_AXON_POOL_IPS at import, which
+would break a TPU bench run.
+"""
+
+from __future__ import annotations
+
+CORES_AXIS = (1, 2, 4, 8, 16, 32)
+
+
+def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
+               members: int = 4, contention: float = 1.0,
+               drain_shards: int = 0, ticks_per_kpod: float = 0.2) -> dict:
+    """Per-pod cost components + the predicted pods/s-vs-cores curves.
+
+    ``drain_shards``: the engine's host-lane count; <=0 = auto, meaning an
+    N-core host runs min(8, N) lanes (config.types.resolve_drain_shards),
+    so the curve's N-core point models what that host would actually run.
+    The single-lane curve is always computed alongside — the trajectory of
+    the host ceiling moving.
+    """
+    fan = api.get("watch_fanout_per_watcher_us", 0.0)
+    api_pp = (
+        api.get("create_pod_us", 0.0)
+        + api.get("bind_patch_us", api.get("patch_status_us", 0.0))
+        + api.get("patch_status_us", 0.0)
+        + 3 * fan
+    )
+    # The sharded-lane split (engine/lanes.py): survivor ingest, echo
+    # drop, and emit render hash-partition across the lanes; the batched
+    # C++ parse (router thread) and the staged-row flush (tick thread)
+    # stay serial. engine_serial_drain_emit remains the UNSHARDED total
+    # for trajectory continuity with earlier rounds.
+    lane_pp = (
+        eng["survivor_added_us"] + eng["echo_modified_us"]
+        + eng["emit_render_us"]
+    )
+    router_pp = (
+        eng.get("batch_parse_us", 0.0) + eng.get("flush_staged_row_us", 0.0)
+    )
+    serial_pp = lane_pp + eng.get("flush_staged_row_us", 0.0)
+    watch_pp = 2 * watch.get("watch_line_us", 0.0)
+    pump_pp = rig.get("issue_request_us", 0.0)  # engine's pump thread
+    rig_pp = 2 * rig.get("issue_request_us", 0.0)
+    kern_pp = (
+        eng.get("tick_kernel_ms_at_capacity", 0.0) * 1e3
+        * ticks_per_kpod / 1000.0
+    )
+    total_modeled = (
+        serial_pp + watch_pp + pump_pp + kern_pp + api_pp + rig_pp
+    )
+    total_1core = total_modeled * max(1.0, contention)
+
+    def predict(cores: int, shards: int) -> float:
+        if cores == 1:
+            # on 1 core every microsecond serializes, sharded or not
+            return 1e6 / total_1core
+        # pipeline model: each process/thread group is a lane once cores
+        # allow. With shards>1 the old engine-serial lane splits into the
+        # router (parse+flush, serial) and per-shard drain+emit lanes —
+        # effective shards bounded by the cores left after the
+        # apiserver/rig processes claim theirs.
+        if shards <= 0:
+            shards = min(8, cores)
+        eff = min(shards, max(1, cores - 2))
+        if shards > 1:
+            eng_lanes = [router_pp, lane_pp / eff]
+        else:
+            eng_lanes = [serial_pp]
+        lanes = eng_lanes + [
+            api_pp / min(members, max(1, cores - 2)),
+            rig_pp / min(4, cores),
+            watch_pp / 2,  # one watch thread per kind
+            pump_pp,
+            kern_pp,  # offloads entirely with a TPU attached
+        ]
+        return 1e6 / max(lanes)
+
+    return {
+        "per_pod_us": {
+            "engine_serial_drain_emit": round(serial_pp, 1),
+            "engine_lane_drain_emit": round(lane_pp, 1),
+            "engine_router_serial": round(router_pp, 1),
+            "engine_watch_threads": round(watch_pp, 1),
+            "engine_offloadable_pump": round(pump_pp, 1),
+            "engine_tick_kernel": round(kern_pp, 1),
+            "apiservers_total": round(api_pp, 1),
+            "rig": round(rig_pp, 1),
+            "total_modeled": round(total_modeled, 1),
+            "contention_factor": round(contention, 3),
+            "total_1core": round(total_1core, 1),
+        },
+        "predicted_pods_per_s_by_cores": {
+            str(c): round(predict(c, drain_shards), 0) for c in CORES_AXIS
+        },
+        "predicted_pods_per_s_by_cores_single_lane": {
+            str(c): round(predict(c, 1), 0) for c in CORES_AXIS
+        },
+    }

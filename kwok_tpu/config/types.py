@@ -39,6 +39,10 @@ class KwokConfigurationOptions:
     parallelism: int = 16
     initialCapacity: int = 4096
     useMesh: bool = False
+    # Host-lane sharding of the drain+emit pipeline: number of
+    # hash-partitioned ShardLanes. 0 = auto (min(8, cpu_count)); 1 = the
+    # classic single-lane engine.
+    drainShards: int = 0
 
 
 @dataclasses.dataclass
@@ -59,6 +63,16 @@ class KwokConfiguration:
 
 def _prune(d: dict) -> dict:
     return {k: v for k, v in d.items() if v not in ("", None)}
+
+
+def resolve_drain_shards(value: int) -> int:
+    """0/negative = auto: min(8, cpu_count). Shards beyond ~8 stop paying
+    on the measured workload — the apiserver/rig lanes bound throughput
+    first (benchmarks/cost_model.py) — so auto caps there."""
+    v = int(value)
+    if v > 0:
+        return v
+    return max(1, min(8, os.cpu_count() or 1))
 
 
 def parse_bool(value: Any) -> bool:

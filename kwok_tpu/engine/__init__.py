@@ -1,22 +1,33 @@
 """Host controller: wires watch-ingest -> device tick -> patch-egress.
 
 The replacement for pkg/kwok/controllers' Controller/NodeController/
-PodController goroutine machinery: one ingest queue, one tick thread owning
-all state mutation (SURVEY.md section 5.2: "host ingest queue needs one
-lock"), and a bounded-parallelism patch executor (the analogue of the
-reference's 16-way parallelTasks pools, controller.go:118-136).
+PodController goroutine machinery: one ingest queue, a tick thread owning
+device dispatch, and a bounded-parallelism patch executor (the analogue of
+the reference's 16-way parallelTasks pools, controller.go:118-136). With
+``EngineConfig.drain_shards > 1`` the host pipeline hash-partitions into
+ShardLanes (engine/lanes.py): per-lane drain workers, staged buffers, emit
+workers, and pump connection groups, coordinated by a tick thread that
+shrinks to kernel dispatch + per-shard wire handoff.
 """
 
 from kwok_tpu.engine.engine import ClusterEngine, EngineConfig
 
-__all__ = ["ClusterEngine", "EngineConfig", "FederatedEngine"]
+__all__ = [
+    "ClusterEngine", "EngineConfig", "FederatedEngine", "LaneSet",
+    "ShardLane",
+]
 
 
 def __getattr__(name):
-    # lazy: federation pulls in the mesh/shard_map machinery, which
-    # single-cluster consumers (the common case) never need
+    # lazy: federation pulls in the mesh/shard_map machinery, and the lane
+    # module pulls the sharded pipeline — single-cluster single-lane
+    # consumers (the synchronous test rigs) never need either
     if name == "FederatedEngine":
         from kwok_tpu.engine.federation import FederatedEngine
 
         return FederatedEngine
+    if name in ("LaneSet", "ShardLane"):
+        from kwok_tpu.engine import lanes
+
+        return getattr(lanes, name)
     raise AttributeError(name)

@@ -126,6 +126,15 @@ class EngineConfig:
     # the difference between TPU-helped and TPU-penalized on a remote/
     # tunneled chip. 1 = the old fully-synchronous loop.
     pipeline_depth: int = 8
+    # Hash-partitioned host lanes for the drain+emit pipeline (engine/
+    # lanes.py): objects shard by key at ingest; each lane runs its own
+    # drain worker, staged-row buffers, emit worker, and pump connection
+    # group, so drain+emit for shard A overlaps shard B and the tick
+    # thread shrinks to kernel dispatch + per-shard wire handoff. 1 = the
+    # classic single-lane engine (the library/test default — every
+    # synchronous test drives engine state directly); 0 = auto,
+    # min(8, cpu_count) — what the CLI defaults to in production.
+    drain_shards: int = 1
     node_rules: list[LifecycleRule] | None = None
     pod_rules: list[LifecycleRule] | None = None
     use_mesh: bool = False
@@ -186,6 +195,52 @@ class _PendingTick:
     # dispatch->consume pipeline lag (measured: ~one tick_interval of
     # heartbeat drift per cycle)
     host_s: float  # host seconds spent in the dispatch half
+
+
+class _PumpGroup:
+    """Several independent native pump connection groups, each with its
+    own lock. The old shape — ONE Pump behind ONE global lock — serialized
+    every emit batch even though the pump held nconn=4 sockets: two
+    executor workers with ready batches queued on the lock instead of the
+    wire. Here a sender claims the first free group (non-blocking probe,
+    round-robin start so load spreads) and only blocks when every group is
+    busy — two concurrent sends ride two different connection groups."""
+
+    def __init__(self, pumps) -> None:
+        self._pumps = [(p, threading.Lock()) for p in pumps]
+        self._next = 0  # racy round-robin hint; exactness doesn't matter
+
+    def __len__(self) -> int:
+        return len(self._pumps)
+
+    def send(self, reqs):
+        n = len(self._pumps)
+        self._next += 1
+        start = self._next % n
+        for i in range(n):
+            p, lock = self._pumps[(start + i) % n]
+            if lock.acquire(blocking=False):
+                try:
+                    return p.send(reqs)
+                finally:
+                    lock.release()
+        p, lock = self._pumps[start]
+        with lock:
+            return p.send(reqs)
+
+    def send_ordered(self, batches):
+        """Send several batches back-to-back on ONE group (a strip batch
+        must complete before its delete batch); returns their statuses."""
+        n = len(self._pumps)
+        self._next += 1
+        p, lock = self._pumps[self._next % n]
+        with lock:
+            return [p.send(reqs) for reqs in batches]
+
+    def close(self) -> None:
+        for p, lock in self._pumps:
+            with lock:
+                p.close()
 
 
 class _Kind:
@@ -281,8 +336,19 @@ class ClusterEngine:
         self._fused: MultiTickKernel | None = None
         self._owns_tick = True  # False when a FederatedEngine drives us
 
-        self.nodes = _Kind(ntab, cap)
-        self.pods = _Kind(ptab, cap)
+        # Sharded host lanes (engine/lanes.py) own ALL row state: the
+        # parent's kinds then exist only as the structural default for
+        # code paths tests drive directly, so they stay at a token
+        # capacity instead of duplicating the configured budget in dead
+        # host arrays. Resolved here (before allocation); the LaneSet
+        # itself is built at the end of __init__, once the shared state
+        # it wires into the lanes exists.
+        from kwok_tpu.config.types import resolve_drain_shards
+
+        self._n_lanes = resolve_drain_shards(config.drain_shards)
+        parent_cap = cap if self._n_lanes <= 1 else min(cap, 1024)
+        self.nodes = _Kind(ntab, parent_cap)
+        self.pods = _Kind(ptab, parent_cap)
 
         self.node_has: set[str] = set()  # nodesSets (need-heartbeat membership)
         self.pods_by_node: dict[str, set[tuple[str, str]]] = {}
@@ -351,10 +417,15 @@ class ClusterEngine:
         # Batched pipelined egress (native/pump.cc): one C++ call sends a
         # whole tick's status patches over pooled keep-alive connections,
         # GIL-free. Plain-HTTP apiservers only (the mock/lab edge); TLS
-        # clusters use the executor path below. Built lazily on first emit.
+        # clusters use the executor path below. Built lazily on first emit
+        # as a _PumpGroup: several connection groups with per-group locks,
+        # so concurrent emit workers never serialize on one global lock.
         self._pump = None
         self._pump_tried = False
-        self._pump_lock = threading.Lock()
+        self._pump_groups = max(1, int(os.environ.get(
+            "KWOK_TPU_PUMP_GROUPS", "4"
+        )))
+        self._pump_nconn = 2
         # monotonic wake-up for the idle tick loop; 0 = tick immediately,
         # None = nothing scheduled on device (sleep until an event arrives)
         self._idle_wake: float | None = 0.0
@@ -369,6 +440,14 @@ class ClusterEngine:
         # tick-thread-only, so plain int arithmetic is race-free
         self._trace_every = max(0, int(config.trace_sample_every))
         self._trace_n = 0
+        # Hash-partitioned host lanes (engine/lanes.py): built when
+        # drain_shards resolves to >1. Lane children are constructed with
+        # drain_shards=1, so this cannot recurse.
+        self._lanes = None
+        if self._n_lanes > 1:
+            from kwok_tpu.engine.lanes import LaneSet
+
+            self._lanes = LaneSet(self, self._n_lanes)
 
     @property
     def metrics(self) -> dict:
@@ -440,12 +519,17 @@ class ClusterEngine:
             max_workers=self.config.parallelism, thread_name_prefix="kwok-patch"
         )
         if run_tick_loop:
-            # move state to device (row-sharded placement under a mesh)
-            fused = self._get_fused()
-            for k in (self.nodes, self.pods):
-                k.state = fused.place(k.state)
-            self._warm_scatters()
-            self._warm_tick()
+            if self._lanes is not None:
+                # sharded pipeline: stacked device state + lane workers;
+                # the tick thread below runs the lane coordinator loop
+                self._lanes.prepare(self._executor)
+            else:
+                # move state to device (row-sharded placement under a mesh)
+                fused = self._get_fused()
+                for k in (self.nodes, self.pods):
+                    k.state = fused.place(k.state)
+                self._warm_scatters()
+                self._warm_tick()
 
         node_label_sel = self.config.manage_nodes_with_label_selector or None
         # Each watch thread registers its watch FIRST, then lists and emits a
@@ -456,7 +540,13 @@ class ClusterEngine:
         self._spawn_watch("pods", field_selector="spec.nodeName!=")
 
         if run_tick_loop:
-            t = threading.Thread(target=self._tick_loop, name="kwok-tick", daemon=True)
+            if self._lanes is not None:
+                self._lanes.start_workers(self._threads)
+            loop = (
+                self._lanes.tick_loop if self._lanes is not None
+                else self._tick_loop
+            )
+            t = threading.Thread(target=loop, name="kwok-tick", daemon=True)
             t.start()
             self._threads.append(t)
         self.ready = True
@@ -535,16 +625,33 @@ class ClusterEngine:
             except Exception:
                 pass
         self._q.put(None)
-        for t in self._threads:
-            # the tick thread's shutdown path flushes up to pipeline_depth
-            # in-flight device ticks (wire waits included) — give it real
-            # time before the executor below is torn down under it
-            t.join(timeout=60 if t.name == "kwok-tick" else 5)
+
+        # Join order matters under sharded lanes: the tick thread's
+        # shutdown path flushes up to pipeline_depth in-flight device
+        # ticks and hands their final emit items (then the sentinels) to
+        # the lane emit queues — so it must be waited on FIRST, then the
+        # emit workers get real time to drain those queues, before the
+        # executor below is torn down under them. Single-lane engines
+        # have no kwok-emit* threads and see the old behavior.
+        def _join_rank(t):
+            if t.name == "kwok-tick":
+                return 0
+            return 1 if t.name.startswith("kwok-emit") else 2
+
+        for t in sorted(self._threads, key=_join_rank):
+            t.join(timeout=(
+                60 if t.name == "kwok-tick"
+                else 30 if t.name.startswith("kwok-emit") else 5
+            ))
         if self._executor:
             self._executor.shutdown(wait=True)
-        if self._dropped_jobs:
+        # the promised total: every lane shares this telemetry, so under
+        # sharding this is the whole engine's tally, not one lane's
+        dropped = self.telemetry.dropped_jobs_total
+        if dropped:
             logger.warning(
-                "%d patch jobs dropped during shutdown", self._dropped_jobs
+                "%d patch jobs dropped during shutdown "
+                "(kwok_dropped_jobs_total)", dropped
             )
         profiling.maybe_dump()
         trace_path = self.config.trace_dump or os.environ.get(
@@ -561,6 +668,8 @@ class ClusterEngine:
         if self._pump is not None:
             self._pump.close()
             self._pump = None
+        if self._lanes is not None:
+            self._lanes.close()  # lane pump groups (client is shared, ours)
         close = getattr(self.client, "close", None)
         if callable(close):  # release pooled keep-alive connections
             close()
@@ -762,18 +871,23 @@ class ClusterEngine:
     # batch-parse latency and memory without giving up amortization
     _RAW_FLUSH_AT = 8192
 
-    def _drain_apply(self, item, raw_buf: dict) -> None:
+    def _drain_apply(self, item, raw_buf: dict, route=None) -> None:
         """Apply one queue item on the tick thread. RAW items (undecoded
         watch lines, the native path) buffer per kind for ONE batched C++
         parse; any non-RAW item for a kind flushes its buffer first so
         per-kind event order is preserved (a RESYNC snapshot must not be
-        overtaken by lines that preceded it)."""
+        overtaken by lines that preceded it).
+
+        With ``route`` (the sharded pipeline's router thread), parsed
+        events are handed to ``route(kind, type_, obj)`` instead of being
+        ingested here — the rv/generation bookkeeping (this engine's watch
+        threads read it on reconnect) stays with the caller either way."""
         kind, type_, obj = item[:3]
         if type_ == "RAW":
             buf = raw_buf.setdefault(kind, [])
             buf.append(obj)
             if len(buf) >= self._RAW_FLUSH_AT:
-                self._drain_flush_kind(kind, raw_buf)
+                self._drain_flush_kind(kind, raw_buf, route)
             return
         if type_ == "RAWB":
             # a packed native-reader batch (buf, off): one entry, many
@@ -783,19 +897,22 @@ class ClusterEngine:
             buf = raw_buf.setdefault(kind, [])
             buf.append(obj)
             if sum(len(o) - 1 for _, o in buf) >= self._RAW_FLUSH_AT:
-                self._drain_flush_kind(kind, raw_buf)
+                self._drain_flush_kind(kind, raw_buf, route)
             return
         if kind in raw_buf:
-            self._drain_flush_kind(kind, raw_buf)
+            self._drain_flush_kind(kind, raw_buf, route)
         if type_ == "GEN":
             # stream boundary: lines after this belong to generation `obj`
             self._drain_gen[kind] = obj
             return
+        if route is not None:
+            route(kind, type_, obj)
+            return
         self._ingest_safe(kind, type_, obj)
 
-    def _drain_flush(self, raw_buf: dict) -> None:
+    def _drain_flush(self, raw_buf: dict, route=None) -> None:
         for kind in list(raw_buf):
-            self._drain_flush_kind(kind, raw_buf)
+            self._drain_flush_kind(kind, raw_buf, route)
 
     def _expire_stream(self, kind: str) -> None:
         """Mark kind's watch stream compacted: the resume revision AND the
@@ -833,7 +950,7 @@ class ClusterEngine:
             if gen == self._stream_gen.get(kind, 0):
                 self._watch_rv[kind] = rv
 
-    def _drain_flush_kind(self, kind: str, raw_buf: dict) -> None:
+    def _drain_flush_kind(self, kind: str, raw_buf: dict, route=None) -> None:
         entries = raw_buf.pop(kind, None)
         if not entries:
             return
@@ -915,7 +1032,10 @@ class ClusterEngine:
                     self._inc("watch_bookmarks_total")
                     continue
                 n_rec += 1
-                self._ingest_safe(kind, "REC", rec)
+                if route is not None:
+                    route(kind, "REC", rec)
+                else:
+                    self._ingest_safe(kind, "REC", rec)
             if latest_rv:
                 self._commit_rv(kind, gen, latest_rv)
             if n_rec:
@@ -931,7 +1051,11 @@ class ClusterEngine:
         rvs = batch.rvs
         type_bytes = batch.type_bytes
         record = batch.record
-        ingest_record = self._ingest_record
+        if route is not None:
+            def ingest_record(kind_, rec_):
+                route(kind_, "REC", rec_)
+        else:
+            ingest_record = self._ingest_record
         for i in range(batch.n):
             tb = type_bytes(i)
             if tb == b"ERROR":
@@ -1253,8 +1377,14 @@ class ClusterEngine:
                     # pool slot then simply stays retired
                     m["cni"] = True
         has_del = m["has_del"]
-        bits = self._pod_bits(m)
+        # register in the node->pods index BEFORE reading node_has for the
+        # selector bits: under sharded lanes a concurrent node
+        # managed-ness flip snapshots this index for its XUPD fan-out —
+        # registering first guarantees either the bits see the flip or
+        # the fan-out sees the pod (and FIFO-per-key re-stages it); the
+        # single-lane engine is single-threaded here, so order is free
         self.pods_by_node.setdefault(node_name, set()).add(key)
+        bits = self._pod_bits(m)
         if new_row:
             phase = self._pod_phase_ids.get(
                 status.get("phase") or "Pending", _PENDING
@@ -1374,11 +1504,12 @@ class ClusterEngine:
                 if self.ippool.contains(rec.pod_ip):
                     self.ippool.use(rec.pod_ip)
                 m["podIP"] = rec.pod_ip
-        bits = self._pod_bits(m)
         by_node = self.pods_by_node.get(node_name)
         if by_node is None:
             by_node = self.pods_by_node[node_name] = set()
+        # index registration before the node_has read — see _pod_upsert
         by_node.add(key)
+        bits = self._pod_bits(m)
         if new_row:
             phase = self._pod_phase_ids.get(rec.phase or "Pending", _PENDING)
             cond = 0
@@ -1652,7 +1783,12 @@ class ClusterEngine:
         """One synchronous engine step: dispatch the fused kernel and
         consume its wire immediately. The pipelined loop (_tick_loop) calls
         the two halves separately with up to pipeline_depth ticks in
-        flight; semantics per tick are identical."""
+        flight; semantics per tick are identical. A sharded engine runs
+        the lane coordinator's synchronous step instead (route + drain +
+        dispatch + consume with inline emit)."""
+        if self._lanes is not None:
+            self._lanes.tick_once()
+            return
         p = self._tick_dispatch()
         if p is not None:
             self._tick_consume(p)
@@ -1818,9 +1954,11 @@ class ClusterEngine:
         except RuntimeError:
             # executor shut down while a tick was still in flight — we
             # are stopping; jobs are dropped, but never silently. One
-            # warning + a count: a flushed tick can carry O(10k) jobs
-            # and per-job lines would flood the shutdown log.
+            # warning + a count (also exported as kwok_dropped_jobs_total;
+            # stop() logs the final tally): a flushed tick can carry
+            # O(10k) jobs and per-job lines would flood the shutdown log.
             self._dropped_jobs += 1
+            self._inc("dropped_jobs_total")
             if self._dropped_jobs == 1:
                 logger.warning(
                     "patch jobs dropped during shutdown (first: %s%r); "
@@ -1854,7 +1992,13 @@ class ClusterEngine:
         token = getattr(self.client, "token", None)
         extra = f"Authorization: Bearer {token}\r\n" if token else ""
         try:
-            self._pump = self._codec.Pump(host, int(port), nconn=4, header_extra=extra)
+            self._pump = _PumpGroup([
+                self._codec.Pump(
+                    host, int(port), nconn=self._pump_nconn,
+                    header_extra=extra,
+                )
+                for _ in range(self._pump_groups)
+            ])
             self._pump_base = base
         except Exception:
             logger.exception("native pump unavailable; using executor egress")
@@ -2044,8 +2188,7 @@ class ClusterEngine:
         """One executor job sends the whole batch; rows whose connection
         died are retried through the per-object Python path."""
         _t = time.perf_counter()
-        with self._pump_lock:
-            status = self._pump.send(reqs)
+        status = self._pump.send(reqs)
         _t1 = time.perf_counter()
         tel = self.telemetry
         tel.pump_hist.observe(_t1 - _t)
@@ -2290,15 +2433,17 @@ class ClusterEngine:
 
     def _pump_send_deletes(self, strips, strip_rows, deletes, del_rows) -> None:
         retry: set[int] = set()
-        with self._pump_lock:
-            if strips:
-                strip_status = self._pump.send(strips)
-                # a failed strip leaves finalizers on the pod, turning the
-                # grace-0 delete into a graceful mark — those rows must go
-                # through the per-object strip+delete fallback
-                for st, (_key, idx) in zip(strip_status.tolist(), strip_rows):
-                    if not (200 <= st < 300 or st == 404):
-                        retry.add(idx)
+        if strips:
+            # one connection group for both batches: every pod's strip
+            # completes before its grace-0 delete is issued
+            strip_status, status = self._pump.send_ordered([strips, deletes])
+            # a failed strip leaves finalizers on the pod, turning the
+            # grace-0 delete into a graceful mark — those rows must go
+            # through the per-object strip+delete fallback
+            for st, (_key, idx) in zip(strip_status.tolist(), strip_rows):
+                if not (200 <= st < 300 or st == 404):
+                    retry.add(idx)
+        else:
             status = self._pump.send(deletes)
         # 404 = already gone server-side; the per-object path counts every
         # issued delete, so the batch path matches that accounting

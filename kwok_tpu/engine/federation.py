@@ -185,11 +185,17 @@ class FederatedEngine:
         # records its spans in its own tracer; /debug/trace merges all.
         self.registry = MetricsRegistry()
         self.tracer = Tracer()
+        # members are forced single-lane (drain_shards=1): the federated
+        # loop drives their ingest queues and emit paths directly, and the
+        # per-member `shard` telemetry label would collide with the lane
+        # label a sharded member's ShardLanes register. Host-lane sharding
+        # composes with federation ABOVE this class, not inside a member.
         self.engines = [
             ClusterEngine(
                 client,
                 dataclasses.replace(
-                    cfg, initial_capacity=base_capacity, use_mesh=False
+                    cfg, initial_capacity=base_capacity, use_mesh=False,
+                    drain_shards=1,
                 ),
                 telemetry=EngineTelemetry(
                     registry=self.registry, shard=str(i)

@@ -1,0 +1,816 @@
+"""Hash-partitioned host lanes: the sharded drain+emit pipeline.
+
+The round-5 cost model attributed 31.9 µs/pod to ``engine_serial_drain_emit``
+— ONE tick thread doing all of drain, apply, wire-consume, and emit — and
+predicted a hard ceiling of ~31k pods/s at any core count. This module
+removes that wall the way the reference KWOK scales (goroutine fan-out per
+controller), but key-partitioned so per-object ordering survives:
+
+  watch threads ──> ingest queue ──> router (parse + hash by key)
+                                       │
+                       ┌───────────────┼──────────────┐
+                       ▼               ▼              ▼
+                    lane 0          lane 1   ...   lane N-1
+                 drain worker    drain worker     drain worker
+                 staged buffer   staged buffer    staged buffer
+                       └───────────────┼──────────────┘
+                                       ▼
+                tick thread: flush per-lane buffers into ONE stacked
+                device state, dispatch the fused kernel, slice the wire
+                per lane (ops/tick.lane_views) and hand each slice to
+                       ┌───────────────┼──────────────┐
+                       ▼               ▼              ▼
+                  emit worker     emit worker     emit worker
+                 (own pump conn   (own pump conn  (own pump conn
+                  group)           group)          group)
+
+Ordering: a key always maps to the same lane (``rowpool.shard_of``), lane
+queues are FIFO, and the tick thread hands wire slices to lanes in consume
+order — so per-object patch order is exactly the single-lane engine's (the
+oracle in tests/test_lanes.py proves it). Cross-shard state is shared with
+striped/narrow locking: the IP pool and release logs ride the engine's
+``_alloc_lock``-adjacent discipline (release bookkeeping is mutated under
+the lane's ``stage_lock``), ``node_has``/``pods_by_node`` are shared
+structures whose single-op mutations are GIL-atomic, and a node's
+managed-ness flip reaches OTHER lanes' pods as routed ``XUPD`` items
+through their own queues (no cross-lane lock acquisition, no deadlock).
+
+Each lane is implemented as a full ``ClusterEngine`` minus its threads —
+exactly how ``FederatedEngine`` hosts members — so the per-event ingest
+and emit code paths run unchanged; only the plumbing around them is new.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import queue
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from kwok_tpu import profiling
+from kwok_tpu.edge.render import now_rfc3339
+from kwok_tpu.engine.engine import ClusterEngine
+from kwok_tpu.engine.rowpool import shard_of
+from kwok_tpu.ops.state import RowState, new_row_state
+from kwok_tpu.ops.updates import UpdateBuffer
+from kwok_tpu.ops.tick import (
+    REBASE_AFTER,
+    lane_views,
+    prefetch,
+    rebase_times,
+    to_host,
+    unpack_wire,
+)
+
+logger = logging.getLogger("kwok_tpu.lanes")
+
+_KINDS = ("nodes", "pods")
+
+
+@dataclasses.dataclass
+class _LanePending:
+    """A dispatched-but-unconsumed stacked tick."""
+
+    wire: object  # device array; self-contained (pack_rows wire)
+    r: int  # rows per lane AT DISPATCH (regrow may change it)
+    cap: int  # stacked capacity at dispatch
+    seqs: list  # per-lane release seq at dispatch (stale-mask filter)
+    now: float  # engine time of the dispatch
+    mono: float  # monotonic clock at dispatch (idle-wake anchor)
+    host_s: float  # host seconds spent in the dispatch half
+
+
+class _LaneEngine(ClusterEngine):
+    """A ClusterEngine serving as ONE lane: no threads of its own, shared
+    cross-lane topology, and node managed-ness flips routed to sibling
+    lanes instead of applied against the (lane-local) pod pool."""
+
+    _lane_set: "LaneSet | None" = None
+
+    def _update_pods_on_node(self, node_name: str) -> None:
+        ls = self._lane_set
+        if ls is None:  # construction-time call paths
+            super()._update_pods_on_node(node_name)
+            return
+        # pods on this node live in OTHER lanes' pools: route one XUPD
+        # batch per owning lane through its own queue (FIFO per key keeps
+        # the update ordered against the pod's own events)
+        ls.route_pod_updates(node_name)
+
+
+class ShardLane:
+    """One hash-partition of the host pipeline: ingest queue + drain
+    worker + staged-row buffers + emit worker + pump connection group."""
+
+    def __init__(self, lane_set: "LaneSet", index: int, capacity: int):
+        parent = lane_set.parent
+        self.lane_set = lane_set
+        self.index = index
+        cfg = dataclasses.replace(
+            parent.config,
+            drain_shards=1,  # lanes never recurse
+            use_mesh=False,  # the coordinator owns device placement
+            initial_capacity=capacity,
+            profile_dir="",
+            trace_dump="",  # one dump, owned by the parent
+        )
+        e = _LaneEngine(parent.client, cfg, telemetry=parent.telemetry)
+        e._lane_set = lane_set
+        # shared cross-lane state: one IP pool / allocation lock (striped
+        # enough — held only for bookkeeping, never across provider
+        # calls), one topology view, one clock
+        e.ippool = parent.ippool
+        e._alloc_lock = parent._alloc_lock
+        e.node_has = parent.node_has
+        e.pods_by_node = parent.pods_by_node
+        e._epoch = parent._epoch
+        e.start_time = parent.start_time
+        e._owns_tick = False  # the coordinator owns device state
+        # each lane's emit path builds its own (smaller) pump connection
+        # group — the satellite fix writ structural: emit workers never
+        # share a pump lock
+        e._pump_groups = 2
+        self.engine = e
+        self.q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.emit_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        # guards this lane's staged buffers + pool growth + release log:
+        # held by the drain worker while applying, by the tick thread
+        # while swapping buffers / growing, by the emit worker only for
+        # the stale-release snapshot. RLock: apply paths may nest.
+        self.stage_lock = threading.RLock()
+        self.telemetry = parent.telemetry.lane(str(index))
+
+    # --------------------------------------------------------------- drain
+
+    # max items applied per stage_lock hold: bounds how long a flood can
+    # keep the tick thread from swapping this lane's buffers
+    _BURST = 4096
+
+    def _apply_item(self, item) -> None:
+        e = self.engine
+        if item[1] == "XUPD":
+            # managed-ness re-evaluation for pods this lane owns, routed
+            # from a sibling lane's node event (see _LaneEngine)
+            k = e.pods
+            for key in item[2]:
+                idx = k.pool.lookup(key)
+                if idx is None:
+                    continue
+                m = k.pool.meta[idx]
+                k.buffer.stage_update(
+                    idx, e._pod_bits(m), m.get("has_del", False)
+                )
+            return
+        e._drain_apply(item, {})  # routed items are parsed; no RAW buffer
+
+    def drain_loop(self) -> None:
+        q = self.q
+        tel = self.telemetry
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            stop = False
+            t0 = time.perf_counter()
+            with self.stage_lock:
+                self._apply_item(item)
+                n = 1
+                while n < self._BURST:
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        stop = True
+                        break
+                    self._apply_item(item)
+                    n += 1
+            tel.observe_stage("drain", time.perf_counter() - t0)
+            tel.set_queue_depth(q.qsize())
+            if stop:
+                return
+
+    # ---------------------------------------------------------------- emit
+
+    def emit_loop(self) -> None:
+        eq = self.emit_q
+        while True:
+            item = eq.get()
+            if item is None:
+                return
+            try:
+                if item[0] == "__prune__":
+                    self._prune_now(item[1])
+                else:
+                    self._process_emit(item)
+            except Exception:
+                logger.exception("lane %d emit failed", self.index)
+
+    def _prune_now(self, min_seq: int) -> None:
+        """Drop release-log entries no queued-or-future emit item can
+        still consult. Runs BEHIND the emit queue (FIFO): every emit item
+        enqueued before this marker has already done its stale filter, so
+        entries at or below the oldest in-flight dispatch's seq are dead."""
+        with self.stage_lock:
+            self.engine._prune_released(min_seq)
+
+    def _process_emit(self, item) -> None:
+        """Consume one tick's wire slice for this lane: filter stale mask
+        bits, refresh fired rows' phase/cond mirrors, emit patches.
+
+        The whole body holds the lane's stage_lock: the single-lane engine
+        ran emit and ingest on one thread, so _emit's pool/meta reads
+        (key_of, meta[idx]) could never see a row released-and-reacquired
+        mid-iteration. Holding the lock restores that invariant per lane —
+        this lane's drain stalls during its own emit, but every OTHER
+        lane's drain+emit (and the tick thread) keep running, which is
+        where the parallelism was always meant to come from."""
+        kind, dirty, deleted, hb, ph, cb, seq, now_str = item
+        e = self.engine
+        k = e.nodes if kind == "nodes" else e.pods
+        t0 = time.perf_counter()
+        cap = dirty.shape[0]
+        with self.stage_lock:
+            # rows released since this tick's dispatch: their mask bits
+            # describe the OLD occupant (see ClusterEngine._tick_consume)
+            stale = [
+                idx for idx, s in k.released_at.items()
+                if s > seq and idx < cap
+            ]
+            if stale:
+                dirty[stale] = False
+                deleted[stale] = False
+                hb[stale] = False
+            idxs = np.nonzero(dirty | deleted)[0]
+            if idxs.size and ph is not None:
+                # fired rows only: rows acquired after the dispatch keep
+                # their ingest-time mirror values
+                k.phase_h[idxs] = ph[idxs]
+                k.cond_h[idxs] = cb[idxs]
+            if idxs.size:
+                e.telemetry.inc_kind(
+                    "transitions_total", kind, int(idxs.size)
+                )
+            if idxs.size or hb.any():
+                e._emit(kind, k, dirty, deleted, hb, now_str)
+        t1 = time.perf_counter()
+        self.telemetry.observe_stage("emit", t1 - t0)
+        e.telemetry.span(
+            "tick.emit", t0, t1, "emit",
+            {"kind": kind, "shard": self.index},
+        )
+
+
+class LaneSet:
+    """The coordinator: owns the stacked device state, the router, and the
+    (now thin) tick loop — kernel dispatch plus per-shard wire handoff."""
+
+    def __init__(self, parent: ClusterEngine, n: int):
+        self.parent = parent
+        self.n = int(n)
+        # per-lane row budget: an even split PLUS 25% slack — crc32
+        # partitioning is only statistically even, and one lane crossing
+        # cap/n would otherwise force a whole-stack regrow (host copy +
+        # re-jit at the new shape) right at the configured capacity
+        r = max(1024, -(-int(parent.config.initial_capacity) * 5 // (4 * self.n)))
+        if parent._mesh is not None:
+            from kwok_tpu.parallel.mesh import pad_to_multiple
+
+            r = pad_to_multiple(r, parent._mesh)
+        self.r = r
+        self.lanes = [ShardLane(self, i, r) for i in range(self.n)]
+        self.stacked: dict[str, RowState] = {}
+        # bumped by the router per routed event; the tick loop's
+        # got-an-event gate (plain int: GIL-atomic, one writer)
+        self.events_routed = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def prepare(self, executor) -> None:
+        """Wire the shared executor into every lane, place the stacked
+        state on device, and pre-compile scatters + the fused tick (the
+        single-lane warm-up, against the stacked shapes)."""
+        for lane in self.lanes:
+            e = lane.engine
+            e._executor = executor
+            e._running = True
+            e._record_needs_full_path = self.parent._record_needs_full_path
+        self._ensure_stacked()
+        self._warm_scatters()
+        self._warm_tick()
+
+    def _ensure_stacked(self) -> None:
+        if self.stacked:
+            return
+        fused = self.parent._get_fused()
+        cap = self.r * self.n
+        self.stacked = {
+            "nodes": fused.place(new_row_state(cap)),
+            "pods": fused.place(new_row_state(cap)),
+        }
+
+    def _warm_scatters(self) -> None:
+        from kwok_tpu.ops.updates import (
+            BATCH,
+            BATCH_LARGE,
+            InitBatch,
+            UpdateBatch,
+            init_rows,
+            update_rows,
+        )
+
+        for kind in _KINDS:
+            state = self.stacked[kind]
+            cap = state.capacity
+            for width in (BATCH, BATCH_LARGE):
+                idx = np.full(width, cap, np.int32)  # every lane padded
+                state = init_rows(state, InitBatch(
+                    idx=idx,
+                    active=np.zeros(width, bool),
+                    phase=np.zeros(width, np.int32),
+                    cond_bits=np.zeros(width, np.uint32),
+                    sel_bits=np.zeros(width, np.uint32),
+                    has_deletion=np.zeros(width, bool),
+                ))
+                state = update_rows(state, UpdateBatch(
+                    idx=idx,
+                    sel_bits=np.zeros(width, np.uint32),
+                    has_deletion=np.zeros(width, bool),
+                ))
+            self.stacked[kind] = state
+
+    def _warm_tick(self) -> None:
+        fused = self.parent._get_fused()
+        (nout, pout), wire = fused(
+            (self.stacked["nodes"], self.stacked["pods"]), 0.0
+        )
+        self.stacked["nodes"] = nout.state
+        self.stacked["pods"] = pout.state
+        np.asarray(wire)  # complete (and warm) the wire's D2H path
+
+    def start_workers(self, threads: list) -> None:
+        """Spawn the router + per-lane drain/emit workers (the tick loop
+        itself is started by ClusterEngine.start as 'kwok-tick')."""
+        t = threading.Thread(
+            target=self.route_loop, name="kwok-route", daemon=True
+        )
+        t.start()
+        threads.append(t)
+        for lane in self.lanes:
+            for target, name in (
+                (lane.drain_loop, f"kwok-lane{lane.index}"),
+                (lane.emit_loop, f"kwok-emit{lane.index}"),
+            ):
+                t = threading.Thread(target=target, name=name, daemon=True)
+                t.start()
+                threads.append(t)
+
+    def close(self) -> None:
+        """Release lane-owned pump connection groups (the shared client
+        and executor belong to the parent)."""
+        for lane in self.lanes:
+            e = lane.engine
+            e._running = False
+            if e._pump is not None:
+                e._pump.close()
+                e._pump = None
+
+    # --------------------------------------------------------------- router
+
+    def route_loop(self) -> None:
+        """Drain the parent's ingest queue, batch-parse raw watch lines
+        (the cheap C++ call — ~1.3 µs/line), and hand parsed events to
+        their key's lane. The rv/generation bookkeeping stays here, on the
+        parent, exactly as the single-lane tick thread kept it."""
+        parent = self.parent
+        q = parent._q
+        tel = parent.telemetry
+        # parse-batch window: after the first queued item, keep absorbing
+        # for up to half a tick before flushing — the single-lane loop
+        # amortized ONE batched C++ parse per drain window, and flushing
+        # per tiny burst would re-pay the per-call setup thousands of
+        # times at high event rates (measured 5x parse inflation)
+        window = max(0.002, parent.config.tick_interval / 2)
+        raw_buf: dict = {}
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=0.1)
+                except queue.Empty:
+                    if not parent._running:
+                        return
+                    continue
+                if item is None:
+                    if not parent._running:
+                        return
+                    continue
+                lag = time.monotonic() - item[3]
+                parent._drain_apply(item, raw_buf, self.route)
+                window_end = time.monotonic() + window
+                while True:
+                    timeout = window_end - time.monotonic()
+                    if timeout <= 0:
+                        break
+                    try:
+                        item = q.get(timeout=timeout)
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        if not parent._running:
+                            break
+                        continue
+                    lag = max(lag, time.monotonic() - item[3])
+                    parent._drain_apply(item, raw_buf, self.route)
+                if raw_buf:
+                    parent._drain_flush(raw_buf, self.route)
+                tel.observe_watch_lag(lag)
+                tel.set_gauge("ingest_queue_depth", q.qsize())
+                if not parent._running:
+                    return
+        finally:
+            # flush straggler lines, then let every lane drain worker exit
+            try:
+                if raw_buf:
+                    parent._drain_flush(raw_buf, self.route)
+            finally:
+                for lane in self.lanes:
+                    lane.q.put(None)
+
+    def route(self, kind: str, type_: str, obj) -> None:
+        """Partition one parsed event to its key's lane. RESYNC snapshots
+        broadcast (each lane prunes only keys it owns)."""
+        t = time.monotonic()
+        if type_ == "RESYNC":
+            for lane in self.lanes:
+                lane.q.put((kind, type_, obj, t))
+            self.events_routed += 1
+            return
+        key = self._key_of(kind, type_, obj)
+        if key is None:
+            return
+        self.events_routed += 1
+        self.lanes[shard_of(key, self.n)].q.put((kind, type_, obj, t))
+
+    def _key_of(self, kind: str, type_: str, obj):
+        """The routing key — identical to the lane pool's key, so a key's
+        row can only ever live in the lane its events are routed to."""
+        if type_ == "REC":
+            name = obj.name
+            ns = obj.namespace or "default"
+            if not name:
+                # unparseable record fields: fall back to the raw line
+                try:
+                    meta = (
+                        (json.loads(obj.raw).get("object") or {})
+                        .get("metadata") or {}
+                    )
+                except Exception:
+                    return None
+                name = meta.get("name") or ""
+                ns = meta.get("namespace") or "default"
+        elif isinstance(obj, dict):
+            meta = obj.get("metadata") or {}
+            name = meta.get("name") or ""
+            ns = meta.get("namespace") or "default"
+        else:
+            return None
+        if not name:
+            return None
+        return (ns, name) if kind == "pods" else name
+
+    def route_pod_updates(self, node_name: str) -> None:
+        """Fan a node's managed-ness change out to the lanes owning its
+        pods — one XUPD batch per lane, through the lane's own queue."""
+        keys = self.parent.pods_by_node.get(node_name)
+        if not keys:
+            return
+        # snapshot: the set is shared and other lanes' drain workers
+        # add/discard concurrently (single-op mutations, GIL-atomic); a
+        # mid-copy resize just means retrying the C-level copy — the
+        # resize window is nanoseconds, so this converges immediately,
+        # and losing the fan-out (stale SEL_MANAGED bits until the pod's
+        # next event) is worse than another attempt
+        while True:
+            try:
+                snapshot = list(keys)
+                break
+            except RuntimeError:
+                time.sleep(0)  # yield to the mutating drain worker
+        by_lane: dict[int, list] = {}
+        for key in snapshot:
+            by_lane.setdefault(shard_of(key, self.n), []).append(key)
+        t = time.monotonic()
+        for li, lane_keys in by_lane.items():
+            self.lanes[li].q.put(("pods", "XUPD", lane_keys, t))
+
+    # ------------------------------------------------------------ tick loop
+
+    def tick_loop(self) -> None:
+        """The coordinator tick thread: pure kernel dispatch + per-shard
+        wire handoff (drain and emit live on the lane workers). Pipelined
+        like the single-lane loop: up to pipeline_depth wires in flight,
+        FIFO consume."""
+        parent = self.parent
+        interval = parent.config.tick_interval
+        depth = max(1, int(parent.config.pipeline_depth))
+        pending: "deque[_LanePending]" = deque()
+        profiling.maybe_start()
+        seen_events = 0
+        tel = parent.telemetry
+        try:
+            while parent._running:
+                deadline = time.monotonic() + interval
+                got_event = self.events_routed != seen_events
+                if (
+                    not pending
+                    and not got_event
+                    and not self._staged()
+                ):
+                    wake = parent._idle_wake
+                    if wake is None:
+                        deadline = time.monotonic() + parent._IDLE_MAX
+                    elif wake > deadline:
+                        deadline = min(
+                            wake, time.monotonic() + parent._IDLE_MAX
+                        )
+                while parent._running:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    if pending and parent._wire_ready(pending[0]):
+                        try:
+                            self._consume(pending.popleft(), pending)
+                        except Exception:
+                            logger.exception("sharded consume failed")
+                        continue
+                    if not got_event and (
+                        self.events_routed != seen_events or self._staged()
+                    ):
+                        # an event arriving during an idle sleep must be
+                        # ticked within one normal interval
+                        got_event = True
+                        deadline = min(
+                            deadline, time.monotonic() + interval
+                        )
+                    time.sleep(
+                        min(remaining, 0.002 if pending else 0.02)
+                    )
+                got_event = got_event or self.events_routed != seen_events
+                seen_events = self.events_routed
+                tel.set_gauge("tick_inflight", len(pending))
+                try:
+                    while pending and (
+                        len(pending) >= depth
+                        or parent._wire_ready(pending[0])
+                    ):
+                        self._consume(pending.popleft(), pending)
+                    wake = parent._idle_wake
+                    if (
+                        got_event
+                        or self._staged()
+                        or (wake is not None
+                            and time.monotonic() >= wake)
+                    ):
+                        p = self.dispatch()
+                        if p is not None:
+                            pending.append(p)
+                except Exception:
+                    logger.exception("sharded tick failed")
+                    parent._idle_wake = time.monotonic() + interval
+        finally:
+            # stopping: flush in-flight wires so computed patches are not
+            # dropped, then release the emit workers
+            while pending:
+                try:
+                    self._consume(pending.popleft(), pending)
+                except Exception:
+                    logger.exception("final sharded consume failed")
+            for lane in self.lanes:
+                lane.emit_q.put(None)
+
+    def _staged(self) -> bool:
+        return any(
+            k.buffer.pending
+            for lane in self.lanes
+            for k in (lane.engine.nodes, lane.engine.pods)
+        )
+
+    # ----------------------------------------------------- dispatch/consume
+
+    def dispatch(self) -> "_LanePending | None":
+        """Flush every lane's staged writes into the stacked state and
+        dispatch the fused kernel (the single-lane _tick_dispatch, minus
+        drain and emit — those live on the lane workers)."""
+        parent = self.parent
+        if parent.config.profile_dir:
+            parent._maybe_profile()
+        t0 = time.perf_counter()
+        now = parent._now()
+        if now >= REBASE_AFTER:
+            parent._epoch += now
+            for lane in self.lanes:
+                lane.engine._epoch = parent._epoch
+            for kind in _KINDS:
+                self.stacked[kind] = rebase_times(self.stacked[kind], now)
+            parent._inc("epoch_rebases_total")
+            logger.info("epoch rebase at engine time %.1fs", now)
+            now = 0.0
+        self._ensure_stacked()
+        # swap full buffers out under each lane's stage lock (cheap), then
+        # flush them into the stacked state lock-free: the drain workers
+        # keep staging into the fresh buffers while the scatters dispatch
+        swapped: list[tuple[int, str, UpdateBuffer]] = []
+        want = self.r
+        any_rows = False
+        for li, lane in enumerate(self.lanes):
+            e = lane.engine
+            with lane.stage_lock:
+                for kind, k in (("nodes", e.nodes), ("pods", e.pods)):
+                    want = max(want, k.capacity)
+                    if k.buffer.pending:
+                        swapped.append((li, kind, k.buffer))
+                        k.buffer = UpdateBuffer()
+                        any_rows = True
+                    elif len(k.pool):
+                        any_rows = True
+        if want > self.r:
+            self._regrow(want)
+        r = self.r
+        for li, kind, buf in swapped:
+            self.stacked[kind] = buf.flush(
+                self.stacked[kind], offset=li * r
+            )
+        t_flush = time.perf_counter()
+        tel = parent.telemetry
+        tel.set_gauge(
+            "nodes_managed",
+            sum(len(lane.engine.nodes.pool) for lane in self.lanes),
+        )
+        tel.set_gauge(
+            "pods_managed",
+            sum(len(lane.engine.pods.pool) for lane in self.lanes),
+        )
+        tel.inc("ticks_total")
+        tel.observe_stage("flush", t_flush - t0)
+        if not any_rows:
+            parent._idle_wake = None  # empty engine: sleep until events
+            return None
+        fused = parent._get_fused()
+        now_base = now - (fused.steps - 1) * fused.dt
+        (nout, pout), wire = fused(
+            (self.stacked["nodes"], self.stacked["pods"]), now_base
+        )
+        self.stacked["nodes"] = nout.state
+        self.stacked["pods"] = pout.state
+        prefetch(wire)
+        t_end = time.perf_counter()
+        tel.span("tick.dispatch", t0, t_end, "dispatch")
+        return _LanePending(
+            wire=wire,
+            r=r,
+            cap=r * self.n,
+            seqs=[lane.engine._release_seq for lane in self.lanes],
+            now=now,
+            mono=time.monotonic(),
+            host_s=t_end - t0,
+        )
+
+    def _consume(self, p: _LanePending, pending, inline: bool = False) -> None:
+        """Consume the oldest in-flight wire: slice it per lane and hand
+        each lane its view (emit worker does mirrors + patches). With
+        inline=True (tick_once) lanes process on the calling thread."""
+        parent = self.parent
+        t0 = time.perf_counter()
+        counters, masks_fn, dues, rows_fn = unpack_wire(
+            np.asarray(p.wire), [p.cap, p.cap], rows=True
+        )
+        t_wire = time.perf_counter()
+        nd = float(dues.min())
+        parent._idle_wake = (
+            None if nd == float("inf")
+            else p.mono + max(0.0, nd - p.now)
+        )
+        if counters.any():
+            now_str = now_rfc3339()
+            masks = masks_fn()
+            rows = (
+                rows_fn()
+                if (int(counters[0]) or int(counters[1])) else None
+            )
+            views = lane_views(masks, rows, self.n, p.r)
+            for li, lane in enumerate(self.lanes):
+                for ki, kind in enumerate(_KINDS):
+                    dirty, deleted, hb, ph, cb = views[li][ki]
+                    if not (dirty.any() or deleted.any() or hb.any()):
+                        continue
+                    item = (
+                        kind, dirty, deleted, hb, ph, cb,
+                        p.seqs[li], now_str,
+                    )
+                    if inline:
+                        lane._process_emit(item)
+                    else:
+                        lane.emit_q.put(item)
+        # release-log pruning rides the emit queue BEHIND this tick's
+        # items: pruning here directly would race the emit workers —
+        # entries between a queued item's seq and the oldest pending
+        # dispatch's seq would vanish before that item's stale filter ran
+        for li, lane in enumerate(self.lanes):
+            nxt = next(
+                (q.seqs[li] for q in pending),
+                lane.engine._release_seq,
+            )
+            if inline:
+                lane._prune_now(nxt)
+            else:
+                lane.emit_q.put(("__prune__", nxt))
+        t_end = time.perf_counter()
+        tel = parent.telemetry
+        tel.observe_tick(t_end - t0 + p.host_s)
+        tel.observe_stage("kernel", t_wire - t0)
+        tel.span(
+            "tick.consume", t0, t_end, "consume",
+            {"wire_wait_us": round((t_wire - t0) * 1e6, 1)},
+        )
+
+    # ------------------------------------------------------------------ grow
+
+    def _regrow(self, want: int) -> None:
+        """A lane's pool grew past the per-lane row budget: grow every
+        lane to the new common capacity and rebuild the stacked state
+        (the federation _maybe_regrow pattern)."""
+        new_r = want
+        if self.parent._mesh is not None:
+            from kwok_tpu.parallel.mesh import pad_to_multiple
+
+            new_r = pad_to_multiple(new_r, self.parent._mesh)
+        old_r = self.r
+        logger.info(
+            "lane regrow (%d lanes): %d -> %d rows/lane",
+            self.n, old_r, new_r,
+        )
+        for lane in self.lanes:
+            with lane.stage_lock:
+                for k in (lane.engine.nodes, lane.engine.pods):
+                    if k.capacity < new_r:
+                        k.grow(new_r)
+        fused = self.parent._get_fused()
+        for kind in _KINDS:
+            host = to_host(self.stacked[kind])
+            stacked = new_row_state(new_r * self.n)
+            for c in range(self.n):
+                for f in RowState._fields:
+                    getattr(stacked, f)[
+                        c * new_r : c * new_r + old_r
+                    ] = getattr(host, f)[c * old_r : (c + 1) * old_r]
+            self.stacked[kind] = fused.place(stacked)
+        self.r = new_r
+
+    # ------------------------------------------------------------ sync mode
+
+    def tick_once(self) -> None:
+        """One synchronous sharded step (tests, tools): route + drain every
+        queue inline, dispatch, consume with inline emit. Patch-for-patch
+        identical to the threaded pipeline — same routing, same lane
+        application order, same wire slicing."""
+        self.drain_inline()
+        p = self.dispatch()
+        if p is not None:
+            self._consume(p, deque(), inline=True)
+
+    def drain_inline(self) -> None:
+        """Route the parent queue and apply every lane queue to quiescence
+        (XUPD fan-outs re-enqueue, hence the outer loop)."""
+        parent = self.parent
+        raw_buf: dict = {}
+        progressed = True
+        while progressed:
+            progressed = False
+            while True:
+                try:
+                    item = parent._q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:
+                    continue
+                parent._drain_apply(item, raw_buf, self.route)
+                progressed = True
+            if raw_buf:
+                parent._drain_flush(raw_buf, self.route)
+                progressed = True
+            for lane in self.lanes:
+                while True:
+                    try:
+                        item = lane.q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        continue
+                    with lane.stage_lock:
+                        lane._apply_item(item)
+                    progressed = True

@@ -7,7 +7,25 @@ reference's ipPool (pkg/kwok/controllers/utils.go:52-117).
 
 from __future__ import annotations
 
+import zlib
 from typing import Any
+
+
+def shard_of(key: Any, n: int) -> int:
+    """Stable key -> shard index for the hash-partitioned host lanes.
+
+    Deliberately NOT Python's ``hash()``: str hashing is salted per process
+    (PYTHONHASHSEED), and the lane layout should be reproducible across
+    runs so soak artifacts and trace dumps from different rounds line up.
+    Keys are the row-pool keys: node name (str) or (namespace, name) for
+    pods — crc32 over the joined utf-8 bytes."""
+    if n <= 1:
+        return 0
+    if isinstance(key, tuple):
+        data = "\x1f".join(str(p) for p in key).encode()
+    else:
+        data = str(key).encode()
+    return zlib.crc32(data) % n
 
 
 class RowPool:

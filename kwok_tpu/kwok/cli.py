@@ -71,6 +71,12 @@ def build_parser(defaults) -> argparse.ArgumentParser:
                    "(amortizes round-trips on remote/tunneled TPUs)")
     p.add_argument("--heartbeat-interval", type=float, default=o.heartbeatInterval)
     p.add_argument("--parallelism", type=int, default=o.parallelism)
+    p.add_argument("--drain-shards", type=int, default=o.drainShards,
+                   help="hash-partitioned host lanes for the drain+emit "
+                   "pipeline: each lane runs its own ingest drain, emit "
+                   "worker, and pump connection group so the host side "
+                   "scales past one core (0 = auto, min(8, cpu_count); "
+                   "1 = the classic single-lane engine)")
     p.add_argument("--initial-capacity", type=int, default=o.initialCapacity)
     p.add_argument("--use-mesh", type=_bool, default=o.useMesh,
                    help="shard cluster state across all local devices")
@@ -93,9 +99,11 @@ _bool = parse_bool
 
 
 def _engine_config(args, stages: list[Stage]):
+    from kwok_tpu.config.types import resolve_drain_shards
     from kwok_tpu.engine import EngineConfig
 
     return EngineConfig(
+        drain_shards=resolve_drain_shards(args.drain_shards),
         manage_all_nodes=args.manage_all_nodes,
         manage_nodes_with_annotation_selector=args.manage_nodes_with_annotation_selector,
         manage_nodes_with_label_selector=args.manage_nodes_with_label_selector,

@@ -556,6 +556,36 @@ def unpack_wire(
     return counters, (masks_fn if lazy else masks_fn()), next_dues, rows_fn
 
 
+def lane_views(masks, rows, n_lanes: int, r: int):
+    """Per-shard index slices of an unpacked STACKED wire.
+
+    The sharded host pipeline (engine/lanes.py) keeps every lane's rows in
+    one stacked device state: lane ``i`` owns rows ``[i*r, (i+1)*r)``. This
+    carves the unpacked wire into exactly those slices so the coordinator
+    can hand each lane its own view without copying: for each lane, a list
+    of per-kind ``(dirty, deleted, hb, phase, cond)`` tuples. ``masks`` is
+    ``masks_fn()``'s output, ``rows`` is ``rows_fn()``'s (or None — the
+    phase/cond entries come back None then, e.g. a heartbeat-only wire).
+
+    The slices are numpy VIEWS over the freshly materialized wire arrays —
+    lanes own disjoint ranges, so one lane clearing stale mask bits in its
+    slice can never touch another lane's rows.
+    """
+    out = []
+    for lane in range(n_lanes):
+        lo, hi = lane * r, (lane + 1) * r
+        kinds = []
+        for ki, (dirty, deleted, hb) in enumerate(masks):
+            if rows is not None:
+                ph, cb = rows[ki]
+                ph, cb = ph[lo:hi], cb[lo:hi]
+            else:
+                ph = cb = None
+            kinds.append((dirty[lo:hi], deleted[lo:hi], hb[lo:hi], ph, cb))
+        out.append(kinds)
+    return out
+
+
 def prefetch(tree) -> None:
     """Start async device->host copies for every array in `tree`.
 

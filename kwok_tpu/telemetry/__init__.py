@@ -14,6 +14,7 @@ Three pieces (ISSUE 1 tentpole):
 
 from kwok_tpu.telemetry.engine_metrics import (
     EngineTelemetry,
+    LaneTelemetry,
     register_build_info,
 )
 from kwok_tpu.telemetry.registry import (
@@ -31,6 +32,7 @@ __all__ = [
     "EngineTelemetry",
     "GaugeFamily",
     "HistogramFamily",
+    "LaneTelemetry",
     "MetricsRegistry",
     "Tracer",
     "merge_chrome_traces",

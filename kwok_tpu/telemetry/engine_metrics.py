@@ -31,6 +31,7 @@ _HELP = {
     "kwok_watch_bookmarks_total": "BOOKMARK events consumed (rv advanced, no ingest)",
     "kwok_watch_relists_total": "Full re-lists performed by the watch loops",
     "kwok_patch_errors_total": "Patch/delete jobs that raised",
+    "kwok_dropped_jobs_total": "Patch jobs rejected during shutdown",
     "kwok_ticks_total": "Engine ticks executed",
     "kwok_pump_requests_total": "Requests shipped through the native pump",
     "kwok_tick_seconds": "Wall seconds per engine tick (dispatch + consume halves)",
@@ -48,6 +49,12 @@ _HELP = {
     "kwok_pods_managed": "Pods currently tracked",
     "kwok_build_info": "Build/version info (value is always 1)",
     "kwok_trace_spans_total": "Spans recorded into the trace ring",
+    "kwok_lane_stage_seconds": "Per-lane wall seconds by stage for the "
+    "sharded drain+emit pipeline (shard=lane index; drain=ingest apply, "
+    "emit=patch fan-out; the router's batched parse stays in the "
+    "unlabeled kwok_tick_stage_seconds{stage=parse})",
+    "kwok_lane_queue_depth": "Routed events waiting in a lane's ingest "
+    "queue (shard=lane index)",
 }
 
 # legacy counter name -> (family name, has kind label)
@@ -61,6 +68,7 @@ _COUNTERS = {
     "watch_bookmarks_total": ("kwok_watch_bookmarks_total", False),
     "watch_relists_total": ("kwok_watch_relists_total", False),
     "patch_errors_total": ("kwok_patch_errors_total", False),
+    "dropped_jobs_total": ("kwok_dropped_jobs_total", False),
     "ticks_total": ("kwok_ticks_total", False),
     "pump_requests_total": ("kwok_pump_requests_total", False),
 }
@@ -202,11 +210,23 @@ class EngineTelemetry:
         self.tracer.span(name, t0, t1, lane, args)
         self._spans.inc()
 
+    def lane(self, lane_id: str) -> "LaneTelemetry":
+        """A per-lane slice for the sharded drain+emit pipeline: lane
+        stage observations land BOTH in the lane-labeled
+        ``kwok_lane_stage_seconds{shard=...}`` family and in the engine's
+        aggregate ``kwok_tick_stage_seconds`` (so the legacy flat view and
+        the cost model keep seeing whole-engine totals)."""
+        return LaneTelemetry(self, lane_id)
+
     # ------------------------------------------------------------- reads
 
     @property
     def ticks_total(self) -> int:
         return self._counters["ticks_total"].value
+
+    @property
+    def dropped_jobs_total(self) -> int:
+        return self._counters["dropped_jobs_total"].value
 
     def legacy_dict(self) -> dict:
         """The pre-telemetry ``engine.metrics`` surface: flat names, plain
@@ -225,3 +245,49 @@ class EngineTelemetry:
         d["ingest_parse_seconds_sum"] = self.stage_hists["parse"].sum
         d["pump_send_seconds_sum"] = self.pump_hist.sum
         return d
+
+
+# Lane stages: the subset of STAGES a ShardLane runs (flush/kernel stay on
+# the coordinator tick thread, parse on the router; both remain unlabeled).
+LANE_STAGES = ("drain", "emit")
+
+
+class LaneTelemetry:
+    """Per-lane metric handles for the sharded host pipeline.
+
+    One instance per ShardLane, sharing the engine's registry. The lane
+    label intentionally reuses the ``shard`` label name the federation
+    surface established, under a lane-specific family — a federated member
+    never runs lanes (members are forced single-lane), so the two uses of
+    the label cannot collide on one registry.
+    """
+
+    def __init__(self, parent: EngineTelemetry, lane_id: str):
+        self.parent = parent
+        self.lane_id = str(lane_id)
+        r = parent.registry
+        fam = r.histogram(
+            "kwok_lane_stage_seconds",
+            _HELP["kwok_lane_stage_seconds"],
+            ("shard", "stage"),
+        )
+        self.stage_hists = {
+            s: fam.labels(shard=self.lane_id, stage=s) for s in LANE_STAGES
+        }
+        self._depth = r.gauge(
+            "kwok_lane_queue_depth",
+            _HELP["kwok_lane_queue_depth"],
+            ("shard",),
+        ).labels(shard=self.lane_id)
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        self.stage_hists[stage].observe(seconds)
+        self.parent.observe_stage(stage, seconds)
+
+    def set_queue_depth(self, depth: int) -> None:
+        self._depth.set(depth)
+
+    @property
+    def stage_sums(self) -> dict:
+        """Per-lane stage second totals (lane-utilization reporting)."""
+        return {s: h.sum for s, h in self.stage_hists.items()}
