@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify bench obs-check lane-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check image cluster-image clean
 
 all: build
 
@@ -24,6 +24,9 @@ test: unit-test e2e-test
 verify:
 	./hack/verify-all.sh
 
+analyze: ## kwoklint: lock discipline, kernel purity, exception hygiene, metrics/docs contract (docs/static-analysis.md)
+	python3 -m kwok_tpu.analysis
+
 bench: ## the headline benchmark on the real device (ONE process, owns the TPU)
 	python3 bench.py
 
@@ -32,9 +35,11 @@ obs-check: ## exposition-format + trace-schema oracle (docs/observability.md)
 
 # lane-check: the per-key patch-order oracle plus the engine tier-1 subset
 # under PYTHONDEVMODE, with test_lanes' threading.excepthook fixture failing
-# any test whose worker thread swallowed an exception.
-lane-check: ## sharded-lane ordering oracle + thread-sanity pass
-	$(PYENV) PYTHONDEVMODE=1 python3 -m pytest \
+# any test whose worker thread swallowed an exception, and the runtime
+# lock-order witness (analysis/witness.py) failing any test whose threads
+# acquired locks out of the declared order or formed an order-graph cycle.
+lane-check: ## sharded-lane ordering oracle + thread-sanity + lock-witness pass
+	$(PYENV) PYTHONDEVMODE=1 KWOK_TPU_LOCK_WITNESS=1 python3 -m pytest \
 	    tests/test_lanes.py tests/test_engine.py tests/test_pipeline.py -q
 
 image:

@@ -7,6 +7,9 @@ cd "${ROOT}"
 echo ">> python syntax (compileall)"
 python3 -m compileall -q kwok_tpu tests bench.py __graft_entry__.py
 
+echo ">> kwoklint (python -m kwok_tpu.analysis)"
+python3 -m kwok_tpu.analysis
+
 echo ">> pytest collection"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python3 -m pytest tests/ --collect-only -q >/dev/null

@@ -1,0 +1,36 @@
+"""kwoklint: the repo-native static-analysis suite (ISSUE 4 tentpole).
+
+PR 2 made the engine genuinely concurrent — 15+ locks, per-lane worker
+threads, a router/tick/emit topology — while the tick path is a JAX kernel
+that must stay pure to stay fusable. The reference KWOK leans on Go's race
+detector and ``go vet`` for exactly this class of code; this package is the
+Python-side equivalent, purpose-built around the invariants the engine
+actually depends on:
+
+- ``locks``     — lock discipline against the declared lock-order table
+                  (out-of-order nested acquisitions, blocking calls held
+                  under a lock, locks created but never acquired)
+- ``purity``    — no host side effects inside the jitted tick kernels
+- ``hygiene``   — no silent broad ``except`` (swallows must log or count)
+- ``metrics_doc`` — the telemetry surface and docs/observability.md agree
+
+Run it as ``python -m kwok_tpu.analysis`` (``make analyze``). Findings are
+``file:line: severity [rule] message``; suppress one with an inline
+``# kwoklint: disable=<rule> -- <justification>`` comment (the
+justification is mandatory — a bare suppression is itself a finding).
+
+The runtime complement is ``witness`` — an instrumented Lock/RLock that
+records acquisition-order edges during tests and fails on order-graph
+cycles or declared-order violations with both stacks
+(``KWOK_TPU_LOCK_WITNESS=1``, wired into ``make lane-check``).
+"""
+
+from kwok_tpu.analysis.core import (
+    Analyzer,
+    Finding,
+    Rule,
+    all_rules,
+    load_module,
+)
+
+__all__ = ["Analyzer", "Finding", "Rule", "all_rules", "load_module"]

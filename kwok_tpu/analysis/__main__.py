@@ -1,0 +1,92 @@
+"""kwoklint CLI: ``python -m kwok_tpu.analysis`` (``make analyze``).
+
+Exit codes: 0 = clean, 1 = unsuppressed findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from kwok_tpu.analysis.core import Analyzer, all_rules
+
+
+def repo_root() -> str:
+    """The tree kwoklint ships in: two levels above this package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kwok_tpu.analysis",
+        description="kwoklint: concurrency + kernel-purity static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: the kwok_tpu package)",
+    )
+    parser.add_argument(
+        "--rule", action="append", default=None,
+        help="run only this rule (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root for relative paths + docs (default: autodetected)",
+    )
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    rules = all_rules(root)
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:22s} {r.description}")
+        return 0
+    if args.rule:
+        known = {r.name for r in rules}
+        bad = set(args.rule) - known
+        if bad:
+            print(
+                f"unknown rule(s): {', '.join(sorted(bad))} "
+                f"(known: {', '.join(sorted(known))})",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [r for r in rules if r.name in set(args.rule)]
+
+    paths = args.paths or [os.path.join(root, "kwok_tpu")]
+    paths = [os.path.abspath(p) for p in paths]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    analyzer = Analyzer(root, rules)
+    findings, suppressed = analyzer.run(paths)
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [vars(f) for f in findings],
+                "suppressed": suppressed,
+            },
+            indent=1,
+        ))
+    else:
+        for f in findings:
+            print(f.format())
+        tail = f"{len(findings)} finding(s), {suppressed} suppressed"
+        print(f"kwoklint: {tail}" if findings else f"kwoklint: clean ({tail})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

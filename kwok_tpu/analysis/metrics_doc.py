@@ -1,0 +1,141 @@
+"""Metrics-contract rule: the telemetry surface and the docs agree.
+
+Every metric family the process can export must be catalogued in
+``docs/observability.md``, and every ``kwok_*``/``process_*`` family the
+doc catalogues must exist in code — a dashboard built from the doc must
+never scrape a phantom, and a family added in code must never ship
+undocumented. Label sets are also checked for consistency: one family
+registered twice with different literal label tuples is a runtime
+``ValueError`` waiting for the second registration to run.
+
+Registered names come from two scans:
+
+- literal first arguments of ``.counter(`` / ``.gauge(`` / ``.histogram(``
+  calls anywhere in the tree (federation's aggregates, build info)
+- all string constants in the registration surface — ``telemetry/``,
+  ``kwok/server.py`` — which catches the dict-driven registrations
+  (``_HELP`` / ``_COUNTERS`` in ``engine_metrics.py``) and the process
+  collector the HTTP server appends
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from kwok_tpu.analysis.core import Finding, Module, Rule
+
+# Family names: kwok_* (must not end in '_' — docs use `kwok_lane_*`
+# wildcards) plus the one process collector the HTTP server appends.
+# Chrome-trace metadata strings (process_name/thread_name) stay out.
+_NAME_RE = re.compile(
+    r"\b(?:kwok_[a-z0-9_]*[a-z0-9]|process_cpu_seconds_total)\b"
+)
+_REG_METHODS = ("counter", "gauge", "histogram")
+# files whose string constants are treated as the registration surface
+_SURFACE = ("telemetry" + os.sep, os.path.join("kwok", "server.py"))
+_SUFFIXES = ("_bucket", "_count")
+
+
+class MetricsContractRule(Rule):
+    name = "metrics-doc"
+    description = (
+        "every registered metric family appears in docs/observability.md "
+        "and vice versa; label sets are consistent across registrations"
+    )
+
+    def __init__(self, doc_path: str) -> None:
+        self.doc_path = doc_path
+
+    def check_project(self, mods: list[Module], root: str):
+        registered: dict[str, tuple] = {}  # name -> (rel, line)
+        labels: dict[str, dict] = {}       # name -> {labels tuple: (rel, line)}
+
+        def note(name: str, rel: str, line: int) -> None:
+            registered.setdefault(name, (rel, line))
+
+        for mod in mods:
+            surface = any(s in mod.rel for s in _SURFACE)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ) and node.func.attr in _REG_METHODS and node.args:
+                    first = node.args[0]
+                    if isinstance(first, ast.Constant) and isinstance(
+                        first.value, str
+                    ) and _NAME_RE.fullmatch(first.value):
+                        note(first.value, mod.rel, node.lineno)
+                        lab = self._literal_labels(node)
+                        if lab is not None:
+                            prev = labels.setdefault(first.value, {})
+                            prev.setdefault(lab, (mod.rel, node.lineno))
+                elif surface and isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    for m in _NAME_RE.findall(node.value):
+                        note(m, mod.rel, node.lineno)
+
+        # label-set consistency across literal registrations
+        for name, sets in labels.items():
+            if len(sets) > 1:
+                variants = sorted(sets.items())
+                (rel, line) = variants[1][1]
+                yield Finding(
+                    rel, line, self.name,
+                    f"{name} registered with inconsistent label sets: "
+                    + " vs ".join(str(list(k)) for k, _ in variants),
+                )
+
+        if not os.path.exists(self.doc_path):
+            yield Finding(
+                os.path.relpath(self.doc_path, root), 1, self.name,
+                "metric catalogue document is missing",
+            )
+            return
+        with open(self.doc_path, encoding="utf-8") as fh:
+            doc_lines = fh.read().splitlines()
+        doc_rel = os.path.relpath(self.doc_path, root)
+        documented: dict[str, int] = {}
+        for i, line in enumerate(doc_lines, 1):
+            for m in _NAME_RE.findall(line):
+                documented.setdefault(m, i)
+
+        def base(name: str) -> str:
+            for suf in _SUFFIXES:
+                if name.endswith(suf) and name[: -len(suf)] in registered:
+                    return name[: -len(suf)]
+            return name
+
+        for name, (rel, line) in sorted(registered.items()):
+            if name not in documented:
+                yield Finding(
+                    rel, line, self.name,
+                    f"metric {name} is registered/exported but not "
+                    f"catalogued in {doc_rel}",
+                )
+        for name, line in sorted(documented.items()):
+            if base(name) not in registered:
+                yield Finding(
+                    doc_rel, line, self.name,
+                    f"metric {name} is catalogued in the doc but "
+                    "registered nowhere in the tree",
+                )
+
+    @staticmethod
+    def _literal_labels(call: ast.Call) -> "tuple | None":
+        """The label-names argument when fully literal (positional third
+        arg or label_names kwarg), else None."""
+        cand = None
+        if len(call.args) >= 3:
+            cand = call.args[2]
+        for kw in call.keywords:
+            if kw.arg == "label_names":
+                cand = kw.value
+        if cand is None:
+            return None
+        if isinstance(cand, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in cand.elts
+        ):
+            return tuple(e.value for e in cand.elts)
+        return None

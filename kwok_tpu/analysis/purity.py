@@ -1,0 +1,241 @@
+"""Kernel-purity rule: nothing host-side inside the jitted tick.
+
+The tick path (``ops/tick.py``, ``ops/pallas_tick.py``, the shard_map'd
+variants) must stay a pure function of its inputs to stay fusable into one
+XLA program: a Python side effect inside a traced function either runs at
+trace time only (silent wrong behavior), forces a host callback (breaks
+fusion and adds a device round-trip per tick), or both. This rule finds
+the *kernel scope* — functions reachable from a ``jax.jit`` /
+``shard_map`` / ``pl.pallas_call`` root via the module's call graph — and
+flags host-side constructs inside it:
+
+- wall-clock / RNG: ``time.*``, ``datetime.*``, Python ``random.*``,
+  ``np.random.*`` (device RNG is ``jax.random``; the pallas kernel's
+  counter hash is jnp-only)
+- host I/O and side effects: ``print``, ``open``, ``input``, ``logging``/
+  ``logger`` calls, ``os.environ``/``os.getenv``
+- implicit transfers: ``.item()``, host ``np.*`` calls on traced values
+- host callbacks: ``io_callback``, ``pure_callback``, ``host_callback``,
+  ``jax.debug.callback``
+
+Jit roots are found structurally: ``@jax.jit`` / ``@functools.partial(
+jax.jit, ...)`` decorators, ``jax.jit(fn)`` / ``shard_map(fn, ...)`` /
+``pl.pallas_call(kern, ...)`` call sites (following one level of
+``functools.partial`` aliasing), and functions *returned* by a factory
+whose result is passed to ``jax.jit`` (the ``jax.jit(self._build(cap))``
+pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kwok_tpu.analysis.core import Finding, Module, Rule
+
+_HOST_MODULES = {"time", "datetime", "random", "np", "numpy", "os",
+                 "logging", "logger"}
+_HOST_CALLS = {"print", "open", "input"}
+_CALLBACKS = {"io_callback", "pure_callback", "host_callback", "callback"}
+
+
+def _terminal(expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _root_of_attr(expr: ast.expr) -> str | None:
+    """Leftmost name of a dotted chain: np.random.uniform -> np."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _is_jit_expr(expr: ast.expr) -> bool:
+    """jax.jit / jit as a bare reference (decorator or partial arg)."""
+    return _terminal(expr) == "jit"
+
+
+def _jit_arg_names(call: ast.Call, aliases: dict) -> list[str]:
+    """Function names rooted by this call if it is jax.jit(f)/shard_map(f)/
+    pl.pallas_call(f). Follows partial aliases one level."""
+    t = _terminal(call.func)
+    if t not in ("jit", "shard_map", "pallas_call"):
+        return []
+    out = []
+    for arg in call.args[:1]:
+        name = None
+        if isinstance(arg, ast.Name):
+            name = aliases.get(arg.id, arg.id)
+        elif isinstance(arg, ast.Call) and _terminal(arg.func) == "partial":
+            if arg.args and isinstance(arg.args[0], ast.Name):
+                name = arg.args[0].id
+        elif isinstance(arg, ast.Call):
+            # jax.jit(self._build(cap)): the factory's returned nested
+            # functions become roots (handled by the caller via factory
+            # name)
+            name = _terminal(arg.func)
+            if name is not None:
+                out.append(("factory", name))
+                continue
+        if name is not None:
+            out.append(("fn", name))
+    return out
+
+
+class _FnScope:
+    def __init__(self, node, mod: Module) -> None:
+        self.node = node
+        self.mod = mod
+        self.name = node.name
+        self.calls: set[str] = set()          # names this fn calls
+        self.returned_defs: set[str] = set()  # nested defs it returns
+
+
+class KernelPurityRule(Rule):
+    name = "kernel-purity"
+    description = (
+        "no Python side effects, host callbacks, RNG/time calls, or "
+        "implicit transfers inside functions reachable from the jitted tick"
+    )
+
+    def check_module(self, mod: Module):
+        # ---- collect every function (incl. nested), partial aliases,
+        # and jit roots ------------------------------------------------
+        fns: dict[str, list[_FnScope]] = {}
+        aliases: dict[str, str] = {}
+        roots: set[str] = set()
+        factories: set[str] = set()
+
+        def visit(node, enclosing: "_FnScope | None"):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = _FnScope(node, mod)
+                fns.setdefault(node.name, []).append(scope)
+                for dec in node.decorator_list:
+                    if _is_jit_expr(dec):
+                        roots.add(node.name)
+                    elif isinstance(dec, ast.Call):
+                        if _terminal(dec.func) == "partial" and any(
+                            _is_jit_expr(a) for a in dec.args
+                        ):
+                            roots.add(node.name)
+                for child in node.body:
+                    visit(child, scope)
+                return
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ) and _terminal(node.value.func) == "partial":
+                args = node.value.args
+                if args and isinstance(args[0], ast.Name):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            aliases[tgt.id] = args[0].id
+            if isinstance(node, ast.Call):
+                for kind, name in _jit_arg_names(node, aliases):
+                    (roots if kind == "fn" else factories).add(name)
+            if isinstance(node, ast.Return) and enclosing is not None:
+                if isinstance(node.value, ast.Name):
+                    enclosing.returned_defs.add(node.value.id)
+            if enclosing is not None and isinstance(node, ast.Call):
+                t = _terminal(node.func)
+                if t:
+                    enclosing.calls.add(t)
+            for child in ast.iter_child_nodes(node):
+                visit(child, enclosing)
+
+        visit(mod.tree, None)
+
+        # factories: jax.jit(self._build(...)) — the defs _build returns
+        for fac in factories:
+            for scope in fns.get(fac, []):
+                roots.update(scope.returned_defs)
+
+        if not roots:
+            return
+
+        # ---- reachability over the name-level call graph --------------
+        reach: set[str] = set()
+        frontier = [r for r in roots if r in fns]
+        while frontier:
+            name = frontier.pop()
+            if name in reach:
+                continue
+            reach.add(name)
+            for scope in fns.get(name, []):
+                for callee in scope.calls:
+                    target = aliases.get(callee, callee)
+                    if target in fns and target not in reach:
+                        frontier.append(target)
+
+        # ---- impurity scan inside reachable bodies --------------------
+        for name in sorted(reach):
+            for scope in fns.get(name, []):
+                yield from self._scan_body(mod, scope)
+
+    def _scan_body(self, mod: Module, scope: _FnScope):
+        qual = f"{mod.modname}.{scope.name}"
+
+        def check_call(call: ast.Call):
+            fn = call.func
+            t = _terminal(fn)
+            if isinstance(fn, ast.Name) and fn.id in _HOST_CALLS:
+                return f"host call {fn.id}() inside jitted {qual}"
+            if t == "item":
+                return (
+                    f".item() inside jitted {qual}: forces a device->host "
+                    "transfer per element"
+                )
+            if t in _CALLBACKS:
+                return (
+                    f"host callback {t}() inside jitted {qual}: breaks "
+                    "fusion with a host round-trip per tick"
+                )
+            if isinstance(fn, ast.Attribute):
+                root = _root_of_attr(fn)
+                if root in ("np", "numpy"):
+                    return (
+                        f"host numpy call {root}.{t}() inside jitted "
+                        f"{qual}: implicit transfer/trace-time constant"
+                    )
+                if root in ("time", "datetime"):
+                    return (
+                        f"{root}.{t}() inside jitted {qual}: wall-clock "
+                        "reads freeze at trace time"
+                    )
+                if root == "random":
+                    return (
+                        f"random.{t}() inside jitted {qual}: host RNG "
+                        "freezes at trace time (use jax.random)"
+                    )
+                if root in ("logging", "logger"):
+                    return f"logging call inside jitted {qual}"
+                if root == "os" and t in ("getenv", "environ"):
+                    return f"os.{t} read inside jitted {qual}"
+            return None
+
+        def walk(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # separate scope; reachable ones scan themselves
+            if isinstance(node, ast.Call):
+                msg = check_call(node)
+                if msg:
+                    yield Finding(mod.rel, node.lineno, self.name, msg)
+            if isinstance(node, ast.Subscript):
+                # os.environ["X"] without a call
+                if (
+                    isinstance(node.value, ast.Attribute)
+                    and node.value.attr == "environ"
+                    and _root_of_attr(node.value) == "os"
+                ):
+                    yield Finding(
+                        mod.rel, node.lineno, self.name,
+                        f"os.environ read inside jitted {qual}",
+                    )
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child)
+
+        for stmt in scope.node.body:
+            yield from walk(stmt)

@@ -30,6 +30,7 @@ from typing import Iterator
 import yaml
 
 from kwok_tpu.edge.kubeclient import TooLargeResourceVersion, WatchEvent
+from kwok_tpu.telemetry.errors import swallowed
 
 logger = logging.getLogger("kwok_tpu.edge.http")
 
@@ -219,7 +220,8 @@ class HttpKubeClient:
             try:
                 c.close()
             except Exception:
-                pass
+                # best-effort teardown of a possibly-dead keep-alive
+                swallowed("httpclient.pool_close")
         self._local = threading.local()
 
     def _json(self, method: str, url: str, body: dict | bytes | None = None,
@@ -249,7 +251,7 @@ class HttpKubeClient:
                 try:
                     conn.close()
                 except Exception:
-                    pass
+                    swallowed("httpclient.stale_conn_close")
                 self._local.conn = None
                 if attempt:
                     raise
@@ -356,6 +358,8 @@ class HttpKubeClient:
             with self._request("GET", self.server + "/healthz") as resp:
                 return resp.status == 200
         except Exception:
+            # probe contract: unreachable == unhealthy, but leave a trace
+            logger.debug("healthz probe failed", exc_info=True)
             return False
 
 
@@ -450,7 +454,8 @@ class _HttpWatch:
             try:
                 self._resp.close()
             except Exception:
-                pass
+                # a stopped stream may already be torn down (shutdown race)
+                swallowed("httpclient.watch_close")
 
     def native_reader(self):
         """Hand the stream to the native batched line reader (ingest.cc
@@ -510,7 +515,7 @@ class _HttpWatch:
             try:
                 self._resp.close()
             except Exception:
-                pass
+                swallowed("httpclient.watch_close")
 
     def stop(self) -> None:
         self._stopped.set()
@@ -524,4 +529,4 @@ class _HttpWatch:
             try:
                 self._resp.close()
             except Exception:
-                pass
+                swallowed("httpclient.watch_stop")

@@ -29,6 +29,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator
 
+from kwok_tpu.telemetry.errors import swallowed
 from kwok_tpu.edge.kubeclient import (
     ADDED,
     BOOKMARK,
@@ -676,7 +677,8 @@ class FakeKube:
             try:
                 w.stop()
             except Exception:
-                pass
+                # shutdown race with a client tearing the stream down
+                swallowed("mockserver.watch_stop")
 
     def delete(self, kind, namespace, name, grace_seconds: int | None = 0):
         """grace_seconds=None applies the server default: for pods,
@@ -1235,7 +1237,9 @@ class HttpFakeApiserver:
                 try:
                     server_obj._audit(self.command or "", self.path, int(code))
                 except Exception:
-                    pass
+                    # audit is best-effort; the request itself already
+                    # succeeded/failed on its own terms
+                    swallowed("mockserver.audit")
 
             def _send_json(self, obj, code=200):
                 self._send_body(json.dumps(obj, separators=(",", ":")).encode(), code)

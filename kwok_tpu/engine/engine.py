@@ -70,6 +70,8 @@ from kwok_tpu.ops.tick import (
 from kwok_tpu.ops.updates import UpdateBuffer
 from kwok_tpu.engine.rowpool import RowPool
 from kwok_tpu.telemetry import EngineTelemetry
+from kwok_tpu.telemetry.errors import swallowed
+from kwok_tpu.workers import spawn_worker
 
 logger = logging.getLogger("kwok_tpu.engine")
 
@@ -221,11 +223,13 @@ class _PumpGroup:
             p, lock = self._pumps[(start + i) % n]
             if lock.acquire(blocking=False):
                 try:
+                    # kwoklint: disable=blocking-under-lock -- this leaf lock EXISTS to serialize sends on one pump connection group; nothing else is ever taken under it
                     return p.send(reqs)
                 finally:
                     lock.release()
         p, lock = self._pumps[start]
         with lock:
+            # kwoklint: disable=blocking-under-lock -- same leaf-lock-by-design as above: the group lock serializes this send and guards nothing else
             return p.send(reqs)
 
     def send_ordered(self, batches):
@@ -235,6 +239,7 @@ class _PumpGroup:
         self._next += 1
         p, lock = self._pumps[self._next % n]
         with lock:
+            # kwoklint: disable=blocking-under-lock -- ordered strip-before-delete batches must ride ONE serialized connection group; the leaf lock is the ordering mechanism
             return [p.send(reqs) for reqs in batches]
 
     def close(self) -> None:
@@ -397,6 +402,10 @@ class ClusterEngine:
             try:
                 self._batch_parser = self._codec.EventParser()
             except Exception:
+                logger.debug(
+                    "native EventParser unavailable; per-event Python "
+                    "parse path stays active", exc_info=True,
+                )
                 self._batch_parser = None
         self._watch_rv: dict[str, int] = {}
         # per-kind watch-stream generation, bumped whenever a stream is
@@ -546,9 +555,7 @@ class ClusterEngine:
                 self._lanes.tick_loop if self._lanes is not None
                 else self._tick_loop
             )
-            t = threading.Thread(target=loop, name="kwok-tick", daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._threads.append(spawn_worker(loop, name="kwok-tick"))
         self.ready = True
 
     def _warm_scatters(self) -> None:
@@ -623,7 +630,9 @@ class ClusterEngine:
             try:
                 w.stop()
             except Exception:
-                pass
+                # expected shutdown race: the watch thread may be tearing
+                # the same handle down; counted, not silent
+                swallowed("engine.stop_watch")
         self._q.put(None)
 
         # Join order matters under sharded lanes: the tick thread's
@@ -861,9 +870,9 @@ class ClusterEngine:
                     logger.warning("watch %s failed: %s; retrying in 5s", kind, e)
                     time.sleep(5)
 
-        t = threading.Thread(target=loop, name=f"kwok-watch-{kind}", daemon=True)
-        t.start()
-        self._threads.append(t)
+        self._threads.append(
+            spawn_worker(loop, name=f"kwok-watch-{kind}")
+        )
 
     # ---------------------------------------------------------------- ingest
 
@@ -1402,11 +1411,17 @@ class ClusterEngine:
             k.cond_h[idx] = cond
         else:
             k.buffer.stage_update(idx, bits, has_del)
-        # repair path (LockPod on every event + computePatchData suppression)
+        # repair path (LockPod on every event + computePatchData
+        # suppression); the ingest-side render never enters a CNI
+        # provider — rows needing provider I/O defer the whole repair to
+        # the executor job, which re-renders and no-op-suppresses itself
         managed = bool(bits >> self.pod_bits[SEL_MANAGED] & 1)
         if managed and not has_del and k.phase_h[idx] != _PENDING:
-            rendered = self._render_pod(idx)
-            if rendered is not None and pod_status_patch_needed(status, rendered):
+            rendered, defer = self._render_pod_ingest(idx)
+            if defer or (
+                rendered is not None
+                and pod_status_patch_needed(status, rendered)
+            ):
                 self._submit(self._patch_pod_status, key, idx)
 
     @staticmethod
@@ -1549,16 +1564,25 @@ class ClusterEngine:
             cni_owned = bool(m.get("cni"))
             ip = m.get("podIP") or (pod.get("status") or {}).get("podIP")
         if cni_owned:
-            # cni.Remove on Deleted (pod_controller.go:329-343)
-            try:
-                if cni.available():
-                    cni.remove(
-                        m.get("namespace") or "default",
-                        m.get("name") or "",
-                        ((pod.get("metadata") or {}).get("uid")) or "",
-                    )
-            except Exception:
-                logger.exception("cni remove failed")
+            # cni.Remove on Deleted (pod_controller.go:329-343). The
+            # provider call does netns/network I/O, so it runs as an
+            # executor job: the delete event is applied on the ingest path
+            # — the tick thread, or under lanes a drain worker HOLDING its
+            # stage_lock — which must never block on a provider (kwoklint
+            # blocking-under-lock caught the old inline call). CNI DEL is
+            # idempotent, so the async hop is safe against replays.
+            ns_ = m.get("namespace") or "default"
+            name_ = m.get("name") or ""
+            uid_ = ((pod.get("metadata") or {}).get("uid")) or ""
+            if not self._submit(
+                self._cni_remove_job, ns_, name_, uid_, count_drop=False
+            ):
+                # executor already shut down (stop() racing a final
+                # drain): leaking the provider's netns/IP across restarts
+                # is worse than one blocking provider call on the closing
+                # ingest path — run the teardown inline, like the
+                # pre-executor code always did
+                self._cni_remove_job(ns_, name_, uid_)
         elif ip and self.ippool.contains(ip):
             # recycle pool-allocated IPs (pod_controller.go:334-337) — also
             # covers the cni-enabled-but-no-provider fallback
@@ -1566,6 +1590,16 @@ class ClusterEngine:
         if node_name and node_name in self.pods_by_node:
             self.pods_by_node[node_name].discard(key)
         k.buffer.stage_init(idx, False)
+
+    def _cni_remove_job(self, ns: str, name: str, uid: str) -> None:
+        """Executor half of the Deleted-event CNI teardown (runs inline
+        only as _pod_deleted's executor-shutdown fallback)."""
+        try:
+            if cni.available():
+                # kwoklint: disable=blocking-under-lock -- runs on the executor; the only under-lock caller is _pod_deleted's shutdown-time fallback, where leaking the provider netns across restarts is worse than one blocking call on the closing drain path
+                cni.remove(ns, name, uid)
+        except Exception:
+            logger.exception("cni remove failed")
 
     def _update_pods_on_node(self, node_name: str) -> None:
         """Re-evaluate pods bound to a node whose managed-ness changed
@@ -1945,13 +1979,22 @@ class ClusterEngine:
 
     # ------------------------------------------------------------------ emit
 
-    def _submit(self, fn, *args) -> None:
+    def _submit(self, fn, *args, count_drop: bool = True) -> bool:
+        """Run fn on the patch executor (inline in synchronous mode).
+        Returns False only when the executor is already shut down —
+        callers with must-run teardown work (CNI remove) pass
+        count_drop=False and fall back inline, so the job is neither
+        dropped nor counted as such (kwok_dropped_jobs_total means
+        'rejected AND not run')."""
         if self._executor is None:
             fn(*args)  # synchronous mode (tests may call tick_once directly)
-            return
+            return True
         try:
             self._executor.submit(self._safe, fn, *args)
+            return True
         except RuntimeError:
+            if not count_drop:
+                return False
             # executor shut down while a tick was still in flight — we
             # are stopping; jobs are dropped, but never silently. One
             # warning + a count (also exported as kwok_dropped_jobs_total;
@@ -1965,6 +2008,7 @@ class ClusterEngine:
                     "total reported at stop",
                     getattr(fn, "__name__", fn), args[:1],
                 )
+            return False
 
     def _safe(self, fn, *args) -> None:
         try:
@@ -1993,6 +2037,7 @@ class ClusterEngine:
         extra = f"Authorization: Bearer {token}\r\n" if token else ""
         try:
             self._pump = _PumpGroup([
+                # kwoklint: disable=blocking-under-lock -- construction is memoized via _pump_tried: lane emit workers (the only under-lock callers) are primed by LaneSet.prepare before any worker starts; all other callers run on the lock-free tick thread or executor
                 self._codec.Pump(
                     host, int(port), nconn=self._pump_nconn,
                     header_extra=extra,
@@ -2298,7 +2343,9 @@ class ClusterEngine:
         )
         self._inc("heartbeats_total")
 
-    def _render_pod(self, idx: int):
+    def _render_pod_pre(self, idx: int):
+        """Shared render preamble: the row's meta dict + target phase
+        name, or None when the row has no object or is Gone."""
         k = self.pods
         m = k.pool.meta[idx]
         if not m or self._pod_obj(m) is None:
@@ -2306,6 +2353,30 @@ class ClusterEngine:
         phase_name = self._pod_phases[int(k.phase_h[idx])]
         if phase_name == "Gone":
             return None
+        return m, phase_name
+
+    def _pool_ip(self, m: dict, idx: int) -> "str | None":
+        """Pool-backed IP lookup/allocate — pure bookkeeping under
+        _alloc_lock, never provider I/O, so it is ingest-path safe.
+        None when the row vanished since the caller looked it up."""
+        with self._alloc_lock:  # check+allocate atomic across workers
+            ip = m.get("podIP")
+            if not ip:
+                if self.pods.pool.meta[idx] is not m:
+                    return None  # row deleted since this job was queued
+                ip = self.ippool.get()
+                m["podIP"] = ip
+        return ip
+
+    def _render_pod(self, idx: int):
+        """Full render for executor workers: may enter the CNI provider
+        (netns/network I/O). Never call on the ingest path — the tick
+        thread, or a lane drain worker holding its stage_lock — which
+        uses _render_pod_ingest instead."""
+        pre = self._render_pod_pre(idx)
+        if pre is None:
+            return None
+        m, phase_name = pre
         ip = m.get("podIP")
         if not ip and self.config.enable_cni and cni.available():
             # real-CNI path (configurePod's cni.Setup branch,
@@ -2315,16 +2386,37 @@ class ClusterEngine:
             if row_gone or (ip is None and m.get("cni_pending")):
                 return None  # deleted mid-setup / another worker mid-setup
         if not ip:
-            with self._alloc_lock:  # check+allocate atomic across workers
-                ip = m.get("podIP")
-                if not ip:
-                    if k.pool.meta[idx] is not m:
-                        return None  # row deleted since this job was queued
-                    ip = self.ippool.get()
-                    m["podIP"] = ip
+            ip = self._pool_ip(m, idx)
+            if ip is None:
+                return None
         return render_pod_status(
-            m["obj"], phase_name, int(k.cond_h[idx]), self.config.node_ip, ip
+            m["obj"], phase_name, int(self.pods.cond_h[idx]),
+            self.config.node_ip, ip,
         )
+
+    def _render_pod_ingest(self, idx: int):
+        """Ingest-path render: NEVER enters the CNI provider, so it is
+        safe on the tick thread and under a lane's stage_lock (kwoklint
+        blocking-under-lock caught the old single _render_pod doing
+        provider I/O from the drain path). Returns (rendered, defer):
+        defer=True means provider I/O is required — the caller submits
+        the work to an executor job instead (_patch_pod_status re-renders
+        with the full path and suppresses no-ops itself)."""
+        pre = self._render_pod_pre(idx)
+        if pre is None:
+            return None, False
+        m, phase_name = pre
+        ip = m.get("podIP")
+        if not ip:
+            if self.config.enable_cni and cni.available():
+                return None, True
+            ip = self._pool_ip(m, idx)
+            if ip is None:
+                return None, False
+        return render_pod_status(
+            m["obj"], phase_name, int(self.pods.cond_h[idx]),
+            self.config.node_ip, ip,
+        ), False
 
     def _cni_allocate(self, m: dict, idx: int) -> tuple[str | None, bool]:
         """Allocate a pod IP through the CNI provider.

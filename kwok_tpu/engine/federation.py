@@ -291,10 +291,9 @@ class FederatedEngine:
         # tunneled device must not block on jit compilation mid-burst
         self._warm_scatters()
         self._warm_ticks()
-        self._thread = threading.Thread(
-            target=self._tick_loop, name="kwok-fed-tick", daemon=True
-        )
-        self._thread.start()
+        from kwok_tpu.workers import spawn_worker
+
+        self._thread = spawn_worker(self._tick_loop, name="kwok-fed-tick")
         self.ready = True
 
     def _warm_scatters(self) -> None:

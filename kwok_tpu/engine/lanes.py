@@ -54,6 +54,8 @@ import numpy as np
 
 from kwok_tpu import profiling
 from kwok_tpu.edge.render import now_rfc3339
+from kwok_tpu.telemetry.errors import swallowed
+from kwok_tpu.workers import spawn_worker
 from kwok_tpu.engine.engine import ClusterEngine
 from kwok_tpu.engine.rowpool import shard_of
 from kwok_tpu.ops.state import RowState, new_row_state
@@ -300,6 +302,13 @@ class LaneSet:
             e._executor = executor
             e._running = True
             e._record_needs_full_path = self.parent._record_needs_full_path
+            # Prime the native pump NOW, outside every lock: the emit
+            # worker runs _process_emit under the lane's stage_lock, and
+            # lazy construction there opened this lane's whole TCP
+            # connection group while the drain worker queued on the lock
+            # (kwoklint blocking-under-lock caught it; regression:
+            # tests/test_lanes.py::test_pump_primed_before_workers).
+            e._get_pump()
         self._ensure_stacked()
         self._warm_scatters()
         self._warm_tick()
@@ -356,19 +365,13 @@ class LaneSet:
     def start_workers(self, threads: list) -> None:
         """Spawn the router + per-lane drain/emit workers (the tick loop
         itself is started by ClusterEngine.start as 'kwok-tick')."""
-        t = threading.Thread(
-            target=self.route_loop, name="kwok-route", daemon=True
-        )
-        t.start()
-        threads.append(t)
+        threads.append(spawn_worker(self.route_loop, name="kwok-route"))
         for lane in self.lanes:
             for target, name in (
                 (lane.drain_loop, f"kwok-lane{lane.index}"),
                 (lane.emit_loop, f"kwok-emit{lane.index}"),
             ):
-                t = threading.Thread(target=target, name=name, daemon=True)
-                t.start()
-                threads.append(t)
+                threads.append(spawn_worker(target, name=name))
 
     def close(self) -> None:
         """Release lane-owned pump connection groups (the shared client
@@ -470,6 +473,10 @@ class LaneSet:
                         .get("metadata") or {}
                     )
                 except Exception:
+                    # unrouteable event dropped — same information loss as
+                    # the single-lane parse fallback, but COUNTED so a
+                    # flood of these shows up on /metrics
+                    swallowed("lanes.unrouteable_event")
                     return None
                 name = meta.get("name") or ""
                 ns = meta.get("namespace") or "default"

@@ -31,6 +31,7 @@ import time
 from urllib.parse import quote as _q
 
 from kwok_tpu.edge.httpclient import HttpKubeClient
+from kwok_tpu.telemetry.errors import swallowed
 from kwok_tpu.edge.merge import strategic_merge
 from kwok_tpu.edge.render import parse_rfc3339
 
@@ -524,7 +525,7 @@ def _get_watch(args, client, kind, ns, name, start_rv=None) -> int:
             try:
                 w.stop()
             except Exception:
-                pass
+                swallowed("kubectl.watch_stop")
 
 
 def _kv_block(d: dict | None) -> str:
@@ -722,7 +723,10 @@ def _describe(args, client) -> int:
     # hundreds of pods must not re-list the events store per pod)
     try:
         all_events = client.list("events")
-    except Exception:
+    except Exception as e:
+        # real kubectl degrades the same way (describe without events);
+        # say so instead of silently showing "<none>"
+        print(f"warning: could not list events: {e}", file=sys.stderr)
         all_events = []
     blocks: list[str] = []
     rc = 0

@@ -42,6 +42,17 @@ _METRIC_HELP = {
 }
 
 
+def _errors_block() -> str:
+    """Error-accounting families (swallowed-exception and worker-crash
+    counters, telemetry/errors.py): process-global state no engine
+    registry owns. Labeled samples, so appended ONLY to the labeled
+    (registry) exposition path — the legacy flat path stays label-free
+    by contract (its strict grammar oracle has no label parser)."""
+    from kwok_tpu.telemetry import errors as telemetry_errors
+
+    return telemetry_errors.render_nonempty()
+
+
 def _process_block() -> str:
     """Standard process collector subset (user+sys CPU of this process),
     appended to both exposition paths."""
@@ -69,7 +80,7 @@ def render_metrics(metrics) -> str:
     output also passes the strict-parser oracle."""
     text_fn = getattr(metrics, "metrics_text", None)
     if callable(text_fn):
-        return text_fn() + _process_block()
+        return text_fn() + _errors_block() + _process_block()
     metrics = dict(getattr(metrics, "metrics", metrics))
     lines = []
     for name, value in sorted(metrics.items()):
@@ -134,10 +145,11 @@ class EngineServer:
         return Handler
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, name="kwok-http", daemon=True
+        from kwok_tpu.workers import spawn_worker
+
+        self._thread = spawn_worker(
+            self.httpd.serve_forever, name="kwok-http"
         )
-        self._thread.start()
 
     def stop(self) -> None:
         self.httpd.shutdown()

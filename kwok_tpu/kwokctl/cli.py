@@ -149,8 +149,11 @@ def cmd_create(args) -> int:
         rt = runtime_registry.load(name, workdir)
         try:
             rt.down()
-        except Exception:
-            pass
+        except Exception as e:
+            print(
+                f"warning: teardown of existing cluster failed ({e}); "
+                "reinstalling anyway", file=sys.stderr,
+            )
     rt = runtime_registry.get(opts.runtime, name, workdir)
     conf = KwokctlConfiguration(options=opts, name=name)
     rt.set_config(conf)
@@ -177,8 +180,11 @@ def cmd_delete(args) -> int:
     rt = _loaded(args)
     try:
         rt.down()
-    except Exception:
-        pass
+    except Exception as e:
+        print(
+            f"warning: cluster teardown failed ({e}); uninstalling anyway",
+            file=sys.stderr,
+        )
     rt.uninstall()
     print(f"Cluster {args.name!r} deleted", file=sys.stderr)
     return 0

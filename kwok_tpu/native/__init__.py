@@ -403,6 +403,7 @@ class WatchReader:
     def __del__(self):  # daemon-thread cleanup safety net
         try:
             self.close()
+        # kwoklint: disable=silent-except -- __del__ can run at interpreter shutdown where logging/imports are unsafe; a failed close only leaks an already-dying fd
         except Exception:
             pass
 
@@ -604,6 +605,7 @@ class Pump:
     def __del__(self):
         try:
             self.close()
+        # kwoklint: disable=silent-except -- __del__ can run at interpreter shutdown where logging/imports are unsafe; a failed close only leaks an already-dying fd
         except Exception:
             pass
 

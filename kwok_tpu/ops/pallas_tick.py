@@ -361,6 +361,7 @@ class PallasTickKernel:
             from jax.experimental.pallas import tpu as pltpu
 
             smem = pltpu.SMEM
+        # kwoklint: disable=silent-except -- backend-dependent import probe: pltpu is absent or broken on cpu-only installs and smem=None falls back to the default memory space
         except Exception:  # pragma: no cover - cpu-only installs
             smem = None
 

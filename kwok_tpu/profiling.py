@@ -54,9 +54,9 @@ class Sampler:
         self._thread: threading.Thread | None = None
 
     def start(self) -> "Sampler":
-        t = threading.Thread(target=self._run, name="kwok-sampler", daemon=True)
-        t.start()
-        self._thread = t
+        from kwok_tpu.workers import spawn_worker
+
+        self._thread = spawn_worker(self._run, name="kwok-sampler")
         return self
 
     def _run(self) -> None:

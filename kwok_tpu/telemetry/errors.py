@@ -1,0 +1,61 @@
+"""Process-wide error accounting: swallowed exceptions + worker crashes.
+
+The exception-hygiene rule (kwoklint ``silent-except``) bans broad
+handlers that just ``pass``: a swallow must either log or bump
+``kwok_swallowed_errors_total{site=...}`` here. The sites live in modules
+with no engine handle (HTTP-client teardown, watch-stream cleanup, the
+mock server's audit ring), so the counters ride a process-global registry
+that the HTTP server appends to every ``/metrics`` render — the same way
+it appends the process CPU collector.
+
+Reading the series: most sites only move during shutdown (connection
+teardown racing reader threads). A series climbing during steady state is
+a bug report with the site name attached.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kwok_tpu.telemetry.registry import MetricsRegistry
+
+logger = logging.getLogger("kwok_tpu.errors")
+
+PROCESS_REGISTRY = MetricsRegistry()
+
+_swallowed = PROCESS_REGISTRY.counter(
+    "kwok_swallowed_errors_total",
+    "Deliberately swallowed exceptions by site (shutdown races, "
+    "best-effort cleanup); climbing outside shutdown means a bug",
+    ("site",),
+)
+_crashes = PROCESS_REGISTRY.counter(
+    "kwok_worker_crashes_total",
+    "Uncaught exceptions that killed a spawned worker thread",
+    ("thread",),
+)
+
+
+def swallowed(site: str) -> None:
+    """Record a deliberately swallowed exception. Call from inside an
+    ``except`` block: the active exception lands in the debug log with a
+    traceback, and the site's counter moves so /metrics shows it."""
+    _swallowed.labels(site=site).inc()
+    logger.debug("swallowed error at %s", site, exc_info=True)
+
+
+def swallowed_total(site: str) -> int:
+    """Test/diagnostic read of one site's counter."""
+    return _swallowed.labels(site=site).value
+
+
+def worker_crashed(thread_name: str) -> None:
+    """Account an uncaught exception escaping a spawn_worker thread."""
+    _crashes.labels(thread=thread_name).inc()
+
+
+def render_nonempty() -> str:
+    """Exposition text of the process registry, or "" when no counter has
+    moved yet (labeled families with no children render no series)."""
+    text = PROCESS_REGISTRY.render()
+    return "" if not text.strip() else text

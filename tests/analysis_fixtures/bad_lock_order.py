@@ -1,0 +1,71 @@
+"""kwoklint fixture: deliberate lock-discipline violations.
+
+Never imported — parsed by tests/test_analysis.py, which asserts that the
+analyzer reports EXACTLY the lines carrying an `# F: <rule>` marker (plus
+the one deliberately bare suppression). Keep markers on the line the
+finding lands on: direct blocking calls flag their own line; transitive
+findings flag the `with` that holds the lock.
+"""
+
+import threading
+import time
+
+
+class Engine:
+    def __init__(self):
+        self.stage_lock = threading.RLock()
+        self._alloc_lock = threading.Lock()
+        self._gen_lock = threading.Lock()
+        self._dead_lock = threading.Lock()  # F: unused-lock
+        self.q = None
+        self.t = None
+
+    def inverted(self):
+        with self._alloc_lock:
+            with self.stage_lock:  # F: lock-order
+                pass
+
+    def same_level(self):
+        with self._lock:
+            with self._apiserver_lock:  # F: lock-order
+                pass
+
+    def re_lock(self):
+        with self._alloc_lock:
+            with self._alloc_lock:  # F: lock-order
+                pass
+
+    def re_rlock_ok(self):
+        with self.stage_lock:
+            with self.stage_lock:  # RLock re-entry: no finding
+                pass
+
+    def blocks(self):
+        with self._alloc_lock:
+            self.t.join()  # F: blocking-under-lock
+            self.q.get(timeout=1.0)  # F: blocking-under-lock
+
+    def transitive_block(self):
+        with self.stage_lock:  # F: blocking-under-lock
+            self.helper()
+
+    def helper(self):
+        time.sleep(1)
+
+    def transitive_order(self):
+        with self._gen_lock:  # F: lock-order
+            self.take_alloc()
+
+    def take_alloc(self):
+        with self._alloc_lock:
+            pass
+
+    def suppressed_ok(self):
+        with self._alloc_lock:
+            # kwoklint: disable=blocking-under-lock -- fixture: a justified suppression is honored
+            self.t.join()
+
+    def suppressed_bare(self):
+        with self._alloc_lock:
+            # kwoklint: disable=blocking-under-lock
+            self.t.join()

@@ -1,0 +1,40 @@
+"""kwoklint fixture: kernel-purity violations (never imported; jax need
+not be installed to analyze this — the rule is pure AST)."""
+
+import time
+
+import functools
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def tick(state):
+    now = time.time()  # F: kernel-purity
+    host = np.asarray(state)  # F: kernel-purity
+    return helper(state) + now + host
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def tick_donating(state):
+    print("debug", state)  # F: kernel-purity
+    return state
+
+
+def helper(state):
+    return state.item()  # F: kernel-purity
+
+
+def launch(state):
+    return jax.jit(inner)(state)
+
+
+def inner(state):
+    seed = np.random.randint(7)  # F: kernel-purity
+    return state + seed
+
+
+def host_side_is_fine(state):
+    # NOT reachable from any jit root: host numpy here is legal
+    return np.asarray(state)

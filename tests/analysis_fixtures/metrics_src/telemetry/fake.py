@@ -1,0 +1,9 @@
+"""kwoklint fixture: metric registrations for the metrics-doc rule
+(never imported; the doc side lives in ../../metrics_doc.md)."""
+
+
+def register(r):
+    r.counter("kwok_documented_total", "in both code and doc", ("kind",))
+    r.counter("kwok_undocumented_total", "registered, missing from doc")
+    r.gauge("kwok_mislabeled_thing", "first label set", ("a", "b"))
+    r.gauge("kwok_mislabeled_thing", "second label set", ("a",))

@@ -1,0 +1,69 @@
+"""kwoklint fixture: exception-hygiene violations (never imported)."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def risky():
+    raise RuntimeError("boom")
+
+
+def swallow_pass():
+    try:
+        risky()
+    except Exception:  # F: silent-except
+        pass
+
+
+def swallow_assign():
+    out = None
+    try:
+        risky()
+    except Exception:  # F: silent-except
+        out = 0
+    return out
+
+
+def swallow_bare():
+    try:
+        risky()
+    except:  # noqa: E722  # F: silent-except
+        pass
+
+
+def ok_logged():
+    try:
+        risky()
+    except Exception:
+        logger.warning("boom", exc_info=True)
+
+
+def ok_narrow():
+    try:
+        risky()
+    except ValueError:
+        pass
+
+
+def ok_reraise():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def ok_suppressed():
+    try:
+        risky()
+    # kwoklint: disable=silent-except -- fixture: a justified allowlist entry for an expected shutdown race
+    except Exception:
+        pass
+
+
+def stale_suppression():
+    try:
+        risky()
+    # kwoklint: disable=silent-except -- fixture: stale, the handler is narrow  # F: unused-suppression
+    except ValueError:
+        pass

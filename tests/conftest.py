@@ -9,8 +9,30 @@ guard lives in kwok_tpu.hostcpu (shared with __graft_entry__.dryrun_multichip).
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from kwok_tpu.hostcpu import force_cpu_devices
 
 force_cpu_devices(8)
+
+
+@pytest.fixture(autouse=True)
+def lock_order_witness():
+    """Runtime lock-order witness (analysis/witness.py): with
+    KWOK_TPU_LOCK_WITNESS=1 (set by `make lane-check`), every lock the
+    test creates is instrumented; acquisition-order cycles and
+    declared-order violations fail the test with both stacks. Off by
+    default — instrumentation adds a stack capture per acquisition."""
+    if os.environ.get("KWOK_TPU_LOCK_WITNESS") != "1":
+        yield
+        return
+    from kwok_tpu.analysis.witness import LockWitness
+
+    w = LockWitness.install()
+    try:
+        yield
+    finally:
+        LockWitness.uninstall()
+        w.assert_clean()

@@ -463,3 +463,102 @@ def test_readyz_gates_on_engine_warmup():
         assert status("/readyz") == 200
     finally:
         server.stop()
+
+
+def test_ingest_path_never_enters_cni_provider(monkeypatch):
+    """Regression (kwoklint blocking-under-lock): with a live CNI
+    provider, the ingest path used to call cni.setup (repair render) and
+    cni.remove (pod Deleted) inline — netns/network I/O on the tick
+    thread, and under a lane's stage_lock when sharded. Both now defer to
+    executor jobs: _render_pod_ingest reports defer=True instead of
+    allocating, and _pod_deleted submits _cni_remove_job."""
+    from kwok_tpu import cni
+
+    provider_calls = []
+    monkeypatch.setattr(cni, "available", lambda: True)
+    monkeypatch.setattr(
+        cni, "setup",
+        lambda ns, name, uid: provider_calls.append(("setup", name))
+        or ["10.0.0.99"],
+    )
+    monkeypatch.setattr(
+        cni, "remove",
+        lambda ns, name, uid: provider_calls.append(("remove", name)),
+    )
+
+    server = FakeKube()
+    eng = SyncEngine(
+        server, EngineConfig(manage_all_nodes=True, enable_cni=True)
+    )
+    server.create("nodes", make_node("cn0"))
+    server.create("pods", make_pod("cp0", node="cn0"))
+    eng.feed_all(server)
+    eng.pump(2)  # Pending -> Running (worker path ran inline: no executor)
+    idx = eng.pods.pool.lookup(("default", "cp0"))
+    assert idx is not None
+
+    # from here on, capture submissions instead of running them inline —
+    # exactly what the threaded engine's executor does (True = accepted;
+    # False would trigger _pod_deleted's shutdown-time inline fallback)
+    submitted = []
+
+    def fake_submit(fn, *a, count_drop=True):
+        submitted.append((fn.__name__, a))
+        return True
+
+    monkeypatch.setattr(eng, "_submit", fake_submit)
+
+    # repair path: a revert-to-known MODIFIED on a transitioned row whose
+    # IP is not yet allocated must DEFER, not enter the provider
+    eng.pods.pool.meta[idx].pop("podIP", None)
+    eng.pods.pool.meta[idx].pop("cni", None)
+    provider_calls.clear()
+    obj = server.get("pods", "default", "cp0")
+    eng._ingest("pods", "MODIFIED", {**obj, "status": {"phase": "Pending"}})
+    assert not provider_calls, provider_calls
+    assert ("_patch_pod_status", (("default", "cp0"), idx)) in submitted
+
+    # delete path: CNI teardown rides an executor job, never inline
+    eng.pods.pool.meta[idx]["cni"] = True
+    eng._ingest("pods", "DELETED", server.get("pods", "default", "cp0"))
+    assert not provider_calls, provider_calls
+    assert any(fn == "_cni_remove_job" for fn, _ in submitted)
+
+
+def test_cni_teardown_survives_executor_shutdown(monkeypatch):
+    """Follow-up to the executor-hop fix: a DELETED event applied while
+    the executor is already shut down (stop() racing a final drain) must
+    still run the provider teardown — inline, like the pre-executor code
+    — instead of dropping it and leaking the netns/IP across restarts."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from kwok_tpu import cni
+
+    removed = []
+    monkeypatch.setattr(cni, "available", lambda: True)
+    monkeypatch.setattr(
+        cni, "setup", lambda ns, name, uid: ["10.0.0.77"]
+    )
+    monkeypatch.setattr(
+        cni, "remove", lambda ns, name, uid: removed.append(name)
+    )
+
+    server = FakeKube()
+    eng = SyncEngine(
+        server, EngineConfig(manage_all_nodes=True, enable_cni=True)
+    )
+    server.create("nodes", make_node("sn0"))
+    server.create("pods", make_pod("sp0", node="sn0"))
+    eng.feed_all(server)
+    eng.pump(2)
+    idx = eng.pods.pool.lookup(("default", "sp0"))
+    assert idx is not None
+    eng.pods.pool.meta[idx]["cni"] = True
+
+    eng._executor = ThreadPoolExecutor(max_workers=1)
+    eng._executor.shutdown(wait=True)  # simulate stop() racing the drain
+    eng._ingest("pods", "DELETED", server.get("pods", "default", "sp0"))
+    assert removed == ["sp0"]
+    # the job RAN (inline), so it must not be counted as dropped —
+    # kwok_dropped_jobs_total means rejected AND not run
+    assert eng.metrics["dropped_jobs_total"] == 0

@@ -388,3 +388,25 @@ def test_lane_exposition_is_strict():
         for _name, labels, _v in fams["kwok_lane_stage_seconds"]["samples"]
     }
     assert shards == {"0", "1"}
+
+
+def test_pump_primed_before_workers():
+    """Regression (kwoklint blocking-under-lock): lazy native-pump
+    construction used to run inside _process_emit UNDER the lane's
+    stage_lock — the first emit opened the lane's whole TCP connection
+    group while the drain worker queued on the lock. LaneSet.prepare now
+    makes the construction decision per lane before a single worker
+    thread exists, so the memoized _get_pump under the lock is a pure
+    attribute read."""
+    server = FakeKube()
+    eng = ClusterEngine(
+        server,
+        EngineConfig(manage_all_nodes=True, drain_shards=2,
+                     tick_interval=0.02),
+    )
+    assert all(not lane.engine._pump_tried for lane in eng._lanes.lanes)
+    eng.start()
+    try:
+        assert all(lane.engine._pump_tried for lane in eng._lanes.lanes)
+    finally:
+        eng.stop()
