@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -171,16 +172,32 @@ def _lane_cost_model() -> "dict | None":
         doc.get("watch") or {},
         members=4,
         contention=(doc.get("contention") or {}).get("factor", 1.0),
-        drain_shards=0,  # auto: an N-core host runs min(8, N) lanes
+        drain_shards=0,  # auto (config.types.auto_drain_shards)
     )
     return {
         "source": os.path.basename(paths[-1]),
-        "drain_shards": "auto (min(8, cores))",
+        "drain_shards": "auto (config.types.auto_drain_shards)",
         "predicted_pods_per_s_by_cores":
             lm["predicted_pods_per_s_by_cores"],
         "predicted_pods_per_s_by_cores_single_lane":
             lm["predicted_pods_per_s_by_cores_single_lane"],
     }
+
+
+def _router_micro_rider() -> "dict | None":
+    """Python-vs-native router cost (benchmarks/route_micro.py) embedded
+    in every BENCH json — the perf trajectory of the serial router term
+    stays machine-readable next to the device headline. Host-only and
+    small (a few hundred ms); never touches the device."""
+    try:
+        from benchmarks.route_micro import run as route_run
+
+        return route_run(events=20000, shards=8, windows=2)
+    except Exception as e:
+        # the rider must never sink the device bench, but a silent None
+        # would hide a broken microbench across rounds — carry the reason
+        print(f"router_micro rider failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
 
 
 def _best_of_windows(tick, consume, per_window: int, n_windows: int = 3) -> float:
@@ -500,6 +517,7 @@ def pallas_main() -> None:
             "note": "same definitions as the XLA headline run",
         },
         "cost_model": _lane_cost_model(),
+        "router_micro": _router_micro_rider(),
         "metrics_snapshot": _metrics_snapshot(),
     }))
 
@@ -598,10 +616,78 @@ def main() -> None:
                 # host-lane model rider: the device headline next to the
                 # predicted host ceiling it feeds (sliced-lane split)
                 "cost_model": _lane_cost_model(),
+                # router trajectory rider: python vs native partitioning
+                "router_micro": _router_micro_rider(),
                 "metrics_snapshot": _metrics_snapshot(),
             }
         )
     )
+
+
+# one verdict per process: bench modes that probe more than once (e.g. a
+# fallback re-exec decision after --mesh-device already probed) must not
+# burn another full retry window re-discovering a dead tunnel
+_PROBE_VERDICT: "bool | None" = None
+
+
+def _pool_endpoints() -> "list[tuple[str, int]]":
+    """TCP endpoints implied by PALLAS_AXON_POOL_IPS: `host[:port]` items,
+    comma/space separated; the port defaults to KWOK_TPU_DEVICE_PROBE_PORT
+    (8471, the TPU runtime's gRPC port)."""
+    raw = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    try:
+        default_port = int(
+            os.environ.get("KWOK_TPU_DEVICE_PROBE_PORT", "8471")
+        )
+    except ValueError:
+        # a typo'd env var must not kill the bench before its JSON line;
+        # per-item ports already degrade the same way below
+        default_port = 8471
+    out = []
+    for item in raw.replace(",", " ").split():
+        if item.startswith("["):
+            # bracketed IPv6: [addr] or [addr]:port
+            host, _, rest = item[1:].partition("]")
+            port = rest[1:] if rest.startswith(":") else ""
+        elif item.count(":") > 1:
+            # bare IPv6 literal: every colon belongs to the address
+            host, port = item, ""
+        else:
+            host, _, port = item.partition(":")
+        if not host:
+            continue
+        try:
+            out.append((host, int(port) if port else default_port))
+        except ValueError:
+            out.append((host, default_port))
+    return out
+
+
+def _relay_tcp_down(log) -> bool:
+    """Fast pre-check: when every pool endpoint refuses/timeouts a plain
+    TCP connect in a few seconds, the relay is down NOW and the expensive
+    subprocess probes (3 x 120s of a hung jax.devices()) are pointless —
+    the BENCH_r05 tail burned 6 minutes discovering exactly this. Returns
+    True only on a definite all-endpoints-dead signal; an empty/unparsable
+    pool var or any successful connect defers to the real probe."""
+    import socket
+
+    endpoints = _pool_endpoints()
+    if not endpoints:
+        return False
+    # one ~3s budget shared across the pool (a black-holed SYN otherwise
+    # costs 3s PER endpoint and a wide pool re-inflates the very wait
+    # this pre-check exists to avoid); each later endpoint still gets a
+    # small floor so a healthy relay behind a dead first entry is found
+    deadline = time.monotonic() + 3.0
+    for host, port in endpoints:
+        try:
+            timeout = max(0.25, deadline - time.monotonic())
+            with socket.create_connection((host, port), timeout=timeout):
+                return False  # something is listening: probe for real
+        except OSError as e:
+            log(f"tcp pre-check {host}:{port}: {e}")
+    return True
 
 
 def _device_reachable(
@@ -611,24 +697,48 @@ def _device_reachable(
     indefinitely when the relay is down, and a benchmark that never prints
     its JSON line is worse than an honestly-labeled CPU number.
 
-    The probe is retried (default 3 x 60s, overridable via
-    KWOK_BENCH_PROBE_RETRIES / KWOK_BENCH_PROBE_TIMEOUT) with a pause
-    between attempts: tunnel outages observed so far are transient relay
-    restarts, and a single failed probe must not demote a TPU round to a
-    CPU number. Every attempt is logged to stderr with its outcome, so a
-    CPU-fallback artifact carries the proof that the tunnel was down for
-    the whole retry window, not just one probe."""
+    Three layers keep a dead tunnel from eating the bench budget (the
+    BENCH_r05 tail paid 3 x 120s before falling back):
+    - every attempt but the LAST starts with a ~3s TCP reachability
+      pre-check against the pool endpoints; a refused relay skips that
+      attempt's expensive subprocess probe but NOT the retry loop —
+      transient relay restarts (the outage mode observed so far) still
+      get the full retry window at ~18s per dead early attempt, while
+      the final attempt always runs the real jax.devices() probe so a
+      runtime that doesn't answer plain TCP on the assumed port can
+      never be demoted to CPU by the shortcut alone,
+    - the per-attempt timeout honors KWOK_TPU_DEVICE_PROBE_TIMEOUT
+      (KWOK_BENCH_PROBE_TIMEOUT kept as the legacy alias),
+    - the verdict is cached AFTER the retry loop concludes, so later
+      probes in the same invocation return instantly.
+    Every attempt is logged to stderr with its outcome, so a CPU-fallback
+    artifact carries the proof that the tunnel was down for the whole
+    retry window, not just one probe."""
     import subprocess
     import sys
     import time as _time
 
+    global _PROBE_VERDICT
     if timeout_s is None:
         # 120s per attempt, matching the old single-probe budget: a healthy
         # tunnel can legitimately take >60s to initialize, and a shorter
         # per-attempt timeout would wrongly demote such runs to CPU
-        timeout_s = float(os.environ.get("KWOK_BENCH_PROBE_TIMEOUT", "120"))
+        try:
+            timeout_s = float(
+                os.environ.get("KWOK_TPU_DEVICE_PROBE_TIMEOUT")
+                or os.environ.get("KWOK_BENCH_PROBE_TIMEOUT")
+                or "120"
+            )
+        except ValueError:
+            # a typo'd env var must not kill the bench before its JSON line
+            print("ignoring non-numeric device-probe timeout env var",
+                  file=sys.stderr)
+            timeout_s = 120.0
     if retries is None:
-        retries = int(os.environ.get("KWOK_BENCH_PROBE_RETRIES", "3"))
+        try:
+            retries = int(os.environ.get("KWOK_BENCH_PROBE_RETRIES", "3"))
+        except ValueError:
+            retries = 3
     retries = max(1, retries)  # 0/negative would skip probing entirely and
     # wrongly demote a healthy TPU run to CPU
 
@@ -641,28 +751,44 @@ def _device_reachable(
         and not os.environ.get("PALLAS_AXON_POOL_IPS")
     ):
         return True
+    if _PROBE_VERDICT is not None:
+        return _PROBE_VERDICT
+
+    def log(msg: str) -> None:
+        print(msg, file=sys.stderr, flush=True)
+
     for attempt in range(1, retries + 1):
         t0 = _time.time()
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; jax.devices(); print('ok')"],
-                timeout=timeout_s, capture_output=True,
-            )
-            ok = proc.returncode == 0 and b"ok" in proc.stdout
-            outcome = "ok" if ok else f"rc={proc.returncode}"
-        except subprocess.TimeoutExpired:
+        if attempt < retries and _relay_tcp_down(log):
+            # the pre-check only short-circuits EARLIER attempts: the
+            # last one always runs the real jax.devices() probe, so a
+            # runtime that doesn't answer plain TCP on the assumed port
+            # (non-default port, gRPC-only intermediary) can never be
+            # demoted to CPU by the shortcut alone
             ok = False
-            outcome = f"timeout after {timeout_s:.0f}s"
-        print(
+            outcome = "pool endpoints refuse TCP (relay down)"
+        else:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; jax.devices(); print('ok')"],
+                    timeout=timeout_s, capture_output=True,
+                )
+                ok = proc.returncode == 0 and b"ok" in proc.stdout
+                outcome = "ok" if ok else f"rc={proc.returncode}"
+            except subprocess.TimeoutExpired:
+                ok = False
+                outcome = f"timeout after {timeout_s:.0f}s"
+        log(
             f"device probe attempt {attempt}/{retries}: {outcome} "
-            f"({_time.time() - t0:.1f}s)",
-            file=sys.stderr, flush=True,
+            f"({_time.time() - t0:.1f}s)"
         )
         if ok:
+            _PROBE_VERDICT = True
             return True
         if attempt < retries:
             _time.sleep(15.0)
+    _PROBE_VERDICT = False
     return False
 
 

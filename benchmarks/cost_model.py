@@ -80,7 +80,7 @@ def engine_costs(n: int, trials: int) -> dict:
     lines = [_pod_line(i) for i in range(n)]
     m_lines = [_pod_line(i, "MODIFIED", 300000) for i in range(n)]
 
-    surv, echo, emit, parse = [], [], [], []
+    surv, echo, emit, parse, route = [], [], [], [], []
     for _ in range(trials):
         eng = ClusterEngine(FakeKube(), EngineConfig(
             manage_all_nodes=True, initial_capacity=n + 128))
@@ -92,6 +92,24 @@ def engine_costs(n: int, trials: int) -> dict:
         batch = eng._batch_parser.parse_raw_batch(lines)
         parse.append(1e6 * (time.perf_counter() - t0) / n)
         del batch
+        # the ROUTER's serial term under native pre-partitioned routing:
+        # one C parse+partition call + the per-lane sub-batch handoff
+        # (engine/lanes.py route_batch) — everything the router thread
+        # pays per window; the lanes pay record materialization in
+        # parallel, which survivor/echo below charge to the lane term
+        import queue as _q
+
+        from kwok_tpu.engine.lanes import iter_recb_items
+
+        sinks = [_q.SimpleQueue() for _ in range(8)]
+        t0 = time.perf_counter()
+        b = eng._batch_parser.parse_raw_batch(lines, kind="pods",
+                                              n_shards=8)
+        tmono = time.monotonic()
+        for li, _count, item in iter_recb_items("pods", b, tmono):
+            sinks[li].put(item)
+        route.append(1e6 * (time.perf_counter() - t0) / n)
+        del b, sinks
         # survivor: ADDED -> full row init
         raw_buf: dict = {}
         t0 = time.perf_counter()
@@ -166,6 +184,7 @@ def engine_costs(n: int, trials: int) -> dict:
         "survivor_added_us": round(statistics.median(surv), 2),
         "echo_modified_us": round(statistics.median(echo), 2),
         "batch_parse_us": round(statistics.median(parse), 2),
+        "route_batch_us": round(statistics.median(route), 2),
         "emit_render_us": round(statistics.median(emit), 2),
         "flush_staged_row_us": round(statistics.median(flushes), 2),
         "tick_kernel_ms_at_capacity": round(statistics.median(ticks), 2),
@@ -485,7 +504,8 @@ def contention_factor(procs: int = 6, seconds: float = 2.0) -> dict:
 
 def build_model(eng: dict, api: dict, rig: dict, watch: dict,
                 members: int, ticks_per_kpod: float = 0.2,
-                contention: float = 1.0, drain_shards: int = 1) -> dict:
+                contention: float = 1.0, drain_shards: int = 1,
+                max_drain_shards: int = 0) -> dict:
     """Assemble per-pod costs and the pods/s-vs-cores curve.
 
     A pod's life in the homogeneous soak:
@@ -519,12 +539,19 @@ def build_model(eng: dict, api: dict, rig: dict, watch: dict,
 
     lm = lane_model(eng, api, rig, watch, members=members,
                     contention=contention, drain_shards=drain_shards,
-                    ticks_per_kpod=ticks_per_kpod)
+                    ticks_per_kpod=ticks_per_kpod,
+                    max_drain_shards=max_drain_shards)
+    from kwok_tpu.config.types import DEFAULT_MAX_DRAIN_SHARDS
+
+    cap = max_drain_shards if max_drain_shards > 0 else (
+        DEFAULT_MAX_DRAIN_SHARDS
+    )
+    auto_txt = f"auto (min(cores, {cap}))"
     return {
         "per_pod_us": lm["per_pod_us"],
         "poll_us_per_store_pod": round(poll_per_store_pod, 3),
         "drain_shards": (
-            drain_shards if drain_shards > 0 else "auto (min(8, cores))"
+            drain_shards if drain_shards > 0 else auto_txt
         ),
         "predicted_pods_per_s_by_cores":
             lm["predicted_pods_per_s_by_cores"],
@@ -537,10 +564,14 @@ def build_model(eng: dict, api: dict, rig: dict, watch: dict,
             "pump + tick-kernel share at "
             f"{ticks_per_kpod} ticks/kpod); N-core = slowest lane "
             "(engine drain+emit hash-partitioned over "
-            f"{drain_shards if drain_shards > 0 else 'min(8, cores)'} "
-            "shard lanes with the parse+flush router serial, apiservers "
-            f"split across {members} members, rig across 4 loaders; the "
-            "tick-kernel lane leaves the host entirely when a TPU is "
+            f"{drain_shards if drain_shards > 0 else auto_txt} "
+            "shard lanes; with route_batch_us measured the router lane is "
+            "the native parse+partition+handoff, the staged-row flush is "
+            "the coordinator tick thread's own lane, and pump sends ride "
+            "per-lane connection groups; apiservers split across "
+            f"max({members}, cores//2) members (the horizontally scaled "
+            "tier, sized like the soak topology), rig across 4 loaders; "
+            "the tick-kernel lane leaves the host entirely when a TPU is "
             "attached)"
         ),
     }
@@ -556,8 +587,18 @@ def main() -> int:
                    "validate the model's 1-core prediction against")
     p.add_argument("--drain-shards", type=int, default=0,
                    help="model the drain+emit lane hash-partitioned over "
-                   "N shard lanes (engine --drain-shards); 0 = auto, "
-                   "min(8, cpu_count) — the engine's production default")
+                   "N shard lanes (engine --drain-shards); 0 = auto — the "
+                   "engine's production default "
+                   "(config.types.auto_drain_shards)")
+    p.add_argument("--max-drain-shards", type=int, default=0,
+                   help="cap on the AUTO lane count, mirroring the "
+                   "engine's --max-drain-shards (0 = built-in default)")
+    p.add_argument("--remodel", action="append", default=[],
+                   help="path to a prior COSTMODEL_r*.json: re-predict "
+                   "its measured inputs under the CURRENT model and embed "
+                   "the result as remodeled_<name>_inputs — the "
+                   "apples-to-apples ceiling trajectory across rounds "
+                   "(repeatable)")
     p.add_argument("--tolerance", type=float, default=0.6,
                    help="bottom-up microbenches vs a live multi-process "
                    "soak: the residual (federation layer, GC/allocator "
@@ -566,6 +607,18 @@ def main() -> int:
                    "lost the right order of magnitude")
     args = p.parse_args()
 
+    # load every --remodel input BEFORE the measurement: a typo'd path
+    # must fail in milliseconds, not after minutes of microbenches whose
+    # results would then be discarded unprinted
+    priors: "list[tuple[str, dict]]" = []
+    for path in args.remodel:
+        try:
+            with open(path) as f:
+                priors.append((path, json.load(f)))
+        except (OSError, ValueError) as e:
+            print(f"--remodel {path}: {e}", file=sys.stderr)
+            return 1
+
     eng = engine_costs(args.events, args.trials)
     api = apiserver_costs(min(args.events, 20000), args.trials)
     rig = rig_costs(min(args.events, 20000), args.trials)
@@ -573,10 +626,11 @@ def main() -> int:
     # soak process count: engine + members + rig + a loader or two
     cont = contention_factor(procs=args.members + 3)
     # 0 = auto: the curve's N-core point models the engine default on an
-    # N-core host, min(8, N) lanes (config.types.resolve_drain_shards)
+    # N-core host (config.types.auto_drain_shards)
     model = build_model(eng, api, rig, watch, args.members,
                         contention=cont["factor"],
-                        drain_shards=args.drain_shards)
+                        drain_shards=args.drain_shards,
+                        max_drain_shards=args.max_drain_shards)
     out = {
         "metric": "cost model: per-process us CPU per op + pods/s-vs-cores",
         "engine": eng,
@@ -586,6 +640,41 @@ def main() -> int:
         "contention": cont,
         "model": model,
     }
+    for path, prior in priors:
+        name = os.path.basename(path).rsplit(".", 1)[0].lower()
+        try:
+            remodeled = build_model(
+                prior.get("engine") or {}, prior.get("apiserver") or {},
+                prior.get("rig") or {}, prior.get("watch") or {},
+                args.members,
+                contention=(prior.get("contention") or {}).get(
+                    "factor", 1.0
+                ),
+                drain_shards=args.drain_shards,
+                max_drain_shards=args.max_drain_shards,
+            )
+        except KeyError as e:
+            # a JSON that parses but is not a COSTMODEL artifact (missing
+            # engine cost keys) gets the same one-line report as an
+            # unreadable file, not a traceback
+            print(f"--remodel {path}: missing input key {e}",
+                  file=sys.stderr)
+            return 1
+        out[f"remodeled_{name}_inputs"] = {
+            "note": (
+                f"the measured per-op inputs of {os.path.basename(path)} "
+                "re-predicted under the CURRENT lane model — the "
+                "ceiling movement across rounds with the host removed "
+                "from the comparison (the fresh measurement above ran on "
+                "whatever host this round got). The delta folds in the "
+                "whole current model, not just the engine refit: the "
+                "auto shard cap and the members-scale-with-cores "
+                "topology policy (lane_model.members_at) apply to old "
+                "inputs too, so where an old curve was apiserver-bound "
+                "at high core counts, part of the rise is that policy"
+            ),
+            **remodeled,
+        }
     ok = True
     if args.measured > 0:
         pred = model["predicted_pods_per_s_by_cores"]["1"]

@@ -6,22 +6,56 @@ against a live soak) and bench.py (which embeds the predicted curve in
 every BENCH json). bench.py must not import cost_model itself: that
 module pins JAX_PLATFORMS and pops PALLAS_AXON_POOL_IPS at import, which
 would break a TPU bench run.
+
+r07 re-fit (native pre-partitioned routing): when the inputs carry
+``route_batch_us`` — the measured serial cost of ONE C parse+partition
+call plus the per-lane sub-batch handoff, per event — the router lane is
+charged exactly that, and the staged-row flush becomes its OWN lane (it
+runs on the coordinator tick thread, a different thread from the router;
+the r06 model lumped them conservatively because the per-event Python
+route loop dominated both). Pump sends shard with the lanes (each lane's
+emit worker owns its pump connection group since PR 2). Old input files
+without ``route_batch_us`` reproduce the r06 ENGINE attribution (parse +
+flush lumped on one serial router lane) — but the topology policy is the
+current one for every prediction: the lifted auto shard cap and the
+members-scale-with-cores apiserver sizing (``members_at``) apply to old
+inputs too. A remodeled delta therefore measures "this round's model on
+that round's inputs", not the engine refit in isolation; where an old
+curve was apiserver-bound at high core counts, part of its rise is the
+members policy, and honest round-over-round claims must attribute that
+(COSTMODEL_r07's remodeled r05 rise at 16 cores past the old 135,593
+ceiling is exactly such a case: the plateau removal is the router fix +
+cap lift, the binding lane above 8 cores is the rig once members scale).
 """
 
 from __future__ import annotations
 
+from kwok_tpu.config.types import auto_drain_shards
+
 CORES_AXIS = (1, 2, 4, 8, 16, 32)
+
+
+def members_at(cores: int, members: int) -> int:
+    """Apiserver lanes available at a core count: the configured member
+    count, grown with the host like the soak topology is (one member per
+    ~2 cores — the shape every soak artifact so far ran: 4 members on the
+    8-core reference box). The apiserver is the horizontally scalable
+    tier (federation), so a 16-core prediction that kept 4 members would
+    model a deliberately undersized deployment."""
+    return max(members, cores // 2)
 
 
 def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
                members: int = 4, contention: float = 1.0,
-               drain_shards: int = 0, ticks_per_kpod: float = 0.2) -> dict:
+               drain_shards: int = 0, ticks_per_kpod: float = 0.2,
+               max_drain_shards: int = 0) -> dict:
     """Per-pod cost components + the predicted pods/s-vs-cores curves.
 
     ``drain_shards``: the engine's host-lane count; <=0 = auto, meaning an
-    N-core host runs min(8, N) lanes (config.types.resolve_drain_shards),
-    so the curve's N-core point models what that host would actually run.
-    The single-lane curve is always computed alongside — the trajectory of
+    N-core host runs config.types.auto_drain_shards(N) lanes (cpu count
+    capped by ``max_drain_shards`` / DEFAULT_MAX_DRAIN_SHARDS), so the
+    curve's N-core point models what that host would actually run. The
+    single-lane curve is always computed alongside — the trajectory of
     the host ceiling moving.
     """
     fan = api.get("watch_fanout_per_watcher_us", 0.0)
@@ -32,20 +66,28 @@ def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
         + 3 * fan
     )
     # The sharded-lane split (engine/lanes.py): survivor ingest, echo
-    # drop, and emit render hash-partition across the lanes; the batched
-    # C++ parse (router thread) and the staged-row flush (tick thread)
-    # stay serial. engine_serial_drain_emit remains the UNSHARDED total
-    # for trajectory continuity with earlier rounds.
+    # drop, and emit render hash-partition across the lanes.
+    # engine_serial_drain_emit remains the UNSHARDED total for trajectory
+    # continuity with earlier rounds.
     lane_pp = (
         eng["survivor_added_us"] + eng["echo_modified_us"]
         + eng["emit_render_us"]
     )
-    router_pp = (
-        eng.get("batch_parse_us", 0.0) + eng.get("flush_staged_row_us", 0.0)
-    )
-    serial_pp = lane_pp + eng.get("flush_staged_row_us", 0.0)
+    flush_pp = eng.get("flush_staged_row_us", 0.0)
+    route_us = eng.get("route_batch_us")
+    if route_us is not None:
+        # native pre-partitioned routing measured: the router lane is the
+        # C parse+partition + per-batch handoff, nothing per-event; the
+        # flush is the coordinator tick thread's own lane
+        router_pp = route_us
+        split_flush = True
+    else:
+        # pre-r07 inputs: parse + flush lumped on one serial lane
+        router_pp = eng.get("batch_parse_us", 0.0) + flush_pp
+        split_flush = False
+    serial_pp = lane_pp + flush_pp
     watch_pp = 2 * watch.get("watch_line_us", 0.0)
-    pump_pp = rig.get("issue_request_us", 0.0)  # engine's pump thread
+    pump_pp = rig.get("issue_request_us", 0.0)  # engine's pump sends
     rig_pp = 2 * rig.get("issue_request_us", 0.0)
     kern_pp = (
         eng.get("tick_kernel_ms_at_capacity", 0.0) * 1e3
@@ -62,39 +104,47 @@ def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
             return 1e6 / total_1core
         # pipeline model: each process/thread group is a lane once cores
         # allow. With shards>1 the old engine-serial lane splits into the
-        # router (parse+flush, serial) and per-shard drain+emit lanes —
-        # effective shards bounded by the cores left after the
+        # router, the flush/dispatch coordinator, and per-shard drain+emit
+        # lanes — effective shards bounded by the cores left after the
         # apiserver/rig processes claim theirs.
         if shards <= 0:
-            shards = min(8, cores)
+            shards = auto_drain_shards(cores, max_drain_shards)
         eff = min(shards, max(1, cores - 2))
         if shards > 1:
             eng_lanes = [router_pp, lane_pp / eff]
+            if split_flush:
+                eng_lanes.append(flush_pp)  # coordinator tick thread
+                # pump sends ride each lane's own connection group
+                eng_lanes.append(pump_pp / eff)
+            else:
+                eng_lanes.append(pump_pp)
         else:
-            eng_lanes = [serial_pp]
+            eng_lanes = [serial_pp, pump_pp]
         lanes = eng_lanes + [
-            api_pp / min(members, max(1, cores - 2)),
+            api_pp / min(members_at(cores, members), max(1, cores - 2)),
             rig_pp / min(4, cores),
             watch_pp / 2,  # one watch thread per kind
-            pump_pp,
             kern_pp,  # offloads entirely with a TPU attached
         ]
         return 1e6 / max(lanes)
 
+    per_pod = {
+        "engine_serial_drain_emit": round(serial_pp, 1),
+        "engine_lane_drain_emit": round(lane_pp, 1),
+        "engine_router_serial": round(router_pp, 1),
+        "engine_watch_threads": round(watch_pp, 1),
+        "engine_offloadable_pump": round(pump_pp, 1),
+        "engine_tick_kernel": round(kern_pp, 1),
+        "apiservers_total": round(api_pp, 1),
+        "rig": round(rig_pp, 1),
+        "total_modeled": round(total_modeled, 1),
+        "contention_factor": round(contention, 3),
+        "total_1core": round(total_1core, 1),
+    }
+    if split_flush:
+        per_pod["engine_tick_flush"] = round(flush_pp, 1)
     return {
-        "per_pod_us": {
-            "engine_serial_drain_emit": round(serial_pp, 1),
-            "engine_lane_drain_emit": round(lane_pp, 1),
-            "engine_router_serial": round(router_pp, 1),
-            "engine_watch_threads": round(watch_pp, 1),
-            "engine_offloadable_pump": round(pump_pp, 1),
-            "engine_tick_kernel": round(kern_pp, 1),
-            "apiservers_total": round(api_pp, 1),
-            "rig": round(rig_pp, 1),
-            "total_modeled": round(total_modeled, 1),
-            "contention_factor": round(contention, 3),
-            "total_1core": round(total_1core, 1),
-        },
+        "per_pod_us": per_pod,
         "predicted_pods_per_s_by_cores": {
             str(c): round(predict(c, drain_shards), 0) for c in CORES_AXIS
         },

@@ -342,7 +342,7 @@ def main() -> None:
     p.add_argument("--engine-parallelism", type=int, default=64)
     p.add_argument("--drain-shards", type=int, default=0,
                    help="engine --drain-shards: hash-partitioned host "
-                   "lanes for drain+emit (0 = auto, min(8, cpu_count), "
+                   "lanes for drain+emit (0 = auto, config.types.auto_drain_shards, "
                    "for the spawned engine; --in-process treats 0 as 1 — "
                    "the single-interpreter topology shares one GIL, so "
                    "lanes there must be asked for explicitly; 1 = the "

@@ -40,9 +40,14 @@ class KwokConfigurationOptions:
     initialCapacity: int = 4096
     useMesh: bool = False
     # Host-lane sharding of the drain+emit pipeline: number of
-    # hash-partitioned ShardLanes. 0 = auto (min(8, cpu_count)); 1 = the
-    # classic single-lane engine.
+    # hash-partitioned ShardLanes. 0 = auto (auto_drain_shards: cpu_count
+    # capped by maxDrainShards); 1 = the classic single-lane engine.
     drainShards: int = 0
+    # Cap on the AUTO lane count (0 = DEFAULT_MAX_DRAIN_SHARDS). With the
+    # router's per-event Python term gone (native pre-partitioned
+    # routing) lanes keep paying past 8 cores; this bounds fan-out on
+    # very wide hosts without touching explicit drainShards values.
+    maxDrainShards: int = 0
 
 
 @dataclasses.dataclass
@@ -65,14 +70,31 @@ def _prune(d: dict) -> dict:
     return {k: v for k, v in d.items() if v not in ("", None)}
 
 
-def resolve_drain_shards(value: int) -> int:
-    """0/negative = auto: min(8, cpu_count). Shards beyond ~8 stop paying
-    on the measured workload — the apiserver/rig lanes bound throughput
-    first (benchmarks/cost_model.py) — so auto caps there."""
+# The auto lane-count ceiling. Historically 8: with the router hashing and
+# dispatching every event in Python, lanes beyond ~8 bought nothing (the
+# serial router was the wall — COSTMODEL_r06). Native pre-partitioned
+# routing removed that term, so auto now follows the core count up to this
+# cap (benchmarks/cost_model.py re-fit; override per deployment with
+# --max-drain-shards / maxDrainShards / KWOK_MAX_DRAIN_SHARDS — the env
+# form reaches the CLI through the generic apply_env_overrides pass over
+# KwokConfigurationOptions, not through this module).
+DEFAULT_MAX_DRAIN_SHARDS = 32
+
+
+def auto_drain_shards(cores: int, max_shards: int = 0) -> int:
+    """THE auto drain-shard policy — the single source the engine, the
+    CLI, and the cost model all share (a drifted copy here once meant the
+    model predicted a lane count the engine would never run)."""
+    cap = max_shards if max_shards > 0 else DEFAULT_MAX_DRAIN_SHARDS
+    return max(1, min(cap, int(cores)))
+
+
+def resolve_drain_shards(value: int, max_shards: int = 0) -> int:
+    """0/negative = auto: auto_drain_shards over this host's cpu_count."""
     v = int(value)
     if v > 0:
         return v
-    return max(1, min(8, os.cpu_count() or 1))
+    return auto_drain_shards(os.cpu_count() or 1, max_shards)
 
 
 def parse_bool(value: Any) -> bool:

@@ -134,9 +134,12 @@ class EngineConfig:
     # group, so drain+emit for shard A overlaps shard B and the tick
     # thread shrinks to kernel dispatch + per-shard wire handoff. 1 = the
     # classic single-lane engine (the library/test default — every
-    # synchronous test drives engine state directly); 0 = auto,
-    # min(8, cpu_count) — what the CLI defaults to in production.
+    # synchronous test drives engine state directly); 0 = auto
+    # (config.types.auto_drain_shards: cpu_count capped by
+    # max_drain_shards) — what the CLI defaults to in production.
     drain_shards: int = 1
+    # cap on the AUTO lane count; 0 = config.types.DEFAULT_MAX_DRAIN_SHARDS
+    max_drain_shards: int = 0
     node_rules: list[LifecycleRule] | None = None
     pod_rules: list[LifecycleRule] | None = None
     use_mesh: bool = False
@@ -350,7 +353,9 @@ class ClusterEngine:
         # it wires into the lanes exists.
         from kwok_tpu.config.types import resolve_drain_shards
 
-        self._n_lanes = resolve_drain_shards(config.drain_shards)
+        self._n_lanes = resolve_drain_shards(
+            config.drain_shards, config.max_drain_shards
+        )
         parent_cap = cap if self._n_lanes <= 1 else min(cap, 1024)
         self.nodes = _Kind(ntab, parent_cap)
         self.pods = _Kind(ptab, parent_cap)
@@ -407,6 +412,15 @@ class ClusterEngine:
                     "parse path stays active", exc_info=True,
                 )
                 self._batch_parser = None
+        # Native pre-partitioned routing (ingest.cc ABI 7): the batch
+        # parser computes each event's lane and per-lane index runs in the
+        # same C call, so the router (or the single-lane drain) stops
+        # hashing/dispatching per event in Python. KWOK_TPU_NATIVE_ROUTE=0
+        # forces the per-record Python route loop (escape hatch + the
+        # ordering oracle's reference arm).
+        self._native_route = (
+            os.environ.get("KWOK_TPU_NATIVE_ROUTE", "1") != "0"
+        )
         self._watch_rv: dict[str, int] = {}
         # per-kind watch-stream generation, bumped whenever a stream is
         # known compacted (410): RAW lines still queued from the dead
@@ -880,7 +894,9 @@ class ClusterEngine:
     # batch-parse latency and memory without giving up amortization
     _RAW_FLUSH_AT = 8192
 
-    def _drain_apply(self, item, raw_buf: dict, route=None) -> None:
+    def _drain_apply(
+        self, item, raw_buf: dict, route=None, route_shards: int = 0
+    ) -> None:
         """Apply one queue item on the tick thread. RAW items (undecoded
         watch lines, the native path) buffer per kind for ONE batched C++
         parse; any non-RAW item for a kind flushes its buffer first so
@@ -890,13 +906,16 @@ class ClusterEngine:
         With ``route`` (the sharded pipeline's router thread), parsed
         events are handed to ``route(kind, type_, obj)`` instead of being
         ingested here — the rv/generation bookkeeping (this engine's watch
-        threads read it on reconnect) stays with the caller either way."""
+        threads read it on reconnect) stays with the caller either way.
+        ``route_shards`` is the LaneSet width when ``route`` is its
+        per-event router (enables the pre-partitioned batch handoff);
+        0 for any other route callable."""
         kind, type_, obj = item[:3]
         if type_ == "RAW":
             buf = raw_buf.setdefault(kind, [])
             buf.append(obj)
             if len(buf) >= self._RAW_FLUSH_AT:
-                self._drain_flush_kind(kind, raw_buf, route)
+                self._drain_flush_kind(kind, raw_buf, route, route_shards)
             return
         if type_ == "RAWB":
             # a packed native-reader batch (buf, off): one entry, many
@@ -906,10 +925,10 @@ class ClusterEngine:
             buf = raw_buf.setdefault(kind, [])
             buf.append(obj)
             if sum(len(o) - 1 for _, o in buf) >= self._RAW_FLUSH_AT:
-                self._drain_flush_kind(kind, raw_buf, route)
+                self._drain_flush_kind(kind, raw_buf, route, route_shards)
             return
         if kind in raw_buf:
-            self._drain_flush_kind(kind, raw_buf, route)
+            self._drain_flush_kind(kind, raw_buf, route, route_shards)
         if type_ == "GEN":
             # stream boundary: lines after this belong to generation `obj`
             self._drain_gen[kind] = obj
@@ -919,9 +938,11 @@ class ClusterEngine:
             return
         self._ingest_safe(kind, type_, obj)
 
-    def _drain_flush(self, raw_buf: dict, route=None) -> None:
+    def _drain_flush(
+        self, raw_buf: dict, route=None, route_shards: int = 0
+    ) -> None:
         for kind in list(raw_buf):
-            self._drain_flush_kind(kind, raw_buf, route)
+            self._drain_flush_kind(kind, raw_buf, route, route_shards)
 
     def _expire_stream(self, kind: str) -> None:
         """Mark kind's watch stream compacted: the resume revision AND the
@@ -959,7 +980,9 @@ class ClusterEngine:
             if gen == self._stream_gen.get(kind, 0):
                 self._watch_rv[kind] = rv
 
-    def _drain_flush_kind(self, kind: str, raw_buf: dict, route=None) -> None:
+    def _drain_flush_kind(
+        self, kind: str, raw_buf: dict, route=None, route_shards: int = 0
+    ) -> None:
         entries = raw_buf.pop(kind, None)
         if not entries:
             return
@@ -969,6 +992,25 @@ class ClusterEngine:
         latest_rv = 0
         rv_dead = False
         n_rec = 0
+        # Pre-partitioned parse: the C parser also computes each event's
+        # lane and per-lane index runs. n_shards = the LaneSet's width
+        # when this flush routes to it (the caller declares it via
+        # route_shards), 1 when this engine ingests inline (single lane /
+        # federation member — the columnar survivor path), 0 for any
+        # other route callable (per-record loop, unchanged).
+        part_shards = 0
+        lanes = self._lanes
+        if self._native_route:
+            if route is None:
+                part_shards = 1
+            elif (
+                route_shards > 1
+                and lanes is not None
+                and route_shards == lanes.n
+            ):
+                # a stale width (caller's LaneSet differs from ours) falls
+                # back to the per-record walk instead of mis-partitioning
+                part_shards = route_shards
         _t = time.perf_counter()
         if any(isinstance(x, tuple) for x in entries):
             # packed native-reader batches: concatenate segments and parse
@@ -1000,7 +1042,9 @@ class ClusterEngine:
                 ]
 
             try:
-                batch = self._batch_parser.parse_blob(blob, offs)
+                batch = self._batch_parser.parse_blob(
+                    blob, offs, kind=kind, n_shards=part_shards
+                )
             except Exception:
                 logger.exception(
                     "batch parse failed; falling back to per-line parse"
@@ -1011,7 +1055,9 @@ class ClusterEngine:
         else:
             lines = entries
             try:
-                batch = self._batch_parser.parse_raw_batch(lines)
+                batch = self._batch_parser.parse_raw_batch(
+                    lines, kind=kind, n_shards=part_shards
+                )
             except Exception:
                 logger.exception(
                     "batch parse failed; falling back to per-line parse"
@@ -1054,6 +1100,32 @@ class ClusterEngine:
             )
             return
         self.telemetry.observe_stage("parse", time.perf_counter() - _t)
+        if batch.partitioned:
+            info = batch.route_info
+            if info.first_error < 0 and not info.unrouteable:
+                # the steady-state fast path: rv/bookmark bookkeeping is
+                # three scalars from the C parse, and routable records are
+                # handed over as per-lane zero-copy sub-batches (or
+                # ingested columnar right here when this engine IS the
+                # lane) — no per-event Python in the serial drain.
+                if info.latest_rv:
+                    self._commit_rv(kind, gen, info.latest_rv)
+                if info.bookmarks:
+                    self._inc("watch_bookmarks_total", info.bookmarks)
+                if info.routable:
+                    self.telemetry.inc_kind(
+                        "watch_events_total", kind, info.routable
+                    )
+                    if part_shards > 1:
+                        lanes.route_batch(kind, batch)
+                    else:
+                        self._ingest_record_batch(
+                            kind, batch, batch.lane_idx, 0, info.routable
+                        )
+                return
+            # ERROR / nameless records present (rare): the per-record walk
+            # below preserves exact ordering and fallback semantics
+            batch.ensure_lists()
         bookmarks = 0
         # hot loop: locals beat repeated attribute/method dispatch at
         # O(10k) records per drain
@@ -1218,6 +1290,255 @@ class ClusterEngine:
                 m = self.nodes.pool.meta[idx]
                 m["fp_meta_sel"] = rec.fp_meta_sel
                 m["fp_nsc_done"] = rec.fp_status_nc
+
+    def _ingest_record_batch(self, kind, batch, idx, lo: int, hi: int) -> int:
+        """Apply a contiguous partitioned sub-batch (`idx[lo:hi]` indexes
+        into `batch`) — the unit the native router hands a lane, and the
+        single-lane inline ingest unit. Pods without full-path needs take
+        the COLUMNAR survivor path (_pod_ingest_cols); everything else
+        replays the per-record path. Returns events applied."""
+        n = hi - lo
+        if n <= 0:
+            return 0
+        if kind == "pods" and not self._record_needs_full_path:
+            try:
+                self._pod_ingest_cols(batch, idx, lo, hi)
+                return n
+            except Exception:
+                # a columnar bug must not drop a whole window: re-run the
+                # per-record path. Rows an earlier flush fully applied
+                # drop as echoes (their fingerprints are seeded); a
+                # partially-applied flush released its fresh rows before
+                # re-raising (flush_cols rollback), so the replay's
+                # new-row path stages them from scratch
+                logger.exception(
+                    "columnar ingest failed; replaying per record"
+                )
+        record = batch.record
+        ing = self._ingest_record
+        for i in idx[lo:hi].tolist():
+            try:
+                ing(kind, record(i))
+            except Exception:
+                logger.exception("ingest failed for %s REC", kind)
+        return n
+
+    def _pod_ingest_cols(self, batch, idx, lo: int, hi: int) -> None:
+        """Columnar pod ingest over a partitioned sub-batch: one gather
+        per fixed-width column (flags/fingerprints/string offsets), the
+        echo drop inlined on plain ints, and survivors accumulated into
+        ONE RowPool acquire run + ONE staged array block
+        (UpdateBuffer.stage_init_array) + vectorized phase/cond mirror
+        writes — the 34µs/pod per-event dict churn (_pod_upsert_record +
+        lazy-record attribute machinery) becomes a tight loop over
+        buffer slices. Per-key event ORDER is preserved exactly: records
+        are scanned in stream order; a record that cannot ride the
+        columnar buffer flushes it first whenever its key is already
+        buffered, then replays through the per-record path."""
+        from kwok_tpu.native import (
+            REC_TYPE_ADDED,
+            REC_TYPE_MASK,
+            REC_TYPE_MODIFIED,
+        )
+
+        sub = idx[lo:hi]
+        ids = sub.tolist()
+        flags_l = batch.flags_a[sub].tolist()
+        fp_a = batch.fp_a
+        fp_status = fp_a[0][sub].tolist()
+        fp_spec = fp_a[2][sub].tolist()
+        fp_meta = fp_a[3][sub].tolist()
+        # string-field boundaries: 11 spans per record (native _REC_STRINGS
+        # order: type, ns, name, node, phase, podIP, hostIP, creation,
+        # ctrs, ictrs, trueConditions), gathered as 12 boundary columns
+        base = sub.astype(np.int64) * 11
+        offs = batch.off_a
+        col = [offs[base + j].tolist() for j in range(12)]
+        c1, c2, c3, c4, c5, c6, c7, c8, c9, c10, c11 = col[1:12]
+        buf = batch.buf
+        lines = batch.lines
+        k = self.pods
+        pool = k.pool
+        lookup = pool.lookup
+        meta = pool.meta
+        phase_ids = self._pod_phase_ids
+        node_has = self.node_has
+        bit_managed = (
+            1 << self.pod_bits[SEL_ON_MANAGED_NODE]
+            | 1 << self.pod_bits[SEL_MANAGED]
+        )
+        record = batch.record
+        ing = self._ingest_record
+        pending: set = set()
+        cols: list = []  # (key, node, meta, cond_bits, has_del)
+
+        def flush_cols() -> None:
+            if not cols:
+                return
+            if self._trace_every:
+                # sampled ingest->patch spans: same 1-in-N cadence as the
+                # per-record path, without a per-record counter bump
+                start = self._trace_n
+                ev = self._trace_every
+                self._trace_n = start + len(cols)
+                j = (ev - (start % ev)) - 1
+                t0 = time.perf_counter()
+                while j < len(cols):
+                    cols[j][2]["_trace_t0"] = t0
+                    j += ev
+            grow = self._grow
+            acquire = pool.acquire
+            pods_by_node = self.pods_by_node
+            rows = []
+            staged = False
+            try:
+                for key, _node, m, _cond, _hd in cols:
+                    if pool.full:
+                        grow(k)
+                    row = acquire(key)
+                    meta[row] = m  # fresh rows: replace the dict wholesale
+                    rows.append(row)
+                # node->pods index registration BEFORE the node_has reads
+                # below — the same publication order _pod_upsert_record
+                # keeps against a concurrent cross-lane managed-ness
+                # snapshot
+                for key, node, _m, _cond, _hd in cols:
+                    by = pods_by_node.get(node)
+                    if by is None:
+                        by = pods_by_node[node] = set()
+                    by.add(key)
+                idx_arr = np.fromiter(rows, np.int32, len(rows))
+                cond_arr = np.fromiter(
+                    (c[3] for c in cols), np.uint32, len(cols)
+                )
+                sel_arr = np.fromiter(
+                    (bit_managed if c[1] in node_has else 0 for c in cols),
+                    np.uint32, len(cols),
+                )
+                del_arr = np.fromiter(
+                    (c[4] for c in cols), bool, len(cols)
+                )
+                k.buffer.stage_init_array(
+                    idx_arr, _PENDING, cond_arr, sel_arr, del_arr
+                )
+                staged = True
+            except BaseException:
+                # rollback: a row acquired here but never staged would
+                # otherwise look like an existing Pending row to the
+                # per-record replay (_pod_upsert_record takes the update
+                # branch, the seeded fingerprints drop the event as an
+                # echo) and stay inactive on device forever. Releasing
+                # the fresh rows makes the replay's new-row stage_init
+                # path the one that runs — the idempotency the replay
+                # fallback in _ingest_record_batch relies on. Every key
+                # here was absent from the pool at scan time (the
+                # eligibility gate requires row is None) and the lane's
+                # stage lock is held, so release cannot hit a row some
+                # other event owns.
+                if not staged:
+                    for (key, node, _m, _c, _hd), _row in zip(cols, rows):
+                        pool.release(key)
+                        by = pods_by_node.get(node)
+                        if by is not None:
+                            by.discard(key)
+                raise
+            k.phase_h[idx_arr] = _PENDING
+            k.cond_h[idx_arr] = cond_arr
+            cols.clear()
+            pending.clear()
+
+        for j, i in enumerate(ids):
+            f = flags_l[j]
+            tcode = f & REC_TYPE_MASK
+            s, e = c2[j], c3[j]
+            name = buf[s:e].decode("utf-8", "surrogateescape")
+            s, e = c1[j], c2[j]
+            ns = (
+                buf[s:e].decode("utf-8", "surrogateescape")
+                if e > s else "default"
+            )
+            key = (ns or "default", name)
+            row = lookup(key)
+            if f & 1 and tcode == REC_TYPE_MODIFIED and row is not None and (
+                key not in pending
+            ):
+                # inlined first-tier echo drop (_ingest_record's
+                # steady-state MODIFIED case) on plain gathered ints
+                m = meta[row]
+                if (
+                    not (f & 2)
+                    and m.get("fp_meta_sel") == fp_meta[j]
+                    and m.get("fp_spec") == fp_spec[j]
+                    and fp_status[j] == m.get("fp_status_done")
+                ):
+                    continue  # identical to what we already processed
+            eligible = (
+                f & 1
+                and tcode in (REC_TYPE_ADDED, REC_TYPE_MODIFIED)
+                and row is None
+                and key not in pending
+                and c4[j] > c3[j]  # nodeName present
+                and c6[j] == c5[j]  # no podIP (alloc-lock path)
+            )
+            if eligible:
+                s, e = c4[j], c5[j]
+                phase_s = (
+                    buf[s:e].decode("utf-8", "surrogateescape")
+                    if e > s else ""
+                )
+                if phase_ids.get(phase_s or "Pending", _PENDING) != _PENDING:
+                    eligible = False  # repair render on first sighting
+            if not eligible:
+                if key in pending:
+                    flush_cols()  # an earlier buffered event for this key
+                try:
+                    ing("pods", record(i))
+                except Exception:
+                    logger.exception("ingest failed for pods REC")
+                continue
+            cond = 0
+            s, e = c10[j], c11[j]
+            if e > s:
+                for t_ in buf[s:e].split(b"\x1f"):
+                    tn = t_.decode()
+                    if tn in POD_PHASES.conditions:
+                        cond |= 1 << POD_PHASES.condition_bit(tn)
+            has_del = bool(f & 2)
+            s = c6[j]
+            e = c7[j]
+            host_ip = (
+                buf[s:e].decode("utf-8", "surrogateescape") if e > s else ""
+            )
+            s = c7[j]
+            e = c8[j]
+            creation = (
+                buf[s:e].decode("utf-8", "surrogateescape") if e > s else ""
+            )
+            node = buf[c3[j]:c4[j]].decode("utf-8", "surrogateescape")
+            m = {
+                "name": name,
+                "namespace": key[0],
+                "node": node,
+                "disregard": False,
+                "raw": lines[i],
+                "finalizers": bool(f & 4),
+                "has_del": has_del,
+                "creation": creation,
+                "ctrs": buf[c8[j]:c9[j]],
+                "ictrs": buf[c9[j]:c10[j]],
+                "rgates": bool(f & 8),
+                "phase_str": phase_s,
+                "host_ip": host_ip,
+                "status_scalar": bool(f & 16),
+                # fingerprint seeding: the echo of this object's next
+                # server state drops without a parse
+                "fp_meta_sel": fp_meta[j],
+                "fp_spec": fp_spec[j],
+                "fp_status_done": fp_status[j],
+            }
+            pending.add(key)
+            cols.append((key, node, m, cond, has_del))
+        flush_cols()
 
     def _resync(self, kind: str, objs: list[dict]) -> None:
         """Free rows for objects that vanished while the watch was down."""

@@ -73,6 +73,11 @@ logger = logging.getLogger("kwok_tpu.lanes")
 
 _KINDS = ("nodes", "pods")
 
+# Per-lane row-budget floor: tiny lanes regrow (host copy + re-jit at the
+# new stacked shape) constantly under any real load. Tests shrink this to
+# exercise the mid-run regrow path without six-digit event streams.
+_MIN_LANE_ROWS = 1024
+
 
 @dataclasses.dataclass
 class _LanePending:
@@ -153,7 +158,10 @@ class ShardLane:
     # keep the tick thread from swapping this lane's buffers
     _BURST = 4096
 
-    def _apply_item(self, item) -> None:
+    def _apply_item(self, item) -> int:
+        """Apply one routed queue item; returns the EVENT count it carried
+        (burst accounting: a packed sub-batch weighs its record count, so
+        the stage_lock hold stays bounded like the per-event path's)."""
         e = self.engine
         if item[1] == "XUPD":
             # managed-ness re-evaluation for pods this lane owns, routed
@@ -167,31 +175,84 @@ class ShardLane:
                 k.buffer.stage_update(
                     idx, e._pod_bits(m), m.get("has_del", False)
                 )
-            return
+            return len(item[2])
+        if item[1] == "RECB":
+            # a native pre-partitioned sub-batch: this lane's contiguous
+            # index run over the shared ParsedBatch (zero-copy handoff)
+            batch, idx, lo, hi = item[2]
+            return e._ingest_record_batch(item[0], batch, idx, lo, hi)
         e._drain_apply(item, {})  # routed items are parsed; no RAW buffer
+        return 1
+
+    def _apply_locked(self, item) -> int:
+        """Apply one routed item under the stage_lock. A RECB sub-batch is
+        indivisible to the burst accounting, and a reconnect flood can
+        partition a whole parse window into one lane — so oversized runs
+        are applied in _BURST slices, each under its OWN hold, keeping the
+        tick thread's buffer-swap wait bounded exactly like the per-event
+        path bounded it. Slice boundaries are legal swap points: the tick
+        thread could always interleave between any two routed items of the
+        same window, and per-key order is the slice order (same thread)."""
+        if item[1] == "RECB":
+            batch, idx, lo, hi = item[2]
+            e = self.engine
+            kind = item[0]
+            n = 0
+            while lo < hi:
+                end = min(lo + self._BURST, hi)
+                with self.stage_lock:
+                    n += e._ingest_record_batch(kind, batch, idx, lo, end)
+                lo = end
+            return n
+        with self.stage_lock:
+            return self._apply_item(item)
+
+    _EMPTY = object()  # drain_loop window sentinel: queue momentarily dry
 
     def drain_loop(self) -> None:
         q = self.q
         tel = self.telemetry
+        empty = self._EMPTY
+
+        def next_item():
+            try:
+                return q.get_nowait()
+            except queue.Empty:
+                return empty
+
         while True:
             item = q.get()
             if item is None:
                 return
             stop = False
             t0 = time.perf_counter()
-            with self.stage_lock:
-                self._apply_item(item)
-                n = 1
-                while n < self._BURST:
-                    try:
-                        item = q.get_nowait()
-                    except queue.Empty:
-                        break
-                    if item is None:
-                        stop = True
-                        break
-                    self._apply_item(item)
-                    n += 1
+            n = 0
+            while item is not empty and not stop:
+                if item[1] == "RECB":
+                    # sub-batches take their own (sliced) holds
+                    n += self._apply_locked(item)
+                    if n >= self._BURST:
+                        item = empty
+                    else:
+                        item = next_item()
+                        if item is None:
+                            stop = True
+                else:
+                    # consecutive per-event items share ONE stage_lock
+                    # hold (bounded by _BURST); a RECB ends the hold so
+                    # its slice-holds never nest inside this one
+                    with self.stage_lock:
+                        while True:
+                            n += self._apply_item(item)
+                            if n >= self._BURST:
+                                item = empty
+                                break
+                            item = next_item()
+                            if item is None:
+                                stop = True
+                                break
+                            if item is empty or item[1] == "RECB":
+                                break
             tel.observe_stage("drain", time.perf_counter() - t0)
             tel.set_queue_depth(q.qsize())
             if stop:
@@ -268,6 +329,23 @@ class ShardLane:
         )
 
 
+def iter_recb_items(kind: str, batch, t: float):
+    """Yield ``(lane_index, n_events, item)`` per non-empty lane of a
+    pre-partitioned ParsedBatch — THE routed-item wire shape
+    ``(kind, "RECB", (batch, lane_idx, lo, hi), t)`` that ShardLane's
+    queue consumer unpacks. The single producer-side definition: the
+    router (LaneSet.route_batch) and the microbenches
+    (benchmarks/cost_model.py, benchmarks/route_micro.py) all build the
+    handoff here, so the benches can never measure a stale shape."""
+    lane_off = batch.lane_off
+    lane_idx = batch.lane_idx
+    for li in range(len(lane_off) - 1):
+        lo = lane_off[li]
+        hi = lane_off[li + 1]
+        if hi > lo:
+            yield li, hi - lo, (kind, "RECB", (batch, lane_idx, lo, hi), t)
+
+
 class LaneSet:
     """The coordinator: owns the stacked device state, the router, and the
     (now thin) tick loop — kernel dispatch plus per-shard wire handoff."""
@@ -279,7 +357,10 @@ class LaneSet:
         # partitioning is only statistically even, and one lane crossing
         # cap/n would otherwise force a whole-stack regrow (host copy +
         # re-jit at the new shape) right at the configured capacity
-        r = max(1024, -(-int(parent.config.initial_capacity) * 5 // (4 * self.n)))
+        r = max(
+            _MIN_LANE_ROWS,
+            -(-int(parent.config.initial_capacity) * 5 // (4 * self.n)),
+        )
         if parent._mesh is not None:
             from kwok_tpu.parallel.mesh import pad_to_multiple
 
@@ -413,7 +494,7 @@ class LaneSet:
                         return
                     continue
                 lag = time.monotonic() - item[3]
-                parent._drain_apply(item, raw_buf, self.route)
+                parent._drain_apply(item, raw_buf, self.route, self.n)
                 window_end = time.monotonic() + window
                 while True:
                     timeout = window_end - time.monotonic()
@@ -428,9 +509,9 @@ class LaneSet:
                             break
                         continue
                     lag = max(lag, time.monotonic() - item[3])
-                    parent._drain_apply(item, raw_buf, self.route)
+                    parent._drain_apply(item, raw_buf, self.route, self.n)
                 if raw_buf:
-                    parent._drain_flush(raw_buf, self.route)
+                    parent._drain_flush(raw_buf, self.route, self.n)
                 tel.observe_watch_lag(lag)
                 tel.set_gauge("ingest_queue_depth", q.qsize())
                 if not parent._running:
@@ -439,7 +520,7 @@ class LaneSet:
             # flush straggler lines, then let every lane drain worker exit
             try:
                 if raw_buf:
-                    parent._drain_flush(raw_buf, self.route)
+                    parent._drain_flush(raw_buf, self.route, self.n)
             finally:
                 for lane in self.lanes:
                     lane.q.put(None)
@@ -458,6 +539,28 @@ class LaneSet:
             return
         self.events_routed += 1
         self.lanes[shard_of(key, self.n)].q.put((kind, type_, obj, t))
+
+    def route_batch(self, kind: str, batch) -> None:
+        """Hand a native pre-partitioned ParsedBatch to the lanes: one
+        zero-copy (batch, index-run) item per lane with routed work. The
+        per-event Python hash+dispatch of `route` collapses to n_lanes
+        queue puts per window — the router's cost stops scaling with the
+        event rate (the serial-Amdahl fix; benchmarks/route_micro.py
+        measures the per-event delta). Key->lane mapping is the C side of
+        rowpool.shard_of, proven identical by the test_lanes parity
+        oracle."""
+        t0 = time.perf_counter()
+        t = time.monotonic()
+        routed = 0
+        for li, count, item in iter_recb_items(kind, batch, t):
+            lane = self.lanes[li]
+            lane.q.put(item)
+            lane.telemetry.inc_routed(count)
+            routed += count
+        self.events_routed += routed
+        self.parent.telemetry.observe_route_batch(
+            time.perf_counter() - t0
+        )
 
     def _key_of(self, kind: str, type_: str, obj):
         """The routing key — identical to the lane pool's key, so a key's
@@ -805,10 +908,10 @@ class LaneSet:
                     break
                 if item is None:
                     continue
-                parent._drain_apply(item, raw_buf, self.route)
+                parent._drain_apply(item, raw_buf, self.route, self.n)
                 progressed = True
             if raw_buf:
-                parent._drain_flush(raw_buf, self.route)
+                parent._drain_flush(raw_buf, self.route, self.n)
                 progressed = True
             for lane in self.lanes:
                 while True:

@@ -75,8 +75,14 @@ def build_parser(defaults) -> argparse.ArgumentParser:
                    help="hash-partitioned host lanes for the drain+emit "
                    "pipeline: each lane runs its own ingest drain, emit "
                    "worker, and pump connection group so the host side "
-                   "scales past one core (0 = auto, min(8, cpu_count); "
-                   "1 = the classic single-lane engine)")
+                   "scales past one core (0 = auto: cpu_count capped by "
+                   "--max-drain-shards; 1 = the classic single-lane "
+                   "engine)")
+    p.add_argument("--max-drain-shards", type=int, default=o.maxDrainShards,
+                   help="cap on the AUTO --drain-shards lane count "
+                   "(0 = built-in default, config.types."
+                   "DEFAULT_MAX_DRAIN_SHARDS); explicit --drain-shards "
+                   "values are never capped")
     p.add_argument("--initial-capacity", type=int, default=o.initialCapacity)
     p.add_argument("--use-mesh", type=_bool, default=o.useMesh,
                    help="shard cluster state across all local devices")
@@ -103,7 +109,10 @@ def _engine_config(args, stages: list[Stage]):
     from kwok_tpu.engine import EngineConfig
 
     return EngineConfig(
-        drain_shards=resolve_drain_shards(args.drain_shards),
+        drain_shards=resolve_drain_shards(
+            args.drain_shards, args.max_drain_shards
+        ),
+        max_drain_shards=args.max_drain_shards,
         manage_all_nodes=args.manage_all_nodes,
         manage_nodes_with_annotation_selector=args.manage_nodes_with_annotation_selector,
         manage_nodes_with_label_selector=args.manage_nodes_with_label_selector,

@@ -27,7 +27,7 @@ _INGEST_SRC = os.path.join(_DIR, "ingest.cc")
 _LIB = os.path.join(_DIR, "libkwokcodec.so")
 _APISERVER_SRC = os.path.join(_DIR, "apiserver.cc")
 _APISERVER_BIN = os.path.join(_DIR, "kwok-mock-apiserver")
-ABI_VERSION = 6
+ABI_VERSION = 7
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -99,6 +99,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p, i64p, ctypes.c_int32,
         u64p, u64p, u64p, u64p, u8p, i64p,
         ctypes.c_char_p, ctypes.c_int64, i64p,
+        # ABI 7 pre-partitioned routing: kind_is_pods, n_shards,
+        # shard_out, lane_idx, lane_off, route_info (null when n_shards=0)
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, i64p, i64p,
     ]
     lib.kwok_fingerprint_statuses.restype = None
     lib.kwok_fingerprint_statuses.argtypes = [
@@ -161,6 +164,17 @@ REC_DELETION = 2
 REC_FINALIZERS = 4
 REC_READINESS_GATES = 8
 REC_STATUS_SCALAR_ONLY = 16
+# bits 5-6: event type code (ABI 7) — lets batch consumers classify
+# without touching the type string
+REC_TYPE_MASK = 0x60
+REC_TYPE_ADDED = 0x20
+REC_TYPE_MODIFIED = 0x40
+REC_TYPE_DELETED = 0x60
+
+# shard_out sentinel codes (ABI 7 partitioned parse)
+SHARD_UNROUTABLE = -1  # nameless, or escapes in ns/name (Python routes it)
+SHARD_ERROR = -2
+SHARD_BOOKMARK = -3
 
 
 class EventRecord:
@@ -206,29 +220,108 @@ class EventRecord:
         return bool(self.flags & REC_OK)
 
 
+class RouteInfo:
+    """Scalar routing summary of one partitioned parse (ingest.cc).
+    ``latest_rv`` is the resume revision a full Python walk would commit:
+    zeroed whenever the batch carries an ERROR event (rv_dead)."""
+
+    __slots__ = ("latest_rv", "first_error", "bookmarks", "routable",
+                 "unrouteable")
+
+    def __init__(self, latest_rv, first_error, bookmarks, routable,
+                 unrouteable):
+        self.latest_rv = latest_rv
+        self.first_error = first_error
+        self.bookmarks = bookmarks
+        self.routable = routable
+        self.unrouteable = unrouteable
+
+
 class ParsedBatch:
     """One batched kwok_parse_events result; `record(i)` returns a LAZY
-    view over the arrays (same attribute surface as EventRecord)."""
+    view over the arrays (same attribute surface as EventRecord).
 
-    __slots__ = ("lines", "buf", "off", "fp", "flags_arr", "rvs", "n")
+    The numpy outputs are kept (`off_a`/`fp_a`/`flags_a`/`rvs_a` — the
+    columnar ingest path gathers straight from them); the per-record list
+    mirrors (`off`/`fp`/`flags_arr`/`rvs`, ~10x faster for scalar reads)
+    are built eagerly on legacy paths but LAZILY on the partitioned
+    router path: the router hands lanes zero-copy sub-batches and never
+    pays the tolist — the first lane that needs per-record views converts
+    once under `_lists_lock` (drain workers on sibling lanes share it).
 
-    def __init__(self, lines, buf, off, fp, flags_arr, rvs):
+    Partitioned parses additionally carry `shard` (per-event lane code),
+    `lane_idx`/`lane_off` (per-lane contiguous index runs over routable
+    records) and `route_info` (RouteInfo scalars)."""
+
+    __slots__ = (
+        "lines", "buf", "n", "off_a", "fp_a", "flags_a", "rvs_a",
+        "off", "fp", "flags_arr", "rvs",
+        "shard", "lane_idx", "lane_off", "route_info", "_lists_lock",
+    )
+
+    def __init__(self, lines, buf, off_a, fp_a, flags_a, rvs_a,
+                 lazy=False, partition=None):
         self.lines = lines
         self.buf = buf
-        self.off = off
-        self.fp = fp
-        self.flags_arr = flags_arr
-        self.rvs = rvs
         self.n = len(lines)
+        self.off_a = off_a
+        self.fp_a = fp_a
+        self.flags_a = flags_a
+        self.rvs_a = rvs_a
+        if partition is not None:
+            self.shard, self.lane_idx, self.lane_off, self.route_info = (
+                partition
+            )
+        else:
+            self.shard = self.lane_idx = self.lane_off = None
+            self.route_info = None
+        self._lists_lock = threading.Lock()
+        if lazy:
+            self.off = self.fp = self.flags_arr = self.rvs = None
+        else:
+            self._build_lists()
+
+    @property
+    def partitioned(self) -> bool:
+        return self.lane_off is not None
+
+    def _build_lists(self) -> None:
+        # numpy scalar indexing costs ~10x a list index and the lazy
+        # records index per field: one tolist() per batch beats 11 numpy
+        # reads per record (profiled at 18us/event before this)
+        self.fp = [row.tolist() for row in self.fp_a]
+        self.flags_arr = self.flags_a.tolist()
+        self.rvs = self.rvs_a.tolist()
+        self.off = self.off_a.tolist()  # set LAST: the presence gate
+
+    def ensure_lists(self) -> None:
+        """Idempotent lazy list conversion; safe from concurrent lane
+        drain workers (one converts, the rest wait on the lock)."""
+        if self.off is not None:
+            return
+        with self._lists_lock:
+            if self.off is None:
+                self._build_lists()
+
+    # accessors inline the presence gate: they run O(10k) times per
+    # drain on the per-record walk, where an always-early-returning
+    # method call is pure dispatch overhead (same unlocked first check
+    # ensure_lists itself makes — `off` is set LAST in _build_lists)
 
     def rv(self, i: int) -> int:
+        if self.off is None:
+            self.ensure_lists()
         return self.rvs[i]
 
     def type_bytes(self, i: int) -> bytes:
+        if self.off is None:
+            self.ensure_lists()
         base = i * _REC_STRINGS
         return self.buf[self.off[base]: self.off[base + 1]]
 
     def record(self, i: int) -> "_LazyRecord":
+        if self.off is None:
+            self.ensure_lists()
         return _LazyRecord(self, i)
 
 
@@ -436,7 +529,9 @@ class EventParser:
         self._off_p = _i64p(self._off)
         self._str_off_p = _i64p(self._str_off)
 
-    def parse_raw_batch(self, lines: list) -> "ParsedBatch | None":
+    def parse_raw_batch(
+        self, lines: list, kind: "str | None" = None, n_shards: int = 0
+    ) -> "ParsedBatch | None":
         """Parse N watch lines in ONE C call. The per-line path pays a
         ctypes transition + GIL handoff per event; on a busy 1-core host
         that ping-pong (watch thread vs tick thread) dominated the parse
@@ -445,14 +540,22 @@ class EventParser:
         tick in a single GIL release. Records come back as LAZY views
         (ParsedBatch.record): fingerprints/flags/rv are array reads, and
         string fields decode only on first access — the steady-state echo
-        flood is dropped by fingerprint after touching just ns+name."""
+        flood is dropped by fingerprint after touching just ns+name.
+
+        With ``kind`` + ``n_shards`` >= 1 the SAME C call also computes
+        each event's lane (crc32, identical to rowpool.shard_of) and the
+        per-lane contiguous index runs — pre-partitioned routing; see
+        ParsedBatch. The list mirrors stay lazy on that path."""
         n = len(lines)
         if n == 0:
             return None
         blob, off = _blob([bytes(x) for x in lines])
-        return self._parse_packed(lines, blob, off, n)
+        return self._parse_packed(lines, blob, off, n, kind, n_shards)
 
-    def parse_blob(self, blob: bytes, off) -> "ParsedBatch | None":
+    def parse_blob(
+        self, blob: bytes, off, kind: "str | None" = None,
+        n_shards: int = 0,
+    ) -> "ParsedBatch | None":
         """parse_raw_batch over lines already packed as (blob, offsets) —
         the native WatchReader's wire format. Skips the per-line list and
         the _blob marshalling loop entirely; `.raw` on records slices the
@@ -461,9 +564,12 @@ class EventParser:
         if n <= 0:
             return None
         off_arr = np.ascontiguousarray(off, np.int64)
-        return self._parse_packed(_BlobLines(blob, off), blob, off_arr, n)
+        return self._parse_packed(
+            _BlobLines(blob, off), blob, off_arr, n, kind, n_shards
+        )
 
-    def _parse_packed(self, lines, blob: bytes, off: np.ndarray, n: int):
+    def _parse_packed(self, lines, blob: bytes, off: np.ndarray, n: int,
+                      kind: "str | None" = None, n_shards: int = 0):
         fp = np.zeros((4, n), np.uint64)
         flags = np.zeros(n, np.uint8)
         rvs = np.zeros(n, np.int64)
@@ -471,6 +577,20 @@ class EventParser:
         cap = max(4096, len(blob))
         buf = bytearray(cap)
         u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        ns_arg = int(n_shards) if (n_shards and kind is not None) else 0
+        if ns_arg:
+            shard = np.zeros(n, np.int32)
+            lane_idx = np.zeros(n, np.int32)
+            lane_off = np.zeros(ns_arg + 1, np.int64)
+            route_info = np.zeros(6, np.int64)
+            part_args = (
+                1 if kind == "pods" else 0, ns_arg,
+                shard.ctypes.data_as(i32p), lane_idx.ctypes.data_as(i32p),
+                _i64p(lane_off), _i64p(route_info),
+            )
+        else:
+            part_args = (0, 0, None, None, None, None)
         for _ in range(2):
             need = self._lib.kwok_parse_events(
                 blob, _i64p(off), n,
@@ -479,17 +599,23 @@ class EventParser:
                 flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
                 _i64p(rvs),
                 (ctypes.c_char * cap).from_buffer(buf), cap, _i64p(str_off),
+                *part_args,
             )
             if need <= cap:
                 break
             cap = int(need) + 1024
             buf = bytearray(cap)
-        # numpy scalar indexing costs ~10x a list index and the lazy
-        # records index per field: one tolist() per batch beats 11 numpy
-        # reads per record (profiled at 18us/event before this)
+        partition = None
+        if ns_arg:
+            partition = (
+                shard, lane_idx, lane_off.tolist(),
+                RouteInfo(*route_info.tolist()[:5]),
+            )
+        # lazy=partitioned: the router path never touches per-record list
+        # views — lanes convert on first need (ParsedBatch.ensure_lists)
         return ParsedBatch(
-            lines, bytes(buf[:min(cap, int(need))]), str_off.tolist(),
-            [row.tolist() for row in fp], flags.tolist(), rvs.tolist(),
+            lines, bytes(buf[:min(cap, int(need))]), str_off,
+            fp, flags, rvs, lazy=bool(ns_arg), partition=partition,
         )
 
     def parse_batch(self, lines: list) -> "list[EventRecord]":
@@ -508,6 +634,7 @@ class EventParser:
                 self._flags_p, self._rv_p,
                 (ctypes.c_char * self._cap).from_buffer(self._buf),
                 self._cap, self._str_off_p,
+                0, 0, None, None, None, None,
             )
             if need <= self._cap:
                 break

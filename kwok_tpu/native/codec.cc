@@ -261,6 +261,6 @@ int64_t kwok_render_pod_statuses(
 
 // Keep in lockstep with ABI_VERSION in native/__init__.py — a mismatch
 // triggers delete+rebuild loops (and bricks hosts without a compiler).
-int32_t kwok_codec_abi_version() { return 6; }
+int32_t kwok_codec_abi_version() { return 7; }
 
 }  // extern "C"

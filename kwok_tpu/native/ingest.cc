@@ -235,6 +235,55 @@ struct Span {
   bool present() const { return p != nullptr; }
 };
 
+// zlib-compatible CRC-32 (IEEE, reflected): the routing hash MUST equal
+// Python's zlib.crc32 over the same bytes, because rowpool.shard_of is the
+// key->lane contract the lane pools are built on. Table built on first use.
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xedb88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+
+const uint32_t* crc32_table() {
+  // C++11 magic static: ctypes drops the GIL around kwok_parse_events,
+  // so two engines in one process can race the first use — a plain
+  // ready-flag would let a thread read the table before its stores are
+  // visible and route a key to the wrong lane
+  static const Crc32Table table;
+  return table.t;
+}
+
+inline uint32_t crc32_update(uint32_t crc, const char* p, int64_t n) {
+  const uint32_t* t = crc32_table();
+  for (int64_t i = 0; i < n; i++)
+    crc = t[(crc ^ (unsigned char)p[i]) & 0xffu] ^ (crc >> 8);
+  return crc;
+}
+
+// shard_of(key, n) for the two key shapes the row pools use: node keys are
+// the name; pod keys are (namespace or "default", name) joined by \x1f —
+// exactly rowpool.shard_of's "\x1f".join(...).encode(). Raw token bytes are
+// what the Python router hashes too (decode("utf-8")/encode() round-trips
+// them), so the mapping is provably unchanged.
+inline int32_t shard_of_event(const Span& ns, const Span& name,
+                              int kind_is_pods, int32_t n_shards) {
+  if (n_shards <= 1) return 0;
+  uint32_t crc = 0xffffffffu;
+  if (kind_is_pods) {
+    if (ns.n > 0) crc = crc32_update(crc, ns.p, ns.n);
+    else crc = crc32_update(crc, "default", 7);
+    crc = crc32_update(crc, "\x1f", 1);
+  }
+  crc = crc32_update(crc, name.p, name.n);
+  return (int32_t)((crc ^ 0xffffffffu) % (uint32_t)n_shards);
+}
+
 bool span_eq(const Span& s, const char* lit) {
   int64_t n = (int64_t)strlen(lit);
   return s.n == n && memcmp(s.p, lit, n) == 0;
@@ -682,12 +731,35 @@ extern "C" {
 // condition types with status True joined by \x1f. Returns total string
 // bytes needed (if > str_cap, call again with a bigger buffer).
 // flags bit 0 = parse ok, 1 = has_deletion, 2 = has_finalizers,
-// 3 = has_readiness_gates, 4 = status has scalar-replace keys only.
+// 3 = has_readiness_gates, 4 = status has scalar-replace keys only;
+// bits 5-6 = event type code (1 ADDED, 2 MODIFIED, 3 DELETED, 0 other).
+//
+// Pre-partitioned routing (ABI 7): with n_shards >= 1 the parser also
+// computes each event's lane (shard_of_event — the same crc32 mapping as
+// rowpool.shard_of) and counting-sorts routable records into per-lane
+// contiguous index runs, so the engine's router hands each lane ONE
+// zero-copy sub-batch instead of hashing+dispatching per event in Python:
+//   shard_out[i]: lane id >= 0, or -1 (record without a name, or with
+//                 JSON escapes in ns/name — either way only the Python
+//                 router can place it), -2 (ERROR event), -3 (BOOKMARK)
+//   lane_idx[ /  lane_off ]: routable record indexes partitioned by lane
+//                 (stable: original order within each lane); lane_off has
+//                 n_shards+1 entries
+//   route_info: [0] the resume revision a full Python walk would commit:
+//               the latest metadata rv, ZEROED once an ERROR appears
+//               (rv_dead — nothing before or after a stream error
+//               commits), [1] index of the first ERROR event or -1,
+//               [2] bookmark count,
+//               [3] routable count, [4] nameless-record count
+// With n_shards == 0 the four routing outputs may be null (legacy paths).
 int64_t kwok_parse_events(
     const char* blob, const int64_t* off, int32_t n,
     uint64_t* fp_status, uint64_t* fp_status_nc, uint64_t* fp_spec,
     uint64_t* fp_meta_sel, uint8_t* flags, int64_t* rv_out,
-    char* str_out, int64_t str_cap, int64_t* str_off) {
+    char* str_out, int64_t str_cap, int64_t* str_off,
+    int32_t kind_is_pods, int32_t n_shards,
+    int32_t* shard_out, int32_t* lane_idx, int64_t* lane_off,
+    int64_t* route_info) {
   int64_t used = 0;
   auto put_bytes = [&](const char* p, int64_t len) {
     if (p && len > 0) {
@@ -712,6 +784,11 @@ int64_t kwok_parse_events(
   auto has_esc = [](const Span& s) {
     return s.p && s.n > 0 && memchr(s.p, '\\', (size_t)s.n) != nullptr;
   };
+  int64_t latest_rv = 0;
+  int64_t first_error = -1;
+  int64_t bookmarks = 0;
+  int64_t routable = 0;
+  int64_t nameless = 0;
   for (int32_t i = 0; i < n; i++) {
     Event ev;
     parse_event(blob + off[i], off[i + 1] - off[i], ev);
@@ -720,6 +797,44 @@ int64_t kwok_parse_events(
     fp_spec[i] = ev.fp_spec;
     fp_meta_sel[i] = ev.fp_meta_sel;
     rv_out[i] = ev.rv;
+    uint8_t tcode = 0;
+    if (span_eq(ev.type, "ADDED")) tcode = 1;
+    else if (span_eq(ev.type, "MODIFIED")) tcode = 2;
+    else if (span_eq(ev.type, "DELETED")) tcode = 3;
+    if (n_shards >= 1) {
+      int32_t shard;
+      if (span_eq(ev.type, "ERROR")) {
+        shard = -2;
+        if (first_error < 0) {
+          first_error = i;
+          // match the Python walk exactly: an ERROR zeroes the pending
+          // resume revision (rv_dead) — the pre-error rv must not be
+          // committable either
+          latest_rv = 0;
+        }
+      } else if (span_eq(ev.type, "BOOKMARK")) {
+        shard = -3;
+        bookmarks++;
+      } else if (ev.name.n > 0 &&
+                 !memchr(ev.name.p, '\\', (size_t)ev.name.n) &&
+                 !(ev.ns.n > 0 &&
+                   memchr(ev.ns.p, '\\', (size_t)ev.ns.n))) {
+        shard = shard_of_event(ev.ns, ev.name, kind_is_pods, n_shards);
+        routable++;
+      } else {
+        // no name, or JSON escapes in ns/name: the Python router hashes
+        // the DECODED string while we'd hash raw token bytes — the same
+        // key could land on two different lanes across the fast/slow
+        // paths. Classify as unrouteable so the whole batch takes the
+        // per-record Python walk (one router, one mapping).
+        shard = -1;
+        nameless++;
+      }
+      shard_out[i] = shard;
+      // the resume-revision walk _drain_flush_kind used to do per record:
+      // nothing after a stream ERROR counts
+      if (ev.rv && first_error < 0) latest_rv = ev.rv;
+    }
     // JSON escapes in any extracted string downgrade the record: the
     // fast path ships raw token bytes, which would mis-render escaped
     // values (the Python side used to re-scan every field for this;
@@ -743,6 +858,7 @@ int64_t kwok_parse_events(
                           (ev.status_scalar_only << 4));
     if (esc_str || esc_blob) f = (uint8_t)(f & ~1u);
     if (esc_blob) f = (uint8_t)(f & ~16u);
+    f = (uint8_t)(f | (tcode << 5));
     flags[i] = f;
     int64_t base = (int64_t)i * 11;
     put(ev.type, base + 0);
@@ -762,6 +878,25 @@ int64_t kwok_parse_events(
     }
   }
   str_off[(int64_t)n * 11] = used;
+  if (n_shards >= 1) {
+    // counting sort of routable records into per-lane contiguous runs
+    // (stable: original order within each lane == the order the Python
+    // per-event router would have enqueued them)
+    for (int32_t s = 0; s <= n_shards; s++) lane_off[s] = 0;
+    for (int32_t i = 0; i < n; i++)
+      if (shard_out[i] >= 0) lane_off[shard_out[i] + 1]++;
+    for (int32_t s = 0; s < n_shards; s++) lane_off[s + 1] += lane_off[s];
+    std::vector<int64_t> cursor(lane_off, lane_off + n_shards);
+    for (int32_t i = 0; i < n; i++) {
+      int32_t s = shard_out[i];
+      if (s >= 0) lane_idx[cursor[s]++] = i;
+    }
+    route_info[0] = latest_rv;
+    route_info[1] = first_error;
+    route_info[2] = bookmarks;
+    route_info[3] = routable;
+    route_info[4] = nameless;
+  }
   return used;
 }
 

@@ -75,11 +75,28 @@ def update_rows(state: RowState, b: UpdateBatch) -> RowState:
     )
 
 
+class _InitBlock(NamedTuple):
+    """A columnar run of active-row inits staged as whole arrays (the
+    batched survivor-ingest path): one append instead of n tuple appends,
+    and flush slices arrays instead of np.fromiter over tuples."""
+
+    idx: np.ndarray  # int32
+    phase: np.ndarray  # int32
+    cond_bits: np.ndarray  # uint32
+    sel_bits: np.ndarray  # uint32
+    has_deletion: np.ndarray  # bool
+
+
 class UpdateBuffer:
     """Host-side accumulator that flushes padded batches to device."""
 
     def __init__(self) -> None:
-        self._init: list[tuple[int, bool, int, int, int, bool]] = []
+        # mixed per-row tuples and _InitBlock runs, in STAGING ORDER: a
+        # row released (tuple init False) then re-acquired by a columnar
+        # block (or vice versa) must flush in that order, or the stale
+        # write wins on device
+        self._init: list = []
+        self._n_init = 0  # staged init ROWS (blocks count their length)
         self._upd: list[tuple[int, int, bool]] = []
 
     def stage_init(
@@ -92,51 +109,139 @@ class UpdateBuffer:
         has_deletion: bool = False,
     ) -> None:
         self._init.append((idx, active, phase, cond_bits, sel_bits, has_deletion))
+        self._n_init += 1
+
+    def stage_init_array(
+        self,
+        idx: np.ndarray,
+        phase,
+        cond_bits: np.ndarray,
+        sel_bits: np.ndarray,
+        has_deletion: np.ndarray,
+    ) -> None:
+        """Stage a columnar run of ACTIVE row inits. `phase` may be a
+        scalar (the survivor path: every new row starts Pending)."""
+        n = int(idx.shape[0])
+        if not n:
+            return
+        ph = np.asarray(phase, np.int32)
+        if ph.ndim == 0:
+            ph = np.full(n, ph, np.int32)
+        self._init.append(_InitBlock(
+            idx=np.ascontiguousarray(idx, np.int32),
+            phase=ph,
+            cond_bits=np.ascontiguousarray(cond_bits, np.uint32),
+            sel_bits=np.ascontiguousarray(sel_bits, np.uint32),
+            has_deletion=np.ascontiguousarray(has_deletion, bool),
+        ))
+        self._n_init += n
 
     def stage_update(self, idx: int, sel_bits: int, has_deletion: bool) -> None:
         self._upd.append((idx, sel_bits, has_deletion))
 
     @property
     def pending(self) -> int:
-        return len(self._init) + len(self._upd)
+        return self._n_init + len(self._upd)
+
+    @staticmethod
+    def _flush_tuples(state: RowState, chunk: list, cap: int,
+                      off: np.int32) -> RowState:
+        while chunk:
+            width = BATCH_LARGE if len(chunk) > BATCH else BATCH
+            part, chunk = chunk[:width], chunk[width:]
+            n = len(part)
+            pad = width - n
+            b = InitBatch(
+                idx=np.concatenate(
+                    [np.fromiter((c[0] for c in part), np.int32, n) + off,
+                     np.full(pad, cap, np.int32)]
+                ),
+                active=np.concatenate(
+                    [np.fromiter((c[1] for c in part), bool, n), np.zeros(pad, bool)]
+                ),
+                phase=np.concatenate(
+                    [np.fromiter((c[2] for c in part), np.int32, n),
+                     np.zeros(pad, np.int32)]
+                ),
+                cond_bits=np.concatenate(
+                    [np.fromiter((c[3] for c in part), np.uint32, n),
+                     np.zeros(pad, np.uint32)]
+                ),
+                sel_bits=np.concatenate(
+                    [np.fromiter((c[4] for c in part), np.uint32, n),
+                     np.zeros(pad, np.uint32)]
+                ),
+                has_deletion=np.concatenate(
+                    [np.fromiter((c[5] for c in part), bool, n), np.zeros(pad, bool)]
+                ),
+            )
+            state = init_rows(state, b)
+        return state
+
+    @staticmethod
+    def _flush_block(state: RowState, blk: "_InitBlock", cap: int,
+                     off: np.int32) -> RowState:
+        n = int(blk.idx.shape[0])
+        pos = 0
+        while pos < n:
+            width = BATCH_LARGE if n - pos > BATCH else BATCH
+            take = min(width, n - pos)
+            pad = width - take
+            sl = slice(pos, pos + take)
+            b = InitBatch(
+                idx=np.concatenate(
+                    [blk.idx[sl] + off, np.full(pad, cap, np.int32)]
+                ),
+                active=np.concatenate(
+                    [np.ones(take, bool), np.zeros(pad, bool)]
+                ),
+                phase=np.concatenate(
+                    [blk.phase[sl], np.zeros(pad, np.int32)]
+                ),
+                cond_bits=np.concatenate(
+                    [blk.cond_bits[sl], np.zeros(pad, np.uint32)]
+                ),
+                sel_bits=np.concatenate(
+                    [blk.sel_bits[sl], np.zeros(pad, np.uint32)]
+                ),
+                has_deletion=np.concatenate(
+                    [blk.has_deletion[sl], np.zeros(pad, bool)]
+                ),
+            )
+            state = init_rows(state, b)
+            pos += take
+        return state
 
     def flush(self, state: RowState, offset: int = 0) -> RowState:
         """Apply staged writes. `offset` shifts row indices (a cluster's slice
         of a federated stacked state). Padding lanes use the TARGET state's
         capacity as their index, which is always out of bounds under
-        mode='drop' regardless of offset."""
+        mode='drop' regardless of offset. Staged inits are cleared only
+        after EVERY entry applied: on a mid-flush device error the caller
+        discards the partially-applied state (RowState is functional), so
+        the whole window stays staged and the next flush re-applies it
+        from the start — row init is an idempotent overwrite, and the
+        alternative (dropping consumed entries whose writes died with the
+        raise) would strand acquired pool rows that never activate."""
         cap = state.capacity
         off = np.int32(offset)
-        while self._init:
-            width = BATCH_LARGE if len(self._init) > BATCH else BATCH
-            chunk, self._init = self._init[:width], self._init[width:]
-            n = len(chunk)
-            pad = width - n
-            b = InitBatch(
-                idx=np.concatenate(
-                    [np.fromiter((c[0] for c in chunk), np.int32, n) + off,
-                     np.full(pad, cap, np.int32)]
-                ),
-                active=np.concatenate(
-                    [np.fromiter((c[1] for c in chunk), bool, n), np.zeros(pad, bool)]
-                ),
-                phase=np.concatenate(
-                    [np.fromiter((c[2] for c in chunk), np.int32, n),
-                     np.zeros(pad, np.int32)]
-                ),
-                cond_bits=np.concatenate(
-                    [np.fromiter((c[3] for c in chunk), np.uint32, n),
-                     np.zeros(pad, np.uint32)]
-                ),
-                sel_bits=np.concatenate(
-                    [np.fromiter((c[4] for c in chunk), np.uint32, n),
-                     np.zeros(pad, np.uint32)]
-                ),
-                has_deletion=np.concatenate(
-                    [np.fromiter((c[5] for c in chunk), bool, n), np.zeros(pad, bool)]
-                ),
-            )
-            state = init_rows(state, b)
+        init = self._init
+        pos = 0
+        while pos < len(init):
+            entry = init[pos]
+            if isinstance(entry, _InitBlock):
+                state = self._flush_block(state, entry, cap, off)
+                pos += 1
+            else:
+                end = pos + 1
+                while end < len(init) and not isinstance(
+                    init[end], _InitBlock
+                ):
+                    end += 1
+                state = self._flush_tuples(state, init[pos:end], cap, off)
+                pos = end
+        self._init = []
+        self._n_init = 0
         while self._upd:
             width = BATCH_LARGE if len(self._upd) > BATCH else BATCH
             chunk, self._upd = self._upd[:width], self._upd[width:]

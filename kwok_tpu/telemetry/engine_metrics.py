@@ -55,6 +55,13 @@ _HELP = {
     "unlabeled kwok_tick_stage_seconds{stage=parse})",
     "kwok_lane_queue_depth": "Routed events waiting in a lane's ingest "
     "queue (shard=lane index)",
+    "kwok_route_batch_seconds": "Wall seconds per native pre-partitioned "
+    "route handoff (the router's per-batch lane enqueue; the C parse that "
+    "computed the partition stays in kwok_tick_stage_seconds{stage=parse})",
+    "kwok_route_partition_events_total": "Events routed to each lane via "
+    "the native pre-partitioned parse (shard=lane index; per-event Python "
+    "routing does not count here — compare with kwok_watch_events_total "
+    "to see the fast-path share)",
 }
 
 # legacy counter name -> (family name, has kind label)
@@ -148,6 +155,13 @@ class EngineTelemetry:
                 "kwok_pump_send_seconds", _HELP["kwok_pump_send_seconds"], base
             )
         )
+        self.route_batch_hist = child(
+            r.histogram(
+                "kwok_route_batch_seconds",
+                _HELP["kwok_route_batch_seconds"],
+                base,
+            )
+        )
         self._rtt_fam = r.histogram(
             "kwok_patch_rtt_seconds",
             _HELP["kwok_patch_rtt_seconds"],
@@ -194,6 +208,9 @@ class EngineTelemetry:
 
     def observe_stage(self, stage: str, seconds: float) -> None:
         self.stage_hists[stage].observe(seconds)
+
+    def observe_route_batch(self, seconds: float) -> None:
+        self.route_batch_hist.observe(seconds)
 
     def observe_watch_lag(self, seconds: float) -> None:
         self.lag_hist.observe(seconds)
@@ -279,10 +296,18 @@ class LaneTelemetry:
             _HELP["kwok_lane_queue_depth"],
             ("shard",),
         ).labels(shard=self.lane_id)
+        self._routed = r.counter(
+            "kwok_route_partition_events_total",
+            _HELP["kwok_route_partition_events_total"],
+            ("shard",),
+        ).labels(shard=self.lane_id)
 
     def observe_stage(self, stage: str, seconds: float) -> None:
         self.stage_hists[stage].observe(seconds)
         self.parent.observe_stage(stage, seconds)
+
+    def inc_routed(self, n: int) -> None:
+        self._routed.inc(n)
 
     def set_queue_depth(self, depth: int) -> None:
         self._depth.set(depth)

@@ -410,3 +410,337 @@ def test_pump_primed_before_workers():
         assert all(lane.engine._pump_tried for lane in eng._lanes.lanes)
     finally:
         eng.stop()
+
+
+# --------------------------------------------------------------------------
+# Native pre-partitioned routing (ingest.cc ABI 7): the C parser computes
+# each event's lane and hands the router per-lane contiguous sub-batches.
+# Two contracts pinned here: (1) the C crc32 key->lane mapping IS
+# rowpool.shard_of, (2) per-key patch order under the native router is
+# byte-identical to the per-event Python route loop on the same raw event
+# stream — including XUPD cross-lane managed-ness flips and a mid-run lane
+# regrow.
+
+import json
+import re
+
+
+def _raw_line(obj, type_="ADDED"):
+    return json.dumps(
+        {"type": type_, "object": obj}, separators=(",", ":")
+    ).encode()
+
+
+def test_native_partition_shard_parity():
+    """C-side shard ids == rowpool.shard_of for both key shapes (pods:
+    (ns|default, name); nodes: name), across shard counts."""
+    from kwok_tpu import native
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    parser = native.EventParser()
+    pods = [
+        make_pod(f"pp-{i}", node="n0", ns=("default" if i % 3 else "kube-sys"))
+        for i in range(64)
+    ]
+    # namespace ABSENT entirely: the router defaults it to "default"
+    bare = {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "no-ns"},
+            "spec": {"nodeName": "n0", "containers": []},
+            "status": {"phase": "Pending"}}
+    lines = [_raw_line(p) for p in pods] + [_raw_line(bare)]
+    for n in (2, 4, 8):
+        b = parser.parse_raw_batch(lines, kind="pods", n_shards=n)
+        for i in range(b.n):
+            rec = b.record(i)
+            key = (rec.namespace or "default", rec.name)
+            assert b.shard[i] == shard_of(key, n), (key, n)
+        # lane runs: stable order, complete cover of routable records
+        seen = []
+        for li in range(n):
+            run = b.lane_idx[b.lane_off[li]: b.lane_off[li + 1]].tolist()
+            assert run == sorted(run)
+            assert all(b.shard[i] == li for i in run)
+            seen += run
+        assert sorted(seen) == list(range(b.n))
+    nlines = [_raw_line(make_node(f"nn-{i}")) for i in range(64)]
+    nb = parser.parse_raw_batch(nlines, kind="nodes", n_shards=4)
+    for i in range(nb.n):
+        assert nb.shard[i] == shard_of(nb.record(i).name, 4)
+
+
+_TS_RE = re.compile(rb'"\d{4}-\d{2}-\d{2}T[^"]*"')
+
+
+class ByteRecordingKube(RecordingKube):
+    """RecordingKube that additionally logs the canonicalized patch BODY
+    (sorted keys, RFC3339 timestamps masked — wall-clock strings are the
+    one legitimate difference between two runs), so the oracle compares
+    per-key emissions byte for byte, not just (op, phase)."""
+
+    def patch_status(self, kind, ns, name, body):
+        key = (ns or "default", name) if kind == "pods" else name
+        data = _TS_RE.sub(
+            b'"T"', json.dumps(body, sort_keys=True).encode()
+        )
+        self.log.append((key, "patch_body", data))
+        return super().patch_status(kind, ns, name, body)
+
+
+def _run_raw_script(eng, server, keys, node="rn0"):
+    """Lifecycle script fed as RAW watch-line bytes (the production wire
+    shape — what the batch parser partitions): pods land BEFORE their node
+    (the node's later arrival flips managed-ness via routed XUPD), then a
+    status revert (repair re-patch), then deletionTimestamp (engine-driven
+    delete)."""
+    for ns, name in keys:
+        server.create("pods", make_pod(name, node=node, ns=ns))
+        eng._q.put((
+            "pods", "RAW",
+            _raw_line(server.get("pods", "default", name)), time.monotonic(),
+        ))
+    _pump(eng, 2)  # ingested unmanaged: no node yet
+    server.create("nodes", make_node(node))
+    eng._q.put((
+        "nodes", "RAW",
+        _raw_line(server.get("nodes", None, node)), time.monotonic(),
+    ))
+    _pump(eng, 3)  # node managed -> XUPD fan-out -> Pending->Running wave
+    for ns, name in keys:
+        obj = server.get("pods", "default", name)
+        obj = {**obj, "status": {"phase": "Pending"}}
+        eng._q.put(("pods", "RAW", _raw_line(obj, "MODIFIED"),
+                    time.monotonic()))
+    _pump(eng, 2)
+    for ns, name in keys:
+        obj = server.get("pods", "default", name)
+        obj = {
+            **obj,
+            "metadata": {
+                **obj["metadata"],
+                "deletionTimestamp": "2026-01-01T00:00:00Z",
+            },
+        }
+        eng._q.put(("pods", "RAW", _raw_line(obj, "MODIFIED"),
+                    time.monotonic()))
+    _pump(eng, 3)
+
+
+def test_ordering_oracle_native_vs_python_router(monkeypatch):
+    """The tentpole oracle: the native pre-partitioned router against the
+    per-event Python shard_of route loop on the SAME raw event stream —
+    per-key patch sequences must match byte for byte, each key must live
+    in the same single lane under both, and the stream is sized to force
+    a mid-run lane regrow."""
+    from kwok_tpu import native
+    from kwok_tpu.engine import lanes as lanes_mod
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    # shrink the per-lane row floor so the ADDED flood crosses the lane
+    # budget and triggers LaneSet._regrow organically mid-run
+    monkeypatch.setattr(lanes_mod, "_MIN_LANE_ROWS", 64)
+    keys = [("default", f"orc{i}") for i in range(600)]
+
+    def build(native_route: bool):
+        kube = ByteRecordingKube()
+        eng = ClusterEngine(
+            kube,
+            EngineConfig(
+                manage_all_nodes=True, drain_shards=4,
+                initial_capacity=256,
+            ),
+        )
+        eng._native_route = native_route
+        start_r = eng._lanes.r
+        _run_raw_script(eng, kube, keys)
+        return kube, eng, start_r
+
+    ref_kube, ref_eng, ref_r0 = build(native_route=False)
+    got_kube, got_eng, got_r0 = build(native_route=True)
+
+    # the stream really regrew the lanes mid-run (both arms identically)
+    assert got_eng._lanes.r > got_r0
+    assert got_eng._lanes.r == ref_eng._lanes.r
+    # the native arm actually used the partitioned fast path
+    routed = sum(
+        lane.telemetry._routed.value for lane in got_eng._lanes.lanes
+    )
+    assert routed >= len(keys)
+    for key in keys:
+        assert got_kube.per_key(key) == ref_kube.per_key(key), (
+            f"per-key emission diverged for {key}"
+        )
+        # identical single-lane residency under both routers
+        owners = [
+            [
+                lane.index
+                for lane in eng._lanes.lanes
+                if lane.engine.pods.pool.lookup(key) is not None
+            ]
+            for eng in (ref_eng, got_eng)
+        ]
+        assert owners[0] == owners[1]
+    # the script exercised all three op classes
+    some = ref_kube.per_key(keys[0])
+    assert any(op == "patch_body" for _k, op, _b in ref_kube.log)
+    assert ("delete", None) in [(o, b) for _k, o, b in ref_kube.log]
+    assert len({shard_of(k, 4) for k in keys}) == 4
+    del some
+
+
+def test_update_buffer_block_order_preserved():
+    """A columnar init block and a later per-row release for the SAME row
+    must flush in staging order (the stale write must not win)."""
+    from kwok_tpu.ops.state import new_row_state
+    from kwok_tpu.ops.updates import UpdateBuffer
+
+    buf = UpdateBuffer()
+    buf.stage_init_array(
+        np.array([3, 4], np.int32), 1,
+        np.array([0, 0], np.uint32), np.array([3, 3], np.uint32),
+        np.array([False, False], bool),
+    )
+    buf.stage_init(3, False)  # row released after the block staged it
+    state = buf.flush(new_row_state(8))
+    assert not bool(np.asarray(state.active)[3])
+    assert bool(np.asarray(state.active)[4])
+    # and the reverse: release first, block re-acquires
+    buf2 = UpdateBuffer()
+    buf2.stage_init(5, False)
+    buf2.stage_init_array(
+        np.array([5], np.int32), 2, np.array([7], np.uint32),
+        np.array([1], np.uint32), np.array([False], bool),
+    )
+    assert buf2.pending == 2
+    state2 = buf2.flush(new_row_state(8))
+    assert bool(np.asarray(state2.active)[5])
+    assert int(np.asarray(state2.phase)[5]) == 2
+    assert int(np.asarray(state2.cond_bits)[5]) == 7
+
+
+def test_update_buffer_flush_failure_keeps_unapplied_tail(monkeypatch):
+    """A mid-flush device error must leave the WHOLE init window staged:
+    the caller discards the partially-applied state on a raise (RowState
+    is functional), so dropping any consumed entry would strand rows
+    acquired in the host pool with seeded fingerprints but never
+    activated on device. The retry re-applies from the start —
+    idempotent overwrites."""
+    from kwok_tpu.ops import updates as upd_mod
+    from kwok_tpu.ops.state import new_row_state
+    from kwok_tpu.ops.updates import UpdateBuffer
+
+    buf = UpdateBuffer()
+    buf.stage_init(1, True, 1, 0, 3)
+    buf.stage_init_array(
+        np.array([2], np.int32), 1, np.array([0], np.uint32),
+        np.array([3], np.uint32), np.array([False], bool),
+    )
+    buf.stage_init(3, True, 1, 0, 3)
+    calls = {"n": 0}
+    real = upd_mod.init_rows
+
+    def flaky(state, b):
+        calls["n"] += 1
+        if calls["n"] == 2:  # die on the block, after the first tuple run
+            raise RuntimeError("transient device error")
+        return real(state, b)
+
+    monkeypatch.setattr(upd_mod, "init_rows", flaky)
+    state = new_row_state(8)
+    with pytest.raises(RuntimeError):
+        state = buf.flush(state)
+    # nothing was dropped: the applied prefix's writes died with the
+    # discarded state, so it must retry along with the block and tail
+    assert buf.pending == 3
+    monkeypatch.setattr(upd_mod, "init_rows", real)
+    state = buf.flush(state)
+    assert buf.pending == 0
+    active = np.asarray(state.active)
+    assert bool(active[1]) and bool(active[2]) and bool(active[3])
+
+
+def test_columnar_flush_failure_rolls_back_and_replays(monkeypatch):
+    """A failure inside the columnar flush (injected: stage_init_array
+    dying on its first block) must not strand acquired-but-never-staged
+    rows: flush_cols releases them before re-raising, so the per-record
+    replay takes the NEW-row path and every pod still activates and
+    converges — the silent-pod-loss mode where a half-applied window left
+    rows in the pool that no stage_init ever activated."""
+    from kwok_tpu import native
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    server = FakeKube()
+    eng = ClusterEngine(
+        server, EngineConfig(manage_all_nodes=True, drain_shards=2)
+    )
+    assert eng._native_route
+    server.create("nodes", make_node("cb0"))
+    eng._q.put((
+        "nodes", "RAW",
+        _raw_line(server.get("nodes", None, "cb0")), time.monotonic(),
+    ))
+    _pump(eng, 2)
+    # arm every lane's pod buffer AFTER the node tick (buffer instances
+    # are swapped out at each flush): first columnar block per lane dies
+    calls = {"n": 0}
+    for lane in eng._lanes.lanes:
+        buf = lane.engine.pods.buffer
+        real = buf.stage_init_array
+
+        def flaky(*a, __real=real, **kw):
+            calls["n"] += 1
+            if calls["n"] <= 1:
+                raise RuntimeError("injected columnar failure")
+            return __real(*a, **kw)
+
+        monkeypatch.setattr(buf, "stage_init_array", flaky)
+    keys = [("default", f"cbp{i}") for i in range(24)]
+    for ns, name in keys:
+        server.create("pods", make_pod(name, node="cb0", ns=ns))
+        eng._q.put((
+            "pods", "RAW",
+            _raw_line(server.get("pods", "default", name)),
+            time.monotonic(),
+        ))
+    _pump(eng, 3)
+    assert calls["n"] >= 1, "injected failure never reached flush_cols"
+    for ns, name in keys:
+        assert (
+            server.get("pods", "default", name)["status"]["phase"]
+            == "Running"
+        ), (ns, name)
+    # every key owns exactly one ACTIVE row in exactly one lane
+    for key in keys:
+        owners = [
+            lane
+            for lane in eng._lanes.lanes
+            if lane.engine.pods.pool.lookup(key) is not None
+        ]
+        assert len(owners) == 1, key
+
+
+def test_route_info_rv_dead_on_error_batch():
+    """route_info.latest_rv mirrors the Python walk's rv_dead semantics:
+    an ERROR event zeroes the batch's committable resume revision — the
+    PRE-error rv must not be resurrectable by a future fast-path consumer
+    (the walk refuses to commit anything once a stream error appears)."""
+    from kwok_tpu import native
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    parser = native.EventParser()
+    pod = make_pod("rvp0", node="n0")
+    pod["metadata"]["resourceVersion"] = "123"
+    lines = [
+        _raw_line(pod),
+        b'{"type":"ERROR","object":{"code":410,"message":"expired"}}',
+    ]
+    b = parser.parse_raw_batch(lines, kind="pods", n_shards=2)
+    assert b.route_info.first_error == 1
+    assert b.route_info.latest_rv == 0
+    # and without the ERROR the rv commits
+    b2 = parser.parse_raw_batch(lines[:1], kind="pods", n_shards=2)
+    assert b2.route_info.first_error == -1
+    assert b2.route_info.latest_rv == 123
