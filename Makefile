@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check image cluster-image clean
 
 all: build
 
@@ -42,6 +42,16 @@ lane-check: ## sharded-lane ordering oracle + thread-sanity + lock-witness pass 
 	$(PYENV) PYTHONDEVMODE=1 KWOK_TPU_LOCK_WITNESS=1 python3 -m pytest \
 	    tests/test_lanes.py tests/test_engine.py tests/test_pipeline.py -q
 	$(PYENV) python3 benchmarks/route_micro.py --check
+
+# chaos-check: the resilience suite (fault plane, retry policy, watchdog,
+# pump partial-write recovery, shedding) plus the chaos convergence gate:
+# the threaded 4-lane engine through a seeded fault storm — pump drops +
+# mid-frame partial writes, watch cuts, 410/compaction storms, apiserver
+# blackouts, a killed drain worker AND a killed emit worker — must end
+# byte-identical to a fault-free run (docs/resilience.md; CHAOS_r*.json).
+chaos-check: ## deterministic fault-injection + self-healing convergence gate
+	$(PYENV) python3 -m pytest tests/test_resilience.py -q
+	$(PYENV) python3 benchmarks/chaos_soak.py --check
 
 image:
 	./images/kwok/build.sh

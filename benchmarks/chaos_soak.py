@@ -1,0 +1,320 @@
+"""Chaos convergence gate: the threaded multi-lane engine through a fault
+storm must end byte-identical to a fault-free run.
+
+Two runs of the same creates-only workload against the HTTP mock
+apiserver (native pump + native/raw ingest engaged, i.e. the REAL I/O
+boundaries the fault plane wraps):
+
+- baseline: no faults;
+- chaos: the resilience fault plane injects pump connection drops,
+  mid-frame partial writes and send delays, watch stream cuts, 410
+  compaction storms on resume, list failures and apiserver-restart
+  blackout windows — and mid-churn a drain worker AND an emit worker are
+  killed with chaos pills the watchdog must absorb. The fault window
+  then closes the way a real outage ends (rates zeroed), the server
+  compacts + cuts the streams (410 -> full re-list), and the engine must
+  CONVERGE: every pod phase identical to the baseline run, per-key patch
+  order preserved (server-side oplog, consecutive duplicates collapsed —
+  pump resend is at-least-once by design), killed workers restarted
+  within policy, every lane queue drained.
+
+Emits a CHAOS_r*.json artifact (fault counts, restart/recovery
+latencies, gate verdicts). ``--check`` (the `make chaos-check` / CI
+entry) runs a smaller workload and exits nonzero if any gate fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the storm: every fault kind the plane speaks, rates sized so a ~10s
+# churn window sees each kind fire at least once but the engine is never
+# permanently wedged (seed pins the whole storm — reruns are identical)
+CHAOS_SPEC = (
+    "seed={seed};pump.drop=0.08;pump.partial=0.08;pump.delay=0.1:0.002;"
+    "watch.cut=0.03;watch.expire=0.4;list.fail=0.15;api.blackout=0.01:0.2"
+)
+
+
+def _make_pod(name: str, node: str) -> dict:
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"nodeName": node,
+                 "containers": [{"name": "c", "image": "busybox"}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def _make_node(name: str) -> dict:
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name},
+        "status": {"phase": ""},
+    }
+
+
+def _recording_store():
+    """FakeKube whose pod-facing write verbs keep an arrival-order oplog
+    (server side, so pump-delivered AND client-delivered writes are both
+    seen). List appends are GIL-atomic."""
+    from kwok_tpu.edge.mockserver import FakeKube
+
+    class RecordingStore(FakeKube):
+        def __init__(self):
+            super().__init__()
+            self.oplog: list = []  # (key, op, phase-or-None)
+
+        def _note(self, kind, namespace, name, patch):
+            if kind != "pods":
+                return
+            phase = None
+            if isinstance(patch, dict):
+                phase = (patch.get("status") or {}).get("phase")
+            self.oplog.append(((namespace or "default", name), "patch", phase))
+
+        def patch_status(self, kind, namespace, name, patch):
+            self._note(kind, namespace, name, patch)
+            return super().patch_status(kind, namespace, name, patch)
+
+        def patch_status_bytes(self, kind, namespace, name, patch):
+            if isinstance(patch, (bytes, bytearray, memoryview)):
+                patch = json.loads(bytes(patch))
+            self._note(kind, namespace, name, patch)
+            return super().patch_status_bytes(kind, namespace, name, patch)
+
+        def delete(self, kind, namespace, name, **kw):
+            if kind == "pods":
+                self.oplog.append(
+                    ((namespace or "default", name), "delete", None)
+                )
+            return super().delete(kind, namespace, name, **kw)
+
+        def per_key_collapsed(self, key):
+            """The ordering oracle's view: consecutive duplicates collapse
+            (pump whole-frame resend is at-least-once: a request whose
+            response died on the wire is legitimately replayed)."""
+            out = []
+            for k, op, ph in list(self.oplog):
+                if k == key and (not out or out[-1] != (op, ph)):
+                    out.append((op, ph))
+            return out
+
+    return RecordingStore()
+
+
+def _wait(pred, timeout, every=0.05) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _pod_phases(store, names) -> dict:
+    return {
+        n: (store.get("pods", "default", n) or {})
+        .get("status", {}).get("phase")
+        for n in names
+    }
+
+
+def _run(pods: int, lanes: int, seed: int, chaos: bool, timeout: float) -> dict:
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+    from kwok_tpu.telemetry.errors import worker_restarts_total
+
+    store = _recording_store()
+    srv = HttpFakeApiserver(store=store).start()
+    url = f"http://127.0.0.1:{srv.port}"
+    names = [f"cs{i}" for i in range(pods)]
+    nodes = [f"csn{i}" for i in range(4)]
+    kill_targets = ["kwok-lane1", f"kwok-emit{min(2, lanes - 1)}"]
+    restarts0 = {n: worker_restarts_total(n) for n in kill_targets}
+    spec = CHAOS_SPEC.format(seed=seed) if chaos else ""
+    eng = ClusterEngine(
+        HttpKubeClient.from_kubeconfig(None, url),
+        EngineConfig(
+            manage_all_nodes=True, tick_interval=0.02, drain_shards=lanes,
+            faults=spec,
+        ),
+    )
+    out: dict = {"mode": "chaos" if chaos else "baseline"}
+    t_run0 = time.time()
+    eng.start()
+    try:
+        for n in nodes:
+            store.create("nodes", _make_node(n))
+        half = pods // 2
+        for n in names[:half]:
+            store.create("pods", _make_pod(n, nodes[hash(n) % len(nodes)]))
+        if chaos:
+            # mid-churn chaos pills: one drain worker, one emit worker —
+            # the watchdog must absorb both and restart them in place
+            time.sleep(0.5)
+            kills = {t: eng._faults.kill_worker(t) for t in kill_targets}
+            out["kills_armed"] = kills
+        for n in names[half:]:
+            store.create("pods", _make_pod(n, nodes[hash(n) % len(nodes)]))
+
+        if chaos:
+            # let the storm rage over live traffic, then close the fault
+            # window the way an outage ends...
+            time.sleep(3.0)
+            eng._faults.spec.rates.clear()
+            out["faults_injected"] = eng._faults.counts()
+            # ...and end on an apiserver-restart-shaped cliff: compaction
+            # + every stream cut, so recovery MUST take the full 410 ->
+            # list+RESYNC path (events lost to killed workers or dropped
+            # frames have no other way back)
+            heal_t0 = time.time()
+            store.compact()
+            store.stop_watches()
+        else:
+            heal_t0 = time.time()
+
+        converged = _wait(
+            lambda: all(
+                ph == "Running" for ph in _pod_phases(store, names).values()
+            ),
+            timeout,
+        )
+        out["converged"] = converged
+        out["recovery_to_converged_s"] = round(time.time() - heal_t0, 3)
+        out["wall_s"] = round(time.time() - t_run0, 3)
+        queues_drained = _wait(
+            lambda: all(
+                lane.q.qsize() == 0 and lane.emit_q.qsize() == 0
+                for lane in eng._lanes.lanes
+            ),
+            10.0,
+        )
+        out["queues_drained"] = queues_drained
+        out["final_phases"] = _pod_phases(store, names)
+        out["per_key_order"] = {
+            n: _recollapse(store, n) for n in names
+        }
+        out["watch_relists_total"] = eng.metrics["watch_relists_total"]
+        out["dropped_jobs_total"] = eng.metrics["dropped_jobs_total"]
+        out["degraded_at_end"] = eng.degraded
+        if chaos:
+            out["worker_restarts"] = {
+                n: worker_restarts_total(n) - restarts0[n]
+                for n in kill_targets
+            }
+            out["kill_log"] = [
+                {"thread": k["thread"]} for k in eng._faults.kill_log()
+            ]
+            out["restart_log"] = eng._watchdog.restart_log()
+    finally:
+        eng.stop()
+        srv.stop()
+    return out
+
+
+def _recollapse(store, name):
+    return store.per_key_collapsed(("default", name))
+
+
+def gates(base: dict, chaos: dict) -> dict:
+    return {
+        "baseline_converged": bool(base["converged"]),
+        "chaos_converged": bool(chaos["converged"]),
+        # the headline: byte-identical final pod phases
+        "phases_identical": (
+            json.dumps(base["final_phases"], sort_keys=True)
+            == json.dumps(chaos["final_phases"], sort_keys=True)
+        ),
+        # per-key patch order preserved (collapsed oplog oracle)
+        "per_key_order_preserved": (
+            base["per_key_order"] == chaos["per_key_order"]
+        ),
+        "workers_restarted": all(
+            v >= 1 for v in chaos.get("worker_restarts", {}).values()
+        ) and len(chaos.get("worker_restarts", {})) == 2,
+        "queues_drained": bool(chaos["queues_drained"]),
+        "not_degraded_at_end": not chaos["degraded_at_end"],
+        "faults_actually_injected": (
+            sum(chaos.get("faults_injected", {}).values()) > 0
+            and chaos.get("faults_injected", {}).get("worker.kill", 0) >= 2
+        ),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--pods", type=int, default=96)
+    p.add_argument("--lanes", type=int, default=4)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--timeout", type=float, default=90.0,
+                   help="per-run convergence deadline (s)")
+    p.add_argument("--out", default=os.path.join(REPO, "CHAOS_r01.json"))
+    p.add_argument("--check", action="store_true",
+                   help="CI gate: smaller workload, exit 1 on any failed "
+                   "convergence/ordering/restart gate")
+    args = p.parse_args()
+    if args.lanes < 2:
+        p.error("--lanes must be >= 2: the gate kills a drain worker and "
+                "an emit worker, which only the sharded pipeline has")
+    if args.check:
+        args.pods = min(args.pods, 64)
+
+    base = _run(args.pods, args.lanes, args.seed, chaos=False,
+                timeout=args.timeout)
+    chaos = _run(args.pods, args.lanes, args.seed, chaos=True,
+                 timeout=args.timeout)
+    g = gates(base, chaos)
+    ok = all(g.values())
+
+    # the artifact keeps the verdicts + the storm's accounting; the full
+    # per-pod maps stay out (identical by gate, and 2x pods lines of noise)
+    artifact = {
+        "bench": "chaos_soak",
+        "spec": CHAOS_SPEC.format(seed=args.seed),
+        "params": {"pods": args.pods, "lanes": args.lanes,
+                   "seed": args.seed, "check": args.check},
+        "gates": g,
+        "ok": ok,
+        "baseline": {
+            "wall_s": base["wall_s"],
+            "watch_relists_total": base["watch_relists_total"],
+        },
+        "chaos": {
+            k: chaos.get(k)
+            for k in (
+                "wall_s", "faults_injected", "kills_armed",
+                "worker_restarts", "restart_log",
+                "recovery_to_converged_s", "watch_relists_total",
+                "dropped_jobs_total", "degraded_at_end",
+            )
+        },
+    }
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"ok": ok, "gates": g, "out": args.out}))
+    if not ok:
+        failed = [k for k, v in g.items() if not v]
+        print(f"chaos_soak: FAILED gates: {failed}", file=sys.stderr)
+        if not g["phases_identical"]:
+            diff = {
+                n: (base["final_phases"][n], chaos["final_phases"][n])
+                for n in base["final_phases"]
+                if base["final_phases"][n] != chaos["final_phases"][n]
+            }
+            print(f"chaos_soak: phase diffs: {diff}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

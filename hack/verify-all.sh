@@ -14,6 +14,9 @@ echo ">> pytest collection"
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python3 -m pytest tests/ --collect-only -q >/dev/null
 
+echo ">> chaos-check (resilience suite + fault-storm convergence gate)"
+make chaos-check
+
 echo ">> bash syntax"
 find hack test images -name '*.sh' -print0 | xargs -0 -n1 bash -n
 

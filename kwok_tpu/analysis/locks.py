@@ -41,6 +41,15 @@ LOCK_ORDER: dict[str, int] = {
     "_gen_lock": 30,
     "lock": 80,         # _PumpGroup per-connection-group locks
     "_conns_lock": 80,  # httpclient keep-alive pool
+    # resilience leaves (ISSUE 6): each guards one module's bookkeeping
+    # dict/set and NOTHING is ever acquired under it — registry child
+    # access always happens after release (see Degradation.set/clear,
+    # FaultPlane.record, Watchdog._allow). Level 84: above the generic
+    # single-resource leaves so holding one while (incorrectly) taking a
+    # registry `_lock` would be an order VIOLATION, not an unordered pair.
+    "_fault_lock": 84,  # FaultPlane: injected-fault tally + killer state
+    "_deg_lock": 84,    # Degradation: the active-reasons set
+    "_wd_lock": 84,     # Watchdog: restart stamps + restart log
     "_lock": 85,        # single-resource leaves (ippool, registry, ...)
     "_apiserver_lock": 85,
     "_audit_lock": 95,  # mockserver audit ring, below the store lock

@@ -48,6 +48,14 @@ class KwokConfigurationOptions:
     # routing) lanes keep paying past 8 cores; this bounds fan-out on
     # very wide hosts without touching explicit drainShards values.
     maxDrainShards: int = 0
+    # Resilience (kwok_tpu/resilience/, docs/resilience.md):
+    # deterministic fault-injection spec ("" = off; KWOK_TPU_FAULTS is
+    # the engine-level fallback), lane-queue shed threshold (0 = never
+    # shed), and the lane-worker restart budget per window.
+    faults: str = ""
+    shedQueueDepth: int = 0
+    workerRestartBudget: int = 5
+    workerRestartWindow: float = 30.0
 
 
 @dataclasses.dataclass

@@ -69,6 +69,14 @@ from kwok_tpu.ops.tick import (
 )
 from kwok_tpu.ops.updates import UpdateBuffer
 from kwok_tpu.engine.rowpool import RowPool
+from kwok_tpu.resilience import faults as resilience_faults
+from kwok_tpu.resilience.policy import (
+    PATCH_RETRY,
+    PUMP_RESEND,
+    WATCH_RECONNECT,
+    Degradation,
+)
+from kwok_tpu.resilience.watchdog import Watchdog
 from kwok_tpu.telemetry import EngineTelemetry
 from kwok_tpu.telemetry.errors import swallowed
 from kwok_tpu.workers import spawn_worker
@@ -155,6 +163,21 @@ class EngineConfig:
     # 1-in-N sampling for per-event ingest->patch spans (the end-to-end
     # per-pod attribution the cost model cannot see); 0 disables
     trace_sample_every: int = 256
+    # Deterministic fault-injection spec (resilience/faults.py grammar;
+    # docs/resilience.md). "" = disabled (falls back to KWOK_TPU_FAULTS);
+    # when set, the client transport, pump, and workers are wrapped.
+    faults: str = ""
+    # Graceful degradation: shed routed events when a lane queue is
+    # deeper than this instead of letting it grow without bound while a
+    # lane is down (kwok_dropped_jobs_total + kwok_degraded{reason=}).
+    # 0 = never shed (the library/test default: correctness tests rely
+    # on lossless ingest).
+    shed_queue_depth: int = 0
+    # Watchdog restart budget for supervised lane workers: more than
+    # `budget` restarts of one worker within `window` seconds stops
+    # supervision and marks the engine degraded (/readyz 503).
+    worker_restart_budget: int = 5
+    worker_restart_window: float = 30.0
 
     def validate(self) -> None:
         if not (
@@ -287,6 +310,13 @@ class ClusterEngine:
         telemetry: EngineTelemetry | None = None,
     ) -> None:
         config.validate()
+        # Fault plane (resilience/faults.py): None unless a spec is
+        # configured — the disabled case wraps nothing and costs nothing.
+        # Wrapping is idempotent, so lane engines handed an
+        # already-wrapped parent client do not double-inject.
+        self._faults = resilience_faults.from_config(config.faults)
+        if self._faults is not None:
+            client = self._faults.wrap_client(client)
         self.client = client
         self.config = config
         self.ippool = IPPool(config.cidr)
@@ -370,6 +400,10 @@ class ClusterEngine:
         # showed up in scale profiles
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._watches: dict[str, object] = {}  # kind -> current watch handle
+        # kinds whose next reconnect must take the full list+RESYNC path
+        # regardless of the thread-local resume revision (resync_streams;
+        # guarded by _gen_lock like the rest of the stream bookkeeping)
+        self._resync_req: set[str] = set()
         self._threads: list[threading.Thread] = []
         self._running = False
         self._executor: ThreadPoolExecutor | None = None
@@ -435,6 +469,9 @@ class ClusterEngine:
         self._drain_gen: dict[str, int] = {}
         self._gen_lock = threading.Lock()
         self._dropped_jobs = 0  # patch jobs rejected during shutdown
+        # monotonic stamp of the last shed-clear stream resync (written
+        # by lane drain workers; see lanes._SHED_RESYNC_MIN_S)
+        self._shed_resync_at = 0.0
         # readiness for /readyz: set once start() finishes warm-up
         self.ready = False
         # Batched pipelined egress (native/pump.cc): one C++ call sends a
@@ -463,6 +500,11 @@ class ClusterEngine:
         # tick-thread-only, so plain int arithmetic is race-free
         self._trace_every = max(0, int(config.trace_sample_every))
         self._trace_n = 0
+        # Degraded-mode ledger (kwok_degraded{reason=}; /readyz answers
+        # 503 while any reason is active) + the lane-worker watchdog
+        # (built in start(): only threaded engines supervise workers).
+        self._degradation = Degradation(self.telemetry.registry)
+        self._watchdog: Watchdog | None = None
         # Hash-partitioned host lanes (engine/lanes.py): built when
         # drain_shards resolves to >1. Lane children are constructed with
         # drain_shards=1, so this cannot recurse.
@@ -489,6 +531,86 @@ class ClusterEngine:
 
     def _inc(self, name: str, v=1) -> None:
         self.telemetry.inc(name, v)
+
+    @property
+    def degraded(self) -> bool:
+        """Degraded mode: shedding load or out of worker restart budget.
+        The HTTP server's /readyz answers 503 while this is True (the
+        engine is alive — /livez stays 200 — but should not be sent
+        load it will drop)."""
+        return self._degradation.active
+
+    def _worker_budget_exhausted(self, name: str) -> None:
+        """Watchdog callback: a supervised worker crashed past its
+        restart budget — the lane topology is now partial."""
+        if self._degradation.set("worker_restart_budget"):
+            logger.error(
+                "engine degraded: worker %s out of restart budget", name
+            )
+
+    def _worker_restarted_resync(self, name: str) -> None:
+        """Watchdog callback, on the restarted worker's own thread: a
+        crashed lane worker can take an in-flight item with it (the crash
+        may land mid-get or mid-apply), and routed-rv bookkeeping means
+        the watch cache will NOT replay it — only a full list+RESYNC
+        provably reconciles the loss (the repair path re-patches any
+        object whose server state diverged, the fingerprint echo-drop
+        no-ops the rest). So a restart completes by resyncing streams:
+        restart-the-thread alone would heal the topology but not the
+        data."""
+        if not self._running:
+            return
+        if name.startswith("kwok-emit"):
+            # emit crashes are LOSSLESS by construction: the in-flight
+            # wire slice survives in the lane's crash-replay slot
+            # (ShardLane.emit_loop) and is replayed on this same restart —
+            # a full-cluster re-list per emit crash would be pure cost
+            return
+        self.resync_streams()
+        # one loss class no re-list can reproduce: a cross-lane XUPD
+        # managed-ness fan-out the dead worker ate. The pods' re-delivery
+        # echo-drops (their objects never changed) and the node's lane
+        # skips the fan-out for already-managed nodes — so re-fan every
+        # managed node explicitly. Idempotent: the XUPD apply recomputes
+        # each pod's bits from the current shared topology. (Only the
+        # sharded pipeline has supervised workers, so the lane router is
+        # always present here.)
+        if self._lanes is not None:
+            while True:
+                try:
+                    nodes = list(self.node_has)
+                    break
+                except RuntimeError:  # shared set resized mid-copy
+                    time.sleep(0)
+            for node in nodes:
+                self._lanes.route_pod_updates(node)
+
+    def resync_streams(self) -> None:
+        """Force every watch stream through the full list+RESYNC path:
+        expire the resume revisions (so the reconnect re-lists instead of
+        resuming) and cut the live streams. Safe to call from any thread;
+        the per-kind watch threads do the actual re-listing."""
+        for kind in list(self._watches):
+            self._expire_stream(kind)
+            # _watch_rv only feeds the RAW/native paths' resume — the
+            # plain-iterator path resumes from a thread-local rv, so the
+            # re-list must be requested explicitly; the watch loop
+            # consumes this at reconnect AND right after installing a
+            # handle, which closes the reconnect race both ways: a handle
+            # installed before this flag is the one we re-read and stop
+            # below; one installed after sees the flag at its
+            # post-install check
+            with self._gen_lock:
+                self._resync_req.add(kind)
+            w = self._watches.get(kind)
+            if w is None:
+                continue
+            try:
+                w.stop()
+            except Exception:
+                # a dying/already-replaced handle: the reconnect path
+                # owns recovery either way
+                swallowed("resync_stream_stop")
 
     # ------------------------------------------------------------------ time
 
@@ -529,6 +651,15 @@ class ClusterEngine:
         queues + emit paths from one shared tick loop."""
         self._running = True
         self._owns_tick = run_tick_loop
+        # supervision + chaos arm before any worker exists
+        self._watchdog = Watchdog(
+            budget=self.config.worker_restart_budget,
+            window=self.config.worker_restart_window,
+            on_exhausted=self._worker_budget_exhausted,
+            on_restart=self._worker_restarted_resync,
+        )
+        if self._faults is not None:
+            self._faults.start()
         # start the sampling profiler from the CALLER's thread (usually
         # main): its SIGTERM crash-dump hook can only install there — the
         # tick thread's own maybe_start() is then an idempotent no-op
@@ -628,6 +759,10 @@ class ClusterEngine:
     def stop(self) -> None:
         self._running = False
         self.ready = False
+        if self._watchdog is not None:
+            self._watchdog.close()  # shutdown crashes must not restart
+        if self._faults is not None:
+            self._faults.stop()  # chaos killer thread down first
         if getattr(self, "_profiling", False):
             # short runs stop before tick 102; flush the trace anyway
             import jax
@@ -711,8 +846,51 @@ class ClusterEngine:
             # list+RESYNC path, which is gap-free by construction
             resume_rv = 0
             too_large_tries = 0
+            # shared reconnect policy (resilience/policy.py): exponential
+            # backoff + full jitter, reset by a healthy handshake cycle —
+            # replaces the old flat time.sleep(5)
+            backoff = WATCH_RECONNECT.session()
+            # storm pacing state: its OWN backoff session (the handshake
+            # path resets `backoff` on every success, and every 410 is
+            # followed by a successful rv-less re-list handshake — a
+            # success-reset counter would never see two in a row), and a
+            # stream-lifetime test instead: expiries separated by a
+            # stream that LIVED are normal compaction recovery, expiries
+            # after short-lived streams are a storm
+            storm_backoff = WATCH_RECONNECT.session()
+            consecutive_expiries = 0
+            stream_t0 = 0.0
+            _STORM_STREAM_S = 5.0
+
+            def expiry_pace():
+                # a lone 410 re-lists immediately (the normal compaction
+                # recovery must stay fast); a compaction STORM — every
+                # short-lived stream ending in another expiry — paces its
+                # full re-lists with backoff instead of hot-looping them
+                nonlocal consecutive_expiries
+                if stream_t0 and (
+                    time.monotonic() - stream_t0 >= _STORM_STREAM_S
+                ):
+                    consecutive_expiries = 0
+                    storm_backoff.reset()
+                consecutive_expiries += 1
+                if consecutive_expiries > 1:
+                    delay = storm_backoff.next_delay()
+                    if delay:
+                        storm_backoff.sleep(
+                            delay, lambda: not self._running
+                        )
+
             while self._running:
                 try:
+                    with self._gen_lock:
+                        if kind in self._resync_req:
+                            # a worker restart (or other healing event)
+                            # demanded a full re-list: the thread-local
+                            # resume revision cannot vouch for items a
+                            # crashed worker took with it
+                            self._resync_req.discard(kind)
+                            resume_rv = 0
                     try:
                         # allow_bookmarks: client-go's reflector always
                         # opts in — periodic rv-only events keep a QUIET
@@ -739,6 +917,7 @@ class ClusterEngine:
                         # NEW line is parsed must not resurrect it and eat
                         # a second 410 + re-list
                         self._expire_stream(kind)
+                        expiry_pace()
                         continue
                     except TooLargeResourceVersion as e:
                         # server's store is BEHIND our resume revision
@@ -768,6 +947,27 @@ class ClusterEngine:
                         continue
                     too_large_tries = 0
                     self._watches[kind] = w  # replaces any dead handle
+                    # resync_streams may have raced this handshake (its
+                    # flag landed after our loop-top check but before the
+                    # install): an rv-resume here would keep a stream
+                    # alive that was ordered to re-list — check again now
+                    # that the handle is visible to resync's stop()
+                    if resume_rv:
+                        with self._gen_lock:
+                            forced = kind in self._resync_req
+                            if forced:
+                                self._resync_req.discard(kind)
+                        if forced:
+                            w.stop()
+                            resume_rv = 0
+                            continue
+                    # a full handshake succeeded: the next connection
+                    # failure backs off from scratch, and the storm pacer
+                    # judges the NEXT expiry by how long this stream
+                    # lives (expiry_pace resets only after a stream that
+                    # lived _STORM_STREAM_S)
+                    backoff.reset()
+                    stream_t0 = time.monotonic()
                     if not resume_rv:
                         # list AFTER the watch registers: the snapshot +
                         # resync marker covers anything missed before/while
@@ -873,16 +1073,22 @@ class ClusterEngine:
                     if expired:
                         resume_rv = 0
                         self._expire_stream(kind)  # see WatchExpired
-                        continue  # immediate re-list, no backoff
+                        expiry_pace()  # lone 410: immediate re-list
+                        continue
                     if not self._running:
                         return
                 except WatchExpired:
                     resume_rv = 0
+                    expiry_pace()
                 except Exception as e:  # re-watch with backoff
                     if not self._running:
                         return
-                    logger.warning("watch %s failed: %s; retrying in 5s", kind, e)
-                    time.sleep(5)
+                    delay = backoff.next_delay() or 0.0
+                    logger.warning(
+                        "watch %s failed: %s; retrying in %.2fs",
+                        kind, e, delay,
+                    )
+                    backoff.sleep(delay, lambda: not self._running)
 
         self._threads.append(
             spawn_worker(loop, name=f"kwok-watch-{kind}")
@@ -1418,6 +1624,17 @@ class ClusterEngine:
                 del_arr = np.fromiter(
                     (c[4] for c in cols), bool, len(cols)
                 )
+                # host mirrors BEFORE the stage call: written to a freed
+                # row they are harmless (the rollback below releases it),
+                # while mirrors written AFTER staging would open a window
+                # where a crash leaves staged rows with stale mirrors
+                # whose seeded fingerprints echo-drop the re-delivery.
+                # stage_init_array is the point of no return — the flag
+                # flips on the very next bytecode, so the rollback can
+                # never release a row whose init is already staged (an
+                # orphan init would activate a freed index at flush).
+                k.phase_h[idx_arr] = _PENDING
+                k.cond_h[idx_arr] = cond_arr
                 k.buffer.stage_init_array(
                     idx_arr, _PENDING, cond_arr, sel_arr, del_arr
                 )
@@ -1442,8 +1659,6 @@ class ClusterEngine:
                         if by is not None:
                             by.discard(key)
                 raise
-            k.phase_h[idx_arr] = _PENDING
-            k.cond_h[idx_arr] = cond_arr
             cols.clear()
             pending.clear()
 
@@ -1584,13 +1799,28 @@ class ClusterEngine:
             if k.pool.full:
                 self._grow(k)
             idx = k.pool.acquire(name)
-            phase = self._node_phase_from_status(node)
-            k.buffer.stage_init(
-                idx, True, phase=phase, cond_bits=_NODE_READY_BITS,
-                sel_bits=bits, has_deletion=False,
-            )
-            k.phase_h[idx] = phase
-            k.cond_h[idx] = _NODE_READY_BITS
+            # crash/chaos-pill rollback, same contract as the pod paths:
+            # an acquired-but-never-staged row would swallow every later
+            # event for this node without ever activating. Mirrors write
+            # BEFORE the stage call (harmless on a rolled-back row);
+            # stage_init is the point of no return — the flag flips on
+            # the very next bytecode, so the rollback can never release a
+            # row whose init is already staged (an orphan init would
+            # activate a freed index at flush).
+            staged = False
+            try:
+                phase = self._node_phase_from_status(node)
+                k.phase_h[idx] = phase
+                k.cond_h[idx] = _NODE_READY_BITS
+                k.buffer.stage_init(
+                    idx, True, phase=phase, cond_bits=_NODE_READY_BITS,
+                    sel_bits=bits, has_deletion=False,
+                )
+                staged = True
+            except BaseException:
+                if not staged:
+                    k.pool.release(name)
+                raise
         else:
             k.buffer.stage_update(idx, bits, False)
         m = k.pool.meta[idx]
@@ -1789,6 +2019,36 @@ class ClusterEngine:
             return False
         flags = rec.flags
         has_del = bool(flags & 2)
+        # rollback discipline (same contract as the columnar flush_cols):
+        # a crash — or a chaos pill, any BaseException — between acquire
+        # and stage_init would leave a row that LOOKS tracked (lookup
+        # hits, but inactive and unfingerprinted) so the resync
+        # re-delivery takes the update branch and never activates it;
+        # releasing makes the re-delivery's new-row path the one that
+        # runs. But ONLY un-staged rows may be released: releasing after
+        # stage_init would orphan the staged init, activating a freed
+        # index at the next buffer flush.
+        staged = [not new_row]  # existing rows have nothing to roll back
+        try:
+            return self._pod_upsert_record_apply(
+                rec, k, key, idx, new_row, flags, has_del, name, ns,
+                node_name, staged,
+            )
+        except BaseException:
+            if not staged[0]:
+                k.pool.release(key)
+                by = self.pods_by_node.get(node_name)
+                if by is not None:
+                    by.discard(key)
+            raise
+
+    def _pod_upsert_record_apply(
+        self, rec, k, key, idx, new_row, flags, has_del, name, ns,
+        node_name, staged,
+    ) -> bool:
+        """The mutation body of _pod_upsert_record, crash-rollback-wrapped
+        by its caller. Fingerprints seed LAST: an event interrupted before
+        they land is re-processed on re-delivery, never echo-dropped."""
         if new_row:
             if k.pool.full:
                 self._grow(k)
@@ -1854,9 +2114,14 @@ class ClusterEngine:
                     tn = t.decode()
                     if tn in POD_PHASES.conditions:
                         cond |= 1 << POD_PHASES.condition_bit(tn)
-            k.buffer.stage_init(idx, True, phase, cond, bits, has_del)
+            # mirrors BEFORE the stage call (harmless on a rolled-back
+            # row); stage_init is the point of no return and the flag
+            # flips on the very next bytecode, so the caller's rollback
+            # can never release a row whose init is already staged
             k.phase_h[idx] = phase
             k.cond_h[idx] = cond
+            k.buffer.stage_init(idx, True, phase, cond, bits, has_del)
+            staged[0] = True
         else:
             k.buffer.stage_update(idx, bits, has_del)
         # repair path not needed: rows here are Pending, where the
@@ -2331,12 +2596,46 @@ class ClusterEngine:
                 )
             return False
 
+    @staticmethod
+    def _transient(e: Exception) -> bool:
+        """Connection-shaped failures worth retrying (apiserver restart,
+        dropped keep-alive, injected blackout). HTTP status errors are
+        definitive answers, not transport loss — never retried."""
+        import http.client
+        import urllib.error
+
+        if isinstance(e, urllib.error.HTTPError):
+            return False
+        return isinstance(
+            e, (ConnectionError, TimeoutError, OSError,
+                http.client.HTTPException)
+        )
+
     def _safe(self, fn, *args) -> None:
-        try:
-            fn(*args)
-        except Exception:
-            self._inc("patch_errors_total")
-            logger.exception("patch job failed")
+        # transport-level failures retry with backoff (shared
+        # resilience policy, deadline-capped) so an apiserver restart
+        # window doesn't silently eat patches: a lost status patch has
+        # no retrigger — the server never echoes the expected state, so
+        # the engine and the cluster would disagree until an unrelated
+        # event touched the object
+        backoff = None
+        while True:
+            try:
+                fn(*args)
+                return
+            except Exception as e:
+                if not (self._running and self._transient(e)):
+                    self._inc("patch_errors_total")
+                    logger.exception("patch job failed")
+                    return
+                if backoff is None:
+                    backoff = PATCH_RETRY.session()
+                delay = backoff.next_delay()
+                if delay is None:  # policy deadline: give up
+                    self._inc("patch_errors_total")
+                    logger.exception("patch job failed after retries")
+                    return
+                backoff.sleep(delay, lambda: not self._running)
 
     def _get_pump(self):
         """Native pump bound to the client's plain-HTTP endpoint, or None
@@ -2357,14 +2656,19 @@ class ClusterEngine:
         token = getattr(self.client, "token", None)
         extra = f"Authorization: Bearer {token}\r\n" if token else ""
         try:
-            self._pump = _PumpGroup([
+            pumps = [
                 # kwoklint: disable=blocking-under-lock -- construction is memoized via _pump_tried: lane emit workers (the only under-lock callers) are primed by LaneSet.prepare before any worker starts; all other callers run on the lock-free tick thread or executor
                 self._codec.Pump(
                     host, int(port), nconn=self._pump_nconn,
                     header_extra=extra,
                 )
                 for _ in range(self._pump_groups)
-            ])
+            ]
+            if self._faults is not None:
+                # chaos: the fault plane reproduces pump.cc's failure
+                # contract (drop / short write / delay) on demand
+                pumps = [self._faults.wrap_pump(p) for p in pumps]
+            self._pump = _PumpGroup(pumps)
             self._pump_base = base
         except Exception:
             logger.exception("native pump unavailable; using executor egress")
@@ -2550,11 +2854,51 @@ class ClusterEngine:
         self._submit(self._pump_send, reqs, sent_idx, "pods")
         return slow
 
-    def _pump_send(self, reqs, idxs, kind) -> None:
-        """One executor job sends the whole batch; rows whose connection
-        died are retried through the per-object Python path."""
-        _t = time.perf_counter()
+    def _pump_send_frames(self, reqs):
+        """Send one batch, resending WHOLE FRAMES for requests whose
+        connection died (status 0). pump.cc's failure contract hands a
+        dead connection's unsent/unread suffix back as status 0 and
+        re-dials on the next call — so a short write mid-frame is
+        recovered here by resending those requests' complete frames on a
+        fresh connection, bounded by the shared resend policy, instead
+        of leaking every mid-frame loss to the per-object slow path (or,
+        for heartbeats, dropping it outright — the old behavior).
+
+        When the deadline expires with the ENTIRE batch still dead the
+        pump target is down: the engine degrades (kwok_degraded{reason=
+        "pump"}) and the caller sheds instead of flooding the executor
+        with doomed per-object retries."""
         status = self._pump.send(reqs)
+        if (status == 0).any():
+            backoff = PUMP_RESEND.session()
+            while self._running:
+                delay = backoff.next_delay()
+                if delay is None:
+                    break  # policy deadline
+                backoff.sleep(delay, lambda: not self._running)
+                fail = np.nonzero(status == 0)[0]
+                sub = [reqs[i] for i in fail.tolist()]
+                status[fail] = self._pump.send(sub)
+                if not (status == 0).any():
+                    break
+        if len(reqs) and (status == 0).all():
+            if self._degradation.set("pump"):
+                logger.error(
+                    "engine degraded: pump egress down past the resend "
+                    "deadline (shedding batches)"
+                )
+        elif (status != 0).any():
+            if self._degradation.clear("pump"):
+                logger.info("pump egress recovered; shedding stops")
+        return status
+
+    def _pump_send(self, reqs, idxs, kind) -> None:
+        """One executor job sends the whole batch (with whole-frame
+        resend of connection failures); rows still failing are retried
+        through the per-object Python path — unless the pump target is
+        down outright, in which case the batch is shed and counted."""
+        _t = time.perf_counter()
+        status = self._pump_send_frames(reqs)
         _t1 = time.perf_counter()
         tel = self.telemetry
         tel.pump_hist.observe(_t1 - _t)
@@ -2562,6 +2906,15 @@ class ClusterEngine:
         tel.span(
             "pump.send", _t, _t1, "pump", {"kind": kind, "n": len(reqs)}
         )
+        if len(reqs) and (status == 0).all() and (
+            "pump" in self._degradation.reasons
+        ):
+            # pump target down past the resend deadline: shed the batch
+            # (counted) instead of converting it into thousands of
+            # doomed per-object jobs that would wedge the executor
+            self._dropped_jobs += len(reqs)
+            self._inc("dropped_jobs_total", len(reqs))
+            return
         ok = int(((status >= 200) & (status < 300)).sum())
         if kind == "heartbeat":
             self._inc("heartbeats_total", ok)
@@ -2589,10 +2942,20 @@ class ClusterEngine:
                 if name is not None:
                     self._submit(self._patch_node_status, name, idx)
             elif kind == "heartbeat":
+                # a heartbeat whose frame died used to be DROPPED here
+                # (one warning, no resend): fall back to the per-object
+                # Python path like the other kinds — a freshly-rendered
+                # heartbeat is always valid
                 name = self.nodes.pool.key_of(idx)
                 if name is not None:
                     self._inc("patch_errors_total")
-                    logger.warning("heartbeat pump send failed for %s: %s", name, st)
+                    logger.warning(
+                        "heartbeat pump send failed for %s: %s; "
+                        "falling back to per-object patch", name, st,
+                    )
+                    self._submit(
+                        self._heartbeat_node, name, idx, now_rfc3339()
+                    )
 
     def _patch_node_status(self, name: str, idx: int) -> None:
         k = self.nodes

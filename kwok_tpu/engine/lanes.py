@@ -78,6 +78,10 @@ _KINDS = ("nodes", "pods")
 # exercise the mid-run regrow path without six-digit event streams.
 _MIN_LANE_ROWS = 1024
 
+# Minimum seconds between shed-clear stream resyncs (drain_loop): bounds
+# the full-LIST rate when a resync's own re-list burst re-trips shedding.
+_SHED_RESYNC_MIN_S = 5.0
+
 
 @dataclasses.dataclass
 class _LanePending:
@@ -125,9 +129,16 @@ class ShardLane:
             initial_capacity=capacity,
             profile_dir="",
             trace_dump="",  # one dump, owned by the parent
+            faults="off",  # ONE fault plane, the parent's (shared below)
         )
         e = _LaneEngine(parent.client, cfg, telemetry=parent.telemetry)
         e._lane_set = lane_set
+        # the parent's fault plane and degraded-mode ledger are THE
+        # engine-wide instances: lane pumps draw from the same seeded
+        # decision streams, and a lane marking "pump" down flips the
+        # parent's /readyz — not a private ledger nobody reads
+        e._faults = parent._faults
+        e._degradation = parent._degradation
         # shared cross-lane state: one IP pool / allocation lock (striped
         # enough — held only for bookkeeping, never across provider
         # calls), one topology view, one clock
@@ -144,13 +155,28 @@ class ShardLane:
         e._pump_groups = 2
         self.engine = e
         self.q: "queue.SimpleQueue" = queue.SimpleQueue()
-        self.emit_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        # queue.Queue (not SimpleQueue): the emit worker's crash-replay
+        # claim (emit_loop) peeks under the queue's own condition before
+        # popping, which needs the Python implementation's not_empty /
+        # queue attributes. Emit traffic is per-TICK per lane (not
+        # per-event), so the condition-variable cost is irrelevant here —
+        # the ingest queues stay SimpleQueue.
+        self.emit_q: "queue.Queue" = queue.Queue()
         # guards this lane's staged buffers + pool growth + release log:
         # held by the drain worker while applying, by the tick thread
         # while swapping buffers / growing, by the emit worker only for
         # the stale-release snapshot. RLock: apply paths may nest.
         self.stage_lock = threading.RLock()
         self.telemetry = parent.telemetry.lane(str(index))
+        # graceful degradation: router sheds into kwok_dropped_jobs_total
+        # when this queue is deeper than the configured threshold (0 =
+        # never; see EngineConfig.shed_queue_depth); the drain worker
+        # clears the flag once the backlog halves
+        self._shed_depth = int(parent.config.shed_queue_depth)
+        self.shedding = False
+        # emit crash-replay slot (see emit_loop): the item being
+        # processed, held so a worker crash cannot lose a wire slice
+        self._emit_inflight = None
 
     # --------------------------------------------------------------- drain
 
@@ -254,7 +280,38 @@ class ShardLane:
                             if item is empty or item[1] == "RECB":
                                 break
             tel.observe_stage("drain", time.perf_counter() - t0)
-            tel.set_queue_depth(q.qsize())
+            depth = q.qsize()
+            tel.set_queue_depth(depth)
+            if self._shed_depth and self.shedding and (
+                depth * 2 <= self._shed_depth
+            ):
+                # backlog halved: stop shedding, clear the degraded
+                # reason, and resync the watch streams — shed events are
+                # GONE from the queue, so only a full list+RESYNC
+                # actually re-delivers them (this is what makes _shed's
+                # "trades freshness, not permanent state" contract true).
+                # The clear is RATE-LIMITED by the last resync: a re-list
+                # burst bigger than the shed threshold would otherwise
+                # re-trip shedding instantly and the clear->resync cycle
+                # would hammer the apiserver with back-to-back full
+                # LISTs. Deferring the clear keeps the lane shedding
+                # (still degraded, still counted) until the interval
+                # passes, bounding the LIST rate while each cycle applies
+                # up to a queue-full of objects — monotonic progress.
+                parent = self.lane_set.parent
+                now = time.monotonic()
+                if now - parent._shed_resync_at >= _SHED_RESYNC_MIN_S:
+                    parent._shed_resync_at = now
+                    self.shedding = False
+                    if self.engine._degradation.clear(
+                        f"lane{self.index}_queue"
+                    ):
+                        logger.info(
+                            "lane %d drained below shed threshold; "
+                            "degraded reason cleared; resyncing streams "
+                            "to re-deliver shed events", self.index,
+                        )
+                        parent.resync_streams()
             if stop:
                 return
 
@@ -263,7 +320,31 @@ class ShardLane:
     def emit_loop(self) -> None:
         eq = self.emit_q
         while True:
-            item = eq.get()
+            if self._emit_inflight is None:
+                # the crash-replay slot: unlike drain items (whose loss a
+                # stream resync re-delivers), an emit item is an
+                # IRREPLACEABLE wire slice — its device transitions fired
+                # exactly once — so the claim is NON-destructive: peek
+                # under the queue's own condition, publish the reference
+                # to the slot, THEN pop. A crash (chaos pill, any
+                # BaseException) at ANY point — including the get() wake,
+                # where an async exception by construction lands — leaves
+                # the item in the queue, in the slot, or both; the
+                # watchdog-restarted loop replays it in order. At-least-
+                # once is safe: a replayed slice only duplicates patches
+                # the echo drop / repair no-op absorbs, the stale filter
+                # is idempotent, and _prune_now is monotonic.
+                with eq.not_empty:
+                    while not eq._qsize():
+                        eq.not_empty.wait()
+                    self._emit_inflight = eq.queue[0]
+                got = eq.get_nowait()
+                if got is not self._emit_inflight:
+                    # replay raced a crash between store and pop: the
+                    # slot's item was already popped+replayed — process
+                    # the freshly popped one instead
+                    self._emit_inflight = got
+            item = self._emit_inflight
             if item is None:
                 return
             try:
@@ -273,6 +354,7 @@ class ShardLane:
                     self._process_emit(item)
             except Exception:
                 logger.exception("lane %d emit failed", self.index)
+            self._emit_inflight = None
 
     def _prune_now(self, min_seq: int) -> None:
         """Drop release-log entries no queued-or-future emit item can
@@ -445,14 +527,25 @@ class LaneSet:
 
     def start_workers(self, threads: list) -> None:
         """Spawn the router + per-lane drain/emit workers (the tick loop
-        itself is started by ClusterEngine.start as 'kwok-tick')."""
-        threads.append(spawn_worker(self.route_loop, name="kwok-route"))
+        itself is started by ClusterEngine.start as 'kwok-tick'),
+        supervised by the engine's watchdog: a crashed worker used to
+        leave its queue backing up forever behind a healthy-looking
+        engine — now it restarts in place (same thread, same queues)
+        within the restart budget."""
+        wd = self.parent._watchdog
+
+        def spawn(target, name):
+            if wd is not None:
+                return wd.spawn(target, name=name)
+            return spawn_worker(target, name=name)
+
+        threads.append(spawn(self.route_loop, "kwok-route"))
         for lane in self.lanes:
             for target, name in (
                 (lane.drain_loop, f"kwok-lane{lane.index}"),
                 (lane.emit_loop, f"kwok-emit{lane.index}"),
             ):
-                threads.append(spawn_worker(target, name=name))
+                threads.append(spawn(target, name))
 
     def close(self) -> None:
         """Release lane-owned pump connection groups (the shared client
@@ -537,8 +630,12 @@ class LaneSet:
         key = self._key_of(kind, type_, obj)
         if key is None:
             return
+        lane = self.lanes[shard_of(key, self.n)]
+        if lane._shed_depth and lane.q.qsize() > lane._shed_depth:
+            self._shed(lane, 1)
+            return
         self.events_routed += 1
-        self.lanes[shard_of(key, self.n)].q.put((kind, type_, obj, t))
+        lane.q.put((kind, type_, obj, t))
 
     def route_batch(self, kind: str, batch) -> None:
         """Hand a native pre-partitioned ParsedBatch to the lanes: one
@@ -554,6 +651,9 @@ class LaneSet:
         routed = 0
         for li, count, item in iter_recb_items(kind, batch, t):
             lane = self.lanes[li]
+            if lane._shed_depth and lane.q.qsize() > lane._shed_depth:
+                self._shed(lane, count)
+                continue
             lane.q.put(item)
             lane.telemetry.inc_routed(count)
             routed += count
@@ -561,6 +661,24 @@ class LaneSet:
         self.parent.telemetry.observe_route_batch(
             time.perf_counter() - t0
         )
+
+    def _shed(self, lane: ShardLane, n: int) -> None:
+        """Graceful degradation: a lane whose drain is down (or drowning)
+        past the configured queue depth sheds routed events — counted in
+        kwok_dropped_jobs_total, surfaced via kwok_degraded{reason=} and
+        a 503 /readyz — instead of growing the queue without bound. The
+        drain worker requests a stream resync the moment it catches up
+        (drain_loop's shed-clear path), so every shed object is
+        re-delivered by the full re-list: shedding trades freshness,
+        not permanent state."""
+        parent = self.parent
+        parent.telemetry.inc("dropped_jobs_total", n)
+        lane.shedding = True
+        if parent._degradation.set(f"lane{lane.index}_queue"):
+            logger.warning(
+                "lane %d queue past %d: shedding routed events "
+                "(engine degraded)", lane.index, lane._shed_depth,
+            )
 
     def _key_of(self, kind: str, type_: str, obj):
         """The routing key — identical to the lane pool's key, so a key's

@@ -95,6 +95,25 @@ def build_parser(defaults) -> argparse.ArgumentParser:
     p.add_argument("--trace-sample-every", type=int, default=256,
                    help="sample 1-in-N watch events for end-to-end "
                    "ingest->patch spans (0 disables)")
+    p.add_argument("--faults", default=o.faults,
+                   help="deterministic fault-injection spec "
+                   "(docs/resilience.md grammar, e.g. "
+                   "'seed=42;pump.drop=0.02;watch.expire=0.1'); "
+                   "KWOK_TPU_FAULTS works too; empty = disabled "
+                   "(zero overhead)")
+    p.add_argument("--shed-queue-depth", type=int, default=o.shedQueueDepth,
+                   help="shed routed events (kwok_dropped_jobs_total, "
+                   "kwok_degraded, /readyz 503) when a lane queue is "
+                   "deeper than this instead of growing it without "
+                   "bound; 0 = never shed")
+    p.add_argument("--worker-restart-budget", type=int,
+                   default=o.workerRestartBudget,
+                   help="watchdog: max restarts of one crashed lane "
+                   "worker per --worker-restart-window before the "
+                   "engine goes degraded")
+    p.add_argument("--worker-restart-window", type=float,
+                   default=o.workerRestartWindow,
+                   help="watchdog restart-budget window in seconds")
     from kwok_tpu import log
 
     log.add_flags(p)
@@ -130,6 +149,10 @@ def _engine_config(args, stages: list[Stage]):
         profile_dir=args.profile_dir,
         trace_dump=args.trace_dump,
         trace_sample_every=args.trace_sample_every,
+        faults=args.faults,
+        shed_queue_depth=args.shed_queue_depth,
+        worker_restart_budget=args.worker_restart_budget,
+        worker_restart_window=args.worker_restart_window,
         node_rules=stages_to_rules(stages, ResourceKind.NODE),
         pod_rules=stages_to_rules(stages, ResourceKind.POD),
     )

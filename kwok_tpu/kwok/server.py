@@ -115,6 +115,13 @@ class EngineServer:
                     if not getattr(engine, "ready", True):
                         self.send_error(503, "engine warming up")
                         return
+                    if getattr(engine, "degraded", False):
+                        # degraded mode (resilience/policy.py): shedding
+                        # load or out of worker restart budget — alive
+                        # (/livez stays 200) but don't send it traffic;
+                        # kwok_degraded{reason=} on /metrics names why
+                        self.send_error(503, "engine degraded")
+                        return
                     body = b"ok"
                     ctype = "text/plain"
                 elif self.path in ("/healthz", "/livez"):

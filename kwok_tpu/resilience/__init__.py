@@ -1,0 +1,50 @@
+"""kwok_tpu.resilience: chaos-hardening substrate for the control loop.
+
+Three pieces (ISSUE 6 tentpole; docs/resilience.md is the operator's
+guide):
+
+- ``faults``: a seedable, deterministic fault-injection plane
+  (``KWOK_TPU_FAULTS`` / ``EngineConfig.faults``) wrapping the pump,
+  the KubeClient transport, and worker threads — zero overhead when
+  disabled.
+- ``policy``: the shared ``RetryPolicy`` (exponential backoff + full
+  jitter + deadline cap) every reconnect loop uses, plus the
+  ``Degradation`` ledger behind ``kwok_degraded{reason=}`` and the
+  ``/readyz`` 503.
+- ``watchdog``: in-thread supervision restarting crashed lane
+  router/drain/emit workers within a budgeted window
+  (``kwok_worker_restarts_total{thread=}``), degrading the engine when
+  the budget runs out.
+"""
+
+from kwok_tpu.resilience.faults import (
+    FaultInjected,
+    FaultPlane,
+    FaultSpec,
+    WorkerKilled,
+    from_config,
+)
+from kwok_tpu.resilience.policy import (
+    PATCH_RETRY,
+    PUMP_RESEND,
+    WATCH_RECONNECT,
+    Backoff,
+    Degradation,
+    RetryPolicy,
+)
+from kwok_tpu.resilience.watchdog import Watchdog
+
+__all__ = [
+    "Backoff",
+    "Degradation",
+    "FaultInjected",
+    "FaultPlane",
+    "FaultSpec",
+    "PATCH_RETRY",
+    "PUMP_RESEND",
+    "RetryPolicy",
+    "WATCH_RECONNECT",
+    "Watchdog",
+    "WorkerKilled",
+    "from_config",
+]

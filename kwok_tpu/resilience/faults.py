@@ -1,0 +1,463 @@
+"""Deterministic fault injection at the engine's real I/O boundaries.
+
+The chaos substrate for ROADMAP items 4-5: the 410/compaction/restart
+dialects the mock apiservers already speak (edge/mockserver.py,
+native/apiserver.cc) and the pump's connection-failure contract
+(native/pump.cc) are only worth anything if the threaded engine is
+routinely *driven through them*. This module wraps the three boundaries
+faults actually enter through:
+
+- the KubeClient transport (``wrap_client``): watch handshake 410 storms,
+  mid-stream connection cuts, list failures, and apiserver-restart
+  blackout windows;
+- the native pump (``wrap_pump``): dropped connections, short writes
+  (a batch suffix dies mid-frame with status 0 — exactly pump.cc's
+  failure contract), and send delays;
+- worker threads (``kill_worker`` / the ``worker.kill`` spec): a
+  :class:`WorkerKilled` poison pill async-raised into a named
+  ``spawn_worker`` thread, which the watchdog must absorb and restart.
+
+Determinism: every boundary draws from its own ``random.Random`` stream
+seeded from ``(seed, site)``, so one site's decision sequence never
+depends on how other sites' calls interleave across threads. Same spec +
+same per-site call sequence -> same faults.
+
+Zero cost when disabled: with no spec there is no plane, no wrapper
+objects exist, and the engine's hot paths carry no fault checks — the
+only trace is an ``is None`` test at construction time.
+
+Spec grammar (``EngineConfig.faults`` / ``KWOK_TPU_FAULTS``)::
+
+    seed=42;pump.drop=0.02;pump.partial=0.02;pump.delay=0.01:0.05;
+    watch.expire=0.2;watch.cut=0.001;list.fail=0.1;
+    api.blackout=0.01:0.5;worker.kill=kwok-lane*:2.0
+
+Entries are ``;``-separated ``key=value`` pairs. Probability-valued keys
+take ``p`` or ``p:arg`` (``pump.delay``'s arg is seconds of sleep,
+``api.blackout``'s the blackout window length). ``worker.kill`` takes
+``<name-glob>:<period-seconds>``: every period, one live matching worker
+is killed, rotating through matches. See docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fnmatch
+import logging
+import random
+import threading
+import time
+
+from kwok_tpu.edge.kubeclient import WatchExpired
+from kwok_tpu.telemetry.errors import PROCESS_REGISTRY
+
+logger = logging.getLogger("kwok_tpu.resilience")
+
+_injected = PROCESS_REGISTRY.counter(
+    "kwok_faults_injected_total",
+    "Faults injected by the resilience fault plane, by kind "
+    "(pump.drop, watch.expire, worker.kill, ...); only moves when "
+    "KWOK_TPU_FAULTS / EngineConfig.faults is set",
+    ("kind",),
+)
+
+# every fault kind the spec accepts; parse rejects anything else so a
+# typo'd key fails fast instead of silently injecting nothing
+KINDS = (
+    "pump.drop",      # whole pump batch loses its connection (status 0)
+    "pump.partial",   # short write: a batch SUFFIX dies mid-frame
+    "pump.delay",     # sleep arg seconds before the send
+    "watch.expire",   # watch handshake answers 410 (WatchExpired)
+    "watch.cut",      # per-event/line: stream cut (connection drop)
+    "list.fail",      # LIST raises a connection error
+    "api.blackout",   # all transport fails for arg seconds (restart)
+    "worker.kill",    # kill matching workers every arg seconds
+)
+
+
+class FaultInjected(ConnectionError):
+    """An injected transport failure. Subclasses ConnectionError so every
+    existing reconnect/retry path treats it exactly like the real thing."""
+
+
+class WorkerKilled(BaseException):
+    """Poison pill async-raised into a worker thread. BaseException so the
+    per-item ``except Exception`` guards inside worker loops cannot absorb
+    it — the thread's supervision (resilience/watchdog.py) must."""
+
+
+def _async_raise(thread: threading.Thread, exc=WorkerKilled) -> bool:
+    """Raise ``exc`` inside ``thread`` at its next bytecode boundary.
+    Returns False when the thread is gone (or the raise could not be
+    armed). A thread parked in a C-level wait dies only once it wakes —
+    acceptable for chaos workers, which wake constantly under load."""
+    tid = thread.ident
+    if tid is None or not thread.is_alive():
+        return False
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(tid), ctypes.py_object(exc)
+    )
+    if res > 1:  # should not happen; undo rather than corrupt the thread
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(tid), None
+        )
+        return False
+    return res == 1
+
+
+class _Rate:
+    __slots__ = ("p", "arg")
+
+    def __init__(self, p: float, arg: float = 0.0):
+        self.p = float(p)
+        self.arg = float(arg)
+
+
+class FaultSpec:
+    """Parsed fault spec: per-kind rates + the deterministic seed."""
+
+    def __init__(self, seed: int = 0, rates: "dict[str, _Rate] | None" = None):
+        self.seed = int(seed)
+        self.rates: dict[str, _Rate] = rates or {}
+        self.kill_glob = ""
+        self.kill_period = 0.0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        spec = cls()
+        for entry in text.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" not in entry:
+                raise ValueError(f"fault spec entry {entry!r}: missing '='")
+            key, _, value = entry.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if key == "seed":
+                spec.seed = int(value)
+                continue
+            if key == "worker.kill":
+                glob, _, period = value.rpartition(":")
+                if not glob:
+                    raise ValueError(
+                        "worker.kill takes <name-glob>:<period-seconds>"
+                    )
+                spec.kill_glob = glob
+                spec.kill_period = float(period)
+                if spec.kill_period <= 0:
+                    raise ValueError("worker.kill period must be > 0")
+                continue
+            if key not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {key!r} (known: {', '.join(KINDS)})"
+                )
+            p, _, arg = value.partition(":")
+            spec.rates[key] = _Rate(p, float(arg) if arg else 0.0)
+        return spec
+
+    def rate(self, kind: str) -> "_Rate | None":
+        return self.rates.get(kind)
+
+
+class FaultPlane:
+    """One seeded instance of the fault plane: decision streams, the
+    blackout window, counters, and the optional worker-killer thread."""
+
+    def __init__(self, spec: FaultSpec):
+        self.spec = spec
+        # per-site decision streams: one Random per kind, seeded from
+        # (seed, kind), each behind its own lock so a site's sequence is
+        # a pure function of its own call count (thread interleaving
+        # across sites cannot perturb it)
+        self._streams = {
+            kind: (random.Random(f"{spec.seed}:{kind}"), threading.Lock())
+            for kind in KINDS
+        }
+        # blackout state: monotonic deadline; reads are lock-free (float
+        # store is GIL-atomic), arming happens under the fault lock
+        self._blackout_until = 0.0
+        self._fault_lock = threading.Lock()
+        self._events: dict[str, int] = {}
+        self._started = 0
+        self._killer: "threading.Thread | None" = None
+        self._stop = threading.Event()
+        self._kill_results: list[dict] = []
+
+    # ------------------------------------------------------------ decisions
+
+    def decide(self, kind: str) -> "_Rate | None":
+        """One draw from ``kind``'s stream: its rate when the fault fires,
+        else None. Sites with no configured rate never draw (their stream
+        stays untouched, preserving determinism for enabled sites)."""
+        rate = self.spec.rate(kind)
+        if rate is None or rate.p <= 0.0:
+            return None
+        rng, lock = self._streams[kind]
+        with lock:
+            fired = rng.random() < rate.p
+        return rate if fired else None
+
+    def record(self, kind: str) -> None:
+        """Account one injected fault (counter + the artifact tally)."""
+        with self._fault_lock:
+            self._events[kind] = self._events.get(kind, 0) + 1
+        # registry child locks are leaves; never take them under ours
+        _injected.labels(kind=kind).inc()
+
+    def counts(self) -> dict:
+        """Injected-fault tally by kind (chaos artifact surface)."""
+        with self._fault_lock:
+            return dict(self._events)
+
+    def kill_log(self) -> list[dict]:
+        with self._fault_lock:
+            return list(self._kill_results)
+
+    # ------------------------------------------------------------- blackout
+
+    def transport_fault(self, op: str) -> None:
+        """Shared unary-transport gate: raises FaultInjected while a
+        blackout window is open, and may open one (api.restart
+        semantics: every caller fails until the window closes)."""
+        now = time.monotonic()
+        if now < self._blackout_until:
+            self.record("api.blackout")
+            raise FaultInjected(f"injected apiserver blackout ({op})")
+        rate = self.decide("api.blackout")
+        if rate is not None:
+            with self._fault_lock:
+                self._blackout_until = now + max(rate.arg, 0.05)
+            self.record("api.blackout")
+            raise FaultInjected(f"injected apiserver restart ({op})")
+
+    # ------------------------------------------------------------- wrappers
+
+    def wrap_client(self, client):
+        """Fault-injecting view over a KubeClient. Idempotent: an already
+        wrapped client is returned unchanged (lane engines share their
+        parent's client)."""
+        if isinstance(client, FaultyClient):
+            return client
+        return FaultyClient(self, client)
+
+    def wrap_pump(self, pump):
+        return FaultyPump(self, pump)
+
+    # --------------------------------------------------------- worker kills
+
+    def start(self) -> None:
+        """Arm the worker-killer thread (when the spec asks for one).
+        Refcounted: engines sharing the plane start/stop it together."""
+        with self._fault_lock:
+            self._started += 1
+            if self._killer is not None or not self.spec.kill_glob:
+                return
+            self._stop.clear()
+            from kwok_tpu.workers import spawn_worker
+
+            self._killer = spawn_worker(
+                self._kill_loop, name="kwok-chaos-killer"
+            )
+
+    def stop(self) -> None:
+        with self._fault_lock:
+            self._started = max(0, self._started - 1)
+            if self._started:
+                return
+            killer, self._killer = self._killer, None
+        if killer is not None:
+            self._stop.set()
+            killer.join(timeout=5)
+
+    # Threads the spec-driven killer may target: ONLY the watchdog-
+    # supervised lane workers (LaneSet.start_workers). Killing an
+    # unsupervised singleton (kwok-tick, kwok-watch-*, kwok-http, the
+    # profiling sampler) would end it for good with /readyz still 200 —
+    # a silently-dead engine, not a self-healing exercise. Tests that
+    # want to assassinate arbitrary threads call kill_worker directly.
+    _SUPERVISED_PREFIXES = ("kwok-lane", "kwok-emit", "kwok-route")
+
+    def _kill_loop(self) -> None:
+        from kwok_tpu.workers import live_workers
+
+        nth = 0
+        while not self._stop.wait(self.spec.kill_period):
+            names = sorted(
+                n for n in live_workers()
+                if fnmatch.fnmatch(n, self.spec.kill_glob)
+                and n.startswith(self._SUPERVISED_PREFIXES)
+            )
+            if not names:
+                continue
+            # rotate deterministically through the sorted matches
+            name = names[nth % len(names)]
+            nth += 1
+            self.kill_worker(name)
+
+    def kill_worker(self, name: str) -> bool:
+        """Async-raise WorkerKilled into the named spawn_worker thread.
+        Returns whether the pill was armed."""
+        from kwok_tpu.workers import live_workers
+
+        t = live_workers().get(name)
+        if t is None:
+            return False
+        ok = _async_raise(t)
+        if ok:
+            self.record("worker.kill")
+            with self._fault_lock:
+                self._kill_results.append(
+                    {"thread": name, "t": time.monotonic()}
+                )
+            logger.warning("chaos: killed worker %s", name)
+        return ok
+
+
+class FaultyClient:
+    """KubeClient wrapper injecting transport faults. Unknown attributes
+    delegate, so FakeKube test hooks and HttpKubeClient extras survive."""
+
+    def __init__(self, plane: FaultPlane, inner):
+        self._plane = plane
+        self._inner = inner
+
+    def list(self, kind, **kw):
+        self._plane.transport_fault("list")
+        if self._plane.decide("list.fail") is not None:
+            self._plane.record("list.fail")
+            raise FaultInjected(f"injected list failure ({kind})")
+        return self._inner.list(kind, **kw)
+
+    def watch(self, kind, **kw):
+        self._plane.transport_fault("watch")
+        if kw.get("resource_version") and (
+            self._plane.decide("watch.expire") is not None
+        ):
+            # a compaction storm: every rv-resume is below the floor
+            self._plane.record("watch.expire")
+            raise WatchExpired(f"injected compaction ({kind})")
+        return FaultyWatch(self._plane, self._inner.watch(kind, **kw))
+
+    def get(self, kind, namespace, name):
+        self._plane.transport_fault("get")
+        return self._inner.get(kind, namespace, name)
+
+    def create(self, kind, obj, *a, **kw):
+        self._plane.transport_fault("create")
+        return self._inner.create(kind, obj, *a, **kw)
+
+    def patch_status(self, kind, namespace, name, patch):
+        self._plane.transport_fault("patch_status")
+        return self._inner.patch_status(kind, namespace, name, patch)
+
+    def patch_meta(self, kind, namespace, name, patch):
+        self._plane.transport_fault("patch_meta")
+        return self._inner.patch_meta(kind, namespace, name, patch)
+
+    def delete(self, kind, namespace, name, **kw):
+        self._plane.transport_fault("delete")
+        return self._inner.delete(kind, namespace, name, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class FaultyWatch:
+    """Watch-handle wrapper: cuts the stream (connection drop) with
+    ``watch.cut`` probability per event/line. The native reader is
+    disabled — it reads the socket from C, where per-line injection
+    cannot reach — so a faulted engine always takes a Python-visible
+    ingest path (raw_lines when the inner handle has it)."""
+
+    native_reader = None  # force the per-line path under faults
+
+    def __init__(self, plane: FaultPlane, inner):
+        self._plane = plane
+        self._inner = inner
+        if hasattr(inner, "raw_lines"):
+            # instance attribute: engines probe with getattr, and a
+            # wrapper around a handle WITHOUT raw_lines must not grow one
+            self.raw_lines = self._raw_lines
+
+    def _cut(self) -> bool:
+        if self._plane.decide("watch.cut") is not None:
+            self._plane.record("watch.cut")
+            try:
+                self._inner.stop()
+            except Exception:
+                logger.debug("inner watch stop failed mid-cut", exc_info=True)
+            return True
+        return False
+
+    def __iter__(self):
+        for ev in self._inner:
+            if self._cut():
+                return
+            yield ev
+
+    def _raw_lines(self):
+        for line in self._inner.raw_lines():
+            if self._cut():
+                return
+            yield line
+
+    def stop(self) -> None:
+        self._inner.stop()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class FaultyPump:
+    """Native-pump wrapper reproducing pump.cc's failure contract on
+    demand: a dropped connection fails the whole batch with status 0; a
+    short write delivers a PREFIX and fails the suffix mid-frame (the
+    exact shape the partial-write fix in the engine's ``_pump_send``
+    retry must recover from); a delay stalls the send."""
+
+    def __init__(self, plane: FaultPlane, inner):
+        self._plane = plane
+        self._inner = inner
+
+    def send(self, requests):
+        import numpy as np
+
+        plane = self._plane
+        rate = plane.decide("pump.delay")
+        if rate is not None:
+            plane.record("pump.delay")
+            time.sleep(rate.arg or 0.01)
+        if plane.decide("pump.drop") is not None:
+            plane.record("pump.drop")
+            return np.zeros(len(requests), np.int32)
+        if len(requests) > 1 and plane.decide("pump.partial") is not None:
+            plane.record("pump.partial")
+            rng, lock = plane._streams[("pump.partial")]
+            with lock:
+                k = rng.randrange(1, len(requests))
+            head = self._inner.send(requests[:k])
+            return np.concatenate(
+                [head, np.zeros(len(requests) - k, np.int32)]
+            )
+        return self._inner.send(requests)
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def from_config(spec_text: str = "") -> "FaultPlane | None":
+    """The engine's entry point: a FaultPlane when a spec is configured
+    (EngineConfig.faults, falling back to KWOK_TPU_FAULTS), else None —
+    the disabled case allocates nothing and wraps nothing. The literal
+    ``"off"`` disables the plane even when the env var is set (lane
+    child engines use it: ONE plane per engine, the parent's)."""
+    import os
+
+    text = (spec_text or os.environ.get("KWOK_TPU_FAULTS", "")).strip()
+    if not text or text == "off":
+        return None
+    return FaultPlane(FaultSpec.parse(text))

@@ -34,6 +34,13 @@ _crashes = PROCESS_REGISTRY.counter(
     "Uncaught exceptions that killed a spawned worker thread",
     ("thread",),
 )
+_restarts = PROCESS_REGISTRY.counter(
+    "kwok_worker_restarts_total",
+    "Crashed workers restarted by the resilience watchdog (within its "
+    "restart budget); a crash WITHOUT a matching restart means the "
+    "budget ran out and the engine went degraded",
+    ("thread",),
+)
 
 
 def swallowed(site: str) -> None:
@@ -52,6 +59,16 @@ def swallowed_total(site: str) -> int:
 def worker_crashed(thread_name: str) -> None:
     """Account an uncaught exception escaping a spawn_worker thread."""
     _crashes.labels(thread=thread_name).inc()
+
+
+def worker_restarted(thread_name: str) -> None:
+    """Account a watchdog restart of a crashed worker thread."""
+    _restarts.labels(thread=thread_name).inc()
+
+
+def worker_restarts_total(thread_name: str) -> int:
+    """Test/diagnostic read of one thread's restart counter."""
+    return _restarts.labels(thread=thread_name).value
 
 
 def render_nonempty() -> str:

@@ -1,0 +1,684 @@
+"""Resilience substrate tests (ISSUE 6): deterministic fault injection,
+shared retry policy, degraded-mode ledger, and supervised worker
+self-healing.
+
+The chaos gate itself (`make chaos-check`) lives in
+benchmarks/chaos_soak.py — a full fault-storm convergence run emitting
+CHAOS_r01.json. These tests pin the pieces it is built from:
+
+- the `KWOK_TPU_FAULTS` spec grammar and the per-site determinism
+  contract (same seed + same call sequence -> same faults);
+- zero cost when disabled: no plane, no wrappers, raw client;
+- RetryPolicy backoff shape (growth, cap, deadline, reset) and
+  stop-aware sleep;
+- the Degradation ledger driving kwok_degraded{reason=} and /readyz;
+- Watchdog in-thread restart within budget, budget exhaustion ->
+  degraded engine;
+- pump whole-frame resend: the partial-write fix over BOTH a stub
+  reproducing pump.cc's status-0 contract and a real short-writing
+  socket under the native pump;
+- lane-queue shedding past threshold, clearing once drained.
+"""
+
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kwok_tpu.edge.kubeclient import WatchExpired
+from kwok_tpu.edge.mockserver import FakeKube
+from kwok_tpu.engine import ClusterEngine, EngineConfig
+from kwok_tpu.engine.engine import _PumpGroup
+from kwok_tpu.resilience import (
+    Degradation,
+    FaultInjected,
+    FaultPlane,
+    FaultSpec,
+    RetryPolicy,
+    Watchdog,
+    from_config,
+)
+from kwok_tpu.resilience.faults import FaultyPump, WorkerKilled
+from kwok_tpu.telemetry.errors import worker_restarts_total
+from kwok_tpu.telemetry.registry import MetricsRegistry
+from tests.test_engine import make_node, make_pod
+
+
+# ------------------------------------------------------------ spec grammar
+
+
+def test_fault_spec_parse_full_grammar():
+    spec = FaultSpec.parse(
+        "seed=42; pump.drop=0.02; pump.delay=0.5:0.01; "
+        "watch.expire=0.2; api.blackout=0.01:0.5; "
+        "worker.kill=kwok-lane*:2.0"
+    )
+    assert spec.seed == 42
+    assert spec.rate("pump.drop").p == 0.02
+    assert spec.rate("pump.delay").p == 0.5
+    assert spec.rate("pump.delay").arg == 0.01
+    assert spec.rate("api.blackout").arg == 0.5
+    assert spec.kill_glob == "kwok-lane*"
+    assert spec.kill_period == 2.0
+    assert spec.rate("watch.cut") is None  # unset kinds stay None
+
+
+@pytest.mark.parametrize("bad", [
+    "pump.dorp=0.1",          # typo'd kind fails fast
+    "seed",                   # missing '='
+    "worker.kill=kwok-*:0",   # period must be > 0
+    "worker.kill=:2.0",       # empty glob
+])
+def test_fault_spec_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        FaultSpec.parse(bad)
+
+
+def test_from_config_disabled_paths(monkeypatch):
+    monkeypatch.delenv("KWOK_TPU_FAULTS", raising=False)
+    assert from_config("") is None
+    assert from_config("off") is None
+    monkeypatch.setenv("KWOK_TPU_FAULTS", "seed=7;pump.drop=0.5")
+    plane = from_config("")  # env fallback
+    assert plane is not None and plane.spec.seed == 7
+    # the literal "off" beats the env var (lane child engines rely on it:
+    # ONE plane per engine, the parent's)
+    assert from_config("off") is None
+
+
+def test_engine_without_faults_is_unwrapped():
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    assert eng._faults is None
+    assert eng.client is kube  # no wrapper object in the disabled case
+
+
+# ------------------------------------------------------------- determinism
+
+
+def test_fault_plane_deterministic_per_site():
+    spec = "seed=5;pump.drop=0.3;watch.expire=0.4"
+    a = FaultPlane(FaultSpec.parse(spec))
+    b = FaultPlane(FaultSpec.parse(spec))
+    seq_a = [a.decide("pump.drop") is not None for _ in range(64)]
+    # interleave another site's draws on b only: pump.drop's stream must
+    # not be perturbed (per-site streams, not one shared stream)
+    seq_b = []
+    for _ in range(64):
+        b.decide("watch.expire")
+        seq_b.append(b.decide("pump.drop") is not None)
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)
+    c = FaultPlane(FaultSpec.parse("seed=6;pump.drop=0.3;watch.expire=0.4"))
+    assert seq_a != [c.decide("pump.drop") is not None for _ in range(64)]
+
+
+# ------------------------------------------------------------ retry policy
+
+
+def test_retry_policy_shape_and_deadline():
+    p = RetryPolicy(base=0.1, cap=0.4, factor=2.0, jitter=False)
+    s = p.session()
+    assert [s.next_delay() for _ in range(4)] == [0.1, 0.2, 0.4, 0.4]
+    s.reset()
+    assert s.next_delay() == 0.1
+    # jittered delays stay inside [0, ceiling]
+    j = RetryPolicy(base=0.1, cap=0.4).session()
+    for _ in range(32):
+        d = j.next_delay()
+        assert 0 <= d <= 0.4
+    # a passed deadline yields None (callers give up / shed / escalate)
+    dead = RetryPolicy(base=0.1, cap=1.0, deadline=0.0).session()
+    assert dead.next_delay() is None
+    with pytest.raises(ValueError):
+        RetryPolicy(base=0.0)
+
+
+def test_backoff_sleep_stops_early():
+    s = RetryPolicy(base=0.1, cap=5.0).session()
+    stop = threading.Event()
+    stop.set()
+    t0 = time.monotonic()
+    s.sleep(5.0, should_stop=stop.is_set)
+    assert time.monotonic() - t0 < 1.0  # sliced sleep saw the stop
+
+
+# -------------------------------------------------------------- degradation
+
+
+def test_degradation_ledger_edges_and_gauge():
+    reg = MetricsRegistry()
+    d = Degradation(reg)
+    assert not d.active
+    assert d.set("pump") is True      # fresh edge
+    assert d.set("pump") is False     # recurrence: no edge
+    assert d.active and d.reasons == ("pump",)
+    assert 'kwok_degraded{reason="pump"} 1' in reg.render()
+    assert d.clear("pump") is True
+    assert d.clear("pump") is False
+    assert not d.active
+    assert 'kwok_degraded{reason="pump"} 0' in reg.render()
+
+
+def test_readyz_503_while_degraded():
+    from kwok_tpu.kwok.server import EngineServer
+
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    eng.ready = True
+    srv = EngineServer(eng, "127.0.0.1:0")
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/readyz"
+        assert urllib.request.urlopen(url).status == 200
+        eng._degradation.set("worker_restart_budget")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url)
+        assert ei.value.code == 503
+        # liveness is NOT degraded-gated: restart probes must not kill a
+        # degraded-but-alive engine
+        live = f"http://127.0.0.1:{srv.port}/livez"
+        assert urllib.request.urlopen(live).status == 200
+        eng._degradation.clear("worker_restart_budget")
+        assert urllib.request.urlopen(url).status == 200
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------- watchdog
+
+
+def test_watchdog_restarts_within_budget():
+    crashes = 3
+    ran = []
+    done = threading.Event()
+
+    def target():
+        ran.append(1)
+        if len(ran) <= crashes:
+            raise RuntimeError("boom")
+        done.set()
+
+    before = worker_restarts_total("wd-test-worker")
+    wd = Watchdog(budget=5, window=30.0)
+    t = wd.spawn(target, name="wd-test-worker")
+    assert done.wait(10), "worker was not restarted to completion"
+    t.join(timeout=10)
+    assert len(ran) == crashes + 1
+    assert worker_restarts_total("wd-test-worker") - before == crashes
+    assert wd.restarts_total() == crashes
+    assert all(r["thread"] == "wd-test-worker" for r in wd.restart_log())
+
+
+def test_watchdog_budget_exhaustion_degrades():
+    exhausted = []
+    hooked = threading.Event()
+    old_hook = threading.excepthook
+    escaped = []
+
+    def hook(args):
+        escaped.append(args.exc_type)
+        hooked.set()
+
+    def target():
+        raise WorkerKilled("pill")  # BaseException: loops can't absorb it
+
+    threading.excepthook = hook
+    try:
+        wd = Watchdog(
+            budget=2, window=30.0,
+            on_exhausted=lambda name: exhausted.append(name),
+        )
+        t = wd.spawn(target, name="wd-crashloop")
+        assert hooked.wait(10), "final crash never reached excepthook"
+        t.join(timeout=10)
+    finally:
+        threading.excepthook = old_hook
+    assert exhausted == ["wd-crashloop"]
+    # 2 restarts spent, the 3rd crash re-raised (budget 2)
+    assert wd.restarts_total() == 2
+    assert escaped and issubclass(escaped[0], WorkerKilled)
+
+
+def test_watchdog_closed_does_not_restart():
+    ran = []
+    old_hook, threading.excepthook = threading.excepthook, lambda a: None
+    try:
+        wd = Watchdog(budget=5, window=30.0)
+        wd.close()
+
+        def target():
+            ran.append(1)
+            raise RuntimeError("shutdown crash")
+
+        t = wd.spawn(target, name="wd-closed")
+        t.join(timeout=10)
+    finally:
+        threading.excepthook = old_hook
+    assert ran == [1]  # crashed once, never restarted
+    assert wd.restarts_total() == 0
+
+
+# -------------------------------------------------- pump partial-write fix
+
+
+class _ShortWritePump:
+    """Reproduces pump.cc's failure contract deterministically: the first
+    ``fail_sends`` calls deliver a PREFIX and fail the suffix with status
+    0 (connection died mid-frame); later calls succeed. Records every
+    request it accepted so the test can prove whole-frame resend."""
+
+    def __init__(self, fail_sends=1, prefix=1):
+        self.fail_sends = fail_sends
+        self.prefix = prefix
+        self.calls: list[list] = []
+
+    def send(self, reqs):
+        self.calls.append(list(reqs))
+        if len(self.calls) <= self.fail_sends:
+            st = np.zeros(len(reqs), np.int32)
+            st[: self.prefix] = 200
+            return st
+        return np.full(len(reqs), 200, np.int32)
+
+    def close(self):
+        pass
+
+
+def _engine_for_pump(monkeypatch=None):
+    eng = ClusterEngine(FakeKube(), EngineConfig(manage_all_nodes=True))
+    eng._running = True
+    return eng
+
+
+def test_pump_send_frames_resends_whole_frames():
+    eng = _engine_for_pump()
+    pump = _ShortWritePump(fail_sends=1, prefix=2)
+    eng._pump = _PumpGroup([pump])
+    reqs = [("PATCH", f"/p{i}", b"%d" % i) for i in range(5)]
+    status = eng._pump_send_frames(reqs)
+    assert (status == 200).all()
+    # first call: the whole batch; second: ONLY the dead suffix, as
+    # complete frames (never a resumed partial frame)
+    assert pump.calls[0] == reqs
+    assert pump.calls[1] == reqs[2:]
+    assert not eng.degraded
+
+
+def test_pump_send_frames_gives_up_and_degrades(monkeypatch):
+    import kwok_tpu.engine.engine as engine_mod
+    from kwok_tpu.resilience.policy import RetryPolicy as RP
+
+    # a fast deadline so the give-up path runs in milliseconds
+    monkeypatch.setattr(
+        engine_mod, "PUMP_RESEND", RP(base=0.001, cap=0.002, deadline=0.05)
+    )
+    eng = _engine_for_pump()
+
+    class DeadPump:
+        def send(self, reqs):
+            return np.zeros(len(reqs), np.int32)
+
+        def close(self):
+            pass
+
+    eng._pump = _PumpGroup([DeadPump()])
+    reqs = [("PATCH", "/x", b"b")]
+    status = eng._pump_send_frames(reqs)
+    assert (status == 0).all()
+    assert eng.degraded and "pump" in eng._degradation.reasons
+    # recovery clears the reason on the next healthy send
+    eng._pump = _PumpGroup([_ShortWritePump(fail_sends=0)])
+    status = eng._pump_send_frames(reqs)
+    assert (status == 200).all()
+    assert not eng.degraded
+
+
+def _short_write_http_server():
+    """A real short-writing socket: connection 1 reads a few bytes of the
+    first frame and closes mid-request (the pump sees its whole pipeline
+    die -> status 0); later connections speak correct HTTP/1.1 and answer
+    every request 200. Returns (port, complete_bodies, stop)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+    complete = []
+    nconn = [0]
+    stopping = threading.Event()
+
+    def handle(conn):
+        nconn[0] += 1
+        if nconn[0] == 1:
+            conn.recv(16)  # a short read of frame 1...
+            conn.close()   # ...then die mid-frame
+            return
+        buf = b""
+        try:
+            while not stopping.is_set():
+                # parse pipelined requests: headers, Content-Length, body
+                while b"\r\n\r\n" in buf:
+                    head, _, rest = buf.partition(b"\r\n\r\n")
+                    clen = 0
+                    for line in head.split(b"\r\n"):
+                        if line.lower().startswith(b"content-length:"):
+                            clen = int(line.split(b":")[1])
+                    if len(rest) < clen:
+                        break
+                    complete.append(rest[:clen])
+                    buf = rest[clen:]
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n"
+                    )
+                data = conn.recv(65536)
+                if not data:
+                    return
+                buf += data
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def accept_loop():
+        while not stopping.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=handle, args=(conn,), daemon=True
+            ).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+
+    def stop():
+        stopping.set()
+        srv.close()
+
+    return port, complete, stop
+
+
+def test_native_pump_short_writing_socket_recovers():
+    """The satellite regression: the NATIVE pump against a socket that
+    dies mid-frame. pump.cc hands the dead suffix back as status 0; the
+    engine's whole-frame resend must deliver every request completely on
+    the re-dialed connection — no torn frame is ever accepted."""
+    native = pytest.importorskip("kwok_tpu.native")
+    if native.load() is None:
+        pytest.skip("native codec unavailable")
+    port, complete, stop = _short_write_http_server()
+    eng = _engine_for_pump()
+    pump = native.Pump("127.0.0.1", port, nconn=1)
+    eng._pump = _PumpGroup([pump])
+    try:
+        bodies = [b'{"frame":%d}' % i for i in range(4)]
+        reqs = [("PATCH", f"/api/v1/x{i}", b) for i, b in enumerate(bodies)]
+        status = eng._pump_send_frames(reqs)
+        assert (status == 200).all(), f"statuses: {status}"
+        # every frame arrived COMPLETE (the mid-frame suffix was resent
+        # whole, not resumed at the break point)
+        for b in bodies:
+            assert b in complete, f"frame {b} never arrived complete"
+    finally:
+        stop()
+        pump.close()
+        eng._pump = None
+
+
+def test_faulty_pump_injects_pump_cc_contract():
+    """The injected partial write matches the REAL failure shape the
+    socket test exercises: head statuses from the inner pump, suffix 0,
+    and the inner pump only ever sees whole frames."""
+    plane = FaultPlane(FaultSpec.parse("seed=1;pump.partial=1.0"))
+    inner = _ShortWritePump(fail_sends=0)
+    fp = FaultyPump(plane, inner)
+    reqs = [("PATCH", f"/p{i}", b"x") for i in range(6)]
+    st = fp.send(reqs)
+    k = int((st == 200).sum())
+    assert 1 <= k < 6 and (st[:k] == 200).all() and (st[k:] == 0).all()
+    assert inner.calls[0] == reqs[:k]  # a prefix of whole frames
+    assert plane.counts().get("pump.partial") == 1
+
+    drop = FaultyPump(
+        FaultPlane(FaultSpec.parse("seed=1;pump.drop=1.0")), inner
+    )
+    assert (drop.send(reqs) == 0).all()
+
+
+# ------------------------------------------------------ client fault plane
+
+
+def test_faulty_client_watch_expire_and_list_fail():
+    kube = FakeKube()
+    kube.create("nodes", make_node("f0"))
+    plane = FaultPlane(FaultSpec.parse("seed=2;watch.expire=1.0"))
+    client = plane.wrap_client(kube)
+    assert plane.wrap_client(client) is client  # idempotent
+    # rv-resumes hit the injected compaction; a fresh watch (rv=0, the
+    # re-list path) passes — exactly the real 410 recovery shape
+    with pytest.raises(WatchExpired):
+        client.watch("nodes", resource_version=3)
+    w = client.watch("nodes")
+    w.stop()
+    assert plane.counts()["watch.expire"] >= 1
+
+    lf = FaultPlane(FaultSpec.parse("seed=2;list.fail=1.0"))
+    client2 = lf.wrap_client(kube)
+    with pytest.raises(FaultInjected):
+        client2.list("nodes")
+
+
+def test_faulty_client_blackout_window():
+    kube = FakeKube()
+    kube.create("nodes", make_node("b0"))
+    plane = FaultPlane(FaultSpec.parse("seed=3;api.blackout=1.0:0.2"))
+    client = plane.wrap_client(kube)
+    with pytest.raises(FaultInjected):
+        client.get("nodes", None, "b0")
+    # inside the window EVERY transport op fails (apiserver restart)
+    with pytest.raises(FaultInjected):
+        client.list("nodes")
+    time.sleep(0.25)
+    # window closed; the next decision draw may reopen it, so drain the
+    # stream's firing with rate still 1.0 -> it reopens: prove the window
+    # CLOSES by using a plane whose stream has fired its one blackout
+    plane.spec.rates.clear()
+    assert client.get("nodes", None, "b0")["metadata"]["name"] == "b0"
+
+
+def test_faulty_watch_cut_ends_stream():
+    kube = FakeKube()
+    plane = FaultPlane(FaultSpec.parse("seed=4;watch.cut=1.0"))
+    client = plane.wrap_client(kube)
+    w = client.watch("nodes")
+    kube.create("nodes", make_node("c0"))
+    kube.create("nodes", make_node("c1"))
+    got = list(w)  # cut after the first event: stream ends early
+    assert len(got) == 0  # p=1.0 cuts before yielding anything
+    assert plane.counts()["watch.cut"] >= 1
+
+
+# ----------------------------------------------------------- lane shedding
+
+
+def test_lane_queue_shedding_and_recovery():
+    kube = FakeKube()
+    eng = ClusterEngine(
+        kube,
+        EngineConfig(
+            manage_all_nodes=True, drain_shards=2, shed_queue_depth=4
+        ),
+    )
+    lanes = eng._lanes
+    kube.create("nodes", make_node("sn"))
+    lanes.route("nodes", "ADDED", kube.get("nodes", None, "sn"))
+    # pick the lane pod key ("default","sp0") hashes to and stuff it past
+    # the threshold
+    from kwok_tpu.engine.rowpool import shard_of
+
+    li = shard_of(("default", "sp0"), 2)
+    lane = lanes.lanes[li]
+    dropped0 = eng.metrics["dropped_jobs_total"]
+    kube.create("pods", make_pod("sp0", node="sn"))
+    obj = kube.get("pods", "default", "sp0")
+    for i in range(12):
+        lanes.route("pods", "MODIFIED", obj)
+    assert lane.q.qsize() <= 4 + 1
+    assert lane.shedding and eng.degraded
+    assert f"lane{li}_queue" in eng._degradation.reasons
+    assert eng.metrics["dropped_jobs_total"] > dropped0
+    # drain the backlog on this thread: the worker-loop clear path runs
+    # once the depth halves, lifting degraded mode
+    lane.q.put(None)  # stop sentinel after the backlog
+    lane.drain_loop()
+    assert not lane.shedding
+    assert not eng.degraded
+
+
+# ------------------------------------------- chaos e2e: kill lane workers
+
+
+def _wait(pred, timeout=30.0, every=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def test_killed_drain_and_emit_workers_restart_and_converge():
+    """The tentpole's heart, in-miniature: a threaded 4-lane engine loses
+    a drain worker AND an emit worker to chaos pills mid-churn; the
+    watchdog restarts both in place, the queues drain, and every pod
+    still converges to Running."""
+    kube = FakeKube()
+    eng = ClusterEngine(
+        kube,
+        EngineConfig(
+            manage_all_nodes=True, tick_interval=0.02, drain_shards=4,
+            faults="seed=11",  # plane armed; zero probabilistic rates
+        ),
+    )
+    r_drain0 = worker_restarts_total("kwok-lane1")
+    r_emit0 = worker_restarts_total("kwok-emit2")
+    eng.start()
+    try:
+        kube.create("nodes", make_node("kn"))
+        for i in range(16):
+            kube.create("pods", make_pod(f"kp{i}", node="kn"))
+        assert _wait(lambda: all(
+            (kube.get("pods", "default", f"kp{i}") or {})
+            .get("status", {}).get("phase") == "Running"
+            for i in range(16)
+        )), "first wave did not converge"
+
+        assert eng._faults.kill_worker("kwok-lane1")
+        assert eng._faults.kill_worker("kwok-emit2")
+        # traffic makes parked workers wake and eat their pills
+        for i in range(16, 40):
+            kube.create("pods", make_pod(f"kp{i}", node="kn"))
+
+        assert _wait(
+            lambda: worker_restarts_total("kwok-lane1") > r_drain0
+            and worker_restarts_total("kwok-emit2") > r_emit0
+        ), "killed workers were not restarted"
+        assert _wait(lambda: all(
+            (kube.get("pods", "default", f"kp{i}") or {})
+            .get("status", {}).get("phase") == "Running"
+            for i in range(40)
+        )), "post-kill wave did not converge"
+        assert _wait(
+            lambda: all(
+                lane.q.qsize() == 0 for lane in eng._lanes.lanes
+            )
+        ), "a lane queue never drained after the kill"
+        assert not eng.degraded  # restarts stayed inside the budget
+        assert eng._faults.counts().get("worker.kill") == 2
+    finally:
+        eng.stop()
+
+
+def test_worker_kill_spec_glob_rotates():
+    """worker.kill=<glob>:<period> kills matching workers on a period,
+    rotating through the sorted matches deterministically."""
+    kube = FakeKube()
+    eng = ClusterEngine(
+        kube,
+        EngineConfig(
+            manage_all_nodes=True, tick_interval=0.02, drain_shards=2,
+            faults="seed=12;worker.kill=kwok-lane*:0.2",
+            # the killer fires for the whole fault window: budget must
+            # cover it (the budget-exhaustion path is pinned separately)
+            worker_restart_budget=1000,
+        ),
+    )
+    eng.start()
+    try:
+        kube.create("nodes", make_node("gn"))
+        # steady trickle so parked workers wake into their pills
+        for i in range(30):
+            kube.create("pods", make_pod(f"gp{i}", node="gn"))
+            time.sleep(0.03)
+        assert _wait(
+            lambda: eng._faults.counts().get("worker.kill", 0) >= 2
+        ), "the worker-killer thread never fired"
+        kills = [k["thread"] for k in eng._faults.kill_log()]
+        assert set(kills) <= {"kwok-lane0", "kwok-lane1"}
+        # end the fault window (the chaos-soak shape: storm, then heal),
+        # then the engine must converge
+        eng._faults.spec.kill_glob = "chaos-window-closed"
+        assert _wait(lambda: all(
+            (kube.get("pods", "default", f"gp{i}") or {})
+            .get("status", {}).get("phase") == "Running"
+            for i in range(30)
+        )), "engine did not converge under periodic worker kills"
+        assert not eng.degraded
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------- CLI plumbing
+
+
+def test_cli_flags_reach_engine_config():
+    from kwok_tpu.config.types import KwokConfigurationOptions
+    from kwok_tpu.kwok.cli import _engine_config, build_parser
+
+    p = build_parser(KwokConfigurationOptions())
+    args = p.parse_args([
+        "--faults", "seed=9;pump.drop=0.5",
+        "--shed-queue-depth", "128",
+        "--worker-restart-budget", "3",
+        "--worker-restart-window", "12.5",
+        "--manage-all-nodes", "true",
+    ])
+    cfg = _engine_config(args, [])
+    assert cfg.faults == "seed=9;pump.drop=0.5"
+    assert cfg.shed_queue_depth == 128
+    assert cfg.worker_restart_budget == 3
+    assert cfg.worker_restart_window == 12.5
+
+
+def test_config_env_overrides_cover_resilience(monkeypatch):
+    from kwok_tpu.config.types import (
+        KwokConfigurationOptions,
+        apply_env_overrides,
+    )
+
+    o = KwokConfigurationOptions()
+    env = {
+        "KWOK_FAULTS": "seed=3;watch.cut=0.1",
+        "KWOK_SHED_QUEUE_DEPTH": "64",
+        "KWOK_WORKER_RESTART_BUDGET": "9",
+        "KWOK_WORKER_RESTART_WINDOW": "45.0",
+    }
+    apply_env_overrides(o, environ=env)
+    assert o.faults == "seed=3;watch.cut=0.1"
+    assert o.shedQueueDepth == 64
+    assert o.workerRestartBudget == 9
+    assert o.workerRestartWindow == 45.0
