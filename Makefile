@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check image cluster-image clean
 
 all: build
 
@@ -49,9 +49,19 @@ lane-check: ## sharded-lane ordering oracle + thread-sanity + lock-witness pass 
 # mid-frame partial writes, watch cuts, 410/compaction storms, apiserver
 # blackouts, a killed drain worker AND a killed emit worker — must end
 # byte-identical to a fault-free run (docs/resilience.md; CHAOS_r*.json).
-chaos-check: ## deterministic fault-injection + self-healing convergence gate
+chaos-check: ## deterministic fault-injection + self-healing convergence gate (+ restore storm)
 	$(PYENV) python3 -m pytest tests/test_resilience.py -q
-	$(PYENV) python3 benchmarks/chaos_soak.py --check
+	$(PYENV) python3 benchmarks/chaos_soak.py --check --restore-storm
+
+# restart-check: the crash-durability RTO gate: a real tpukwok process is
+# SIGKILLed mid-lifecycle and cold-restarted against its --checkpoint-dir;
+# gates = zero double-fired transitions (server-side oplog oracle), every
+# Stage delay resumed within one tick quantum of its checkpointed residue,
+# final pod phases byte-identical to an uninterrupted control arm, and the
+# recovery-to-caught-up latency recorded in RESTART_r*.json
+# (docs/resilience.md).
+restart-check: ## SIGKILL + cold-restart crash-durability gate (RTO artifact)
+	$(PYENV) python3 benchmarks/restart_soak.py --check
 
 image:
 	./images/kwok/build.sh

@@ -225,6 +225,201 @@ def _recollapse(store, name):
     return store.per_key_collapsed(("default", name))
 
 
+def _post_restore(url: str, snap: dict) -> None:
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + "/restore",
+        data=json.dumps(snap).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+
+
+def _run_restore_storm(
+    pods: int, lanes: int, timeout: float, rounds: int = 2
+) -> dict:
+    """The --restore-storm arm (mock apiserver): snapshot the store right
+    after the workload lands (every pod still Pending), let the engine
+    start converging, then POST /restore with that snapshot mid-run —
+    twice. Each restore rewinds every object's resourceVersion and
+    status underneath the engine and closes all watch streams; the
+    engine must detect the rv rewind on its re-list
+    (kwok_rv_rewinds_total), resync every stream, re-assert its state
+    through the repair path, and still end byte-identical to the
+    fault-free baseline with per-key patch order preserved (the repair
+    re-patch collapses as a consecutive duplicate)."""
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+
+    store = _recording_store()
+    srv = HttpFakeApiserver(store=store).start()
+    url = f"http://127.0.0.1:{srv.port}"
+    names = [f"cs{i}" for i in range(pods)]
+    nodes = [f"csn{i}" for i in range(4)]
+    eng = ClusterEngine(
+        HttpKubeClient.from_kubeconfig(None, url),
+        EngineConfig(
+            manage_all_nodes=True, tick_interval=0.02, drain_shards=lanes,
+        ),
+    )
+    out: dict = {"mode": "restore_storm"}
+    t_run0 = time.time()
+    eng.start()
+    try:
+        for n in nodes:
+            store.create("nodes", _make_node(n))
+        for n in names:
+            store.create("pods", _make_pod(n, nodes[hash(n) % len(nodes)]))
+        # the rewind target: every pod Pending, pre-convergence revisions
+        snap = store.dump()
+        heal_t0 = time.time()
+        for _ in range(rounds):
+            time.sleep(1.2)  # let transitions land, then yank the store
+            _post_restore(url, snap)
+        converged = _wait(
+            lambda: all(
+                ph == "Running" for ph in _pod_phases(store, names).values()
+            ),
+            timeout,
+        )
+        out["converged"] = converged
+        out["recovery_to_converged_s"] = round(time.time() - heal_t0, 3)
+        out["wall_s"] = round(time.time() - t_run0, 3)
+        out["queues_drained"] = _wait(
+            lambda: all(
+                lane.q.qsize() == 0 and lane.emit_q.qsize() == 0
+                for lane in eng._lanes.lanes
+            ),
+            10.0,
+        )
+        # "no stranded rows": every pod is still tracked by exactly its
+        # lane and reached the terminal workload phase
+        out["rows_tracked"] = sum(
+            len(lane.engine.pods.pool) for lane in eng._lanes.lanes
+        )
+        out["final_phases"] = _pod_phases(store, names)
+        out["per_key_order"] = {n: _recollapse(store, n) for n in names}
+        out["watch_relists_total"] = eng.metrics["watch_relists_total"]
+        out["rv_rewinds_total"] = eng.metrics["rv_rewinds_total"]
+        out["degraded_at_end"] = eng.degraded
+    finally:
+        eng.stop()
+        srv.stop()
+    return out
+
+
+def _run_restore_storm_native(
+    pods: int, timeout: float, rounds: int = 2
+) -> "dict | None":
+    """The native-apiserver twin of the restore storm: same engine, same
+    gates, but the store being yanked is apiserver.cc over a real socket
+    (snapshot via GET /snapshot, rewind via POST /restore). Returns None
+    when no C++ compiler is available (the parity twin in
+    tests/test_mock_snapshot.py is skipped the same way)."""
+    import signal
+    import subprocess
+    import urllib.request
+
+    from kwok_tpu import native
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+
+    binary = native.apiserver_binary()
+    if binary is None:
+        return None
+    proc = subprocess.Popen(
+        [binary, "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    url = None
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "listening on" in line:
+            url = line.rsplit(" ", 1)[-1].strip()
+            break
+    if not url:
+        proc.kill()
+        return None
+    names = [f"cs{i}" for i in range(pods)]
+    nodes = [f"csn{i}" for i in range(4)]
+    client = HttpKubeClient(url)
+    eng = ClusterEngine(
+        HttpKubeClient(url),
+        EngineConfig(manage_all_nodes=True, tick_interval=0.02),
+    )
+    out: dict = {"mode": "restore_storm_native"}
+
+    def phases():
+        return {
+            n: (client.get("pods", "default", n) or {})
+            .get("status", {}).get("phase")
+            for n in names
+        }
+
+    eng.start()
+    try:
+        for n in nodes:
+            client.create("nodes", _make_node(n))
+        for n in names:
+            client.create("pods", _make_pod(n, nodes[hash(n) % len(nodes)]))
+        snap = json.loads(
+            urllib.request.urlopen(url + "/snapshot", timeout=10).read()
+        )
+        heal_t0 = time.time()
+        for _ in range(rounds):
+            time.sleep(1.2)
+            _post_restore(url, snap)
+        converged = _wait(
+            lambda: all(ph == "Running" for ph in phases().values()),
+            timeout,
+        )
+        out["converged"] = converged
+        out["recovery_to_converged_s"] = round(time.time() - heal_t0, 3)
+        out["final_phases"] = phases()
+        out["watch_relists_total"] = eng.metrics["watch_relists_total"]
+        out["rv_rewinds_total"] = eng.metrics["rv_rewinds_total"]
+        out["degraded_at_end"] = eng.degraded
+    finally:
+        eng.stop()
+        client.close()
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    return out
+
+
+def restore_gates(base: dict, storm: dict, native: "dict | None") -> dict:
+    g = {
+        "restore_converged": bool(storm["converged"]),
+        "restore_phases_identical": (
+            json.dumps(base["final_phases"], sort_keys=True)
+            == json.dumps(storm["final_phases"], sort_keys=True)
+        ),
+        "restore_per_key_order_preserved": (
+            base["per_key_order"] == storm["per_key_order"]
+        ),
+        "restore_rv_rewind_detected": storm["rv_rewinds_total"] >= 1,
+        "restore_no_stranded_rows": (
+            storm["rows_tracked"] == len(storm["final_phases"])
+        ),
+        "restore_queues_drained": bool(storm["queues_drained"]),
+        "restore_not_degraded_at_end": not storm["degraded_at_end"],
+    }
+    if native is not None:
+        g["restore_native_converged"] = bool(native["converged"])
+        g["restore_native_rv_rewind_detected"] = (
+            native["rv_rewinds_total"] >= 1
+        )
+        g["restore_native_not_degraded"] = not native["degraded_at_end"]
+    return g
+
+
 def gates(base: dict, chaos: dict) -> dict:
     return {
         "baseline_converged": bool(base["converged"]),
@@ -261,6 +456,11 @@ def main() -> int:
     p.add_argument("--check", action="store_true",
                    help="CI gate: smaller workload, exit 1 on any failed "
                    "convergence/ordering/restart gate")
+    p.add_argument("--restore-storm", action="store_true",
+                   help="also run the store-restore arms: POST /restore "
+                   "(rv rewind + watch closure) mid-run against the mock "
+                   "AND native apiservers, gated on the same convergence "
+                   "oracles (native skipped without a C++ compiler)")
     args = p.parse_args()
     if args.lanes < 2:
         p.error("--lanes must be >= 2: the gate kills a drain worker and "
@@ -273,6 +473,13 @@ def main() -> int:
     chaos = _run(args.pods, args.lanes, args.seed, chaos=True,
                  timeout=args.timeout)
     g = gates(base, chaos)
+    storm = storm_native = None
+    if args.restore_storm:
+        storm = _run_restore_storm(args.pods, args.lanes, args.timeout)
+        storm_native = _run_restore_storm_native(
+            min(args.pods, 32), args.timeout
+        )
+        g.update(restore_gates(base, storm, storm_native))
     ok = all(g.values())
 
     # the artifact keeps the verdicts + the storm's accounting; the full

@@ -1,0 +1,496 @@
+"""RTO gate: SIGKILL the real engine process mid-lifecycle and prove the
+cold restart is crash-durable.
+
+Two arms against the HTTP mock apiserver, driving the REAL ``tpukwok``
+process (subprocess, multi-lane, native ingest — the production wiring):
+
+- control: the workload runs uninterrupted to convergence;
+- crash: the same workload, but the engine is ``SIGKILL``\\ ed mid-delay —
+  while every pod's Pending->Running Stage delay is still in flight —
+  then cold-restarted against the same ``--checkpoint-dir``.
+
+Pods are created in two staggered waves so their checkpointed residues
+differ; the restarted engine must resume each delay where the checkpoint
+left it, not restart it from zero (and not fire it twice).
+
+Gates (--check exits nonzero on any failure):
+
+- **no double fire**: the server-side oplog oracle (every status patch
+  stamped at arrival) shows exactly ONE Running patch per pod across
+  both engine lifetimes;
+- **delays resume**: per pod, wall-clock fire time minus checkpointed
+  residue is constant up to one tick quantum (the common offset — kill
+  lag + restart cost — is anchored out with the median, which is
+  exactly the freeze-during-downtime contract);
+- **phases byte-identical**: final pod phases equal the control arm's;
+- **RTO recorded**: recovery-to-caught-up latency (process spawn ->
+  /readyz 200, i.e. first full re-list + checkpoint reconcile applied)
+  lands in the RESTART_r*.json artifact, alongside the engine's own
+  kwok_restart_recovery_seconds;
+- **graceful drain**: both surviving engines exit 0 on SIGTERM within
+  the --drain-deadline, refreshing their final checkpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUANTUM = 0.25  # --tick-interval: the gate's resume tolerance
+DELAY_S = 8.0  # Pending->Running Stage delay (long vs kill timing)
+STAGGER_S = 1.5  # wave B trails wave A: distinct residues
+CKPT_INTERVAL = 0.5
+
+STAGES_YAML = f"""\
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata: {{name: pod-delete}}
+spec:
+  resourceRef: {{kind: Pod}}
+  selector:
+    matchSelector: on-managed-node
+    matchDeletion: present
+    matchPhases: ["Pending", "Running", "Succeeded", "Failed", "Terminating"]
+  next: {{delete: true}}
+---
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata: {{name: pod-run}}
+spec:
+  resourceRef: {{kind: Pod}}
+  selector: {{matchPhases: ["Pending"], matchSelector: managed}}
+  delay: {{duration: {DELAY_S}s}}
+  next:
+    phase: Running
+    conditions: {{Ready: true, ContainersReady: true}}
+"""
+
+
+def _make_pod(name: str, node: str) -> dict:
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"nodeName": node,
+                 "containers": [{"name": "c", "image": "busybox"}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def _make_node(name: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name}, "status": {}}
+
+
+def _timed_store():
+    """FakeKube whose pod status patches keep a wall-stamped arrival
+    oplog (server side: pump- and client-delivered writes both land
+    here) — the double-fire and residue-resume oracle."""
+    from kwok_tpu.edge.mockserver import FakeKube
+
+    class TimedStore(FakeKube):
+        def __init__(self):
+            super().__init__()
+            self.oplog: list = []  # (key, phase, wall-seconds)
+
+        def _note(self, kind, namespace, name, patch):
+            if kind != "pods" or not isinstance(patch, dict):
+                return
+            phase = (patch.get("status") or {}).get("phase")
+            if phase:
+                self.oplog.append(
+                    ((namespace or "default", name), phase, time.time())
+                )
+
+        def patch_status(self, kind, namespace, name, patch):
+            self._note(kind, namespace, name, patch)
+            return super().patch_status(kind, namespace, name, patch)
+
+        def patch_status_bytes(self, kind, namespace, name, patch):
+            if isinstance(patch, (bytes, bytearray, memoryview)):
+                patch = json.loads(bytes(patch))
+            self._note(kind, namespace, name, patch)
+            return super().patch_status_bytes(kind, namespace, name, patch)
+
+    return TimedStore()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http_status(url: str, timeout: float = 2.0) -> int:
+    try:
+        return urllib.request.urlopen(url, timeout=timeout).status
+    except urllib.error.HTTPError as e:
+        return e.code
+    except Exception:
+        return 0
+
+
+def _scrape(url: str) -> dict:
+    """Flat name{labels} -> float of a /metrics exposition."""
+    out: dict = {}
+    try:
+        text = urllib.request.urlopen(url, timeout=3).read().decode()
+    except Exception:
+        return out
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            pass
+    return out
+
+
+class Engine:
+    """One real tpukwok process."""
+
+    def __init__(self, master: str, cfg_path: str, ckpt_dir: str):
+        self.port = _free_port()
+        env = {**os.environ,
+               "KWOK_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        # engine output lands in the checkpoint dir: post-mortem evidence
+        # for a failed gate without flooding the bench's own output
+        log_path = os.path.join(ckpt_dir, f"engine-{self.port}.log")
+        self._log = open(log_path, "ab")
+        self.log_path = log_path
+        self.t_spawn = time.time()
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "kwok_tpu.kwok",
+             "--config", cfg_path,
+             "--master", master,
+             "--manage-all-nodes", "true",
+             "--tick-interval", str(QUANTUM),
+             "--drain-shards", "2",
+             "--server-address", f"127.0.0.1:{self.port}",
+             "--checkpoint-dir", ckpt_dir,
+             "--checkpoint-interval", str(CKPT_INTERVAL),
+             "--drain-deadline", "30"],
+            env=env, cwd=REPO,
+            stdout=self._log, stderr=subprocess.STDOUT,
+        )
+
+    def wait_ready(self, timeout: float = 120.0) -> float:
+        """Blocks until /readyz answers 200 (the startup catch-up gate —
+        first full re-list + checkpoint reconcile — has closed); returns
+        seconds since spawn."""
+        deadline = time.time() + timeout
+        url = f"http://127.0.0.1:{self.port}/readyz"
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"engine died during startup (rc={self.proc.returncode})"
+                )
+            if _http_status(url) == 200:
+                return time.time() - self.t_spawn
+            time.sleep(0.05)
+        raise RuntimeError("engine never became ready")
+
+    def metrics(self) -> dict:
+        return _scrape(f"http://127.0.0.1:{self.port}/metrics")
+
+    def sigkill(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def sigterm(self, timeout: float = 40.0) -> int:
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            return self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            return -9
+
+
+def _wait(pred, timeout: float, every: float = 0.1) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _pod_phases(store, names) -> dict:
+    return {
+        n: (store.get("pods", "default", n) or {})
+        .get("status", {}).get("phase")
+        for n in names
+    }
+
+
+def _create_workload(store, names, nodes) -> None:
+    for n in nodes:
+        store.create("nodes", _make_node(n))
+    half = len(names) // 2
+    for n in names[:half]:
+        store.create("pods", _make_pod(n, nodes[hash(n) % len(nodes)]))
+    time.sleep(STAGGER_S)
+    for n in names[half:]:
+        store.create("pods", _make_pod(n, nodes[hash(n) % len(nodes)]))
+
+
+def _run_control(pods: int, cfg_path: str, timeout: float) -> dict:
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+
+    store = _timed_store()
+    srv = HttpFakeApiserver(store=store).start()
+    names = [f"rp{i}" for i in range(pods)]
+    ckpt = tempfile.mkdtemp(prefix="kwok-restart-ctl-")
+    eng = Engine(f"http://127.0.0.1:{srv.port}", cfg_path, ckpt)
+    out = {"arm": "control"}
+    try:
+        out["ready_s"] = round(eng.wait_ready(), 3)
+        _create_workload(store, names, [f"rn{i}" for i in range(4)])
+        converged = _wait(
+            lambda: all(
+                ph == "Running" for ph in _pod_phases(store, names).values()
+            ),
+            timeout,
+        )
+        out["converged"] = converged
+        out["final_phases"] = _pod_phases(store, names)
+        out["running_patches_per_pod"] = _running_counts(store, names)
+        rc = eng.sigterm()
+        out["sigterm_exit"] = rc
+    finally:
+        if eng.proc.poll() is None:
+            eng.proc.kill()
+        srv.stop()
+    return out
+
+
+def _running_counts(store, names) -> dict:
+    counts = {n: 0 for n in names}
+    for (ns, name), phase, _t in list(store.oplog):
+        if phase == "Running" and name in counts:
+            counts[name] += 1
+    return counts
+
+
+def _run_crash(pods: int, cfg_path: str, timeout: float) -> dict:
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+    from kwok_tpu.resilience import checkpoint as ckpt_mod
+
+    store = _timed_store()
+    srv = HttpFakeApiserver(store=store).start()
+    master = f"http://127.0.0.1:{srv.port}"
+    names = [f"rp{i}" for i in range(pods)]
+    ckpt_dir = tempfile.mkdtemp(prefix="kwok-restart-")
+    ckpt_path = ckpt_mod.checkpoint_path(ckpt_dir, "engine")
+    out = {"arm": "crash"}
+    eng1 = Engine(master, cfg_path, ckpt_dir)
+    try:
+        out["ready1_s"] = round(eng1.wait_ready(), 3)
+        _create_workload(store, names, [f"rn{i}" for i in range(4)])
+
+        def ckpt_complete():
+            try:
+                with open(ckpt_path, "rb") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                return False
+            ents = doc.get("kinds", {}).get("pods", {})
+            return len(ents) == pods and all(
+                v[2] is not None for v in ents.values()
+            )
+
+        assert _wait(ckpt_complete, 30.0), \
+            "checkpoint never covered every armed pod"
+        # one more cadence so the residues we gate against are fresh,
+        # then kill without warning — no drain, no final checkpoint
+        time.sleep(CKPT_INTERVAL + 0.2)
+        with open(ckpt_path, "rb") as f:
+            doc = json.load(f)
+        residues = {
+            ks.split("/", 1)[1]: v[2]
+            for ks, v in doc["kinds"]["pods"].items()
+        }
+        out["ckpt_residues"] = residues
+        eng1.sigkill()
+        out["killed_at_wall"] = time.time()
+    except Exception:
+        if eng1.proc.poll() is None:
+            eng1.proc.kill()
+        srv.stop()
+        raise
+
+    eng2 = Engine(master, cfg_path, ckpt_dir)
+    try:
+        out["recovery_readyz_s"] = round(eng2.wait_ready(), 3)
+        converged = _wait(
+            lambda: all(
+                ph == "Running" for ph in _pod_phases(store, names).values()
+            ),
+            timeout,
+        )
+        out["converged"] = converged
+        out["recovery_to_caught_up_s"] = round(
+            (max((t for _k, _p, t in store.oplog), default=eng2.t_spawn)
+             - eng2.t_spawn),
+            3,
+        )
+        m = eng2.metrics()
+        out["kwok_restart_recovery_seconds"] = m.get(
+            "kwok_restart_recovery_seconds"
+        )
+        out["kwok_rv_rewinds_total"] = m.get("kwok_rv_rewinds_total", 0)
+        out["final_phases"] = _pod_phases(store, names)
+        out["running_patches_per_pod"] = _running_counts(store, names)
+        # residue-resume oracle: wall fire time minus checkpointed
+        # residue must be a constant (the restart anchor) per pod,
+        # within one tick quantum
+        fires = {}
+        for (ns, name), phase, t in list(store.oplog):
+            if phase == "Running" and name not in fires:
+                fires[name] = t
+        devs = {
+            n: fires[n] - residues[n]
+            for n in names if n in fires and residues.get(n) is not None
+        }
+        anchor = statistics.median(devs.values()) if devs else 0.0
+        out["resume_anchor_wall"] = anchor
+        out["resume_deviation_s"] = {
+            n: round(d - anchor, 4) for n, d in devs.items()
+        }
+        out["resume_max_abs_dev_s"] = round(
+            max((abs(d - anchor) for d in devs.values()), default=999.0), 4
+        )
+        out["resume_pods_measured"] = len(devs)
+        ckpt_mtime = os.path.getmtime(ckpt_path)
+        rc = eng2.sigterm()
+        out["sigterm_exit"] = rc
+        out["final_checkpoint_refreshed"] = (
+            os.path.getmtime(ckpt_path) >= ckpt_mtime
+        )
+    finally:
+        if eng2.proc.poll() is None:
+            eng2.proc.kill()
+        srv.stop()
+    return out
+
+
+def gates(control: dict, crash: dict, pods: int) -> dict:
+    return {
+        "control_converged": bool(control["converged"]),
+        "crash_converged": bool(crash["converged"]),
+        # the headline: SIGKILL + cold restart ends byte-identical to the
+        # uninterrupted arm
+        "phases_identical": (
+            json.dumps(control["final_phases"], sort_keys=True)
+            == json.dumps(crash["final_phases"], sort_keys=True)
+        ),
+        # zero double-fired transitions across both lifetimes
+        "no_double_fire": all(
+            c == 1 for c in crash["running_patches_per_pod"].values()
+        ) and len(crash["running_patches_per_pod"]) == pods,
+        # every delay resumed within one tick quantum of its
+        # checkpointed residue (common restart anchor factored out)
+        "delays_resumed_within_quantum": (
+            crash["resume_pods_measured"] == pods
+            and crash["resume_max_abs_dev_s"] <= QUANTUM
+        ),
+        "rto_recorded": (
+            crash.get("kwok_restart_recovery_seconds") is not None
+            and crash["recovery_readyz_s"] > 0
+        ),
+        "graceful_exit_zero": (
+            control["sigterm_exit"] == 0 and crash["sigterm_exit"] == 0
+        ),
+        "final_checkpoint_refreshed": bool(
+            crash.get("final_checkpoint_refreshed")
+        ),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--pods", type=int, default=24)
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="per-arm convergence deadline (s)")
+    p.add_argument("--out", default=os.path.join(REPO, "RESTART_r01.json"))
+    p.add_argument("--check", action="store_true",
+                   help="CI gate: smaller workload, exit 1 on any "
+                   "failed gate")
+    args = p.parse_args()
+    if args.check:
+        args.pods = min(args.pods, 16)
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".yaml", prefix="kwok-restart-stages-", delete=False
+    ) as f:
+        f.write(STAGES_YAML)
+        cfg_path = f.name
+    try:
+        control = _run_control(args.pods, cfg_path, args.timeout)
+        crash = _run_crash(args.pods, cfg_path, args.timeout)
+    finally:
+        os.unlink(cfg_path)
+    g = gates(control, crash, args.pods)
+    ok = all(g.values())
+
+    artifact = {
+        "bench": "restart_soak",
+        "params": {"pods": args.pods, "tick_quantum_s": QUANTUM,
+                   "delay_s": DELAY_S, "stagger_s": STAGGER_S,
+                   "checkpoint_interval_s": CKPT_INTERVAL,
+                   "check": args.check},
+        "gates": g,
+        "ok": ok,
+        "control": {k: control.get(k) for k in
+                    ("ready_s", "converged", "sigterm_exit")},
+        "crash": {k: crash.get(k) for k in (
+            "ready1_s", "recovery_readyz_s", "recovery_to_caught_up_s",
+            "kwok_restart_recovery_seconds", "kwok_rv_rewinds_total",
+            "resume_max_abs_dev_s", "resume_pods_measured",
+            "sigterm_exit", "final_checkpoint_refreshed", "converged",
+        )},
+    }
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"ok": ok, "gates": g, "out": args.out}))
+    if not ok:
+        failed = [k for k, v in g.items() if not v]
+        print(f"restart_soak: FAILED gates: {failed}", file=sys.stderr)
+        if not g["phases_identical"]:
+            diff = {
+                n: (control["final_phases"].get(n),
+                    crash["final_phases"].get(n))
+                for n in control["final_phases"]
+                if control["final_phases"].get(n)
+                != crash["final_phases"].get(n)
+            }
+            print(f"restart_soak: phase diffs: {diff}", file=sys.stderr)
+        if not g["delays_resumed_within_quantum"]:
+            print(
+                "restart_soak: resume deviations: "
+                f"{crash.get('resume_deviation_s')}", file=sys.stderr,
+            )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,6 +17,9 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 echo ">> chaos-check (resilience suite + fault-storm convergence gate)"
 make chaos-check
 
+echo ">> restart-check (SIGKILL + cold-restart crash-durability RTO gate)"
+make restart-check
+
 echo ">> bash syntax"
 find hack test images -name '*.sh' -print0 | xargs -0 -n1 bash -n
 

@@ -50,6 +50,11 @@ LOCK_ORDER: dict[str, int] = {
     "_fault_lock": 84,  # FaultPlane: injected-fault tally + killer state
     "_deg_lock": 84,    # Degradation: the active-reasons set
     "_wd_lock": 84,     # Watchdog: restart stamps + restart log
+    # checkpoint/startup-gate bookkeeping (ISSUE 7): marks RESYNC
+    # completion per kind/lane — taken by drain workers (under their
+    # lane's stage_lock, a legal 10 -> 84 descent) and the tick thread;
+    # nothing is ever acquired under it
+    "_ckpt_lock": 84,
     "_lock": 85,        # single-resource leaves (ippool, registry, ...)
     "_apiserver_lock": 85,
     "_audit_lock": 95,  # mockserver audit ring, below the store lock

@@ -56,6 +56,16 @@ class KwokConfigurationOptions:
     shedQueueDepth: int = 0
     workerRestartBudget: int = 5
     workerRestartWindow: float = 30.0
+    # Crash-durable restarts (resilience/checkpoint.py): directory for
+    # the periodic atomic-rename checkpoint of device-resident timer
+    # state ("" = disabled — no thread, no gathers; KWOK_TPU_CHECKPOINT_DIR
+    # is the engine-level fallback), its cadence in seconds, and the
+    # SIGTERM graceful-drain bound (flush in-flight emits + write a final
+    # checkpoint within this many seconds, else force-exit nonzero; a
+    # second SIGTERM force-exits immediately).
+    checkpointDir: str = ""
+    checkpointInterval: float = 2.0
+    drainDeadline: float = 30.0
 
 
 @dataclasses.dataclass

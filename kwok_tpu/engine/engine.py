@@ -178,6 +178,18 @@ class EngineConfig:
     # supervision and marks the engine degraded (/readyz 503).
     worker_restart_budget: int = 5
     worker_restart_window: float = 30.0
+    # Crash-durable restarts (resilience/checkpoint.py): when set, the
+    # irreplaceable per-row scalars — (uid, rv, remaining-delay residue,
+    # heartbeat-wheel phase, transition generation) — are checkpointed
+    # to <dir>/<name>.ckpt.json every checkpoint_interval seconds
+    # (atomic rename), and a cold start re-lists then refines matching
+    # rows' timers from the file instead of resetting every in-flight
+    # delay. "" = disabled (falls back to KWOK_TPU_CHECKPOINT_DIR); the
+    # literal "off" disables even under the env var (lane children).
+    # Disabled means disabled: no writer thread, no device gathers, no
+    # per-tick cost beyond one attribute test.
+    checkpoint_dir: str = ""
+    checkpoint_interval: float = 2.0
 
     def validate(self) -> None:
         if not (
@@ -456,6 +468,10 @@ class ClusterEngine:
             os.environ.get("KWOK_TPU_NATIVE_ROUTE", "1") != "0"
         )
         self._watch_rv: dict[str, int] = {}
+        # monotonic stamp of the last rewind-triggered resync: bounds the
+        # full-LIST rate if a pathological store keeps rewinding
+        # (_note_rv_rewind)
+        self._rv_rewind_at = 0.0
         # per-kind watch-stream generation, bumped whenever a stream is
         # known compacted (410): RAW lines still queued from the dead
         # stream belong to the old generation and must not repopulate
@@ -501,10 +517,43 @@ class ClusterEngine:
         self._trace_every = max(0, int(config.trace_sample_every))
         self._trace_n = 0
         # Degraded-mode ledger (kwok_degraded{reason=}; /readyz answers
-        # 503 while any reason is active) + the lane-worker watchdog
-        # (built in start(): only threaded engines supervise workers).
+        # 503 while any reason is active) + the worker watchdog (built in
+        # start() unless a FederatedEngine installed a shared one first).
         self._degradation = Degradation(self.telemetry.registry)
         self._watchdog: Watchdog | None = None
+        # Crash-durable restarts (resilience/checkpoint.py). The dir
+        # resolves config < KWOK_TPU_CHECKPOINT_DIR (same precedence as
+        # the fault plane); "off" disables even under the env var. The
+        # Checkpointer/RestoreSession are built in start(); a
+        # FederatedEngine names members via _ckpt_name/_worker_suffix
+        # before starting them.
+        self._ckpt_dir = (
+            config.checkpoint_dir
+            or os.environ.get("KWOK_TPU_CHECKPOINT_DIR", "")
+        ).strip()
+        if self._ckpt_dir == "off":
+            self._ckpt_dir = ""
+        self._ckpt = None  # resilience.checkpoint.Checkpointer | None
+        self._restore = None  # resilience.checkpoint.RestoreSession | None
+        self._ckpt_name = "engine"
+        self._worker_suffix = ""
+        # guards the startup catch-up bookkeeping below (drain workers of
+        # several lanes mark their RESYNCs concurrently); level 84 in the
+        # kwoklint lock table — a leaf like the other resilience locks
+        self._ckpt_lock = threading.Lock()
+        # /readyz startup gate: kinds whose first full re-list has not
+        # completed yet (None = gate not armed / already finished)
+        self._startup_pending: "set[str] | None" = None
+        self._startup_lanes: dict[str, set] = {}
+        self._startup_flush_wait = False
+        self._startup_t0 = 0.0
+        # iterations left during which the tick loop is forced awake
+        # after a timer refine: in-flight wires dispatched BEFORE the
+        # refine still carry fresh-arm deadlines, and each of their
+        # consumes overwrites the idle wake — the loop must keep
+        # dispatching until a post-refine wire's consume recomputes the
+        # wake from the refined state (device-owning thread only)
+        self._ckpt_force_ticks = 0
         # Hash-partitioned host lanes (engine/lanes.py): built when
         # drain_shards resolves to >1. Lane children are constructed with
         # drain_shards=1, so this cannot recurse.
@@ -540,6 +589,13 @@ class ClusterEngine:
         load it will drop)."""
         return self._degradation.active
 
+    @property
+    def startup_resync_pending(self) -> bool:
+        """True while the startup catch-up gate is open: the first full
+        re-list (+ checkpoint reconcile, when one is armed) has not
+        completed, so /readyz answers 503 with reason startup_resync."""
+        return self._running and self._startup_pending is not None
+
     def _worker_budget_exhausted(self, name: str) -> None:
         """Watchdog callback: a supervised worker crashed past its
         restart budget — the lane topology is now partial."""
@@ -565,6 +621,15 @@ class ClusterEngine:
             # wire slice survives in the lane's crash-replay slot
             # (ShardLane.emit_loop) and is replayed on this same restart —
             # a full-cluster re-list per emit crash would be pure cost
+            return
+        if name.startswith("kwok-watch"):
+            # a restarted watch loop re-lists by CONSTRUCTION (the fresh
+            # loop starts with no resume revision), which re-delivers
+            # whatever the pill ate — no explicit resync needed, and
+            # cutting the OTHER kind's healthy stream would be pure
+            # cost. Re-arm the checkpoint refine instead, so any rows
+            # the re-list re-initializes resume their timers.
+            self._rearm_restore()
             return
         self.resync_streams()
         # one loss class no re-list can reproduce: a cross-lane XUPD
@@ -612,6 +677,255 @@ class ClusterEngine:
                 # owns recovery either way
                 swallowed("resync_stream_stop")
 
+    # ------------------------------------- crash-durable restarts (ckpt)
+
+    def _rearm_restore(self) -> None:
+        """Reload the on-disk checkpoint and arm a refill RestoreSession
+        (no readiness gate, TTL-bounded): rows a re-list re-initializes
+        after a worker/member restart resume their checkpointed timers.
+        Safe from any thread — the session reference swap is atomic, and
+        only the device-owning loop ever consumes a session."""
+        if self._ckpt is None:
+            return
+        from kwok_tpu.resilience import checkpoint as ckpt_mod
+
+        data = ckpt_mod.load(self._ckpt_dir, self._ckpt_name)
+        if data is None:
+            return
+        session = ckpt_mod.RestoreSession(
+            data["kinds"], gate_ready=False, ttl=30.0
+        )
+        with self._ckpt_lock:
+            # the swap pairs with _close_restore's identity check: the
+            # device loop closing an OLD session can never clobber a
+            # refill armed concurrently from a restarted worker's thread
+            self._restore = session
+        logger.info(
+            "checkpoint refill armed (%s): %d candidate rows",
+            self._ckpt_name, session.remaining,
+        )
+
+    def _close_restore(self, r) -> None:
+        """Drop a finished/expired restore session — but only if it is
+        still THE session: _rearm_restore may have swapped a fresh one in
+        from another thread between our read and this close."""
+        with self._ckpt_lock:
+            if self._restore is r:
+                self._restore = None
+
+    def _tracked_rv(self, kind: str, obj: dict) -> int:
+        """The revision this engine last ingested for ``obj``'s key, or 0
+        when the row is unknown. Row meta is read lock-free: dict get and
+        list index are GIL-atomic, and meta rv only ever moves FORWARD
+        (events are server-delivered), so a stale read can only make the
+        rewind check more conservative, never a false positive."""
+        meta = obj.get("metadata") or {}
+        name = meta.get("name")
+        if not name:
+            return 0
+        if kind == "pods":
+            key = (meta.get("namespace") or "default", name)
+        else:
+            key = name
+        lanes = self._lanes
+        if lanes is not None:
+            from kwok_tpu.engine.rowpool import shard_of
+
+            e = lanes.lanes[shard_of(key, lanes.n)].engine
+        else:
+            e = self
+        k = e.pods if kind == "pods" else e.nodes
+        idx = k.pool.lookup(key)
+        if idx is None:
+            return 0
+        m = k.pool.meta[idx] or {}
+        try:
+            return int(m.get("rv") or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def _note_rv_rewind(self, kind: str, name, listed: int,
+                        tracked: int) -> None:
+        """A re-listed object carries a revision BELOW the one this
+        engine already ingested for it — an object's own rv can never
+        legitimately decrease, so this is the store-restore /
+        blackout-recovery signature (POST /restore keeps the STORE
+        counter monotonic but hands back objects carrying their
+        snapshot-time revisions; judging per object instead of against a
+        stream high-water mark means deletions and bookmarks can never
+        fake it). Treat it as a compaction-plus-rewind: drive the
+        existing resync_streams() path so no kind keeps resuming — or
+        echo-dropping — against revisions from the old world."""
+        now = time.monotonic()
+        if now - self._rv_rewind_at < 5.0:
+            return  # a rewinding-in-a-loop store must not LIST-storm us
+        self._rv_rewind_at = now
+        self._inc("rv_rewinds_total")
+        logger.warning(
+            "rv rewind detected on %s re-list (%s listed at rv %d < "
+            "ingested rv %d): store restore/blackout recovery; "
+            "resyncing all streams", kind, name, listed, tracked,
+        )
+        self.resync_streams()
+
+    def _mark_resync(self, kind: str, lane: int = 0) -> None:
+        """One full re-list snapshot for ``kind`` has been INGESTED (the
+        RESYNC marker drained). Under sharded lanes the marker broadcasts
+        to every lane, so the kind only counts once all lanes processed
+        theirs — rows listed before the marker are then staged
+        everywhere."""
+        if self._startup_pending is None:
+            return
+        with self._ckpt_lock:
+            sp = self._startup_pending
+            if sp is None or kind not in sp:
+                return
+            done = self._startup_lanes.setdefault(kind, set())
+            done.add(lane)
+            need = self._n_lanes if self._lanes is not None else 1
+            if len(done) >= need:
+                sp.discard(kind)
+
+    def _ckpt_gate(self, dispatched: bool, staged: bool) -> None:
+        """Finish the startup catch-up gate once every kind's first
+        re-list has been ingested AND its staged rows have reached the
+        device through one arming dispatch (refine runs after that
+        dispatch, so matched rows' timers are already restored when
+        ready flips)."""
+        sp = self._startup_pending
+        if sp is None:
+            return
+        with self._ckpt_lock:
+            empty = not sp
+        if not empty:
+            return
+        if not self._startup_flush_wait:
+            self._startup_flush_wait = True
+            if staged:
+                return  # listed rows not flushed yet: one more dispatch
+        elif not (dispatched or not staged):
+            return
+        self._finish_startup()
+
+    def _finish_startup(self) -> None:
+        self._startup_pending = None
+        self._startup_lanes = {}
+        dt = time.monotonic() - self._startup_t0
+        self.telemetry.set_gauge("restart_recovery_seconds", dt)
+        r = self._restore
+        if r is not None and r.gate_ready:
+            if r.remaining:
+                # rows re-listed but not ARMED yet (a pod's managed bit
+                # can arrive via a later XUPD fan-out): readiness flips
+                # now — the re-list is ingested — but the session keeps
+                # refining for a bounded tail instead of dropping
+                # residues the next dispatch would have matched
+                r.gate_ready = False
+                r.deadline = time.monotonic() + 10.0
+                logger.info(
+                    "checkpoint reconcile: %d rows refined, %d stale, "
+                    "%d awaiting arming (tail refine continues)",
+                    r.matched, r.stale, r.remaining,
+                )
+            else:
+                s = r.finish()
+                self._close_restore(r)
+                logger.info(
+                    "checkpoint reconcile done in %.3fs: %d rows "
+                    "refined, %d stale dropped",
+                    dt, s["refined"], s["stale"],
+                )
+        else:
+            logger.info("startup re-list caught up in %.3fs", dt)
+        self.ready = True
+
+    def _ckpt_service(self, dispatched: bool) -> None:
+        """Single-lane checkpoint/restore service — one call per tick
+        iteration on the tick thread (the only mutator of pools, buffers,
+        and device state here). Sharded engines run LaneSet._ckpt_service
+        instead; federation members are serviced by the federated loop."""
+        now = self._now()
+        r = self._restore
+        if r is not None:
+            if r.expired() or (not r.gate_ready and not r.remaining):
+                s = r.finish()
+                self._close_restore(r)
+                logger.info(
+                    "checkpoint refine closed: %d refined, %d stale",
+                    s["refined"], s["stale"],
+                )
+            else:
+                self._ckpt_refine(now)
+            # Keep the loop TICKING while a restore session is live AND
+            # until the pipeline has flushed every pre-refine wire: the
+            # idle wake is recomputed from each consumed wire's dues, and
+            # wires dispatched before a refine carry the FRESH-arm
+            # deadlines — one of their consumes overwriting the wake put
+            # the whole engine to sleep past every resumed delay
+            # (restart_soak caught it: ticks_total froze at 1 and both
+            # waves fired together at the stale wake). Only a
+            # POST-refine wire's consume yields the correct deadline.
+            self._ckpt_force_ticks = (
+                max(1, int(self.config.pipeline_depth)) + 2
+            )
+        if self._ckpt_force_ticks > 0:
+            self._ckpt_force_ticks -= 1
+            self._idle_wake = time.monotonic()
+        self._ckpt_gate(
+            dispatched,
+            staged=bool(
+                self.nodes.buffer.pending or self.pods.buffer.pending
+            ),
+        )
+        ck = self._ckpt
+        if ck is not None and ck.due():
+            ck.submit(self._ckpt_snapshot(now))
+
+    def _ckpt_refine(self, now: float) -> None:
+        """Scatter checkpointed timer residues into matching rows. Runs
+        AFTER the arming dispatch (the kernel re-armed restored rows with
+        fresh delays; this overwrites them with ``now + residue``), and
+        skips rows whose init is still staged — their device slots are
+        not current until the next flush."""
+        from kwok_tpu.ops.updates import refine_flush
+
+        r = self._restore
+        for k, kind in ((self.nodes, "nodes"), (self.pods, "pods")):
+            if not r.kinds.get(kind):
+                continue
+            staged = (
+                k.buffer.staged_rows() if k.buffer.pending else frozenset()
+            )
+            # current deadlines: an entry with a delay residue is only
+            # consumed once the kernel ARMED its row (finite fire_at) —
+            # refining earlier is undone by the arming re-arm itself
+            cur_fire = np.asarray(k.state.fire_at)
+            idx, fire, hb, gen = r.match_kind(
+                kind, k.pool, staged, now,
+                phase_h=k.phase_h, fire=cur_fire,
+            )
+            if idx.size:
+                k.state = refine_flush(k.state, idx, fire, hb, gen)
+
+    def _ckpt_snapshot(self, now: float) -> dict:
+        """Gather the checkpoint rows (single-lane topology): ONE host
+        copy of the timer fields per kind plus a pool/meta walk. Runs on
+        the tick thread between dispatches, where the state arrays are
+        live outputs."""
+        from kwok_tpu.ops.tick import gather_deadlines
+        from kwok_tpu.resilience import checkpoint as ckpt_mod
+
+        kinds = {}
+        for k, kind in ((self.nodes, "nodes"), (self.pods, "pods")):
+            fire, hb, gen = gather_deadlines(k.state)
+            staged = (
+                k.buffer.staged_rows() if k.buffer.pending else frozenset()
+            )
+            kinds[kind] = ckpt_mod.gather_rows(
+                kind, k.pool, k.phase_h, fire, hb, gen, staged, now
+            )
+        return {"kinds": kinds}
+
     # ------------------------------------------------------------------ time
 
     def _now(self) -> float:
@@ -651,13 +965,43 @@ class ClusterEngine:
         queues + emit paths from one shared tick loop."""
         self._running = True
         self._owns_tick = run_tick_loop
-        # supervision + chaos arm before any worker exists
-        self._watchdog = Watchdog(
-            budget=self.config.worker_restart_budget,
-            window=self.config.worker_restart_window,
-            on_exhausted=self._worker_budget_exhausted,
-            on_restart=self._worker_restarted_resync,
-        )
+        # supervision + chaos arm before any worker exists (a
+        # FederatedEngine installs ONE shared watchdog across members —
+        # with member-failover callbacks — before calling start())
+        if self._watchdog is None:
+            self._watchdog = Watchdog(
+                budget=self.config.worker_restart_budget,
+                window=self.config.worker_restart_window,
+                on_exhausted=self._worker_budget_exhausted,
+                on_restart=self._worker_restarted_resync,
+            )
+        # Startup catch-up gate: /readyz answers 503 (reason
+        # startup_resync) until the first full re-list of BOTH kinds has
+        # been ingested — a restarted engine must not report ready while
+        # its rows are still empty. Armed before the watch threads spawn;
+        # the device-owning loop (tick thread / lane coordinator /
+        # federated loop) finishes it.
+        self._startup_pending = {"nodes", "pods"}
+        self._startup_lanes = {}
+        self._startup_flush_wait = False
+        self._startup_t0 = time.monotonic()
+        if self._ckpt_dir:
+            from kwok_tpu.resilience import checkpoint as ckpt_mod
+
+            self._ckpt = ckpt_mod.Checkpointer(
+                self._ckpt_dir, self._ckpt_name,
+                self.config.checkpoint_interval, telemetry=self.telemetry,
+            )
+            data = ckpt_mod.load(self._ckpt_dir, self._ckpt_name)
+            if data is not None:
+                self._restore = ckpt_mod.RestoreSession(
+                    data["kinds"], gate_ready=True
+                )
+                logger.info(
+                    "checkpoint %s: %d rows to reconcile after re-list",
+                    self._ckpt.path, self._restore.remaining,
+                )
+            self._ckpt.start()
         if self._faults is not None:
             self._faults.start()
         # start the sampling profiler from the CALLER's thread (usually
@@ -701,7 +1045,11 @@ class ClusterEngine:
                 else self._tick_loop
             )
             self._threads.append(spawn_worker(loop, name="kwok-tick"))
-        self.ready = True
+        # ready flips on the device-owning loop once the startup catch-up
+        # gate (first full re-list + checkpoint reconcile) completes —
+        # NOT here: a restarted engine reporting ready with empty rows is
+        # exactly the hole the gate closes. Members (run_tick_loop=False)
+        # are finished by the FederatedEngine's loop the same way.
 
     def _warm_scatters(self) -> None:
         """Pre-compile both ingest-scatter widths with all-pad no-op
@@ -803,6 +1151,10 @@ class ClusterEngine:
             ))
         if self._executor:
             self._executor.shutdown(wait=True)
+        if self._ckpt is not None:
+            # the tick loop queued the final snapshot in its finally (it
+            # was joined above); this drains the writer and joins it
+            self._ckpt.stop()
         # the promised total: every lane shares this telemetry, so under
         # sharding this is the whole engine's tally, not one lane's
         dropped = self.telemetry.dropped_jobs_total
@@ -974,9 +1326,29 @@ class ClusterEngine:
                         # down
                         self._inc("watch_relists_total")
                         objs = self.client.list(kind, **opts)
+                        rewind = None
                         for obj in objs:
                             self._q.put((kind, ADDED, obj, time.monotonic()))
+                            rv = int(
+                                (obj.get("metadata") or {}).get(
+                                    "resourceVersion"
+                                )
+                                or 0
+                            )
+                            if rv and rewind is None:
+                                tracked = self._tracked_rv(kind, obj)
+                                if tracked and rv < tracked:
+                                    rewind = (
+                                        (obj.get("metadata") or {})
+                                        .get("name"), rv, tracked,
+                                    )
                         self._q.put((kind, "RESYNC", objs, time.monotonic()))
+                        if rewind is not None:
+                            # store-restore detection: an object re-listed
+                            # BELOW its last-ingested revision resyncs
+                            # every stream (per-object, so deletions and
+                            # bookmarks can never fake it)
+                            self._note_rv_rewind(kind, *rewind)
                     expired = False
                     reader = None
                     if parser is not None:
@@ -1090,8 +1462,18 @@ class ClusterEngine:
                     )
                     backoff.sleep(delay, lambda: not self._running)
 
+        # Supervised (ISSUE 7): a chaos pill async-raised into a watch
+        # thread used to end ingest for that kind for good behind a 200
+        # readyz. Under supervision the loop restarts in place — and a
+        # fresh loop re-lists by construction, so the restart IS the
+        # recovery. The suffix disambiguates federation members
+        # (kwok-watch-pods-m1) for the watchdog's budget accounting and
+        # kwok_fed_member_restarts_total.
+        name = f"kwok-watch-{kind}{self._worker_suffix}"
+        wd = self._watchdog
         self._threads.append(
-            spawn_worker(loop, name=f"kwok-watch-{kind}")
+            wd.spawn(loop, name=name) if wd is not None
+            else spawn_worker(loop, name=name)
         )
 
     # ---------------------------------------------------------------- ingest
@@ -1435,6 +1817,11 @@ class ClusterEngine:
                             m["host_ip"] = rec.host_ip
                             m["status_scalar"] = bool(rec.flags & 16)
                             m["raw"] = rec.raw
+                            if rec.rv:
+                                # the checkpoint identity must track our
+                                # own echo's revision, or every restore
+                                # would see a stale (uid, rv) and re-arm
+                                m["rv"] = rec.rv
                             m.pop("obj", None)
                             return
             else:
@@ -1450,6 +1837,8 @@ class ClusterEngine:
                             # fresh raw line for later slow-path renders
                             m["fp_nsc_done"] = rec.fp_status_nc
                             m["raw"] = rec.raw
+                            if rec.rv:
+                                m["rv"] = rec.rv  # see the pod echo path
                             m.pop("obj", None)
                             return
         # record-only row init: upsert without any json.loads when the
@@ -1554,6 +1943,7 @@ class ClusterEngine:
         fp_status = fp_a[0][sub].tolist()
         fp_spec = fp_a[2][sub].tolist()
         fp_meta = fp_a[3][sub].tolist()
+        rvs_l = batch.rvs_a[sub].tolist()
         # string-field boundaries: 11 spans per record (native _REC_STRINGS
         # order: type, ns, name, node, phase, podIP, hostIP, creation,
         # ctrs, ictrs, trueConditions), gathered as 12 boundary columns
@@ -1745,6 +2135,7 @@ class ClusterEngine:
                 "phase_str": phase_s,
                 "host_ip": host_ip,
                 "status_scalar": bool(f & 16),
+                "rv": rvs_l[j],  # checkpoint identity; uid lazily from raw
                 # fingerprint seeding: the echo of this object's next
                 # server state drops without a parse
                 "fp_meta_sel": fp_meta[j],
@@ -1775,6 +2166,9 @@ class ClusterEngine:
             stale = [key for key in k.pool.keys() if key not in seen]
             for ns, name in stale:
                 self._pod_deleted({"metadata": {"namespace": ns, "name": name}})
+        # startup catch-up gate: this kind's first full re-list is now
+        # ingested on this lane (lane engines forward their index)
+        self._mark_resync(kind)
 
     def _node_upsert(self, node: dict) -> None:
         meta = node.get("metadata") or {}
@@ -1795,6 +2189,7 @@ class ClusterEngine:
             if need_lock:
                 bits |= 1 << self.node_bits[SEL_MANAGED]
         new_row = idx is None
+        meta_rv = int(meta.get("resourceVersion") or 0)
         if new_row:
             if k.pool.full:
                 self._grow(k)
@@ -1826,6 +2221,12 @@ class ClusterEngine:
         m = k.pool.meta[idx]
         m.update(name=name, obj=node)
         m.pop("raw", None)
+        # checkpoint identity (resilience/checkpoint.py): rv + uid of the
+        # last ingested revision — a restore refines timers only for rows
+        # whose (uid, rv) still match
+        if meta_rv:
+            m["rv"] = meta_rv
+        m["uid"] = meta.get("uid") or ""
         # same invalidation as _pod_upsert: dict-path content may differ
         # from what the stored fingerprints describe
         for fp_key in ("fp_meta_sel", "fp_nsc_done", "fp_expect"):
@@ -1908,6 +2309,9 @@ class ClusterEngine:
             phase_str=status.get("phase") or "",
             host_ip=status.get("hostIP") or "",
             status_scalar=set(status) <= _SCALAR_STATUS_KEYS,
+            # checkpoint identity: the restore's (uid, rv) match key
+            rv=int(meta.get("resourceVersion") or 0),
+            uid=meta.get("uid") or "",
         )
         m.pop("raw", None)  # the parsed object supersedes any raw line
         if self._trace_every:
@@ -2070,6 +2474,7 @@ class ClusterEngine:
                 "phase_str": rec.phase,
                 "host_ip": rec.host_ip,
                 "status_scalar": bool(flags & 16),
+                "rv": rec.rv,  # checkpoint identity; uid lazily from raw
             }
             k.pool.meta[idx] = m
         else:
@@ -2089,8 +2494,10 @@ class ClusterEngine:
                 phase_str=rec.phase,
                 host_ip=rec.host_ip,
                 status_scalar=bool(flags & 16),
+                rv=rec.rv,
             )
             m.pop("obj", None)  # the raw line supersedes any stale object
+            m.pop("uid", None)  # re-extracted from the fresh raw on demand
         if self._trace_every:
             self._trace_n += 1
             if self._trace_n % self._trace_every == 0:
@@ -2334,6 +2741,7 @@ class ClusterEngine:
                     tel.span(
                         "tick.drain", drain_t0, drain_t0 + drain_s, "drain"
                     )
+                did_dispatch = False
                 try:
                     # consume every tick whose wire has landed (free);
                     # a full pipeline blocks on the oldest, so `depth`
@@ -2357,6 +2765,7 @@ class ClusterEngine:
                         or self.pods.buffer.pending
                         or (wake is not None and time.monotonic() >= wake)
                     ):
+                        did_dispatch = True
                         p = self._tick_dispatch()
                         if p is not None:
                             pending.append(p)
@@ -2367,6 +2776,17 @@ class ClusterEngine:
                     # gate — without a wake the engine would idle-sleep
                     # on it until an unrelated event arrives
                     self._idle_wake = time.monotonic() + interval
+                if (
+                    self._startup_pending is not None
+                    or self._ckpt is not None
+                ):
+                    # crash-durable restarts: startup reconcile + the
+                    # cadenced checkpoint gather (one attribute test per
+                    # iteration when disabled — zero-cost contract)
+                    try:
+                        self._ckpt_service(did_dispatch)
+                    except Exception:
+                        logger.exception("checkpoint service failed")
         finally:
             # stopping: flush in-flight ticks so patches already computed
             # on device are not dropped (stop() joins us, then shuts the
@@ -2376,6 +2796,15 @@ class ClusterEngine:
                     self._tick_consume(pending.popleft())
                 except Exception:
                     logger.exception("final tick consume failed")
+            if self._ckpt is not None:
+                # SIGTERM graceful drain: the shutdown checkpoint is
+                # gathered HERE — after the in-flight ticks flushed, on
+                # the thread that owns device state — and queued behind
+                # any periodic write still in flight
+                try:
+                    self._ckpt.final(self._ckpt_snapshot(self._now()))
+                except Exception:
+                    logger.exception("final checkpoint failed")
 
     def _ingest_safe(self, kind, type_, obj) -> None:
         """One malformed event must not kill the tick thread."""

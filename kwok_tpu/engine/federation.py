@@ -203,6 +203,14 @@ class FederatedEngine:
             )
             for i, (client, cfg) in enumerate(zip(clients, cfgs))
         ]
+        # Member identity for crash-durable restarts + failover: each
+        # member checkpoints to its own <dir>/member<i>.ckpt.json, and
+        # its watch ("ingest pump") threads carry a -m<i> suffix so the
+        # shared watchdog's budget accounting and the member-restart
+        # counter can attribute a crash to its member.
+        for i, e in enumerate(self.engines):
+            e._ckpt_name = f"member{i}"
+            e._worker_suffix = f"-m{i}"
 
         # Group members by compiled rule set + heartbeat cadence: the rule
         # table is baked into the jitted kernel, so each distinct set needs
@@ -265,9 +273,23 @@ class FederatedEngine:
             "kwok_fed_pods_managed", "Pods tracked across all shards"
         )
 
+        # Member failover (ISSUE 7): ONE shared watchdog supervises every
+        # member's ingest-pump (watch) threads; a crashed worker restarts
+        # in place on its own thread, counted per member.
+        self._member_restarts = self.registry.counter(
+            "kwok_fed_member_restarts_total",
+            "Supervised federation-member ingest workers restarted in "
+            "place after a crash (the member re-lists and refines its "
+            "slice of the stacked state from its checkpoint)",
+            ("member",),
+        )
+        self._watchdog = None
+
         self.config = config
         self._running = False
-        self.ready = False  # /readyz gate; set once start() finishes warm-up
+        self.ready = False  # /readyz gate; flips once members catch up
+        # post-refine forced-tick budget (see _ckpt_service)
+        self._ckpt_force_ticks = 0
         self._thread: threading.Thread | None = None
         # monotonic wake-up for the idle tick loop (see ClusterEngine):
         # 0 = tick immediately, None = nothing scheduled on device
@@ -282,8 +304,22 @@ class FederatedEngine:
     # ------------------------------------------------------------- lifecycle
 
     def start(self) -> None:
+        from kwok_tpu.resilience.watchdog import Watchdog
+
         self._running = True
+        # ONE watchdog across members, installed BEFORE they start so
+        # ClusterEngine.start() adopts it instead of building its own:
+        # a member watch worker killed by a chaos pill restarts in place,
+        # re-lists (the fresh loop's construction), and re-fills its
+        # slice of the stacked group state from its checkpoint.
+        self._watchdog = Watchdog(
+            budget=self.config.worker_restart_budget,
+            window=self.config.worker_restart_window,
+            on_exhausted=self._member_budget_exhausted,
+            on_restart=self._member_worker_restarted,
+        )
         for e in self.engines:
+            e._watchdog = self._watchdog
             e.start(run_tick_loop=False)
         # pre-compile both ingest-scatter widths against the STACKED state
         # shapes (member engines skip their own warm-up under
@@ -294,7 +330,69 @@ class FederatedEngine:
         from kwok_tpu.workers import spawn_worker
 
         self._thread = spawn_worker(self._tick_loop, name="kwok-fed-tick")
-        self.ready = True
+        # ready flips on the federated loop once every member's startup
+        # catch-up gate (first full re-list + checkpoint reconcile)
+        # completes — the same contract as the solo engine.
+
+    @property
+    def degraded(self) -> bool:
+        """Any member degraded degrades the federation's /readyz (the
+        members share one process; a load balancer cannot route around
+        half of it)."""
+        return any(e.degraded for e in self.engines)
+
+    @property
+    def startup_resync_pending(self) -> bool:
+        return self._running and any(
+            e._startup_pending is not None for e in self.engines
+        )
+
+    def _member_of_worker(self, name: str) -> "int | None":
+        i = name.rfind("-m")
+        if i < 0:
+            return None
+        try:
+            idx = int(name[i + 2:])
+        except ValueError:
+            return None
+        return idx if 0 <= idx < len(self.engines) else None
+
+    def _member_budget_exhausted(self, name: str) -> None:
+        i = self._member_of_worker(name)
+        if i is None:
+            return
+        e = self.engines[i]
+        if e._degradation.set("worker_restart_budget"):
+            logger.error(
+                "federation member %d degraded: worker %s out of "
+                "restart budget", i, name,
+            )
+
+    def _member_worker_restarted(self, name: str) -> None:
+        """Watchdog callback, on the restarted worker's own thread: a
+        dead member ingest pump is back — account it and re-arm the
+        member's checkpoint refill so rows its re-list re-initializes
+        resume their timers (the federated loop applies the refine into
+        the member's slice of the stacked group state). The re-list
+        itself is the restarted loop's own construction."""
+        i = self._member_of_worker(name)
+        if i is None:
+            return
+        self._member_restarts.labels(member=str(i)).inc()
+        e = self.engines[i]
+        if not e._running:
+            return
+        logger.warning(
+            "federation member %d: ingest worker %s restarted; "
+            "re-listing and re-filling its slice", i, name,
+        )
+        # the restarted loop re-lists its own kind BY CONSTRUCTION (the
+        # fresh loop has no resume revision) — cutting the member's
+        # healthy other-kind stream too would be pure cost, exactly like
+        # the standalone kwok-watch branch in _worker_restarted_resync.
+        # The checkpoint refill re-arms so rows the re-list
+        # re-initializes resume their timers.
+        e._rearm_restore()
 
     def _warm_scatters(self) -> None:
         import numpy as np
@@ -348,6 +446,8 @@ class FederatedEngine:
     def stop(self) -> None:
         self._running = False
         self.ready = False
+        if self._watchdog is not None:
+            self._watchdog.close()  # shutdown crashes must not restart
         # join the shared tick first so it cannot submit patch jobs to
         # members whose executors are already shut down
         if self._thread is not None:
@@ -410,6 +510,7 @@ class FederatedEngine:
                             wake, time.monotonic() + self._IDLE_MAX
                         )
                 got_event = self._drain_ingest(deadline, pending)
+                did_dispatch = False
                 try:
                     while pending and (
                         len(pending) >= depth * max(1, len(self.groups))
@@ -430,10 +531,19 @@ class FederatedEngine:
                         or (wake is not None
                             and time.monotonic() >= wake)
                     ):
+                        did_dispatch = True
                         self._tick_dispatch_all(pending)
                 except Exception:
                     logger.exception("federated tick failed")
                     self._idle_wake = time.monotonic() + interval
+                # crash-durable restarts: per-member reconcile +
+                # checkpoint gathers against each member's slice of its
+                # group's stacked state; also flips federation readiness
+                # once every member caught up
+                try:
+                    self._ckpt_service(did_dispatch)
+                except Exception:
+                    logger.exception("federated checkpoint service failed")
         finally:
             # stopping: flush in-flight group wires so computed patches
             # are not dropped (stop() joins us before member teardown)
@@ -442,6 +552,17 @@ class FederatedEngine:
                     self._consume_one(pending)
                 except Exception:
                     logger.exception("final federated consume failed")
+            for g in self.groups:
+                for c, e in enumerate(g.engines):
+                    if e._ckpt is not None:
+                        try:
+                            e._ckpt.final(
+                                self._member_snapshot(g, c, e)
+                            )
+                        except Exception:
+                            logger.exception(
+                                "final member checkpoint failed"
+                            )
 
     def _drain_ingest(self, deadline: float, pending=None) -> bool:
         """Round-robin the members' ingest queues until the tick is due;
@@ -520,6 +641,103 @@ class FederatedEngine:
                 if drain_i:
                     tel.observe_stage("drain", drain_i)
         return got_event
+
+    # --------------------------------------- crash-durable restarts (ckpt)
+
+    def _ckpt_service(self, dispatched: bool) -> None:
+        """Per-member reconcile + checkpoint gathers, on the federated
+        loop (the only thread that touches member pools and the stacked
+        group states). Mirrors ClusterEngine._ckpt_service with each
+        member refining/gathering its own [c*r, (c+1)*r) slice."""
+        from kwok_tpu.ops.updates import refine_flush
+
+        now = time.time() - self._epoch
+        for g in self.groups:
+            for c, e in enumerate(g.engines):
+                r = e._restore
+                if r is not None:
+                    if r.expired() or (
+                        not r.gate_ready and not r.remaining
+                    ):
+                        s = r.finish()
+                        e._close_restore(r)
+                        logger.info(
+                            "member checkpoint refine closed: %d "
+                            "refined, %d stale", s["refined"], s["stale"],
+                        )
+                    else:
+                        for kind in ("nodes", "pods"):
+                            if not r.kinds.get(kind):
+                                continue
+                            k = e.nodes if kind == "nodes" else e.pods
+                            staged = (
+                                k.buffer.staged_rows()
+                                if k.buffer.pending else frozenset()
+                            )
+                            cur_fire = np.asarray(
+                                g.stacked[kind].fire_at
+                            )
+                            idx, fire, hb, gen = r.match_kind(
+                                kind, k.pool, staged, now,
+                                phase_h=k.phase_h, fire=cur_fire,
+                                offset=c * g.r,
+                            )
+                            if idx.size:
+                                g.stacked[kind] = refine_flush(
+                                    g.stacked[kind], idx, fire, hb, gen,
+                                    offset=c * g.r,
+                                )
+                    # tick until the pipeline flushes every pre-refine
+                    # wire — their consumes re-arm the stale fresh-arm
+                    # wake (see ClusterEngine._ckpt_service)
+                    self._ckpt_force_ticks = (
+                        max(1, int(getattr(
+                            self.config, "pipeline_depth", 8
+                        ))) + 2
+                    ) * max(1, len(self.groups))
+                e._ckpt_gate(
+                    dispatched,
+                    staged=bool(
+                        e.nodes.buffer.pending or e.pods.buffer.pending
+                    ),
+                )
+                ck = e._ckpt
+                if ck is not None and ck.due():
+                    ck.submit(self._member_snapshot(g, c, e))
+        if self._ckpt_force_ticks > 0:
+            self._ckpt_force_ticks -= 1
+            self._idle_wake = time.monotonic()
+            for g in self.groups:
+                if g.wake is not None:
+                    g.wake = min(g.wake, self._idle_wake)
+        if not self.ready and self._running and all(
+            e._startup_pending is None for e in self.engines
+        ):
+            self.ready = True
+
+    def _member_snapshot(self, g: _Group, c: int, e: ClusterEngine) -> dict:
+        """Gather one member's checkpoint rows from its slice of the
+        group's stacked state."""
+        from kwok_tpu.ops.tick import gather_deadlines
+        from kwok_tpu.resilience import checkpoint as ckpt_mod
+
+        now = time.time() - self._epoch
+        kinds: dict = {}
+        for kind in ("nodes", "pods"):
+            state = g.stacked.get(kind)
+            if state is None:
+                kinds[kind] = {}
+                continue
+            fire, hb, gen = gather_deadlines(state)
+            k = e.nodes if kind == "nodes" else e.pods
+            staged = (
+                k.buffer.staged_rows() if k.buffer.pending else frozenset()
+            )
+            kinds[kind] = ckpt_mod.gather_rows(
+                kind, k.pool, k.phase_h, fire, hb, gen, staged, now,
+                offset=c * g.r,
+            )
+        return {"kinds": kinds}
 
     # ------------------------------------------------------------------ tick
 

@@ -102,6 +102,7 @@ class _LaneEngine(ClusterEngine):
     lanes instead of applied against the (lane-local) pod pool."""
 
     _lane_set: "LaneSet | None" = None
+    _lane_index = 0
 
     def _update_pods_on_node(self, node_name: str) -> None:
         ls = self._lane_set
@@ -112,6 +113,16 @@ class _LaneEngine(ClusterEngine):
         # batch per owning lane through its own queue (FIFO per key keeps
         # the update ordered against the pod's own events)
         ls.route_pod_updates(node_name)
+
+    def _mark_resync(self, kind: str, lane: int = 0) -> None:
+        # the startup catch-up gate lives on the PARENT: RESYNC markers
+        # broadcast to every lane, and the kind only counts once all
+        # lanes processed theirs (ClusterEngine._mark_resync)
+        ls = self._lane_set
+        if ls is None:
+            super()._mark_resync(kind, lane)
+            return
+        ls.parent._mark_resync(kind, self._lane_index)
 
 
 class ShardLane:
@@ -130,9 +141,11 @@ class ShardLane:
             profile_dir="",
             trace_dump="",  # one dump, owned by the parent
             faults="off",  # ONE fault plane, the parent's (shared below)
+            checkpoint_dir="off",  # ONE checkpoint, the parent's stacked
         )
         e = _LaneEngine(parent.client, cfg, telemetry=parent.telemetry)
         e._lane_set = lane_set
+        e._lane_index = index
         # the parent's fault plane and degraded-mode ledger are THE
         # engine-wide instances: lane pumps draw from the same seeded
         # decision streams, and a lane marking "pump" down flips the
@@ -791,6 +804,7 @@ class LaneSet:
                 got_event = got_event or self.events_routed != seen_events
                 seen_events = self.events_routed
                 tel.set_gauge("tick_inflight", len(pending))
+                did_dispatch = False
                 try:
                     while pending and (
                         len(pending) >= depth
@@ -804,12 +818,25 @@ class LaneSet:
                         or (wake is not None
                             and time.monotonic() >= wake)
                     ):
+                        did_dispatch = True
                         p = self.dispatch()
                         if p is not None:
                             pending.append(p)
                 except Exception:
                     logger.exception("sharded tick failed")
                     parent._idle_wake = time.monotonic() + interval
+                if (
+                    parent._startup_pending is not None
+                    or parent._ckpt is not None
+                ):
+                    # crash-durable restarts: the coordinator owns the
+                    # stacked device state, so reconcile + checkpoint
+                    # gathers run here (zero-cost when disabled: one
+                    # attribute test per iteration)
+                    try:
+                        self._ckpt_service(did_dispatch)
+                    except Exception:
+                        logger.exception("checkpoint service failed")
         finally:
             # stopping: flush in-flight wires so computed patches are not
             # dropped, then release the emit workers
@@ -820,6 +847,14 @@ class LaneSet:
                     logger.exception("final sharded consume failed")
             for lane in self.lanes:
                 lane.emit_q.put(None)
+            if parent._ckpt is not None:
+                # SIGTERM graceful drain: gather the shutdown checkpoint
+                # after the in-flight wires flushed (see the single-lane
+                # loop's finally)
+                try:
+                    parent._ckpt.final(self._ckpt_snapshot(parent._now()))
+                except Exception:
+                    logger.exception("final checkpoint failed")
 
     def _staged(self) -> bool:
         return any(
@@ -827,6 +862,115 @@ class LaneSet:
             for lane in self.lanes
             for k in (lane.engine.nodes, lane.engine.pods)
         )
+
+    # --------------------------------------- crash-durable restarts (ckpt)
+
+    def _ckpt_service(self, dispatched: bool) -> None:
+        """The sharded twin of ClusterEngine._ckpt_service: the stacked
+        device state lives here, the row pools live on the lanes. Pool
+        walks take each lane's stage_lock (pure dict/array reads — never
+        blocking work); device reads/scatters happen lock-free on this
+        thread, which owns the stacked state."""
+        parent = self.parent
+        now = parent._now()
+        r = parent._restore
+        if r is not None:
+            if r.expired() or (not r.gate_ready and not r.remaining):
+                s = r.finish()
+                parent._close_restore(r)
+                logger.info(
+                    "checkpoint refine closed: %d refined, %d stale",
+                    s["refined"], s["stale"],
+                )
+            else:
+                self._ckpt_refine(r, now)
+            # tick until the pipeline flushes every pre-refine wire —
+            # their consumes re-arm the stale fresh-arm wake (see
+            # ClusterEngine._ckpt_service)
+            parent._ckpt_force_ticks = (
+                max(1, int(parent.config.pipeline_depth)) + 2
+            )
+        if parent._ckpt_force_ticks > 0:
+            parent._ckpt_force_ticks -= 1
+            parent._idle_wake = time.monotonic()
+        parent._ckpt_gate(dispatched, staged=self._staged())
+        ck = parent._ckpt
+        if ck is not None and ck.due():
+            ck.submit(self._ckpt_snapshot(now))
+
+    def _lane_kind(self, lane: ShardLane, kind: str):
+        e = lane.engine
+        return e.nodes if kind == "nodes" else e.pods
+
+    def _ckpt_refine(self, r, now: float) -> None:
+        """Match checkpoint entries per lane (the key->lane mapping is
+        the pool's own), then scatter ONE refine run per kind into the
+        stacked state at each lane's offset. A matched row released by a
+        concurrent drain worker right after the match is harmless: its
+        re-acquisition's staged init flushes AFTER this scatter (the
+        flush runs on this same thread) and overwrites the refined
+        fields."""
+        from kwok_tpu.ops.updates import refine_flush
+
+        for kind in _KINDS:
+            if not r.kinds.get(kind):
+                continue
+            state = self.stacked.get(kind)
+            if state is None:
+                continue
+            # current deadlines of the whole stacked kind: entries with a
+            # delay residue are consumed only once their row is ARMED
+            # (finite fire_at) — see ClusterEngine._ckpt_refine
+            cur_fire = np.asarray(state.fire_at)
+            runs = []
+            for li, lane in enumerate(self.lanes):
+                k = self._lane_kind(lane, kind)
+                with lane.stage_lock:
+                    staged = (
+                        k.buffer.staged_rows() if k.buffer.pending
+                        else frozenset()
+                    )
+                    idx, fire, hb, gen = r.match_kind(
+                        kind, k.pool, staged, now,
+                        phase_h=k.phase_h, fire=cur_fire,
+                        offset=li * self.r,
+                    )
+                if idx.size:
+                    runs.append((li, idx, fire, hb, gen))
+            for li, idx, fire, hb, gen in runs:
+                state = refine_flush(
+                    state, idx, fire, hb, gen, offset=li * self.r
+                )
+            self.stacked[kind] = state
+
+    def _ckpt_snapshot(self, now: float) -> dict:
+        """Gather the checkpoint rows across lanes: one host copy of the
+        stacked timer fields per kind, then a per-lane pool walk under
+        that lane's stage_lock."""
+        from kwok_tpu.ops.tick import gather_deadlines
+        from kwok_tpu.resilience import checkpoint as ckpt_mod
+
+        kinds: dict = {}
+        for kind in _KINDS:
+            state = self.stacked.get(kind)
+            if state is None:
+                kinds[kind] = {}
+                continue
+            fire, hb, gen = gather_deadlines(state)
+            ents: dict = {}
+            for li, lane in enumerate(self.lanes):
+                k = self._lane_kind(lane, kind)
+                with lane.stage_lock:
+                    staged = (
+                        k.buffer.staged_rows() if k.buffer.pending
+                        else frozenset()
+                    )
+                    ents.update(ckpt_mod.gather_rows(
+                        kind, k.pool, k.phase_h, fire, hb, gen, staged,
+                        now, offset=li * self.r,
+                    ))
+            kinds[kind] = ents
+        return {"kinds": kinds}
 
     # ----------------------------------------------------- dispatch/consume
 

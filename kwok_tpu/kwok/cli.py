@@ -114,6 +114,23 @@ def build_parser(defaults) -> argparse.ArgumentParser:
     p.add_argument("--worker-restart-window", type=float,
                    default=o.workerRestartWindow,
                    help="watchdog restart-budget window in seconds")
+    p.add_argument("--checkpoint-dir", default=o.checkpointDir,
+                   help="crash-durable restarts: periodically checkpoint "
+                   "the device-resident timer state (remaining Stage "
+                   "delays, heartbeat phases) here via atomic rename; a "
+                   "cold start re-lists then resumes matching rows' "
+                   "timers from the file (docs/resilience.md). "
+                   "KWOK_TPU_CHECKPOINT_DIR works too; empty = disabled "
+                   "(no thread, no gathers)")
+    p.add_argument("--checkpoint-interval", type=float,
+                   default=o.checkpointInterval,
+                   help="checkpoint cadence in seconds")
+    p.add_argument("--drain-deadline", type=float,
+                   default=o.drainDeadline,
+                   help="SIGTERM graceful-drain bound: flush in-flight "
+                   "emits and write a final checkpoint within this many "
+                   "seconds, else force-exit nonzero (a second SIGTERM "
+                   "force-exits immediately)")
     from kwok_tpu import log
 
     log.add_flags(p)
@@ -153,9 +170,48 @@ def _engine_config(args, stages: list[Stage]):
         shed_queue_depth=args.shed_queue_depth,
         worker_restart_budget=args.worker_restart_budget,
         worker_restart_window=args.worker_restart_window,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_interval=args.checkpoint_interval,
         node_rules=stages_to_rules(stages, ResourceKind.NODE),
         pod_rules=stages_to_rules(stages, ResourceKind.POD),
     )
+
+
+def make_signal_handler(stop: threading.Event, force_exit=None):
+    """First SIGTERM/SIGINT: set the stop event and let the graceful
+    drain run (flush in-flight emit slots, write a final checkpoint). A
+    SECOND SIGTERM means the operator wants out NOW: force-exit 130
+    without waiting on the drain. Factored out so the escalation is unit
+    testable without a subprocess."""
+    force = force_exit if force_exit is not None else os._exit
+    state = {"terms": 0}
+
+    def handler(sig, frame=None):
+        if sig == signal.SIGTERM:
+            state["terms"] += 1
+            if state["terms"] >= 2:
+                force(130)
+                return
+        stop.set()
+
+    return handler
+
+
+def stop_with_deadline(
+    stop_fns, deadline: float, force_exit=None
+) -> None:
+    """Run the shutdown callables under a wall-clock bound: a drain that
+    wedges past ``deadline`` seconds force-exits nonzero instead of
+    hanging the process manager's TERM->KILL escalation window."""
+    force = force_exit if force_exit is not None else os._exit
+    timer = threading.Timer(max(0.1, deadline), force, args=(3,))
+    timer.daemon = True
+    timer.start()
+    try:
+        for fn in stop_fns:
+            fn()
+    finally:
+        timer.cancel()
 
 
 def wait_for_apiserver(client, deadline_seconds: float = 120.0) -> None:
@@ -288,18 +344,24 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
                 "all nodes" if args.manage_all_nodes else "selected nodes")
 
     stop = stop_event or threading.Event()
+    handler = make_signal_handler(stop)
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
-            signal.signal(sig, lambda *_: stop.set())
+            signal.signal(sig, handler)
         except ValueError:
             pass  # not main thread (tests)
     try:
         while not stop.is_set():
             stop.wait(1.0)
     finally:
-        engine.stop()
+        # SIGTERM graceful drain: engine.stop() flushes in-flight device
+        # ticks and emit queues and writes the final checkpoint; the
+        # whole drain is bounded by --drain-deadline (and a second
+        # SIGTERM skips it outright — see make_signal_handler)
+        stop_fns = [engine.stop]
         if server:
-            server.stop()
+            stop_fns.append(server.stop)
+        stop_with_deadline(stop_fns, args.drain_deadline)
     return 0
 
 

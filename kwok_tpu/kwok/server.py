@@ -113,7 +113,19 @@ class EngineServer:
                     # tunneled device); liveness endpoints stay 200 the
                     # whole time so restart probes don't kill the warm-up
                     if not getattr(engine, "ready", True):
-                        self.send_error(503, "engine warming up")
+                        # a RESTARTED engine is the dangerous case: it
+                        # can look alive while its rows are still empty —
+                        # the startup catch-up gate keeps readiness 503
+                        # (reason startup_resync) until the first full
+                        # re-list + checkpoint reconcile lands
+                        reason = (
+                            "startup_resync"
+                            if getattr(
+                                engine, "startup_resync_pending", False
+                            )
+                            else "engine warming up"
+                        )
+                        self.send_error(503, reason)
                         return
                     if getattr(engine, "degraded", False):
                         # degraded mode (resilience/policy.py): shedding

@@ -586,6 +586,21 @@ def lane_views(masks, rows, n_lanes: int, r: int):
     return out
 
 
+def gather_deadlines(state: RowState):
+    """Host copies of the device-owned timer fields ``(fire_at, hb_due,
+    gen)`` — the checkpoint gather (resilience/checkpoint.py). The async
+    copies are started together so the three D2H transfers overlap; the
+    np.asarray consumption then blocks once. Runs on the device-owning
+    loop between dispatches, where the state arrays are live outputs
+    (not yet donated to the next dispatch)."""
+    prefetch((state.fire_at, state.hb_due, state.gen))
+    return (
+        np.asarray(state.fire_at),
+        np.asarray(state.hb_due),
+        np.asarray(state.gen),
+    )
+
+
 def prefetch(tree) -> None:
     """Start async device->host copies for every array in `tree`.
 

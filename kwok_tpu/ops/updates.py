@@ -75,6 +75,72 @@ def update_rows(state: RowState, b: UpdateBatch) -> RowState:
     )
 
 
+class RefineBatch(NamedTuple):
+    """Checkpoint-restore refinement: overwrite device-owned timer fields
+    of already-armed rows (resilience/checkpoint.py). The tick kernel
+    re-arms a restarted row with a FRESH delay; this scatter runs after
+    that arming dispatch and restores the checkpointed residue, so an
+    in-flight Stage delay resumes instead of resetting."""
+
+    idx: np.ndarray  # int32[W], capacity = padding
+    fire_at: np.ndarray  # float32
+    hb_due: np.ndarray  # float32
+    gen: np.ndarray  # int32
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def refine_rows(state: RowState, b: RefineBatch) -> RowState:
+    idx = b.idx
+    return state._replace(
+        fire_at=state.fire_at.at[idx].set(b.fire_at, mode="drop"),
+        hb_due=state.hb_due.at[idx].set(b.hb_due, mode="drop"),
+        gen=state.gen.at[idx].set(b.gen, mode="drop"),
+    )
+
+
+def refine_flush(
+    state: RowState,
+    idx: np.ndarray,
+    fire_at: np.ndarray,
+    hb_due: np.ndarray,
+    gen: np.ndarray,
+    offset: int = 0,
+) -> RowState:
+    """Apply a refine run in the same fixed padded widths as the ingest
+    scatters (two compiled variants, ever). ``offset`` shifts indices
+    into a stacked state (lane/member slices); padding uses the target
+    capacity under mode='drop', exactly like UpdateBuffer.flush."""
+    cap = state.capacity
+    off = np.int32(offset)
+    n = int(idx.shape[0])
+    pos = 0
+    while pos < n:
+        width = BATCH_LARGE if n - pos > BATCH else BATCH
+        take = min(width, n - pos)
+        pad = width - take
+        sl = slice(pos, pos + take)
+        b = RefineBatch(
+            idx=np.concatenate(
+                [np.asarray(idx[sl], np.int32) + off,
+                 np.full(pad, cap, np.int32)]
+            ),
+            fire_at=np.concatenate(
+                [np.asarray(fire_at[sl], np.float32),
+                 np.zeros(pad, np.float32)]
+            ),
+            hb_due=np.concatenate(
+                [np.asarray(hb_due[sl], np.float32),
+                 np.zeros(pad, np.float32)]
+            ),
+            gen=np.concatenate(
+                [np.asarray(gen[sl], np.int32), np.zeros(pad, np.int32)]
+            ),
+        )
+        state = refine_rows(state, b)
+        pos += take
+    return state
+
+
 class _InitBlock(NamedTuple):
     """A columnar run of active-row inits staged as whole arrays (the
     batched survivor-ingest path): one append instead of n tuple appends,
@@ -138,6 +204,22 @@ class UpdateBuffer:
 
     def stage_update(self, idx: int, sel_bits: int, has_deletion: bool) -> None:
         self._upd.append((idx, sel_bits, has_deletion))
+
+    def staged_rows(self) -> set:
+        """Row indices with a staged-but-unflushed INIT. The checkpoint
+        gather and restore refine (resilience/checkpoint.py) skip these:
+        their device slots still describe a previous occupant (or
+        nothing), so neither reading their timers nor overwriting them
+        is meaningful until the init flushes. Updates are excluded on
+        purpose — they only touch matching inputs, and the kernel's
+        re-arm supersedes any refine on such rows at the next tick."""
+        out: set = set()
+        for entry in self._init:
+            if isinstance(entry, _InitBlock):
+                out.update(entry.idx.tolist())
+            else:
+                out.add(entry[0])
+        return out
 
     @property
     def pending(self) -> int:

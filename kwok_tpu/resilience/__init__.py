@@ -15,8 +15,16 @@ guide):
   router/drain/emit workers within a budgeted window
   (``kwok_worker_restarts_total{thread=}``), degrading the engine when
   the budget runs out.
+- ``checkpoint`` (ISSUE 7): crash-durable restarts — the periodic
+  atomic-rename checkpoint of device-resident timer state
+  (``--checkpoint-dir``), and the cold-start/refill reconcile that
+  resumes matching rows' Stage delays after a ``kill -9``.
 """
 
+from kwok_tpu.resilience.checkpoint import (
+    Checkpointer,
+    RestoreSession,
+)
 from kwok_tpu.resilience.faults import (
     FaultInjected,
     FaultPlane,
@@ -36,12 +44,14 @@ from kwok_tpu.resilience.watchdog import Watchdog
 
 __all__ = [
     "Backoff",
+    "Checkpointer",
     "Degradation",
     "FaultInjected",
     "FaultPlane",
     "FaultSpec",
     "PATCH_RETRY",
     "PUMP_RESEND",
+    "RestoreSession",
     "RetryPolicy",
     "WATCH_RECONNECT",
     "Watchdog",

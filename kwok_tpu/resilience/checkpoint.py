@@ -1,0 +1,405 @@
+"""Crash-durable checkpoints: the per-row scalars a restart cannot relist.
+
+The reference kwok is stateless by design — a controller restart re-lists
+and re-adopts the cluster from apiserver state. This engine holds volatile
+state the apiserver does NOT carry: the device-resident ``fire_at`` stage
+deadline of every armed row (how much of a Stage delay has already
+elapsed), the heartbeat wheel's per-row phase (``hb_due``), and the
+per-row transition generation (``gen``). A ``kill -9`` + restart without
+this module silently resets every in-flight delay to zero.
+
+Three pieces:
+
+- :class:`Checkpointer`: a periodic, atomic-rename JSON checkpoint of the
+  irreplaceable scalars. The GATHER (device arrays -> host, pool/meta
+  walk) always happens on the thread that owns device state — the tick
+  thread / lane coordinator / federated loop — at the configured cadence;
+  serialization and file I/O happen on this module's writer thread so the
+  tick lane never blocks on disk. Writes go to ``<name>.ckpt.json.tmp``
+  then ``os.replace`` — a crash mid-write can never leave a torn file.
+- :func:`gather_rows` / :func:`load`: the snapshot row format. Each
+  active, device-flushed row records ``(uid, rv, fire-residue,
+  hb-residue, gen, phase)``; residues are *remaining* seconds (deadline
+  minus engine-now), so the restore semantics are freeze-during-downtime.
+- :class:`RestoreSession`: the cold-start (and federation member refill)
+  reconcile. The engine re-lists as it always did and lets Stage
+  selectors place each row; the session then refines ``fire_at``/
+  ``hb_due``/``gen`` for rows whose ``(uid, rv)`` still match their
+  checkpoint entry, and drops stale rows PER ROW (an object that changed
+  while the engine was down simply re-arms fresh) — never wholesale.
+
+Zero cost when disabled: no ``--checkpoint-dir`` means no Checkpointer
+object, no writer thread, no gathers, and a single ``is None`` test on
+the tick loop's service gate.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger("kwok_tpu.resilience")
+
+VERSION = 1
+
+# Per-kind key <-> JSON string key. Pods join (namespace, name) with "/":
+# a k8s namespace can never contain a slash (RFC 1123 label), so the join
+# is unambiguous.
+_POD_SEP = "/"
+
+
+def key_str(kind: str, key) -> str:
+    if kind == "pods":
+        return f"{key[0]}{_POD_SEP}{key[1]}"
+    return str(key)
+
+
+def str_key(kind: str, ks: str):
+    if kind == "pods":
+        ns, _, name = ks.partition(_POD_SEP)
+        return (ns, name)
+    return ks
+
+
+def checkpoint_path(directory: str, name: str) -> str:
+    return os.path.join(directory, f"{name}.ckpt.json")
+
+
+def row_uid(m: dict) -> str:
+    """The row's object uid, extracted lazily and cached in the meta dict.
+
+    Dict-path rows carry a parsed object; native-record rows only carry
+    the raw watch line — a C-level byte search finds the first
+    ``"uid":"`` there without a JSON parse. ownerReferences can in
+    principle shadow metadata.uid depending on serialization order, so a
+    mis-extracted uid only ever makes the restore MORE conservative (the
+    (uid, rv) match fails and the row re-arms fresh)."""
+    uid = m.get("uid")
+    if uid is None:
+        obj = m.get("obj")
+        if obj is not None:
+            uid = ((obj.get("metadata") or {}).get("uid")) or ""
+        else:
+            raw = m.get("raw") or b""
+            i = raw.find(b'"uid":"')
+            if i >= 0:
+                j = raw.find(b'"', i + 7)
+                uid = raw[i + 7 : j].decode("utf-8", "replace") if j > 0 else ""
+            else:
+                uid = ""
+        m["uid"] = uid
+    return uid
+
+
+def _residue(deadline: float, now: float):
+    """Remaining seconds until an engine-time deadline; None for the
+    +inf sentinel (no timer armed — JSON has no Infinity)."""
+    if not math.isfinite(deadline):
+        return None
+    return round(max(0.0, deadline - now), 6)
+
+
+def gather_rows(
+    kind: str,
+    pool,
+    phase_h,
+    fire: np.ndarray,
+    hb: np.ndarray,
+    gen: np.ndarray,
+    staged,
+    now: float,
+    offset: int = 0,
+) -> dict:
+    """One kind's checkpoint rows: ``{key: [uid, rv, fire_res, hb_res,
+    gen, phase]}`` over every pooled row whose device state is current.
+
+    ``staged`` is the set of row indices with a staged-but-unflushed init
+    (UpdateBuffer.staged_rows): their device slots still describe a
+    previous occupant, so they are skipped — they'll be in the next
+    checkpoint, one cadence later. Rows without a recorded ``rv`` carry
+    no identity the restore could match and are skipped too. ``offset``
+    shifts pool-local indices into a stacked state (lane/member slices).
+    """
+    ents: dict[str, list] = {}
+    for key, idx in list(pool.items()):
+        if idx in staged:
+            continue
+        m = pool.meta[idx]
+        if not m:
+            continue
+        rv = int(m.get("rv") or 0)
+        if not rv:
+            continue
+        di = idx + offset
+        ents[key_str(kind, key)] = [
+            row_uid(m),
+            rv,
+            _residue(float(fire[di]), now),
+            _residue(float(hb[di]), now),
+            int(gen[di]),
+            int(phase_h[idx]),
+        ]
+    return ents
+
+
+def load(directory: str, name: str) -> "dict | None":
+    """Read a checkpoint written by :class:`Checkpointer`. Returns the
+    parsed document or None (absent file = cold start; a malformed file —
+    impossible from the atomic writer, possible from a hand edit — is a
+    logged warning, never a startup crash)."""
+    path = checkpoint_path(directory, name)
+    try:
+        with open(path, "rb") as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError):
+        logger.warning("unreadable checkpoint %s; cold start", path,
+                       exc_info=True)
+        return None
+    if not isinstance(doc, dict) or doc.get("v") != VERSION:
+        logger.warning(
+            "checkpoint %s has unknown version %r; cold start",
+            path, doc.get("v") if isinstance(doc, dict) else None,
+        )
+        return None
+    kinds = doc.get("kinds")
+    if not isinstance(kinds, dict):
+        logger.warning("checkpoint %s missing kinds; cold start", path)
+        return None
+    return doc
+
+
+class Checkpointer:
+    """Cadenced checkpoint writer for one engine (or federation member).
+
+    The device-owning loop polls :meth:`due` once per iteration (one
+    monotonic compare), gathers a snapshot when due, and :meth:`submit`\\ s
+    it; this class serializes + atomically renames on its own writer
+    thread. The FINAL checkpoint at shutdown (:meth:`final`) rides the
+    same queue so it can never be overwritten by an older periodic
+    snapshot still in flight."""
+
+    def __init__(
+        self,
+        directory: str,
+        name: str,
+        interval: float,
+        telemetry=None,
+    ) -> None:
+        self.directory = directory
+        self.name = name
+        self.interval = max(0.05, float(interval))
+        self.path = checkpoint_path(directory, name)
+        self._tmp = self.path + ".tmp"
+        self._telemetry = telemetry
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread: "threading.Thread | None" = None
+        self._next = time.monotonic() + self.interval
+        self.writes = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        from kwok_tpu.workers import spawn_worker
+
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread = spawn_worker(
+            self._write_loop, name=f"kwok-ckpt-{self.name}"
+        )
+
+    def stop(self) -> None:
+        """Drain the queue (any final snapshot included) and join."""
+        self._q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -------------------------------------------------------------- cadence
+
+    def due(self) -> bool:
+        return time.monotonic() >= self._next
+
+    def submit(self, snapshot: dict) -> None:
+        """Queue one gathered snapshot for writing; resets the cadence."""
+        self._next = time.monotonic() + self.interval
+        self._q.put(snapshot)
+
+    def final(self, snapshot: dict) -> None:
+        """Queue the shutdown checkpoint (ordered behind any periodic
+        snapshot already queued, so the last write is always the newest
+        gather). Falls back to a synchronous write when the writer thread
+        is gone (a crash-during-shutdown path)."""
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(snapshot)
+        else:
+            self._write(snapshot)
+
+    # --------------------------------------------------------------- writer
+
+    def _write_loop(self) -> None:
+        while True:
+            snap = self._q.get()
+            if snap is None:
+                return
+            try:
+                self._write(snap)
+            except Exception:
+                # one failed write must not end checkpointing; the next
+                # cadence retries with fresher data
+                logger.exception("checkpoint write failed (%s)", self.path)
+
+    def _write(self, snapshot: dict) -> None:
+        t0 = time.perf_counter()
+        doc = {
+            "v": VERSION,
+            "name": self.name,
+            "wall": time.time(),
+            "kinds": snapshot.get("kinds") or {},
+        }
+        blob = json.dumps(doc, separators=(",", ":")).encode()
+        with open(self._tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(self._tmp, self.path)
+        self.writes += 1
+        dt = time.perf_counter() - t0
+        tel = self._telemetry
+        if tel is not None:
+            armed = idle = 0
+            for ents in doc["kinds"].values():
+                for e in ents.values():
+                    if e[2] is not None:
+                        armed += 1
+                    else:
+                        idle += 1
+            tel.ckpt_write_hist.observe(dt)
+            tel.ckpt_rows["armed"].set(armed)
+            tel.ckpt_rows["idle"].set(idle)
+
+
+class RestoreSession:
+    """Match checkpoint entries against freshly re-listed rows and hand
+    back refine batches; consumed per row, dropped per row.
+
+    Single consumer by contract: only the device-owning loop calls
+    :meth:`match_kind`. ``gate_ready`` sessions belong to the startup
+    reconcile (the engine's /readyz gate finishes them); refill sessions
+    (federation member restarts, watch-worker restarts) instead carry a
+    TTL — they end when the re-list has had ample time to re-deliver."""
+
+    def __init__(self, kinds: dict, gate_ready: bool, ttl: float = 0.0):
+        # parse into {kind: {key_str: entry-list}} defensively: a stale
+        # or hand-edited file must degrade to "nothing matches"
+        self.kinds: dict[str, dict] = {}
+        for kind in ("nodes", "pods"):
+            ents = kinds.get(kind)
+            self.kinds[kind] = dict(ents) if isinstance(ents, dict) else {}
+        self.gate_ready = gate_ready
+        self.deadline = (time.monotonic() + ttl) if ttl > 0 else 0.0
+        self.matched = 0
+        self.stale = 0
+
+    @property
+    def remaining(self) -> int:
+        return sum(len(v) for v in self.kinds.values())
+
+    def expired(self) -> bool:
+        return bool(self.deadline) and time.monotonic() > self.deadline
+
+    def match_kind(
+        self, kind: str, pool, staged, now: float, phase_h=None,
+        fire=None, offset: int = 0,
+    ):
+        """Pop every entry whose row is present, device-flushed, ARMED,
+        and still the same object ``(uid, rv, phase)``; return its
+        refine arrays (idx, fire_at, hb_due, gen) in ENGINE time.
+        Entries whose row exists but whose identity moved on are dropped
+        as stale; entries whose key is absent — or whose row the kernel
+        has not armed yet — stay (the re-list / a managed-ness XUPD may
+        not have reached them; :meth:`finish` drops the leftovers).
+
+        ``fire`` is the CURRENT device fire_at array (host copy): an
+        entry carrying a delay residue is only consumed once the row's
+        own deadline is finite, i.e. the kernel has matched and armed
+        its rule. Refining before that point would be undone by the very
+        re-arm that follows — the restart_soak gate caught exactly this
+        on pods whose managed bit arrives via a later XUPD."""
+        ents = self.kinds.get(kind)
+        if not ents:
+            return (np.empty(0, np.int32),) * 4
+        idx_l: list[int] = []
+        fire_l: list[float] = []
+        hb_l: list[float] = []
+        gen_l: list[int] = []
+        inf = float("inf")
+        for ks, ent in list(ents.items()):
+            try:
+                uid, rv, fire_res, hb_res, gen, phase = ent
+            except (TypeError, ValueError):
+                ents.pop(ks)
+                self.stale += 1
+                continue
+            idx = pool.lookup(str_key(kind, ks))
+            if idx is None:
+                continue  # not re-listed yet; the final pass drops it
+            if idx in staged:
+                continue  # staged init not flushed/armed yet; next pass
+            m = pool.meta[idx] or {}
+            if int(m.get("rv") or 0) != int(rv):
+                ents.pop(ks)
+                self.stale += 1
+                continue
+            cur_uid = row_uid(m)
+            if uid and cur_uid and uid != cur_uid:
+                ents.pop(ks)
+                self.stale += 1
+                continue
+            if phase_h is not None and int(phase_h[idx]) != int(phase):
+                # same rv but a different lifecycle phase can only mean
+                # the row transitioned since the checkpoint (the echo
+                # has not landed yet): resuming the OLD delay would
+                # re-fire it — drop, let the fresh arm win
+                ents.pop(ks)
+                self.stale += 1
+                continue
+            if fire_res is not None and fire is not None and not (
+                math.isfinite(float(fire[idx + offset]))
+            ):
+                continue  # not armed yet (e.g. XUPD pending); next pass
+            ents.pop(ks)
+            self.matched += 1
+            idx_l.append(idx)
+            fire_l.append(now + fire_res if fire_res is not None else inf)
+            hb_l.append(now + hb_res if hb_res is not None else inf)
+            gen_l.append(int(gen))
+        if not idx_l:
+            return (np.empty(0, np.int32),) * 4
+        return (
+            np.fromiter(idx_l, np.int32, len(idx_l)),
+            np.fromiter(fire_l, np.float32, len(fire_l)),
+            np.fromiter(hb_l, np.float32, len(hb_l)),
+            np.fromiter(gen_l, np.int32, len(gen_l)),
+        )
+
+    def finish(self) -> dict:
+        """Close the session: leftovers are objects the re-list did not
+        return (deleted while down) — stale by definition, dropped per
+        row. Returns the summary for the recovery log line."""
+        leftover = self.remaining
+        self.stale += leftover
+        for ents in self.kinds.values():
+            ents.clear()
+        return {
+            "refined": self.matched,
+            "stale": self.stale,
+            "unlisted": leftover,
+        }

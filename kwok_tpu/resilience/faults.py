@@ -271,12 +271,17 @@ class FaultPlane:
             killer.join(timeout=5)
 
     # Threads the spec-driven killer may target: ONLY the watchdog-
-    # supervised lane workers (LaneSet.start_workers). Killing an
-    # unsupervised singleton (kwok-tick, kwok-watch-*, kwok-http, the
-    # profiling sampler) would end it for good with /readyz still 200 —
-    # a silently-dead engine, not a self-healing exercise. Tests that
+    # supervised workers — lane workers (LaneSet.start_workers) and,
+    # since ISSUE 7, the watch ingest loops (ClusterEngine._spawn_watch
+    # spawns them under the watchdog; a restarted watch loop re-lists by
+    # construction, so the restart IS the recovery). Killing an
+    # unsupervised singleton (kwok-tick, kwok-http, the profiling
+    # sampler) would end it for good with /readyz still 200 — a
+    # silently-dead engine, not a self-healing exercise. Tests that
     # want to assassinate arbitrary threads call kill_worker directly.
-    _SUPERVISED_PREFIXES = ("kwok-lane", "kwok-emit", "kwok-route")
+    _SUPERVISED_PREFIXES = (
+        "kwok-lane", "kwok-emit", "kwok-route", "kwok-watch",
+    )
 
     def _kill_loop(self) -> None:
         from kwok_tpu.workers import live_workers

@@ -62,6 +62,21 @@ _HELP = {
     "the native pre-partitioned parse (shard=lane index; per-event Python "
     "routing does not count here — compare with kwok_watch_events_total "
     "to see the fast-path share)",
+    "kwok_rv_rewinds_total": "Store-restore signatures detected: an "
+    "object re-listed BELOW its last-ingested resourceVersion (POST "
+    "/restore or a blackout recovery from an old snapshot — an object's "
+    "own rv can never legitimately decrease); each one resyncs every "
+    "watch stream",
+    "kwok_restart_recovery_seconds": "Seconds from engine start to the "
+    "startup catch-up gate closing (first full re-list of both kinds "
+    "ingested + checkpoint reconcile applied); /readyz answers 503 with "
+    "reason startup_resync until then",
+    "kwok_checkpoint_write_seconds": "Wall seconds serializing + "
+    "atomically renaming one crash-durability checkpoint "
+    "(resilience/checkpoint.py; only moves with --checkpoint-dir set)",
+    "kwok_checkpoint_rows": "Rows in the most recent checkpoint by "
+    "state (armed = a Stage delay in flight whose residue the next "
+    "restart resumes; idle = no pending rule timer)",
 }
 
 # legacy counter name -> (family name, has kind label)
@@ -78,6 +93,7 @@ _COUNTERS = {
     "dropped_jobs_total": ("kwok_dropped_jobs_total", False),
     "ticks_total": ("kwok_ticks_total", False),
     "pump_requests_total": ("kwok_pump_requests_total", False),
+    "rv_rewinds_total": ("kwok_rv_rewinds_total", False),
 }
 
 _GAUGES = {
@@ -87,6 +103,7 @@ _GAUGES = {
     "tick_inflight": "kwok_tick_inflight",
     "nodes_managed": "kwok_nodes_managed",
     "pods_managed": "kwok_pods_managed",
+    "restart_recovery_seconds": "kwok_restart_recovery_seconds",
 }
 
 _KINDS = ("nodes", "pods")
@@ -162,6 +179,24 @@ class EngineTelemetry:
                 base,
             )
         )
+        # crash-durability checkpoint surface (resilience/checkpoint.py):
+        # pre-created so exposition is stable whether or not a
+        # --checkpoint-dir is configured
+        self.ckpt_write_hist = child(
+            r.histogram(
+                "kwok_checkpoint_write_seconds",
+                _HELP["kwok_checkpoint_write_seconds"],
+                base,
+            )
+        )
+        ckpt_rows_fam = r.gauge(
+            "kwok_checkpoint_rows", _HELP["kwok_checkpoint_rows"],
+            base + ("state",),
+        )
+        self.ckpt_rows = {
+            s: ckpt_rows_fam.labels(**sl, state=s)
+            for s in ("armed", "idle")
+        }
         self._rtt_fam = r.histogram(
             "kwok_patch_rtt_seconds",
             _HELP["kwok_patch_rtt_seconds"],

@@ -1,8 +1,18 @@
-"""FakeKube dump/load + HTTP /snapshot + /restore (the mock's etcd)."""
+"""FakeKube dump/load + HTTP /snapshot + /restore (the mock's etcd),
+plus the mock-vs-native restore PARITY TWIN (ISSUE 7): both apiservers
+must speak the same /restore dialect — watch closure, per-object rv
+rewind, compaction of the pre-restore history — byte-compared over real
+sockets with deterministic inputs."""
 
 import json
+import threading
+import time
+import urllib.error
 import urllib.request
 
+import pytest
+
+from kwok_tpu.edge.httpclient import HttpKubeClient
 from kwok_tpu.edge.mockserver import FakeKube, HttpFakeApiserver
 
 
@@ -46,3 +56,153 @@ def test_http_snapshot_restore_endpoints():
         assert srv.store.get("nodes", None, "drop") is None
     finally:
         srv.stop()
+
+
+# ------------------------------------- mock vs native restore parity twin
+
+
+def _obj(kind, name, uid, ns=None, node=None):
+    """Deterministic object: explicit uid + creationTimestamp so the two
+    servers' serialized stores are byte-comparable."""
+    meta = {"name": name, "uid": uid,
+            "creationTimestamp": "2026-01-02T03:04:05Z"}
+    if ns:
+        meta["namespace"] = ns
+    doc = {"apiVersion": "v1", "kind": kind.capitalize()[:-1] or kind,
+           "metadata": meta}
+    if kind == "pods":
+        doc["spec"] = {"nodeName": node or "n0",
+                       "containers": [{"name": "c", "image": "busybox"}]}
+        doc["status"] = {"phase": "Pending"}
+    return doc
+
+
+def _canon(doc) -> str:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+def _http(url, data=None, method=None):
+    req = urllib.request.Request(
+        url, data=data,
+        method=method or ("POST" if data is not None else "GET"),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=10).read()
+
+
+def _drive_restore_sequence(url: str) -> dict:
+    """One identical op sequence against an apiserver base URL; returns
+    the observables the twin byte-compares."""
+    client = HttpKubeClient(url)
+    out: dict = {}
+    try:
+        client.create("nodes", _obj("nodes", "n0", "uid-n0"))
+        client.create("pods", _obj("pods", "p0", "uid-p0", ns="default"))
+        client.create("pods", _obj("pods", "p1", "uid-p1", ns="default"))
+        snap = json.loads(_http(url + "/snapshot"))
+        out["snapshot_objects"] = _canon(snap["objects"])
+        # post-snapshot writes the restore must erase
+        client.create("pods", _obj("pods", "p2", "uid-p2", ns="default"))
+        client.patch_status("pods", "default", "p0",
+                            {"status": {"phase": "Running"}})
+        pre_rv = max(
+            int(p["metadata"]["resourceVersion"])
+            for p in client.list("pods")
+        )
+        out["pre_restore_rv"] = pre_rv
+
+        # a live watch must be CLOSED by the restore
+        w = client.watch("pods")
+        seen_end = threading.Event()
+
+        def drain():
+            for _ in w:
+                pass
+            seen_end.set()
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        time.sleep(0.2)  # let the stream register server-side
+        _http(url + "/restore", data=json.dumps(snap).encode())
+        out["watch_closed"] = seen_end.wait(5.0)
+
+        # rv rewind: restored objects carry their snapshot-time revisions
+        pods = client.list("pods")
+        out["post_restore_pods"] = _canon(
+            sorted(pods, key=lambda p: p["metadata"]["name"])
+        )
+        out["object_rv_rewound"] = all(
+            int(p["metadata"]["resourceVersion"]) < pre_rv for p in pods
+        )
+        # compaction: resuming from the pre-restore world answers the
+        # apiserver's expired-watch dialect — 200 + ONE ERROR event
+        # carrying a 410 Status, then the stream closes (docs/parity.md)
+        # — byte-compared between the two servers
+        raw = _http(
+            url + f"/api/v1/pods?watch=true&resourceVersion={pre_rv}"
+        )
+        ev = json.loads(raw)
+        status = ev.get("object") or {}
+        out["resume_410_code"] = status.get("code")
+        out["resume_410_type"] = ev.get("type")
+        status.pop("message", None)  # wording may embed revisions
+        out["resume_410_body"] = _canon(status)
+        # the store counter never rewinds: a new write lands ABOVE the
+        # pre-restore high-water mark (monotonic rv)
+        created = client.create(
+            "nodes", _obj("nodes", "n1", "uid-n1")
+        )
+        out["rv_monotonic"] = (
+            int(created["metadata"]["resourceVersion"]) > pre_rv
+        )
+    finally:
+        client.close()
+    return out
+
+
+def test_restore_semantics_mock_http():
+    srv = HttpFakeApiserver()
+    srv.start()
+    try:
+        out = _drive_restore_sequence(srv.url)
+    finally:
+        srv.stop()
+    assert out["watch_closed"], "restore must close open watch streams"
+    assert out["object_rv_rewound"]
+    assert out["resume_410_code"] == 410
+    assert out["rv_monotonic"]
+    assert '"p2"' not in out["post_restore_pods"]
+
+
+def _native_binary():
+    from kwok_tpu import native
+
+    return native.apiserver_binary()
+
+
+@pytest.mark.skipif(_native_binary() is None, reason="no C++ compiler")
+def test_restore_parity_mock_vs_native():
+    """The twin: the SAME sequence against both servers — snapshots,
+    post-restore lists, 410 dialect, watch closure, rv monotonicity —
+    byte-compared field for field."""
+    from tests.test_native_apiserver import NativeServer
+
+    srv = HttpFakeApiserver()
+    srv.start()
+    try:
+        mock = _drive_restore_sequence(srv.url)
+    finally:
+        srv.stop()
+    ns = NativeServer()
+    try:
+        nat = _drive_restore_sequence(ns.url)
+    finally:
+        ns.stop()
+    for key in (
+        "snapshot_objects", "pre_restore_rv", "watch_closed",
+        "post_restore_pods", "object_rv_rewound", "resume_410_code",
+        "resume_410_type", "resume_410_body", "rv_monotonic",
+    ):
+        assert mock[key] == nat[key], (key, mock[key], nat[key])
+    assert mock["watch_closed"] and mock["object_rv_rewound"]
+    assert mock["rv_monotonic"]
