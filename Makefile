@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check fleet-check image cluster-image clean
 
 all: build
 
@@ -62,6 +62,18 @@ chaos-check: ## deterministic fault-injection + self-healing convergence gate (+
 # (docs/resilience.md).
 restart-check: ## SIGKILL + cold-restart crash-durability gate (RTO artifact)
 	$(PYENV) python3 benchmarks/restart_soak.py --check
+
+# fleet-check: the apiserver overload-protection gate: a watcher fleet
+# (normal + deliberately-slow + churn + list-flood cohorts) against the
+# native apiserver with max-inflight admission + bounded watch buffers
+# configured, while the threaded engine converges a workload under the
+# fault storm. Gates = byte-identical final phases vs a no-fleet control
+# arm, every watcher at the final resourceVersion, engine patch-RTT p99
+# bounded, slow watchers terminated (not buffered unboundedly), and all
+# 429s throttled by Retry-After (docs/resilience.md; FLEET_r*.json).
+# Skips cleanly when no C++ compiler is available.
+fleet-check: ## watcher-fleet survival gate (overload admission + slow-watcher eviction)
+	$(PYENV) python3 benchmarks/watcher_fleet.py --check
 
 image:
 	./images/kwok/build.sh

@@ -629,6 +629,11 @@ def main() -> None:
 # burn another full retry window re-discovering a dead tunnel
 _PROBE_VERDICT: "bool | None" = None
 
+# every probe attempt's outcome, machine-readable: a skipped TPU leg must
+# record WHY in the BENCH rider (hack/tpu-recapture.sh --probe-only
+# gate), not just in a scrolled-away stderr
+_PROBE_LOG: "list[str]" = []
+
 
 def _pool_endpoints() -> "list[tuple[str, int]]":
     """TCP endpoints implied by PALLAS_AXON_POOL_IPS: `host[:port]` items,
@@ -755,6 +760,7 @@ def _device_reachable(
         return _PROBE_VERDICT
 
     def log(msg: str) -> None:
+        _PROBE_LOG.append(msg)
         print(msg, file=sys.stderr, flush=True)
 
     for attempt in range(1, retries + 1):
@@ -811,7 +817,36 @@ if __name__ == "__main__":
     _p.add_argument("--mesh-device", action="store_true",
                     help="1-device mesh vs plain jit on the REAL device: "
                     "the sharded path's per-dispatch overhead")
+    _p.add_argument("--probe-only", action="store_true",
+                    help="run ONLY the bounded device probe and emit a "
+                    "JSON verdict with the attempt log — the recapture "
+                    "script's reachability gate, so a dead tunnel is "
+                    "recorded as an explicit skip (reason + attempts) in "
+                    "the BENCH rider instead of burning the budget on "
+                    "CPU-fallback legs")
     _a = _p.parse_args()
+    if _a.probe_only:
+        # the recapture gate asks "is a real ACCELERATOR reachable", not
+        # "can jax import": with no tunnel configured at all the TPU leg
+        # is unreachable by configuration, and _device_reachable()'s
+        # CPU-is-fine shortcut must not answer for it
+        _pool = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+        _plat = os.environ.get("JAX_PLATFORMS", "")
+        if not _pool and _plat in ("", "cpu"):
+            _ok = False
+            _PROBE_LOG.append(
+                "no accelerator configured: PALLAS_AXON_POOL_IPS unset "
+                f"and JAX_PLATFORMS={_plat!r} (tunnel absent in this "
+                "environment)"
+            )
+        else:
+            _ok = _device_reachable()
+        print(json.dumps({
+            "device_reachable": _ok,
+            "pool_ips_set": _pool,
+            "probe_log": _PROBE_LOG,
+        }))
+        sys.exit(0 if _ok else 3)
     if os.environ.get("KWOK_BENCH_CPU_FALLBACK"):
         # a single CPU core cannot turn over 1M rows in a sane bench
         # budget; the metric line reports the actual sizes + platform.

@@ -34,6 +34,16 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from benchmarks.rig import (  # noqa: E402 (path bootstrap above)
+    MockApiserver,
+    NativeApiserver,
+    make_node as _make_node,
+    make_pod as _make_pod,
+    oplog_store as _recording_store,
+    pod_phases as _pod_phases,
+    wait_until as _wait,
+)
+
 # the storm: every fault kind the plane speaks, rates sized so a ~10s
 # churn window sees each kind fire at least once but the engine is never
 # permanently wedged (seed pins the whole storm — reruns are identical)
@@ -43,99 +53,14 @@ CHAOS_SPEC = (
 )
 
 
-def _make_pod(name: str, node: str) -> dict:
-    return {
-        "apiVersion": "v1", "kind": "Pod",
-        "metadata": {"name": name, "namespace": "default"},
-        "spec": {"nodeName": node,
-                 "containers": [{"name": "c", "image": "busybox"}]},
-        "status": {"phase": "Pending"},
-    }
-
-
-def _make_node(name: str) -> dict:
-    return {
-        "apiVersion": "v1", "kind": "Node",
-        "metadata": {"name": name},
-        "status": {"phase": ""},
-    }
-
-
-def _recording_store():
-    """FakeKube whose pod-facing write verbs keep an arrival-order oplog
-    (server side, so pump-delivered AND client-delivered writes are both
-    seen). List appends are GIL-atomic."""
-    from kwok_tpu.edge.mockserver import FakeKube
-
-    class RecordingStore(FakeKube):
-        def __init__(self):
-            super().__init__()
-            self.oplog: list = []  # (key, op, phase-or-None)
-
-        def _note(self, kind, namespace, name, patch):
-            if kind != "pods":
-                return
-            phase = None
-            if isinstance(patch, dict):
-                phase = (patch.get("status") or {}).get("phase")
-            self.oplog.append(((namespace or "default", name), "patch", phase))
-
-        def patch_status(self, kind, namespace, name, patch):
-            self._note(kind, namespace, name, patch)
-            return super().patch_status(kind, namespace, name, patch)
-
-        def patch_status_bytes(self, kind, namespace, name, patch):
-            if isinstance(patch, (bytes, bytearray, memoryview)):
-                patch = json.loads(bytes(patch))
-            self._note(kind, namespace, name, patch)
-            return super().patch_status_bytes(kind, namespace, name, patch)
-
-        def delete(self, kind, namespace, name, **kw):
-            if kind == "pods":
-                self.oplog.append(
-                    ((namespace or "default", name), "delete", None)
-                )
-            return super().delete(kind, namespace, name, **kw)
-
-        def per_key_collapsed(self, key):
-            """The ordering oracle's view: consecutive duplicates collapse
-            (pump whole-frame resend is at-least-once: a request whose
-            response died on the wire is legitimately replayed)."""
-            out = []
-            for k, op, ph in list(self.oplog):
-                if k == key and (not out or out[-1] != (op, ph)):
-                    out.append((op, ph))
-            return out
-
-    return RecordingStore()
-
-
-def _wait(pred, timeout, every=0.05) -> bool:
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(every)
-    return pred()
-
-
-def _pod_phases(store, names) -> dict:
-    return {
-        n: (store.get("pods", "default", n) or {})
-        .get("status", {}).get("phase")
-        for n in names
-    }
-
-
 def _run(pods: int, lanes: int, seed: int, chaos: bool, timeout: float) -> dict:
     from kwok_tpu.edge.httpclient import HttpKubeClient
-    from kwok_tpu.edge.mockserver import HttpFakeApiserver
     from kwok_tpu.engine import ClusterEngine, EngineConfig
     from kwok_tpu.telemetry.errors import worker_restarts_total
 
-    store = _recording_store()
-    srv = HttpFakeApiserver(store=store).start()
-    url = f"http://127.0.0.1:{srv.port}"
+    srv = MockApiserver()
+    store = srv.store
+    url = srv.url
     names = [f"cs{i}" for i in range(pods)]
     nodes = [f"csn{i}" for i in range(4)]
     kill_targets = ["kwok-lane1", f"kwok-emit{min(2, lanes - 1)}"]
@@ -251,12 +176,11 @@ def _run_restore_storm(
     fault-free baseline with per-key patch order preserved (the repair
     re-patch collapses as a consecutive duplicate)."""
     from kwok_tpu.edge.httpclient import HttpKubeClient
-    from kwok_tpu.edge.mockserver import HttpFakeApiserver
     from kwok_tpu.engine import ClusterEngine, EngineConfig
 
-    store = _recording_store()
-    srv = HttpFakeApiserver(store=store).start()
-    url = f"http://127.0.0.1:{srv.port}"
+    srv = MockApiserver()
+    store = srv.store
+    url = srv.url
     names = [f"cs{i}" for i in range(pods)]
     nodes = [f"csn{i}" for i in range(4)]
     eng = ClusterEngine(
@@ -319,31 +243,15 @@ def _run_restore_storm_native(
     (snapshot via GET /snapshot, rewind via POST /restore). Returns None
     when no C++ compiler is available (the parity twin in
     tests/test_mock_snapshot.py is skipped the same way)."""
-    import signal
-    import subprocess
     import urllib.request
 
-    from kwok_tpu import native
     from kwok_tpu.edge.httpclient import HttpKubeClient
     from kwok_tpu.engine import ClusterEngine, EngineConfig
 
-    binary = native.apiserver_binary()
-    if binary is None:
+    srv = NativeApiserver.spawn()
+    if srv is None:
         return None
-    proc = subprocess.Popen(
-        [binary, "--port", "0"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
-    url = None
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        line = proc.stdout.readline()
-        if "listening on" in line:
-            url = line.rsplit(" ", 1)[-1].strip()
-            break
-    if not url:
-        proc.kill()
-        return None
+    url = srv.url
     names = [f"cs{i}" for i in range(pods)]
     nodes = [f"csn{i}" for i in range(4)]
     client = HttpKubeClient(url)
@@ -386,11 +294,7 @@ def _run_restore_storm_native(
     finally:
         eng.stop()
         client.close()
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=5)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+        srv.stop()
     return out
 
 

@@ -36,18 +36,22 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import signal
-import socket
 import statistics
-import subprocess
 import sys
 import tempfile
 import time
-import urllib.error
-import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+from benchmarks.rig import (  # noqa: E402 (path bootstrap above)
+    EngineProc,
+    MockApiserver,
+    make_node as _make_node,
+    make_pod as _make_pod,
+    pod_phases as _pod_phases,
+    wait_until as _wait,
+)
 
 QUANTUM = 0.25  # --tick-interval: the gate's resume tolerance
 DELAY_S = 8.0  # Pending->Running Stage delay (long vs kill timing)
@@ -79,165 +83,18 @@ spec:
 """
 
 
-def _make_pod(name: str, node: str) -> dict:
-    return {
-        "apiVersion": "v1", "kind": "Pod",
-        "metadata": {"name": name, "namespace": "default"},
-        "spec": {"nodeName": node,
-                 "containers": [{"name": "c", "image": "busybox"}]},
-        "status": {"phase": "Pending"},
-    }
-
-
-def _make_node(name: str) -> dict:
-    return {"apiVersion": "v1", "kind": "Node",
-            "metadata": {"name": name}, "status": {}}
-
-
-def _timed_store():
-    """FakeKube whose pod status patches keep a wall-stamped arrival
-    oplog (server side: pump- and client-delivered writes both land
-    here) — the double-fire and residue-resume oracle."""
-    from kwok_tpu.edge.mockserver import FakeKube
-
-    class TimedStore(FakeKube):
-        def __init__(self):
-            super().__init__()
-            self.oplog: list = []  # (key, phase, wall-seconds)
-
-        def _note(self, kind, namespace, name, patch):
-            if kind != "pods" or not isinstance(patch, dict):
-                return
-            phase = (patch.get("status") or {}).get("phase")
-            if phase:
-                self.oplog.append(
-                    ((namespace or "default", name), phase, time.time())
-                )
-
-        def patch_status(self, kind, namespace, name, patch):
-            self._note(kind, namespace, name, patch)
-            return super().patch_status(kind, namespace, name, patch)
-
-        def patch_status_bytes(self, kind, namespace, name, patch):
-            if isinstance(patch, (bytes, bytearray, memoryview)):
-                patch = json.loads(bytes(patch))
-            self._note(kind, namespace, name, patch)
-            return super().patch_status_bytes(kind, namespace, name, patch)
-
-    return TimedStore()
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _http_status(url: str, timeout: float = 2.0) -> int:
-    try:
-        return urllib.request.urlopen(url, timeout=timeout).status
-    except urllib.error.HTTPError as e:
-        return e.code
-    except Exception:
-        return 0
-
-
-def _scrape(url: str) -> dict:
-    """Flat name{labels} -> float of a /metrics exposition."""
-    out: dict = {}
-    try:
-        text = urllib.request.urlopen(url, timeout=3).read().decode()
-    except Exception:
-        return out
-    for line in text.splitlines():
-        if line.startswith("#") or " " not in line:
-            continue
-        name, _, val = line.rpartition(" ")
-        try:
-            out[name] = float(val)
-        except ValueError:
-            pass
-    return out
-
-
-class Engine:
-    """One real tpukwok process."""
-
-    def __init__(self, master: str, cfg_path: str, ckpt_dir: str):
-        self.port = _free_port()
-        env = {**os.environ,
-               "KWOK_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        # engine output lands in the checkpoint dir: post-mortem evidence
-        # for a failed gate without flooding the bench's own output
-        log_path = os.path.join(ckpt_dir, f"engine-{self.port}.log")
-        self._log = open(log_path, "ab")
-        self.log_path = log_path
-        self.t_spawn = time.time()
-        self.proc = subprocess.Popen(
-            [sys.executable, "-m", "kwok_tpu.kwok",
-             "--config", cfg_path,
-             "--master", master,
-             "--manage-all-nodes", "true",
-             "--tick-interval", str(QUANTUM),
-             "--drain-shards", "2",
-             "--server-address", f"127.0.0.1:{self.port}",
-             "--checkpoint-dir", ckpt_dir,
-             "--checkpoint-interval", str(CKPT_INTERVAL),
-             "--drain-deadline", "30"],
-            env=env, cwd=REPO,
-            stdout=self._log, stderr=subprocess.STDOUT,
-        )
-
-    def wait_ready(self, timeout: float = 120.0) -> float:
-        """Blocks until /readyz answers 200 (the startup catch-up gate —
-        first full re-list + checkpoint reconcile — has closed); returns
-        seconds since spawn."""
-        deadline = time.time() + timeout
-        url = f"http://127.0.0.1:{self.port}/readyz"
-        while time.time() < deadline:
-            if self.proc.poll() is not None:
-                raise RuntimeError(
-                    f"engine died during startup (rc={self.proc.returncode})"
-                )
-            if _http_status(url) == 200:
-                return time.time() - self.t_spawn
-            time.sleep(0.05)
-        raise RuntimeError("engine never became ready")
-
-    def metrics(self) -> dict:
-        return _scrape(f"http://127.0.0.1:{self.port}/metrics")
-
-    def sigkill(self) -> None:
-        self.proc.send_signal(signal.SIGKILL)
-        self.proc.wait(timeout=10)
-
-    def sigterm(self, timeout: float = 40.0) -> int:
-        self.proc.send_signal(signal.SIGTERM)
-        try:
-            return self.proc.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            self.proc.kill()
-            return -9
-
-
-def _wait(pred, timeout: float, every: float = 0.1) -> bool:
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(every)
-    return pred()
-
-
-def _pod_phases(store, names) -> dict:
-    return {
-        n: (store.get("pods", "default", n) or {})
-        .get("status", {}).get("phase")
-        for n in names
-    }
+def _engine(master: str, cfg_path: str, ckpt_dir: str) -> EngineProc:
+    """The crash-gate wiring: multi-lane, checkpointed, bounded drain."""
+    return EngineProc(
+        master, cfg_path, ckpt_dir,
+        extra_args=[
+            "--tick-interval", str(QUANTUM),
+            "--drain-shards", "2",
+            "--checkpoint-dir", ckpt_dir,
+            "--checkpoint-interval", str(CKPT_INTERVAL),
+            "--drain-deadline", "30",
+        ],
+    )
 
 
 def _create_workload(store, names, nodes) -> None:
@@ -252,13 +109,11 @@ def _create_workload(store, names, nodes) -> None:
 
 
 def _run_control(pods: int, cfg_path: str, timeout: float) -> dict:
-    from kwok_tpu.edge.mockserver import HttpFakeApiserver
-
-    store = _timed_store()
-    srv = HttpFakeApiserver(store=store).start()
+    srv = MockApiserver()
+    store = srv.store
     names = [f"rp{i}" for i in range(pods)]
     ckpt = tempfile.mkdtemp(prefix="kwok-restart-ctl-")
-    eng = Engine(f"http://127.0.0.1:{srv.port}", cfg_path, ckpt)
+    eng = _engine(srv.url, cfg_path, ckpt)
     out = {"arm": "control"}
     try:
         out["ready_s"] = round(eng.wait_ready(), 3)
@@ -271,36 +126,28 @@ def _run_control(pods: int, cfg_path: str, timeout: float) -> dict:
         )
         out["converged"] = converged
         out["final_phases"] = _pod_phases(store, names)
-        out["running_patches_per_pod"] = _running_counts(store, names)
+        out["running_patches_per_pod"] = store.phase_counts(
+            "Running", names
+        )
         rc = eng.sigterm()
         out["sigterm_exit"] = rc
     finally:
-        if eng.proc.poll() is None:
-            eng.proc.kill()
+        eng.kill_if_alive()
         srv.stop()
     return out
 
 
-def _running_counts(store, names) -> dict:
-    counts = {n: 0 for n in names}
-    for (ns, name), phase, _t in list(store.oplog):
-        if phase == "Running" and name in counts:
-            counts[name] += 1
-    return counts
-
-
 def _run_crash(pods: int, cfg_path: str, timeout: float) -> dict:
-    from kwok_tpu.edge.mockserver import HttpFakeApiserver
     from kwok_tpu.resilience import checkpoint as ckpt_mod
 
-    store = _timed_store()
-    srv = HttpFakeApiserver(store=store).start()
-    master = f"http://127.0.0.1:{srv.port}"
+    srv = MockApiserver()
+    store = srv.store
+    master = srv.url
     names = [f"rp{i}" for i in range(pods)]
     ckpt_dir = tempfile.mkdtemp(prefix="kwok-restart-")
     ckpt_path = ckpt_mod.checkpoint_path(ckpt_dir, "engine")
     out = {"arm": "crash"}
-    eng1 = Engine(master, cfg_path, ckpt_dir)
+    eng1 = _engine(master, cfg_path, ckpt_dir)
     try:
         out["ready1_s"] = round(eng1.wait_ready(), 3)
         _create_workload(store, names, [f"rn{i}" for i in range(4)])
@@ -331,12 +178,11 @@ def _run_crash(pods: int, cfg_path: str, timeout: float) -> dict:
         eng1.sigkill()
         out["killed_at_wall"] = time.time()
     except Exception:
-        if eng1.proc.poll() is None:
-            eng1.proc.kill()
+        eng1.kill_if_alive()
         srv.stop()
         raise
 
-    eng2 = Engine(master, cfg_path, ckpt_dir)
+    eng2 = _engine(master, cfg_path, ckpt_dir)
     try:
         out["recovery_readyz_s"] = round(eng2.wait_ready(), 3)
         converged = _wait(
@@ -347,7 +193,8 @@ def _run_crash(pods: int, cfg_path: str, timeout: float) -> dict:
         )
         out["converged"] = converged
         out["recovery_to_caught_up_s"] = round(
-            (max((t for _k, _p, t in store.oplog), default=eng2.t_spawn)
+            (max((t for _k, _op, _p, t in store.oplog),
+                 default=eng2.t_spawn)
              - eng2.t_spawn),
             3,
         )
@@ -357,14 +204,13 @@ def _run_crash(pods: int, cfg_path: str, timeout: float) -> dict:
         )
         out["kwok_rv_rewinds_total"] = m.get("kwok_rv_rewinds_total", 0)
         out["final_phases"] = _pod_phases(store, names)
-        out["running_patches_per_pod"] = _running_counts(store, names)
+        out["running_patches_per_pod"] = store.phase_counts(
+            "Running", names
+        )
         # residue-resume oracle: wall fire time minus checkpointed
         # residue must be a constant (the restart anchor) per pod,
         # within one tick quantum
-        fires = {}
-        for (ns, name), phase, t in list(store.oplog):
-            if phase == "Running" and name not in fires:
-                fires[name] = t
+        fires = store.phase_stamps("Running")
         devs = {
             n: fires[n] - residues[n]
             for n in names if n in fires and residues.get(n) is not None
@@ -385,8 +231,7 @@ def _run_crash(pods: int, cfg_path: str, timeout: float) -> dict:
             os.path.getmtime(ckpt_path) >= ckpt_mtime
         )
     finally:
-        if eng2.proc.poll() is None:
-            eng2.proc.kill()
+        eng2.kill_if_alive()
         srv.stop()
     return out
 

@@ -1,0 +1,295 @@
+"""Shared spawn-and-teardown scaffolding for the soak/gate benchmarks.
+
+``chaos_soak.py``, ``restart_soak.py`` and ``watcher_fleet.py`` all drive
+the same shapes: an HTTP mock apiserver (in-process, with a server-side
+oplog oracle), the native C++ apiserver (subprocess), workload object
+factories, converge-polling, and /metrics scraping. This module is the
+single copy; the benchmarks import it instead of re-pasting the rig.
+
+Import side effect free: heavyweights (mockserver, native) are imported
+inside the helpers so `--help` stays instant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------- workload
+
+def make_pod(name: str, node: str) -> dict:
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"nodeName": node,
+                 "containers": [{"name": "c", "image": "busybox"}]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def make_node(name: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name}, "status": {}}
+
+
+def wait_until(pred, timeout: float, every: float = 0.05) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def pod_phases(store, names) -> dict:
+    return {
+        n: (store.get("pods", "default", n) or {})
+        .get("status", {}).get("phase")
+        for n in names
+    }
+
+
+# ------------------------------------------------------- network plumbing
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http_status(url: str, timeout: float = 2.0) -> int:
+    try:
+        return urllib.request.urlopen(url, timeout=timeout).status
+    except urllib.error.HTTPError as e:
+        return e.code
+    except Exception:
+        return 0
+
+
+def scrape_metrics(url: str) -> dict:
+    """Flat ``name{labels}`` -> float of a /metrics exposition."""
+    out: dict = {}
+    try:
+        text = urllib.request.urlopen(url, timeout=3).read().decode()
+    except Exception:
+        return out
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            pass
+    return out
+
+
+# ------------------------------------------------------- oplog mock store
+
+def oplog_store():
+    """A FakeKube whose pod-facing write verbs keep a wall-stamped
+    arrival-order oplog SERVER-side (pump-delivered and client-delivered
+    writes both land here) — the ordering / double-fire / residue-resume
+    oracle the gates read. Entries: ``(key, op, phase-or-None, wall_s)``."""
+    from kwok_tpu.edge.mockserver import FakeKube
+
+    class OplogStore(FakeKube):
+        def __init__(self):
+            super().__init__()
+            self.oplog: list = []  # (key, op, phase|None, wall seconds)
+
+        def _note(self, kind, namespace, name, patch):
+            if kind != "pods" or not isinstance(patch, dict):
+                return
+            phase = (patch.get("status") or {}).get("phase")
+            self.oplog.append(
+                ((namespace or "default", name), "patch", phase, time.time())
+            )
+
+        def patch_status(self, kind, namespace, name, patch):
+            self._note(kind, namespace, name, patch)
+            return super().patch_status(kind, namespace, name, patch)
+
+        def patch_status_bytes(self, kind, namespace, name, patch):
+            if isinstance(patch, (bytes, bytearray, memoryview)):
+                patch = json.loads(bytes(patch))
+            self._note(kind, namespace, name, patch)
+            return super().patch_status_bytes(kind, namespace, name, patch)
+
+        def delete(self, kind, namespace, name, **kw):
+            if kind == "pods":
+                self.oplog.append(
+                    ((namespace or "default", name), "delete", None,
+                     time.time())
+                )
+            return super().delete(kind, namespace, name, **kw)
+
+        def per_key_collapsed(self, key):
+            """The ordering oracle's view: consecutive duplicates collapse
+            (pump whole-frame resend is at-least-once: a request whose
+            response died on the wire is legitimately replayed)."""
+            out = []
+            for k, op, ph, _t in list(self.oplog):
+                if k == key and (not out or out[-1] != (op, ph)):
+                    out.append((op, ph))
+            return out
+
+        def phase_stamps(self, phase: str) -> dict:
+            """First wall stamp per pod for ``phase`` patches (the
+            restart gate's fire-time oracle)."""
+            out: dict = {}
+            for (_ns, name), op, ph, t in list(self.oplog):
+                if op == "patch" and ph == phase and name not in out:
+                    out[name] = t
+            return out
+
+        def phase_counts(self, phase: str, names) -> dict:
+            counts = {n: 0 for n in names}
+            for (_ns, name), op, ph, _t in list(self.oplog):
+                if op == "patch" and ph == phase and name in counts:
+                    counts[name] += 1
+            return counts
+
+    return OplogStore()
+
+
+# ----------------------------------------------------------- apiservers
+
+class MockApiserver:
+    """In-process HTTP mock apiserver bound to a (usually oplog) store."""
+
+    def __init__(self, store=None, **kw):
+        from kwok_tpu.edge.mockserver import HttpFakeApiserver
+
+        self.store = store if store is not None else oplog_store()
+        self.srv = HttpFakeApiserver(store=self.store, **kw).start()
+        self.port = self.srv.port
+        self.url = f"http://127.0.0.1:{self.srv.port}"
+
+    def stop(self) -> None:
+        self.srv.stop()
+
+
+class NativeApiserver:
+    """The C++ mock apiserver as a subprocess. ``spawn()`` returns None
+    when no C++ compiler is available — callers skip or fall back, the
+    same way the parity twins do."""
+
+    @classmethod
+    def spawn(cls, args=(), env=None, timeout: float = 10.0):
+        from kwok_tpu import native
+
+        binary = native.apiserver_binary()
+        if binary is None:
+            return None
+        self = cls.__new__(cls)
+        self.proc = subprocess.Popen(
+            [binary, "--port", "0", *args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=None if env is None else {**os.environ, **env},
+        )
+        self.url = None
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            line = self.proc.stdout.readline()
+            if "listening on" in line:
+                self.url = line.rsplit(" ", 1)[-1].strip()
+                break
+        if not self.url:
+            self.proc.kill()
+            return None
+        return self
+
+    def rss_bytes(self) -> int:
+        """Resident set of the server process (the unbounded-buffer
+        gate's measurement); 0 when unreadable."""
+        try:
+            with open(f"/proc/{self.proc.pid}/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1]) * 1024
+        except (OSError, ValueError, IndexError):
+            pass
+        return 0
+
+    def stop(self, sig=signal.SIGTERM) -> None:
+        self.proc.send_signal(sig)
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+class EngineProc:
+    """One real ``tpukwok`` engine process (the production wiring the
+    restart gate SIGKILLs). Extra CLI args ride through ``extra_args``."""
+
+    def __init__(self, master: str, cfg_path: str, workdir: str,
+                 extra_args=()):
+        self.port = free_port()
+        env = {**os.environ,
+               "KWOK_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        # engine output lands in the workdir: post-mortem evidence for a
+        # failed gate without flooding the bench's own output
+        log_path = os.path.join(workdir, f"engine-{self.port}.log")
+        self._log = open(log_path, "ab")
+        self.log_path = log_path
+        self.t_spawn = time.time()
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "kwok_tpu.kwok",
+             "--config", cfg_path,
+             "--master", master,
+             "--manage-all-nodes", "true",
+             "--server-address", f"127.0.0.1:{self.port}",
+             *extra_args],
+            env=env, cwd=REPO,
+            stdout=self._log, stderr=subprocess.STDOUT,
+        )
+
+    def wait_ready(self, timeout: float = 120.0) -> float:
+        """Blocks until /readyz answers 200 (the startup catch-up gate —
+        first full re-list + checkpoint reconcile — has closed); returns
+        seconds since spawn."""
+        deadline = time.time() + timeout
+        url = f"http://127.0.0.1:{self.port}/readyz"
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"engine died during startup (rc={self.proc.returncode})"
+                )
+            if http_status(url) == 200:
+                return time.time() - self.t_spawn
+            time.sleep(0.05)
+        raise RuntimeError("engine never became ready")
+
+    def metrics(self) -> dict:
+        return scrape_metrics(f"http://127.0.0.1:{self.port}/metrics")
+
+    def sigkill(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def sigterm(self, timeout: float = 40.0) -> int:
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            return self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            return -9
+
+    def kill_if_alive(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()

@@ -20,6 +20,9 @@ make chaos-check
 echo ">> restart-check (SIGKILL + cold-restart crash-durability RTO gate)"
 make restart-check
 
+echo ">> fleet-check (watcher-fleet survival gate: overload admission + slow-watcher eviction)"
+make fleet-check
+
 echo ">> bash syntax"
 find hack test images -name '*.sh' -print0 | xargs -0 -n1 bash -n
 

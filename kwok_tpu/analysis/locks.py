@@ -55,6 +55,13 @@ LOCK_ORDER: dict[str, int] = {
     # lane's stage_lock, a legal 10 -> 84 descent) and the tick thread;
     # nothing is ever acquired under it
     "_ckpt_lock": 84,
+    # apiserver overload admission (ISSUE 8): guards only the per-band
+    # inflight/rejected counters in mockserver._Admission; the band SLOT
+    # is held across the request but the lock is released immediately, so
+    # nothing (store lock included) is ever acquired under it. Level 84 so
+    # holding it into a level-85 leaf (the store's _lock, a registry
+    # child) would be an order violation, not an unordered pair.
+    "_adm_lock": 84,
     "_lock": 85,        # single-resource leaves (ippool, registry, ...)
     "_apiserver_lock": 85,
     "_audit_lock": 95,  # mockserver audit ring, below the store lock

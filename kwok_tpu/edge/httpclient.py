@@ -29,7 +29,11 @@ from typing import Iterator
 
 import yaml
 
-from kwok_tpu.edge.kubeclient import TooLargeResourceVersion, WatchEvent
+from kwok_tpu.edge.kubeclient import (
+    TooLargeResourceVersion,
+    TooManyRequests,
+    WatchEvent,
+)
 from kwok_tpu.telemetry.errors import swallowed
 
 logger = logging.getLogger("kwok_tpu.edge.http")
@@ -257,6 +261,16 @@ class HttpKubeClient:
                     raise
         if status == 404:
             return None
+        if status == 429:
+            # a max-inflight band is saturated: typed so callers throttle
+            # by the server's Retry-After hint (never a blind hot retry)
+            try:
+                ra = float(resp.getheader("Retry-After") or 1)
+            except ValueError:
+                ra = 1.0
+            raise TooManyRequests(
+                payload.decode(errors="replace"), retry_after=ra
+            )
         if status >= 400:
             raise urllib.error.HTTPError(
                 url, status, payload.decode(errors="replace"), None, None
@@ -391,6 +405,21 @@ class _HttpWatch:
         try:
             self._resp = client._request("GET", url, timeout=3600.0)
         except urllib.error.HTTPError as e:
+            if e.code == 429:
+                # watch handshake rejected by a saturated max-inflight
+                # band: typed, so the reconnect loop throttles by the
+                # server's hint instead of hammering the handshake
+                try:
+                    ra = float(
+                        (e.headers.get("Retry-After") if e.headers else None)
+                        or 1
+                    )
+                except ValueError:
+                    ra = 1.0
+                body = e.read() if hasattr(e, "read") else b""
+                raise TooManyRequests(
+                    body.decode(errors="replace"), retry_after=ra
+                ) from e
             # a resume AHEAD of the server's store fails the watch
             # handshake with 504 + a ResourceVersionTooLarge cause
             # (storage.NewTooLargeResourceVersionError); surface it typed

@@ -55,6 +55,20 @@ class TooLargeResourceVersion(Exception):
         self.retry_after = float(retry_after)
 
 
+class TooManyRequests(Exception):
+    """HTTP 429: one of the apiserver's max-inflight bands is saturated
+    (kube-apiserver --max-requests-inflight /
+    --max-mutating-requests-inflight rejection; KEP-1040 semantics).
+    Carries the server's Retry-After hint — callers THROTTLE through the
+    shared RetryPolicy (sleep at least ``retry_after``) and retry; they
+    never hammer, and other HTTP statuses stay non-retryable."""
+
+    def __init__(self, message: str = "Too many requests",
+                 retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
 class WatchHandle(Protocol):
     def __iter__(self) -> Iterator[WatchEvent]: ...
     def stop(self) -> None: ...

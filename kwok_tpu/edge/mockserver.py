@@ -25,10 +25,12 @@ import os
 import queue
 import re
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator
 
+from kwok_tpu.telemetry.apiserver_metrics import render_apiserver_metrics
 from kwok_tpu.telemetry.errors import swallowed
 from kwok_tpu.edge.kubeclient import (
     ADDED,
@@ -71,6 +73,11 @@ class _Watch:
         self.stopped = False
         #: opted into periodic BOOKMARK events (allowWatchBookmarks=true)
         self.bookmarks = False
+        #: set to the reason ("slow") when the SERVER closed this watch
+        #: because its bounded send buffer overflowed — the HTTP facade
+        #: closes the connection at the current event boundary instead of
+        #: letting a consumer that stopped reading pin unbounded memory
+        self.terminated: "str | None" = None
 
     def _matches(self, obj: dict) -> bool:
         if not match_field_selector(obj, self.field_selector):
@@ -123,6 +130,39 @@ EVENTS_CAP = int(os.environ.get("KWOK_TPU_EVENTS_CAP", "4096"))
 # apiserver.cc; same env override.
 RV_WINDOW = int(os.environ.get("KWOK_TPU_RV_WINDOW", "4096"))
 
+# bounded per-watcher send buffer: a consumer that stops reading has its
+# watch TERMINATED (connection closed at the current event boundary,
+# kwok_watch_terminations_total{reason="slow"}) once this many events are
+# queued, instead of growing the queue without bound — the watch cache's
+# slow-consumer termination; the client recovers by resuming/re-listing
+# (the same expiry-class path a 410 takes). <= 0 disables the cap.
+# Mirrored by apiserver.cc; same env override. The resume replay a fresh
+# watch receives is exempt (it is bounded by RV_WINDOW already — capping
+# it would terminate every resume whose gap exceeds the backlog, a loop).
+WATCH_BACKLOG = int(os.environ.get("KWOK_TPU_WATCH_BACKLOG", "16384"))
+
+# Two-band max-inflight admission (kube-apiserver --max-requests-inflight /
+# --max-mutating-requests-inflight, KEP-1040's predecessor knobs): when a
+# band is saturated the server answers 429 + Retry-After instead of
+# queueing unboundedly. 0 disables a band (the default: zero admission
+# cost when unconfigured). Watches are long-running and exempt, like the
+# real apiserver's longRunningRequestCheck; they are bounded by
+# WATCH_BACKLOG instead. Mirrored by apiserver.cc; same env overrides.
+MAX_INFLIGHT = int(os.environ.get("KWOK_TPU_MAX_INFLIGHT", "0"))
+MAX_MUTATING_INFLIGHT = int(
+    os.environ.get("KWOK_TPU_MAX_MUTATING_INFLIGHT", "0")
+)
+
+# The 429 dialect, byte-identical across both servers (parity-pinned):
+# kube-apiserver's TooManyRequests Status plus a Retry-After hint the
+# client's RetryPolicy must honor (throttle, never hammer).
+RETRY_AFTER_SECONDS = "1"
+TOO_MANY_REQUESTS_BODY = (
+    b'{"kind":"Status","apiVersion":"v1","status":"Failure",'
+    b'"message":"Too many requests, please try again later.",'
+    b'"reason":"TooManyRequests","code":429}'
+)
+
 # BOOKMARK cadence for opted-in watches (allowWatchBookmarks=true): a
 # periodic event carrying only metadata.resourceVersion so a QUIET watch's
 # resume revision keeps advancing and compaction can't strand it into a
@@ -172,6 +212,13 @@ class FakeKube:
         # observability for tests
         self.patch_count = 0
         self.delete_count = 0
+        # bounded per-watcher send buffers (slow-consumer termination);
+        # instance attr so tests/parity twins can tighten it per store
+        self.watch_backlog = WATCH_BACKLOG
+        # kwok_watch_terminations_total{reason=}: ints bumped under the
+        # store lock (a registry child lock here would nest two level-85
+        # leaves); /metrics renders them via telemetry.apiserver_metrics
+        self.watch_terminations = {"slow": 0, "deadline": 0}
 
     # -- helpers ------------------------------------------------------------
 
@@ -205,6 +252,34 @@ class FakeKube:
             self._json[kind][key] = b
         return b
 
+    def count_termination(self, reason: str) -> None:
+        """Record a server-side watch close (slow-consumer overflow or
+        timeoutSeconds expiry) for /metrics."""
+        with self._lock:
+            self.watch_terminations[reason] = (
+                self.watch_terminations.get(reason, 0) + 1
+            )
+
+    def _push(self, w: _Watch, ev: WatchEvent) -> None:
+        """Queue one event on a live watch, terminating the watch instead
+        when its bounded send buffer is full (caller holds the lock). The
+        backlog is dropped NOW — draining it into a stalled socket would
+        pin the very memory the cap bounds; the client re-lists/resumes,
+        the same recovery as a 410."""
+        bl = self.watch_backlog
+        if bl > 0 and w.q.qsize() >= bl:
+            w.terminated = "slow"
+            w.stopped = True
+            self.watch_terminations["slow"] += 1
+            try:
+                while True:
+                    w.q.get_nowait()
+            except queue.Empty:
+                pass
+            w.q.put(None)
+            return
+        w.q.put(ev)
+
     def _emit(self, kind: str, type_: str, obj: dict, key=None) -> None:
         if RV_WINDOW > 0:
             # ring position is the store clock (self._rv); snapshots are
@@ -226,7 +301,7 @@ class FakeKube:
             if w.stopped or w.kind != kind:
                 continue
             if w._matches(obj):
-                w.q.put(WatchEvent(type_, copy.deepcopy(obj)))
+                self._push(w, WatchEvent(type_, copy.deepcopy(obj)))
 
     def compact(self) -> int:
         """Force watch-cache compaction NOW: any watch resuming from a
@@ -262,7 +337,7 @@ class FakeKube:
                     )
                     else "v1"
                 )
-                w.q.put(WatchEvent(BOOKMARK, {
+                self._push(w, WatchEvent(BOOKMARK, {
                     "kind": KIND_SINGULAR.get(w.kind, "Object"),
                     "apiVersion": api,
                     "metadata": {"resourceVersion": rv},
@@ -1034,6 +1109,55 @@ def _too_large_rv_status(e: TooLargeResourceVersion) -> dict:
     }
 
 
+class _Admission:
+    """Two-band max-inflight admission (kube-apiserver's
+    --max-requests-inflight bands, KEP-1040's reject-don't-queue shape).
+
+    A slot is held for the request's full lifetime — including reading
+    its body and writing its response — so a band saturates exactly when
+    that many requests are genuinely in flight. ``_adm_lock`` guards only
+    the counters and nothing is ever acquired under it (kwoklint level
+    84, documented in docs/static-analysis.md)."""
+
+    def __init__(self, readonly_max: int, mutating_max: int) -> None:
+        self.limits = {"readonly": readonly_max, "mutating": mutating_max}
+        self.inflight = {"readonly": 0, "mutating": 0}
+        self.rejected = {"readonly": 0, "mutating": 0}
+        self._adm_lock = threading.Lock()
+
+    def try_acquire(self, band: str) -> bool:
+        with self._adm_lock:
+            limit = self.limits[band]
+            if limit > 0 and self.inflight[band] >= limit:
+                self.rejected[band] += 1
+                return False
+            self.inflight[band] += 1
+            return True
+
+    def release(self, band: str) -> None:
+        with self._adm_lock:
+            self.inflight[band] -= 1
+
+
+def _admission_band(method: str, path: str, query: str) -> "str | None":
+    """The max-inflight band a request is admitted through, or None when
+    exempt. Resource requests only (like the real apiserver: /healthz,
+    /metrics, discovery and the snapshot/restore/compact ops hooks stay
+    outside); watches are long-running and exempt
+    (longRunningRequestCheck), bounded by the per-watcher send buffer
+    instead."""
+    if method == "GET":
+        if not _match_path(path):
+            return None
+        q = urllib.parse.parse_qs(query)
+        if (q.get("watch") or ["false"])[0] in ("true", "1"):
+            return None
+        return "readonly"
+    if method in ("POST", "PATCH", "DELETE") and _match_path(path):
+        return "mutating"
+    return None
+
+
 class _HandshakeFailed(Exception):
     """TLS handshake rejected/timed out — normal under mTLS (cert-less
     dials, mis-scheme probes); closed quietly, no traceback."""
@@ -1064,8 +1188,20 @@ class HttpFakeApiserver:
         tls_cert_file: str | None = None,
         tls_key_file: str | None = None,
         client_ca_file: str | None = None,
+        max_inflight: int | None = None,
+        max_mutating_inflight: int | None = None,
     ) -> None:
         self.store = store or FakeKube()
+        # two-band overload admission; None falls back to the env knobs
+        # (KWOK_TPU_MAX_INFLIGHT / KWOK_TPU_MAX_MUTATING_INFLIGHT). Both
+        # bands off => no admission object, zero per-request cost.
+        ro = MAX_INFLIGHT if max_inflight is None else int(max_inflight)
+        mu = (
+            MAX_MUTATING_INFLIGHT
+            if max_mutating_inflight is None
+            else int(max_mutating_inflight)
+        )
+        self._admission = _Admission(ro, mu) if (ro > 0 or mu > 0) else None
         # bearer-token authentication (kube-apiserver --token-auth-file):
         # when set, every request except /healthz must carry one of the
         # accepted tokens. The real apiserver accepts every row of the CSV,
@@ -1283,13 +1419,73 @@ class HttpFakeApiserver:
                 )
                 return False
 
+            def _reject_429(self):
+                """Band saturated: 429 + Retry-After (never queue). The
+                unread body is drained first so the next request on this
+                keep-alive connection parses cleanly."""
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    self.rfile.read(n)
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", RETRY_AFTER_SECONDS)
+                self.send_header(
+                    "Content-Length", str(len(TOO_MANY_REQUESTS_BODY))
+                )
+                self.end_headers()
+                self.wfile.write(TOO_MANY_REQUESTS_BODY)
+
+            def _admitted(self, impl):
+                """Run one request through max-inflight admission. The
+                slot spans the request's whole lifetime (body read
+                included — that is what makes saturation observable);
+                exempt requests (watches, non-resource paths) and
+                unconfigured servers skip straight through."""
+                adm = server_obj._admission
+                if adm is None:
+                    return impl()
+                parsed = urllib.parse.urlparse(self.path)
+                band = _admission_band(
+                    self.command or "", parsed.path, parsed.query
+                )
+                if band is None:
+                    return impl()
+                if not adm.try_acquire(band):
+                    self._reject_429()
+                    return
+                try:
+                    impl()
+                finally:
+                    adm.release(band)
+
             def do_GET(self):  # noqa: N802
+                self._admitted(self._do_get)
+
+            def _do_get(self):
                 parsed = urllib.parse.urlparse(self.path)
                 if parsed.path == "/healthz":
                     self.send_response(200)
                     self.send_header("Content-Length", "2")
                     self.end_headers()
                     self.wfile.write(b"ok")
+                    return
+                if parsed.path == "/metrics":
+                    # overload-protection surface (anonymous, like
+                    # /healthz): inflight per band, 429 rejections, watch
+                    # terminations — scraped by the watcher-fleet gate
+                    adm = server_obj._admission
+                    body = render_apiserver_metrics(
+                        adm.inflight if adm else {},
+                        adm.rejected if adm else {},
+                        store.watch_terminations,
+                    )
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 if not self._authorized():
                     return
@@ -1324,12 +1520,27 @@ class HttpFakeApiserver:
                     return
                 fs = (q.get("fieldSelector") or [None])[0]
                 ls = (q.get("labelSelector") or [None])[0]
+                # request deadline (ListOptions.timeoutSeconds): live on
+                # watch streams (clean close at an event boundary when it
+                # expires); vacuously honored on LIST — list handlers
+                # never queue (admission rejects with 429 instead) and
+                # serve synchronously, so the deadline cannot expire
+                # mid-request. Non-numeric values are ignored, matching
+                # the C++ mirror's atof. Parity-pinned in
+                # tests/test_native_apiserver.py.
+                try:
+                    timeout_s = float(
+                        (q.get("timeoutSeconds") or ["0"])[0] or 0
+                    )
+                except ValueError:
+                    timeout_s = 0.0
                 if (q.get("watch") or ["false"])[0] in ("true", "1"):
                     self._stream_watch(
                         kind, fs, ls,
                         (q.get("resourceVersion") or [None])[0],
                         (q.get("allowWatchBookmarks") or ["false"])[0]
                         in ("true", "1"),
+                        timeout_s,
                     )
                     return
                 try:
@@ -1356,7 +1567,9 @@ class HttpFakeApiserver:
                     return
                 self._send_body(body)
 
-            def _stream_watch(self, kind, fs, ls, rv, bookmarks=False):
+            def _stream_watch(
+                self, kind, fs, ls, rv, bookmarks=False, timeout_s=0.0
+            ):
                 try:
                     w = store.watch(
                         kind, field_selector=fs, label_selector=ls,
@@ -1404,8 +1617,38 @@ class HttpFakeApiserver:
                 # wfile is fully buffered (wbufsize): push the headers out
                 # now or the client blocks until the first event arrives
                 self.wfile.flush()
+                deadline = (
+                    time.monotonic() + timeout_s if timeout_s > 0 else None
+                )
                 try:
-                    for ev in w:
+                    while True:
+                        if deadline is None:
+                            ev = w.q.get()
+                        else:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                ev = False  # sentinel: deadline expired
+                            else:
+                                try:
+                                    ev = w.q.get(timeout=remaining)
+                                except queue.Empty:
+                                    ev = False
+                        if ev is False:
+                            # timeoutSeconds expiry: the real apiserver
+                            # ENDS the watch cleanly (terminal chunk) at
+                            # an event boundary; the client resumes from
+                            # its last revision
+                            store.count_termination("deadline")
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+                            break
+                        if ev is None:
+                            # stream stopped server-side. A slow-consumer
+                            # termination closes the connection abruptly
+                            # (no terminal chunk — the backlog is already
+                            # dropped; the client re-lists, 410-class
+                            # recovery), same as shutdown/restore closes.
+                            break
                         line = json.dumps(
                             {"type": ev.type, "object": ev.object},
                             separators=(",", ":"),
@@ -1416,8 +1659,12 @@ class HttpFakeApiserver:
                     pass
                 finally:
                     w.stop()
+                self.close_connection = True
 
             def do_PATCH(self):  # noqa: N802
+                self._admitted(self._do_patch)
+
+            def _do_patch(self):
                 if not self._authorized():
                     return
                 parsed = urllib.parse.urlparse(self.path)
@@ -1441,6 +1688,9 @@ class HttpFakeApiserver:
                     self._send_body(body)
 
             def do_DELETE(self):  # noqa: N802
+                self._admitted(self._do_delete)
+
+            def _do_delete(self):
                 if not self._authorized():
                     return
                 parsed = urllib.parse.urlparse(self.path)
@@ -1461,6 +1711,9 @@ class HttpFakeApiserver:
                 self._send_json({"kind": "Status", "status": "Success"})
 
             def do_POST(self):  # noqa: N802 (test convenience: create)
+                self._admitted(self._do_post)
+
+            def _do_post(self):
                 if not self._authorized():
                     return
                 parsed = urllib.parse.urlparse(self.path)
@@ -1569,6 +1822,18 @@ def main(argv=None) -> int:
         help="CSV token file (token,user,uid[,groups]) as kube-apiserver's "
         "--token-auth-file; requests without the token get 401",
     )
+    p.add_argument(
+        "--max-inflight", type=int, default=None,
+        help="max concurrent LIST/GET requests before answering 429 + "
+        "Retry-After (kube-apiserver --max-requests-inflight; 0 = off, "
+        "default from KWOK_TPU_MAX_INFLIGHT)",
+    )
+    p.add_argument(
+        "--max-mutating-inflight", type=int, default=None,
+        help="max concurrent POST/PATCH/DELETE requests before 429 "
+        "(kube-apiserver --max-mutating-requests-inflight; 0 = off, "
+        "default from KWOK_TPU_MAX_MUTATING_INFLIGHT)",
+    )
     p.add_argument("--tls-cert-file", default="",
                    help="serve HTTPS with this certificate")
     p.add_argument("--tls-private-key-file", default="")
@@ -1594,6 +1859,8 @@ def main(argv=None) -> int:
         tls_cert_file=args.tls_cert_file or None,
         tls_key_file=args.tls_private_key_file or None,
         client_ca_file=args.client_ca_file or None,
+        max_inflight=args.max_inflight,
+        max_mutating_inflight=args.max_mutating_inflight,
     )
     if args.data_file:
         try:

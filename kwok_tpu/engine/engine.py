@@ -38,6 +38,7 @@ from kwok_tpu.edge.kubeclient import (
     DELETED,
     KubeClient,
     TooLargeResourceVersion,
+    TooManyRequests,
     WatchExpired,
 )
 from kwok_tpu.edge.merge import node_status_patch_needed, pod_status_patch_needed
@@ -1452,6 +1453,21 @@ class ClusterEngine:
                 except WatchExpired:
                     resume_rv = 0
                     expiry_pace()
+                except TooManyRequests as e:
+                    # a saturated max-inflight band rejected the list/
+                    # handshake: throttle by AT LEAST the server's
+                    # Retry-After hint (riding the shared backoff so a
+                    # persistently-saturated server still converges to
+                    # the policy ceiling) — never a hot retry
+                    if not self._running:
+                        return
+                    delay = max(backoff.next_delay() or 0.0, e.retry_after)
+                    self.telemetry.add_throttle(delay)
+                    logger.warning(
+                        "watch %s throttled by apiserver (429); "
+                        "retrying in %.2fs", kind, delay,
+                    )
+                    backoff.sleep(delay, lambda: not self._running)
                 except Exception as e:  # re-watch with backoff
                     if not self._running:
                         return
@@ -3052,6 +3068,27 @@ class ClusterEngine:
             try:
                 fn(*args)
                 return
+            except TooManyRequests as e:
+                # 429 from a saturated max-inflight band: retryable, but
+                # THROTTLED — sleep at least the server's Retry-After
+                # hint under the shared policy deadline. Other HTTP
+                # statuses stay definitive answers (never retried).
+                if not self._running:
+                    self._inc("patch_errors_total")
+                    return
+                if backoff is None:
+                    backoff = PATCH_RETRY.session()
+                delay = backoff.next_delay()
+                if delay is None:  # policy deadline: give up
+                    self._inc("patch_errors_total")
+                    logger.warning(
+                        "patch job still throttled (429) past the retry "
+                        "deadline; giving up"
+                    )
+                    return
+                delay = max(delay, e.retry_after)
+                self.telemetry.add_throttle(delay)
+                backoff.sleep(delay, lambda: not self._running)
             except Exception as e:
                 if not (self._running and self._transient(e)):
                     self._inc("patch_errors_total")

@@ -786,6 +786,25 @@ static EntryPtr publish(JVal obj) {
   return e;
 }
 
+// bounded per-watcher send buffer: a consumer that stops reading has its
+// watch TERMINATED (kwok_watch_terminations_total{reason="slow"}, the
+// watch cache's slow-consumer termination) instead of pinning unbounded
+// memory; the client re-lists/resumes (410-class recovery). Mirrors
+// mockserver.py WATCH_BACKLOG; same env override; <= 0 disables the cap.
+static long watch_backlog() {
+  static const long bl = [] {
+    const char* v = getenv("KWOK_TPU_WATCH_BACKLOG");
+    return v && *v ? atol(v) : 16384L;
+  }();
+  return bl;
+}
+
+// kwok_watch_terminations_total{reason=}: slow-consumer closes happen in
+// Watch::push (no App pointer there), timeoutSeconds expiries in the
+// writer loop; one store per process, so file-scope atomics suffice.
+static std::atomic<long> g_watch_term_slow{0};
+static std::atomic<long> g_watch_term_deadline{0};
+
 struct Watch {
   int kind;  // 0 nodes, 1 pods
   std::string field_sel;
@@ -796,23 +815,37 @@ struct Watch {
   bool closed = false;
   // opted into periodic BOOKMARK events (allowWatchBookmarks=true)
   bool bookmarks = false;
-
-  // A consumer that stops reading must not pin unbounded memory: past the
-  // cap the watch closes and the client re-lists (410-Gone semantics).
-  static constexpr size_t MAX_BACKLOG = 2'000'000;
+  // set when the server closed this watch because the consumer stopped
+  // reading (the writer distinguishes it from a shutdown close)
+  bool terminated_slow = false;
 
   void push(std::shared_ptr<const std::string> ev) {
     {
       std::lock_guard<std::mutex> lk(mu);
       if (closed) return;
-      if (q.size() >= MAX_BACKLOG) {
+      long cap = watch_backlog();
+      if (cap > 0 && (long)q.size() >= cap) {
         // client must re-list; drop the backlog NOW — draining it into a
         // stalled socket would pin the very memory this cap bounds
         closed = true;
+        terminated_slow = true;
+        g_watch_term_slow.fetch_add(1);
         q.clear();
       } else {
         q.push_back(std::move(ev));
       }
+    }
+    cv.notify_one();
+  }
+  // resume replay (watch-cache gap): exempt from the backlog cap — the
+  // gap is bounded by rv_window() already, and capping it would
+  // terminate every resume whose gap exceeds the backlog (a loop).
+  // Called before the watch is registered, so no reader races it.
+  void push_replay(std::shared_ptr<const std::string> ev) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (closed) return;
+      q.push_back(std::move(ev));
     }
     cv.notify_one();
   }
@@ -1072,6 +1105,13 @@ struct Request {
   std::string body;
   std::string auth;     // Authorization header (bearer-token authn)
   bool close = false;   // Connection: close
+  // body handling is split from header parsing so max-inflight admission
+  // can hold a band slot ACROSS the body read (a request is in flight
+  // from its headers on, like the real apiserver's filter chain) and a
+  // rejected request can still drain its body to keep the keep-alive
+  // pipeline parseable
+  size_t content_len = 0;
+  bool body_read = false;
 };
 
 static bool send_all(int fd, const char* data, size_t n) {
@@ -1157,11 +1197,24 @@ static bool read_request(ConnIO& io, Request& req) {
       if (v == "close") req.close = true;
     }
   }
-  size_t total = hdr_end + 4 + content_len;  // absolute index into io.in
+  req.content_len = content_len;
+  req.body.clear();
+  req.body_read = false;
+  io.off = hdr_end + 4;  // body bytes are consumed by read_body
+  return true;
+}
+
+// Completes a request by reading its body off the pipeline (must be
+// called exactly once per read_request before the next read_request, or
+// the pipeline would parse body bytes as the next request's headers).
+static bool read_body(ConnIO& io, Request& req) {
+  if (req.body_read) return true;
+  req.body_read = true;
+  size_t total = io.off + req.content_len;
   while (io.in.size() < total) {
     if (!io.fill()) return false;
   }
-  req.body = io.in.substr(hdr_end + 4, content_len);
+  req.body = io.in.substr(io.off, req.content_len);
   io.off = total;
   if (io.off == io.in.size()) {
     io.in.clear();
@@ -1175,17 +1228,20 @@ static bool read_request(ConnIO& io, Request& req) {
 
 // Queues one response on the connection's out-buffer; flushed in one send
 // when the request pipeline drains (ConnIO::fill) or past the size cap.
-static bool queue_response(ConnIO& io, int code, const std::string& body) {
+static bool queue_response(ConnIO& io, int code, const std::string& body,
+                           const char* extra_headers = "",
+                           const char* content_type = "application/json") {
   const char* reason = code == 200   ? "OK"
                        : code == 201 ? "Created"
                        : code == 401 ? "Unauthorized"
                        : code == 404 ? "Not Found"
+                       : code == 429 ? "Too Many Requests"
                                      : "Error";
-  char head[256];
+  char head[384];
   int hn = snprintf(head, sizeof head,
-                    "HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
+                    "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n%s"
                     "Content-Length: %zu\r\n\r\n",
-                    code, reason, body.size());
+                    code, reason, content_type, extra_headers, body.size());
   io.out.append(head, hn);
   io.out += body;
   // bound queued-response memory (large LIST pages): flush early
@@ -1331,6 +1387,14 @@ static const std::pair<const char*, const char*> DISCOVERY_DOCS[] = {
 
 // ------------------------------------------------------------------ app
 
+// The 429 dialect, byte-identical to mockserver.py TOO_MANY_REQUESTS_BODY
+// (parity-pinned): kube-apiserver's TooManyRequests Status plus a
+// Retry-After hint the client's RetryPolicy must honor.
+static const char* TOO_MANY_REQUESTS_BODY =
+    "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":\"Failure\","
+    "\"message\":\"Too many requests, please try again later.\","
+    "\"reason\":\"TooManyRequests\",\"code\":429}";
+
 struct App {
   Store store;
   std::mutex audit_mu;
@@ -1341,10 +1405,20 @@ struct App {
   std::set<std::string> auth_tokens;
   int listen_fd = -1;
   std::atomic<bool> stopping{false};
+  // two-band max-inflight admission (kube-apiserver
+  // --max-requests-inflight / --max-mutating-requests-inflight; KEP-1040
+  // reject-don't-queue shape). 0 = band off (the default: the admission
+  // branch is never entered, zero per-request cost). Index 0 = readonly
+  // (LIST/GET), 1 = mutating (POST/PATCH/DELETE); watches are
+  // long-running and exempt, bounded by watch_backlog() instead.
+  long max_inflight_band[2] = {0, 0};
+  std::atomic<long> inflight[2] = {{0}, {0}};
+  std::atomic<long> rejected[2] = {{0}, {0}};
 
   void audit_line(const std::string& method, const std::string& uri, int code);
   void handle_conn(int fd);
   bool handle_request(ConnIO& io, Request& req);
+  std::string metrics_text();
   std::string snapshot_dump();
   void restore_load(const JVal& data);
   void seed_rbac();
@@ -1393,6 +1467,37 @@ void App::audit_line(const std::string& method, const std::string& uri,
   std::lock_guard<std::mutex> lk(audit_mu);
   fwrite(line.data(), 1, line.size(), audit);
   fflush(audit);
+}
+
+std::string App::metrics_text() {
+  // overload-protection surface, HELP text byte-identical to
+  // kwok_tpu/telemetry/apiserver_metrics.py (both servers scrape alike)
+  static const char* BANDS[2] = {"readonly", "mutating"};
+  std::string out;
+  out +=
+      "# HELP kwok_apiserver_inflight Requests currently admitted per "
+      "max-inflight band (readonly=LIST/GET, mutating=POST/PATCH/DELETE; "
+      "watches exempt)\n# TYPE kwok_apiserver_inflight gauge\n";
+  for (int b = 0; b < 2; b++)
+    out += "kwok_apiserver_inflight{band=\"" + std::string(BANDS[b]) +
+           "\"} " + std::to_string(inflight[b].load()) + "\n";
+  out +=
+      "# HELP kwok_apiserver_rejected_total Requests rejected with 429 + "
+      "Retry-After because the band's max-inflight limit was saturated\n"
+      "# TYPE kwok_apiserver_rejected_total counter\n";
+  for (int b = 0; b < 2; b++)
+    out += "kwok_apiserver_rejected_total{band=\"" + std::string(BANDS[b]) +
+           "\"} " + std::to_string(rejected[b].load()) + "\n";
+  out +=
+      "# HELP kwok_watch_terminations_total Watch streams closed by the "
+      "server (slow=send-buffer overflow from a consumer that stopped "
+      "reading, deadline=timeoutSeconds expiry)\n"
+      "# TYPE kwok_watch_terminations_total counter\n";
+  out += "kwok_watch_terminations_total{reason=\"slow\"} " +
+         std::to_string(g_watch_term_slow.load()) + "\n";
+  out += "kwok_watch_terminations_total{reason=\"deadline\"} " +
+         std::to_string(g_watch_term_deadline.load()) + "\n";
+  return out;
 }
 
 std::string App::snapshot_dump() {
@@ -1553,9 +1658,11 @@ bool App::handle_request(ConnIO& io, Request& req) {
   std::string uri = req.path;
   if (!req.query.empty()) uri += "?" + req.query;
 
-  auto respond = [&](int code, const std::string& body) {
+  auto respond = [&](int code, const std::string& body,
+                     const char* extra = "",
+                     const char* ctype = "application/json") {
     audit_line(req.method, uri, code);
-    bool ok = queue_response(io, code, body);
+    bool ok = queue_response(io, code, body, extra, ctype);
     if (req.close) {
       io.flush();
       return false;
@@ -1563,8 +1670,47 @@ bool App::handle_request(ConnIO& io, Request& req) {
     return ok;
   };
 
+  // ---- max-inflight admission (two bands; watches + non-resource paths
+  // exempt). The band slot spans the request's whole lifetime — body read
+  // included — so saturation is observable; a rejected request answers
+  // 429 + Retry-After NOW instead of queueing, after draining its body so
+  // the keep-alive pipeline stays parseable.
+  int band = -1;
+  if (max_inflight_band[0] > 0 || max_inflight_band[1] > 0) {
+    PathMatch am = match_path(req.path);
+    if (am.ok) {
+      if (req.method == "GET") {
+        auto wq = q.find("watch");
+        bool is_watch =
+            wq != q.end() && (wq->second == "true" || wq->second == "1");
+        if (!is_watch) band = 0;
+      } else if (req.method == "POST" || req.method == "PATCH" ||
+                 req.method == "DELETE") {
+        band = 1;
+      }
+    }
+  }
+  struct SlotRelease {
+    std::atomic<long>* c = nullptr;
+    ~SlotRelease() {
+      if (c) c->fetch_sub(1);
+    }
+  } slot;
+  if (band >= 0 && max_inflight_band[band] > 0) {
+    if (inflight[band].fetch_add(1) + 1 > max_inflight_band[band]) {
+      inflight[band].fetch_sub(1);
+      rejected[band].fetch_add(1);
+      if (!read_body(io, req)) return false;  // drain for keep-alive
+      return respond(429, TOO_MANY_REQUESTS_BODY, "Retry-After: 1\r\n");
+    }
+    slot.c = &inflight[band];
+  }
+  if (!read_body(io, req)) return false;
+
   if (req.method == "GET" && req.path == "/healthz")
     return respond(200, "ok");
+  if (req.method == "GET" && req.path == "/metrics")
+    return respond(200, metrics_text(), "", "text/plain; version=0.0.4");
   // bearer-token authn (--token-auth-file): /healthz stays anonymous (the
   // components' --authorization-always-allow-paths contract)
   if (!auth_tokens.empty() &&
@@ -1703,6 +1849,12 @@ bool App::handle_request(ConnIO& io, Request& req) {
       w->kind = m.kind;
       w->field_sel = fs;
       w->label_sel = LabelSel::parse(lsq);
+      // request deadline (ListOptions.timeoutSeconds): the stream ends
+      // CLEANLY (terminal chunk) at the first event boundary past it;
+      // non-numeric values parse to 0 = no deadline (atof; the Python
+      // mirror ignores unparseable values the same way)
+      double timeout_s =
+          q.count("timeoutSeconds") ? atof(q["timeoutSeconds"].c_str()) : 0;
       if (q.count("allowWatchBookmarks")) {
         const std::string& ab = q["allowWatchBookmarks"];
         w->bookmarks = (ab == "true" || ab == "1");
@@ -1742,7 +1894,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
               if (h.rv <= wrv || h.kind != m.kind) continue;
               if (!match_field_selector(h.e->obj, fs)) continue;
               if (!w->label_sel.matches(h.e->obj)) continue;
-              w->push(Store::event_line(h.type.c_str(), h.e));
+              w->push_replay(Store::event_line(h.type.c_str(), h.e));
             }
           }
         }
@@ -1789,11 +1941,28 @@ bool App::handle_request(ConnIO& io, Request& req) {
       // a top apiserver CPU term.
       std::vector<std::shared_ptr<const std::string>> evs;
       std::string out;
+      auto wdeadline =
+          std::chrono::steady_clock::now() +
+          std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+              std::chrono::duration<double>(timeout_s > 0 ? timeout_s : 0));
+      bool deadline_expired = false;
       while (alive && !stopping.load()) {
+        if (timeout_s > 0 && std::chrono::steady_clock::now() >= wdeadline) {
+          deadline_expired = true;  // event boundary: batch fully sent
+          break;
+        }
         evs.clear();
         {
           std::unique_lock<std::mutex> lk(w->mu);
-          w->cv.wait(lk, [&] { return w->closed || !w->q.empty(); });
+          auto ready = [&] { return w->closed || !w->q.empty(); };
+          if (timeout_s > 0) {
+            if (!w->cv.wait_until(lk, wdeadline, ready)) {
+              deadline_expired = true;
+              break;
+            }
+          } else {
+            w->cv.wait(lk, ready);
+          }
           if (w->closed && w->q.empty()) break;
           size_t take_bytes = 0;
           // cap the batch by BYTES, not events: one send buffer must stay
@@ -1814,6 +1983,13 @@ bool App::handle_request(ConnIO& io, Request& req) {
           out += "\r\n";
         }
         alive = send_all(fd, out.data(), out.size());
+      }
+      if (alive && deadline_expired) {
+        // timeoutSeconds expiry: END the watch cleanly (terminal chunk)
+        // — the client resumes from its last revision. A slow-consumer
+        // close stays abrupt (the backlog is already dropped).
+        g_watch_term_deadline.fetch_add(1);
+        send_all(fd, "0\r\n\r\n", 5);
       }
       {
         std::lock_guard<std::mutex> lk(store.mu);
@@ -2332,6 +2508,12 @@ int main(int argc, char** argv) {
   std::string address = "127.0.0.1";
   std::string audit_log, data_file, token_file;
   bool authorization = false;
+  // admission limits: flags override the env knobs (mirrors mockserver.py
+  // main(); 0/unset = band off)
+  const char* env_ro = getenv("KWOK_TPU_MAX_INFLIGHT");
+  const char* env_mu = getenv("KWOK_TPU_MAX_MUTATING_INFLIGHT");
+  long max_ro = env_ro && *env_ro ? atol(env_ro) : 0;
+  long max_mu = env_mu && *env_mu ? atol(env_mu) : 0;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto val = [&](const char* flag) -> const char* {
@@ -2346,6 +2528,8 @@ int main(int argc, char** argv) {
     else if (const char* v = val("--audit-log")) audit_log = v;
     else if (const char* v = val("--data-file")) data_file = v;
     else if (const char* v = val("--token-auth-file")) token_file = v;
+    else if (const char* v = val("--max-inflight")) max_ro = atol(v);
+    else if (const char* v = val("--max-mutating-inflight")) max_mu = atol(v);
     else if (a == "--authorization") authorization = true;
   }
 
@@ -2354,6 +2538,8 @@ int main(int argc, char** argv) {
   App app;
   g_app = &app;
   app.data_file = data_file;
+  app.max_inflight_band[0] = max_ro;
+  app.max_inflight_band[1] = max_mu;
   if (!audit_log.empty()) {
     app.audit = fopen(audit_log.c_str(), "a");
     if (!app.audit) {

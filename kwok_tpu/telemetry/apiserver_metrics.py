@@ -1,0 +1,86 @@
+"""Overload-protection metric surface shared by both mock apiservers.
+
+The Python mock (``edge/mockserver.py``) and the native C++ twin
+(``native/apiserver.cc``) expose the same three families on their own
+``/metrics`` endpoint so overload gates (``benchmarks/watcher_fleet.py``)
+can scrape either server identically:
+
+- ``kwok_apiserver_inflight{band=}`` — requests currently admitted per
+  max-inflight band ("readonly" = LIST/GET, "mutating" =
+  POST/PATCH/DELETE; watches are long-running and exempt, bounded by the
+  per-watcher send buffer instead).
+- ``kwok_apiserver_rejected_total{band=}`` — requests answered 429 +
+  ``Retry-After`` because the band was saturated (kube-apiserver
+  ``--max-requests-inflight`` / ``--max-mutating-requests-inflight``
+  semantics: reject, never queue unboundedly).
+- ``kwok_watch_terminations_total{reason=}`` — watch streams the server
+  closed: ``slow`` = the consumer stopped reading and its bounded send
+  buffer overflowed (the watch cache's slow-consumer termination; the
+  client re-lists), ``deadline`` = the request's ``timeoutSeconds``
+  expired (clean close at an event boundary; the client resumes from its
+  last revision).
+
+The counters themselves are plain ints owned by the store/server objects
+(they are bumped under the store lock, where taking a registry child lock
+would nest two level-85 leaves); this module renders them into the strict
+Prometheus text format the rest of the tree uses.
+"""
+
+from __future__ import annotations
+
+BANDS = ("readonly", "mutating")
+TERMINATION_REASONS = ("slow", "deadline")
+
+APISERVER_METRICS_HELP = {
+    "kwok_apiserver_inflight": (
+        "Requests currently admitted per max-inflight band "
+        "(readonly=LIST/GET, mutating=POST/PATCH/DELETE; watches exempt)"
+    ),
+    "kwok_apiserver_rejected_total": (
+        "Requests rejected with 429 + Retry-After because the band's "
+        "max-inflight limit was saturated"
+    ),
+    "kwok_watch_terminations_total": (
+        "Watch streams closed by the server (slow=send-buffer overflow "
+        "from a consumer that stopped reading, deadline=timeoutSeconds "
+        "expiry)"
+    ),
+}
+
+
+def render_apiserver_metrics(
+    inflight: dict, rejected: dict, terminations: dict
+) -> bytes:
+    """Strict Prometheus exposition of the three families. All three dicts
+    are read without locks: values are ints written under the GIL."""
+    lines: list[str] = []
+
+    def fam(name: str, type_: str, samples: list) -> None:
+        lines.append(f"# HELP {name} {APISERVER_METRICS_HELP[name]}")
+        lines.append(f"# TYPE {name} {type_}")
+        lines.extend(samples)
+
+    fam(
+        "kwok_apiserver_inflight", "gauge",
+        [
+            f'kwok_apiserver_inflight{{band="{b}"}} {int(inflight.get(b, 0))}'
+            for b in BANDS
+        ],
+    )
+    fam(
+        "kwok_apiserver_rejected_total", "counter",
+        [
+            f'kwok_apiserver_rejected_total{{band="{b}"}} '
+            f"{int(rejected.get(b, 0))}"
+            for b in BANDS
+        ],
+    )
+    fam(
+        "kwok_watch_terminations_total", "counter",
+        [
+            f'kwok_watch_terminations_total{{reason="{r}"}} '
+            f"{int(terminations.get(r, 0))}"
+            for r in TERMINATION_REASONS
+        ],
+    )
+    return ("\n".join(lines) + "\n").encode()

@@ -77,6 +77,11 @@ _HELP = {
     "kwok_checkpoint_rows": "Rows in the most recent checkpoint by "
     "state (armed = a Stage delay in flight whose residue the next "
     "restart resumes; idle = no pending rule timer)",
+    "kwok_client_throttle_seconds_total": "Cumulative seconds this engine "
+    "slept honoring apiserver 429 Retry-After hints (watch/list "
+    "reconnects and patch-executor retries); a nonzero rate means the "
+    "apiserver's max-inflight bands are saturated and the engine is "
+    "backing off instead of hammering",
 }
 
 # legacy counter name -> (family name, has kind label)
@@ -217,6 +222,15 @@ class EngineTelemetry:
                 "kwok_trace_spans_total", _HELP["kwok_trace_spans_total"], base
             )
         )
+        # client-side overload accounting: seconds slept honoring 429
+        # Retry-After hints (a float counter; monotonic)
+        self._throttle = child(
+            r.counter(
+                "kwok_client_throttle_seconds_total",
+                _HELP["kwok_client_throttle_seconds_total"],
+                base,
+            )
+        )
         register_build_info(r)
 
     # ------------------------------------------------------------- writes
@@ -257,6 +271,13 @@ class EngineTelemetry:
             c = self._rtt_fam.labels(**self._rtt_labels, path=path)
             self._rtt_children[path] = c
         c.observe(seconds)
+
+    def add_throttle(self, seconds: float) -> None:
+        self._throttle.inc(seconds)
+
+    @property
+    def client_throttle_seconds(self) -> float:
+        return self._throttle.value
 
     def span(self, name, t0, t1, lane="drain", args=None) -> None:
         self.tracer.span(name, t0, t1, lane, args)

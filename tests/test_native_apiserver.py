@@ -560,3 +560,232 @@ def test_pod_log_proxy_dialect(client):
         assert code == want_code, (name, got)
         assert got["message"] == want["message"], (name, got, want)
         assert got["code"] == want["code"]
+
+
+# ---------------------------------------------------- overload dialects
+# (ISSUE 8): the two servers must speak byte-identical overload answers —
+# 429 + Retry-After from a saturated max-inflight band, the abrupt
+# slow-consumer watch close, and the clean timeoutSeconds deadline expiry
+# — so ROADMAP item 1's rewrite inherits a pinned contract.
+
+import re as _re
+import socket as _socket
+
+from kwok_tpu.edge.mockserver import HttpFakeApiserver
+
+
+def _mask_times(b: bytes) -> bytes:
+    return _re.sub(rb'"creationTimestamp":"[^"]*"',
+                   b'"creationTimestamp":"T"', b)
+
+
+def _hold_mutating_slot(host: str, port: int):
+    """Open a POST whose body never arrives: the server admits it (the
+    slot spans the body read) and blocks — deterministic saturation."""
+    import http.client
+
+    body = json.dumps({"apiVersion": "v1", "kind": "Node",
+                       "metadata": {"name": "held"}}).encode()
+    c = http.client.HTTPConnection(host, port)
+    c.putrequest("POST", "/api/v1/nodes")
+    c.putheader("Content-Type", "application/json")
+    c.putheader("Content-Length", str(len(body)))
+    c.endheaders()
+    return c, body
+
+
+def _post_expect_429(url: str):
+    import urllib.error
+
+    req = urllib.request.Request(
+        url + "/api/v1/nodes",
+        data=json.dumps({"apiVersion": "v1", "kind": "Node",
+                         "metadata": {"name": "n2"}}).encode(),
+        method="POST",
+    )
+    try:
+        urllib.request.urlopen(req, timeout=5)
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Retry-After"), e.read()
+    raise AssertionError("expected 429")
+
+
+def test_429_dialect_parity():
+    """Saturate the mutating band on both servers the same way and
+    byte-compare the rejection: status, Retry-After, Status body. The
+    readonly band must stay unaffected (band separation: watcher reads
+    cannot be starved by engine writes and vice versa)."""
+    answers = {}
+    # native
+    s = NativeServer(["--max-mutating-inflight", "1"])
+    try:
+        host, port = "127.0.0.1", int(s.url.rsplit(":", 1)[1])
+        held, body = _hold_mutating_slot(host, port)
+        time.sleep(0.3)
+        answers["native"] = _post_expect_429(s.url)
+        # band separation: LIST still answers while mutating is full
+        assert urllib.request.urlopen(
+            s.url + "/api/v1/pods", timeout=5
+        ).status == 200
+        held.send(body)
+        assert held.getresponse().status == 201
+        held.close()
+    finally:
+        s.stop()
+    # python twin
+    py = HttpFakeApiserver(max_mutating_inflight=1).start()
+    try:
+        held, body = _hold_mutating_slot("127.0.0.1", py.port)
+        time.sleep(0.3)
+        answers["python"] = _post_expect_429(py.url)
+        assert urllib.request.urlopen(
+            py.url + "/api/v1/pods", timeout=5
+        ).status == 200
+        held.send(body)
+        assert held.getresponse().status == 201
+        held.close()
+    finally:
+        py.stop()
+    assert answers["native"] == answers["python"]
+    code, retry_after, doc = answers["native"]
+    assert code == 429 and retry_after == "1"
+    assert json.loads(doc)["reason"] == "TooManyRequests"
+
+
+def _raw_watch_stream(port: int, query: str, drive, timeout=10.0) -> bytes:
+    """Open a watch on a raw socket, run `drive()`, read to EOF; returns
+    the bytes AFTER the response headers (the chunked stream)."""
+    s = _socket.socket()
+    s.settimeout(timeout)
+    s.connect(("127.0.0.1", port))
+    s.sendall(
+        f"GET /api/v1/pods?watch=true{query} HTTP/1.1\r\n"
+        f"Host: x\r\n\r\n".encode()
+    )
+    time.sleep(0.2)
+    drive()
+    buf = b""
+    try:
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            buf += b
+    except _socket.timeout:
+        pass
+    s.close()
+    return buf.split(b"\r\n\r\n", 1)[1]
+
+
+def test_watch_deadline_expiry_parity():
+    """timeoutSeconds on a watch: both servers deliver the events, then
+    END the stream cleanly (terminal chunk) at the deadline — byte-
+    compared with timestamps masked (identical write sequences give
+    identical revisions and uids on both stores)."""
+    streams = {}
+    pod = make_pod("dl-p", node="n1")
+
+    s = NativeServer()
+    try:
+        port = int(s.url.rsplit(":", 1)[1])
+        c = HttpKubeClient(s.url)
+        streams["native"] = _raw_watch_stream(
+            port, "&timeoutSeconds=1",
+            lambda: c.create("pods", dict(pod)),
+        )
+        c.close()
+    finally:
+        s.stop()
+
+    py = HttpFakeApiserver().start()
+    try:
+        c = HttpKubeClient(py.url)
+        streams["python"] = _raw_watch_stream(
+            py.port, "&timeoutSeconds=1",
+            lambda: c.create("pods", dict(pod)),
+        )
+        c.close()
+    finally:
+        py.stop()
+
+    for name, raw in streams.items():
+        assert raw.endswith(b"0\r\n\r\n"), (name, raw[-40:])
+        assert b'"type":"ADDED"' in raw, name
+    assert _mask_times(streams["native"]) == _mask_times(streams["python"])
+
+
+def test_slow_consumer_termination_parity():
+    """A consumer that stops reading: both servers drop the backlog once
+    the bounded per-watcher send buffer overflows and CLOSE the stream
+    abruptly (no terminal chunk, no ERROR event — re-list recovery),
+    counting kwok_watch_terminations_total{reason="slow"} on /metrics."""
+    pad = "x" * 32768
+
+    def burst(url):
+        c = HttpKubeClient(url)
+        for i in range(200):
+            c.create("nodes", {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": f"bn{i}", "labels": {"pad": pad}},
+            })
+        c.close()
+
+    def stalled_watch(port):
+        s = _socket.socket()
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 4096)
+        s.connect(("127.0.0.1", port))
+        s.sendall(b"GET /api/v1/nodes?watch=true HTTP/1.1\r\n"
+                  b"Host: x\r\n\r\n")
+        return s
+
+    def drain_to_eof(s) -> bytes:
+        s.settimeout(10)
+        tail = b""
+        while True:
+            b = s.recv(65536)
+            if not b:
+                return tail
+            tail = (tail + b)[-64:]
+
+    def scrape_slow(url) -> float:
+        text = urllib.request.urlopen(url + "/metrics", timeout=5) \
+            .read().decode()
+        for line in text.splitlines():
+            if line.startswith(
+                'kwok_watch_terminations_total{reason="slow"}'
+            ):
+                return float(line.rsplit(" ", 1)[1])
+        return 0.0
+
+    results = {}
+    s = NativeServer(env={"KWOK_TPU_WATCH_BACKLOG": "8"})
+    try:
+        port = int(s.url.rsplit(":", 1)[1])
+        sock = stalled_watch(port)
+        time.sleep(0.2)
+        burst(s.url)
+        time.sleep(0.3)
+        terms = scrape_slow(s.url)
+        tail = drain_to_eof(sock)
+        results["native"] = (terms, tail.endswith(b"0\r\n\r\n"))
+    finally:
+        s.stop()
+
+    py = HttpFakeApiserver().start()
+    py.store.watch_backlog = 8
+    try:
+        sock = stalled_watch(py.port)
+        time.sleep(0.2)
+        burst(py.url)
+        time.sleep(0.3)
+        terms = scrape_slow(py.url)
+        tail = drain_to_eof(sock)
+        results["python"] = (terms, tail.endswith(b"0\r\n\r\n"))
+    finally:
+        py.stop()
+
+    for name, (terms, clean_end) in results.items():
+        assert terms >= 1, (name, results)
+        assert not clean_end, (
+            name, "slow close must be abrupt, not a clean deadline end"
+        )

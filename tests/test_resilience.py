@@ -1095,3 +1095,122 @@ def test_stop_with_deadline_force_exits_on_wedge():
     stop_with_deadline([wedge], 0.2, force_exit=forced.append)
     wedged.set()
     assert forced == [3]
+
+
+# ------------------------------------------- 429 Retry-After honoring
+# (ISSUE 8): the apiserver's overload rejections are retryable but
+# THROTTLED — the shared RetryPolicy sleeps at least the server's hint;
+# every other HTTP status stays a definitive, never-retried answer.
+
+def test_429_patch_executor_paced_by_retry_after():
+    from kwok_tpu.edge.kubeclient import TooManyRequests
+
+    eng = _engine_for_pump()
+    stamps = []
+
+    def flaky():
+        stamps.append(time.monotonic())
+        if len(stamps) < 3:
+            raise TooManyRequests(retry_after=0.15)
+
+    eng._safe(flaky)
+    assert len(stamps) == 3
+    # every retry waited at least the server's hint — never a hot retry
+    assert stamps[1] - stamps[0] >= 0.15
+    assert stamps[2] - stamps[1] >= 0.15
+    assert eng.telemetry.client_throttle_seconds >= 0.3
+    assert eng.metrics["patch_errors_total"] == 0
+
+
+def test_429_gives_up_at_policy_deadline(monkeypatch):
+    import kwok_tpu.engine.engine as engine_mod
+    from kwok_tpu.edge.kubeclient import TooManyRequests
+    from kwok_tpu.resilience.policy import RetryPolicy as RP
+
+    monkeypatch.setattr(
+        engine_mod, "PATCH_RETRY", RP(base=0.001, cap=0.002, deadline=0.05)
+    )
+    eng = _engine_for_pump()
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TooManyRequests(retry_after=0.01)
+
+    eng._safe(always)
+    assert len(calls) > 1  # it DID retry (throttled) ...
+    assert eng.metrics["patch_errors_total"] == 1  # ... then gave up
+
+
+def test_http_status_errors_still_never_blind_retried():
+    import urllib.error
+
+    eng = _engine_for_pump()
+    calls = []
+
+    def fail():
+        calls.append(1)
+        raise urllib.error.HTTPError("u", 500, "boom", None, None)
+
+    eng._safe(fail)
+    assert len(calls) == 1  # a definitive answer, not transport loss
+    assert eng.metrics["patch_errors_total"] == 1
+
+
+def test_httpclient_raises_typed_429_with_retry_after():
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.kubeclient import TooManyRequests
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+
+    srv = HttpFakeApiserver(max_inflight=1).start()
+    client = HttpKubeClient(srv.url)
+    try:
+        # consume the only readonly slot, then a GET must answer the
+        # typed throttle carrying the server's Retry-After hint
+        assert srv._admission.try_acquire("readonly")
+        with pytest.raises(TooManyRequests) as ei:
+            client.get("pods", "default", "x")
+        assert ei.value.retry_after == 1.0
+        srv._admission.release("readonly")
+        assert client.get("pods", "default", "x") is None  # 404, not 429
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_engine_watch_loop_throttles_on_429_and_recovers():
+    """A saturated readonly band at engine startup: the initial lists
+    must be paced by Retry-After (kwok_client_throttle_seconds_total moves),
+    and once the band frees the engine converges normally."""
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+
+    srv = HttpFakeApiserver(max_inflight=1).start()
+    store = srv.store
+    store.create("nodes", make_node("tn1"))
+    assert srv._admission.try_acquire("readonly")  # saturate
+    eng = ClusterEngine(
+        HttpKubeClient(srv.url),
+        EngineConfig(manage_all_nodes=True, tick_interval=0.02),
+    )
+    eng.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+            eng.telemetry.client_throttle_seconds == 0
+        ):
+            time.sleep(0.05)
+        assert eng.telemetry.client_throttle_seconds > 0
+        srv._admission.release("readonly")
+        store.create("pods", make_pod("tp1", node="tn1"))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            pod = store.get("pods", "default", "tp1")
+            if (pod.get("status") or {}).get("phase") == "Running":
+                break
+            time.sleep(0.05)
+        assert store.get("pods", "default", "tp1")["status"]["phase"] \
+            == "Running"
+    finally:
+        eng.stop()
+        srv.stop()

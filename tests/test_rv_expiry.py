@@ -829,3 +829,86 @@ def test_fault_plane_compaction_storm_multilane_converges():
         assert counts.get("watch.expire", 0) >= 1
     finally:
         eng.stop()
+
+
+# ----------------------------------------- slow-watcher eviction resume
+# (ISSUE 8): a watch the SERVER terminates for falling behind (bounded
+# per-watcher send buffer, kwok_watch_terminations_total{reason="slow"})
+# is an expiry-class event for the client: the engine resumes from its
+# last parsed revision (watch-cache replay) or — once the gap compacts —
+# takes the full 410 -> re-list + RESYNC path. Either way nothing is
+# lost and nothing is double-applied (the PR 7 re-delivery machinery).
+
+def test_slow_watcher_termination_engine_resumes():
+    """A 2-event send buffer makes the engine's own pod stream overflow
+    during a creation burst (the producer outruns the per-connection
+    writer): the server terminates it mid-burst, and the engine must
+    still converge every pod with the termination actually recorded."""
+    srv = HttpFakeApiserver().start()
+    store = srv.store
+    store.watch_backlog = 2
+    store.create("nodes", make_node("sw-n"))
+    eng = ClusterEngine(
+        HttpKubeClient(srv.url),
+        EngineConfig(manage_all_nodes=True, tick_interval=0.02),
+    )
+    eng.start()
+    try:
+        names = [f"swp{i}" for i in range(80)]
+        for n in names:
+            store.create("pods", make_pod(n, node="sw-n"))
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            phases = [
+                (store.get("pods", "default", n) or {})
+                .get("status", {}).get("phase")
+                for n in names
+            ]
+            if all(p == "Running" for p in phases):
+                break
+            time.sleep(0.1)
+        assert all(p == "Running" for p in phases), phases
+        # the burst genuinely overflowed at least one stream
+        assert store.watch_terminations["slow"] >= 1
+    finally:
+        eng.stop()
+        srv.stop()
+
+
+def test_slow_termination_with_compaction_forces_relist():
+    """Termination + compaction of the gap: the rv-resume answers 410,
+    so recovery MUST take the full re-list + RESYNC path — and still
+    converge (the eviction cannot strand state)."""
+    srv = HttpFakeApiserver().start()
+    store = srv.store
+    store.watch_backlog = 2
+    store.create("nodes", make_node("sc-n"))
+    eng = ClusterEngine(
+        HttpKubeClient(srv.url),
+        EngineConfig(manage_all_nodes=True, tick_interval=0.02),
+    )
+    eng.start()
+    try:
+        relists0 = eng.metrics["watch_relists_total"]
+        names = [f"scp{i}" for i in range(60)]
+        for n in names:
+            store.create("pods", make_pod(n, node="sc-n"))
+        # compact NOW: any stream the burst terminated (and any rv it
+        # would resume from) is below the floor -> 410 -> re-list
+        store.compact()
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            phases = [
+                (store.get("pods", "default", n) or {})
+                .get("status", {}).get("phase")
+                for n in names
+            ]
+            if all(p == "Running" for p in phases):
+                break
+            time.sleep(0.1)
+        assert all(p == "Running" for p in phases), phases
+        assert store.watch_terminations["slow"] >= 1
+        assert eng.metrics["watch_relists_total"] > relists0
+    finally:
+        eng.stop()
+        srv.stop()
