@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check fleet-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check fleet-check drift-check image cluster-image clean
 
 all: build
 
@@ -74,6 +74,20 @@ restart-check: ## SIGKILL + cold-restart crash-durability gate (RTO artifact)
 # Skips cleanly when no C++ compiler is available.
 fleet-check: ## watcher-fleet survival gate (overload admission + slow-watcher eviction)
 	$(PYENV) python3 benchmarks/watcher_fleet.py --check
+
+# drift-check: the hostile-wire + anti-entropy gate: the threaded engine
+# converges a workload through a byte-corruption storm (wire.garble /
+# wire.truncate / wire.dup / wire.stale + clock.jump) byte-identically to
+# a clean control arm, with every corruption rejected-or-repaired and
+# zero unsupervised crashes; then a divergence seeded BEHIND the engine's
+# back (silent status rewind + silent delete) must be detected and
+# repaired by the anti-entropy auditor within one audit pass
+# (docs/resilience.md "Hostile wire & anti-entropy"; DRIFT_r*.json). The
+# unit tier (tests/test_resilience.py wire/clock cases +
+# tests/test_antientropy.py) rides tier-1.
+drift-check: ## hostile-wire convergence + anti-entropy drift-repair gate
+	$(PYENV) python3 -m pytest tests/test_antientropy.py -q
+	$(PYENV) python3 benchmarks/drift_soak.py --check
 
 image:
 	./images/kwok/build.sh

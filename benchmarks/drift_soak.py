@@ -1,0 +1,352 @@
+"""Drift convergence gate: hostile wire + anti-entropy (ISSUE 10).
+
+Three arms against the HTTP mock apiserver (oplog store), sharing one
+creates-only workload:
+
+- **control**: no faults, no auditor — the reference final state.
+- **storm**: the hostile-wire fault tier corrupts the engine's real
+  ingest bytes — ``wire.garble`` (byte flips/inserts in watch lines and
+  LIST bodies), ``wire.truncate`` (mid-JSON cuts with no clean close),
+  ``wire.dup``/``wire.stale`` (replayed and regressed-rv events) and
+  ``clock.jump`` (a skewed engine clock) — while the anti-entropy
+  auditor runs. The storm closes the way an outage ends (rates zeroed,
+  streams cut, compaction forces the full re-list) and the engine must
+  CONVERGE: final pod phases byte-identical to control, per-key patch
+  order preserved, every corruption rejected-or-repaired (counted in
+  ``kwok_wire_rejects_total`` / repaired by re-list+auditor — proven by
+  the byte-identical end state), zero worker crashes outside
+  supervision, queues drained, not degraded.
+- **seeded divergence** (same storm run, post-convergence, faults off):
+  the rig mutates server state *behind the engine's back* — one pod's
+  status.phase silently rewound (no watch event, no rv bump) and one
+  pod silently deleted (a ghost row) — and the auditor must detect
+  (``kwok_drift_detected_total{reason="stale-row"|"ghost-row"}``) and
+  repair (server phase re-asserted; ghost row released) within one
+  audit pass of the next interval.
+
+Artifact: ``DRIFT_r01.json``. ``--check`` (the ``make drift-check`` /
+CI entry) runs a smaller workload and exits nonzero on any failed gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.rig import (  # noqa: E402 (path bootstrap above)
+    MockApiserver,
+    make_node as _make_node,
+    make_pod as _make_pod,
+    pod_phases as _pod_phases,
+    silent_delete,
+    silent_patch,
+    wait_until as _wait,
+)
+
+# the hostile-wire storm: every wire.* kind plus a skewed clock, rates
+# sized so a ~3s churn window sees each kind fire but recovery (bounded
+# integrity resyncs + the closing re-list) still converges quickly
+DRIFT_SPEC = (
+    "seed={seed};wire.garble=0.08;wire.truncate=0.02;wire.dup=0.10;"
+    "wire.stale=0.10;clock.jump=0.5:0.3;watch.cut=0.005"
+)
+
+AUDIT_INTERVAL = 1.0
+
+# gate bound for the seeded-divergence repair: worst case the mutation
+# lands right after a pass began (one full interval of waiting), plus
+# the repairing pass itself (settle re-check + repair enqueue + the
+# ingest/patch round trip) — generous for 2-vCPU CI hosts
+REPAIR_BOUND_S = AUDIT_INTERVAL + 3.0
+
+
+def _run(pods: int, lanes: int, seed: int, storm: bool,
+         timeout: float) -> dict:
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+    from kwok_tpu.telemetry.errors import (
+        wire_rejects_total,
+        worker_crash_ledger,
+    )
+
+    srv = MockApiserver()
+    store = srv.store
+    names = [f"dp{i}" for i in range(pods)]
+    nodes = [f"dn{i}" for i in range(4)]
+    spec = DRIFT_SPEC.format(seed=seed) if storm else ""
+    rejects0 = wire_rejects_total()
+    eng = ClusterEngine(
+        HttpKubeClient.from_kubeconfig(None, srv.url),
+        EngineConfig(
+            manage_all_nodes=True, tick_interval=0.02, drain_shards=lanes,
+            faults=spec,
+            audit_interval=AUDIT_INTERVAL if storm else 0.0,
+        ),
+    )
+    out: dict = {"mode": "storm" if storm else "control"}
+    t_run0 = time.time()
+    eng.start()
+    try:
+        for n in nodes:
+            store.create("nodes", _make_node(n))
+        # pace the workload across the fault window so the wire tier has
+        # live traffic to corrupt (a burst that converges in 200ms would
+        # leave most of the storm injecting into an idle stream)
+        half = pods // 2
+        for n in names[:half]:
+            store.create("pods", _make_pod(n, nodes[hash(n) % len(nodes)]))
+        if storm:
+            time.sleep(1.0)
+        for n in names[half:]:
+            store.create("pods", _make_pod(n, nodes[hash(n) % len(nodes)]))
+        if storm:
+            # let the wire tier corrupt live traffic...
+            time.sleep(2.5)
+            eng._faults.spec.rates.clear()
+            out["faults_injected"] = eng._faults.counts()
+            # ...then close the window the way an outage ends: compaction
+            # + every stream cut, so recovery takes the full 410 ->
+            # list+RESYNC path (events eaten by garbled lines or
+            # truncated streams have no other way back)
+            heal_t0 = time.time()
+            store.compact()
+            store.stop_watches()
+        else:
+            heal_t0 = time.time()
+
+        converged = _wait(
+            lambda: all(
+                ph == "Running" for ph in _pod_phases(store, names).values()
+            ),
+            timeout,
+        )
+        out["converged"] = converged
+        out["recovery_to_converged_s"] = round(time.time() - heal_t0, 3)
+        out["final_phases"] = _pod_phases(store, names)
+        out["per_key_order"] = {
+            n: store.per_key_collapsed(("default", n)) for n in names
+        }
+        out["wire_rejects_delta"] = wire_rejects_total() - rejects0
+        out["watch_relists_total"] = eng.metrics["watch_relists_total"]
+        out["integrity_resyncs_total"] = eng.metrics[
+            "watch_integrity_resyncs_total"
+        ]
+        out["crash_ledger"] = {
+            t: list(v) for t, v in worker_crash_ledger().items()
+        }
+        if eng._lanes is not None:
+            out["queues_drained"] = _wait(
+                lambda: all(
+                    lane.q.qsize() == 0 and lane.emit_q.qsize() == 0
+                    for lane in eng._lanes.lanes
+                ),
+                10.0,
+            )
+        else:
+            out["queues_drained"] = True
+
+        if storm and converged:
+            out.update(_seed_divergence(eng, store, names))
+        out["degraded_at_end"] = eng.degraded
+        out["degraded_reasons"] = list(eng._degradation.reasons)
+        if eng._auditor is not None:
+            out["audit"] = eng._auditor.snapshot()
+            out["drift_detected_by_reason"] = {
+                r: eng._auditor.detected_total(reason=r)
+                for r in ("missed-event", "double-apply",
+                          "stale-row", "ghost-row")
+            }
+        out["wall_s"] = round(time.time() - t_run0, 3)
+    finally:
+        eng.stop()
+        srv.stop()
+    return out
+
+
+def _watch_quiescent(eng, hold: float = 1.5, timeout: float = 20.0) -> bool:
+    """Wait until the watch tier stops re-listing: a storm-era stream cut
+    or pending resync request landing DURING the seeded-divergence window
+    would repair the seed through the re-list path (upsert repair render
+    + RESYNC stale-key prune) before the auditor ever sees it — proving
+    the wrong mechanism. Quiescence first makes the auditor the only
+    repairer in play."""
+    deadline = time.time() + timeout
+    last = -1
+    stable_since = time.time()
+    while time.time() < deadline:
+        cur = eng.metrics["watch_relists_total"]
+        now = time.time()
+        if cur != last:
+            last = cur
+            stable_since = now
+        elif now - stable_since >= hold:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _seed_divergence(eng, store, names) -> dict:
+    """Post-convergence, faults off: mutate server state behind the
+    engine's back and time the auditor's detect+repair."""
+    aud = eng._auditor
+    victim, ghost = names[0], names[1]
+    quiesced = _watch_quiescent(eng)
+    detected0 = aud.detected_total()
+    repaired0 = aud.repaired_total
+
+    def rewind(obj):
+        (obj.setdefault("status", {}))["phase"] = "Pending"
+
+    assert silent_patch(store, "pods", "default", victim, rewind)
+    assert silent_delete(store, "pods", "default", ghost)
+    t0 = time.time()
+
+    def ghost_row_gone():
+        lanes = eng._lanes
+        engines = (
+            [ln.engine for ln in lanes.lanes] if lanes is not None
+            else [eng]
+        )
+        return all(
+            e.pods.pool.lookup(("default", ghost)) is None for e in engines
+        )
+
+    def repaired():
+        ph = (store.get("pods", "default", victim) or {}) \
+            .get("status", {}).get("phase")
+        return ph == "Running" and ghost_row_gone()
+
+    ok = _wait(repaired, REPAIR_BOUND_S + 5.0)
+    dt = round(time.time() - t0, 3)
+    # post-repair settle: one clean pass clears any transient degraded
+    # state and proves the repair is stable
+    _wait(lambda: not eng.degraded, 3 * AUDIT_INTERVAL + 2.0)
+    return {
+        "seeded_watch_quiesced": quiesced,
+        "seeded_divergence_repaired": ok,
+        "seeded_repair_s": dt,
+        "seeded_repair_bound_s": REPAIR_BOUND_S,
+        "seeded_repaired_within_bound": ok and dt <= REPAIR_BOUND_S,
+        "seeded_detected_delta": aud.detected_total() - detected0,
+        "seeded_repaired_delta": aud.repaired_total - repaired0,
+    }
+
+
+def gates(base: dict, storm: dict) -> dict:
+    fi = storm.get("faults_injected", {})
+    ledger = storm.get("crash_ledger", {})
+    return {
+        "control_converged": bool(base["converged"]),
+        "storm_converged": bool(storm["converged"]),
+        # the headline: byte-identical final pod phases through the storm
+        "phases_identical": (
+            json.dumps(base["final_phases"], sort_keys=True)
+            == json.dumps(storm["final_phases"], sort_keys=True)
+        ),
+        "per_key_order_preserved": (
+            base["per_key_order"] == storm["per_key_order"]
+        ),
+        # every wire kind actually fired, and corruptions were counted
+        # (rejected) — the byte-identical end state proves the rest were
+        # repaired
+        "wire_faults_actually_injected": all(
+            fi.get(k, 0) >= 1
+            for k in ("wire.garble", "wire.truncate", "wire.dup",
+                      "wire.stale", "clock.jump")
+        ),
+        "corruptions_rejected": storm["wire_rejects_delta"] > 0,
+        # no worker died outside supervision: every crash has a restart
+        "zero_unsupervised_crashes": all(
+            crashes == restarts for crashes, restarts in ledger.values()
+        ),
+        "queues_drained": bool(storm["queues_drained"]),
+        "not_degraded_at_end": not storm["degraded_at_end"],
+        # the anti-entropy oracle: both seeded divergences detected with
+        # the right class and repaired inside the bound
+        "seeded_divergence_repaired_in_bound": bool(
+            storm.get("seeded_repaired_within_bound")
+        ),
+        "seeded_stale_row_detected": (
+            storm.get("drift_detected_by_reason", {})
+            .get("stale-row", 0) >= 1
+        ),
+        "seeded_ghost_row_detected": (
+            storm.get("drift_detected_by_reason", {})
+            .get("ghost-row", 0) >= 1
+        ),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--pods", type=int, default=96)
+    p.add_argument("--lanes", type=int, default=2)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--timeout", type=float, default=90.0,
+                   help="per-arm convergence deadline (s)")
+    p.add_argument("--out", default=os.path.join(REPO, "DRIFT_r01.json"))
+    p.add_argument("--check", action="store_true",
+                   help="CI gate: smaller workload, exit 1 on any failed "
+                   "convergence/rejection/repair gate")
+    args = p.parse_args()
+    if args.check:
+        args.pods = min(args.pods, 48)
+
+    base = _run(args.pods, args.lanes, args.seed, storm=False,
+                timeout=args.timeout)
+    storm = _run(args.pods, args.lanes, args.seed, storm=True,
+                 timeout=args.timeout)
+    g = gates(base, storm)
+    ok = all(g.values())
+
+    artifact = {
+        "bench": "drift_soak",
+        "spec": DRIFT_SPEC.format(seed=args.seed),
+        "audit_interval_s": AUDIT_INTERVAL,
+        "params": {"pods": args.pods, "lanes": args.lanes,
+                   "seed": args.seed, "check": args.check},
+        "gates": g,
+        "ok": ok,
+        "control": {
+            "wall_s": base["wall_s"],
+            "watch_relists_total": base["watch_relists_total"],
+        },
+        "storm": {
+            k: storm.get(k)
+            for k in (
+                "wall_s", "faults_injected", "wire_rejects_delta",
+                "integrity_resyncs_total", "watch_relists_total",
+                "recovery_to_converged_s", "queues_drained",
+                "degraded_at_end", "degraded_reasons", "audit",
+                "drift_detected_by_reason", "seeded_repair_s",
+                "seeded_repair_bound_s", "seeded_detected_delta",
+                "seeded_repaired_delta", "crash_ledger",
+            )
+        },
+    }
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"ok": ok, "gates": g, "out": args.out}))
+    if not ok:
+        failed = [k for k, v in g.items() if not v]
+        print(f"drift_soak: FAILED gates: {failed}", file=sys.stderr)
+        if not g["phases_identical"]:
+            diff = {
+                n: (base["final_phases"][n], storm["final_phases"][n])
+                for n in base["final_phases"]
+                if base["final_phases"][n] != storm["final_phases"][n]
+            }
+            print(f"drift_soak: phase diffs: {diff}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -165,6 +165,34 @@ def oplog_store():
     return OplogStore()
 
 
+# -------------------------------------------- behind-the-engine mutation
+
+def silent_patch(store, kind, namespace, name, mutate) -> bool:
+    """Mutate a stored object WITHOUT bumping its resourceVersion or
+    emitting a watch event — the anti-entropy rig's hook for seeding
+    silent divergence (nothing on the engine's event path can see this;
+    only the auditor's ground-truth re-read can). ``mutate(obj)`` edits
+    the live dict in place. Returns whether the object existed."""
+    with store._lock:
+        key = store._key(namespace, name)
+        obj = store._store[kind].get(key)
+        if obj is None:
+            return False
+        mutate(obj)
+        store._json[kind].pop(key, None)  # invalidate the bytes cache
+        return True
+
+
+def silent_delete(store, kind, namespace, name) -> bool:
+    """Remove a stored object without a DELETED event or rv bump: the
+    engine's row becomes a ghost only anti-entropy can notice."""
+    with store._lock:
+        key = store._key(namespace, name)
+        gone = store._store[kind].pop(key, None)
+        store._json[kind].pop(key, None)
+        return gone is not None
+
+
 # ----------------------------------------------------------- apiservers
 
 class MockApiserver:

@@ -23,6 +23,9 @@ make restart-check
 echo ">> fleet-check (watcher-fleet survival gate: overload admission + slow-watcher eviction)"
 make fleet-check
 
+echo ">> drift-check (hostile-wire convergence + anti-entropy drift-repair gate)"
+make drift-check
+
 echo ">> bash syntax"
 find hack test images -name '*.sh' -print0 | xargs -0 -n1 bash -n
 

@@ -62,6 +62,12 @@ LOCK_ORDER: dict[str, int] = {
     # holding it into a level-85 leaf (the store's _lock, a registry
     # child) would be an order violation, not an unordered pair.
     "_adm_lock": 84,
+    # anti-entropy auditor (ISSUE 10): guards only the scan cursor /
+    # cycle-seen sets / unrepaired-streak dict in
+    # resilience/antientropy.py — the audit thread's state, snapshot-read
+    # by gates/tests. Taken after a lane's stage_lock on the pool-keys
+    # walk (a legal 10 -> 84 descent); nothing is ever acquired under it.
+    "_ae_lock": 84,
     "_lock": 85,        # single-resource leaves (ippool, registry, ...)
     "_apiserver_lock": 85,
     "_audit_lock": 95,  # mockserver audit ring, below the store lock

@@ -66,6 +66,11 @@ class KwokConfigurationOptions:
     checkpointDir: str = ""
     checkpointInterval: float = 2.0
     drainDeadline: float = 30.0
+    # Anti-entropy auditor (resilience/antientropy.py): cadence in
+    # seconds of the background apiserver-vs-rows drift pass (budgeted
+    # LIST pages; detects + repairs silent divergence). 0 = off (the
+    # default; KWOK_TPU_AUDIT_INTERVAL is the engine-level fallback).
+    auditInterval: float = 0.0
 
 
 @dataclasses.dataclass

@@ -30,11 +30,12 @@ from typing import Iterator
 import yaml
 
 from kwok_tpu.edge.kubeclient import (
+    ContinueExpired,
     TooLargeResourceVersion,
     TooManyRequests,
     WatchEvent,
 )
-from kwok_tpu.telemetry.errors import swallowed
+from kwok_tpu.telemetry.errors import swallowed, wire_reject
 
 logger = logging.getLogger("kwok_tpu.edge.http")
 
@@ -275,7 +276,15 @@ class HttpKubeClient:
             raise urllib.error.HTTPError(
                 url, status, payload.decode(errors="replace"), None, None
             )
-        return json.loads(payload or b"null")
+        try:
+            return json.loads(payload or b"null")
+        except ValueError:
+            # a 2xx response whose body does not decode: garbled or
+            # truncated on the wire. Counted, then raised — every caller
+            # (watch loop, patch executor) already treats this as a
+            # transient failure and re-fetches, which is the repair.
+            wire_reject("http_body")
+            raise
 
     # ------------------------------------------------------------- KubeClient
 
@@ -311,6 +320,40 @@ class HttpKubeClient:
             cont = (doc.get("metadata") or {}).get("continue")
             if not cont:
                 return items
+
+    def list_page(self, kind, *, limit: int, cont: str = "",
+                  field_selector=None, label_selector=None):
+        """ONE page of a paged LIST — the anti-entropy auditor's budgeted
+        read primitive (resilience/antientropy.py): the auditor bounds
+        pages per pass so it can never self-inflict a 429 storm, and
+        resumes the continue cursor on its next pass. Returns
+        ``(items, continue_token)``; an expired cursor (410 mid-scan)
+        raises typed :class:`ContinueExpired` — a caller must restart
+        its scan, and must NOT mistake the expiry for a completed one
+        (a legitimately-empty final page also returns no token)."""
+        try:
+            doc = self._json(
+                "GET",
+                self._url(kind, query={
+                    "fieldSelector": field_selector,
+                    "labelSelector": label_selector,
+                    "limit": limit,
+                    "continue": cont or None,
+                }),
+            ) or {}
+        except urllib.error.HTTPError as e:
+            if e.code == 410 and cont:
+                logger.warning(
+                    "audit list %s continue token expired; restarting scan",
+                    kind,
+                )
+                raise ContinueExpired(kind) from e
+            raise
+        items = []
+        for item in doc.get("items") or []:
+            item.setdefault("apiVersion", "v1")
+            items.append(item)
+        return items, (doc.get("metadata") or {}).get("continue") or ""
 
     def watch(self, kind, *, field_selector=None, label_selector=None,
               resource_version=None, allow_bookmarks=False):
@@ -465,9 +508,20 @@ class _HttpWatch:
                     continue
                 try:
                     doc = json.loads(line)
-                except json.JSONDecodeError:
-                    logger.warning("bad watch line: %.120r", line)
-                    continue
+                except ValueError:  # JSONDecodeError or bad UTF-8
+                    # corrupt bytes on the watch stream: integrity doubt.
+                    # Skipping would silently lose whatever event the line
+                    # carried (its rv is unreadable, so nothing would ever
+                    # re-deliver it); ending the stream makes the engine's
+                    # reconnect resume from the last good revision — the
+                    # server replays the gap, the echo-drop absorbs the
+                    # duplicates, and the corrupt event comes back whole.
+                    wire_reject("watch_line")
+                    logger.warning(
+                        "bad watch line (ending stream for resume): "
+                        "%.120r", line,
+                    )
+                    return
                 type_ = doc.get("type")
                 if type_ in ("ADDED", "MODIFIED", "DELETED", "BOOKMARK"):
                     # BOOKMARK objects carry only metadata.resourceVersion;

@@ -55,6 +55,13 @@ class TooLargeResourceVersion(Exception):
         self.retry_after = float(retry_after)
 
 
+class ContinueExpired(Exception):
+    """A paged LIST's continue token was compacted away mid-scan (HTTP
+    410 on the continuation page). Typed so callers can restart their
+    scan cleanly — distinguishable from a legitimately-empty final page,
+    which also carries no further token but IS a completed scan."""
+
+
 class TooManyRequests(Exception):
     """HTTP 429: one of the apiserver's max-inflight bands is saturated
     (kube-apiserver --max-requests-inflight /

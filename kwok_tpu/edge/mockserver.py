@@ -63,6 +63,13 @@ class AlreadyExists(Exception):
     generateName collisions are retried server-side instead)."""
 
 
+class _BadBody(Exception):
+    """A request body that does not decode as JSON — garbled or truncated
+    on the wire. Answered 400 with the same Status body the C++ mirror
+    sends (parity-pinned), never a handler crash: hostile request bytes
+    must not kill the connection thread or wedge the store lock."""
+
+
 class _Watch:
     def __init__(self, server: "FakeKube", kind: str, field_selector, label_selector):
         self.server = server
@@ -1389,7 +1396,17 @@ class HttpFakeApiserver:
 
             def _body(self):
                 n = int(self.headers.get("Content-Length") or 0)
-                return json.loads(self.rfile.read(n) or b"null") if n else None
+                if not n:
+                    return None
+                data = self.rfile.read(n)
+                try:
+                    return json.loads(data or b"null")
+                except ValueError as e:
+                    # garbled or truncated (client died mid-body -> short
+                    # read) request bytes: typed, answered 400 by the
+                    # _admitted chokepoint — byte-identical to the C++
+                    # mirror's JParser rejection, never a crash
+                    raise _BadBody() from e
 
             def _authorized(self) -> bool:
                 """kube-apiserver token authn: /healthz stays anonymous (the
@@ -1443,20 +1460,36 @@ class HttpFakeApiserver:
                 unconfigured servers skip straight through."""
                 adm = server_obj._admission
                 if adm is None:
-                    return impl()
+                    return self._guarded(impl)
                 parsed = urllib.parse.urlparse(self.path)
                 band = _admission_band(
                     self.command or "", parsed.path, parsed.query
                 )
                 if band is None:
-                    return impl()
+                    return self._guarded(impl)
                 if not adm.try_acquire(band):
                     self._reject_429()
                     return
                 try:
-                    impl()
+                    self._guarded(impl)
                 finally:
                     adm.release(band)
+
+            def _guarded(self, impl):
+                """Hostile-byte backstop around one request handler: a
+                garbled/truncated body answers the C++ mirror's exact 400
+                Status (`{"kind":"Status","code":400}`); a connection
+                that died before the answer could be written is closed
+                quietly (the 400 had no reader) — either way the handler
+                thread survives and the store lock was never entered
+                (body parse precedes every store call)."""
+                try:
+                    impl()
+                except _BadBody:
+                    try:
+                        self._send_json({"kind": "Status", "code": 400}, 400)
+                    except OSError:
+                        self.close_connection = True
 
             def do_GET(self):  # noqa: N802
                 self._admitted(self._do_get)
@@ -1702,7 +1735,12 @@ class HttpFakeApiserver:
                 ):
                     self.send_error(404)  # binding create-only, log GET-only
                     return
-                body = self._body() or {}
+                try:
+                    body = self._body() or {}
+                except _BadBody:
+                    # C++ parity: an undecodable DELETE body falls back to
+                    # default grace (JParser failure leaves b non-OBJ)
+                    body = {}
                 grace = body.get("gracePeriodSeconds")
                 store.delete(
                     m.group("kind"), m.group("ns"), m.group("name"),

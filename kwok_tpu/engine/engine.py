@@ -79,7 +79,7 @@ from kwok_tpu.resilience.policy import (
 )
 from kwok_tpu.resilience.watchdog import Watchdog
 from kwok_tpu.telemetry import EngineTelemetry
-from kwok_tpu.telemetry.errors import swallowed
+from kwok_tpu.telemetry.errors import swallowed, wire_reject
 from kwok_tpu.workers import spawn_worker
 
 logger = logging.getLogger("kwok_tpu.engine")
@@ -191,6 +191,15 @@ class EngineConfig:
     # per-tick cost beyond one attribute test.
     checkpoint_dir: str = ""
     checkpoint_interval: float = 2.0
+    # Anti-entropy auditor (resilience/antientropy.py): a paced
+    # background pass diffing a budgeted window of apiserver objects
+    # against engine rows by (uid, rv, phase), classifying divergence
+    # (missed-event / double-apply / stale-row / ghost-row) and
+    # repairing per row via re-ingest. 0 = off (the default; falls back
+    # to KWOK_TPU_AUDIT_INTERVAL); negative = forced off even under the
+    # env var (lane children). Off means off: no thread, no LISTs, no
+    # per-tick cost.
+    audit_interval: float = 0.0
 
     def validate(self) -> None:
         if not (
@@ -200,6 +209,19 @@ class EngineConfig:
         ):
             # controller.go:98 "no nodes are managed"
             raise ValueError("no nodes are managed")
+
+
+def _rv_of(meta: dict) -> int:
+    """metadata.resourceVersion as an int, 0 when absent OR unparseable.
+    Tolerant by contract: the hostile-wire tier deliberately delivers
+    garbled-but-parseable objects (a flipped digit turns \"1234\" into
+    \"12x4\"), and an unguarded int() here killed the ingest path — a
+    corrupt rv simply means the object carries no usable identity, the
+    same as a missing one."""
+    try:
+        return int(meta.get("resourceVersion") or 0)
+    except (TypeError, ValueError):
+        return 0
 
 
 def _ctr_blob(containers) -> bytes:
@@ -330,6 +352,13 @@ class ClusterEngine:
         self._faults = resilience_faults.from_config(config.faults)
         if self._faults is not None:
             client = self._faults.wrap_client(client)
+            rate = self._faults.spec.rate("clock.jump")
+            if rate is not None and rate.p > 0:
+                # hostile clock: skew every engine `now` read. Installed
+                # as an instance attribute only when the spec asks, so
+                # the unfaulted _now stays a two-op method (zero-cost
+                # contract).
+                self._now = self._skewed_now
         self.client = client
         self.config = config
         self.ippool = IPPool(config.cidr)
@@ -473,6 +502,19 @@ class ClusterEngine:
         # full-LIST rate if a pathological store keeps rewinding
         # (_note_rv_rewind)
         self._rv_rewind_at = 0.0
+        # monotonic stamp of the last corrupt-input integrity resync:
+        # under a garbling storm EVERY batch carries doubt, and an
+        # unbounded cut-and-relist loop would LIST-storm the apiserver
+        # (_integrity_resync; same bound as the rewind path)
+        self._wire_resync_at = 0.0
+        # kinds with unserved integrity doubt + the one deferral timer
+        # (guarded by _gen_lock like the rest of the stream bookkeeping)
+        self._wire_doubt: set[str] = set()
+        self._wire_timer: "threading.Timer | None" = None
+        # per-kind watch selector opts, captured by _spawn_watch — the
+        # anti-entropy auditor lists through the SAME selectors so its
+        # apiserver window matches what the engine is supposed to track
+        self._watch_opts: dict[str, dict] = {}
         # per-kind watch-stream generation, bumped whenever a stream is
         # known compacted (410): RAW lines still queued from the dead
         # stream belong to the old generation and must not repopulate
@@ -538,6 +580,27 @@ class ClusterEngine:
         self._restore = None  # resilience.checkpoint.RestoreSession | None
         self._ckpt_name = "engine"
         self._worker_suffix = ""
+        # Anti-entropy auditor (resilience/antientropy.py): config < env
+        # (same precedence as faults/checkpoint); a NEGATIVE config value
+        # forces off even under the env var — lane children use it, ONE
+        # auditor per engine (the parent's, over the shared client).
+        if config.audit_interval < 0:
+            self._audit_interval = 0.0
+        elif config.audit_interval > 0:
+            self._audit_interval = float(config.audit_interval)
+        else:
+            env_aud = os.environ.get("KWOK_TPU_AUDIT_INTERVAL", "").strip()
+            try:
+                self._audit_interval = (
+                    float(env_aud) if env_aud and env_aud != "off" else 0.0
+                )
+            except ValueError:
+                logger.warning(
+                    "KWOK_TPU_AUDIT_INTERVAL=%r is not a number; "
+                    "auditor stays off", env_aud,
+                )
+                self._audit_interval = 0.0
+        self._auditor = None  # resilience.antientropy.AntiEntropyAuditor
         # guards the startup catch-up bookkeeping below (drain workers of
         # several lanes mark their RESYNCs concurrently); level 84 in the
         # kwoklint lock table — a leaf like the other resilience locks
@@ -632,6 +695,11 @@ class ClusterEngine:
             # the re-list re-initializes resume their timers.
             self._rearm_restore()
             return
+        if name.startswith("kwok-audit"):
+            # the auditor holds no engine data a crash could eat — its
+            # next pass re-lists its window anyway; a full stream resync
+            # per audit crash would be pure cost
+            return
         self.resync_streams()
         # one loss class no re-list can reproduce: a cross-lane XUPD
         # managed-ness fan-out the dead worker ate. The pods' re-delivery
@@ -657,26 +725,106 @@ class ClusterEngine:
         resuming) and cut the live streams. Safe to call from any thread;
         the per-kind watch threads do the actual re-listing."""
         for kind in list(self._watches):
-            self._expire_stream(kind)
-            # _watch_rv only feeds the RAW/native paths' resume — the
-            # plain-iterator path resumes from a thread-local rv, so the
-            # re-list must be requested explicitly; the watch loop
-            # consumes this at reconnect AND right after installing a
-            # handle, which closes the reconnect race both ways: a handle
-            # installed before this flag is the one we re-read and stop
-            # below; one installed after sees the flag at its
-            # post-install check
-            with self._gen_lock:
-                self._resync_req.add(kind)
+            self._resync_stream(kind)
+
+    def _resync_stream(self, kind: str) -> None:
+        """One kind's share of resync_streams: expire + request + cut."""
+        self._expire_stream(kind)
+        # _watch_rv only feeds the RAW/native paths' resume — the
+        # plain-iterator path resumes from a thread-local rv, so the
+        # re-list must be requested explicitly; the watch loop
+        # consumes this at reconnect AND right after installing a
+        # handle, which closes the reconnect race both ways: a handle
+        # installed before this flag is the one we re-read and stop
+        # below; one installed after sees the flag at its
+        # post-install check
+        with self._gen_lock:
+            self._resync_req.add(kind)
+        w = self._watches.get(kind)
+        if w is None:
+            return
+        try:
+            w.stop()
+        except Exception:
+            # a dying/already-replaced handle: the reconnect path
+            # owns recovery either way
+            swallowed("resync_stream_stop")
+
+    # --------------------------------------------- hostile-wire quarantine
+
+    def _wire_reject(self, kind: str, reason: str, n: int = 1) -> None:
+        """Quarantine corrupt wire input: count it
+        (kwok_wire_rejects_total{reason=}) and treat it as integrity
+        doubt — the full list+RESYNC re-delivers whatever the corruption
+        ate, bounded-rate so a garbling storm cannot LIST-storm the
+        apiserver. Stale-rv drops are counted by the caller WITHOUT the
+        resync (a regressed revision is provably old news, not doubt)."""
+        wire_reject(reason, n)
+        self._integrity_resync(kind)
+
+    #: minimum seconds between integrity-resync stream cuts: bounds the
+    #: full-LIST rate under a sustained garbling storm (the rewind
+    #: path's bound). Doubt inside the window is DEFERRED (one timer),
+    #: never dropped — a burst whose last corrupt line lands mid-window
+    #: with the stream then going quiet must still get its re-list, or
+    #: the eaten event stays missing forever.
+    _WIRE_RESYNC_MIN_S = 5.0
+
+    def _integrity_resync(self, kind: str) -> None:
+        """Request a full list+RESYNC for ``kind`` after corrupt input.
+        The expire+request flags are set unconditionally (idempotent —
+        the NEXT reconnect re-lists no matter what); the stream CUT that
+        forces that reconnect now is paced: immediate when the rate
+        window is open, deferred to one shared timer when not. Callers
+        may hold a lane's stage_lock, so the cut — socket I/O — always
+        runs off-thread (executor job or the timer)."""
+        self._expire_stream(kind)
+        with self._gen_lock:
+            self._resync_req.add(kind)
+            self._wire_doubt.add(kind)
+        now = time.monotonic()
+        if now - self._wire_resync_at >= self._WIRE_RESYNC_MIN_S:
+            self._wire_resync_at = now
+            logger.warning(
+                "corrupt wire input on %s: scheduling full list+RESYNC",
+                kind,
+            )
+            self._submit(self._integrity_fire)
+            return
+        with self._gen_lock:
+            if self._wire_timer is None:
+                wait = max(
+                    0.05,
+                    self._WIRE_RESYNC_MIN_S - (now - self._wire_resync_at),
+                )
+                t = threading.Timer(wait, self._integrity_fire)
+                t.daemon = True
+                self._wire_timer = t
+                t.start()
+
+    def _integrity_fire(self) -> None:
+        """Serve every pending integrity doubt: cut the doubted kinds'
+        live streams so their watch loops reconnect (and re-list, per the
+        flags) now. Runs on an executor worker or the deferral timer —
+        never under a lane lock."""
+        with self._gen_lock:
+            timer, self._wire_timer = self._wire_timer, None
+            kinds = set(self._wire_doubt)
+            self._wire_doubt.clear()
+        if timer is not None:
+            timer.cancel()  # idempotent; closes the fire-vs-arm race
+        if not self._running or not kinds:
+            return
+        self._wire_resync_at = time.monotonic()
+        self._inc("watch_integrity_resyncs_total")
+        for kind in kinds:
             w = self._watches.get(kind)
             if w is None:
                 continue
             try:
                 w.stop()
             except Exception:
-                # a dying/already-replaced handle: the reconnect path
-                # owns recovery either way
-                swallowed("resync_stream_stop")
+                swallowed("integrity_resync_stop")
 
     # ------------------------------------- crash-durable restarts (ckpt)
 
@@ -932,6 +1080,14 @@ class ClusterEngine:
     def _now(self) -> float:
         return time.time() - self._epoch
 
+    def _skewed_now(self) -> float:
+        """The clock.jump arm of ``_now`` (installed as an instance
+        attribute only when the fault spec configures clock.jump): engine
+        time plus the plane's bounded, seeded skew. Everything downstream
+        — timers, heartbeats, checkpoint residues — sees the hostile
+        clock; the restart-soak oracle proves nothing double-fires."""
+        return time.time() - self._epoch + self._faults.clock_skew()
+
     # ------------------------------------------------------- selector checks
 
     def _node_need_heartbeat(self, node: dict) -> bool:
@@ -992,6 +1148,7 @@ class ClusterEngine:
             self._ckpt = ckpt_mod.Checkpointer(
                 self._ckpt_dir, self._ckpt_name,
                 self.config.checkpoint_interval, telemetry=self.telemetry,
+                degradation=self._degradation,
             )
             data = ckpt_mod.load(self._ckpt_dir, self._ckpt_name)
             if data is not None:
@@ -1046,6 +1203,21 @@ class ClusterEngine:
                 else self._tick_loop
             )
             self._threads.append(spawn_worker(loop, name="kwok-tick"))
+        if run_tick_loop and self._audit_interval > 0:
+            # anti-entropy auditor (resilience/antientropy.py): paced
+            # apiserver-vs-rows drift detection + per-row repair, off by
+            # default; supervised so a crashed pass restarts in place
+            # (the restart needs no stream resync — see
+            # _worker_restarted_resync)
+            from kwok_tpu.resilience.antientropy import AntiEntropyAuditor
+
+            self._auditor = AntiEntropyAuditor(self, self._audit_interval)
+            wd = self._watchdog
+            self._threads.append(
+                wd.spawn(self._auditor.run, name="kwok-audit")
+                if wd is not None
+                else spawn_worker(self._auditor.run, name="kwok-audit")
+            )
         # ready flips on the device-owning loop once the startup catch-up
         # gate (first full re-list + checkpoint reconcile) completes —
         # NOT here: a restarted engine reporting ready with empty rows is
@@ -1112,6 +1284,10 @@ class ClusterEngine:
             self._watchdog.close()  # shutdown crashes must not restart
         if self._faults is not None:
             self._faults.stop()  # chaos killer thread down first
+        with self._gen_lock:
+            timer, self._wire_timer = self._wire_timer, None
+        if timer is not None:
+            timer.cancel()  # pending integrity-doubt cut dies with us
         if getattr(self, "_profiling", False):
             # short runs stop before tick 102; flush the trace anyway
             import jax
@@ -1187,6 +1363,9 @@ class ClusterEngine:
 
     def _spawn_watch(self, kind: str, **sel) -> None:
         opts = {k: v for k, v in sel.items() if v}
+        # the anti-entropy auditor lists through the same selectors, so
+        # its apiserver window is exactly the set this engine tracks
+        self._watch_opts[kind] = dict(opts)
 
         def loop():
             # capability only: parsing happens on the tick thread
@@ -1330,12 +1509,7 @@ class ClusterEngine:
                         rewind = None
                         for obj in objs:
                             self._q.put((kind, ADDED, obj, time.monotonic()))
-                            rv = int(
-                                (obj.get("metadata") or {}).get(
-                                    "resourceVersion"
-                                )
-                                or 0
-                            )
+                            rv = _rv_of(obj.get("metadata") or {})
                             if rv and rewind is None:
                                 tracked = self._tracked_rv(kind, obj)
                                 if tracked and rv < tracked:
@@ -1428,12 +1602,9 @@ class ClusterEngine:
                         resume_rv = self._watch_rv.get(kind, 0)
                     else:
                         for ev in w:
-                            rv = int(
-                                (ev.object.get("metadata") or {}).get(
-                                    "resourceVersion"
-                                )
-                                or 0
-                            )
+                            # tolerant parse: a garbled-but-parseable rv
+                            # must not kill (or retry-loop) the stream
+                            rv = _rv_of(ev.object.get("metadata") or {})
                             if rv:
                                 resume_rv = rv
                             if ev.type == BOOKMARK:
@@ -1678,7 +1849,14 @@ class ClusterEngine:
                 try:
                     rec = self._batch_parser.parse(line)
                 except Exception:
+                    # quarantine + integrity doubt: the line's rv is
+                    # unreadable, so nothing after this point in the
+                    # stream can vouch for completeness — stop committing
+                    # rvs and let the bounded-rate re-list re-deliver
                     logger.warning("unparseable watch line: %.120r", line)
+                    self._wire_reject(kind, "unparseable")
+                    latest_rv = 0
+                    rv_dead = True
                     continue
                 if rec.type == "ERROR":
                     self._drain_error_line(kind, line, gen)
@@ -1781,6 +1959,10 @@ class ClusterEngine:
         if type_ == "RESYNC":
             self._resync(kind, obj)
             return
+        if type_ in ("MODIFIED", DELETED) and self._stale_dict_event(
+            kind, obj
+        ):
+            return
         if kind == "nodes":
             if type_ == DELETED:
                 self._node_deleted(obj)
@@ -1791,6 +1973,44 @@ class ClusterEngine:
                 self._pod_deleted(obj)
             else:
                 self._pod_upsert(obj)
+
+    def _stale_dict_event(self, kind: str, obj: dict) -> bool:
+        """The dict-path stale-rv tier (plain-iterator clients and the
+        record path's full-parse fallback): True when this MODIFIED or
+        DELETED event's revision regressed below the row's last ingested
+        one — a replay, dropped and counted. A replayed DELETED is the
+        nastiest shape: applying it releases a LIVE row (the object was
+        deleted and re-created at a higher rv since), so it gets the
+        same guard; the re-list prune path carries no rv and is exempt
+        by construction. ADDED events are never guarded:
+        restore-recovery re-lists deliver legitimately regressed
+        revisions that must apply (a replayed ADDED resurrecting a
+        deleted object's row is the auditor's ghost-row case)."""
+        meta = obj.get("metadata") or {}
+        try:
+            rv = int(meta.get("resourceVersion") or 0)
+        except (TypeError, ValueError):
+            return False
+        if not rv:
+            return False
+        name = meta.get("name")
+        if not name:
+            return False
+        key = (meta.get("namespace") or "default", name) \
+            if kind == "pods" else name
+        k = self.pods if kind == "pods" else self.nodes
+        idx = k.pool.lookup(key)
+        if idx is None:
+            return False
+        m = k.pool.meta[idx] or {}
+        try:
+            seen = int(m.get("rv") or 0)
+        except (TypeError, ValueError):
+            return False
+        if seen and rv < seen:
+            wire_reject("stale_rv")
+            return True
+        return False
 
     def _ingest_record(self, kind: str, rec) -> None:
         """Native-ingest fast path (tick thread): drop events whose
@@ -1815,6 +2035,17 @@ class ClusterEngine:
                 idx = k.pool.lookup(key)
                 if idx is not None:
                     m = k.pool.meta[idx]
+                    # stale-rv tier: a MODIFIED whose revision regressed
+                    # below what this row already ingested is provably a
+                    # replay (wire.dup/wire.stale, reconnect replays) —
+                    # an object's own rv never legitimately decreases.
+                    # Dropped BEFORE the echo tiers so old content can
+                    # never overwrite newer row meta. ADDED stays exempt:
+                    # restore-recovery re-lists legitimately deliver
+                    # regressed revisions and must apply.
+                    if rec.rv and rec.rv < int(m.get("rv") or 0):
+                        wire_reject("stale_rv")
+                        return
                     if (
                         not (rec.flags & 2)  # no deletionTimestamp
                         and m.get("fp_meta_sel") == rec.fp_meta_sel
@@ -1845,6 +2076,9 @@ class ClusterEngine:
                 idx = k.pool.lookup(rec.name)
                 if idx is not None:
                     m = k.pool.meta[idx]
+                    if rec.rv and rec.rv < int(m.get("rv") or 0):
+                        wire_reject("stale_rv")  # see the pod tier above
+                        return
                     if m.get("fp_meta_sel") == rec.fp_meta_sel:
                         if rec.fp_status_nc == m.get("fp_nsc_done"):
                             return  # heartbeat echo / no observable drift
@@ -1869,8 +2103,14 @@ class ClusterEngine:
         # full path: parse the raw line once and run the normal ingest
         try:
             doc = json.loads(rec.raw)
-        except json.JSONDecodeError:
+        except ValueError:
+            # JSONDecodeError or UnicodeDecodeError — garbled bytes are
+            # frequently not valid UTF-8 either
+            # corrupt bytes that slipped past the C scanner: quarantine
+            # (counted) and treat as integrity doubt — the bounded-rate
+            # full re-list re-delivers whatever this line carried
             logger.warning("bad watch line: %.120r", rec.raw)
+            self._wire_reject(kind, "unparseable")
             return
         obj = doc.get("object") or {}
         ev_type = doc.get("type") or type_
@@ -1878,6 +2118,10 @@ class ClusterEngine:
             logger.warning("watch error event: %s", obj)
             return
         if ev_type not in (ADDED, "MODIFIED", DELETED):
+            return
+        if ev_type in ("MODIFIED", DELETED) and self._stale_dict_event(
+            kind, obj
+        ):
             return
         if kind == "pods":
             if ev_type == DELETED:
@@ -1982,6 +2226,7 @@ class ClusterEngine:
         record = batch.record
         ing = self._ingest_record
         pending: set = set()
+        stale_drops = 0  # regressed-rv replays dropped (counted once)
         cols: list = []  # (key, node, meta, cond_bits, has_del)
 
         def flush_cols() -> None:
@@ -2086,6 +2331,11 @@ class ClusterEngine:
                 # inlined first-tier echo drop (_ingest_record's
                 # steady-state MODIFIED case) on plain gathered ints
                 m = meta[row]
+                if rvs_l[j] and rvs_l[j] < (m.get("rv") or 0):
+                    # inlined stale-rv tier (see _ingest_record): a
+                    # regressed-revision replay never overwrites the row
+                    stale_drops += 1
+                    continue
                 if (
                     not (f & 2)
                     and m.get("fp_meta_sel") == fp_meta[j]
@@ -2161,6 +2411,8 @@ class ClusterEngine:
             pending.add(key)
             cols.append((key, node, m, cond, has_del))
         flush_cols()
+        if stale_drops:
+            wire_reject("stale_rv", stale_drops)
 
     def _resync(self, kind: str, objs: list[dict]) -> None:
         """Free rows for objects that vanished while the watch was down."""
@@ -2205,7 +2457,7 @@ class ClusterEngine:
             if need_lock:
                 bits |= 1 << self.node_bits[SEL_MANAGED]
         new_row = idx is None
-        meta_rv = int(meta.get("resourceVersion") or 0)
+        meta_rv = _rv_of(meta)
         if new_row:
             if k.pool.full:
                 self._grow(k)
@@ -2326,7 +2578,7 @@ class ClusterEngine:
             host_ip=status.get("hostIP") or "",
             status_scalar=set(status) <= _SCALAR_STATUS_KEYS,
             # checkpoint identity: the restore's (uid, rv) match key
-            rv=int(meta.get("resourceVersion") or 0),
+            rv=_rv_of(meta),
             uid=meta.get("uid") or "",
         )
         m.pop("raw", None)  # the parsed object supersedes any raw line
@@ -2403,7 +2655,7 @@ class ClusterEngine:
         if obj is None and "raw" in m:
             try:
                 doc = json.loads(m["raw"])
-            except json.JSONDecodeError:
+            except ValueError:  # garbled raw line (or bad UTF-8)
                 return None
             obj = doc.get("object") or {}
             m["obj"] = obj

@@ -124,6 +124,17 @@ class _LaneEngine(ClusterEngine):
             return
         ls.parent._mark_resync(kind, self._lane_index)
 
+    def _integrity_resync(self, kind: str) -> None:
+        # corrupt input detected while THIS lane applied a routed record:
+        # the watch handles (and the resync bookkeeping the reconnect
+        # reads) live on the parent, so the quarantine's re-list request
+        # must land there
+        ls = self._lane_set
+        if ls is None:
+            super()._integrity_resync(kind)
+            return
+        ls.parent._integrity_resync(kind)
+
 
 class ShardLane:
     """One hash-partition of the host pipeline: ingest queue + drain
@@ -142,6 +153,7 @@ class ShardLane:
             trace_dump="",  # one dump, owned by the parent
             faults="off",  # ONE fault plane, the parent's (shared below)
             checkpoint_dir="off",  # ONE checkpoint, the parent's stacked
+            audit_interval=-1.0,  # ONE auditor, the parent's (env-proof)
         )
         e = _LaneEngine(parent.client, cfg, telemetry=parent.telemetry)
         e._lane_set = lane_set

@@ -125,6 +125,16 @@ def build_parser(defaults) -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-interval", type=float,
                    default=o.checkpointInterval,
                    help="checkpoint cadence in seconds")
+    p.add_argument("--audit-interval", type=float,
+                   default=o.auditInterval,
+                   help="anti-entropy auditor cadence in seconds: a paced "
+                   "background pass diffs a budgeted window of apiserver "
+                   "objects against engine rows by (uid, rv, phase), "
+                   "classifies silent divergence (missed-event / "
+                   "double-apply / stale-row / ghost-row) and repairs "
+                   "per row via re-ingest (docs/resilience.md). "
+                   "KWOK_TPU_AUDIT_INTERVAL works too; 0 = off "
+                   "(no thread, no LISTs)")
     p.add_argument("--drain-deadline", type=float,
                    default=o.drainDeadline,
                    help="SIGTERM graceful-drain bound: flush in-flight "
@@ -172,6 +182,7 @@ def _engine_config(args, stages: list[Stage]):
         worker_restart_window=args.worker_restart_window,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_interval=args.checkpoint_interval,
+        audit_interval=args.audit_interval,
         node_rules=stages_to_rules(stages, ResourceKind.NODE),
         pod_rules=stages_to_rules(stages, ResourceKind.POD),
     )

@@ -129,10 +129,21 @@ class EngineServer:
                         return
                     if getattr(engine, "degraded", False):
                         # degraded mode (resilience/policy.py): shedding
-                        # load or out of worker restart budget — alive
+                        # load, out of worker restart budget, a downed
+                        # checkpoint disk, or unrepaired drift — alive
                         # (/livez stays 200) but don't send it traffic;
-                        # kwok_degraded{reason=} on /metrics names why
-                        self.send_error(503, "engine degraded")
+                        # the active reasons ride the status line so a
+                        # probe log names the cause without a scrape
+                        # (kwok_degraded{reason=} has the full detail)
+                        deg = getattr(engine, "_degradation", None)
+                        reasons = ",".join(
+                            getattr(deg, "reasons", ())
+                        ) if deg is not None else ""
+                        self.send_error(
+                            503,
+                            "engine degraded"
+                            + (f": {reasons}" if reasons else ""),
+                        )
                         return
                     body = b"ok"
                     ctype = "text/plain"

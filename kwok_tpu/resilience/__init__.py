@@ -19,8 +19,14 @@ guide):
   atomic-rename checkpoint of device-resident timer state
   (``--checkpoint-dir``), and the cold-start/refill reconcile that
   resumes matching rows' Stage delays after a ``kill -9``.
+- ``antientropy`` (ISSUE 10): the continuous convergence oracle — a
+  paced background pass diffing budgeted windows of apiserver truth
+  against engine rows by ``(uid, rv, phase)``, classifying silent
+  divergence and repairing per row via re-ingest
+  (``--audit-interval``).
 """
 
+from kwok_tpu.resilience.antientropy import AntiEntropyAuditor
 from kwok_tpu.resilience.checkpoint import (
     Checkpointer,
     RestoreSession,
@@ -43,6 +49,7 @@ from kwok_tpu.resilience.policy import (
 from kwok_tpu.resilience.watchdog import Watchdog
 
 __all__ = [
+    "AntiEntropyAuditor",
     "Backoff",
     "Checkpointer",
     "Degradation",

@@ -193,6 +193,7 @@ class Checkpointer:
         name: str,
         interval: float,
         telemetry=None,
+        degradation=None,
     ) -> None:
         self.directory = directory
         self.name = name
@@ -200,6 +201,10 @@ class Checkpointer:
         self.path = checkpoint_path(directory, name)
         self._tmp = self.path + ".tmp"
         self._telemetry = telemetry
+        # the engine's Degradation ledger: a writer that cannot reach
+        # disk (ENOSPC, read-only remount) flips kwok_degraded{reason=
+        # "checkpoint"} while it retries, cleared on the next good write
+        self._degradation = degradation
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._thread: "threading.Thread | None" = None
         self._next = time.monotonic() + self.interval
@@ -245,16 +250,86 @@ class Checkpointer:
     # --------------------------------------------------------------- writer
 
     def _write_loop(self) -> None:
+        from kwok_tpu.resilience.policy import CKPT_RETRY
+
+        backoff = None
+        snap = None
         while True:
-            snap = self._q.get()
+            if snap is None:
+                snap = self._q.get()
             if snap is None:
                 return
             try:
                 self._write(snap)
-            except Exception:
-                # one failed write must not end checkpointing; the next
-                # cadence retries with fresher data
+            except OSError:
+                # disk trouble (ENOSPC, EIO, read-only remount): the tmp
+                # write failed BEFORE os.replace, so the last good
+                # checkpoint on disk is intact by construction. Degrade
+                # (kwok_degraded{reason="checkpoint"}; /readyz 503 —
+                # this engine's crash durability is gone until the disk
+                # heals) and retry under the shared policy — always with
+                # the NEWEST snapshot available, because writing a stale
+                # one after a fresher gather queued would move the
+                # restore target BACKWARD.
                 logger.exception("checkpoint write failed (%s)", self.path)
+                if self._degradation is not None and self._degradation.set(
+                    "checkpoint"
+                ):
+                    logger.error(
+                        "engine degraded: checkpoint writer cannot reach "
+                        "disk (%s); retrying under policy", self.path,
+                    )
+                if backoff is None:
+                    backoff = CKPT_RETRY.session()
+                snap = self._retry_wait(snap, backoff.next_delay() or 1.0)
+                if snap is None:
+                    return  # stop sentinel drained mid-retry
+                continue
+            except Exception:
+                # a serialization bug is not a disk outage: one failed
+                # write must not end checkpointing; the next cadence
+                # retries with fresher data
+                logger.exception("checkpoint write failed (%s)", self.path)
+                snap = None
+                continue
+            if backoff is not None:
+                backoff = None
+                if self._degradation is not None and self._degradation.clear(
+                    "checkpoint"
+                ):
+                    logger.info(
+                        "checkpoint writer recovered (%s)", self.path
+                    )
+            snap = None
+
+    def _retry_wait(self, snap: dict, delay: float) -> "dict | None":
+        """Sleep out one write-retry backoff window on the writer thread,
+        absorbing anything newer that queues meanwhile: the freshest
+        snapshot supersedes the failed one. Returns the snapshot to retry
+        (never older than ``snap``) or None when the stop sentinel
+        arrived — after one last best-effort write of the freshest
+        gather, so a shutdown during a disk outage still tries to leave
+        the newest state behind."""
+        deadline = time.monotonic() + delay
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return snap
+            try:
+                nxt = self._q.get(timeout=min(remaining, 0.2))
+            except queue.Empty:
+                continue
+            if nxt is None:
+                try:
+                    self._write(snap)
+                except OSError:
+                    logger.error(
+                        "final checkpoint write failed during disk "
+                        "outage; last good checkpoint (%s) left intact",
+                        self.path,
+                    )
+                return None
+            snap = nxt
 
     def _write(self, snapshot: dict) -> None:
         t0 = time.perf_counter()

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import ctypes
 import fnmatch
+import json
 import logging
 import random
 import threading
@@ -72,6 +73,12 @@ KINDS = (
     "list.fail",      # LIST raises a connection error
     "api.blackout",   # all transport fails for arg seconds (restart)
     "worker.kill",    # kill matching workers every arg seconds
+    # hostile-wire tier (ISSUE 10): bytes are WRONG, not just absent
+    "wire.garble",    # flip/insert bytes in a watch line / LIST body
+    "wire.truncate",  # cut a line mid-JSON, then die without a clean close
+    "wire.dup",       # replay the immediately-prior event/line
+    "wire.stale",     # re-deliver an OLD event (regressed resourceVersion)
+    "clock.jump",     # skew the engine's `now` by uniform(-arg, +arg)
 )
 
 
@@ -177,6 +184,9 @@ class FaultPlane:
         # blackout state: monotonic deadline; reads are lock-free (float
         # store is GIL-atomic), arming happens under the fault lock
         self._blackout_until = 0.0
+        # clock.jump skew: the offset added to engine `now`; re-drawn (not
+        # accumulated — convergence must stay bounded) on each firing draw
+        self._skew = 0.0
         self._fault_lock = threading.Lock()
         self._events: dict[str, int] = {}
         self._started = 0
@@ -213,6 +223,48 @@ class FaultPlane:
     def kill_log(self) -> list[dict]:
         with self._fault_lock:
             return list(self._kill_results)
+
+    # ---------------------------------------------------------- hostile wire
+
+    def clock_skew(self) -> float:
+        """The current clock.jump skew in seconds, re-drawn from the
+        kind's stream with its configured probability per read. The skew
+        JUMPS to a fresh uniform(-arg, +arg) value instead of
+        accumulating, so hostile clocks stay bounded (arg must be well
+        under the heartbeat interval). Only the engine's ``_now`` calls
+        this, and only when the spec configures clock.jump."""
+        rate = self.decide("clock.jump")
+        if rate is not None:
+            rng, lock = self._streams["clock.jump"]
+            with lock:
+                self._skew = rng.uniform(-rate.arg, rate.arg)
+            self.record("clock.jump")
+        return self._skew
+
+    def garble_bytes(self, data: bytes) -> bytes:
+        """One seeded byte-level corruption: flip a byte to a different
+        value, or insert a junk byte — the two shapes a hostile wire
+        produces without changing framing. Callers already drew the
+        wire.garble decision; this only draws the corruption shape."""
+        if not data:
+            return b"\xff"
+        rng, lock = self._streams["wire.garble"]
+        with lock:
+            i = rng.randrange(len(data))
+            delta = rng.randrange(1, 256)
+            insert = rng.random() < 0.5
+        if insert:
+            return data[:i] + bytes((delta,)) + data[i:]
+        return data[:i] + bytes(((data[i] ^ delta),)) + data[i + 1:]
+
+    def truncate_bytes(self, data: bytes) -> bytes:
+        """A seeded mid-JSON cut: a strict, non-empty prefix."""
+        if len(data) < 2:
+            return data[:1]
+        rng, lock = self._streams["wire.truncate"]
+        with lock:
+            k = rng.randrange(1, len(data))
+        return data[:k]
 
     # ------------------------------------------------------------- blackout
 
@@ -332,7 +384,33 @@ class FaultyClient:
         if self._plane.decide("list.fail") is not None:
             self._plane.record("list.fail")
             raise FaultInjected(f"injected list failure ({kind})")
-        return self._inner.list(kind, **kw)
+        out = self._inner.list(kind, **kw)
+        if self._plane.decide("wire.truncate") is not None:
+            # a LIST body cut mid-JSON: the whole-document parse fails —
+            # the same error shape json.loads raises in the real client
+            self._plane.record("wire.truncate")
+            raise FaultInjected(f"injected truncated LIST body ({kind})")
+        if self._plane.decide("wire.garble") is not None:
+            self._plane.record("wire.garble")
+            return self._garble_list(kind, out)
+        return out
+
+    def _garble_list(self, kind, items):
+        """Byte-corrupt the LIST body: serialize, garble, re-parse.
+        A parse failure is what a real garbled body does to the client
+        (raised, caller re-lists); a still-parseable result carries the
+        corrupted values into ingest — the anti-entropy auditor's case."""
+        blob = json.dumps({"items": items}, separators=(",", ":")).encode()
+        try:
+            doc = json.loads(self._plane.garble_bytes(blob))
+            got = doc.get("items")
+            if not isinstance(got, list):
+                raise ValueError("garbled items")
+        except ValueError:
+            raise FaultInjected(
+                f"injected garbled LIST body ({kind})"
+            ) from None
+        return [o for o in got if isinstance(o, dict)]
 
     def watch(self, kind, **kw):
         self._plane.transport_fault("watch")
@@ -370,12 +448,19 @@ class FaultyClient:
 
 class FaultyWatch:
     """Watch-handle wrapper: cuts the stream (connection drop) with
-    ``watch.cut`` probability per event/line. The native reader is
+    ``watch.cut`` probability per event/line, and speaks the hostile-wire
+    tier — ``wire.dup`` (replay the prior event), ``wire.stale``
+    (re-deliver an old event whose resourceVersion has regressed),
+    ``wire.garble`` (byte corruption) and ``wire.truncate`` (a mid-JSON
+    cut followed by an abrupt stream death). The native reader is
     disabled — it reads the socket from C, where per-line injection
     cannot reach — so a faulted engine always takes a Python-visible
     ingest path (raw_lines when the inner handle has it)."""
 
     native_reader = None  # force the per-line path under faults
+
+    #: replay window for wire.dup / wire.stale (per stream)
+    _RECENT = 64
 
     def __init__(self, plane: FaultPlane, inner):
         self._plane = plane
@@ -388,23 +473,94 @@ class FaultyWatch:
     def _cut(self) -> bool:
         if self._plane.decide("watch.cut") is not None:
             self._plane.record("watch.cut")
-            try:
-                self._inner.stop()
-            except Exception:
-                logger.debug("inner watch stop failed mid-cut", exc_info=True)
+            self._stop_inner()
             return True
         return False
 
+    def _stop_inner(self) -> None:
+        try:
+            self._inner.stop()
+        except Exception:
+            logger.debug("inner watch stop failed mid-cut", exc_info=True)
+
     def __iter__(self):
+        """Parsed-event path (clients without raw_lines): the wire tier is
+        emulated at the event level. Garble serializes the event document,
+        corrupts bytes, and re-parses — a still-parseable result delivers
+        the corrupted values (the auditor's case); an unparseable one ends
+        the stream the way the hardened client does on a bad line
+        (integrity doubt -> reconnect resumes and the server replays)."""
+        import collections
+        import json as _json
+
+        from kwok_tpu.edge.kubeclient import WatchEvent
+
+        plane = self._plane
+        recent: "collections.deque" = collections.deque(maxlen=self._RECENT)
         for ev in self._inner:
             if self._cut():
                 return
+            if recent and plane.decide("wire.dup") is not None:
+                plane.record("wire.dup")
+                yield recent[-1]
+            if recent and plane.decide("wire.stale") is not None:
+                plane.record("wire.stale")
+                yield recent[0]
+            if plane.decide("wire.truncate") is not None:
+                plane.record("wire.truncate")
+                self._stop_inner()
+                return  # the half-delivered event dies with the stream
+            if plane.decide("wire.garble") is not None:
+                plane.record("wire.garble")
+                blob = plane.garble_bytes(_json.dumps(
+                    {"type": ev.type, "object": ev.object},
+                    separators=(",", ":"), default=str,
+                ).encode())
+                try:
+                    doc = _json.loads(blob)
+                    type_ = doc.get("type")
+                    obj = doc.get("object")
+                    if type_ not in ("ADDED", "MODIFIED", "DELETED",
+                                     "BOOKMARK") or not isinstance(obj, dict):
+                        raise ValueError("garbled event")
+                except ValueError:
+                    # unparseable on the wire: the hardened client treats
+                    # it as integrity doubt and ends the stream
+                    self._stop_inner()
+                    return
+                recent.append(ev)
+                yield WatchEvent(type_, obj)
+                continue
+            recent.append(ev)
             yield ev
 
     def _raw_lines(self):
+        """Raw byte-line path (the engine's native-parse ingest edge):
+        the wire tier operates on the real bytes."""
+        import collections
+
+        plane = self._plane
+        recent: "collections.deque" = collections.deque(maxlen=self._RECENT)
         for line in self._inner.raw_lines():
             if self._cut():
                 return
+            if recent and plane.decide("wire.dup") is not None:
+                plane.record("wire.dup")
+                yield recent[-1]
+            if recent and plane.decide("wire.stale") is not None:
+                plane.record("wire.stale")
+                yield recent[0]
+            if plane.decide("wire.truncate") is not None:
+                plane.record("wire.truncate")
+                yield plane.truncate_bytes(line)
+                self._stop_inner()
+                return  # mid-JSON cut, no clean close
+            if plane.decide("wire.garble") is not None:
+                plane.record("wire.garble")
+                recent.append(line)
+                yield plane.garble_bytes(line)
+                continue
+            recent.append(line)
             yield line
 
     def stop(self) -> None:

@@ -105,6 +105,11 @@ PUMP_RESEND = RetryPolicy(base=0.05, cap=0.5, deadline=5.0)
 # only): enough attempts to ride out an apiserver restart window.
 PATCH_RETRY = RetryPolicy(base=0.1, cap=1.0, deadline=8.0)
 
+# Checkpoint writer disk retries (ENOSPC / read-only remounts): no
+# deadline — a degraded-but-retrying writer beats silently losing crash
+# durability, and every retry uses the newest queued snapshot.
+CKPT_RETRY = RetryPolicy(base=0.2, cap=5.0)
+
 _DEGRADED_HELP = (
     "Degraded-mode reasons currently active (1 = degraded): queue "
     "shedding, exhausted worker restart budgets, a downed pump; "

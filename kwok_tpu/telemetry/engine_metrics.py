@@ -82,6 +82,10 @@ _HELP = {
     "reconnects and patch-executor retries); a nonzero rate means the "
     "apiserver's max-inflight bands are saturated and the engine is "
     "backing off instead of hammering",
+    "kwok_watch_integrity_resyncs_total": "Full list+RESYNC passes "
+    "scheduled because corrupt wire input (an unparseable watch line) "
+    "cast doubt on stream completeness; bounded to one per 5s so a "
+    "garbling storm cannot LIST-storm the apiserver",
 }
 
 # legacy counter name -> (family name, has kind label)
@@ -99,6 +103,9 @@ _COUNTERS = {
     "ticks_total": ("kwok_ticks_total", False),
     "pump_requests_total": ("kwok_pump_requests_total", False),
     "rv_rewinds_total": ("kwok_rv_rewinds_total", False),
+    "watch_integrity_resyncs_total": (
+        "kwok_watch_integrity_resyncs_total", False,
+    ),
 }
 
 _GAUGES = {

@@ -41,6 +41,17 @@ _restarts = PROCESS_REGISTRY.counter(
     "budget ran out and the engine went degraded",
     ("thread",),
 )
+_wire_rejects = PROCESS_REGISTRY.counter(
+    "kwok_wire_rejects_total",
+    "Corrupt or regressed wire input quarantined instead of applied: "
+    "unparseable watch lines (reason=unparseable -> integrity resync), "
+    "undecodable HTTP response bodies (http_body), watch-stream lines "
+    "the client rejected mid-iteration (watch_line), and MODIFIED "
+    "events whose resourceVersion regressed below the row's last "
+    "ingested revision (stale_rv — routine after reconnect replays, "
+    "hostile under wire.dup/wire.stale)",
+    ("reason",),
+)
 
 
 def swallowed(site: str) -> None:
@@ -69,6 +80,35 @@ def worker_restarted(thread_name: str) -> None:
 def worker_restarts_total(thread_name: str) -> int:
     """Test/diagnostic read of one thread's restart counter."""
     return _restarts.labels(thread=thread_name).value
+
+
+def worker_crashes_total(thread_name: str) -> int:
+    """Test/diagnostic read of one thread's crash counter."""
+    return _crashes.labels(thread=thread_name).value
+
+
+def worker_crash_ledger() -> dict:
+    """Every thread's (crashes, restarts) pair — the 'zero unsupervised
+    crashes' gate reads this: a crash without a matching restart means a
+    worker died for good outside the watchdog's care."""
+    out: dict = {}
+    for (thread,), c in _crashes.children():
+        out[thread] = [c.value, 0]
+    for (thread,), c in _restarts.children():
+        out.setdefault(thread, [0, 0])[1] = c.value
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def wire_reject(reason: str, n: int = 1) -> None:
+    """Account one quarantined corrupt/regressed wire record."""
+    _wire_rejects.labels(reason=reason).inc(n)
+
+
+def wire_rejects_total(reason: "str | None" = None) -> int:
+    """Test/diagnostic read: one reason's tally, or the sum of all."""
+    if reason is not None:
+        return _wire_rejects.labels(reason=reason).value
+    return sum(c.value for _values, c in _wire_rejects.children())
 
 
 def render_nonempty() -> str:

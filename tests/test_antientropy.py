@@ -1,0 +1,494 @@
+"""Anti-entropy auditor tests (ISSUE 10 tentpole, half b).
+
+The drift gate itself (`make drift-check`) lives in
+benchmarks/drift_soak.py — a hostile-wire storm + seeded-divergence run
+emitting DRIFT_r01.json. These tests pin the pieces it is built from:
+
+- classification by (uid, rv, phase): missed-event / double-apply /
+  stale-row / ghost-row, with the settle re-check throwing out
+  in-flight transients;
+- repair via re-ingest through the engine's own queue (upsert repair
+  render re-asserts engine-owned status; synthetic DELETED releases
+  ghosts);
+- budgeted paging: bounded pages per pass, cursor resumed across
+  passes, ghost scan only after a full cycle;
+- degradation (reason `drift`) only when the SAME divergence survives
+  repair for consecutive passes, cleared by a clean pass;
+- zero cost when disabled: no thread, no auditor object.
+
+Most tests drive ``pass_once`` synchronously on an unstarted engine —
+full control, no timing flake; one threaded e2e proves the paced loop.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.rig import silent_delete, silent_patch  # noqa: E402
+from kwok_tpu.edge.mockserver import FakeKube  # noqa: E402
+from kwok_tpu.engine import ClusterEngine, EngineConfig  # noqa: E402
+from kwok_tpu.resilience.antientropy import AntiEntropyAuditor  # noqa: E402
+from tests.test_engine import make_node, make_pod  # noqa: E402
+
+
+def _wait(pred, timeout=30.0, every=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _silent_patch(store, kind, ns, name, mutate):
+    assert silent_patch(store, kind, ns, name, mutate)
+
+
+def _silent_delete(store, kind, ns, name):
+    assert silent_delete(store, kind, ns, name)
+
+
+def _sync_engine(kube, **cfg):
+    """An unstarted single-lane engine driven synchronously: ingest via
+    tick_once / explicit queue drains, the auditor via pass_once."""
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True, **cfg))
+    eng._running = True
+    eng.ready = True
+    eng._startup_pending = None
+    return eng
+
+
+def _drain(eng):
+    """Apply everything queued (watchless synchronous mode)."""
+    import queue
+
+    raw: dict = {}
+    while True:
+        try:
+            item = eng._q.get_nowait()
+        except queue.Empty:
+            break
+        if item is not None:
+            eng._drain_apply(item, raw)
+    eng._drain_flush(raw)
+
+
+def _seed(eng, kube, pods=4):
+    kube.create("nodes", make_node("ae-n"))
+    eng._ingest("nodes", "ADDED", kube.get("nodes", None, "ae-n"))
+    names = [f"aep{i}" for i in range(pods)]
+    for n in names:
+        kube.create("pods", make_pod(n, node="ae-n"))
+        eng._ingest("pods", "ADDED", kube.get("pods", "default", n))
+    return names
+
+
+def _auditor(eng, **kw):
+    kw.setdefault("settle_s", 0.05)
+    return AntiEntropyAuditor(eng, 0.5, **kw)
+
+
+# -------------------------------------------------------- classification
+
+
+def test_converged_state_detects_nothing():
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    _seed(eng, kube)
+    aud = _auditor(eng)
+    aud.pass_once()
+    assert aud.detected_total() == 0
+    assert aud.repaired_total == 0
+    assert not eng.degraded
+
+
+def test_stale_row_detected_and_repaired():
+    """A silent server-side status rewind (no event, no rv bump): the
+    auditor classifies stale-row and the re-ingest repair re-asserts the
+    engine-owned phase onto the server."""
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    names = _seed(eng, kube)
+    victim = names[0]
+    # engine says Running (device truth); server silently rewound
+    idx = eng.pods.pool.lookup(("default", victim))
+    eng.pods.phase_h[idx] = eng._pod_phase_ids["Running"]
+    kube.patch_status("pods", "default", victim,
+                      {"status": {"phase": "Running"}})
+    eng.pods.pool.meta[idx]["rv"] = int(
+        kube.get("pods", "default", victim)["metadata"]["resourceVersion"]
+    )
+
+    def rewind(obj):
+        obj.setdefault("status", {})["phase"] = "Pending"
+
+    _silent_patch(kube, "pods", "default", victim, rewind)
+    aud = _auditor(eng)
+    aud.pass_once()
+    assert aud.detected_total(reason="stale-row") == 1
+    assert aud.repaired_total == 1
+    _drain(eng)  # apply the re-ingest; its repair render patches status
+    assert _wait(
+        lambda: (kube.get("pods", "default", victim) or {})
+        .get("status", {}).get("phase") == "Running",
+        5.0,
+    )
+    # next pass: converged again, nothing detected
+    aud.pass_once()
+    assert aud.detected_total() == 1
+
+
+def test_ghost_row_detected_and_released():
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    names = _seed(eng, kube)
+    ghost = names[1]
+    _silent_delete(kube, "pods", "default", ghost)
+    aud = _auditor(eng)
+    aud.pass_once()
+    assert aud.detected_total(reason="ghost-row") == 1
+    _drain(eng)  # apply the synthetic DELETED
+    assert eng.pods.pool.lookup(("default", ghost)) is None
+
+
+def test_ghost_uid_mismatch_reingested():
+    """Deleted + recreated under a new uid: classified ghost-row, but the
+    repair re-ingests the NEW object (the row continues under the fresh
+    identity instead of being released)."""
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    names = _seed(eng, kube)
+    victim = names[2]
+
+    def swap_uid(obj):
+        obj["metadata"]["uid"] = "uid-recreated"
+
+    _silent_patch(kube, "pods", "default", victim, swap_uid)
+    aud = _auditor(eng)
+    aud.pass_once()
+    assert aud.detected_total(reason="ghost-row") == 1
+    _drain(eng)
+    idx = eng.pods.pool.lookup(("default", victim))
+    assert idx is not None
+    from kwok_tpu.resilience.checkpoint import row_uid
+
+    assert row_uid(eng.pods.pool.meta[idx]) == "uid-recreated"
+
+
+def test_missed_event_reingested():
+    """An object the engine never saw (created silently): missed-event,
+    repaired by re-ingest — the row appears."""
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    _seed(eng, kube)
+    pod = make_pod("ae-missed", node="ae-n")
+    with kube._lock:
+        kube._bump(pod)  # a real server revision, no event emitted
+        kube._store["pods"][kube._key("default", pod["metadata"]["name"])] \
+            = pod
+    aud = _auditor(eng)
+    aud.pass_once()
+    assert aud.detected_total(reason="missed-event") == 1
+    _drain(eng)
+    assert eng.pods.pool.lookup(("default", "ae-missed")) is not None
+
+
+def test_double_apply_detected():
+    """Engine rv AHEAD of the server's (old-world state after a rewind
+    the engine somehow kept): classified double-apply, repaired by
+    re-ingesting the server's object (ADDED bypasses the stale-rv
+    MODIFIED guard by design)."""
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    names = _seed(eng, kube)
+    victim = names[3]
+    idx = eng.pods.pool.lookup(("default", victim))
+    eng.pods.pool.meta[idx]["rv"] = 10_000_000  # engine ahead of server
+    aud = _auditor(eng)
+    aud.pass_once()
+    assert aud.detected_total(reason="double-apply") == 1
+    _drain(eng)
+    srv_rv = int(
+        kube.get("pods", "default", victim)["metadata"]["resourceVersion"]
+    )
+    assert eng.pods.pool.meta[
+        eng.pods.pool.lookup(("default", victim))
+    ]["rv"] == srv_rv
+
+
+def test_settle_recheck_throws_out_transients():
+    """A divergence that heals during the settle window (an in-flight
+    patch landing) must not count: suspicion requires the SAME class
+    twice."""
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    names = _seed(eng, kube)
+    victim = names[0]
+
+    def rewind(obj):
+        obj.setdefault("status", {})["phase"] = "CrashLoopBackOff"
+
+    _silent_patch(kube, "pods", "default", victim, rewind)
+    aud = _auditor(eng, settle_s=0.2)
+    # the "in-flight patch": heal the server mid-settle from a thread
+    t = threading.Timer(0.05, lambda: _silent_patch(
+        kube, "pods", "default", victim,
+        lambda o: o.setdefault("status", {}).update(phase="Pending"),
+    ))
+    t.start()
+    try:
+        aud.pass_once()
+    finally:
+        t.cancel()
+    assert aud.detected_total() == 0
+
+
+# ------------------------------------------------------- budgeted paging
+
+
+class _PagingClient:
+    """A KubeClient stub with server-side pagination, recording every
+    page request (limit, cont)."""
+
+    def __init__(self, pods):
+        self.pods = pods  # list of dicts
+        self.calls: list = []
+
+    def list_page(self, kind, *, limit, cont="", **sel):
+        self.calls.append((kind, limit, cont))
+        if kind != "pods":
+            return [], ""
+        start = int(cont or 0)
+        page = self.pods[start:start + limit]
+        nxt = start + limit
+        return page, (str(nxt) if nxt < len(self.pods) else "")
+
+    def list(self, kind, **sel):
+        return self.pods if kind == "pods" else []
+
+    def get(self, kind, ns, name):
+        for o in self.pods if kind == "pods" else []:
+            if o["metadata"]["name"] == name:
+                return o
+        return None
+
+
+def test_budgeted_paging_resumes_cursor_across_passes():
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    pods = []
+    for i in range(10):
+        o = make_pod(f"pg{i}", node="ae-n")
+        o["metadata"]["uid"] = f"u{i}"
+        o["metadata"]["resourceVersion"] = str(i + 1)
+        pods.append(o)
+    client = _PagingClient(pods)
+    eng.client = client
+    aud = AntiEntropyAuditor(
+        eng, 0.5, page_size=2, max_pages=2, settle_s=0.01
+    )
+    items, done = aud._list_window("pods")
+    assert len(items) == 4 and not done  # 2 pages x 2, mid-scan
+    assert [c[2] for c in client.calls] == ["", "2"]
+    items, done = aud._list_window("pods")
+    assert len(items) == 4 and not done  # resumed at cursor 4
+    items, done = aud._list_window("pods")
+    assert len(items) == 2 and done  # wrapped: cycle complete
+    assert all(limit == 2 for _k, limit, _c in client.calls)
+
+
+def test_ghost_scan_waits_for_full_cycle():
+    """Rows absent from ONE window must not be ghost suspects until the
+    scan cursor wraps (they may simply live in a later page)."""
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    names = _seed(eng, kube, pods=6)
+    pods = [kube.get("pods", "default", n) for n in names]
+    client = _PagingClient(pods)
+    eng.client = client
+    aud = AntiEntropyAuditor(
+        eng, 0.5, page_size=2, max_pages=1, settle_s=0.01
+    )
+    # first two windows cover pages 0-1 and 2-3: no ghost suspects even
+    # though 4 of 6 engine rows are absent from each window
+    assert aud._scan_kind("pods") == []
+    assert aud._scan_kind("pods") == []
+    # last window wraps the cursor; every row was seen -> still clean
+    assert aud._scan_kind("pods") == []
+    # now a real ghost: drop one pod from the server's world
+    gone = pods.pop()
+    suspects = []
+    for _ in range(3):  # one full cycle of 1-page windows
+        suspects.extend(aud._scan_kind("pods"))
+    keys = [(s[0], s[1], s[2]) for s in suspects]
+    assert ("pods", ("default", gone["metadata"]["name"]), "ghost-row") \
+        in keys
+
+
+# -------------------------------------------------- degradation + repair
+
+
+def test_unrepaired_divergence_degrades_then_clears():
+    """Repair that cannot land (the re-ingest queue is never drained):
+    the same divergence re-confirms pass after pass — after 3 passes the
+    engine degrades with reason drift; draining (repair lands) plus one
+    clean pass clears it."""
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    names = _seed(eng, kube)
+    victim = names[0]
+    idx = eng.pods.pool.lookup(("default", victim))
+    eng.pods.pool.meta[idx]["rv"] = 10_000_000  # double-apply divergence
+    aud = _auditor(eng)
+    for i in range(3):
+        aud.pass_once()  # repair enqueued but never drained
+        assert aud.detected_total() == i + 1
+    assert eng.degraded
+    assert "drift" in eng._degradation.reasons
+    _drain(eng)  # repairs land (the last re-ingest fixes the rv)
+    # clearing is cycle-keyed: the streak survives until a full cycle
+    # STARTED after the last confirmation re-covers the window clean
+    aud.pass_once()
+    aud.pass_once()
+    assert not eng.degraded
+    assert "drift" not in eng._degradation.reasons
+
+
+def test_streaks_survive_multi_window_cycles():
+    """On a cluster larger than one window, a divergent object is only
+    re-scanned once per cycle: its streak must survive the intervening
+    healthy windows (pass-keyed streaks would reset and never degrade),
+    and healthy windows must not clear the degraded flag."""
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    names = _seed(eng, kube, pods=6)
+    victim = names[0]
+    idx = eng.pods.pool.lookup(("default", victim))
+    eng.pods.pool.meta[idx]["rv"] = 10_000_000  # double-apply divergence
+    pods = [kube.get("pods", "default", n) for n in names]
+    client = _PagingClient(pods)
+    eng.client = client
+    # 3 windows per cycle (2 pods each): the victim (page 0) is seen
+    # once every 3 passes
+    aud = AntiEntropyAuditor(
+        eng, 0.5, page_size=2, max_pages=1, settle_s=0.01
+    )
+    for cycle in range(3):
+        for _window in range(3):
+            aud.pass_once()  # repairs enqueued but never drained
+    # confirmed once per cycle -> streak reached the degrade threshold
+    # despite 2 healthy windows between confirmations
+    assert aud.detected_total(reason="double-apply") == 3
+    assert eng.degraded and "drift" in eng._degradation.reasons
+
+
+# ------------------------------------------------------------- lifecycle
+
+
+def test_zero_cost_when_disabled():
+    from kwok_tpu.workers import live_workers
+
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    eng.start()
+    try:
+        assert eng._auditor is None
+        assert not any(
+            n.startswith("kwok-audit") for n in live_workers()
+        )
+    finally:
+        eng.stop()
+
+
+def test_lane_children_never_audit(monkeypatch):
+    """ONE auditor per engine — the parent's; lane children force the
+    interval off even under the env var."""
+    monkeypatch.setenv("KWOK_TPU_AUDIT_INTERVAL", "1.0")
+    kube = FakeKube()
+    eng = ClusterEngine(
+        kube, EngineConfig(manage_all_nodes=True, drain_shards=2)
+    )
+    assert eng._audit_interval == 1.0
+    for lane in eng._lanes.lanes:
+        assert lane.engine._audit_interval == 0.0
+
+
+def test_threaded_e2e_paced_loop():
+    """The paced loop end to end on a threaded engine: converge, seed a
+    silent rewind + a ghost, and the kwok-audit worker detects and
+    repairs both within a couple of intervals."""
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(
+        manage_all_nodes=True, tick_interval=0.02, audit_interval=0.4,
+    ))
+    eng.start()
+    try:
+        kube.create("nodes", make_node("te-n"))
+        names = [f"tep{i}" for i in range(6)]
+        for n in names:
+            kube.create("pods", make_pod(n, node="te-n"))
+        assert _wait(lambda: all(
+            (kube.get("pods", "default", n) or {})
+            .get("status", {}).get("phase") == "Running" for n in names
+        ))
+        time.sleep(0.5)  # let the stream go quiet
+        _silent_patch(kube, "pods", "default", names[0],
+                      lambda o: o["status"].update(phase="Pending"))
+        _silent_delete(kube, "pods", "default", names[1])
+        assert _wait(
+            lambda: (kube.get("pods", "default", names[0]) or {})
+            .get("status", {}).get("phase") == "Running"
+            and eng.pods.pool.lookup(("default", names[1])) is None,
+            15.0,
+        )
+        aud = eng._auditor
+        assert aud.detected_total(reason="stale-row") >= 1
+        assert aud.detected_total(reason="ghost-row") >= 1
+        assert aud.repaired_total >= 2
+        # repairs held: a later pass finds nothing and the engine is
+        # not degraded
+        assert _wait(lambda: not eng.degraded, 5.0)
+    finally:
+        eng.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
+
+
+def test_expired_continue_token_is_not_a_completed_cycle():
+    """A 410-expired continue token mid-scan (typed ContinueExpired from
+    list_page) must read as a scan RESTART, not a completed cycle —
+    otherwise every unscanned engine row becomes a false ghost suspect
+    swept against an apiserver that just compacted. (A legitimately
+    empty final page still completes the cycle — the two signatures are
+    typed apart.)"""
+    from kwok_tpu.edge.kubeclient import ContinueExpired
+
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    _seed(eng, kube, pods=6)
+
+    class _ExpiringClient(_PagingClient):
+        def list_page(self, kind, *, limit, cont="", **sel):
+            if cont:  # every resumed cursor has expired
+                raise ContinueExpired(kind)
+            return super().list_page(kind, limit=limit, cont=cont, **sel)
+
+    pods = [kube.get("pods", "default", n)
+            for n in [f"aep{i}" for i in range(6)]]
+    client = _ExpiringClient(pods)
+    eng.client = client
+    aud = AntiEntropyAuditor(
+        eng, 0.5, page_size=2, max_pages=4, settle_s=0.01
+    )
+    items, done = aud._list_window("pods")
+    assert len(items) == 2 and not done  # restarted, NOT complete
+    assert aud._cursor["pods"] == ""  # scan restarts from the top
+    # and no ghost sweep happened: a pass confirms nothing
+    assert aud._scan_kind("pods") == []

@@ -789,3 +789,134 @@ def test_slow_consumer_termination_parity():
         assert not clean_end, (
             name, "slow close must be abrupt, not a clean deadline end"
         )
+
+
+# ------------------------------------------------- hostile request bytes
+# (ISSUE 10): garbled/truncated REQUEST bytes must answer 400 with a
+# Status body — byte-identical across the two servers — and never crash
+# a handler or wedge the store lock (a later clean request must work).
+
+
+def _raw_response(port: int, method: str, path: str, body: bytes,
+                  content_length: "int | None" = None,
+                  timeout: float = 5.0):
+    """One raw request -> (status, body_bytes). content_length overrides
+    the real length (the truncated-body case promises more bytes than it
+    sends, then half-closes)."""
+    s = _socket.socket()
+    s.settimeout(timeout)
+    s.connect(("127.0.0.1", port))
+    cl = len(body) if content_length is None else content_length
+    s.sendall(
+        f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {cl}\r\nConnection: close\r\n\r\n".encode()
+        + body
+    )
+    if content_length is not None and content_length > len(body):
+        s.shutdown(_socket.SHUT_WR)  # the rest of the body never comes
+    buf = b""
+    try:
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            buf += b
+    except _socket.timeout:
+        pass
+    s.close()
+    if not buf:
+        return None, b""
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    return status, rest
+
+
+_GARBLED = b'{"apiVersion":"v1","kind":"Pod","met\xff\x00adata":{{{{'
+
+
+def test_garbled_request_body_400_parity(srv):
+    """Garbled JSON in POST and PATCH bodies: both servers answer 400
+    with the byte-identical Status body, and both keep serving (store
+    lock untouched — body parse precedes every store call)."""
+    answers = {}
+    servers = {"native": srv.url}
+    py = HttpFakeApiserver().start()
+    servers["python"] = py.url
+    try:
+        for name, url in servers.items():
+            port = int(url.rsplit(":", 1)[1])
+            c = HttpKubeClient(url)
+            c.create("nodes", make_node("gb-n"))
+            c.create("pods", make_pod("gb-p", node="gb-n"))
+            got = {
+                "post": _raw_response(
+                    port, "POST", "/api/v1/namespaces/default/pods",
+                    _GARBLED,
+                ),
+                "patch": _raw_response(
+                    port, "PATCH",
+                    "/api/v1/namespaces/default/pods/gb-p/status",
+                    _GARBLED,
+                ),
+            }
+            # the server survived: a clean request still works, and the
+            # store lock is not wedged (a write succeeds)
+            assert c.get("pods", "default", "gb-p") is not None
+            c.create("nodes", make_node("gb-n2"))
+            c.close()
+            answers[name] = got
+    finally:
+        py.stop()
+    assert answers["native"] == answers["python"], answers
+    for verb, (code, body) in answers["native"].items():
+        assert code == 400, (verb, code)
+        doc = json.loads(body)
+        assert doc["kind"] == "Status" and doc["code"] == 400, (verb, doc)
+
+
+def test_truncated_request_survival_parity(srv):
+    """A request whose Content-Length promises more bytes than ever
+    arrive (the connection half-closes mid-body): neither server may
+    crash, leak the admission slot, or wedge the store — a clean request
+    on a fresh connection must succeed immediately after."""
+    py = HttpFakeApiserver().start()
+    try:
+        for url in (srv.url, py.url):
+            port = int(url.rsplit(":", 1)[1])
+            # mid-JSON cut: 20 bytes delivered of a promised 512
+            _raw_response(
+                port, "POST", "/api/v1/namespaces/default/pods",
+                _GARBLED[:20], content_length=512, timeout=3.0,
+            )
+            c = HttpKubeClient(url)
+            c.create("nodes", make_node("tr-n"))
+            assert c.get("nodes", None, "tr-n") is not None
+            c.close()
+    finally:
+        py.stop()
+
+
+def test_garbled_request_line_survival(srv):
+    """Bytes that are not HTTP at all: the connection dies (or gets a
+    parser 400), the server thread survives, and the next request on a
+    fresh connection works."""
+    py = HttpFakeApiserver().start()
+    try:
+        for url in (srv.url, py.url):
+            port = int(url.rsplit(":", 1)[1])
+            s = _socket.socket()
+            s.settimeout(3.0)
+            s.connect(("127.0.0.1", port))
+            s.sendall(b"\xff\xfe\x00 GET garbage\r\n\r\n")
+            try:
+                s.recv(4096)
+            except _socket.timeout:
+                pass
+            s.close()
+            c = HttpKubeClient(url)
+            c.create("nodes", make_node("hl-n"))
+            assert c.get("nodes", None, "hl-n") is not None
+            c.close()
+    finally:
+        py.stop()

@@ -20,6 +20,8 @@ CHAOS_r01.json. These tests pin the pieces it is built from:
 - lane-queue shedding past threshold, clearing once drained.
 """
 
+import json
+import os
 import socket
 import threading
 import time
@@ -1214,3 +1216,360 @@ def test_engine_watch_loop_throttles_on_429_and_recovers():
     finally:
         eng.stop()
         srv.stop()
+
+
+# ------------------------------------------- hostile wire tier (ISSUE 10)
+
+
+def test_wire_fault_grammar_and_helpers():
+    spec = FaultSpec.parse(
+        "seed=5;wire.garble=0.1;wire.truncate=0.05;wire.dup=0.2;"
+        "wire.stale=0.2;clock.jump=0.3:0.5"
+    )
+    for kind in ("wire.garble", "wire.truncate", "wire.dup",
+                 "wire.stale", "clock.jump"):
+        assert spec.rate(kind) is not None, kind
+    plane = FaultPlane(spec)
+    data = b'{"type":"MODIFIED","object":{"metadata":{"name":"x"}}}'
+    g = plane.garble_bytes(data)
+    assert g != data and abs(len(g) - len(data)) <= 1
+    t = plane.truncate_bytes(data)
+    assert data.startswith(t) and 0 < len(t) < len(data)
+    # clock.jump: p=0.3 with arg 0.5 — skew stays inside [-arg, +arg]
+    # and is deterministic per seed
+    skews = [FaultPlane(spec).clock_skew() for _ in range(3)]
+    assert len(set(skews)) == 1
+    assert all(abs(s) <= 0.5 for s in skews)
+
+
+def test_clock_jump_installs_skewed_now():
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(
+        manage_all_nodes=True, faults="seed=5;clock.jump=1.0:0.25",
+    ))
+    assert eng._now.__func__ is ClusterEngine._skewed_now
+    for _ in range(4):
+        eng._now()
+    assert eng._faults.counts().get("clock.jump", 0) >= 4
+    # the skew stays inside [-arg, +arg] of the honest clock
+    honest = time.time() - eng._epoch
+    assert abs(eng._now() - honest) <= 0.25 + 0.05
+    # no spec -> plain _now, no instance attribute (zero-cost contract)
+    eng2 = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    assert "_now" not in eng2.__dict__
+
+
+def test_stale_rv_modified_dropped_added_applied():
+    """The stale-rv ingest tier: a MODIFIED whose rv regressed below the
+    row's last ingested revision is dropped (counted as stale_rv); an
+    ADDED carrying a regressed rv (the restore-recovery re-list shape)
+    still applies."""
+    from kwok_tpu.telemetry.errors import wire_rejects_total
+
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    kube.create("nodes", make_node("sv-n"))
+    kube.create("pods", make_pod("sv-p", node="sv-n"))
+    obj = kube.get("pods", "default", "sv-p")
+    eng._ingest("pods", "ADDED", obj)
+    idx = eng.pods.pool.lookup(("default", "sv-p"))
+    rv_seen = eng.pods.pool.meta[idx]["rv"]
+    assert rv_seen > 0
+    stale = json.loads(json.dumps(obj))
+    stale["metadata"]["resourceVersion"] = str(rv_seen - 1)
+    stale["metadata"]["labels"] = {"old": "world"}
+    drops0 = wire_rejects_total("stale_rv")
+    eng._ingest("pods", "MODIFIED", stale)
+    assert wire_rejects_total("stale_rv") == drops0 + 1
+    # the stale content never landed: rv and object untouched
+    m = eng.pods.pool.meta[idx]
+    assert m["rv"] == rv_seen
+    assert "labels" not in ((m.get("obj") or {}).get("metadata") or {})
+    # an ADDED with the same regressed rv applies (restore recovery)
+    eng._ingest("pods", "ADDED", stale)
+    assert eng.pods.pool.meta[idx]["rv"] == rv_seen - 1
+
+
+def test_stale_rv_deleted_replay_never_releases_live_row():
+    """The nastiest replay shape: a DELETED from before the object was
+    re-created. Applying it would release the LIVE row — the stale-rv
+    tier drops it; a legitimate DELETED (rv above the row's) and the
+    rv-less re-list prune shape still apply."""
+    from kwok_tpu.telemetry.errors import wire_rejects_total
+
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    kube.create("nodes", make_node("dr-n"))
+    kube.create("pods", make_pod("dr-p", node="dr-n"))
+    obj = kube.get("pods", "default", "dr-p")
+    eng._ingest("pods", "ADDED", obj)
+    key = ("default", "dr-p")
+    rv_seen = eng.pods.pool.meta[eng.pods.pool.lookup(key)]["rv"]
+    stale_del = json.loads(json.dumps(obj))
+    stale_del["metadata"]["resourceVersion"] = str(rv_seen - 1)
+    drops0 = wire_rejects_total("stale_rv")
+    eng._ingest("pods", "DELETED", stale_del)
+    assert eng.pods.pool.lookup(key) is not None  # row survived
+    assert wire_rejects_total("stale_rv") == drops0 + 1
+    # a real DELETED (rv ahead) applies
+    fresh_del = json.loads(json.dumps(obj))
+    fresh_del["metadata"]["resourceVersion"] = str(rv_seen + 1)
+    eng._ingest("pods", "DELETED", fresh_del)
+    assert eng.pods.pool.lookup(key) is None
+    # the rv-less prune shape (re-list) applies too
+    kube.create("pods", make_pod("dr-p2", node="dr-n"))
+    eng._ingest("pods", "ADDED", kube.get("pods", "default", "dr-p2"))
+    eng._ingest("pods", "DELETED",
+                {"metadata": {"namespace": "default", "name": "dr-p2"}})
+    assert eng.pods.pool.lookup(("default", "dr-p2")) is None
+
+
+def _converge(kube, names, timeout=30.0):
+    return _wait(
+        lambda: all(
+            (kube.get("pods", "default", n) or {})
+            .get("status", {}).get("phase") == "Running"
+            for n in names
+        ),
+        timeout,
+    )
+
+
+def test_wire_dup_stale_absorbed_byte_identical():
+    """wire.dup and wire.stale replays are absorbed by the stale-rv /
+    echo-drop tiers: the faulted engine's final server state is
+    byte-identical to a fault-free control run."""
+
+    def run(faults):
+        kube = FakeKube()
+        eng = ClusterEngine(kube, EngineConfig(
+            manage_all_nodes=True, tick_interval=0.02, faults=faults,
+        ))
+        eng.start()
+        try:
+            kube.create("nodes", make_node("ds-n"))
+            names = [f"dsp{i}" for i in range(12)]
+            for n in names:
+                kube.create("pods", make_pod(n, node="ds-n"))
+            assert _converge(kube, names)
+            # settle: replayed events still in flight must drain
+            time.sleep(0.3)
+            return (
+                {
+                    n: (kube.get("pods", "default", n) or {}).get("status")
+                    for n in names
+                },
+                dict(eng._faults.counts()) if eng._faults else {},
+            )
+        finally:
+            eng.stop()
+
+    base, _ = run("")
+    faulted, counts = run("seed=11;wire.dup=0.25;wire.stale=0.25")
+    assert counts.get("wire.dup", 0) >= 1
+    assert counts.get("wire.stale", 0) >= 1
+
+    def masked(doc):
+        import re
+
+        return re.sub(
+            r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z", "T",
+            json.dumps(doc, sort_keys=True),
+        )
+
+    # byte-identical final status documents, wall timestamps masked
+    assert masked(base) == masked(faulted)
+
+
+def test_wire_garble_truncate_quarantined_over_http():
+    """The raw-lines ingest edge under garble/truncate: corrupt lines are
+    quarantined (kwok_wire_rejects_total moves), integrity doubt
+    schedules a bounded-rate full re-list, no worker crashes, and the
+    engine still converges every pod."""
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+    from kwok_tpu.telemetry.errors import wire_rejects_total
+
+    srv = HttpFakeApiserver().start()
+    rejects0 = wire_rejects_total()
+    eng = ClusterEngine(
+        HttpKubeClient(srv.url),
+        EngineConfig(
+            manage_all_nodes=True, tick_interval=0.02,
+            faults="seed=3;wire.garble=0.25;wire.truncate=0.05",
+        ),
+    )
+    eng.start()
+    try:
+        client = HttpKubeClient(srv.url)
+        client.create("nodes", make_node("gq-n"))
+        names = [f"gqp{i}" for i in range(16)]
+        for n in names:
+            client.create("pods", make_pod(n, node="gq-n"))
+
+        def done():
+            return all(
+                (client.get("pods", "default", n) or {})
+                .get("status", {}).get("phase") == "Running"
+                for n in names
+            )
+
+        assert _wait(done, 45.0)
+        assert eng._faults.counts().get("wire.garble", 0) >= 1
+        client.close()
+    finally:
+        eng.stop()
+        srv.stop()
+    assert wire_rejects_total() > rejects0
+
+
+def test_clock_jump_never_double_fires_checkpointed_delay(tmp_path):
+    """The restart-soak unit tier under a hostile clock: an engine whose
+    `now` jumps (clock.jump) checkpoints mid-delay, restarts, and every
+    pod still fires its Running transition EXACTLY once (server-side
+    patch-count oracle) — the (uid, rv, phase) restore match plus the
+    device's edge-triggered firing make double-fires impossible even
+    when the clock lies."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    from benchmarks.rig import oplog_store
+
+    store = oplog_store()
+    mk = lambda: EngineConfig(  # noqa: E731
+        manage_all_nodes=True, tick_interval=0.05,
+        checkpoint_dir=str(tmp_path), checkpoint_interval=0.25,
+        pod_rules=_pod_rules_delayed(3.0),
+        faults="seed=21;clock.jump=0.4:0.2",
+    )
+    names = [f"cjp{i}" for i in range(4)]
+    e1 = ClusterEngine(store, mk())
+    e1.start()
+    try:
+        store.create("nodes", make_node("cj-n"))
+        for n in names:
+            store.create("pods", make_pod(n, node="cj-n"))
+
+        def armed():
+            doc = _ckpt().load(str(tmp_path), "engine")
+            if doc is None:
+                return False
+            pods = doc["kinds"].get("pods", {})
+            return len(pods) == len(names) and all(
+                v[2] is not None for v in pods.values()
+            )
+
+        assert _wait(armed, 20.0), "checkpoint never covered armed pods"
+        time.sleep(0.6)  # a measurable slice of the delay elapses
+    finally:
+        e1.stop()
+    # restart against the same checkpoint, hostile clock still on
+    e2 = ClusterEngine(store, mk())
+    e2.start()
+    try:
+        assert _wait(
+            lambda: all(
+                (store.get("pods", "default", n) or {})
+                .get("status", {}).get("phase") == "Running"
+                for n in names
+            ),
+            30.0,
+        ), "pods never fired after restart"
+        time.sleep(0.5)  # late duplicates would land here
+    finally:
+        e2.stop()
+    counts = store.phase_counts("Running", names)
+    assert all(c == 1 for c in counts.values()), counts
+    assert e2._faults.counts().get("clock.jump", 0) >= 1
+
+
+# -------------------------------------- checkpoint writer disk outages
+
+
+def test_checkpoint_writer_full_disk_degrades_and_recovers(
+    tmp_path, monkeypatch
+):
+    """ENOSPC on the writer thread: the writer must not die silently —
+    it degrades (kwok_degraded{reason="checkpoint"}), keeps the last
+    good checkpoint intact, retries under policy, and recovers (clearing
+    the reason) once the disk heals."""
+    import os as _os
+
+    ckpt_mod = _ckpt()
+    reg = MetricsRegistry()
+    deg = Degradation(reg)
+    w = ckpt_mod.Checkpointer(str(tmp_path), "engine", 0.1, degradation=deg)
+    # make retries fast so the test stays sub-second (_write_loop imports
+    # the policy at thread start, i.e. after this patch lands)
+    from kwok_tpu.resilience import policy as policy_mod
+
+    monkeypatch.setattr(
+        policy_mod, "CKPT_RETRY", RetryPolicy(base=0.01, cap=0.05)
+    )
+    w.start()
+    try:
+        good = {"kinds": {"pods": {"default/p0": ["u", 1, 1.5, None, 0, 0]}}}
+        w.submit(good)
+        assert _wait(lambda: w.writes == 1, 5.0)
+        disk_full = threading.Event()
+        disk_full.set()
+        real_replace = _os.replace
+
+        def replace(src, dst):
+            if disk_full.is_set() and dst == w.path:
+                raise OSError(28, "No space left on device")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(ckpt_mod.os, "replace", replace)
+        newer = {"kinds": {"pods": {"default/p0": ["u", 2, 0.5, None, 1, 1]}}}
+        w.submit(newer)
+        assert _wait(lambda: "checkpoint" in deg.reasons, 5.0), \
+            "writer never degraded on ENOSPC"
+        # the last GOOD checkpoint is intact on disk
+        doc = ckpt_mod.load(str(tmp_path), "engine")
+        assert doc["kinds"] == good["kinds"]
+        # newest snapshot supersedes the failed one while retrying
+        newest = {"kinds": {"pods": {"default/p0": ["u", 3, 0.1, None, 2, 1]}}}
+        w.submit(newest)
+        disk_full.clear()  # the disk heals
+        assert _wait(
+            lambda: "checkpoint" not in deg.reasons, 5.0
+        ), "degraded reason never cleared after recovery"
+        assert _wait(
+            lambda: (ckpt_mod.load(str(tmp_path), "engine") or {})
+            .get("kinds") == newest["kinds"],
+            5.0,
+        ), "recovered write did not carry the newest snapshot"
+    finally:
+        w.stop()
+    # writer thread exited cleanly (stop drained the sentinel)
+    assert w._thread is None
+
+
+def test_garbled_parseable_rv_never_crashes_ingest():
+    """wire.garble can flip one digit of resourceVersion into a letter
+    while the document still parses: the quarantine contract says never
+    crash — the object applies with rv 0 (no usable identity), exactly
+    like a missing revision, on both kinds and the watch-loop's own rv
+    bookkeeping."""
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    kube.create("nodes", make_node("gr-n"))
+    node = kube.get("nodes", None, "gr-n")
+    node["metadata"]["resourceVersion"] = "1x2"
+    eng._ingest("nodes", "ADDED", node)  # must not raise
+    idx = eng.nodes.pool.lookup("gr-n")
+    assert idx is not None
+    assert eng.nodes.pool.meta[idx].get("rv", 0) == 0
+    kube.create("pods", make_pod("gr-p", node="gr-n"))
+    pod = kube.get("pods", "default", "gr-p")
+    pod["metadata"]["resourceVersion"] = "äbc"
+    eng._ingest("pods", "ADDED", pod)  # must not raise
+    idx = eng.pods.pool.lookup(("default", "gr-p"))
+    assert idx is not None
+    assert eng.pods.pool.meta[idx].get("rv", 0) == 0
+    # MODIFIED with a garbled rv flows (not stale-droppable, not a crash)
+    eng._ingest("pods", "MODIFIED", pod)
+    assert eng.pods.pool.lookup(("default", "gr-p")) is not None
