@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check fleet-check drift-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check fleet-check drift-check attrib-check image cluster-image clean
 
 all: build
 
@@ -88,6 +88,18 @@ fleet-check: ## watcher-fleet survival gate (overload admission + slow-watcher e
 drift-check: ## hostile-wire convergence + anti-entropy drift-repair gate
 	$(PYENV) python3 -m pytest tests/test_antientropy.py -q
 	$(PYENV) python3 benchmarks/drift_soak.py --check
+
+# attrib-check: the latency-attribution gate (ISSUE 11): drives the rig
+# workload against the native apiserver with phase timing on and gates on
+# (a) per-phase sums reconciling to the request-level totals within the
+# disclosed tolerance, (b) the /debug/flight schema + timeline merge,
+# (c) KWOK_TPU_APISERVER_TIMING=0 being measurably zero-cost (zeroed
+# histograms, empty flight ring, parity-twin patch burst), and (d) the
+# route_micro/hb_micro zero-cost contracts still holding with timing
+# compiled in. Emits LATENCY_r*.json — the measured before-photo for the
+# apiserver 10x tentpole. Skips cleanly when no C++ compiler is available.
+attrib-check: ## measured end-to-end latency attribution gate (LATENCY_r* artifact)
+	$(PYENV) python3 benchmarks/latency_attrib.py --check
 
 image:
 	./images/kwok/build.sh

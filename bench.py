@@ -200,6 +200,21 @@ def _router_micro_rider() -> "dict | None":
         return {"error": repr(e)}
 
 
+def _latency_attrib_rider() -> "dict | None":
+    """Measured apiserver phase attribution (benchmarks/latency_attrib.py
+    rider mode): a small native-server workload's per-phase µs/request —
+    the apiserver tier's 437µs/pod model term, finally measured, rides
+    every BENCH json next to the engine-side cost model. Host-only;
+    skips to a reason dict when no C++ compiler is available."""
+    try:
+        from benchmarks.latency_attrib import rider as attrib_rider
+
+        return attrib_rider()
+    except Exception as e:
+        print(f"latency_attrib rider failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _best_of_windows(tick, consume, per_window: int, n_windows: int = 3) -> float:
     """The shared timing harness: the device is reached through a shared
     tunnel whose latency has multi-second transients, so a single long
@@ -518,6 +533,7 @@ def pallas_main() -> None:
         },
         "cost_model": _lane_cost_model(),
         "router_micro": _router_micro_rider(),
+        "latency_attrib": _latency_attrib_rider(),
         "metrics_snapshot": _metrics_snapshot(),
     }))
 
@@ -618,6 +634,9 @@ def main() -> None:
                 "cost_model": _lane_cost_model(),
                 # router trajectory rider: python vs native partitioning
                 "router_micro": _router_micro_rider(),
+                # measured apiserver phase attribution (the 437us/pod
+                # model term, measured; benchmarks/latency_attrib.py)
+                "latency_attrib": _latency_attrib_rider(),
                 "metrics_snapshot": _metrics_snapshot(),
             }
         )

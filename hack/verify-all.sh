@@ -26,6 +26,9 @@ make fleet-check
 echo ">> drift-check (hostile-wire convergence + anti-entropy drift-repair gate)"
 make drift-check
 
+echo ">> attrib-check (measured apiserver latency attribution + zero-cost contracts)"
+make attrib-check
+
 echo ">> bash syntax"
 find hack test images -name '*.sh' -print0 | xargs -0 -n1 bash -n
 

@@ -16,6 +16,11 @@ Registered names come from two scans:
   ``kwok/server.py`` — which catches the dict-driven registrations
   (``_HELP`` / ``_COUNTERS`` in ``engine_metrics.py``) and the process
   collector the HTTP server appends
+- the native apiserver's exposition source (``native/apiserver.cc``
+  ``metrics_text()``): every ``kwok_*`` name in that file must be
+  catalogued too, so native-side families can't drift undocumented
+  (the C++ twin mirrors ``telemetry/apiserver_metrics.py``, but a
+  family added only in the .cc would otherwise be invisible here)
 """
 
 from __future__ import annotations
@@ -35,7 +40,7 @@ _NAME_RE = re.compile(
 _REG_METHODS = ("counter", "gauge", "histogram")
 # files whose string constants are treated as the registration surface
 _SURFACE = ("telemetry" + os.sep, os.path.join("kwok", "server.py"))
-_SUFFIXES = ("_bucket", "_count")
+_SUFFIXES = ("_bucket", "_count", "_sum")
 
 
 class MetricsContractRule(Rule):
@@ -74,6 +79,26 @@ class MetricsContractRule(Rule):
                         isinstance(node.value, str):
                     for m in _NAME_RE.findall(node.value):
                         note(m, mod.rel, node.lineno)
+
+        # native exposition surface: kwok_* names in apiserver.cc. Only
+        # QUOTED string literals are scanned — comments routinely carry
+        # `kwok_tpu/...` path references that would otherwise register a
+        # phantom family. A histogram family's _bucket/_sum/_count sample
+        # names fold into their parent via the same suffix rule the doc
+        # side uses.
+        cc_path = os.path.join(root, "kwok_tpu", "native", "apiserver.cc")
+        if os.path.exists(cc_path):
+            cc_rel = os.path.relpath(cc_path, root)
+            cc_str = re.compile(r'"((?:[^"\\]|\\.)*)"')
+            with open(cc_path, encoding="utf-8") as fh:
+                for i, line in enumerate(fh, 1):
+                    for lit in cc_str.findall(line):
+                        for m in _NAME_RE.findall(lit):
+                            for suf in ("_bucket", "_count", "_sum"):
+                                if m.endswith(suf):
+                                    m = m[: -len(suf)]
+                                    break
+                            note(m, cc_rel, i)
 
         # label-set consistency across literal registrations
         for name, sets in labels.items():

@@ -30,7 +30,11 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator
 
-from kwok_tpu.telemetry.apiserver_metrics import render_apiserver_metrics
+from kwok_tpu.telemetry.apiserver_metrics import (
+    ApiserverTiming,
+    render_apiserver_metrics,
+    render_timing_metrics,
+)
 from kwok_tpu.telemetry.errors import swallowed
 from kwok_tpu.edge.kubeclient import (
     ADDED,
@@ -226,6 +230,10 @@ class FakeKube:
         # store lock (a registry child lock here would nest two level-85
         # leaves); /metrics renders them via telemetry.apiserver_metrics
         self.watch_terminations = {"slow": 0, "deadline": 0}
+        # phase timing + flight recorder (ISSUE 11); clock stamps gated
+        # by KWOK_TPU_APISERVER_TIMING, counters (fanout pushes, backlog
+        # peak) always on — plain ints under the GIL like the rest
+        self.timing = ApiserverTiming()
 
     # -- helpers ------------------------------------------------------------
 
@@ -274,7 +282,17 @@ class FakeKube:
         pin the very memory the cap bounds; the client re-lists/resumes,
         the same recovery as a 410."""
         bl = self.watch_backlog
-        if bl > 0 and w.q.qsize() >= bl:
+        depth = w.q.qsize()
+        # bounded-buffer high-watermark: the fleet gate's deterministic
+        # proof that no CAPPED push ever grew a send buffer past the cap.
+        # The terminate branch clamps its record to the cap: a resume
+        # replay is cap-exempt (bounded by RV_WINDOW) and may legally
+        # overfill a queue, so the raw depth here can exceed the cap
+        # without any enforcement failure — only the push branch below,
+        # which grows the queue, may ever record past the cap.
+        if bl > 0 and depth >= bl:
+            if min(depth, bl) > self.timing.backlog_peak:
+                self.timing.backlog_peak = min(depth, bl)
             w.terminated = "slow"
             w.stopped = True
             self.watch_terminations["slow"] += 1
@@ -286,6 +304,8 @@ class FakeKube:
             w.q.put(None)
             return
         w.q.put(ev)
+        if depth + 1 > self.timing.backlog_peak:
+            self.timing.backlog_peak = depth + 1
 
     def _emit(self, kind: str, type_: str, obj: dict, key=None) -> None:
         if RV_WINDOW > 0:
@@ -304,11 +324,28 @@ class FakeKube:
                 self._compacted_rv = max(
                     self._compacted_rv, self._history.popleft()[0]
                 )
+        # fanout phase (ISSUE 11): the per-watcher encode+push loop, the
+        # term ROADMAP item 1's serialize-once broadcast ring attacks.
+        # The push counter is always on (one int add); clocks are gated.
+        t0 = time.perf_counter() if self.timing.enabled else None
+        pushes = 0
         for w in list(self._watches):
             if w.stopped or w.kind != kind:
                 continue
             if w._matches(obj):
                 self._push(w, WatchEvent(type_, copy.deepcopy(obj)))
+                pushes += 1
+        if pushes:
+            if t0 is not None:
+                self.timing.note_fanout(time.perf_counter() - t0, pushes)
+            else:
+                self.timing.fanout_pushes += pushes
+
+    def watch_backlogs(self) -> list:
+        """Live per-watcher send-buffer depths (the /metrics backlog
+        gauges' scrape-time source)."""
+        with self._lock:
+            return [w.q.qsize() for w in self._watches if not w.stopped]
 
     def compact(self) -> int:
         """Force watch-cache compaction NOW: any watch resuming from a
@@ -1347,9 +1384,88 @@ class HttpFakeApiserver:
     def _make_handler(self):
         store = self.store
         server_obj = self
+        timing = store.timing
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            # ---- phase timing (ISSUE 11): stamps mirror apiserver.cc.
+            # parse_request runs after the request line was read, so the
+            # read_headers phase excludes keep-alive idle time — exactly
+            # like the C++ twin's first-bytes stamp.
+            def parse_request(self):
+                self._t_start = timing.begin_request()
+                self._t_hdr = self._t_body = self._t_parse = None
+                self._commit_s = 0.0
+                self._parse_ran = False
+                ok = super().parse_request()
+                if ok and self._t_start is not None:
+                    self._t_hdr = time.perf_counter()
+                return ok
+
+            def _commit(self, fn):
+                """Run one store call, attributing its wall time to the
+                commit phase (the under-the-lock work plus, via the tls
+                accumulator, the fanout subset)."""
+                if self._t_start is None:
+                    return fn()
+                t0 = time.perf_counter()
+                try:
+                    return fn()
+                finally:
+                    self._commit_s += time.perf_counter() - t0
+
+            def _finish_timing(self, code: int, enc_s: float) -> None:
+                t0 = getattr(self, "_t_start", None)
+                if t0 is None:
+                    return
+                self._t_start = None  # one observation per request
+                t_end = time.perf_counter()
+                parsed = urllib.parse.urlparse(self.path)
+                m = _match_path(parsed.path)
+                if not m:
+                    return  # ops/debug paths stay untimed (parity)
+                t_hdr = self._t_hdr or t0
+                t_body = self._t_body or t_hdr
+                phases = {
+                    "read_headers": t_hdr - t0,
+                    "read_body": t_body - t_hdr,
+                    "commit": self._commit_s,
+                    "encode": enc_s,
+                }
+                if self._parse_ran:
+                    phases["parse"] = self._t_parse - t_body
+                fan = getattr(timing.tls, "fanout_s", 0.0) or 0.0
+                if fan:
+                    phases["fanout"] = fan
+                total = t_end - t0
+                # verb + band inline from the ONE parse/match above
+                # (_audit_verb/_admission_band semantics for resource
+                # paths, without re-parsing the URI per call)
+                method = (self.command or "").upper()
+                if method == "GET":
+                    q = urllib.parse.parse_qs(parsed.query)
+                    if (q.get("watch") or ["false"])[0] in ("true", "1"):
+                        verb, band = "watch", "none"
+                    else:
+                        verb = "get" if m.group("name") else "list"
+                        band = "readonly"
+                else:
+                    verb = {"POST": "create", "PUT": "update",
+                            "PATCH": "patch", "DELETE": "delete"}.get(
+                        method, method.lower()
+                    )
+                    band = (
+                        "mutating"
+                        if method in ("POST", "PATCH", "DELETE")
+                        else "none"
+                    )
+                timing.observe_request(verb, total, phases)
+                timing.record_flight(
+                    self.command or "", self.path, code, band,
+                    time.time() - total, total * 1e6,
+                    {p: v * 1e6 for p, v in phases.items()},
+                )
 
             def setup(self):  # noqa: D401
                 # TLS handshake deferred out of the accept loop (see
@@ -1388,25 +1504,41 @@ class HttpFakeApiserver:
                 self._send_body(json.dumps(obj, separators=(",", ":")).encode(), code)
 
             def _send_body(self, body: bytes, code=200):
+                t_enc = (
+                    time.perf_counter()
+                    if getattr(self, "_t_start", None) is not None
+                    else None
+                )
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                if t_enc is not None:
+                    self._finish_timing(code, time.perf_counter() - t_enc)
 
             def _body(self):
                 n = int(self.headers.get("Content-Length") or 0)
+                timed = getattr(self, "_t_start", None) is not None
                 if not n:
+                    if timed:
+                        self._t_body = time.perf_counter()
                     return None
                 data = self.rfile.read(n)
+                if timed:
+                    self._t_body = time.perf_counter()
                 try:
-                    return json.loads(data or b"null")
+                    doc = json.loads(data or b"null")
                 except ValueError as e:
                     # garbled or truncated (client died mid-body -> short
                     # read) request bytes: typed, answered 400 by the
                     # _admitted chokepoint — byte-identical to the C++
                     # mirror's JParser rejection, never a crash
                     raise _BadBody() from e
+                if timed:
+                    self._t_parse = time.perf_counter()
+                    self._parse_ran = True
+                return doc
 
             def _authorized(self) -> bool:
                 """kube-apiserver token authn: /healthz stays anonymous (the
@@ -1451,6 +1583,7 @@ class HttpFakeApiserver:
                 )
                 self.end_headers()
                 self.wfile.write(TOO_MANY_REQUESTS_BODY)
+                self._finish_timing(429, 0.0)
 
             def _admitted(self, impl):
                 """Run one request through max-inflight admission. The
@@ -1511,11 +1644,26 @@ class HttpFakeApiserver:
                         adm.inflight if adm else {},
                         adm.rejected if adm else {},
                         store.watch_terminations,
+                    ) + render_timing_metrics(
+                        timing, store.watch_backlogs()
                     )
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
                     )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if parsed.path == "/debug/flight":
+                    # flight recorder dump (anonymous, like /metrics):
+                    # the bounded ring of recent request records — the
+                    # engine auto-grabs it on a /readyz degradation edge
+                    body = json.dumps(
+                        timing.flight_doc("mock"), separators=(",", ":")
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -1539,13 +1687,15 @@ class HttpFakeApiserver:
                     # ns passed verbatim (no defaulting): a namespace-less
                     # pods/NAME/log matches neither server's store key —
                     # the C++ mirror behaves identically
-                    doc, code = pod_log_status(
+                    doc, code = self._commit(lambda: pod_log_status(
                         store, ns, name, (q.get("container") or [None])[0]
-                    )
+                    ))
                     self._send_json(doc, code)
                     return
                 if name:
-                    body = store.get_bytes(kind, ns, name)
+                    body = self._commit(
+                        lambda: store.get_bytes(kind, ns, name)
+                    )
                     if body is None:
                         self._send_json({"kind": "Status", "code": 404}, 404)
                     else:
@@ -1577,13 +1727,13 @@ class HttpFakeApiserver:
                     )
                     return
                 try:
-                    body = store.list_bytes(
+                    body = self._commit(lambda: store.list_bytes(
                         kind,
                         field_selector=fs,
                         label_selector=ls,
                         limit=int((q.get("limit") or [0])[0] or 0),
                         continue_=(q.get("continue") or [None])[0],
-                    )
+                    ))
                 except WatchExpired as e:
                     # expired continue token: 410 Gone, client restarts
                     # the list (kube-apiserver "continue too old" answer)
@@ -1643,6 +1793,9 @@ class HttpFakeApiserver:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+                # a live watch stream is long-running: no unary phase
+                # observation (the handshake errors above stay timed)
+                self._t_start = None
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -1712,9 +1865,13 @@ class HttpFakeApiserver:
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
                 patch = self._body()
                 if m.group("sub") == "status":
-                    body = store.patch_status_bytes(kind, ns, name, patch)
+                    body = self._commit(lambda: store.patch_status_bytes(
+                        kind, ns, name, patch
+                    ))
                 else:
-                    body = store.patch_meta_bytes(kind, ns, name, patch)
+                    body = self._commit(lambda: store.patch_meta_bytes(
+                        kind, ns, name, patch
+                    ))
                 if body is None:
                     self._send_json({"kind": "Status", "code": 404}, 404)
                 else:
@@ -1742,10 +1899,10 @@ class HttpFakeApiserver:
                     # default grace (JParser failure leaves b non-OBJ)
                     body = {}
                 grace = body.get("gracePeriodSeconds")
-                store.delete(
+                self._commit(lambda: store.delete(
                     m.group("kind"), m.group("ns"), m.group("name"),
                     grace_seconds=None if grace is None else int(grace),
-                )
+                ))
                 self._send_json({"kind": "Status", "status": "Success"})
 
             def do_POST(self):  # noqa: N802 (test convenience: create)
@@ -1776,7 +1933,9 @@ class HttpFakeApiserver:
                     # the real scheduler's bind: POST v1 Binding
                     node = ((obj or {}).get("target") or {}).get("name") or ""
                     try:
-                        pod = store.bind(m.group("ns"), m.group("name"), node)
+                        pod = self._commit(lambda: store.bind(
+                            m.group("ns"), m.group("name"), node
+                        ))
                     except BindConflict as e:
                         self._send_json(
                             {"kind": "Status", "status": "Failure",
@@ -1799,7 +1958,9 @@ class HttpFakeApiserver:
                 if m.group("ns"):
                     obj.setdefault("metadata", {})["namespace"] = m.group("ns")
                 try:
-                    body = store.create_bytes(m.group("kind"), obj)
+                    body = self._commit(
+                        lambda: store.create_bytes(m.group("kind"), obj)
+                    )
                 except AlreadyExists as e:
                     self._send_json(
                         {"kind": "Status", "apiVersion": "v1",

@@ -161,6 +161,12 @@ class EngineConfig:
     # works too. The tracer itself is always on — this only controls the
     # at-exit dump (the live view is the HTTP /debug/trace endpoint).
     trace_dump: str = ""
+    # when set (or KWOK_TPU_FLIGHT_DIR), any FRESH /readyz degradation
+    # reason triggers a best-effort grab of the apiserver's
+    # /debug/flight dump into this directory — the flight-recorder
+    # post-mortem for "why did we degrade" (HTTP masters only; merge it
+    # with the trace dump via `python -m kwok_tpu.telemetry.timeline`)
+    flight_dir: str = ""
     # 1-in-N sampling for per-event ingest->patch spans (the end-to-end
     # per-pod attribution the cost model cannot see); 0 disables
     trace_sample_every: int = 256
@@ -562,7 +568,12 @@ class ClusterEngine:
         # Degraded-mode ledger (kwok_degraded{reason=}; /readyz answers
         # 503 while any reason is active) + the worker watchdog (built in
         # start() unless a FederatedEngine installed a shared one first).
-        self._degradation = Degradation(self.telemetry.registry)
+        # Every FRESH degradation edge auto-grabs the apiserver's flight
+        # recorder (ISSUE 11): the post-mortem of the requests that led
+        # into the transition, saved before the ring overwrites them.
+        self._degradation = Degradation(
+            self.telemetry.registry, on_set=self._flight_dump_on_degrade
+        )
         self._watchdog: Watchdog | None = None
         # Crash-durable restarts (resilience/checkpoint.py). The dir
         # resolves config < KWOK_TPU_CHECKPOINT_DIR (same precedence as
@@ -659,6 +670,49 @@ class ClusterEngine:
         re-list (+ checkpoint reconcile, when one is armed) has not
         completed, so /readyz answers 503 with reason startup_resync."""
         return self._running and self._startup_pending is not None
+
+    def _flight_dump_on_degrade(self, reason: str) -> None:
+        """Degradation edge hook (Degradation.on_set): snapshot the
+        apiserver's flight recorder before its bounded ring overwrites
+        the requests that led into the transition. Best-effort and off
+        the degrading thread (a daemon grab thread); only armed when a
+        dump directory is configured and the master is HTTP."""
+        dir_ = (
+            self.config.flight_dir
+            or os.environ.get("KWOK_TPU_FLIGHT_DIR", "")
+        ).strip()
+        server = getattr(self.client, "server", "")
+        if not dir_ or not str(server).startswith("http"):
+            return
+
+        def _grab():
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(
+                    str(server) + "/debug/flight", timeout=3
+                ) as r:
+                    data = r.read()
+                os.makedirs(dir_, exist_ok=True)
+                path = os.path.join(
+                    dir_, f"flight-{reason}-{int(time.time() * 1000)}.json"
+                )
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+                logger.warning(
+                    "degraded (%s): apiserver flight dump saved to %s",
+                    reason, path,
+                )
+            except Exception:
+                # the apiserver may BE the reason we degraded; a failed
+                # post-mortem grab is expected there, never an error
+                swallowed("engine.flight_dump")
+
+        threading.Thread(
+            target=_grab, name="kwok-flight-dump", daemon=True
+        ).start()
 
     def _worker_budget_exhausted(self, name: str) -> None:
         """Watchdog callback: a supervised worker crashed past its
@@ -3648,7 +3702,17 @@ class ClusterEngine:
                     m = self.pods.pool.meta[idx]
                     t0e = m.pop("_trace_t0", None) if m else None
                     if t0e is not None:
-                        tel.span("pod.ingest_to_patch", t0e, _now, "event")
+                        # (key, rv) correlation context: ties this span
+                        # to the apiserver flight record / store-commit
+                        # stamp for the same object (timeline.py merge)
+                        key = self.pods.pool.key_of(idx)
+                        tel.span(
+                            "pod.ingest_to_patch", t0e, _now, "event",
+                            {
+                                "key": f"{key[0]}/{key[1]}" if key else "",
+                                "rv": m.get("rv"),
+                            },
+                        )
                 continue  # 404 = object deleted server-side; Python path
                 # treats that as a no-op too
             if kind == "pods":
@@ -3884,7 +3948,7 @@ class ClusterEngine:
         if t0e is not None:  # sampled ingest->patch end-to-end span
             self.telemetry.span(
                 "pod.ingest_to_patch", t0e, _t1, "event",
-                {"ns": ns, "name": name},
+                {"key": f"{ns}/{name}", "rv": m.get("rv")},
             )
         self._inc("status_patches_total")
 

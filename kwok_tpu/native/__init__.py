@@ -27,7 +27,7 @@ _INGEST_SRC = os.path.join(_DIR, "ingest.cc")
 _LIB = os.path.join(_DIR, "libkwokcodec.so")
 _APISERVER_SRC = os.path.join(_DIR, "apiserver.cc")
 _APISERVER_BIN = os.path.join(_DIR, "kwok-mock-apiserver")
-ABI_VERSION = 7
+ABI_VERSION = 8
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -93,6 +93,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.kwok_pump_close.restype = None
     lib.kwok_pump_close.argtypes = [ctypes.c_int64]
+    lib.kwok_pump_stats.restype = None
+    lib.kwok_pump_stats.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_double),
+    ]
     u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.kwok_parse_events.restype = ctypes.c_int64
     lib.kwok_parse_events.argtypes = [
@@ -723,6 +727,22 @@ class Pump:
             status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
         return status
+
+    def stats(self) -> dict:
+        """Send-path attribution since open (pump.cc, always on): batch
+        wall plus the write/read split summed across the pool's
+        overlapping connection threads — the pump half of the ISSUE 11
+        latency-attribution surface."""
+        out = (ctypes.c_double * 5)()
+        if self._handle:
+            self._lib.kwok_pump_stats(self._handle, out)
+        return {
+            "batches": int(out[0]),
+            "requests": int(out[1]),
+            "batch_s": round(out[2], 9),
+            "write_s": round(out[3], 9),
+            "read_s": round(out[4], 9),
+        }
 
     def close(self) -> None:
         if self._handle:

@@ -805,6 +805,168 @@ static long watch_backlog() {
 static std::atomic<long> g_watch_term_slow{0};
 static std::atomic<long> g_watch_term_deadline{0};
 
+// ---------------------------------------------------------- phase timing
+// (ISSUE 11) Per-request phase attribution, parity-pinned with
+// kwok_tpu/telemetry/apiserver_metrics.py: family names, HELP text,
+// bucket labels and the full phase/verb sample matrix are byte-identical
+// across the two servers (tests/test_native_apiserver.py masks only the
+// values). Clock stamps are gated by KWOK_TPU_APISERVER_TIMING (default
+// on; "0" makes every request pay exactly one cached-bool branch); the
+// fanout-push counter and the backlog peak watermark stay on — they are
+// single relaxed atomics per queued event and the fleet gate's
+// bounded-buffer proof must not depend on the timing knob.
+
+static bool timing_enabled() {
+  static const bool on = [] {
+    const char* v = getenv("KWOK_TPU_APISERVER_TIMING");
+    return !(v && v[0] == '0' && v[1] == '\0');
+  }();
+  return on;
+}
+
+static inline uint64_t now_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+static double wall_unix_s() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// fixed bucket ladder (seconds, here as ns): telemetry.apiserver_metrics
+// TIMING_BUCKETS — the `le` strings below are the canonical label bytes
+static const int N_TBUCKETS = 17;
+static const uint64_t TBUCKET_NS[N_TBUCKETS] = {
+    5000ull,      10000ull,     25000ull,     50000ull,     100000ull,
+    250000ull,    500000ull,    1000000ull,   2500000ull,   5000000ull,
+    10000000ull,  25000000ull,  50000000ull,  100000000ull, 250000000ull,
+    500000000ull, 1000000000ull};
+static const char* TBUCKET_LE[N_TBUCKETS] = {
+    "5e-06", "1e-05", "2.5e-05", "5e-05", "0.0001", "0.00025", "0.0005",
+    "0.001", "0.0025", "0.005",  "0.01",  "0.025",  "0.05",    "0.1",
+    "0.25",  "0.5",   "1"};
+
+struct PhaseHist {
+  std::atomic<uint64_t> buckets[N_TBUCKETS + 1] = {};
+  std::atomic<uint64_t> sum_ns{0};
+  std::atomic<uint64_t> count{0};
+  void observe_ns(uint64_t ns) {
+    int i = 0;
+    while (i < N_TBUCKETS && ns > TBUCKET_NS[i]) i++;  // le inclusive
+    buckets[i].fetch_add(1, std::memory_order_relaxed);
+    sum_ns.fetch_add(ns, std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+  }
+};
+
+enum {
+  PH_READ_HEADERS = 0,
+  PH_READ_BODY,
+  PH_PARSE,
+  PH_COMMIT,
+  PH_ENCODE,
+  PH_FANOUT,
+  N_PHASES,
+};
+static const char* PHASE_NAMES[N_PHASES] = {
+    "read_headers", "read_body", "parse", "commit", "encode", "fanout"};
+static const int N_VERBS = 6;
+static const char* VERB_NAMES[N_VERBS] = {"get",   "list",   "create",
+                                          "patch", "delete", "other"};
+static PhaseHist g_phase_hist[N_PHASES];
+static PhaseHist g_verb_hist[N_VERBS];
+static std::atomic<long> g_fanout_pushes{0};
+static std::atomic<long> g_backlog_peak{0};
+
+static void peak_update(long depth) {
+  long prev = g_backlog_peak.load(std::memory_order_relaxed);
+  while (depth > prev &&
+         !g_backlog_peak.compare_exchange_weak(prev, depth)) {
+  }
+}
+
+// Per-request phase accumulator: boundary stamps shared between adjacent
+// phases (mark() is one clock read), so a timed unary request costs a
+// handful of clock reads total; disabled => `on` stays false everywhere.
+struct PhaseTimer {
+  bool on = false;
+  // set by handlers only when the body parse SUCCEEDED — a malformed
+  // body contributes no parse sample, mirroring the Python mock (whose
+  // _BadBody raise precedes its parse stamp)
+  bool parsed = false;
+  uint64_t last = 0;
+  double us[N_PHASES] = {0, 0, 0, 0, 0, 0};
+  void mark(int phase) {
+    if (!on) return;
+    uint64_t now = now_ns();
+    us[phase] += (double)(now - last) / 1000.0;
+    last = now;
+  }
+};
+
+// flight recorder: a bounded ring of recent request records, dumped via
+// GET /debug/flight (schema shared with the Python mock and validated by
+// kwok_tpu/telemetry/timeline.check_flight)
+static const size_t FLIGHT_CAPACITY = 1024;
+struct FlightRec {
+  std::string method, path, band;
+  int status = 0;
+  double ts_unix = 0;
+  double total_us = 0;
+  double phases_us[N_PHASES] = {0, 0, 0, 0, 0, 0};
+};
+static std::mutex g_flight_mu;  // leaf: nothing acquired under it
+static std::deque<FlightRec> g_flight;
+static long g_flight_captured = 0;
+
+static void flight_record(FlightRec rec) {
+  std::lock_guard<std::mutex> lk(g_flight_mu);
+  g_flight_captured++;
+  if (g_flight.size() >= FLIGHT_CAPACITY) g_flight.pop_front();
+  g_flight.push_back(std::move(rec));
+}
+
+static std::string flight_dump_json() {
+  std::string out = "{\"server\":\"native\",\"timing_enabled\":";
+  out += timing_enabled() ? "true" : "false";
+  out += ",\"ring_capacity\":" + std::to_string(FLIGHT_CAPACITY);
+  std::lock_guard<std::mutex> lk(g_flight_mu);
+  out += ",\"captured\":" + std::to_string(g_flight_captured);
+  out += ",\"records\":[";
+  char num[64];
+  bool first = true;
+  for (const auto& r : g_flight) {
+    if (!first) out += ',';
+    first = false;
+    out += "{\"method\":\"";
+    json_escape(out, r.method);
+    out += "\",\"path\":\"";
+    json_escape(out, r.path);
+    out += "\",\"status\":" + std::to_string(r.status);
+    out += ",\"band\":\"";
+    json_escape(out, r.band);
+    out += "\"";
+    snprintf(num, sizeof num, ",\"ts_unix\":%.6f", r.ts_unix);
+    out += num;
+    snprintf(num, sizeof num, ",\"total_us\":%.3f", r.total_us);
+    out += num;
+    out += ",\"phases_us\":{";
+    for (int p = 0; p < N_PHASES; p++) {
+      if (p) out += ',';
+      out += "\"";
+      out += PHASE_NAMES[p];
+      snprintf(num, sizeof num, "\":%.3f", r.phases_us[p]);
+      out += num;
+    }
+    out += "}}";
+  }
+  out += "]}";
+  return out;
+}
+
 struct Watch {
   int kind;  // 0 nodes, 1 pods
   std::string field_sel;
@@ -824,15 +986,23 @@ struct Watch {
       std::lock_guard<std::mutex> lk(mu);
       if (closed) return;
       long cap = watch_backlog();
-      if (cap > 0 && (long)q.size() >= cap) {
+      long depth = (long)q.size();
+      if (cap > 0 && depth >= cap) {
         // client must re-list; drop the backlog NOW — draining it into a
-        // stalled socket would pin the very memory this cap bounds
+        // stalled socket would pin the very memory this cap bounds. The
+        // peak watermark is clamped to the cap here: a cap-exempt resume
+        // replay (push_replay, bounded by rv_window) may legally
+        // overfill a queue, so only the GROWING push below may ever
+        // record past the cap — which is exactly the enforcement-failure
+        // signal the fleet gate reads.
+        peak_update(std::min(depth, cap));
         closed = true;
         terminated_slow = true;
         g_watch_term_slow.fetch_add(1);
         q.clear();
       } else {
         q.push_back(std::move(ev));
+        peak_update(depth + 1);
       }
     }
     cv.notify_one();
@@ -1015,9 +1185,11 @@ struct Store {
   // caller holds mu; records the event in the watch cache + undo log,
   // then fans out to matching live watches (the entry's published bytes
   // serialize the event line once). `prev` is the key's entry BEFORE
-  // this event (nullptr for creates).
+  // this event (nullptr for creates). `fanout_us` (when timing is on)
+  // accumulates the per-watcher encode+push loop into the request's
+  // fanout phase — the term the serialize-once broadcast ring attacks.
   void emit(int kind, const char* type, const EntryPtr& e, const Key& key,
-            EntryPtr prev) {
+            EntryPtr prev, double* fanout_us = nullptr) {
     idx_adjust(kind, prev, strcmp(type, "DELETED") == 0 ? nullptr : e);
     if (rv_window() > 0) {
       history.push_back({rv, kind, type, e});
@@ -1036,6 +1208,8 @@ struct Store {
         break;
       }
     if (!any) return;
+    uint64_t f0 = fanout_us ? now_ns() : 0;
+    int pushes = 0;
     std::shared_ptr<const std::string> line;
     for (const auto& w : watches) {
       if (w->kind != kind) continue;
@@ -1043,6 +1217,11 @@ struct Store {
       if (!w->label_sel.matches(e->obj)) continue;
       if (!line) line = event_line(type, e);
       w->push(line);
+      pushes++;
+    }
+    if (pushes) {
+      g_fanout_pushes.fetch_add(pushes, std::memory_order_relaxed);
+      if (fanout_us) *fanout_us += (double)(now_ns() - f0) / 1000.0;
     }
   }
 
@@ -1112,6 +1291,11 @@ struct Request {
   // pipeline parseable
   size_t content_len = 0;
   bool body_read = false;
+  // phase-timing boundary stamps (0 = timing off): first request bytes,
+  // headers parsed, body consumed
+  uint64_t t_start = 0;
+  uint64_t t_hdr = 0;
+  uint64_t t_body = 0;
 };
 
 static bool send_all(int fd, const char* data, size_t n) {
@@ -1155,6 +1339,12 @@ struct ConnIO {
 
 // Reads one HTTP/1.1 request from the connection's pipelined buffer.
 static bool read_request(ConnIO& io, Request& req) {
+  // read_headers starts at the request's FIRST bytes (buffered for a
+  // pipelined request, or the first fill otherwise) — keep-alive idle
+  // time between requests is never attributed to the phase
+  bool timed = timing_enabled();
+  req.t_start = req.t_hdr = req.t_body = 0;
+  if (timed && io.off < io.in.size()) req.t_start = now_ns();
   size_t hdr_end;
   while ((hdr_end = io.in.find("\r\n\r\n", io.off)) == std::string::npos) {
     if (io.off) {  // compact the consumed prefix before growing
@@ -1163,6 +1353,7 @@ static bool read_request(ConnIO& io, Request& req) {
     }
     if (io.in.size() > (32u << 20)) return false;
     if (!io.fill()) return false;
+    if (timed && !req.t_start) req.t_start = now_ns();
   }
   std::string head = io.in.substr(io.off, hdr_end - io.off);
   size_t line_end = head.find("\r\n");
@@ -1201,6 +1392,7 @@ static bool read_request(ConnIO& io, Request& req) {
   req.body.clear();
   req.body_read = false;
   io.off = hdr_end + 4;  // body bytes are consumed by read_body
+  if (req.t_start) req.t_hdr = now_ns();
   return true;
 }
 
@@ -1223,6 +1415,7 @@ static bool read_body(ConnIO& io, Request& req) {
     io.in.erase(0, io.off);
     io.off = 0;
   }
+  if (req.t_start) req.t_body = now_ns();
   return true;
 }
 
@@ -1497,6 +1690,89 @@ std::string App::metrics_text() {
          std::to_string(g_watch_term_slow.load()) + "\n";
   out += "kwok_watch_terminations_total{reason=\"deadline\"} " +
          std::to_string(g_watch_term_deadline.load()) + "\n";
+
+  // ---- phase-timing families (ISSUE 11): HELP text, bucket labels and
+  // the full phase/verb sample matrix are byte-identical to
+  // telemetry/apiserver_metrics.render_timing_metrics — only the sample
+  // values differ (the parity twin masks them)
+  char fbuf[64];
+  auto hist_lines = [&out, &fbuf](const char* name, const char* label,
+                                  const char* value, const PhaseHist& h) {
+    uint64_t acc = 0;
+    for (int i = 0; i < N_TBUCKETS; i++) {
+      acc += h.buckets[i].load(std::memory_order_relaxed);
+      out += std::string(name) + "_bucket{" + label + "=\"" + value +
+             "\",le=\"" + TBUCKET_LE[i] + "\"} " + std::to_string(acc) +
+             "\n";
+    }
+    // count is read LAST; clamp so a mid-scrape observe can never leave
+    // the +Inf bucket (rendered from count) below a finite bucket
+    uint64_t c = h.count.load(std::memory_order_relaxed);
+    acc += h.buckets[N_TBUCKETS].load(std::memory_order_relaxed);
+    if (c < acc) c = acc;
+    out += std::string(name) + "_bucket{" + label + "=\"" + value +
+           "\",le=\"+Inf\"} " + std::to_string(c) + "\n";
+    snprintf(fbuf, sizeof fbuf, "%.9f",
+             (double)h.sum_ns.load(std::memory_order_relaxed) / 1e9);
+    out += std::string(name) + "_sum{" + label + "=\"" + value + "\"} " +
+           fbuf + "\n";
+    out += std::string(name) + "_count{" + label + "=\"" + value + "\"} " +
+           std::to_string(c) + "\n";
+  };
+  out +=
+      "# HELP kwok_apiserver_request_phase_seconds Per-request phase "
+      "seconds inside the mock apiserver (read_headers+read_body+parse+"
+      "commit+encode reconcile to the request total; fanout is the "
+      "per-watcher encode+push subset of commit and is excluded from the "
+      "sum)\n# TYPE kwok_apiserver_request_phase_seconds histogram\n";
+  for (int p = 0; p < N_PHASES; p++)
+    hist_lines("kwok_apiserver_request_phase_seconds", "phase",
+               PHASE_NAMES[p], g_phase_hist[p]);
+  out +=
+      "# HELP kwok_apiserver_request_seconds End-to-end seconds per "
+      "unary request by audit verb (first request bytes to response "
+      "queued; watch streams are long-running and excluded)\n"
+      "# TYPE kwok_apiserver_request_seconds histogram\n";
+  for (int v = 0; v < N_VERBS; v++)
+    hist_lines("kwok_apiserver_request_seconds", "verb", VERB_NAMES[v],
+               g_verb_hist[v]);
+  out +=
+      "# HELP kwok_watch_fanout_total Watch events pushed to individual "
+      "watchers (one increment per matching watcher per event; "
+      "fanout_sum over this count is the per-watcher encode+push cost)\n"
+      "# TYPE kwok_watch_fanout_total counter\n";
+  out += "kwok_watch_fanout_total " +
+         std::to_string(g_fanout_pushes.load()) + "\n";
+  long n_watch = 0, bmax = 0, btotal = 0;
+  {
+    std::lock_guard<std::mutex> lk(store.mu);
+    for (const auto& w : store.watches) {
+      long d;
+      {
+        std::lock_guard<std::mutex> wl(w->mu);
+        d = (long)w->q.size();
+      }
+      n_watch++;
+      btotal += d;
+      if (d > bmax) bmax = d;
+    }
+  }
+  out +=
+      "# HELP kwok_apiserver_watchers Live watch streams currently "
+      "registered\n# TYPE kwok_apiserver_watchers gauge\n";
+  out += "kwok_apiserver_watchers " + std::to_string(n_watch) + "\n";
+  out +=
+      "# HELP kwok_watch_backlog_events Per-watcher send-buffer depth "
+      "across live watches (agg=max/total) and the high-watermark of "
+      "any capped push (agg=peak; never exceeds KWOK_TPU_WATCH_BACKLOG "
+      "while the slow-consumer cap enforces)\n"
+      "# TYPE kwok_watch_backlog_events gauge\n";
+  out += "kwok_watch_backlog_events{agg=\"max\"} " +
+         std::to_string(bmax) + "\n";
+  out += "kwok_watch_backlog_events{agg=\"total\"} " +
+         std::to_string(btotal) + "\n";
+  out += "kwok_watch_backlog_events{agg=\"peak\"} " +
+         std::to_string(g_backlog_peak.load()) + "\n";
   return out;
 }
 
@@ -1658,16 +1934,88 @@ bool App::handle_request(ConnIO& io, Request& req) {
   std::string uri = req.path;
   if (!req.query.empty()) uri += "?" + req.query;
 
+  // phase timing (ISSUE 11): boundary marks accumulate into pt; the
+  // respond chokepoint closes the request and observes/records it.
+  // band is declared up here so the finisher can label flight records.
+  PhaseTimer pt;
+  int band = -1;
+  auto finish_timing = [&](int code) {
+    if (!req.t_start) return;
+    pt.mark(PH_ENCODE);  // response build + audit + queueing since the
+                         // last mark (commit end, or body read)
+    uint64_t t_end = pt.on ? pt.last : now_ns();
+    uint64_t t0 = req.t_start;
+    req.t_start = 0;  // one observation per request
+    PathMatch fm = match_path(req.path);
+    if (!fm.ok) return;  // ops/debug paths stay untimed (Python parity)
+    bool is_watch = false;
+    if (req.method == "GET") {
+      auto wq = q.find("watch");
+      is_watch =
+          wq != q.end() && (wq->second == "true" || wq->second == "1");
+    }
+    double total_us = (double)(t_end - t0) / 1000.0;
+    uint64_t t_hdr = req.t_hdr ? req.t_hdr : t0;
+    uint64_t t_body = req.t_body ? req.t_body : t_hdr;
+    pt.us[PH_READ_HEADERS] = (double)(t_hdr - t0) / 1000.0;
+    pt.us[PH_READ_BODY] = (double)(t_body - t_hdr) / 1000.0;
+    g_phase_hist[PH_READ_HEADERS].observe_ns(t_hdr - t0);
+    g_phase_hist[PH_READ_BODY].observe_ns(t_body - t_hdr);
+    g_phase_hist[PH_COMMIT].observe_ns(
+        (uint64_t)(pt.us[PH_COMMIT] * 1000.0));
+    g_phase_hist[PH_ENCODE].observe_ns(
+        (uint64_t)(pt.us[PH_ENCODE] * 1000.0));
+    if (pt.parsed)
+      g_phase_hist[PH_PARSE].observe_ns(
+          (uint64_t)(pt.us[PH_PARSE] * 1000.0));
+    if (pt.us[PH_FANOUT] > 0)
+      g_phase_hist[PH_FANOUT].observe_ns(
+          (uint64_t)(pt.us[PH_FANOUT] * 1000.0));
+    int vi = 5;  // other (includes watch-handshake errors, Python parity)
+    if (req.method == "GET" && !is_watch) vi = fm.name.empty() ? 1 : 0;
+    else if (req.method == "POST") vi = 2;
+    else if (req.method == "PATCH") vi = 3;
+    else if (req.method == "DELETE") vi = 4;
+    g_verb_hist[vi].observe_ns(t_end - t0);
+    FlightRec rec;
+    rec.method = req.method;
+    rec.path = uri;
+    rec.status = code;
+    // band by REQUEST SHAPE (Python _admission_band parity): labeled
+    // even when no max-inflight limit is configured
+    if (band == 0 || (band < 0 && req.method == "GET" && !is_watch))
+      rec.band = "readonly";
+    else if (band == 1 ||
+             (band < 0 && (req.method == "POST" || req.method == "PATCH" ||
+                           req.method == "DELETE")))
+      rec.band = "mutating";
+    else
+      rec.band = "none";
+    rec.ts_unix = wall_unix_s() - total_us / 1e6;
+    rec.total_us = total_us;
+    for (int p = 0; p < N_PHASES; p++) rec.phases_us[p] = pt.us[p];
+    flight_record(std::move(rec));
+  };
+
   auto respond = [&](int code, const std::string& body,
                      const char* extra = "",
                      const char* ctype = "application/json") {
     audit_line(req.method, uri, code);
     bool ok = queue_response(io, code, body, extra, ctype);
+    finish_timing(code);
     if (req.close) {
       io.flush();
       return false;
     }
     return ok;
+  };
+  // arm the phase accumulator once the body is consumed (read_body
+  // stamped t_body); every later mark() is one clock read
+  auto arm_timer = [&] {
+    if (req.t_start) {
+      pt.on = true;
+      pt.last = req.t_body ? req.t_body : now_ns();
+    }
   };
 
   // ---- max-inflight admission (two bands; watches + non-resource paths
@@ -1675,7 +2023,6 @@ bool App::handle_request(ConnIO& io, Request& req) {
   // included — so saturation is observable; a rejected request answers
   // 429 + Retry-After NOW instead of queueing, after draining its body so
   // the keep-alive pipeline stays parseable.
-  int band = -1;
   if (max_inflight_band[0] > 0 || max_inflight_band[1] > 0) {
     PathMatch am = match_path(req.path);
     if (am.ok) {
@@ -1701,16 +2048,23 @@ bool App::handle_request(ConnIO& io, Request& req) {
       inflight[band].fetch_sub(1);
       rejected[band].fetch_add(1);
       if (!read_body(io, req)) return false;  // drain for keep-alive
+      arm_timer();
       return respond(429, TOO_MANY_REQUESTS_BODY, "Retry-After: 1\r\n");
     }
     slot.c = &inflight[band];
   }
   if (!read_body(io, req)) return false;
+  arm_timer();
 
   if (req.method == "GET" && req.path == "/healthz")
     return respond(200, "ok");
   if (req.method == "GET" && req.path == "/metrics")
     return respond(200, metrics_text(), "", "text/plain; version=0.0.4");
+  if (req.method == "GET" && req.path == "/debug/flight")
+    // flight recorder dump (anonymous, like /metrics): the bounded ring
+    // of recent request records — the engine auto-grabs it on a /readyz
+    // degradation edge
+    return respond(200, flight_dump_json());
   // bearer-token authn (--token-auth-file): /healthz stays anonymous (the
   // components' --authorization-always-allow-paths contract)
   if (!auth_tokens.empty() &&
@@ -1795,6 +2149,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
         }
       }
     }
+    pt.mark(PH_COMMIT);
     if (!found) {
       std::string body =
           "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":\"Failure\","
@@ -1834,6 +2189,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
         auto it = store.kinds[m.kind].find(key);
         if (it != store.kinds[m.kind].end()) e = it->second;
       }
+      pt.mark(PH_COMMIT);
       if (!e) return respond(404, "{\"kind\":\"Status\",\"code\":404}");
       return respond(200, e->bytes);
     }
@@ -2140,6 +2496,8 @@ bool App::handle_request(ConnIO& io, Request& req) {
         }
       }
     }
+    pt.mark(PH_COMMIT);  // snapshot under the lock; match/serialize below
+                         // is response build, attributed to encode
     // The continue token is rebuilt from the entry's own (immutable)
     // metadata — map keys may be erased concurrently once the lock drops.
     auto key_of = [token_rv](const JVal& obj, std::string& out) {
@@ -2221,6 +2579,8 @@ bool App::handle_request(ConnIO& io, Request& req) {
     // the real scheduler's bind: POST v1 Binding -> set spec.nodeName once
     JParser p(req.body);
     JVal b = p.parse();
+    pt.mark(PH_PARSE);
+    if (p.ok) pt.parsed = true;
     const JVal* target = b.is_obj() ? b.find("target") : nullptr;
     const JVal* tname =
         target && target->is_obj() ? target->find("name") : nullptr;
@@ -2245,10 +2605,12 @@ bool App::handle_request(ConnIO& io, Request& req) {
           EntryPtr e = publish(std::move(obj));
           EntryPtr prev = it->second;
           it->second = e;
-          store.emit(1, "MODIFIED", e, key, std::move(prev));
+          store.emit(1, "MODIFIED", e, key, std::move(prev),
+                     pt.on ? &pt.us[PH_FANOUT] : nullptr);
         }
       }
     }
+    pt.mark(PH_COMMIT);
     if (!found) return respond(404, "{\"kind\":\"Status\",\"code\":404}");
     if (!conflict.empty()) {
       std::string body =
@@ -2269,6 +2631,8 @@ bool App::handle_request(ConnIO& io, Request& req) {
       return respond(404, "{\"kind\":\"Status\",\"code\":404}");
     JParser p(req.body);
     JVal obj = p.parse();
+    pt.mark(PH_PARSE);
+    if (p.ok) pt.parsed = true;
     if (!p.ok || obj.type != JVal::OBJ)
       return respond(400, "{\"kind\":\"Status\",\"code\":400}");
     JVal& meta = obj.get_or_insert_obj("metadata");
@@ -2320,7 +2684,8 @@ bool App::handle_request(ConnIO& io, Request& req) {
         store.bump(obj);
         e = publish(std::move(obj));
         store.kinds[m.kind][k] = e;
-        store.emit(m.kind, "ADDED", e, k, nullptr);
+        store.emit(m.kind, "ADDED", e, k, nullptr,
+                   pt.on ? &pt.us[PH_FANOUT] : nullptr);
         if (m.kind == kind_index("events") && events_cap() > 0) {
           auto& evs = store.kinds[m.kind];
           while ((int)evs.size() > events_cap()) {
@@ -2349,11 +2714,13 @@ bool App::handle_request(ConnIO& io, Request& req) {
             evs.erase(victim);
             store.bump(vobj);
             store.emit(m.kind, "DELETED", publish(std::move(vobj)), vkey,
-                       std::move(vprev));
+                       std::move(vprev),
+                       pt.on ? &pt.us[PH_FANOUT] : nullptr);
           }
         }
       }
     }
+    pt.mark(PH_COMMIT);
     if (!exists_name.empty()) {
       std::string body =
           "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
@@ -2373,6 +2740,8 @@ bool App::handle_request(ConnIO& io, Request& req) {
   if (req.method == "PATCH") {
     JParser p(req.body);
     JVal patch = p.parse();
+    pt.mark(PH_PARSE);
+    if (p.ok) pt.parsed = true;
     if (!p.ok) return respond(400, "{\"kind\":\"Status\",\"code\":400}");
     std::string body;
     int code = 200;
@@ -2414,10 +2783,12 @@ bool App::handle_request(ConnIO& io, Request& req) {
         EntryPtr e = publish(std::move(obj));
         EntryPtr prev = it->second;
         it->second = e;
-        store.emit(m.kind, "MODIFIED", e, key, std::move(prev));
+        store.emit(m.kind, "MODIFIED", e, key, std::move(prev),
+                   pt.on ? &pt.us[PH_FANOUT] : nullptr);
         body = e->bytes;
       }
     }
+    pt.mark(PH_COMMIT);
     return respond(code, body);
   }
 
@@ -2427,6 +2798,8 @@ bool App::handle_request(ConnIO& io, Request& req) {
     if (!req.body.empty()) {
       JParser p(req.body);
       JVal b = p.parse();
+      pt.mark(PH_PARSE);
+      if (p.ok) pt.parsed = true;
       const JVal* g = b.is_obj() ? b.find("gracePeriodSeconds") : nullptr;
       if (g && g->type == JVal::NUM) {
         grace = atol(g->s.c_str());
@@ -2464,16 +2837,19 @@ bool App::handle_request(ConnIO& io, Request& req) {
           EntryPtr e = publish(std::move(obj));
           EntryPtr prev = it->second;
           it->second = e;
-          store.emit(m.kind, "MODIFIED", e, key, std::move(prev));
+          store.emit(m.kind, "MODIFIED", e, key, std::move(prev),
+                     pt.on ? &pt.us[PH_FANOUT] : nullptr);
         } else {
           EntryPtr prev = it->second;
           store.kinds[m.kind].erase(it);
           store.bump(obj);
           EntryPtr de = publish(std::move(obj));
-          store.emit(m.kind, "DELETED", de, key, std::move(prev));
+          store.emit(m.kind, "DELETED", de, key, std::move(prev),
+                     pt.on ? &pt.us[PH_FANOUT] : nullptr);
         }
       }
     }
+    pt.mark(PH_COMMIT);
     return respond(200, "{\"kind\":\"Status\",\"status\":\"Success\"}");
   }
 

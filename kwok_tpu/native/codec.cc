@@ -261,6 +261,7 @@ int64_t kwok_render_pod_statuses(
 
 // Keep in lockstep with ABI_VERSION in native/__init__.py — a mismatch
 // triggers delete+rebuild loops (and bricks hosts without a compiler).
-int32_t kwok_codec_abi_version() { return 7; }
+// ABI 8: pump.cc grew kwok_pump_stats (send-path attribution).
+int32_t kwok_codec_abi_version() { return 8; }
 
 }  // extern "C"

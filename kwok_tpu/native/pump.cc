@@ -27,6 +27,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -47,7 +49,23 @@ struct Pump {
   int port = 0;
   std::vector<Conn> conns;
   std::string header_extra;  // e.g. "Authorization: Bearer ...\r\n"
+  // send-path attribution (ISSUE 11): cumulative wall ns split between
+  // the request-writing side and the response-reading side, summed
+  // across connections (they overlap, so write+read can exceed batch).
+  // Two clock reads per connection per BATCH — amortized over hundreds
+  // of requests, so the stats are always on.
+  std::atomic<uint64_t> batches{0};
+  std::atomic<uint64_t> requests{0};
+  std::atomic<uint64_t> batch_ns{0};
+  std::atomic<uint64_t> write_ns{0};
+  std::atomic<uint64_t> read_ns{0};
 };
+
+uint64_t pump_now_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 std::mutex g_pumps_mu;
 std::map<int64_t, Pump*> g_pumps;
@@ -195,36 +213,41 @@ void run_conn(Pump* p, size_t ci, const Slices& method, const Slices& path,
   // writer thread streams all requests; this thread reads responses
   bool write_ok = true;
   std::thread writer([&] {
-    std::string out;
-    out.reserve(1 << 20);
-    char clen[64];
-    for (int32_t i : idxs) {
-      out.append(method.ptr(i), method.len(i));
-      out += ' ';
-      out.append(path.ptr(i), path.len(i));
-      out += " HTTP/1.1\r\nHost: ";
-      out += p->host;
-      out += "\r\nContent-Type: ";
-      if (ctype.len(i) > 0) out.append(ctype.ptr(i), ctype.len(i));
-      else out += "application/json";
-      out += "\r\n";
-      out += p->header_extra;
-      int n = snprintf(clen, sizeof clen, "Content-Length: %lld\r\n\r\n",
-                       (long long)body.len(i));
-      out.append(clen, n);
-      out.append(body.ptr(i), body.len(i));
-      if (out.size() >= (1 << 20)) {
-        if (!send_all(c.fd, out.data(), out.size())) {
-          write_ok = false;
-          return;
+    uint64_t w0 = pump_now_ns();
+    [&] {
+      std::string out;
+      out.reserve(1 << 20);
+      char clen[64];
+      for (int32_t i : idxs) {
+        out.append(method.ptr(i), method.len(i));
+        out += ' ';
+        out.append(path.ptr(i), path.len(i));
+        out += " HTTP/1.1\r\nHost: ";
+        out += p->host;
+        out += "\r\nContent-Type: ";
+        if (ctype.len(i) > 0) out.append(ctype.ptr(i), ctype.len(i));
+        else out += "application/json";
+        out += "\r\n";
+        out += p->header_extra;
+        int n = snprintf(clen, sizeof clen, "Content-Length: %lld\r\n\r\n",
+                         (long long)body.len(i));
+        out.append(clen, n);
+        out.append(body.ptr(i), body.len(i));
+        if (out.size() >= (1 << 20)) {
+          if (!send_all(c.fd, out.data(), out.size())) {
+            write_ok = false;
+            return;
+          }
+          out.clear();
         }
-        out.clear();
       }
-    }
-    if (!out.empty() && !send_all(c.fd, out.data(), out.size()))
-      write_ok = false;
+      if (!out.empty() && !send_all(c.fd, out.data(), out.size()))
+        write_ok = false;
+    }();
+    p->write_ns.fetch_add(pump_now_ns() - w0, std::memory_order_relaxed);
   });
 
+  uint64_t r0 = pump_now_ns();
   RespReader rr{c.fd};
   size_t done = 0;
   for (; done < idxs.size(); done++) {
@@ -232,6 +255,7 @@ void run_conn(Pump* p, size_t ci, const Slices& method, const Slices& path,
     if (code == 0) break;
     status_out[idxs[done]] = code;
   }
+  p->read_ns.fetch_add(pump_now_ns() - r0, std::memory_order_relaxed);
   writer.join();
   if (done < idxs.size() || !write_ok) {
     for (size_t i = done; i < idxs.size(); i++) status_out[idxs[i]] = 0;
@@ -277,6 +301,7 @@ int64_t kwok_pump_send(int64_t handle, int32_t n,
   Slices path{path_blob, path_off};
   Slices ctype{ctype_blob, ctype_off};
   Slices body{body_blob, body_off};
+  uint64_t b0 = pump_now_ns();
 
   size_t nconn = p->conns.size();
   std::vector<std::vector<int32_t>> shards(nconn);
@@ -290,11 +315,35 @@ int64_t kwok_pump_send(int64_t handle, int32_t n,
                          std::cref(shards[ci]), status_out);
   }
   for (auto& t : threads) t.join();
+  p->batches.fetch_add(1, std::memory_order_relaxed);
+  p->requests.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  p->batch_ns.fetch_add(pump_now_ns() - b0, std::memory_order_relaxed);
 
   int64_t ok = 0;
   for (int32_t i = 0; i < n; i++)
     if (status_out[i] >= 200 && status_out[i] < 300) ok++;
   return ok;
+}
+
+// Send-path attribution snapshot: out[5] = {batches, requests, batch_s,
+// write_s, read_s}. write/read are summed across the pool's overlapping
+// per-connection threads, so each can exceed batch_s on multi-conn pumps.
+void kwok_pump_stats(int64_t handle, double* out) {
+  Pump* p = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_pumps_mu);
+    auto it = g_pumps.find(handle);
+    if (it != g_pumps.end()) p = it->second;
+  }
+  if (!p) {
+    for (int i = 0; i < 5; i++) out[i] = 0;
+    return;
+  }
+  out[0] = (double)p->batches.load(std::memory_order_relaxed);
+  out[1] = (double)p->requests.load(std::memory_order_relaxed);
+  out[2] = (double)p->batch_ns.load(std::memory_order_relaxed) / 1e9;
+  out[3] = (double)p->write_ns.load(std::memory_order_relaxed) / 1e9;
+  out[4] = (double)p->read_ns.load(std::memory_order_relaxed) / 1e9;
 }
 
 void kwok_pump_close(int64_t handle) {

@@ -122,12 +122,17 @@ class Degradation:
     (a process-global ledger would cross-contaminate the multi-engine
     test and federation topologies)."""
 
-    def __init__(self, registry):
+    def __init__(self, registry, on_set=None):
         self._fam = registry.gauge(
             "kwok_degraded", _DEGRADED_HELP, ("reason",)
         )
         self._deg_lock = threading.Lock()
         self._reasons: set[str] = set()
+        # edge hook: called with the reason on every FRESH set, outside
+        # the ledger lock (the engine hangs its flight-recorder
+        # post-mortem grab here — best-effort, never raising back into
+        # the degrading code path)
+        self._on_set = on_set
 
     def set(self, reason: str) -> bool:
         """Mark a reason active; returns True when newly set (callers
@@ -137,6 +142,15 @@ class Degradation:
             self._reasons.add(reason)
         # registry child access is a leaf; never under our lock
         self._fam.labels(reason=reason).set(1)
+        if fresh and self._on_set is not None:
+            try:
+                self._on_set(reason)
+            except Exception:
+                from kwok_tpu.telemetry.errors import swallowed
+
+                # a failing post-mortem hook must never break the
+                # degradation transition it is documenting
+                swallowed("policy.degradation_on_set")
         return fresh
 
     def clear(self, reason: str) -> bool:

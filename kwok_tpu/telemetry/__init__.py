@@ -24,6 +24,7 @@ from kwok_tpu.telemetry.registry import (
     HistogramFamily,
     MetricsRegistry,
 )
+from kwok_tpu.telemetry.timeline import check_flight, merge_timeline
 from kwok_tpu.telemetry.trace import Tracer, merge_chrome_traces
 
 __all__ = [
@@ -35,6 +36,8 @@ __all__ = [
     "LaneTelemetry",
     "MetricsRegistry",
     "Tracer",
+    "check_flight",
     "merge_chrome_traces",
+    "merge_timeline",
     "register_build_info",
 ]

@@ -114,6 +114,30 @@ def test_metrics_contract_fixture():
     assert len(findings) == 3
 
 
+def test_metrics_contract_scans_native_apiserver_cc(tmp_path):
+    """ISSUE 11 satellite: families that exist only in the native
+    apiserver's metrics_text() (apiserver.cc) are held to the same
+    doc contract — an undocumented native family is a finding, and a
+    documented one is not reported as a phantom."""
+    root = tmp_path
+    (root / "kwok_tpu" / "native").mkdir(parents=True)
+    (root / "kwok_tpu" / "native" / "apiserver.cc").write_text(
+        '// mock\nstd::string m() {\n'
+        '  out += "# TYPE kwok_native_only_total counter\\n";\n'
+        '  out += "kwok_cc_documented_seconds_bucket{le=\\"1\\"} 0\\n";\n'
+        '}\n'
+    )
+    doc = root / "obs.md"
+    doc.write_text("| `kwok_cc_documented_seconds` | catalogued |\n")
+    rule = MetricsContractRule(doc_path=str(doc))
+    findings = list(rule.check_project([], str(root)))
+    msgs = "\n".join(f.message for f in findings)
+    # undocumented native family fires; the _bucket sample of the
+    # documented one folds into its parent and stays clean
+    assert "kwok_native_only_total" in msgs
+    assert "kwok_cc_documented_seconds" not in msgs
+
+
 # ------------------------------------------------- the real tree is clean
 
 

@@ -370,6 +370,191 @@ def test_engine_stop_dumps_trace(tmp_path):
     check_chrome_trace(doc)
 
 
+def _drive_mock_apiserver():
+    """One HTTP workload against the Python mock: create/patch/list with
+    a live watcher, so every timing phase (fanout included) observes."""
+    import threading
+    import urllib.request
+
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+
+    srv = HttpFakeApiserver().start()
+    try:
+        c = HttpKubeClient(srv.url)
+        c.create("nodes", make_node("tm-n"))
+        c.create("pods", make_pod("tm-p", node="tm-n"))
+        w = c.watch("pods")
+        threading.Thread(
+            target=lambda: [None for _ in w], daemon=True
+        ).start()
+        import time
+
+        time.sleep(0.2)
+        for i in range(3):
+            c.patch_status(
+                "pods", "default", "tm-p", {"status": {"phase": "Running"}}
+            )
+        c.list("pods")
+        text = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=5
+        ).read().decode()
+        flight = json.loads(urllib.request.urlopen(
+            srv.url + "/debug/flight", timeout=5
+        ).read())
+        w.stop()
+        c.close()
+        return text, flight
+    finally:
+        srv.stop()
+
+
+def test_apiserver_timing_exposition_strict():
+    """ISSUE 11: the mock apiserver's /metrics — overload surface plus
+    the new phase-timing families — passes the strict format oracle,
+    with the full phase/verb matrix and live watcher/backlog gauges."""
+    from kwok_tpu.telemetry.apiserver_metrics import (
+        TIMING_PHASES,
+        TIMING_VERBS,
+    )
+
+    text, flight = _drive_mock_apiserver()
+    fams = parse_exposition(text)
+    ph = fams["kwok_apiserver_request_phase_seconds"]
+    assert ph["type"] == "histogram"
+    phases = {s[1]["phase"] for s in ph["samples"]}
+    assert phases == set(TIMING_PHASES)
+    rq = fams["kwok_apiserver_request_seconds"]
+    assert rq["type"] == "histogram"
+    assert {s[1]["verb"] for s in rq["samples"]} == set(TIMING_VERBS)
+    # the workload was actually observed: patches landed in the patch
+    # verb and the commit phase moved
+    counts = {
+        s.get("verb"): v for n, s, v in rq["samples"]
+        if n.endswith("_count")
+    }
+    assert counts["patch"] >= 3 and counts["create"] >= 2
+    commit_sum = [
+        v for n, s, v in ph["samples"]
+        if n.endswith("_sum") and s["phase"] == "commit"
+    ]
+    assert commit_sum and commit_sum[0] > 0
+    assert fams["kwok_watch_fanout_total"]["samples"][0][2] >= 3
+    assert fams["kwok_apiserver_watchers"]["type"] == "gauge"
+    aggs = {s[1]["agg"] for s in
+            fams["kwok_watch_backlog_events"]["samples"]}
+    assert aggs == {"max", "total", "peak"}
+    # flight recorder: shared schema + the patches are in the ring
+    from kwok_tpu.telemetry.timeline import check_flight
+
+    check_flight(flight)
+    assert flight["server"] == "mock" and flight["records"]
+    patched = [r for r in flight["records"] if r["method"] == "PATCH"]
+    assert patched and patched[-1]["band"] == "mutating"
+    assert patched[-1]["phases_us"]["commit"] > 0
+
+
+def test_timeline_merge_and_attribution():
+    """The flight dump merges with a tracer ring into one valid Chrome
+    trace, and the attribution table reconciles phases vs totals."""
+    from kwok_tpu.telemetry import Tracer
+    from kwok_tpu.telemetry.timeline import (
+        attribution,
+        attribution_from_metrics,
+        format_table,
+        merge_timeline,
+    )
+
+    text, flight = _drive_mock_apiserver()
+    tr = Tracer()
+    ep = tr.epoch_perf
+    tr.span("pump.send", ep, ep + 0.01, "pump", {"requests": 3})
+    merged = json.loads(json.dumps(merge_timeline(tr.chrome_trace(),
+                                                  flight)))
+    check_chrome_trace(merged)
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1}  # engine + apiserver sides both present
+    assert merged["otherData"]["flight_records_merged"] == len(
+        flight["records"]
+    )
+    att = attribution(flight)
+    assert att["requests"] == len(flight["records"])
+    assert att["request_total_us"] > 0
+    # phase sum within the attribution contract's shape (the hard bound
+    # is benchmarks/latency_attrib.py's disclosed tolerance)
+    assert 0 < att["phase_sum_us"] <= att["request_total_us"] * 1.5
+    table = format_table(att)
+    assert "request total" in table and "fanout" in table
+    att2 = attribution_from_metrics(text)
+    assert att2["requests"] >= att["requests"]
+    assert att2["phase_totals_us"]["commit"] > 0
+
+
+def test_flight_schema_rejects_malformed():
+    from kwok_tpu.telemetry.timeline import check_flight
+
+    good = {
+        "server": "mock", "timing_enabled": True, "ring_capacity": 8,
+        "captured": 1,
+        "records": [{
+            "method": "GET", "path": "/api/v1/pods", "status": 200,
+            "band": "readonly", "ts_unix": 1.0, "total_us": 5.0,
+            "phases_us": {p: 0.0 for p in (
+                "read_headers", "read_body", "parse", "commit",
+                "encode", "fanout")},
+        }],
+    }
+    check_flight(good)
+    bad = json.loads(json.dumps(good))
+    bad["records"][0]["band"] = "purple"
+    with pytest.raises(AssertionError):
+        check_flight(bad)
+    bad2 = json.loads(json.dumps(good))
+    del bad2["records"][0]["phases_us"]["commit"]
+    with pytest.raises(AssertionError):
+        check_flight(bad2)
+
+
+def test_engine_flight_autodump_on_degradation(tmp_path):
+    """A FRESH /readyz degradation reason auto-grabs the apiserver's
+    /debug/flight into the configured directory (the post-mortem for
+    'why did we degrade', saved before the ring overwrites it)."""
+    import time
+
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+    from kwok_tpu.engine import ClusterEngine
+    from kwok_tpu.telemetry.timeline import check_flight
+
+    srv = HttpFakeApiserver().start()
+    client = HttpKubeClient(srv.url)
+    try:
+        client.create("nodes", make_node("fd-n"))  # something in the ring
+        eng = ClusterEngine(
+            client,
+            EngineConfig(
+                manage_all_nodes=True, flight_dir=str(tmp_path)
+            ),
+        )
+        assert eng._degradation.set("pump")  # fresh edge fires the hook
+        deadline = time.time() + 10
+        dumps = []
+        while time.time() < deadline:
+            dumps = list(tmp_path.glob("flight-pump-*.json"))
+            if dumps:
+                break
+            time.sleep(0.05)
+        assert dumps, "degradation edge did not dump the flight recorder"
+        doc = json.loads(dumps[0].read_text())
+        check_flight(doc)
+        assert doc["server"] == "mock"
+        # re-setting the SAME reason is not an edge: no second dump
+        assert not eng._degradation.set("pump")
+    finally:
+        client.close()
+        srv.stop()
+
+
 def test_profiling_overruns_and_hooks(tmp_path, monkeypatch):
     """Sampler dumps carry the overrun counter, and the crash-dump hooks
     install idempotently."""

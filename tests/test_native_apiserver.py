@@ -791,6 +791,176 @@ def test_slow_consumer_termination_parity():
         )
 
 
+# ---------------------------------------------- latency attribution
+# (ISSUE 11): both servers measure per-request phase timing into the
+# SAME metric families and keep the same flight-recorder schema — the
+# /metrics text is byte-compared with only sample values masked, and
+# /debug/flight dumps validate against one shared schema.
+
+
+def _timing_workload(url: str):
+    """Identical drive on either server: create/patch/list/delete with
+    one live watcher, every phase exercised."""
+    c = HttpKubeClient(url)
+    c.create("nodes", make_node("tw-n"))
+    c.create("pods", make_pod("tw-p", node="tw-n"))
+    w = c.watch("pods")
+    threading.Thread(target=lambda: [None for _ in w], daemon=True).start()
+    time.sleep(0.2)
+    for i in range(3):
+        c.patch_status(
+            "pods", "default", "tw-p", {"status": {"phase": "Running"}}
+        )
+    c.list("pods")
+    c.delete("pods", "default", "tw-p", grace_seconds=0)
+    text = urllib.request.urlopen(url + "/metrics", timeout=5) \
+        .read().decode()
+    flight = json.load(
+        urllib.request.urlopen(url + "/debug/flight", timeout=5)
+    )
+    w.stop()
+    c.close()
+    return text, flight
+
+
+def _mask_values(text: str) -> str:
+    """Replace every sample VALUE (the trailing token of non-comment
+    lines) — family names, HELP text, label sets and ordering remain."""
+    return _re.sub(
+        r"^(?!#)(.*) \S+$", r"\1 V", text, flags=_re.M
+    )
+
+
+def test_timing_metrics_families_parity(srv):
+    """The whole /metrics exposition — overload surface + the ISSUE 11
+    timing families — is byte-identical across the two servers once
+    sample values are masked (full phase/verb matrix, same bucket
+    labels, same HELP text)."""
+    native_text, _ = _timing_workload(srv.url)
+    py = HttpFakeApiserver().start()
+    try:
+        python_text, _ = _timing_workload(py.url)
+    finally:
+        py.stop()
+    assert _mask_values(native_text) == _mask_values(python_text)
+
+
+def test_flight_recorder_schema_parity(srv):
+    """/debug/flight on both servers: one shared schema (timeline.py
+    check_flight), same record/phase key sets, and the workload's
+    patches present with a positive commit phase."""
+    from kwok_tpu.telemetry.timeline import check_flight
+
+    dumps = {}
+    _, dumps["native"] = _timing_workload(srv.url)
+    py = HttpFakeApiserver().start()
+    try:
+        _, dumps["python"] = _timing_workload(py.url)
+    finally:
+        py.stop()
+    keysets = {}
+    for name, doc in dumps.items():
+        check_flight(doc)
+        assert doc["timing_enabled"] is True
+        assert doc["records"], name
+        keysets[name] = (
+            tuple(sorted(doc["records"][0])),
+            tuple(sorted(doc["records"][0]["phases_us"])),
+        )
+        patches = [r for r in doc["records"] if r["method"] == "PATCH"]
+        assert patches, name
+        assert patches[-1]["band"] == "mutating"
+        assert patches[-1]["phases_us"]["commit"] > 0, name
+        assert patches[-1]["total_us"] > 0
+    assert dumps["native"]["server"] == "native"
+    assert dumps["python"]["server"] == "mock"
+    assert keysets["native"] == keysets["python"]
+
+
+def test_timing_disabled_is_zero_cost_surface():
+    """KWOK_TPU_APISERVER_TIMING=0: the families still render (shape-
+    stable scrapes) but every histogram stays zeroed and the flight
+    ring stays empty — on BOTH servers."""
+    from kwok_tpu.edge.mockserver import FakeKube
+    from kwok_tpu.telemetry.apiserver_metrics import ApiserverTiming
+
+    def drive_and_scrape(url):
+        c = HttpKubeClient(url)
+        c.create("nodes", make_node("zd-n"))
+        c.patch_status("nodes", None, "zd-n", {"status": {"phase": "X"}})
+        text = urllib.request.urlopen(url + "/metrics", timeout=5) \
+            .read().decode()
+        flight = json.load(
+            urllib.request.urlopen(url + "/debug/flight", timeout=5)
+        )
+        c.close()
+        return text, flight
+
+    results = {}
+    s = NativeServer(env={"KWOK_TPU_APISERVER_TIMING": "0"})
+    try:
+        results["native"] = drive_and_scrape(s.url)
+    finally:
+        s.stop()
+    fk = FakeKube()
+    fk.timing = ApiserverTiming(enabled=False)
+    py = HttpFakeApiserver(store=fk).start()
+    try:
+        results["python"] = drive_and_scrape(py.url)
+    finally:
+        py.stop()
+    for name, (text, flight) in results.items():
+        assert flight["timing_enabled"] is False, name
+        assert flight["records"] == [] and flight["captured"] == 0, name
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            if "_request_phase_seconds" in line or \
+                    "_request_seconds" in line:
+                assert line.endswith(" 0") or \
+                    line.endswith(" 0.000000000"), (name, line)
+
+
+def test_backlog_peak_tracks_and_respects_cap():
+    """The kwok_watch_backlog_events{agg="peak"} watermark: grows with
+    queued events, never exceeds the configured cap when a slow consumer
+    is terminated (the fleet gate's deterministic bounded-buffer
+    proof)."""
+
+    def scrape_peak(url):
+        text = urllib.request.urlopen(url + "/metrics", timeout=5) \
+            .read().decode()
+        for line in text.splitlines():
+            if line.startswith('kwok_watch_backlog_events{agg="peak"}'):
+                return float(line.rsplit(" ", 1)[1])
+        return -1.0
+
+    s = NativeServer(env={"KWOK_TPU_WATCH_BACKLOG": "8"})
+    try:
+        port = int(s.url.rsplit(":", 1)[1])
+        # a stalled raw-socket watcher (never reads)
+        sock = _socket.socket()
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 4096)
+        sock.connect(("127.0.0.1", port))
+        sock.sendall(b"GET /api/v1/nodes?watch=true HTTP/1.1\r\n"
+                     b"Host: x\r\n\r\n")
+        time.sleep(0.2)
+        c = HttpKubeClient(s.url)
+        pad = "x" * 32768
+        for i in range(60):
+            c.create("nodes", {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": f"bp{i}", "labels": {"pad": pad}},
+            })
+        time.sleep(0.3)
+        peak = scrape_peak(s.url)
+        c.close()
+        sock.close()
+        assert 1 <= peak <= 8, peak  # cap enforced, watermark visible
+    finally:
+        s.stop()
+
+
 # ------------------------------------------------- hostile request bytes
 # (ISSUE 10): garbled/truncated REQUEST bytes must answer 400 with a
 # Status body — byte-identical across the two servers — and never crash
