@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check fleet-check drift-check attrib-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check fleet-check drift-check attrib-check ha-check image cluster-image clean
 
 all: build
 
@@ -100,6 +100,20 @@ drift-check: ## hostile-wire convergence + anti-entropy drift-repair gate
 # apiserver 10x tentpole. Skips cleanly when no C++ compiler is available.
 attrib-check: ## measured end-to-end latency attribution gate (LATENCY_r* artifact)
 	$(PYENV) python3 benchmarks/latency_attrib.py --check
+
+# ha-check: the warm-standby failover gate (ISSUE 12): a real
+# primary/standby tpukwok pair (lease-fenced through both mock
+# apiservers' coordination.k8s.io Lease dialect) under the PR 6 storm.
+# The primary is SIGKILLed AND SIGSTOPped (zombie) mid-delay; gates =
+# takeover RTO <= lease duration + one tick quantum (and under the
+# measured cold-restart reference), ZERO double-fired transitions on the
+# wall-stamped oplog across both holders (the SIGCONT'd zombie provably
+# write-dead: client fence + pump fence + server-side fencing header),
+# final pod phases byte-identical to the uninterrupted-pair control arm,
+# across every seed (docs/resilience.md "Warm-standby failover";
+# HA_r*.json).
+ha-check: ## lease-fenced warm-standby failover gate (HA_r* artifact)
+	$(PYENV) python3 benchmarks/failover_soak.py --check
 
 image:
 	./images/kwok/build.sh

@@ -29,6 +29,9 @@ make drift-check
 echo ">> attrib-check (measured apiserver latency attribution + zero-cost contracts)"
 make attrib-check
 
+echo ">> ha-check (lease-fenced warm-standby failover gate)"
+make ha-check
+
 echo ">> bash syntax"
 find hack test images -name '*.sh' -print0 | xargs -0 -n1 bash -n
 

@@ -68,6 +68,13 @@ LOCK_ORDER: dict[str, int] = {
     # by gates/tests. Taken after a lane's stage_lock on the pool-keys
     # walk (a legal 10 -> 84 descent); nothing is ever acquired under it.
     "_ae_lock": 84,
+    # HA leadership plane (ISSUE 12): guards only the elector's role
+    # state machine (leading/lost flags) and the tailed peer-checkpoint
+    # document in resilience/ha.py. The fence itself is a lock-free
+    # float attribute (the per-write check must never take a lock);
+    # degradation/registry/_ckpt_lock interactions all happen AFTER
+    # release — nothing is ever acquired under it.
+    "_ha_lock": 84,
     "_lock": 85,        # single-resource leaves (ippool, registry, ...)
     "_apiserver_lock": 85,
     "_audit_lock": 95,  # mockserver audit ring, below the store lock

@@ -71,6 +71,21 @@ class KwokConfigurationOptions:
     # LIST pages; detects + repairs silent divergence). 0 = off (the
     # default; KWOK_TPU_AUDIT_INTERVAL is the engine-level fallback).
     auditInterval: float = 0.0
+    # Warm-standby HA (resilience/ha.py, docs/resilience.md): "" = off
+    # (no elector, no fence — the zero-cost default). "primary" serves
+    # while renewing the coordination.k8s.io Lease; "standby" observes
+    # warm and takes over on lease expiry. Identity defaults to
+    # hostname-pid; it doubles as the checkpoint file name so the
+    # standby can tail the holder's stream. Env: KWOK_HA_ROLE,
+    # KWOK_HA_IDENTITY, KWOK_LEASE_NAME, KWOK_LEASE_NAMESPACE,
+    # KWOK_LEASE_DURATION, KWOK_LEASE_RENEW_INTERVAL (the generic
+    # apply_env_overrides pass).
+    haRole: str = ""
+    haIdentity: str = ""
+    leaseName: str = "kwok-tpu-engine"
+    leaseNamespace: str = "kube-system"
+    leaseDuration: float = 2.0
+    leaseRenewInterval: float = 0.0
 
 
 @dataclasses.dataclass

@@ -74,6 +74,12 @@ class HttpKubeClient:
         self.server = server.rstrip("/")
         self.token = token
         self.timeout = timeout
+        # extra request headers applied to every unary request: the HA
+        # plane plants its fencing claim here (resilience/ha.py
+        # FENCE_HEADER) so the servers can reject writes from a deposed
+        # holder at processing time. Empty dict = zero per-request cost
+        # beyond one truthiness test.
+        self.extra_headers: dict[str, str] = {}
         # per-thread persistent connections for unary requests (keep-alive):
         # a new TCP (+TLS) handshake per status patch would dominate the
         # egress at high transition rates (SURVEY.md "Hard parts":
@@ -242,6 +248,8 @@ class HttpKubeClient:
             headers["Content-Type"] = content_type
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        if self.extra_headers:
+            headers.update(self.extra_headers)
         for attempt in (0, 1):
             conn = None
             try:
@@ -408,6 +416,60 @@ class HttpKubeClient:
                 "metadata": {"name": name, "namespace": namespace},
                 "target": {"apiVersion": "v1", "kind": "Node", "name": node},
             },
+        )
+
+    # ------------------------------------------- coordination.k8s.io leases
+
+    def _lease_url(self, namespace: str, name: str | None = None) -> str:
+        url = (
+            f"{self.server}/apis/coordination.k8s.io/v1/namespaces/"
+            f"{namespace}/leases"
+        )
+        return url + (f"/{name}" if name else "")
+
+    def _lease_call(self, method, url, body=None,
+                    content_type="application/json"):
+        """One lease op -> ``(status_code, parsed_doc | None)``. Unlike
+        the resource verbs, lease denials (409 Conflict / AlreadyExists)
+        are NORMAL protocol answers the elector switches on every poll —
+        surfacing them as exceptions would make the common path the
+        exceptional one. Transport failures still raise."""
+        try:
+            doc = self._json(method, url, body, content_type)
+        except urllib.error.HTTPError as e:
+            try:
+                doc = json.loads(str(e.reason) or "null")
+            except ValueError:
+                doc = None
+            return e.code, doc
+        if doc is None:
+            return 404, None
+        return (201 if method == "POST" else 200), doc
+
+    def lease_get(self, namespace, name):
+        """GET the Lease -> (code, doc); 404 means it does not exist."""
+        return self._lease_call("GET", self._lease_url(namespace, name))
+
+    def lease_create(self, namespace, name, spec):
+        """POST a fresh Lease (first acquisition; leaseTransitions starts
+        at 0) -> (201, doc) or (409, Status) when it already exists."""
+        return self._lease_call(
+            "POST", self._lease_url(namespace),
+            {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": dict(spec or {}),
+            },
+        )
+
+    def lease_renew(self, namespace, name, spec):
+        """PATCH-renew/acquire -> (200, doc), (409, Status) while someone
+        else holds it unexpired, or (404, None) when absent."""
+        return self._lease_call(
+            "PATCH", self._lease_url(namespace, name),
+            {"spec": dict(spec or {})},
+            "application/merge-patch+json",
         )
 
     def healthz(self) -> bool:

@@ -234,6 +234,14 @@ class FakeKube:
         # by KWOK_TPU_APISERVER_TIMING, counters (fanout pushes, backlog
         # peak) always on — plain ints under the GIL like the rest
         self.timing = ApiserverTiming()
+        # coordination.k8s.io/v1 leases (ISSUE 12): the leadership plane's
+        # minimal dialect. Keyed (ns, name); each record keeps the wall
+        # epochs the expiry arithmetic uses alongside the rendered RFC3339
+        # stamps, so expiry never re-parses a timestamp. Leases live
+        # OUTSIDE the watch/snapshot machinery by design (no events, no
+        # dump entry): leadership is polled, not watched, and a restored
+        # store must not resurrect an old holder.
+        self._leases: dict[tuple[str, str], dict] = {}
 
     # -- helpers ------------------------------------------------------------
 
@@ -835,6 +843,171 @@ class FakeKube:
             self._undo_push(kind, key, prev)
             self._emit(kind, DELETED, obj, key=key)
 
+    # -- coordination.k8s.io/v1 leases (ISSUE 12) ---------------------------
+    #
+    # The minimal Lease dialect both mock apiservers speak byte-for-byte
+    # (parity twins in tests/test_native_apiserver.py): create / GET /
+    # PATCH-renew with holderIdentity + leaseDurationSeconds +
+    # leaseTransitions. The SERVER is the one clock authority: it stamps
+    # acquireTime/renewTime when it processes the write and judges expiry
+    # against its own wall clock, so a standby never has to trust a dead
+    # primary's clock — it simply keeps PATCHing with its own identity and
+    # is answered 409 Conflict until the lease genuinely expired
+    # (client-go leader-election shape over the Lease object, with the
+    # optimistic-concurrency Update replaced by server-arbitrated PATCH).
+
+    def _lease_render(self, ns: str, name: str, lease: dict) -> bytes:
+        return json.dumps({
+            "kind": "Lease",
+            "apiVersion": "coordination.k8s.io/v1",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "creationTimestamp": lease["created"],
+                "uid": lease["uid"],
+                "resourceVersion": str(lease["rv"]),
+            },
+            "spec": {
+                "holderIdentity": lease["holder"],
+                "leaseDurationSeconds": lease["duration"],
+                "acquireTime": lease["acquire_str"],
+                "renewTime": lease["renew_str"],
+                "leaseTransitions": lease["transitions"],
+            },
+        }, separators=(",", ":")).encode()
+
+    @staticmethod
+    def _lease_spec(spec) -> tuple[str, int]:
+        """(holderIdentity, leaseDurationSeconds) from a request spec,
+        tolerantly: hostile bodies must never crash the handler. Parity
+        with the C++ twin on every shape our clients and the twins pin:
+        non-object specs read empty, integers and plain finite floats
+        truncate, leading-integer strings parse like atol ("2.5" -> 2),
+        booleans and infinities read 0. Exponent-form NUMBER tokens
+        (1e3) are a documented tolerance: C++ atol sees the raw token's
+        leading digits where Python sees the parsed value — both
+        bounded, neither crashing."""
+        if not isinstance(spec, dict):
+            return "", 0
+        holder = spec.get("holderIdentity")
+        holder = holder if isinstance(holder, str) else ""
+        raw = spec.get("leaseDurationSeconds")
+        duration = 0
+        if isinstance(raw, bool):
+            duration = 0  # C++ BOOL is neither NUM nor STR
+        elif isinstance(raw, (int, float)):
+            try:
+                duration = int(raw)
+            except (OverflowError, ValueError):  # inf / nan
+                duration = 0
+        elif isinstance(raw, str):
+            m = re.match(r"\s*[-+]?\d+", raw)
+            duration = int(m.group()) if m else 0
+        return holder, duration
+
+    @staticmethod
+    def _lease_expired(lease: dict, now: float) -> bool:
+        """Server-clock expiry: a lease with no holder is vacant (same as
+        expired); otherwise it expires once renewTime + duration has
+        passed. duration <= 0 means instantly reacquirable."""
+        if not lease["holder"]:
+            return True
+        return now >= lease["renew"] + max(0, lease["duration"])
+
+    def lease_create(self, ns: str, name: str, spec: dict) -> tuple[int, bytes]:
+        """POST .../leases — acquire by creation (leaseTransitions starts
+        at 0, like the real object on first acquisition). An existing
+        lease answers 409 AlreadyExists exactly like any other create."""
+        holder, duration = self._lease_spec(spec or {})
+        with self._lock:
+            key = (ns or "", name)
+            if key in self._leases:
+                return 409, json.dumps({
+                    "kind": "Status", "apiVersion": "v1",
+                    "status": "Failure",
+                    "message": f'leases "{name}" already exists',
+                    "reason": "AlreadyExists", "code": 409,
+                }, separators=(",", ":")).encode()
+            now = time.time()
+            stamp = now_rfc3339()
+            self._rv += 1
+            lease = {
+                "holder": holder,
+                "duration": duration,
+                "acquire": now,
+                "renew": now,
+                "transitions": 0,
+                "created": stamp,
+                "uid": f"uid-{self._rv}",
+                "rv": self._rv,
+                "acquire_str": stamp,
+                "renew_str": stamp,
+            }
+            self._leases[key] = lease
+            return 201, self._lease_render(ns, name, lease)
+
+    def lease_get(self, ns: str, name: str) -> tuple[int, bytes]:
+        with self._lock:
+            lease = self._leases.get((ns or "", name))
+            if lease is None:
+                return 404, b'{"kind":"Status","code":404}'
+            return 200, self._lease_render(ns, name, lease)
+
+    def lease_renew(self, ns: str, name: str, spec: dict) -> tuple[int, bytes]:
+        """PATCH .../leases/NAME — renew-or-acquire, arbitrated under the
+        store lock by the server's own clock:
+
+        - same holder: renewTime advances (a renew);
+        - different holder, lease NOT expired: 409 Conflict — both the
+          standby's premature grab and the revived zombie's stale renew
+          land here (conflict-on-stolen-holder);
+        - different holder, lease expired: acquisition — holderIdentity
+          flips, acquireTime/renewTime restamp, leaseTransitions += 1.
+        """
+        holder, duration = self._lease_spec(spec or {})
+        with self._lock:
+            key = (ns or "", name)
+            lease = self._leases.get(key)
+            if lease is None:
+                return 404, b'{"kind":"Status","code":404}'
+            now = time.time()
+            if holder != lease["holder"] and not self._lease_expired(
+                lease, now
+            ):
+                return 409, json.dumps({
+                    "kind": "Status", "apiVersion": "v1",
+                    "status": "Failure",
+                    "message": (
+                        f'lease "{ns}/{name}" is held by '
+                        f'"{lease["holder"]}" and has not expired'
+                    ),
+                    "reason": "Conflict", "code": 409,
+                }, separators=(",", ":")).encode()
+            stamp = now_rfc3339()
+            if holder != lease["holder"]:
+                lease["holder"] = holder
+                lease["acquire"] = now
+                lease["acquire_str"] = stamp
+                lease["transitions"] += 1
+            lease["renew"] = now
+            lease["renew_str"] = stamp
+            if duration > 0:
+                lease["duration"] = duration
+            self._rv += 1
+            lease["rv"] = self._rv
+            return 200, self._lease_render(ns, name, lease)
+
+    def lease_held(self, ns: str, name: str, holder: str) -> bool:
+        """The fencing check (FENCING_HEADER): is this lease currently
+        held by this identity and unexpired, on the server's clock? One
+        dict lookup under the store lock — only writes that CARRY the
+        header ever pay it."""
+        with self._lock:
+            lease = self._leases.get((ns or "", name))
+            if lease is None or lease["holder"] != holder:
+                return False
+            return not self._lease_expired(lease, time.time())
+
 
 
 
@@ -856,6 +1029,24 @@ _EVENTS_PATHS = re.compile(
     r"(?:/namespaces/(?P<ns>[^/]+))?"
     r"/(?P<kind>events)(?:/(?P<name>[^/]+))?(?P<sub>)?$"
 )
+# coordination.k8s.io/v1 Lease: the leadership plane's object (ISSUE 12).
+# Deliberately OUTSIDE _match_path: leases are served by a dedicated
+# minimal dialect (create / GET / PATCH-renew, no list/watch/delete), stay
+# exempt from max-inflight admission and phase timing like every other
+# non-resource path, and never enter snapshots — both servers agree.
+_LEASE_PATHS = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1"
+    r"/namespaces/(?P<ns>[^/]+)/leases(?:/(?P<name>[^/]+))?$"
+)
+
+#: mutating requests may carry this header naming the lease the writer
+#: believes it holds, as ``<namespace>/<name>/<holderIdentity>``; the
+#: server rejects the write 409 when that lease is NOT currently held by
+#: that identity — server-side write fencing, the authoritative half of
+#: the HA plane's zombie protection (a paused-and-revived old primary's
+#: in-flight writes die HERE even when they slipped past the client-side
+#: fence check before the pause). Absent header = zero cost, no check.
+FENCING_HEADER = "X-Kwok-Lease-Holder"
 
 
 def _match_path(path: str):
@@ -963,6 +1154,17 @@ DISCOVERY: dict[str, dict] = {
                     "groupVersion": "events.k8s.io/v1", "version": "v1"
                 },
             },
+            {
+                "name": "coordination.k8s.io",
+                "versions": [
+                    {"groupVersion": "coordination.k8s.io/v1",
+                     "version": "v1"}
+                ],
+                "preferredVersion": {
+                    "groupVersion": "coordination.k8s.io/v1",
+                    "version": "v1",
+                },
+            },
         ],
     },
     "/api/v1": {
@@ -988,6 +1190,16 @@ DISCOVERY: dict[str, dict] = {
         "kind": "APIResourceList",
         "groupVersion": "events.k8s.io/v1",
         "resources": _api_resource("events", "Event", True),
+    },
+    "/apis/coordination.k8s.io/v1": {
+        "kind": "APIResourceList",
+        "groupVersion": "coordination.k8s.io/v1",
+        # the minimal Lease dialect: create / get / patch only (no
+        # list/watch/delete — leadership is polled, never watched)
+        "resources": [
+            {"name": "leases", "singularName": "", "namespaced": True,
+             "kind": "Lease", "verbs": ["create", "get", "patch"]}
+        ],
     },
 }
 
@@ -1624,6 +1836,56 @@ class HttpFakeApiserver:
                     except OSError:
                         self.close_connection = True
 
+            def _fenced_commit(self, fn):
+                """Server-side write fencing (ISSUE 12): a mutating
+                request carrying FENCING_HEADER names the lease its
+                writer believes it holds as ``ns/name/holder``; when
+                that lease is not currently held by that identity the
+                write answers 409 Conflict instead of committing.
+                The claim is evaluated and the commit performed under
+                ONE store-lock hold (the RLock re-enters for the store
+                call), so a takeover PATCH can never interleave between
+                check and write — a revived zombie's in-flight bytes
+                die here no matter when it was paused. Returns
+                ``(fenced, result)``; the 409 is sent by the caller
+                AFTER the lock drops (no socket I/O under the store
+                lock). Requests without the header run ``fn()`` with
+                one header lookup of overhead. Callers have already
+                consumed the body (keep-alive stays parseable)."""
+                hdr = self.headers.get(FENCING_HEADER)
+                if not hdr:
+                    return False, fn()
+                # split exactly like the C++ twin's find-based parse so
+                # malformed claims produce byte-identical 409 bodies:
+                # no first slash -> all fields empty; no second slash ->
+                # name/holder empty (ns keeps its prefix)
+                ns, sep, rest = hdr.partition("/")
+                if not sep:
+                    ns = ""
+                name, sep2, holder = rest.partition("/")
+                if not sep2:
+                    name = holder = ""
+                with store._lock:
+                    if not (
+                        name and holder
+                        and store.lease_held(ns, name, holder)
+                    ):
+                        self._fence_claim = (ns, name, holder)
+                        return True, None
+                    return False, fn()
+
+            def _send_fencing_409(self) -> None:
+                ns, name, holder = self._fence_claim
+                self._send_json({
+                    "kind": "Status", "apiVersion": "v1",
+                    "status": "Failure",
+                    "message": (
+                        f"fencing lease {ns}/{name} is not held by "
+                        f"{holder}"
+                    ),
+                    "reason": "Conflict", "code": 409,
+                }, 409)
+
             def do_GET(self):  # noqa: N802
                 self._admitted(self._do_get)
 
@@ -1676,6 +1938,16 @@ class HttpFakeApiserver:
                 if parsed.path == "/snapshot":
                     # the mock's `etcdctl snapshot save`
                     self._send_json(store.dump())
+                    return
+                lm = _LEASE_PATHS.match(parsed.path)
+                if lm:
+                    if not lm.group("name"):
+                        self.send_error(404)  # no lease LIST in the dialect
+                        return
+                    code, body = store.lease_get(
+                        lm.group("ns"), lm.group("name")
+                    )
+                    self._send_body(body, code)
                     return
                 m = _match_path(parsed.path)
                 if not m or m.group("sub") == "binding":
@@ -1854,6 +2126,28 @@ class HttpFakeApiserver:
                 if not self._authorized():
                     return
                 parsed = urllib.parse.urlparse(self.path)
+                lm = _LEASE_PATHS.match(parsed.path)
+                if lm and lm.group("name"):
+                    # PATCH-renew: the leadership plane's heartbeat
+                    # (renew / conflict-on-stolen-holder / expiry-acquire
+                    # arbitrated server-side under the store lock). A
+                    # valid-JSON non-object body reads as an empty spec,
+                    # exactly like the C++ twin's non-OBJ tolerance.
+                    patch = self._body()
+                    if patch is None:
+                        # no body at all: the C++ twin's JParser("")
+                        # rejection answers 400
+                        self._send_json({"kind": "Status", "code": 400}, 400)
+                        return
+                    spec = (
+                        patch.get("spec") if isinstance(patch, dict)
+                        else None
+                    )
+                    code, body = store.lease_renew(
+                        lm.group("ns"), lm.group("name"), spec
+                    )
+                    self._send_body(body, code)
+                    return
                 m = _match_path(parsed.path)
                 if (
                     not m
@@ -1865,13 +2159,24 @@ class HttpFakeApiserver:
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
                 patch = self._body()
                 if m.group("sub") == "status":
-                    body = self._commit(lambda: store.patch_status_bytes(
-                        kind, ns, name, patch
-                    ))
+                    fenced, body = self._fenced_commit(
+                        lambda: self._commit(
+                            lambda: store.patch_status_bytes(
+                                kind, ns, name, patch
+                            )
+                        )
+                    )
                 else:
-                    body = self._commit(lambda: store.patch_meta_bytes(
-                        kind, ns, name, patch
-                    ))
+                    fenced, body = self._fenced_commit(
+                        lambda: self._commit(
+                            lambda: store.patch_meta_bytes(
+                                kind, ns, name, patch
+                            )
+                        )
+                    )
+                if fenced:
+                    self._send_fencing_409()
+                    return
                 if body is None:
                     self._send_json({"kind": "Status", "code": 404}, 404)
                 else:
@@ -1899,10 +2204,15 @@ class HttpFakeApiserver:
                     # default grace (JParser failure leaves b non-OBJ)
                     body = {}
                 grace = body.get("gracePeriodSeconds")
-                self._commit(lambda: store.delete(
-                    m.group("kind"), m.group("ns"), m.group("name"),
-                    grace_seconds=None if grace is None else int(grace),
-                ))
+                fenced, _r = self._fenced_commit(
+                    lambda: self._commit(lambda: store.delete(
+                        m.group("kind"), m.group("ns"), m.group("name"),
+                        grace_seconds=None if grace is None else int(grace),
+                    ))
+                )
+                if fenced:
+                    self._send_fencing_409()
+                    return
                 self._send_json({"kind": "Status", "status": "Success"})
 
             def do_POST(self):  # noqa: N802 (test convenience: create)
@@ -1924,6 +2234,26 @@ class HttpFakeApiserver:
                     self._body()  # drain
                     self._send_json({"compactedRevision": store.compact()})
                     return
+                lm = _LEASE_PATHS.match(parsed.path)
+                if lm:
+                    if lm.group("name"):
+                        self.send_error(404)  # create is collection-POST
+                        return
+                    obj = self._body()
+                    if not isinstance(obj, dict):
+                        # valid-JSON non-object create: 400, like the
+                        # C++ twin's `obj.type != OBJ` rejection
+                        self._send_json({"kind": "Status", "code": 400}, 400)
+                        return
+                    name = (obj.get("metadata") or {}).get("name")
+                    if not name or not isinstance(name, str):
+                        self._send_json({"kind": "Status", "code": 400}, 400)
+                        return
+                    code, body = store.lease_create(
+                        lm.group("ns"), name, obj.get("spec")
+                    )
+                    self._send_body(body, code)
+                    return
                 m = _match_path(parsed.path)
                 if not m:
                     self.send_error(404)
@@ -1933,9 +2263,14 @@ class HttpFakeApiserver:
                     # the real scheduler's bind: POST v1 Binding
                     node = ((obj or {}).get("target") or {}).get("name") or ""
                     try:
-                        pod = self._commit(lambda: store.bind(
-                            m.group("ns"), m.group("name"), node
-                        ))
+                        fenced, pod = self._fenced_commit(
+                            lambda: self._commit(lambda: store.bind(
+                                m.group("ns"), m.group("name"), node
+                            ))
+                        )
+                        if fenced:
+                            self._send_fencing_409()
+                            return
                     except BindConflict as e:
                         self._send_json(
                             {"kind": "Status", "status": "Failure",
@@ -1958,9 +2293,14 @@ class HttpFakeApiserver:
                 if m.group("ns"):
                     obj.setdefault("metadata", {})["namespace"] = m.group("ns")
                 try:
-                    body = self._commit(
-                        lambda: store.create_bytes(m.group("kind"), obj)
+                    fenced, body = self._fenced_commit(
+                        lambda: self._commit(
+                            lambda: store.create_bytes(m.group("kind"), obj)
+                        )
                     )
+                    if fenced:
+                        self._send_fencing_409()
+                        return
                 except AlreadyExists as e:
                     self._send_json(
                         {"kind": "Status", "apiVersion": "v1",

@@ -71,6 +71,7 @@ from kwok_tpu.ops.tick import (
 from kwok_tpu.ops.updates import UpdateBuffer
 from kwok_tpu.engine.rowpool import RowPool
 from kwok_tpu.resilience import faults as resilience_faults
+from kwok_tpu.resilience import ha as resilience_ha
 from kwok_tpu.resilience.policy import (
     PATCH_RETRY,
     PUMP_RESEND,
@@ -206,6 +207,27 @@ class EngineConfig:
     # env var (lane children). Off means off: no thread, no LISTs, no
     # per-tick cost.
     audit_interval: float = 0.0
+    # Warm-standby high availability (resilience/ha.py): "" = off (the
+    # zero-cost default — no elector thread, no client/pump wrapping, no
+    # fence check on the hot path). "primary" races to the
+    # coordination.k8s.io Lease at startup and serves while renewing it;
+    # "standby" runs observe-only (watches+ingests, arms nothing, emits
+    # nothing), tails the primary's checkpoint stream, and takes over
+    # when the lease expires. Every outward write of an HA engine is
+    # fenced on still-holding-the-lease, locally AND server-side.
+    ha_role: str = ""
+    # holderIdentity + this engine's checkpoint file name under HA
+    # (<dir>/<identity>.ckpt.json — the lease names the holder, so the
+    # standby knows which file to tail). "" = hostname-pid.
+    ha_identity: str = ""
+    lease_name: str = "kwok-tpu-engine"
+    lease_namespace: str = "kube-system"
+    # lease TTL in seconds (whole seconds on the wire); the failure
+    # detection budget — a dead primary is unservable for at most this
+    # long before the standby may acquire
+    lease_duration: float = 2.0
+    # renew cadence; 0 = lease_duration / 3 (client-go's shape)
+    lease_renew_interval: float = 0.0
 
     def validate(self) -> None:
         if not (
@@ -365,6 +387,22 @@ class ClusterEngine:
                 # the unfaulted _now stays a two-op method (zero-cost
                 # contract).
                 self._now = self._skewed_now
+        # Warm-standby HA (resilience/ha.py): None unless ha_role is
+        # configured — the disabled case wraps nothing and costs nothing.
+        # The fence wraps OUTSIDE the fault plane: chaos injects into the
+        # real transport, fencing decides whether the write may try at
+        # all. Lane children are built with ha_role="" and share the
+        # parent's plane (ShardLane.__init__), so there is ONE elector
+        # and ONE fence per engine.
+        self._ha = resilience_ha.from_config(config)
+        if self._ha is not None:
+            client = self._ha.wrap_client(client)
+        # observe-only gate: True while an HA engine is NOT the leader.
+        # The tick loops flush staged ingest writes (mirrors stay
+        # current, buffers stay bounded) but never run the transition
+        # kernel — nothing arms, nothing fires, nothing emits. The HA
+        # plane opens the gate at acquisition/takeover.
+        self._ha_hold = self._ha is not None
         self.client = client
         self.config = config
         self.ippool = IPPool(config.cidr)
@@ -590,6 +628,11 @@ class ClusterEngine:
         self._ckpt = None  # resilience.checkpoint.Checkpointer | None
         self._restore = None  # resilience.checkpoint.RestoreSession | None
         self._ckpt_name = "engine"
+        if self._ha is not None:
+            # under HA the lease's holderIdentity IS the checkpoint file
+            # name: the standby learns which <identity>.ckpt.json to tail
+            # from the lease object itself (resilience/ha.py _tail_peer)
+            self._ckpt_name = self._ha.identity
         self._worker_suffix = ""
         # Anti-entropy auditor (resilience/antientropy.py): config < env
         # (same precedence as faults/checkpoint); a NEGATIVE config value
@@ -753,6 +796,10 @@ class ClusterEngine:
             # the auditor holds no engine data a crash could eat — its
             # next pass re-lists its window anyway; a full stream resync
             # per audit crash would be pure cost
+            return
+        if name.startswith("kwok-ha"):
+            # the elector's state machine lives on the HAPlane object and
+            # survives the restart; it touches no engine rows
             return
         self.resync_streams()
         # one loss class no re-list can reproduce: a cross-lane XUPD
@@ -1186,6 +1233,12 @@ class ClusterEngine:
                 on_exhausted=self._worker_budget_exhausted,
                 on_restart=self._worker_restarted_resync,
             )
+        if self._ha is not None:
+            # bind BEFORE any worker: registers the kwok_ha_* families,
+            # holds the serve gate (/readyz 503, reason ha_standby, until
+            # this engine leads) and plants the server-side fencing claim
+            # on the HTTP client's headers
+            self._ha.bind(self)
         # Startup catch-up gate: /readyz answers 503 (reason
         # startup_resync) until the first full re-list of BOTH kinds has
         # been ingested — a restarted engine must not report ready while
@@ -1257,6 +1310,17 @@ class ClusterEngine:
                 else self._tick_loop
             )
             self._threads.append(spawn_worker(loop, name="kwok-tick"))
+        if run_tick_loop and self._ha is not None:
+            # the elector (resilience/ha.py): renew-or-acquire loop,
+            # supervised so a crashed cycle restarts in place (the fence
+            # deadline lives on the plane object and survives — a crash
+            # window can only be MORE conservative, never less)
+            wd = self._watchdog
+            self._threads.append(
+                wd.spawn(self._ha.run, name="kwok-ha")
+                if wd is not None
+                else spawn_worker(self._ha.run, name="kwok-ha")
+            )
         if run_tick_loop and self._audit_interval > 0:
             # anti-entropy auditor (resilience/antientropy.py): paced
             # apiserver-vs-rows drift detection + per-row repair, off by
@@ -1334,6 +1398,12 @@ class ClusterEngine:
     def stop(self) -> None:
         self._running = False
         self.ready = False
+        # the HA elector is NOT stopped here: a gracefully-stopping
+        # leader must keep renewing while the drain below flushes its
+        # in-flight emits, or the fence lapses mid-drain (lease TTL <<
+        # drain deadline) and the tail writes are silently dropped —
+        # unrecoverable for a solo primary. Stopped after the executor
+        # drains; the lease then expires and a standby takes over.
         if self._watchdog is not None:
             self._watchdog.close()  # shutdown crashes must not restart
         if self._faults is not None:
@@ -1376,12 +1446,22 @@ class ClusterEngine:
             return 1 if t.name.startswith("kwok-emit") else 2
 
         for t in sorted(self._threads, key=_join_rank):
+            if t.name == "kwok-ha":
+                continue  # still renewing; stopped after the drain below
             t.join(timeout=(
                 60 if t.name == "kwok-tick"
                 else 30 if t.name.startswith("kwok-emit") else 5
             ))
         if self._executor:
             self._executor.shutdown(wait=True)
+        if self._ha is not None:
+            # every drain write is out (or settled): release the lease
+            # plane — renewals cease, the fence lapses on its own, and
+            # a paired standby takes over within one lease TTL
+            self._ha.stop()
+            for t in self._threads:
+                if t.name == "kwok-ha":
+                    t.join(timeout=5)
         if self._ckpt is not None:
             # the tick loop queued the final snapshot in its finally (it
             # was joined above); this drains the writer and joins it
@@ -3015,6 +3095,12 @@ class ClusterEngine:
                     if item is None:
                         if not self._running:
                             return
+                        # explicit wake (the HA plane enqueues one when
+                        # it opens the takeover gate on a quiet cluster):
+                        # end this drain window now so the dispatch gate
+                        # re-reads _idle_wake instead of sleeping out the
+                        # old idle deadline
+                        deadline = min(deadline, time.monotonic())
                         continue
                     if not got_event:
                         got_event = True
@@ -3183,6 +3269,29 @@ class ClusterEngine:
         """First half of a tick: flush staged ingest writes and dispatch the
         fused kernel. Returns a _PendingTick whose wire materializes on host
         asynchronously (prefetch), or None when nothing is on device."""
+        if self._ha_hold:
+            # observe-only standby (resilience/ha.py): flush staged
+            # ingest writes so the device mirrors stay current and the
+            # UpdateBuffer stays bounded, but never run the transition
+            # kernel — nothing arms (fire_at stays +inf), nothing fires,
+            # nothing emits. The HA plane flips _ha_hold at takeover and
+            # the next dispatch arms everything fresh; the checkpoint
+            # refine then overwrites matched rows with resumed residues.
+            for k in (self.nodes, self.pods):
+                if k.buffer.pending:
+                    k.state = k.buffer.flush(k.state)
+            tel = self.telemetry
+            tel.set_gauge("nodes_managed", len(self.nodes.pool))
+            tel.set_gauge("pods_managed", len(self.pods.pool))
+            self._idle_wake = None  # no timers can be due while held
+            if not self._ha_hold:
+                # the takeover gate opened while this hold dispatch ran:
+                # the None above would clobber the plane's explicit wake
+                # and a quiet cluster would idle-sleep past the whole
+                # reconcile window — restore the wake (order safe both
+                # ways: the plane flips _ha_hold before writing 0.0)
+                self._idle_wake = 0.0
+            return None
         if self.config.profile_dir:
             self._maybe_profile()
         t0 = time.perf_counter()
@@ -3427,6 +3536,12 @@ class ClusterEngine:
             return None
         token = getattr(self.client, "token", None)
         extra = f"Authorization: Bearer {token}\r\n" if token else ""
+        if self._ha is not None:
+            # every pump request carries the fencing claim: the servers
+            # validate it at processing time under the store lock, so a
+            # revived zombie's in-flight batches die server-side even
+            # when they slipped past FencedPump before the pause
+            extra += self._ha.fence_header_line()
         try:
             pumps = [
                 # kwoklint: disable=blocking-under-lock -- construction is memoized via _pump_tried: lane emit workers (the only under-lock callers) are primed by LaneSet.prepare before any worker starts; all other callers run on the lock-free tick thread or executor
@@ -3440,6 +3555,10 @@ class ClusterEngine:
                 # chaos: the fault plane reproduces pump.cc's failure
                 # contract (drop / short write / delay) on demand
                 pumps = [self._faults.wrap_pump(p) for p in pumps]
+            if self._ha is not None:
+                # fence OUTSIDE the fault plane: a write the fence drops
+                # must never reach the chaos layer, let alone the wire
+                pumps = [self._ha.wrap_pump(p) for p in pumps]
             self._pump = _PumpGroup(pumps)
             self._pump_base = base
         except Exception:

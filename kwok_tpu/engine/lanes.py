@@ -154,6 +154,7 @@ class ShardLane:
             faults="off",  # ONE fault plane, the parent's (shared below)
             checkpoint_dir="off",  # ONE checkpoint, the parent's stacked
             audit_interval=-1.0,  # ONE auditor, the parent's (env-proof)
+            ha_role="",  # ONE lease plane + fence, the parent's (below)
         )
         e = _LaneEngine(parent.client, cfg, telemetry=parent.telemetry)
         e._lane_set = lane_set
@@ -164,6 +165,10 @@ class ShardLane:
         # parent's /readyz — not a private ledger nobody reads
         e._faults = parent._faults
         e._degradation = parent._degradation
+        # the parent's HA plane fences THIS lane's pump group too (the
+        # client is the parent's, already fence-wrapped); lane engines
+        # never dispatch, so their own _ha_hold stays False and inert
+        e._ha = parent._ha
         # shared cross-lane state: one IP pool / allocation lock (striped
         # enough — held only for bookkeeping, never across provider
         # calls), one topology view, one clock
@@ -810,6 +815,14 @@ class LaneSet:
                         deadline = min(
                             deadline, time.monotonic() + interval
                         )
+                    if parent._idle_wake == 0.0:
+                        # the HA plane zeroes the wake when it opens the
+                        # takeover gate on a quiet cluster: honor the
+                        # explicit wake within one poll slice. (Normal
+                        # wakes are future monotonic stamps and keep the
+                        # interval pacing; only the literal 0.0 sentinel
+                        # breaks early.)
+                        break
                     time.sleep(
                         min(remaining, 0.002 if pending else 0.02)
                     )
@@ -991,6 +1004,45 @@ class LaneSet:
         dispatch the fused kernel (the single-lane _tick_dispatch, minus
         drain and emit — those live on the lane workers)."""
         parent = self.parent
+        if parent._ha_hold:
+            # observe-only standby (resilience/ha.py): flush every
+            # lane's staged writes into the stacked state (mirrors stay
+            # current, buffers stay bounded) but never run the kernel —
+            # nothing arms, nothing fires, no emit items are produced.
+            # Same swap-under-stage-lock protocol as the live path.
+            self._ensure_stacked()
+            swapped: list[tuple[int, str, UpdateBuffer]] = []
+            want = self.r
+            for li, lane in enumerate(self.lanes):
+                e = lane.engine
+                with lane.stage_lock:
+                    for kind, k in (("nodes", e.nodes), ("pods", e.pods)):
+                        want = max(want, k.capacity)
+                        if k.buffer.pending:
+                            swapped.append((li, kind, k.buffer))
+                            k.buffer = UpdateBuffer()
+            if want > self.r:
+                self._regrow(want)
+            for li, kind, buf in swapped:
+                self.stacked[kind] = buf.flush(
+                    self.stacked[kind], offset=li * self.r
+                )
+            tel = parent.telemetry
+            tel.set_gauge(
+                "nodes_managed",
+                sum(len(lane.engine.nodes.pool) for lane in self.lanes),
+            )
+            tel.set_gauge(
+                "pods_managed",
+                sum(len(lane.engine.pods.pool) for lane in self.lanes),
+            )
+            parent._idle_wake = None  # no timers can be due while held
+            if not parent._ha_hold:
+                # takeover raced this hold dispatch: restore the plane's
+                # explicit wake the None above would otherwise clobber
+                # (the plane flips _ha_hold before writing 0.0)
+                parent._idle_wake = 0.0
+            return None
         if parent.config.profile_dir:
             parent._maybe_profile()
         t0 = time.perf_counter()

@@ -135,6 +135,33 @@ def build_parser(defaults) -> argparse.ArgumentParser:
                    "per row via re-ingest (docs/resilience.md). "
                    "KWOK_TPU_AUDIT_INTERVAL works too; 0 = off "
                    "(no thread, no LISTs)")
+    p.add_argument("--ha-role", default=o.haRole,
+                   choices=["", "off", "primary", "standby"],
+                   help="warm-standby HA (docs/resilience.md): 'primary' "
+                   "serves while renewing the coordination.k8s.io Lease "
+                   "and fences every outward write on still holding it; "
+                   "'standby' runs observe-only (ingests warm, arms "
+                   "nothing, emits nothing), tails the holder's "
+                   "checkpoint stream, and takes over on lease expiry. "
+                   "Empty = HA off (no elector thread, no fence). "
+                   "KWOK_HA_ROLE works too")
+    p.add_argument("--ha-identity", default=o.haIdentity,
+                   help="lease holderIdentity AND this engine's "
+                   "checkpoint file name (<dir>/<identity>.ckpt.json) "
+                   "under HA; default hostname-pid")
+    p.add_argument("--lease-name", default=o.leaseName,
+                   help="coordination.k8s.io Lease object name the HA "
+                   "pair elects through")
+    p.add_argument("--lease-namespace", default=o.leaseNamespace)
+    p.add_argument("--lease-duration", type=float,
+                   default=o.leaseDuration,
+                   help="lease TTL seconds (whole seconds on the wire): "
+                   "the failure-detection budget — a dead primary is "
+                   "unservable at most this long before the standby "
+                   "may acquire")
+    p.add_argument("--lease-renew-interval", type=float,
+                   default=o.leaseRenewInterval,
+                   help="leader renew cadence; 0 = lease-duration/3")
     p.add_argument("--drain-deadline", type=float,
                    default=o.drainDeadline,
                    help="SIGTERM graceful-drain bound: flush in-flight "
@@ -183,6 +210,12 @@ def _engine_config(args, stages: list[Stage]):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_interval=args.checkpoint_interval,
         audit_interval=args.audit_interval,
+        ha_role="" if args.ha_role == "off" else args.ha_role,
+        ha_identity=args.ha_identity,
+        lease_name=args.lease_name,
+        lease_namespace=args.lease_namespace,
+        lease_duration=args.lease_duration,
+        lease_renew_interval=args.lease_renew_interval,
         node_rules=stages_to_rules(stages, ResourceKind.NODE),
         pod_rules=stages_to_rules(stages, ResourceKind.POD),
     )
@@ -293,6 +326,14 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
             # a typo'd path must not silently fall back to default rules
             # (the member would quietly run a homogeneous federation)
             raise SystemExit(f"--member-config {mc}: no such file")
+    if len(masters) > 1 and args.ha_role not in ("", "off"):
+        # a federation already tolerates member failures via the shared
+        # watchdog (PR 7); the lease-fenced pair is a single-cluster
+        # topology — refusing beats silently running an unfenced leader
+        raise SystemExit(
+            "--ha-role is a single-cluster flag; federation "
+            "(multi-master --master) has its own member failover"
+        )
     if len(masters) > 1:
         from kwok_tpu.engine import FederatedEngine
 

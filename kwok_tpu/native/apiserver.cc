@@ -1148,9 +1148,72 @@ struct Undo {
   EntryPtr prev;
 };
 
+// coordination.k8s.io/v1 Lease record (ISSUE 12): the leadership plane's
+// minimal dialect, mirrored byte-for-byte with mockserver.py's lease_*
+// methods (parity twins in tests/test_native_apiserver.py). Wall epochs
+// are kept alongside the rendered RFC3339 stamps so expiry arithmetic
+// never re-parses a timestamp; the SERVER clock is the one authority.
+// Leases live outside the watch/snapshot machinery by design: leadership
+// is polled, never watched, and a restored store must not resurrect an
+// old holder.
+struct LeaseRec {
+  std::string holder;
+  long duration = 0;          // leaseDurationSeconds
+  double acquire = 0, renew = 0;  // wall epochs (server clock)
+  long transitions = 0;       // leaseTransitions
+  std::string created, uid;
+  int64_t rv = 0;
+  std::string acquire_str, renew_str;
+};
+
+static std::string lease_render(const std::string& ns,
+                                const std::string& name,
+                                const LeaseRec& L) {
+  std::string out =
+      "{\"kind\":\"Lease\",\"apiVersion\":\"coordination.k8s.io/v1\","
+      "\"metadata\":{\"name\":\"";
+  json_escape(out, name);
+  out += "\",\"namespace\":\"";
+  json_escape(out, ns);
+  out += "\",\"creationTimestamp\":\"" + L.created + "\",\"uid\":\"" +
+         L.uid + "\",\"resourceVersion\":\"" + std::to_string(L.rv) +
+         "\"},\"spec\":{\"holderIdentity\":\"";
+  json_escape(out, L.holder);
+  out += "\",\"leaseDurationSeconds\":" + std::to_string(L.duration) +
+         ",\"acquireTime\":\"" + L.acquire_str + "\",\"renewTime\":\"" +
+         L.renew_str + "\",\"leaseTransitions\":" +
+         std::to_string(L.transitions) + "}}";
+  return out;
+}
+
+// (holderIdentity, leaseDurationSeconds) from a request body's spec,
+// tolerantly — a garbled duration reads as 0 (Python int() parity on the
+// shapes our clients send).
+static void lease_spec_fields(const JVal& body, std::string& holder,
+                              long& duration) {
+  holder.clear();
+  duration = 0;
+  const JVal* spec = body.is_obj() ? body.find("spec") : nullptr;
+  if (!spec || !spec->is_obj()) return;
+  const JVal* h = spec->find("holderIdentity");
+  if (h && h->type == JVal::STR) holder = h->s;
+  const JVal* d = spec->find("leaseDurationSeconds");
+  if (d && (d->type == JVal::NUM || d->type == JVal::STR))
+    duration = atol(d->s.c_str());
+}
+
+// server-clock expiry: vacant (no holder) counts as expired; otherwise a
+// lease expires once renewTime + duration has passed (duration <= 0 =
+// instantly reacquirable). Mirrors mockserver.FakeKube._lease_expired.
+static bool lease_expired(const LeaseRec& L, double now) {
+  if (L.holder.empty()) return true;
+  return now >= L.renew + (double)(L.duration > 0 ? L.duration : 0);
+}
+
 struct Store {
   std::mutex mu;
   std::map<Key, EntryPtr> kinds[NKINDS];
+  std::map<Key, LeaseRec> leases;  // coordination.k8s.io/v1 (ISSUE 12)
   int64_t rv = 0;
   std::vector<std::shared_ptr<Watch>> watches;
   // everything at or below compacted_rv is gone from history: resumes
@@ -1283,6 +1346,10 @@ struct Request {
   std::string query;    // raw query string
   std::string body;
   std::string auth;     // Authorization header (bearer-token authn)
+  // X-Kwok-Lease-Holder: the fencing claim ("ns/name/holder") a mutating
+  // request rides under; empty = unfenced (zero cost). Mirrors
+  // mockserver.py FENCING_HEADER.
+  std::string lease_holder;
   bool close = false;   // Connection: close
   // body handling is split from header parsing so max-inflight admission
   // can hold a band slot ACROSS the body read (a request is in flight
@@ -1370,6 +1437,7 @@ static bool read_request(ConnIO& io, Request& req) {
   size_t content_len = 0;
   req.close = false;
   req.auth.clear();
+  req.lease_holder.clear();
   size_t pos = line_end + 2;
   while (pos < head.size()) {
     size_t e = head.find("\r\n", pos);
@@ -1383,6 +1451,7 @@ static bool read_request(ConnIO& io, Request& req) {
     std::string v = strip(h.substr(colon + 1));
     if (k == "content-length") content_len = (size_t)atoll(v.c_str());
     else if (k == "authorization") req.auth = v;
+    else if (k == "x-kwok-lease-holder") req.lease_holder = v;
     else if (k == "connection") {
       std::transform(v.begin(), v.end(), v.begin(), ::tolower);
       if (v == "close") req.close = true;
@@ -1569,13 +1638,16 @@ static const std::pair<const char*, const char*> DISCOVERY_DOCS[] = {
     {"/api",
      R"DISC({"kind":"APIVersions","versions":["v1"]})DISC"},
     {"/apis",
-     R"DISC({"kind":"APIGroupList","apiVersion":"v1","groups":[{"name":"rbac.authorization.k8s.io","versions":[{"groupVersion":"rbac.authorization.k8s.io/v1","version":"v1"}],"preferredVersion":{"groupVersion":"rbac.authorization.k8s.io/v1","version":"v1"}},{"name":"events.k8s.io","versions":[{"groupVersion":"events.k8s.io/v1","version":"v1"}],"preferredVersion":{"groupVersion":"events.k8s.io/v1","version":"v1"}}]})DISC"},
+     R"DISC({"kind":"APIGroupList","apiVersion":"v1","groups":[{"name":"rbac.authorization.k8s.io","versions":[{"groupVersion":"rbac.authorization.k8s.io/v1","version":"v1"}],"preferredVersion":{"groupVersion":"rbac.authorization.k8s.io/v1","version":"v1"}},{"name":"events.k8s.io","versions":[{"groupVersion":"events.k8s.io/v1","version":"v1"}],"preferredVersion":{"groupVersion":"events.k8s.io/v1","version":"v1"}},{"name":"coordination.k8s.io","versions":[{"groupVersion":"coordination.k8s.io/v1","version":"v1"}],"preferredVersion":{"groupVersion":"coordination.k8s.io/v1","version":"v1"}}]})DISC"},
     {"/api/v1",
      R"DISC({"kind":"APIResourceList","groupVersion":"v1","resources":[{"name":"nodes","singularName":"","namespaced":false,"kind":"Node","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"nodes/status","singularName":"","namespaced":false,"kind":"Node","verbs":["get","patch","update"]},{"name":"pods","singularName":"","namespaced":true,"kind":"Pod","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"pods/status","singularName":"","namespaced":true,"kind":"Pod","verbs":["get","patch","update"]},{"name":"pods/binding","singularName":"","namespaced":true,"kind":"Pod","verbs":["create"]},{"name":"events","singularName":"","namespaced":true,"kind":"Event","verbs":["create","delete","get","list","patch","update","watch"]}]})DISC"},
     {"/apis/rbac.authorization.k8s.io/v1",
      R"DISC({"kind":"APIResourceList","groupVersion":"rbac.authorization.k8s.io/v1","resources":[{"name":"roles","singularName":"","namespaced":true,"kind":"Role","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"rolebindings","singularName":"","namespaced":true,"kind":"RoleBinding","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"clusterroles","singularName":"","namespaced":false,"kind":"ClusterRole","verbs":["create","delete","get","list","patch","update","watch"]},{"name":"clusterrolebindings","singularName":"","namespaced":false,"kind":"ClusterRoleBinding","verbs":["create","delete","get","list","patch","update","watch"]}]})DISC"},
     {"/apis/events.k8s.io/v1",
      R"DISC({"kind":"APIResourceList","groupVersion":"events.k8s.io/v1","resources":[{"name":"events","singularName":"","namespaced":true,"kind":"Event","verbs":["create","delete","get","list","patch","update","watch"]}]})DISC"},
+    // the minimal Lease dialect: create / get / patch only (ISSUE 12)
+    {"/apis/coordination.k8s.io/v1",
+     R"DISC({"kind":"APIResourceList","groupVersion":"coordination.k8s.io/v1","resources":[{"name":"leases","singularName":"","namespaced":true,"kind":"Lease","verbs":["create","get","patch"]}]})DISC"},
 };
 
 // ------------------------------------------------------------------ app
@@ -2102,6 +2174,148 @@ bool App::handle_request(ConnIO& io, Request& req) {
                    "{\"compactedRevision\":" + std::to_string(crv) + "}");
   }
 
+  // ---- coordination.k8s.io/v1 leases (ISSUE 12): the leadership plane's
+  // minimal dialect — create / GET / PATCH-renew, arbitrated under the
+  // store lock by the SERVER's clock. Deliberately outside match_path:
+  // exempt from admission/timing like every non-resource path, mirrored
+  // byte-for-byte with mockserver.py (parity twins pin it).
+  {
+    static const std::string lease_prefix =
+        "/apis/coordination.k8s.io/v1/namespaces/";
+    if (req.path.rfind(lease_prefix, 0) == 0) {
+      std::string rest = req.path.substr(lease_prefix.size());
+      size_t s1 = rest.find('/');
+      std::string lns =
+          s1 == std::string::npos ? "" : url_decode(rest.substr(0, s1));
+      std::string tail = s1 == std::string::npos ? "" : rest.substr(s1 + 1);
+      std::string lname;
+      bool routed = false;
+      if (tail == "leases") routed = true;
+      else if (tail.rfind("leases/", 0) == 0) {
+        lname = url_decode(tail.substr(7));
+        routed = !lname.empty() && lname.find('/') == std::string::npos;
+      }
+      if (!lns.empty() && routed) {
+        Key lkey{lns, lname};
+        if (req.method == "GET" && !lname.empty()) {
+          int code = 404;
+          std::string body = "{\"kind\":\"Status\",\"code\":404}";
+          {
+            std::lock_guard<std::mutex> lk(store.mu);
+            auto it = store.leases.find(lkey);
+            if (it != store.leases.end()) {
+              code = 200;
+              body = lease_render(lns, lname, it->second);
+            }
+          }
+          return respond(code, body);
+        }
+        if (req.method == "POST" && lname.empty()) {
+          JParser p(req.body);
+          JVal obj = p.parse();
+          if (!p.ok || obj.type != JVal::OBJ)
+            return respond(400, "{\"kind\":\"Status\",\"code\":400}");
+          const JVal* meta = obj.find("metadata");
+          const JVal* nm = meta && meta->is_obj() ? meta->find("name")
+                                                  : nullptr;
+          std::string name =
+              nm && nm->type == JVal::STR ? nm->s : std::string();
+          if (name.empty())
+            return respond(400, "{\"kind\":\"Status\",\"code\":400}");
+          std::string holder;
+          long duration = 0;
+          lease_spec_fields(obj, holder, duration);
+          int code;
+          std::string body;
+          {
+            std::lock_guard<std::mutex> lk(store.mu);
+            if (store.leases.count(Key{lns, name})) {
+              code = 409;
+              body =
+                  "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+                  "\"Failure\",\"message\":\"leases \\\"";
+              json_escape(body, name);
+              body +=
+                  "\\\" already exists\",\"reason\":\"AlreadyExists\","
+                  "\"code\":409}";
+            } else {
+              double now = wall_unix_s();
+              std::string stamp = now_rfc3339();
+              store.rv++;
+              LeaseRec L;
+              L.holder = holder;
+              L.duration = duration;
+              L.acquire = L.renew = now;
+              L.transitions = 0;
+              L.created = L.acquire_str = L.renew_str = stamp;
+              L.uid = "uid-" + std::to_string(store.rv);
+              L.rv = store.rv;
+              store.leases[Key{lns, name}] = L;
+              code = 201;
+              body = lease_render(lns, name, L);
+            }
+          }
+          return respond(code, body);
+        }
+        if (req.method == "PATCH" && !lname.empty()) {
+          JParser p(req.body);
+          JVal patch = p.parse();
+          if (!p.ok)
+            return respond(400, "{\"kind\":\"Status\",\"code\":400}");
+          std::string holder;
+          long duration = 0;
+          lease_spec_fields(patch, holder, duration);
+          int code = 200;
+          std::string body;
+          {
+            std::lock_guard<std::mutex> lk(store.mu);
+            auto it = store.leases.find(lkey);
+            if (it == store.leases.end()) {
+              code = 404;
+              body = "{\"kind\":\"Status\",\"code\":404}";
+            } else {
+              LeaseRec& L = it->second;
+              double now = wall_unix_s();
+              if (holder != L.holder && !lease_expired(L, now)) {
+                // conflict-on-stolen-holder: both the standby's
+                // premature grab and a revived zombie's stale renew
+                code = 409;
+                body =
+                    "{\"kind\":\"Status\",\"apiVersion\":\"v1\","
+                    "\"status\":\"Failure\",\"message\":\"lease \\\"";
+                json_escape(body, lns);
+                body += "/";
+                json_escape(body, lname);
+                body += "\\\" is held by \\\"";
+                json_escape(body, L.holder);
+                body +=
+                    "\\\" and has not expired\",\"reason\":\"Conflict\","
+                    "\"code\":409}";
+              } else {
+                std::string stamp = now_rfc3339();
+                if (holder != L.holder) {
+                  // expiry-acquire: leadership changes hands
+                  L.holder = holder;
+                  L.acquire = now;
+                  L.acquire_str = stamp;
+                  L.transitions++;
+                }
+                L.renew = now;
+                L.renew_str = stamp;
+                if (duration > 0) L.duration = duration;
+                store.rv++;
+                L.rv = store.rv;
+                body = lease_render(lns, lname, L);
+              }
+            }
+          }
+          return respond(code, body);
+        }
+      }
+      return respond(404, "{\"kind\":\"Status\",\"code\":404}");
+    }
+  }
+
   PathMatch m = match_path(req.path);
   if (m.binding && req.method != "POST")
     return respond(404, "{\"kind\":\"Status\",\"code\":404}");
@@ -2109,6 +2323,50 @@ bool App::handle_request(ConnIO& io, Request& req) {
     return respond(404, "{\"kind\":\"Status\",\"code\":404}");
   if (!m.ok || (req.method != "GET" && m.name.empty() && req.method != "POST"))
     return respond(404, "{\"kind\":\"Status\",\"code\":404}");
+
+  // ---- server-side write fencing (ISSUE 12): a mutating request
+  // carrying X-Kwok-Lease-Holder ("ns/name/holder") commits only while
+  // that lease is currently held by that identity. The claim is parsed
+  // here; fence_ok_locked() is evaluated as the FIRST statement inside
+  // each mutation site's store-lock critical section — the same lock a
+  // takeover PATCH serializes through, so check and commit are one
+  // atomic step and a paused-and-revived zombie primary's in-flight
+  // bytes die HERE no matter how the takeover interleaves. Requests
+  // without the header pay one empty-string test (mirrors
+  // mockserver._fenced_commit); the 409 is sent after the lock drops.
+  bool fence_claimed =
+      !req.lease_holder.empty() &&
+      (req.method == "POST" || req.method == "PATCH" ||
+       req.method == "DELETE");
+  std::string fns, fname, fholder;
+  if (fence_claimed) {
+    const std::string& hdr = req.lease_holder;
+    size_t f1 = hdr.find('/');
+    size_t f2 = f1 == std::string::npos ? std::string::npos
+                                        : hdr.find('/', f1 + 1);
+    fns = f1 == std::string::npos ? "" : hdr.substr(0, f1);
+    fname = f2 == std::string::npos ? "" : hdr.substr(f1 + 1, f2 - f1 - 1);
+    fholder = f2 == std::string::npos ? "" : hdr.substr(f2 + 1);
+  }
+  auto fence_ok_locked = [&]() {  // caller holds store.mu
+    if (!fence_claimed) return true;
+    if (fname.empty() || fholder.empty()) return false;
+    auto it = store.leases.find(Key{fns, fname});
+    return it != store.leases.end() && it->second.holder == fholder &&
+           !lease_expired(it->second, wall_unix_s());
+  };
+  auto fencing_409 = [&]() {
+    std::string body =
+        "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+        "\"Failure\",\"message\":\"fencing lease ";
+    json_escape(body, fns);
+    body += "/";
+    json_escape(body, fname);
+    body += " is not held by ";
+    json_escape(body, fholder);
+    body += "\",\"reason\":\"Conflict\",\"code\":409}";
+    return respond(409, body);
+  };
 
   Key key{m.ns, m.name};
 
@@ -2587,30 +2845,36 @@ bool App::handle_request(ConnIO& io, Request& req) {
     std::string node = tname && tname->type == JVal::STR ? tname->s : "";
     std::string conflict;
     bool found = false;
+    bool fenced = false;
     {
       std::lock_guard<std::mutex> lk(store.mu);
-      auto it = store.kinds[1].find(key);
-      if (it != store.kinds[1].end()) {
-        found = true;
-        JVal obj = it->second->obj;  // copy-on-write
-        JVal& spec = obj.get_or_insert_obj("spec");
-        const JVal* cur = spec.find("nodeName");
-        if (cur && cur->type == JVal::STR && !cur->s.empty()) {
-          // real apiserver BindingREST: any bind after spec.nodeName is set
-          // conflicts, even to the same node
-          conflict = cur->s;
-        } else {
-          spec.set("nodeName", JVal::str(node));
-          store.bump(obj);
-          EntryPtr e = publish(std::move(obj));
-          EntryPtr prev = it->second;
-          it->second = e;
-          store.emit(1, "MODIFIED", e, key, std::move(prev),
-                     pt.on ? &pt.us[PH_FANOUT] : nullptr);
+      if (!fence_ok_locked()) {
+        fenced = true;  // check+commit atomic: respond after the lock
+      } else {
+        auto it = store.kinds[1].find(key);
+        if (it != store.kinds[1].end()) {
+          found = true;
+          JVal obj = it->second->obj;  // copy-on-write
+          JVal& spec = obj.get_or_insert_obj("spec");
+          const JVal* cur = spec.find("nodeName");
+          if (cur && cur->type == JVal::STR && !cur->s.empty()) {
+            // real apiserver BindingREST: any bind after spec.nodeName
+            // is set conflicts, even to the same node
+            conflict = cur->s;
+          } else {
+            spec.set("nodeName", JVal::str(node));
+            store.bump(obj);
+            EntryPtr e = publish(std::move(obj));
+            EntryPtr prev = it->second;
+            it->second = e;
+            store.emit(1, "MODIFIED", e, key, std::move(prev),
+                       pt.on ? &pt.us[PH_FANOUT] : nullptr);
+          }
         }
       }
     }
     pt.mark(PH_COMMIT);
+    if (fenced) return fencing_409();
     if (!found) return respond(404, "{\"kind\":\"Status\",\"code\":404}");
     if (!conflict.empty()) {
       std::string body =
@@ -2639,9 +2903,13 @@ bool App::handle_request(ConnIO& io, Request& req) {
     if (!m.ns.empty()) meta.set("namespace", JVal::str(m.ns));
     EntryPtr e;
     std::string exists_name;
+    bool fenced = false;
     {
       std::lock_guard<std::mutex> lk(store.mu);
-      if (!meta.find("name")) {
+      // check+commit atomic: fenced requests skip the whole mutation
+      // and answer after the lock drops
+      fenced = !fence_ok_locked();
+      if (!fenced && !meta.find("name")) {
         // apiserver names.go semantics: generateName + 5-char random
         // suffix (kube-scheduler POSTs events this way). Resolved inside
         // the create's critical section — the name stays unique through
@@ -2667,8 +2935,8 @@ bool App::handle_request(ConnIO& io, Request& req) {
           }
         }
       }
-      Key k = Store::obj_key(obj);
-      if (k.second.empty()) {
+      Key k = fenced ? Key{"", ""} : Store::obj_key(obj);
+      if (fenced || k.second.empty()) {
         e = nullptr;
       } else if (store.kinds[m.kind].count(k)) {
         // the real apiserver never overwrites on create (HTTP 409;
@@ -2721,6 +2989,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
       }
     }
     pt.mark(PH_COMMIT);
+    if (fenced) return fencing_409();
     if (!exists_name.empty()) {
       std::string body =
           "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
@@ -2745,10 +3014,14 @@ bool App::handle_request(ConnIO& io, Request& req) {
     if (!p.ok) return respond(400, "{\"kind\":\"Status\",\"code\":400}");
     std::string body;
     int code = 200;
+    bool fenced = false;
     {
       std::lock_guard<std::mutex> lk(store.mu);
-      auto it = store.kinds[m.kind].find(key);
-      if (it == store.kinds[m.kind].end()) {
+      auto it = store.kinds[m.kind].end();
+      if (!fence_ok_locked()) {
+        fenced = true;  // check+commit atomic: respond after the lock
+      } else if ((it = store.kinds[m.kind].find(key)) ==
+                 store.kinds[m.kind].end()) {
         code = 404;
         body = "{\"kind\":\"Status\",\"code\":404}";
       } else {
@@ -2789,6 +3062,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
       }
     }
     pt.mark(PH_COMMIT);
+    if (fenced) return fencing_409();
     return respond(code, body);
   }
 
@@ -2806,10 +3080,14 @@ bool App::handle_request(ConnIO& io, Request& req) {
         grace_given = true;
       }
     }
+    bool fenced = false;
     {
       std::lock_guard<std::mutex> lk(store.mu);
-      auto it = store.kinds[m.kind].find(key);
-      if (it != store.kinds[m.kind].end()) {
+      auto it = store.kinds[m.kind].end();
+      if (!fence_ok_locked()) {
+        fenced = true;  // check+commit atomic: respond after the lock
+      } else if ((it = store.kinds[m.kind].find(key)) !=
+                 store.kinds[m.kind].end()) {
         JVal obj = it->second->obj;  // copy-on-write
         if (!grace_given && m.kind == 1) {
           // DeleteOptions omitted: server default for pods is
@@ -2850,6 +3128,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
       }
     }
     pt.mark(PH_COMMIT);
+    if (fenced) return fencing_409();
     return respond(200, "{\"kind\":\"Status\",\"status\":\"Success\"}");
   }
 

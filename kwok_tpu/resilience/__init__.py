@@ -24,6 +24,12 @@ guide):
   against engine rows by ``(uid, rv, phase)``, classifying silent
   divergence and repairing per row via re-ingest
   (``--audit-interval``).
+- ``ha`` (ISSUE 12): warm-standby high availability — a lease-based
+  leadership plane (``--ha-role``) whose elector renews/acquires the
+  apiservers' coordination.k8s.io Lease, fences every outward write on
+  still-holding-it (locally and server-side), runs the standby
+  observe-only over warm state, and turns the PR 7 checkpoint stream
+  into zero-double-fire takeover.
 """
 
 from kwok_tpu.resilience.antientropy import AntiEntropyAuditor
@@ -38,6 +44,7 @@ from kwok_tpu.resilience.faults import (
     WorkerKilled,
     from_config,
 )
+from kwok_tpu.resilience.ha import HAPlane
 from kwok_tpu.resilience.policy import (
     PATCH_RETRY,
     PUMP_RESEND,
@@ -56,6 +63,7 @@ __all__ = [
     "FaultInjected",
     "FaultPlane",
     "FaultSpec",
+    "HAPlane",
     "PATCH_RETRY",
     "PUMP_RESEND",
     "RestoreSession",

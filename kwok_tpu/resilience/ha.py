@@ -1,0 +1,597 @@
+"""Warm-standby high availability: lease-fenced failover (ISSUE 12).
+
+PR 7 made one engine crash-*durable* — a SIGKILL'd process cold-restarts
+from its checkpoint with zero double-fires. This module turns that into
+*availability*: an active/warm-standby engine pair coordinated through a
+minimal ``coordination.k8s.io/v1`` Lease both mock apiservers serve
+(create / GET / PATCH-renew; the server's clock arbitrates expiry), the
+client-go leader-election shape with the optimistic-concurrency Update
+replaced by a server-arbitrated PATCH:
+
+- the **primary** renews the lease every ``renew_interval`` and holds a
+  local *fence*: a monotonic deadline stamped BEFORE each renew was sent,
+  plus the lease duration. The server stamps ``renewTime`` when it
+  processes the PATCH — always at-or-after the send stamp — so the fence
+  always lapses at-or-before the earliest instant the server could hand
+  the lease to someone else. Every outward write is gated on the fence:
+  the patch executor through :class:`FencedClient`, the native pump
+  through :class:`FencedPump` (and, authoritatively, server-side: both
+  writers ride the :data:`FENCE_HEADER` fencing claim, which the
+  apiservers validate under the same store lock a takeover PATCH
+  serializes through — a paused-and-revived zombie's in-flight bytes die
+  there even when they slipped past the local check before the pause).
+- the **standby** runs the engine in observe-only mode — watches both
+  kinds, ingests, flushes device mirrors, but the transition kernel never
+  runs: nothing arms, nothing fires, nothing emits (``engine._ha_hold``).
+  It tails the primary's ``<identity>.ckpt.json`` checkpoint stream
+  (atomic-rename files are safe to read concurrently) and keeps PATCHing
+  the lease with its own identity: 409 Conflict while the primary lives,
+  acquisition the moment the lease expires. Takeover = arm a PR 7
+  :class:`~kwok_tpu.resilience.checkpoint.RestoreSession` from the dead
+  primary's freshest checkpoint, open the gate, flip /readyz — the
+  re-list is already done, so failover beats a cold restart.
+- a **deposed leader** (renew answered 409: the lease was stolen while it
+  was paused/partitioned) closes its fence permanently, re-enters hold
+  mode and parks degraded (``kwok_degraded{reason="ha_lost_lease"}``);
+  rejoining the pair takes a process restart, never a split brain.
+
+Zero cost when disabled: ``from_config`` returns None for an empty role —
+no elector thread, no client/pump wrapping, no fence check anywhere on
+the hot path (the single ``_ha_hold`` attribute test per tick dispatch is
+the same class of cost as the checkpoint service gate).
+
+Lock: ``_ha_lock`` guards the role state machine and the tailed peer
+checkpoint; it is a leaf (kwoklint level 84, docs/static-analysis.md) —
+nothing is ever acquired under it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger("kwok_tpu.resilience")
+
+#: mutating requests carry this header naming the lease the writer
+#: believes it holds ("<namespace>/<name>/<holderIdentity>"); both mock
+#: apiservers reject the write 409 when that lease is not currently held
+#: by that identity (mockserver.FENCING_HEADER / apiserver.cc mirror).
+FENCE_HEADER = "X-Kwok-Lease-Holder"
+
+_ROLES = ("leader", "standby", "lost")
+
+_HELP_ROLE = (
+    "Current HA role of this engine (1 on exactly one of "
+    "role=leader|standby|lost; absent families mean HA is disabled)"
+)
+_HELP_TRANSITIONS = (
+    "Lease acquisitions performed by THIS engine (its standby->leader "
+    "edges; the lease object's own leaseTransitions counts cluster-wide "
+    "handovers)"
+)
+_HELP_TAKEOVER = (
+    "Seconds from the last moment the previous holder was observed "
+    "alive (the final 409-denied acquire attempt) to this engine "
+    "serving after takeover (gate open, /readyz 200); 0 for an "
+    "uncontested first acquisition"
+)
+_HELP_FENCED = (
+    "Outward writes dropped by the lease fence (patch-executor jobs and "
+    "native pump requests attempted while not holding the lease: the "
+    "observe-only standby's repair renders, a deposed or expired "
+    "leader's in-flight emits)"
+)
+
+
+def default_identity() -> str:
+    """client-go's id shape: hostname + a per-process discriminator."""
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
+class _Fence:
+    """The local fencing token: a monotonic deadline below which this
+    process may still consider itself the lease holder. Reads and writes
+    are single float-attribute operations (GIL-atomic) — the fence check
+    on the emit path is one clock read and one compare."""
+
+    def __init__(self) -> None:
+        self._deadline = 0.0
+
+    def open_until(self, deadline: float) -> None:
+        self._deadline = deadline
+
+    def close(self) -> None:
+        self._deadline = 0.0
+
+    def holding(self) -> bool:
+        return time.monotonic() < self._deadline
+
+
+class FencedClient:
+    """KubeClient wrapper gating the OUTWARD WRITE verbs on the fence.
+
+    A fenced write is dropped (counted, warn-once) and reports the same
+    shape a deleted-object no-op would: ``None`` from the patch verbs,
+    silent return from delete — the executor's ``_safe`` treats both as
+    settled, so a fenced engine never burns retries on writes that must
+    not land. Reads (list/watch/get) and the lease verbs themselves pass
+    through untouched."""
+
+    def __init__(self, plane: "HAPlane", inner):
+        self.plane = plane
+        self.inner = inner
+
+    def patch_status(self, kind, namespace, name, patch):
+        if self.plane.fence.holding():
+            return self.inner.patch_status(kind, namespace, name, patch)
+        self.plane.note_fenced()
+        return None
+
+    def patch_meta(self, kind, namespace, name, patch):
+        if self.plane.fence.holding():
+            return self.inner.patch_meta(kind, namespace, name, patch)
+        self.plane.note_fenced()
+        return None
+
+    def delete(self, kind, namespace, name, **kw):
+        if self.plane.fence.holding():
+            return self.inner.delete(kind, namespace, name, **kw)
+        self.plane.note_fenced()
+        return None
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class FencedPump:
+    """Native pump wrapper: a batch sent while not holding the lease is
+    answered with all-404 statuses — the engine's ack loop treats 404 as
+    "object deleted server-side, no-op" (no per-object fallback, no
+    resend, no pump degradation), which is exactly a dropped write."""
+
+    def __init__(self, plane: "HAPlane", inner):
+        self.plane = plane
+        self.inner = inner
+
+    def send(self, requests):
+        if self.plane.fence.holding():
+            return self.inner.send(requests)
+        n = len(requests)
+        self.plane.note_fenced(n)
+        return np.full(n, 404, dtype=np.int32)
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class HAPlane:
+    """The leadership plane of one engine: elector thread + fence +
+    peer-checkpoint tail. Built by ``ClusterEngine.__init__`` (via
+    :func:`from_config`), bound to the engine in ``start()``, run as the
+    watchdog-supervised ``kwok-ha`` worker."""
+
+    def __init__(
+        self,
+        role: str,
+        identity: str = "",
+        lease_name: str = "kwok-tpu-engine",
+        lease_namespace: str = "kube-system",
+        duration: float = 2.0,
+        renew_interval: float = 0.0,
+    ) -> None:
+        if role not in ("primary", "standby"):
+            raise ValueError(f"ha_role must be primary|standby, got {role!r}")
+        self.role = role
+        self.identity = identity or default_identity()
+        self.lease_name = lease_name
+        self.lease_namespace = lease_namespace
+        # the wire carries whole seconds (k8s leaseDurationSeconds), and
+        # the LOCAL fence must never outlive the server's grant — so the
+        # working duration is quantized to the exact integer the wire
+        # carries (a fractional configured value anchoring the fence
+        # while the server granted the rounded one would let a
+        # partitioned leader keep writing after a takeover window opens)
+        self.duration = float(max(1, round(float(duration))))
+        self.renew_interval = (
+            float(renew_interval) if renew_interval and renew_interval > 0
+            else self.duration / 3.0
+        )
+        # the standby's acquire-poll cadence bounds takeover detection
+        # latency on top of the lease duration; keep it well under the
+        # RTO gate's one-tick-quantum allowance
+        self.acquire_interval = max(
+            0.05, min(self.renew_interval, self.duration / 20.0)
+        )
+        self.fence = _Fence()
+        # role state machine + tailed peer checkpoint; leaf lock,
+        # kwoklint level 84 (docs/static-analysis.md)
+        self._ha_lock = threading.Lock()
+        self.leading = False
+        self.lost = False
+        self.engine = None
+        self._stop = False
+        self._next_renew = 0.0
+        self._last_denied = 0.0   # monotonic of the last 409-denied grab
+        self._lease_seen = False  # a GET has observed the lease existing
+        self._lease_get_at = 0.0  # monotonic of the last discovery GET
+        self._peer_holder = ""
+        self._peer_doc = None     # freshest parsed peer checkpoint
+        self._peer_read_at = 0.0
+        self.fenced_writes = 0
+        self._fenced_logged = False
+        self._role_fam = None
+        self._transitions_c = None
+        self._takeover_g = None
+        self._fenced_c = None
+
+    # ------------------------------------------------------------- wrapping
+
+    def wrap_client(self, client):
+        return FencedClient(self, client)
+
+    def wrap_pump(self, pump):
+        return FencedPump(self, pump)
+
+    def fence_header_line(self) -> str:
+        """The fencing claim as a raw HTTP header line (native pump
+        ``header_extra``)."""
+        return f"{FENCE_HEADER}: {self.fence_header_value()}\r\n"
+
+    def fence_header_value(self) -> str:
+        return f"{self.lease_namespace}/{self.lease_name}/{self.identity}"
+
+    def note_fenced(self, n: int = 1) -> None:
+        # executor threads and several lane pump workers can hit the
+        # fence concurrently: the tally moves under _ha_lock (a legal
+        # 80 -> 84 descent from a pump group lock; the registry child
+        # below is touched after release, per the leaf-lock contract)
+        with self._ha_lock:
+            self.fenced_writes += n
+            first = not self._fenced_logged
+            self._fenced_logged = True
+        c = self._fenced_c
+        if c is not None:
+            c.inc(n)
+        if first:
+            logger.warning(
+                "HA fence dropped an outward write (not holding lease "
+                "%s/%s as %s); further drops are counted silently "
+                "(kwok_ha_fenced_writes_total)",
+                self.lease_namespace, self.lease_name, self.identity,
+            )
+
+    # ---------------------------------------------------------------- wiring
+
+    def bind(self, engine) -> None:
+        """Attach to the engine: register the kwok_ha_* families on its
+        registry, hold the serve gate (degradation reason ``ha_standby``
+        keeps /readyz 503 until leadership), and plant the fencing claim
+        on the underlying HTTP client's extra headers so every unary
+        write is server-side fenced too."""
+        self.engine = engine
+        reg = engine.telemetry.registry
+        self._role_fam = reg.gauge("kwok_ha_role", _HELP_ROLE, ("role",))
+        self._transitions_c = reg.counter(
+            "kwok_lease_transitions_total", _HELP_TRANSITIONS
+        ).labels()
+        self._takeover_g = reg.gauge(
+            "kwok_ha_takeover_seconds", _HELP_TAKEOVER
+        ).labels()
+        self._fenced_c = reg.counter(
+            "kwok_ha_fenced_writes_total", _HELP_FENCED
+        ).labels()
+        self._set_role_gauge("standby")
+        engine._degradation.set("ha_standby")
+        inner = engine.client
+        for _ in range(8):
+            if inner is None or hasattr(inner, "extra_headers"):
+                break
+            inner = getattr(inner, "inner", None)
+        if inner is not None and hasattr(inner, "extra_headers"):
+            inner.extra_headers[FENCE_HEADER] = self.fence_header_value()
+
+    def _set_role_gauge(self, role: str) -> None:
+        fam = self._role_fam
+        if fam is None:
+            return
+        for r in _ROLES:
+            fam.labels(role=r).set(1 if r == role else 0)
+
+    def stop(self) -> None:
+        self._stop = True
+
+    # ------------------------------------------------------------ lease wire
+
+    def _spec(self) -> dict:
+        return {
+            "holderIdentity": self.identity,
+            # exact: self.duration is quantized to this integer at
+            # construction, so fence arithmetic and wire agree
+            "leaseDurationSeconds": int(self.duration),
+        }
+
+    def _lease(self, verb: str):
+        """One lease operation -> (status_code, parsed doc | None).
+        Transport failures raise (callers back off). Works against both
+        the HTTP client (dict answers) and the in-process FakeKube
+        (bytes answers)."""
+        c = self.engine.client
+        ns, name = self.lease_namespace, self.lease_name
+        if verb == "GET":
+            code, doc = c.lease_get(ns, name)
+        elif verb == "POST":
+            code, doc = c.lease_create(ns, name, self._spec())
+        else:
+            code, doc = c.lease_renew(ns, name, self._spec())
+        if isinstance(doc, (bytes, bytearray, memoryview)):
+            import json
+
+            try:
+                doc = json.loads(bytes(doc) or b"null")
+            except ValueError:
+                doc = None
+        return code, doc
+
+    # --------------------------------------------------------------- elector
+
+    def run(self) -> None:
+        """The elector loop (worker ``kwok-ha``, watchdog-supervised; a
+        crash restarts it in place — the fence deadline survives on this
+        object, so a mid-crash window can only be MORE conservative).
+
+        Deliberately keyed on ``self._stop`` alone, NOT the engine's
+        ``_running``: a gracefully-stopping leader keeps RENEWING while
+        the engine drains its in-flight emits — otherwise the fence
+        lapses mid-drain (lease TTL << drain deadline) and the tail
+        writes are silently dropped, unrecoverable for a solo primary
+        (a paired standby would re-fire them, a solo engine has nobody
+        to). ``ClusterEngine.stop`` stops this plane only after the
+        executor drained; the lease then expires naturally and a
+        standby takes over."""
+        while not self._stop:
+            if self.lost:
+                # deposed: permanently fenced and parked; rejoining the
+                # pair takes a process restart (never a split brain)
+                time.sleep(0.2)
+                continue
+            try:
+                if self.leading:
+                    self._renew_cycle()
+                else:
+                    self._attempt_cycle()
+            except Exception:
+                # transport trouble reaching the lease: the fence lapses
+                # by itself at its deadline (writes stop — the safe
+                # direction); keep trying on a short cadence, a renew
+                # that lands before anyone stole the lease re-opens it
+                logger.warning(
+                    "lease %s transport failure; retrying",
+                    "renew" if self.leading else "acquire", exc_info=True,
+                )
+                self._sleep(0.1)
+
+    def _sleep(self, seconds: float) -> None:
+        deadline = time.monotonic() + seconds
+        while not self._stop:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            time.sleep(min(remaining, 0.05))
+
+    def _renew_cycle(self) -> None:
+        while not self._stop and time.monotonic() < self._next_renew:
+            time.sleep(
+                min(0.05, max(0.0, self._next_renew - time.monotonic()))
+            )
+        if self._stop:
+            return
+        t0 = time.monotonic()
+        code, doc = self._lease("PATCH")
+        if code == 200:
+            # fence anchored at the SEND stamp: the server's renewTime is
+            # at-or-after it, so local expiry precedes server expiry
+            self.fence.open_until(t0 + self.duration)
+            self._next_renew = t0 + self.renew_interval
+            return
+        if code == 409:
+            self._lose("lease stolen while renewing")
+            return
+        if code == 404:
+            # the dialect has no lease delete, so this is a fresh store
+            # (e.g. the apiserver restarted empty): re-create
+            code2, _doc2 = self._lease("POST")
+            if code2 == 201:
+                self.fence.open_until(t0 + self.duration)
+                self._next_renew = t0 + self.renew_interval
+                return
+            self._lose(f"lease vanished and re-create answered {code2}")
+            return
+        logger.warning("lease renew answered %s; retrying", code)
+        self._sleep(0.1)
+
+    def _attempt_cycle(self) -> None:
+        # the discovery GET feeds holder identification + the checkpoint
+        # tail, both of which only need the renew cadence — pacing it
+        # keeps the standby's steady-state load at one acquire PATCH per
+        # poll instead of doubling it. While the lease has never been
+        # seen (startup, or a fresh store) the GET stays on the fast
+        # poll: that path decides whether a primary may CREATE.
+        if (
+            not self._lease_seen
+            or time.monotonic() - self._lease_get_at >= self.renew_interval
+        ):
+            code, doc = self._lease("GET")
+            self._lease_get_at = time.monotonic()
+            if code == 404:
+                self._lease_seen = False
+                if self.role == "primary":
+                    # first acquisition: create IS the claim
+                    t0 = time.monotonic()
+                    code2, _doc2 = self._lease("POST")
+                    if code2 == 201:
+                        self._become_leader(t0, prev_holder="")
+                        return
+                # a standby never self-elects onto a lease that has
+                # never existed: it only takes over from a once-alive
+                # primary
+                self._sleep(self.acquire_interval)
+                return
+            self._lease_seen = True
+            holder = ""
+            if isinstance(doc, dict):
+                holder = (
+                    (doc.get("spec") or {}).get("holderIdentity") or ""
+                )
+            if holder and holder != self.identity:
+                self._tail_peer(holder)
+        t0 = time.monotonic()
+        code2, _doc2 = self._lease("PATCH")
+        if code2 == 200:
+            # the previous holder is the last one discovery observed; a
+            # holder that changed hands inside one renew window tails a
+            # slightly older checkpoint, which the (uid, rv, phase)
+            # match degrades to fresh arms — conservative, never wrong
+            ph = self._peer_holder
+            self._become_leader(
+                t0, prev_holder=ph if ph != self.identity else ""
+            )
+            return
+        if code2 == 409:
+            self._last_denied = time.monotonic()
+        elif code2 == 404:
+            self._lease_seen = False  # store reset between polls
+        self._sleep(self.acquire_interval)
+
+    # ------------------------------------------------------------- takeover
+
+    def _tail_peer(self, holder: str) -> None:
+        """Keep the freshest parse of the current holder's checkpoint
+        (atomic-rename files are safe to read concurrently); paced to the
+        renew cadence so a fast acquire poll doesn't hammer the disk."""
+        e = self.engine
+        if not e._ckpt_dir:
+            return
+        now = time.monotonic()
+        if (
+            holder == self._peer_holder
+            and now - self._peer_read_at < self.renew_interval
+        ):
+            return
+        from kwok_tpu.resilience import checkpoint as ckpt_mod
+
+        doc = ckpt_mod.load(e._ckpt_dir, holder)
+        with self._ha_lock:
+            self._peer_holder = holder
+            self._peer_read_at = now
+            if doc is not None:
+                self._peer_doc = doc
+
+    def _become_leader(self, t0: float, prev_holder: str) -> None:
+        with self._ha_lock:
+            self.leading = True
+        self.fence.open_until(t0 + self.duration)
+        self._next_renew = t0 + self.renew_interval
+        if self._transitions_c is not None:
+            self._transitions_c.inc()
+        takeover = (
+            time.monotonic() - self._last_denied if self._last_denied
+            else 0.0
+        )
+        self._open_gate(prev_holder)
+        if self._takeover_g is not None:
+            self._takeover_g.set(takeover)
+        self._set_role_gauge("leader")
+        logger.warning(
+            "HA: %s acquired lease %s/%s%s; serving (takeover %.3fs)",
+            self.identity, self.lease_namespace, self.lease_name,
+            f" from {prev_holder}" if prev_holder else "", takeover,
+        )
+
+    def _open_gate(self, prev_holder: str) -> None:
+        """Standby -> leader: arm the PR 7 reconcile from the dead
+        primary's freshest checkpoint (rows whose (uid, rv, phase) still
+        match resume their delay residues; everything else fresh-arms
+        from the already-warm re-list) and open the tick gate."""
+        e = self.engine
+        if prev_holder and e._ckpt is not None:
+            from kwok_tpu.resilience import checkpoint as ckpt_mod
+
+            doc = ckpt_mod.load(e._ckpt_dir, prev_holder)
+            if doc is None:
+                with self._ha_lock:
+                    doc = (
+                        self._peer_doc
+                        if self._peer_holder == prev_holder else None
+                    )
+            if doc is not None:
+                session = ckpt_mod.RestoreSession(
+                    doc.get("kinds") or {}, gate_ready=False, ttl=30.0
+                )
+                with e._ckpt_lock:
+                    e._restore = session
+                logger.info(
+                    "HA takeover: %d checkpointed rows from %s to "
+                    "reconcile against warm state",
+                    session.remaining, prev_holder,
+                )
+        e._ha_hold = False
+        e._idle_wake = 0.0  # wake the (possibly idle) device loop now
+        # a QUIET cluster's tick loop may be deep in its idle sleep with
+        # the old wake: the sentinel ends the drain window promptly (the
+        # single-lane loop clamps its deadline on it; the lane
+        # coordinator re-reads _idle_wake every poll slice)
+        e._q.put(None)
+        e._degradation.clear("ha_standby")
+        # flight-recorder dump on the role edge (the set() edge hook only
+        # fires on degradations; a takeover is the OTHER edge worth a
+        # post-mortem of the requests that led into it)
+        try:
+            e._flight_dump_on_degrade("ha_takeover")
+        except Exception:
+            from kwok_tpu.telemetry.errors import swallowed
+
+            swallowed("ha.takeover_flight_dump")
+
+    def _lose(self, reason: str) -> None:
+        with self._ha_lock:
+            self.leading = False
+            self.lost = True
+        self.fence.close()
+        e = self.engine
+        e._ha_hold = True  # stop arming/firing; observe-only again
+        self._set_role_gauge("lost")
+        if e._degradation.set("ha_lost_lease"):
+            logger.error(
+                "HA: %s lost lease %s/%s (%s); engine fenced and parked "
+                "— restart the process to rejoin the pair",
+                self.identity, self.lease_namespace, self.lease_name,
+                reason,
+            )
+
+
+def from_config(config) -> "HAPlane | None":
+    """Build the HA plane from an EngineConfig, or None when HA is off
+    (``ha_role`` empty — the zero-cost default). ``KWOK_HA_ROLE`` etc.
+    reach the CLI through the generic env-override pass over
+    KwokConfigurationOptions, not through this module."""
+    role = (getattr(config, "ha_role", "") or "").strip()
+    if not role or role == "off":
+        return None
+    return HAPlane(
+        role,
+        identity=(getattr(config, "ha_identity", "") or "").strip(),
+        lease_name=getattr(config, "lease_name", "") or "kwok-tpu-engine",
+        lease_namespace=(
+            getattr(config, "lease_namespace", "") or "kube-system"
+        ),
+        duration=getattr(config, "lease_duration", 2.0) or 2.0,
+        renew_interval=getattr(config, "lease_renew_interval", 0.0) or 0.0,
+    )

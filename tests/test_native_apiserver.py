@@ -1090,3 +1090,204 @@ def test_garbled_request_line_survival(srv):
             c.close()
     finally:
         py.stop()
+
+
+# ------------------------------------------------- lease dialect (ISSUE 12)
+# The leadership plane's coordination.k8s.io/v1 Lease — create / GET /
+# PATCH-renew with server-arbitrated expiry — plus the fencing-header
+# write rejection. Both servers must answer byte-identically (timestamps
+# masked; uids/resourceVersions are deterministic for an identical drive
+# sequence and are deliberately NOT masked).
+
+_LEASE_BASE = "/apis/coordination.k8s.io/v1/namespaces/kube-system/leases"
+
+
+def _mask_lease_times(b: bytes) -> bytes:
+    return _re.sub(
+        rb'"(creationTimestamp|acquireTime|renewTime)":"[^"]*"',
+        rb'"\1":"T"', b,
+    )
+
+
+def _lease_req(url, method, path, doc=None, headers=None):
+    import urllib.error
+
+    req = urllib.request.Request(
+        url + path,
+        data=None if doc is None else json.dumps(doc).encode(),
+        method=method,
+    )
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        resp = urllib.request.urlopen(req, timeout=5)
+        return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _drive_lease_dialect(url):
+    """The full dialect sequence: miss, create, duplicate create, get,
+    renew, conflict-on-stolen-holder, fenced writes (held + rejected),
+    expiry-acquire with a transitions bump, the deposed holder's stale
+    renew, and the zombie's fenced write. Returns [(label, code, body)].
+    Wall time: ~1.2s (the lease must genuinely expire on the server's
+    clock)."""
+    out = []
+
+    def step(label, *a, **kw):
+        code, body = _lease_req(url, *a, **kw)
+        out.append((label, code, body))
+
+    lease = {
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": {"name": "eng", "namespace": "kube-system"},
+        "spec": {"holderIdentity": "alpha", "leaseDurationSeconds": 1},
+    }
+    renew = {"spec": {"holderIdentity": "alpha", "leaseDurationSeconds": 1}}
+    steal = {"spec": {"holderIdentity": "beta", "leaseDurationSeconds": 1}}
+    node = {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "ln"}}
+    patch = {"status": {"phase": "X"}}
+    step("get_missing", "GET", _LEASE_BASE + "/eng")
+    step("create", "POST", _LEASE_BASE, lease)
+    step("create_duplicate", "POST", _LEASE_BASE, lease)
+    step("get", "GET", _LEASE_BASE + "/eng")
+    step("renew", "PATCH", _LEASE_BASE + "/eng", renew)
+    step("steal_unexpired_conflict", "PATCH", _LEASE_BASE + "/eng", steal)
+    step("fenced_create_held", "POST", "/api/v1/nodes", node,
+         headers={"X-Kwok-Lease-Holder": "kube-system/eng/alpha"})
+    step("fenced_patch_wrong_holder", "PATCH", "/api/v1/nodes/ln/status",
+         patch, headers={"X-Kwok-Lease-Holder": "kube-system/eng/beta"})
+    time.sleep(1.15)  # server-clock expiry
+    step("expiry_acquire", "PATCH", _LEASE_BASE + "/eng", steal)
+    step("deposed_holder_conflict", "PATCH", _LEASE_BASE + "/eng", renew)
+    step("zombie_fenced_patch", "PATCH", "/api/v1/nodes/ln/status",
+         patch, headers={"X-Kwok-Lease-Holder": "kube-system/eng/alpha"})
+    return out
+
+
+def test_lease_dialect_parity(srv):
+    """create / renew / conflict-on-stolen-holder / expiry-acquire (and
+    the fencing-header rejections) answer byte-identically on both
+    servers, mirroring the 429/deadline/restore twins."""
+    native = _drive_lease_dialect(srv.url)
+    py = HttpFakeApiserver().start()
+    try:
+        python = _drive_lease_dialect(py.url)
+    finally:
+        py.stop()
+    assert [x[0] for x in native] == [x[0] for x in python]
+    for (label, ncode, nbody), (_l, pcode, pbody) in zip(native, python):
+        assert ncode == pcode, (label, ncode, pcode, nbody, pbody)
+        assert _mask_lease_times(nbody) == _mask_lease_times(pbody), (
+            label, nbody, pbody,
+        )
+    by_label = {label: (code, body) for label, code, body in native}
+    # dialect semantics, asserted once (the bytes already matched)
+    assert by_label["get_missing"][0] == 404
+    assert by_label["create"][0] == 201
+    created = json.loads(by_label["create"][1])
+    assert created["spec"]["holderIdentity"] == "alpha"
+    assert created["spec"]["leaseTransitions"] == 0
+    assert by_label["create_duplicate"][0] == 409
+    assert json.loads(by_label["create_duplicate"][1])["reason"] == (
+        "AlreadyExists"
+    )
+    renewed = json.loads(by_label["renew"][1])
+    assert renewed["spec"]["leaseTransitions"] == 0  # renew, not handover
+    conflict = json.loads(by_label["steal_unexpired_conflict"][1])
+    assert (conflict["reason"], by_label["steal_unexpired_conflict"][0]) \
+        == ("Conflict", 409)
+    assert '"alpha"' in conflict["message"]
+    # the held writer's fenced create commits; the wrong holder's is
+    # rejected with the pinned fencing Status
+    assert by_label["fenced_create_held"][0] == 201
+    fr = json.loads(by_label["fenced_patch_wrong_holder"][1])
+    assert (fr["reason"], fr["code"]) == ("Conflict", 409)
+    assert "fencing lease kube-system/eng" in fr["message"]
+    acquired = json.loads(by_label["expiry_acquire"][1])
+    assert acquired["spec"]["holderIdentity"] == "beta"
+    assert acquired["spec"]["leaseTransitions"] == 1  # the handover
+    deposed = json.loads(by_label["deposed_holder_conflict"][1])
+    assert (deposed["reason"], by_label["deposed_holder_conflict"][0]) \
+        == ("Conflict", 409)
+    # the zombie's in-flight write dies server-side after the handover
+    assert by_label["zombie_fenced_patch"][0] == 409
+
+
+def test_lease_discovery_parity(srv):
+    """/apis lists coordination.k8s.io and the group's APIResourceList
+    serves the minimal create/get/patch verb set, byte-identically."""
+    py = HttpFakeApiserver().start()
+    try:
+        for path in ("/apis", "/apis/coordination.k8s.io/v1"):
+            ncode, nbody = _lease_req(srv.url, "GET", path)
+            pcode, pbody = _lease_req(py.url, "GET", path)
+            assert (ncode, nbody) == (pcode, pbody), path
+        doc = json.loads(nbody)
+        assert doc["resources"][0]["verbs"] == ["create", "get", "patch"]
+    finally:
+        py.stop()
+
+
+def test_lease_hostile_body_parity(srv):
+    """Valid-JSON-but-wrong-shape lease bodies (arrays, bool/string-float
+    durations, empty bodies) must answer identically on both servers and
+    never kill the handler thread — the hostile-wire contract extended to
+    the new dialect (review regression pin)."""
+    def drive(url):
+        out = [
+            # array create: 400 (non-object rejection)
+            _lease_req(url, "POST", _LEASE_BASE, [1]),
+            # string-float duration: atol semantics ("2.5" -> 2) on both
+            _lease_req(url, "POST", _LEASE_BASE, {
+                "metadata": {"name": "hb"},
+                "spec": {"holderIdentity": "a",
+                         "leaseDurationSeconds": "2.5"},
+            }),
+            # array renew: empty spec -> arbitrated as a different-holder
+            # grab of an unexpired lease -> 409
+            _lease_req(url, "PATCH", _LEASE_BASE + "/hb", [1]),
+            # boolean duration reads as 0 (C++ BOOL is neither NUM nor
+            # STR); same-holder renew still 200
+            _lease_req(url, "PATCH", _LEASE_BASE + "/hb", {
+                "spec": {"holderIdentity": "a",
+                         "leaseDurationSeconds": True},
+            }),
+            # malformed fencing claims (no second slash / no slash at
+            # all): byte-identical 409 bodies from the C++ find-split
+            # and the Python partition mirror
+            _lease_req(url, "PATCH", "/api/v1/nodes/hn/status",
+                       {"status": {"phase": "X"}},
+                       headers={"X-Kwok-Lease-Holder": "a/b"}),
+            _lease_req(url, "PATCH", "/api/v1/nodes/hn/status",
+                       {"status": {"phase": "X"}},
+                       headers={"X-Kwok-Lease-Holder": "garbage"}),
+            # the handler survived everything above: GET still answers
+            _lease_req(url, "GET", _LEASE_BASE + "/hb"),
+        ]
+        return out
+
+    native_out = drive(srv.url)
+    py = HttpFakeApiserver().start()
+    try:
+        python_out = drive(py.url)
+    finally:
+        py.stop()
+    for i, ((nc, nb), (pc, pb)) in enumerate(zip(native_out, python_out)):
+        assert nc == pc, (i, nc, pc, nb, pb)
+        assert _mask_lease_times(nb) == _mask_lease_times(pb), (i, nb, pb)
+    assert [c for c, _ in native_out] == [
+        400, 201, 409, 200, 409, 409, 200,
+    ]
+    created = json.loads(native_out[1][1])
+    assert created["spec"]["leaseDurationSeconds"] == 2  # atol("2.5")
+    # Python-only crash-proofing: stdlib json parses the non-standard
+    # Infinity token (the C++ parser 400s it — a tree-wide dialect
+    # tolerance), so an infinite duration must read bounded, never
+    # raise out of the handler
+    from kwok_tpu.edge.mockserver import FakeKube as _FK
+
+    assert _FK._lease_spec(
+        {"holderIdentity": "x", "leaseDurationSeconds": float("inf")}
+    ) == ("x", 0)

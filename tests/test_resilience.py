@@ -1573,3 +1573,237 @@ def test_garbled_parseable_rv_never_crashes_ingest():
     # MODIFIED with a garbled rv flows (not stale-droppable, not a crash)
     eng._ingest("pods", "MODIFIED", pod)
     assert eng.pods.pool.lookup(("default", "gr-p")) is not None
+
+
+# ----------------------------------------- warm-standby HA (ISSUE 12)
+# Fencing unit + e2e: the observe-only standby is emit-silent, a
+# partitioned zombie leader is write-dead on the oplog and observes its
+# own deposition, and HA disabled is provably zero-cost. The
+# whole-process SIGSTOP arm (OS-level pause) is exercised by
+# benchmarks/failover_soak.py (`make ha-check`); here the pause is
+# applied to the renewal channel, which exercises the identical fence
+# lapse + server-arbitrated handover + fenced-write paths in-process.
+
+from kwok_tpu.resilience import ha as _ha  # noqa: E402
+
+
+def _ha_engine(kube, role, ident, *, duration=1.0, ckpt_dir="", **over):
+    cfg = EngineConfig(
+        manage_all_nodes=True, tick_interval=0.02,
+        ha_role=role, ha_identity=ident,
+        lease_duration=duration, checkpoint_dir=ckpt_dir or "off",
+        **over,
+    )
+    return ClusterEngine(kube, cfg)
+
+
+def test_ha_disabled_is_zero_cost():
+    """No role, no plane: the client is the caller's own object (no
+    fence wrapper), no hold gate, no kwok-ha thread, no kwok_ha_*
+    families on the registry."""
+    kube = FakeKube()
+    eng = ClusterEngine(kube, EngineConfig(manage_all_nodes=True))
+    assert eng._ha is None
+    assert eng._ha_hold is False
+    assert eng.client is kube  # unwrapped: no per-write fence check
+    assert eng._ckpt_name == "engine"
+    assert "kwok_ha_role" not in eng.metrics_text()
+    # "off" behaves like empty (lane children / config files)
+    assert _ha.from_config(EngineConfig(
+        manage_all_nodes=True, ha_role="off"
+    )) is None
+
+
+def test_ha_fence_and_wrappers_unit():
+    """The fence is a monotonic deadline; fenced client verbs report the
+    deleted-object no-op shape; fenced pump batches answer all-404 (the
+    engine's no-op code, so no resend/degradation/fallback fires)."""
+    plane = _ha.HAPlane("primary", identity="u1", duration=1.0)
+    assert not plane.fence.holding()
+    kube = FakeKube()
+    kube.create("nodes", make_node("fz"))
+    fc = plane.wrap_client(kube)
+    # fenced: dropped + counted, server untouched
+    assert fc.patch_status("nodes", None, "fz",
+                           {"status": {"phase": "X"}}) is None
+    assert fc.patch_meta("nodes", None, "fz",
+                         {"metadata": {"labels": {"a": "b"}}}) is None
+    fc.delete("nodes", None, "fz")
+    assert plane.fenced_writes == 3
+    got = kube.get("nodes", None, "fz")
+    assert got is not None  # the fenced delete never landed
+    assert "phase" not in (got.get("status") or {})  # nor the patch
+    assert got["metadata"].get("labels", {}) == {}   # nor the meta patch
+    # reads always pass through
+    assert fc.get("nodes", None, "fz") is not None
+    # open: delegates for real
+    plane.fence.open_until(time.monotonic() + 5)
+    assert fc.patch_status(
+        "nodes", None, "fz", {"status": {"phase": "Y"}}
+    ) is not None
+    assert kube.get("nodes", None, "fz")["status"]["phase"] == "Y"
+    plane.fence.close()
+
+    class _Pump:
+        sent = 0
+
+        def send(self, reqs):
+            self.sent += len(reqs)
+            return np.full(len(reqs), 200, np.int32)
+
+        def close(self):
+            pass
+
+    p = _Pump()
+    fp = plane.wrap_pump(p)
+    st = fp.send([b"a", b"b"])
+    assert p.sent == 0 and list(st) == [404, 404]
+    assert plane.fenced_writes == 5
+    plane.fence.open_until(time.monotonic() + 5)
+    st = fp.send([b"a"])
+    assert p.sent == 1 and list(st) == [200]
+
+
+def test_ha_standby_observe_only_then_takeover():
+    """A warm standby ingests the world but emits NOTHING (arms nothing:
+    no patch ever reaches the store) while another identity holds the
+    lease; when the holder dies (stops renewing) the standby acquires on
+    expiry, opens the gate, and converges the same pods — the e2e
+    emit-silence + takeover proof on the in-process store."""
+    kube = FakeKube()
+    # a once-alive primary: holds the lease (renewed manually below so
+    # the engine's multi-second warm-up can't race the expiry clock)
+    code, _ = kube.lease_create(
+        "kube-system", "kwok-tpu-engine",
+        {"holderIdentity": "ghost", "leaseDurationSeconds": 2},
+    )
+    assert code == 201
+    eng = _ha_engine(kube, "standby", "obs1", duration=2.0)
+    eng.start()
+    try:
+        kube.create("nodes", make_node("sb-n"))
+        for i in range(4):
+            kube.create("pods", make_pod(f"sb-p{i}", node="sb-n"))
+        # warm: every row tracked...
+        assert _wait(
+            lambda: len(eng.pods.pool) == 4 and len(eng.nodes.pool) == 1
+        )
+        # the ghost is "alive": renew its lease NOW, then observe a
+        # silent window comfortably inside the fresh TTL
+        code, _ = kube.lease_renew(
+            "kube-system", "kwok-tpu-engine",
+            {"holderIdentity": "ghost", "leaseDurationSeconds": 2},
+        )
+        assert code == 200
+        t0 = time.time()
+        while time.time() - t0 < 1.0:  # hold window: must stay silent
+            assert kube.patch_count == 0
+            assert not eng._ha.leading and eng._ha_hold
+            time.sleep(0.05)
+        assert eng.degraded  # ha_standby keeps /readyz 503
+        # the ghost's lease expires -> acquisition -> gate opens
+        assert _wait(lambda: eng._ha.leading and not eng._ha_hold,
+                     timeout=5.0)
+        assert _wait(lambda: all(
+            (kube.get("pods", "default", f"sb-p{i}") or {})
+            .get("status", {}).get("phase") == "Running"
+            for i in range(4)
+        ), timeout=20.0)
+        assert not eng.degraded
+        text = eng.metrics_text()
+        assert 'kwok_ha_role{role="leader"} 1' in text
+        assert "kwok_lease_transitions_total 1" in text
+    finally:
+        eng.stop()
+
+
+def test_ha_partitioned_zombie_is_write_dead_then_deposed():
+    """The fencing core: a leader whose lease channel freezes (the
+    in-process twin of a SIGSTOPped primary) keeps trying to write when
+    its timers fire — every write dies on the fence (oplog gains only
+    the standby's patches, exactly one Running per pod) — and on healing
+    the partition its renew meets 409: role=lost, permanently fenced,
+    degraded ha_lost_lease."""
+    import benchmarks.rig as rig
+
+    store = rig.oplog_store()
+    primary = _ha_engine(store, "primary", "za")
+    primary.start()
+    try:
+        assert _wait(lambda: primary._ha.leading, timeout=5.0)
+        standby = _ha_engine(store, "standby", "zb")
+        standby.start()
+        try:
+            store.create("nodes", make_node("zn"))
+            # partition the primary's lease channel BEFORE the workload:
+            # its fence lapses while the pods' delays are in flight, so
+            # its kernel will genuinely try to emit afterward
+            orig_lease = primary._ha._lease
+
+            def _partitioned(verb):
+                raise ConnectionError("lease channel partitioned")
+
+            primary._ha._lease = _partitioned
+            for i in range(4):
+                store.create("pods", make_pod(f"zp{i}", node="zn"))
+            names = [f"zp{i}" for i in range(4)]
+            # the standby acquires once the unrenewed lease expires
+            assert _wait(
+                lambda: standby._ha.leading and not standby._ha_hold,
+                timeout=6.0,
+            )
+            assert _wait(lambda: all(
+                (store.get("pods", "default", n) or {})
+                .get("status", {}).get("phase") == "Running"
+                for n in names
+            ), timeout=20.0)
+            # zombie primary kept running the whole time; give any of
+            # its in-flight emits a window, then read the oplog: every
+            # pod got EXACTLY ONE Running patch (the standby's)
+            time.sleep(0.5)
+            counts = store.phase_counts("Running", names)
+            assert counts == {n: 1 for n in names}, counts
+            # heal the partition: the zombie's renew meets the stolen
+            # holder, loses permanently, parks fenced + degraded
+            primary._ha._lease = orig_lease
+            assert _wait(lambda: primary._ha.lost, timeout=5.0)
+            assert primary._ha_hold and not primary._ha.fence.holding()
+            assert "ha_lost_lease" in primary._degradation.reasons
+            assert 'kwok_ha_role{role="lost"} 1' in primary.metrics_text()
+        finally:
+            standby.stop()
+    finally:
+        primary.stop()
+
+
+def test_ha_cli_and_env_plumbing(monkeypatch):
+    """KWOK_HA_* / KWOK_LEASE_* reach EngineConfig through the generic
+    env-override pass + the CLI flag surface (the same path every other
+    resilience knob takes)."""
+    from kwok_tpu.config.types import (
+        KwokConfigurationOptions, apply_env_overrides,
+    )
+    from kwok_tpu.kwok.cli import build_parser
+
+    opts = KwokConfigurationOptions()
+    monkeypatch.setenv("KWOK_HA_ROLE", "standby")
+    monkeypatch.setenv("KWOK_HA_IDENTITY", "env-id")
+    monkeypatch.setenv("KWOK_LEASE_NAME", "env-lease")
+    monkeypatch.setenv("KWOK_LEASE_NAMESPACE", "env-ns")
+    monkeypatch.setenv("KWOK_LEASE_DURATION", "7.5")
+    monkeypatch.setenv("KWOK_LEASE_RENEW_INTERVAL", "2.5")
+    apply_env_overrides(opts)
+    assert (opts.haRole, opts.haIdentity) == ("standby", "env-id")
+    assert (opts.leaseName, opts.leaseNamespace) == (
+        "env-lease", "env-ns"
+    )
+    assert (opts.leaseDuration, opts.leaseRenewInterval) == (7.5, 2.5)
+    args = build_parser(opts).parse_args([])
+    assert args.ha_role == "standby" and args.ha_identity == "env-id"
+    assert args.lease_duration == 7.5
+    # the plane resolves the config; identity defaults to hostname-pid
+    plane = _ha.from_config(EngineConfig(
+        manage_all_nodes=True, ha_role="primary",
+    ))
+    assert plane is not None and plane.identity
+    assert plane.renew_interval == pytest.approx(2.0 / 3.0)
