@@ -63,16 +63,17 @@ chaos-check: ## deterministic fault-injection + self-healing convergence gate (+
 restart-check: ## SIGKILL + cold-restart crash-durability gate (RTO artifact)
 	$(PYENV) python3 benchmarks/restart_soak.py --check
 
-# fleet-check: the apiserver overload-protection gate: a watcher fleet
-# (normal + deliberately-slow + churn + list-flood cohorts) against the
-# native apiserver with max-inflight admission + bounded watch buffers
+# fleet-check: the apiserver overload-protection gate: a 1000-watcher
+# fleet (normal + deliberately-slow + churn + list-flood cohorts, the
+# ISSUE 13 scale the serialize-once broadcast ring holds) against the
+# native apiserver with max-inflight admission + the ring-cursor lag cap
 # configured, while the threaded engine converges a workload under the
 # fault storm. Gates = byte-identical final phases vs a no-fleet control
 # arm, every watcher at the final resourceVersion, engine patch-RTT p99
-# bounded, slow watchers terminated (not buffered unboundedly), and all
-# 429s throttled by Retry-After (docs/resilience.md; FLEET_r*.json).
-# Skips cleanly when no C++ compiler is available.
-fleet-check: ## watcher-fleet survival gate (overload admission + slow-watcher eviction)
+# bounded, slow watchers ring-lag-terminated (not buffered unboundedly),
+# and all 429s throttled by Retry-After (docs/resilience.md;
+# FLEET_r*.json). Skips cleanly when no C++ compiler is available.
+fleet-check: ## watcher-fleet survival gate (overload admission + ring-lag slow-watcher eviction)
 	$(PYENV) python3 benchmarks/watcher_fleet.py --check
 
 # drift-check: the hostile-wire + anti-entropy gate: the threaded engine

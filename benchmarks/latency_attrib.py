@@ -332,6 +332,7 @@ def run(a) -> "dict | None":
             "fanout) is the transferable number"
         ),
     }
+    artifact["vs_r01"] = _delta_vs_r01(artifact)
 
     # ---- parity-twin perf check: the SAME watcher-free patch burst on
     # a timing-on and a timing-off server (the attribution arm above had
@@ -405,6 +406,34 @@ def run(a) -> "dict | None":
     return artifact
 
 
+def _delta_vs_r01(artifact: dict) -> "dict | None":
+    """The before/after delta against LATENCY_r01.json (the pre-surgery
+    photo, same rig mix) — the ISSUE 13 tentpole's headline comparison,
+    embedded in both the r02 artifact and bench.py's rider."""
+    try:
+        with open(os.path.join(REPO, "LATENCY_r01.json")) as fh:
+            r01 = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    b_pp = (r01.get("per_pod") or {})
+    a_pp = (artifact.get("per_pod") or {})
+    out = {"r01": {
+        "measured_apiserver_us_per_pod":
+            b_pp.get("measured_apiserver_us_per_pod"),
+        "commit_us_per_request": b_pp.get("commit_us_per_request"),
+        "fanout_us_per_watcher_push":
+            b_pp.get("fanout_us_per_watcher_push"),
+    }}
+    for key in (
+        "measured_apiserver_us_per_pod", "commit_us_per_request",
+        "fanout_us_per_watcher_push",
+    ):
+        before, after = b_pp.get(key), a_pp.get(key)
+        if before and after:
+            out[f"{key}_speedup"] = round(before / after, 2)
+    return out
+
+
 def _fanout_pushes(text: str) -> int:
     for line in text.splitlines():
         if line.startswith("kwok_watch_fanout_total "):
@@ -443,7 +472,7 @@ def rider(pods: int = 24, rounds: int = 3, watchers: int = 4) -> dict:
         srv.stop()
     att = attribution_from_metrics(text)
     fanout_pushes = _fanout_pushes(text)
-    return {
+    out = {
         "requests": att["requests"],
         "phase_us_per_request": att["phase_us_per_request"],
         "unattributed_frac": att["unattributed_frac"],
@@ -453,6 +482,11 @@ def rider(pods: int = 24, rounds: int = 3, watchers: int = 4) -> dict:
             att["phase_totals_us"].get("fanout", 0.0) / fanout_pushes, 3
         ) if fanout_pushes else None,
     }
+    out["vs_r01"] = _delta_vs_r01({"per_pod": {
+        "commit_us_per_request": att["phase_us_per_request"].get("commit"),
+        "fanout_us_per_watcher_push": out["fanout_us_per_watcher_push"],
+    }})
+    return out
 
 
 def main() -> int:
@@ -461,7 +495,7 @@ def main() -> int:
     p.add_argument("--rounds", type=int, default=8,
                    help="pump patch-burst rounds (one batch per round)")
     p.add_argument("--watchers", type=int, default=8)
-    p.add_argument("--out", default=os.path.join(REPO, "LATENCY_r01.json"))
+    p.add_argument("--out", default=os.path.join(REPO, "LATENCY_r02.json"))
     p.add_argument("--check", action="store_true",
                    help="CI gate: smaller workload, exit 1 on any "
                    "failed gate")

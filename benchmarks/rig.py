@@ -173,23 +173,27 @@ def silent_patch(store, kind, namespace, name, mutate) -> bool:
     silent divergence (nothing on the engine's event path can see this;
     only the auditor's ground-truth re-read can). ``mutate(obj)`` edits
     the live dict in place. Returns whether the object existed."""
-    with store._lock:
-        key = store._key(namespace, name)
-        obj = store._store[kind].get(key)
+    sh = store._shard(kind, namespace, create=False)
+    if sh is None:
+        return False
+    with sh._shard_lock:
+        obj = sh.objs.get(name)
         if obj is None:
             return False
         mutate(obj)
-        store._json[kind].pop(key, None)  # invalidate the bytes cache
+        sh.json.pop(name, None)  # invalidate the bytes cache
         return True
 
 
 def silent_delete(store, kind, namespace, name) -> bool:
     """Remove a stored object without a DELETED event or rv bump: the
     engine's row becomes a ghost only anti-entropy can notice."""
-    with store._lock:
-        key = store._key(namespace, name)
-        gone = store._store[kind].pop(key, None)
-        store._json[kind].pop(key, None)
+    sh = store._shard(kind, namespace, create=False)
+    if sh is None:
+        return False
+    with sh._shard_lock:
+        gone = sh.objs.pop(name, None)
+        sh.json.pop(name, None)
         return gone is not None
 
 

@@ -79,13 +79,22 @@ FLEET_STORM = (
 )
 
 # Absolute floor for the p99 ratio gate: on a core-starved CI host (2
-# vCPUs here) every probe patch wakes the whole attached fleet (60+
-# watcher threads across the worker processes), so the no-fleet ratio
-# measures core oversubscription, not server starvation. 100 ms is the
-# bound that still catches what the gate hunts — lock convoys, unbounded
-# queueing, admission livelock — and the 2x ratio binds on hosts with
-# cores to spare. Disclosed in the artifact.
+# vCPUs here) every probe patch wakes the whole attached fleet, so the
+# no-fleet ratio measures core oversubscription, not server starvation.
+# 100 ms is the bound that still catches what the gate hunts — lock
+# convoys, unbounded queueing, admission livelock — and the 2x ratio
+# binds on hosts with cores to spare. At the ISSUE 13 scale the floor
+# grows with the cohort (see _p99_floor): delivering one event to 1000
+# sockets is ~1000 write syscalls + wakeups sharing 2 cores — per-event
+# cost scales with the fleet no matter how cheap the encode got, and a
+# fixed 60-watcher floor would gate on arithmetic, not on convoys.
+# Both the base and the per-watcher term are disclosed in the artifact.
 P99_FLOOR_S = 0.1
+P99_FLOOR_PER_WATCHER_S = 2.5e-4
+
+
+def _p99_floor(watchers: int) -> float:
+    return max(P99_FLOOR_S, watchers * P99_FLOOR_PER_WATCHER_S)
 # RSS is recorded for the artifact (post-mortem context) but no longer
 # gated — the bounded-buffer proof is the backlog peak watermark
 RSS_CEILING_BYTES = 512 << 20
@@ -716,7 +725,13 @@ def _run_arm(a, fleet: bool) -> dict:
                      "--flood", str(flood_per),
                      "--stall", str(stall_s),
                      "--seed", str(a.seed), "--ctl", ctl,
-                     "--deadline", str(a.timeout + 60)],
+                     # workers must outlive the whole parent pipeline
+                     # (throttled setup + storm + filler + convergence +
+                     # settle + probe + target write): at the 1000-watcher
+                     # scale that approaches the convergence timeout
+                     # itself on a 2-vCPU host, and a worker dying before
+                     # the target lands reads as a false non-convergence
+                     "--deadline", str(a.timeout + 240)],
                     cwd=REPO,
                 ))
         def wait_attached():
@@ -759,7 +774,7 @@ def _run_arm(a, fleet: bool) -> dict:
                     f.write(str(target_rv))
                 os.replace(tmp, os.path.join(ctl, "target_rv"))
                 for w in workers:
-                    w.wait(timeout=a.timeout + 90)
+                    w.wait(timeout=a.timeout + 270)
         finally:
             if eng is not None:
                 eng.stop()
@@ -814,7 +829,7 @@ def gates(control: dict, fleet: dict, a) -> dict:
         'kwok_watch_backlog_events{agg="peak"}', a.watch_backlog + 1
     )
     fleet_n = rep.get("n", 0)
-    p99_bound = max(2 * control["p99_s"], P99_FLOOR_S)
+    p99_bound = max(2 * control["p99_s"], _p99_floor(a.watchers))
     return {
         "control_converged": bool(control["converged"]),
         "fleet_converged": bool(fleet["converged"]),
@@ -852,12 +867,15 @@ def gates(control: dict, fleet: dict, a) -> dict:
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--watchers", type=int, default=200)
-    p.add_argument("--slow", type=int, default=24,
+    # ISSUE 13: the serialize-once broadcast ring made the 200-watcher
+    # fleet cheap — the default cohort is now 1000 (same mix, 5x), the
+    # scale the ring's one-encode-per-event must hold at
+    p.add_argument("--watchers", type=int, default=1000)
+    p.add_argument("--slow", type=int, default=120,
                    help="deliberately-slow cohort size")
-    p.add_argument("--churn", type=int, default=40,
+    p.add_argument("--churn", type=int, default=200,
                    help="connect/disconnect cohort size")
-    p.add_argument("--flood", type=int, default=24,
+    p.add_argument("--flood", type=int, default=120,
                    help="back-to-back list cohort size (mass resync)")
     p.add_argument("--pods", type=int, default=96)
     p.add_argument("--seed", type=int, default=42)
@@ -876,9 +894,10 @@ def main() -> int:
     p.add_argument("--storm-s", type=float, default=3.0,
                    help="fault-storm window length")
     p.add_argument("--timeout", type=float, default=120.0)
-    p.add_argument("--out", default=os.path.join(REPO, "FLEET_r01.json"))
+    p.add_argument("--out", default=os.path.join(REPO, "FLEET_r02.json"))
     p.add_argument("--check", action="store_true",
-                   help="CI gate: smaller fleet, exit 1 on any failed gate")
+                   help="CI gate: exit 1 on any failed gate (the full "
+                   "1000-watcher cohort — the ring must hold at scale)")
     # internal: worker-process mode
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--server", default="", help=argparse.SUPPRESS)
@@ -892,9 +911,10 @@ def main() -> int:
     if a.worker:
         return _worker_main(a)
     if a.check:
-        a.watchers, a.slow, a.churn, a.flood = 60, 9, 12, 12
+        # fleet-check gates AT the 1000-watcher scale (ISSUE 13): the
+        # cohort mix stays the default; only the engine workload and the
+        # admission/backlog knobs shrink to CI size
         a.pods = min(a.pods, 48)
-        a.worker_procs = 3
         a.max_inflight = 4
         a.max_mutating_inflight = 32
         a.watch_backlog = 64
@@ -914,6 +934,34 @@ def main() -> int:
     fleet = _run_arm(a, fleet=True)
     g = gates(control, fleet, a)
     ok = all(g.values())
+    # ISSUE 13: the slow-close MECHANISM changed (per-watcher buffer
+    # drops -> ring-cursor lag); record this run's ring-lag terminations
+    # against the r01 buffer-drop counts so the contract's continuity is
+    # auditable in one place
+    sm = fleet.get("server_metrics", {})
+    ring_vs_r01: dict = {
+        "ring_lag_terminations_slow": sm.get(
+            'kwok_watch_terminations_total{reason="slow"}'
+        ),
+        "ring_lag_peak": sm.get('kwok_watch_ring_lag{agg="peak"}'),
+        "ring_encode_total": sm.get("kwok_watch_encode_total"),
+        "ring_fanout_total": sm.get("kwok_watch_fanout_total"),
+    }
+    try:
+        with open(os.path.join(REPO, "FLEET_r01.json")) as fh:
+            r01 = json.load(fh)
+        r01_sm = (r01.get("fleet_arm") or {}).get("server_metrics") or {}
+        ring_vs_r01["r01_buffer_drop_terminations_slow"] = r01_sm.get(
+            'kwok_watch_terminations_total{reason="slow"}'
+        )
+        ring_vs_r01["r01_backlog_peak"] = r01_sm.get(
+            'kwok_watch_backlog_events{agg="peak"}'
+        )
+        ring_vs_r01["r01_watchers"] = (r01.get("params") or {}).get(
+            "watchers"
+        )
+    except (OSError, ValueError):
+        ring_vs_r01["r01_buffer_drop_terminations_slow"] = None
     artifact = {
         "bench": "watcher_fleet",
         "storm": FLEET_STORM.format(seed=a.seed),
@@ -926,12 +974,15 @@ def main() -> int:
             "watch_backlog": a.watch_backlog,
             "filler_events": a.filler_events,
             "filler_bytes": FILLER_BYTES,
-            "p99_floor_s": P99_FLOOR_S,
+            "p99_floor_s": _p99_floor(a.watchers),
+            "p99_floor_base_s": P99_FLOOR_S,
+            "p99_floor_per_watcher_s": P99_FLOOR_PER_WATCHER_S,
             "rss_ceiling_bytes": RSS_CEILING_BYTES,
             "check": a.check,
         },
         "gates": g,
         "ok": ok,
+        "ring_lag_vs_r01_buffer_drops": ring_vs_r01,
         "control": {
             k: control.get(k)
             for k in ("converged", "wall_s", "p99_s", "probe",

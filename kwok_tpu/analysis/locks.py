@@ -77,6 +77,17 @@ LOCK_ORDER: dict[str, int] = {
     "_ha_lock": 84,
     "_lock": 85,        # single-resource leaves (ippool, registry, ...)
     "_apiserver_lock": 85,
+    # mock-apiserver sharded store (ISSUE 13), outermost-first:
+    # _lease_lock wraps a FENCED write's whole mutation (check+commit one
+    # critical section, so a takeover PATCH cannot interleave), each
+    # (kind, namespace) _shard_lock orders same-key writes, and
+    # _ring_lock is the store's clock/broadcast section (revision
+    # allocation, watch cache, undo log, serialize-once ring, watch
+    # registry). Shard locks NEVER nest with each other — cross-shard
+    # reads walk shards sequentially and reconcile via the undo log.
+    "_lease_lock": 86,
+    "_shard_lock": 87,
+    "_ring_lock": 88,
     "_audit_lock": 95,  # mockserver audit ring, below the store lock
 }
 DEFAULT_LEVEL = 85
@@ -508,6 +519,20 @@ class LockOrderRule(Rule):
                                     )
 
 
+def _paired_cond_wait(reason: str, held: str) -> bool:
+    """A ``<stem>_cond.wait()`` under ``<stem>_lock`` is the
+    threading.Condition contract working as designed: wait() atomically
+    RELEASES the lock that backs the condition while sleeping, so it is
+    the one blocking shape that cannot convoy the lock it is charged
+    against. The pairing is by naming convention and exact: the same
+    wait under any OTHER lock (a shard lock, say) still convoys that
+    lock and stays a finding."""
+    suffix = "_cond.wait()"
+    if not reason.endswith(suffix):
+        return False
+    return held == reason[: -len(suffix)] + "_lock"
+
+
 class BlockingUnderLockRule(Rule):
     name = "blocking-under-lock"
     description = (
@@ -521,6 +546,8 @@ class BlockingUnderLockRule(Rule):
         for fi in index.funcs:
             for blk in fi.blocks:
                 for reason, line in blk.blocking:
+                    if _paired_cond_wait(reason, blk.name):
+                        continue
                     msg = (
                         f"in {fi.qual}: {reason} while holding {blk.name}"
                     )
@@ -531,6 +558,8 @@ class BlockingUnderLockRule(Rule):
                 for site in blk.calls:
                     for callee in index.resolve(fi, site):
                         for reason, chain in callee.t_blocking.items():
+                            if _paired_cond_wait(reason, blk.name):
+                                continue
                             path = (
                                 f"{callee.qual} -> {chain}" if chain
                                 else callee.qual

@@ -74,21 +74,94 @@ class _BadBody(Exception):
     must not kill the connection thread or wedge the store lock."""
 
 
+class _RingEv:
+    """One serialize-once broadcast-ring entry (ISSUE 13): the event line
+    is encoded exactly once at emit time and SHARED by every watcher whose
+    cursor passes it. ``line`` is the full wire event
+    (``{"type":T,"object":O}\\n`` — byte-identical to what json.dumps
+    produced when each watcher encoded its own copy); ``doc`` is the
+    lazily-parsed document, materialized only for selector matching and
+    in-process consumers (plain HTTP watchers never pay a parse)."""
+
+    __slots__ = ("kind", "type", "line", "bookmark", "_doc")
+
+    def __init__(self, kind: str, type_: str, line: bytes,
+                 bookmark: bool = False):
+        self.kind = kind
+        self.type = type_
+        self.line = line
+        self.bookmark = bookmark
+        self._doc = None
+
+    def obj(self) -> dict:
+        if self._doc is None:
+            self._doc = json.loads(self.line)
+        return self._doc["object"]
+
+
+class _CompatQueue:
+    """queue.Queue-shaped view over a cursor watch (tests and in-process
+    consumers use ``w.q.get_nowait()`` / ``qsize()``; the HTTP facade and
+    the iterator read the ring directly). Only MATCHING events count —
+    the same events the old per-watcher queue would have held."""
+
+    def __init__(self, w: "_Watch"):
+        self._w = w
+
+    def get(self, block: bool = True, timeout: "float | None" = None):
+        ev = self._w._next_event(block=block, timeout=timeout)
+        if ev is _STOPPED:
+            return None  # the old stop sentinel
+        if ev is None:
+            raise queue.Empty
+        return ev
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return self._w._pending_count()
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def put(self, item) -> None:  # pragma: no cover - legacy shim
+        raise TypeError("ring watches are server-fed; use the store API")
+
+
+_STOPPED = object()  # sentinel: the watch ended (old q's None)
+
+
 class _Watch:
+    """A cursor into the store's broadcast ring (ISSUE 13). The server
+    encodes each watch event ONCE into the shared ring; every watch holds
+    ``cursor`` (the next ring sequence it will read) plus a private,
+    cap-exempt ``replay`` of resume-gap events from the watch cache. A
+    watch whose cursor falls more than ``watch_backlog`` events behind the
+    ring head is closed with ``terminated="slow"`` — PR 8's bounded-
+    backlog semantics folded into ring-cursor lag."""
+
     def __init__(self, server: "FakeKube", kind: str, field_selector, label_selector):
         self.server = server
         self.kind = kind
         self.field_selector = field_selector
         self.label_selector = parse_selector(label_selector)
-        self.q: "queue.Queue[WatchEvent | None]" = queue.Queue()
+        #: next ring sequence to read (guarded by the store's _ring_lock)
+        self.cursor = 0
+        #: events delivered before ``stop_seq`` even after a graceful stop
+        self.stop_seq = None
+        #: resume replay from the watch cache: (type, object-bytes) pairs,
+        #: exempt from the lag cap (bounded by RV_WINDOW already)
+        self.replay: "collections.deque" = collections.deque()
         self.stopped = False
         #: opted into periodic BOOKMARK events (allowWatchBookmarks=true)
         self.bookmarks = False
         #: set to the reason ("slow") when the SERVER closed this watch
-        #: because its bounded send buffer overflowed — the HTTP facade
-        #: closes the connection at the current event boundary instead of
-        #: letting a consumer that stopped reading pin unbounded memory
+        #: because its ring-cursor lag exceeded the backlog cap — the HTTP
+        #: facade closes the connection abruptly instead of letting a
+        #: consumer that stopped reading pin unbounded memory
         self.terminated: "str | None" = None
+        self.q = _CompatQueue(self)
 
     def _matches(self, obj: dict) -> bool:
         if not match_field_selector(obj, self.field_selector):
@@ -99,16 +172,134 @@ class _Watch:
                 return False
         return True
 
+    def _takes(self, ev: _RingEv) -> bool:
+        """Whether this watch consumes a ring event (kind + bookmark
+        opt-in + selectors); runs on the WATCHER's thread, not the
+        writer's — the per-watcher filter cost left the commit path."""
+        if ev.kind != self.kind:
+            return False
+        if ev.bookmark:
+            return self.bookmarks
+        if self.field_selector is None and self.label_selector is None:
+            return True
+        return self._matches(ev.obj())
+
+    # ---- delivery (all ring reads under the store's _ring_lock) --------
+
+    def _scan_locked(self):
+        """Advance the cursor to the next matching ring event and return
+        it, or None when drained (caller holds _ring_lock)."""
+        s = self.server
+        while True:
+            if self.replay:
+                return self.replay.popleft()
+            limit = s._ring_next
+            if self.stop_seq is not None:
+                limit = min(limit, self.stop_seq)
+            if self.cursor >= limit:
+                return None
+            base = s._ring_next - len(s._ring)
+            if self.cursor < base:
+                # trimmed past us (stopped watch): nothing left to read
+                self.cursor = base
+                continue
+            ev = s._ring[self.cursor - base]
+            self.cursor += 1
+            if self._takes(ev):
+                return ev
+
+    def _next_event(self, block: bool = True, timeout: "float | None" = None):
+        """Next matching WatchEvent, ``_STOPPED`` when the stream ended,
+        or None on timeout/empty (non-blocking)."""
+        s = self.server
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with s._ring_lock:
+            while True:
+                ev = self._scan_locked()
+                if ev is not None:
+                    return WatchEvent(ev.type, ev.obj())
+                if self.stopped:
+                    return _STOPPED
+                if not block:
+                    return None
+                if deadline is None:
+                    s._ring_cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not s._ring_cond.wait(remaining):
+                        if deadline - time.monotonic() <= 0:
+                            return None
+
+    def _pending_count(self) -> int:
+        """Matching events between cursor and head (non-consuming)."""
+        s = self.server
+        with s._ring_lock:
+            n = len(self.replay)
+            base = s._ring_next - len(s._ring)
+            limit = s._ring_next
+            if self.stop_seq is not None:
+                limit = min(limit, self.stop_seq)
+            for seq in range(max(self.cursor, base), limit):
+                if self._takes(s._ring[seq - base]):
+                    n += 1
+            return n
+
+    def take_lines(self, timeout: "float | None" = None):
+        """HTTP stream writer: block for the next batch of matching
+        event LINES (shared bytes, one chunk each). Returns
+        ``(lines, state)`` where state is "ok", "stopped" (close the
+        stream) or "timeout" (deadline slice elapsed, nothing pending)."""
+        s = self.server
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with s._ring_lock:
+            while True:
+                # the deadline closes at the next event BOUNDARY past it,
+                # pending backlog or not (a flooding stream must not be
+                # able to outrun its own timeoutSeconds)
+                if deadline is not None and time.monotonic() >= deadline:
+                    return [], "timeout"
+                lines = []
+                take = 0
+                while take < 4 << 20:
+                    ev = self._scan_locked()
+                    if ev is None:
+                        break
+                    lines.append(ev.line)
+                    take += len(ev.line)
+                if lines:
+                    return lines, "ok"
+                if self.stopped:
+                    return [], "stopped"
+                if deadline is None:
+                    s._ring_cond.wait()
+                else:
+                    s._ring_cond.wait(
+                        max(0.0, deadline - time.monotonic())
+                    )
+
     def __iter__(self) -> Iterator[WatchEvent]:
         while True:
-            ev = self.q.get()
-            if ev is None:
+            ev = self._next_event()
+            if ev is _STOPPED:
                 return
             yield ev
 
     def stop(self) -> None:
-        self.stopped = True
-        self.q.put(None)
+        s = self.server
+        with s._ring_lock:
+            # route through the server's close so the per-kind live
+            # count drops (a leaked count would keep the ring encoding
+            # for kinds nobody watches and inflate fanout_total forever)
+            s._close_watch_locked(self)
+            s._ring_cond.notify_all()
+
+
+def _event_line(type_: str, data: bytes) -> bytes:
+    """The serialized watch event line, built from the object's cached
+    bytes — byte-identical to
+    ``json.dumps({"type": type_, "object": obj}, separators=(",", ":"))``
+    plus the newline, without re-serializing the object."""
+    return b'{"type":"' + type_.encode() + b'","object":' + data + b"}\n"
 
 
 # core/v1 kinds plus the rbac.authorization.k8s.io/v1 group served when the
@@ -195,20 +386,48 @@ KIND_SINGULAR = {
 }
 
 
-class FakeKube:
-    """kinds: "nodes"/"clusterroles"/"clusterrolebindings" (cluster-scoped),
-    "pods"/"roles"/"rolebindings" (namespaced)."""
+class _Shard:
+    """One (kind, namespace) store partition (ISSUE 13): its own RLock,
+    live objects and the per-object serialized-bytes cache. Writers to
+    different shards no longer serialize on one index; the global event
+    order (revision allocation + ring/history append) is the ONLY shared
+    critical section, taken under the store's ``_ring_lock`` while the
+    shard lock is held (a declared 87 → 88 descent, see
+    docs/static-analysis.md). Shard locks never nest with each other —
+    cross-shard reads (LIST/snapshot) visit shards sequentially and
+    reconcile through the undo log instead."""
+
+    __slots__ = ("_shard_lock", "objs", "json")
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
-        self._store: dict[str, dict[tuple[str, str], dict]] = {k: {} for k in KINDS}
-        # per-object serialized JSON, invalidated on mutation: list/get/patch
-        # responses are cache joins, so a 50k-pod LIST poll costs no
-        # deepcopies and only serializes objects that changed since last read
-        self._json: dict[str, dict[tuple[str, str], bytes]] = {k: {} for k in KINDS}
+        self._shard_lock = threading.RLock()
+        self.objs: dict[str, dict] = {}   # name -> live object
+        self.json: dict[str, bytes] = {}  # name -> serialized bytes
+
+
+class FakeKube:
+    """kinds: "nodes"/"clusterroles"/"clusterrolebindings" (cluster-scoped),
+    "pods"/"roles"/"rolebindings" (namespaced). Sharded by (kind,
+    namespace) with a serialize-once broadcast ring for watch fanout
+    (ISSUE 13); the C++ twin (native/apiserver.cc) mirrors the design."""
+
+    def __init__(self) -> None:
+        # shard registry: kind -> ns -> _Shard. The registry dict itself
+        # is swapped atomically on restore; _shard_idx_lock guards only
+        # shard creation and is never held with any other lock.
+        self._shard_idx_lock = threading.Lock()
+        self._shards: dict[str, dict[str, _Shard]] = {k: {} for k in KINDS}
+        # the ring/clock lock: revision allocation, watch cache (history),
+        # undo log, broadcast ring, watch registry, per-kind counts. The
+        # condition shares the lock so commit can notify watchers inline.
+        self._ring_lock = threading.RLock()
+        self._ring_cond = threading.Condition(self._ring_lock)
         self._rv = 0
         self._watches: list[_Watch] = []
-        # watch cache: recent (rv, kind, type, obj) for resumed watches;
+        #: live watch count per kind: events are encoded into the ring
+        #: only when someone could consume them
+        self._kind_watchers: dict[str, int] = {}
+        # watch cache: recent (rv, kind, type, bytes) for resumed watches;
         # everything at or below _compacted_rv has been compacted away
         # (resume -> 410 Gone, like etcd compaction under the real
         # apiserver)
@@ -217,21 +436,38 @@ class FakeKube:
         # BEFORE the event, same window as the watch cache. Lets a
         # paginated LIST serve continuation pages from a consistent
         # snapshot at the continue token's revision (what the real
-        # apiserver reads from etcd MVCC) by rolling the live view back.
+        # apiserver reads from etcd MVCC) by rolling the live view back —
+        # and, since the store sharded, lets EVERY list/snapshot roll its
+        # sequential per-shard walk back to one consistent revision.
         self._undo: collections.deque = collections.deque()
         self._compacted_rv = 0
+        # the serialize-once broadcast ring: each watch event is encoded
+        # exactly once into _ring; watchers hold cursors (absolute
+        # sequences; base = _ring_next - len(_ring)). Trimmed to the
+        # slowest live cursor, bounded by watch_backlog — a watcher whose
+        # cursor lag exceeds the cap is closed reason="slow".
+        self._ring: collections.deque = collections.deque()
+        self._ring_next = 0
+        self._ring_min = 0  # lazily-recomputed min live cursor estimate
+        #: kwok_watch_encode_total: ring appends — exactly one encode per
+        #: event, the serialize-once proof the parity twin reads
+        self.encode_total = 0
+        # per-kind object counts (kept under the ring lock so limit=1
+        # population polls read a count consistent with the list revision)
+        self._counts: dict[str, int] = {k: 0 for k in KINDS}
         # observability for tests
         self.patch_count = 0
         self.delete_count = 0
-        # bounded per-watcher send buffers (slow-consumer termination);
-        # instance attr so tests/parity twins can tighten it per store
+        # ring-cursor lag cap (PR 8's bounded-backlog semantics folded
+        # into the ring); instance attr so tests/parity twins can tighten
+        # it per store
         self.watch_backlog = WATCH_BACKLOG
         # kwok_watch_terminations_total{reason=}: ints bumped under the
-        # store lock (a registry child lock here would nest two level-85
-        # leaves); /metrics renders them via telemetry.apiserver_metrics
+        # ring lock (a registry child lock here would nest two leaves);
+        # /metrics renders them via telemetry.apiserver_metrics
         self.watch_terminations = {"slow": 0, "deadline": 0}
         # phase timing + flight recorder (ISSUE 11); clock stamps gated
-        # by KWOK_TPU_APISERVER_TIMING, counters (fanout pushes, backlog
+        # by KWOK_TPU_APISERVER_TIMING, counters (fanout pushes, lag
         # peak) always on — plain ints under the GIL like the rest
         self.timing = ApiserverTiming()
         # coordination.k8s.io/v1 leases (ISSUE 12): the leadership plane's
@@ -240,7 +476,10 @@ class FakeKube:
         # stamps, so expiry never re-parses a timestamp. Leases live
         # OUTSIDE the watch/snapshot machinery by design (no events, no
         # dump entry): leadership is polled, not watched, and a restored
-        # store must not resurrect an old holder.
+        # store must not resurrect an old holder. _lease_lock is held
+        # ACROSS a fenced write's commit (86 → 87 → 88) so a takeover
+        # PATCH can never interleave between fence check and commit.
+        self._lease_lock = threading.RLock()
         self._leases: dict[tuple[str, str], dict] = {}
 
     # -- helpers ------------------------------------------------------------
@@ -248,229 +487,338 @@ class FakeKube:
     def _key(self, namespace, name):
         return (namespace or "", name)
 
-    def _bump(self, obj: dict, kind: str | None = None, key=None) -> None:
-        self._rv += 1
-        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
-        if kind is not None:
-            self._json[kind].pop(key, None)
+    def _shard(self, kind: str, namespace, create: bool = True):
+        ns = namespace or ""
+        shards = self._shards  # local ref: restore swaps the registry
+        sh = shards[kind].get(ns)
+        if sh is None and create:
+            with self._shard_idx_lock:
+                sh = shards[kind].setdefault(ns, _Shard())
+        return sh
 
-    def _undo_push(self, kind: str, key, prev: bytes | None) -> None:
-        """Record a write's pre-state (caller holds the lock, called right
-        after _bump so self._rv is the event's revision). prev=None means
-        the key did not exist before the event."""
-        if RV_WINDOW <= 0:
-            return
-        self._undo.append((self._rv, kind, key, prev))
-        while self._undo and self._undo[0][0] <= self._compacted_rv:
-            self._undo.popleft()
+    def _kind_shards(self, kind: str):
+        """(ns, shard) pairs in namespace order — concatenating their
+        sorted names yields the kind's global (ns, name) key order."""
+        with self._shard_idx_lock:
+            return sorted(self._shards[kind].items())
 
-    def _obj_bytes(self, kind: str, key) -> bytes | None:
-        """Serialized form of a stored object (caller holds the lock)."""
-        b = self._json[kind].get(key)
+    def _shard_bytes_locked(self, sh: _Shard, name: str) -> bytes | None:
+        """Serialized form of a stored object (caller holds the shard
+        lock)."""
+        b = sh.json.get(name)
         if b is None:
-            obj = self._store[kind].get(key)
+            obj = sh.objs.get(name)
             if obj is None:
                 return None
             b = json.dumps(obj, separators=(",", ":")).encode()
-            self._json[kind][key] = b
+            sh.json[name] = b
         return b
+
+    def _commit_locked(
+        self, sh: "_Shard | None", kind: str, key, obj: dict, type_: str,
+        prev: "bytes | None", *, stamp_uid: bool = False,
+    ) -> bytes:
+        """The global event-order critical section (caller holds the
+        SHARD's lock, so same-key writes are totally ordered): allocate
+        the revision, serialize ONCE, record watch cache + undo, append
+        the broadcast ring, wake watchers. Returns the new bytes."""
+        timing = self.timing
+        with self._ring_lock:
+            self._rv += 1
+            meta = obj.setdefault("metadata", {})
+            if stamp_uid:
+                meta.setdefault("uid", f"uid-{self._rv}")
+            meta["resourceVersion"] = str(self._rv)
+            data = json.dumps(obj, separators=(",", ":")).encode()
+            if sh is not None and self._shards[kind].get(key[0]) is not sh:
+                # a restore swapped the registry while this write held
+                # its (now orphaned) shard: the registry swap happens
+                # under THIS lock, so the check is race-free. The client
+                # sees the same outcome the old atomic store gave —
+                # committed, then wiped by the restore — so answer with
+                # the serialized object but record NOTHING: no counts
+                # (the restore reset them), no watch-cache/undo entry
+                # (compacted), no ring event (watchers were closed) — a
+                # ghost event here would be exactly the silent
+                # divergence the drift auditor hunts.
+                return data
+            if sh is not None and type_ != DELETED:
+                sh.json[key[1]] = data
+            if RV_WINDOW > 0:
+                self._history.append((self._rv, kind, type_, data))
+                while len(self._history) > RV_WINDOW:
+                    self._compacted_rv = max(
+                        self._compacted_rv, self._history.popleft()[0]
+                    )
+                self._undo.append((self._rv, kind, key, prev))
+                while self._undo and self._undo[0][0] <= self._compacted_rv:
+                    self._undo.popleft()
+            if type_ == ADDED:
+                self._counts[kind] += 1
+            elif type_ == DELETED:
+                self._counts[kind] -= 1
+            # fanout (ISSUE 13): ONE encode + ring append per event no
+            # matter how many watchers consume it; the per-watcher
+            # filter/write cost moved to the watcher threads. The push
+            # counter counts deliveries-to-be (events x live watchers of
+            # the kind) so fanout_sum / fanout_total is the AMORTIZED
+            # per-watcher-push cost; both always on, clocks gated.
+            nw = self._kind_watchers.get(kind, 0)
+            if nw > 0:
+                t0 = time.perf_counter() if timing.enabled else None
+                self._ring.append(_RingEv(kind, type_, _event_line(type_, data)))
+                self._ring_next += 1
+                self.encode_total += 1
+                timing.fanout_pushes += nw
+                self._ring_trim_locked()
+                self._ring_cond.notify_all()
+                if t0 is not None:
+                    timing.note_fanout(time.perf_counter() - t0)
+            return data
+
+    def _ring_trim_locked(self) -> None:
+        """Trim consumed ring entries and enforce the lag cap (caller
+        holds the ring lock): entries every live watcher consumed are
+        dropped; once the ring outgrows ``watch_backlog`` the lagging
+        watchers (cursor more than the cap behind) are slow-closed and
+        their backlog reclaimed — PR 8's bounded-buffer drop/close
+        semantics as ring-cursor lag. The peak watermark records the
+        deepest retained lag, clamped to the cap on a termination, so
+        fleet-check's gate (peak <= cap) keeps its meaning."""
+        bl = self.watch_backlog
+        while self._ring:
+            base = self._ring_next - len(self._ring)
+            if self._ring_min <= base:
+                self._watches = [w for w in self._watches if not w.stopped]
+                self._ring_min = min(
+                    (w.cursor for w in self._watches),
+                    default=self._ring_next,
+                )
+            if self._ring_min > base:
+                self._ring.popleft()
+                continue
+            if bl > 0 and len(self._ring) > bl:
+                lagged = False
+                for w in self._watches:
+                    if not w.stopped and self._ring_next - w.cursor > bl:
+                        self._close_watch_locked(w, terminated="slow")
+                        lagged = True
+                self._ring_min = 0
+                if bl > self.timing.backlog_peak:
+                    self.timing.backlog_peak = bl
+                if not lagged:
+                    break  # safety: nobody to blame, stop trimming
+                continue
+            if len(self._ring) > self.timing.backlog_peak:
+                self.timing.backlog_peak = len(self._ring)
+            break
+
+    def _close_watch_locked(self, w: _Watch, terminated=None) -> None:
+        """Caller holds the ring lock. A slow termination DROPS the
+        backlog (cursor jumps to head — 410-class recovery); a graceful
+        stop still delivers events queued before the stop point — they
+        are moved into the watch's PRIVATE replay now, because the ring
+        trim stops retaining for stopped watches the moment this
+        returns (shared refs, bounded by the live ring size)."""
+        if w.stopped:
+            return
+        w.stopped = True
+        if terminated:
+            w.terminated = terminated
+            w.cursor = self._ring_next
+            w.stop_seq = w.cursor
+            self.watch_terminations[terminated] = (
+                self.watch_terminations.get(terminated, 0) + 1
+            )
+        else:
+            base = self._ring_next - len(self._ring)
+            for seq in range(max(w.cursor, base), self._ring_next):
+                ev = self._ring[seq - base]
+                if w._takes(ev):
+                    w.replay.append(ev)
+            w.cursor = self._ring_next
+            w.stop_seq = w.cursor
+        self._kind_watchers[w.kind] = self._kind_watchers.get(w.kind, 1) - 1
 
     def count_termination(self, reason: str) -> None:
         """Record a server-side watch close (slow-consumer overflow or
         timeoutSeconds expiry) for /metrics."""
-        with self._lock:
+        with self._ring_lock:
             self.watch_terminations[reason] = (
                 self.watch_terminations.get(reason, 0) + 1
             )
 
-    def _push(self, w: _Watch, ev: WatchEvent) -> None:
-        """Queue one event on a live watch, terminating the watch instead
-        when its bounded send buffer is full (caller holds the lock). The
-        backlog is dropped NOW — draining it into a stalled socket would
-        pin the very memory the cap bounds; the client re-lists/resumes,
-        the same recovery as a 410."""
-        bl = self.watch_backlog
-        depth = w.q.qsize()
-        # bounded-buffer high-watermark: the fleet gate's deterministic
-        # proof that no CAPPED push ever grew a send buffer past the cap.
-        # The terminate branch clamps its record to the cap: a resume
-        # replay is cap-exempt (bounded by RV_WINDOW) and may legally
-        # overfill a queue, so the raw depth here can exceed the cap
-        # without any enforcement failure — only the push branch below,
-        # which grows the queue, may ever record past the cap.
-        if bl > 0 and depth >= bl:
-            if min(depth, bl) > self.timing.backlog_peak:
-                self.timing.backlog_peak = min(depth, bl)
-            w.terminated = "slow"
-            w.stopped = True
-            self.watch_terminations["slow"] += 1
-            try:
-                while True:
-                    w.q.get_nowait()
-            except queue.Empty:
-                pass
-            w.q.put(None)
-            return
-        w.q.put(ev)
-        if depth + 1 > self.timing.backlog_peak:
-            self.timing.backlog_peak = depth + 1
-
-    def _emit(self, kind: str, type_: str, obj: dict, key=None) -> None:
-        if RV_WINDOW > 0:
-            # ring position is the store clock (self._rv); snapshots are
-            # the per-object serialized bytes — for live objects that cache
-            # entry is computed once and shared with every subsequent
-            # read, so recording history is amortized-free (deleted
-            # objects pay one dumps). Replay json.loads a fresh dict, so
-            # no defensive copies are needed anywhere on this path.
-            if key is not None and type_ != DELETED and key in self._store[kind]:
-                data = self._obj_bytes(kind, key)
-            else:
-                data = json.dumps(obj, separators=(",", ":")).encode()
-            self._history.append((self._rv, kind, type_, data))
-            while len(self._history) > RV_WINDOW:
-                self._compacted_rv = max(
-                    self._compacted_rv, self._history.popleft()[0]
-                )
-        # fanout phase (ISSUE 11): the per-watcher encode+push loop, the
-        # term ROADMAP item 1's serialize-once broadcast ring attacks.
-        # The push counter is always on (one int add); clocks are gated.
-        t0 = time.perf_counter() if self.timing.enabled else None
-        pushes = 0
-        for w in list(self._watches):
-            if w.stopped or w.kind != kind:
-                continue
-            if w._matches(obj):
-                self._push(w, WatchEvent(type_, copy.deepcopy(obj)))
-                pushes += 1
-        if pushes:
-            if t0 is not None:
-                self.timing.note_fanout(time.perf_counter() - t0, pushes)
-            else:
-                self.timing.fanout_pushes += pushes
-
     def watch_backlogs(self) -> list:
-        """Live per-watcher send-buffer depths (the /metrics backlog
-        gauges' scrape-time source)."""
-        with self._lock:
-            return [w.q.qsize() for w in self._watches if not w.stopped]
+        """Live per-watcher ring-cursor lags (resume replay stays
+        cap-exempt and uncounted); thin view over ring_stats()."""
+        return self.ring_stats()[0]
+
+    def ring_stats(self) -> tuple:
+        """(lags, lag_peak, encode_total) for /metrics — one consistent
+        ring-lock read."""
+        with self._ring_lock:
+            lags = [
+                self._ring_next - w.cursor
+                for w in self._watches if not w.stopped
+            ]
+            return lags, self.timing.backlog_peak, self.encode_total
 
     def compact(self) -> int:
         """Force watch-cache compaction NOW: any watch resuming from a
         revision BELOW the current one gets 410 Gone (resuming at exactly
         the compacted revision is still gap-free, matching etcd, whose
         compaction at X drops revisions below X), and continue tokens
-        below it expire. Returns the compacted revision. (Ops/test hook;
-        the real apiserver compacts every 5 minutes.)"""
-        with self._lock:
+        below it expire. Live watchers' undelivered ring events are NOT
+        dropped — compaction expires resumes, not broadcasts. Returns the
+        compacted revision. (Ops/test hook; the real apiserver compacts
+        every 5 minutes.)"""
+        with self._ring_lock:
             self._history.clear()
             self._undo.clear()
             self._compacted_rv = self._rv
             return self._compacted_rv
 
     def emit_bookmarks(self) -> int:
-        """Push one BOOKMARK event (current store revision) to every
-        opted-in live watch — the watch cache's periodic rv-advance for
-        quiet watchers. The bookmark object carries ONLY kind/apiVersion/
+        """Append one BOOKMARK ring event (current store revision) per
+        kind with opted-in live watches — the watch cache's periodic
+        rv-advance for quiet watchers, encoded once per kind no matter the
+        cohort size. The bookmark object carries ONLY kind/apiVersion/
         metadata.resourceVersion, like the real apiserver's. Called by the
         HTTP servers' interval timer (BOOKMARK_INTERVAL) and by tests
         directly. Returns how many watches were bookmarked."""
         sent = 0
-        with self._lock:
+        with self._ring_lock:
             rv = str(self._rv)
-            for w in list(self._watches):
+            kinds: dict[str, int] = {}
+            for w in self._watches:
                 if w.stopped or not w.bookmarks:
                     continue
+                kinds[w.kind] = kinds.get(w.kind, 0) + 1
+                sent += 1
+            for kind in kinds:
                 api = (
                     "rbac.authorization.k8s.io/v1"
-                    if w.kind in (
+                    if kind in (
                         "roles", "rolebindings",
                         "clusterroles", "clusterrolebindings",
                     )
                     else "v1"
                 )
-                self._push(w, WatchEvent(BOOKMARK, {
-                    "kind": KIND_SINGULAR.get(w.kind, "Object"),
+                data = json.dumps({
+                    "kind": KIND_SINGULAR.get(kind, "Object"),
                     "apiVersion": api,
                     "metadata": {"resourceVersion": rv},
-                }))
-                sent += 1
+                }, separators=(",", ":")).encode()
+                self._ring.append(
+                    _RingEv(kind, BOOKMARK, _event_line(BOOKMARK, data),
+                            bookmark=True)
+                )
+                self._ring_next += 1
+                self.encode_total += 1
+            if kinds:
+                self._ring_trim_locked()
+                self._ring_cond.notify_all()
         return sent
 
     # -- test-side API ------------------------------------------------------
 
-    def _create_locked(self, kind: str, obj: dict):
+    def _create_impl(self, kind: str, obj: dict) -> bytes:
         obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
-        if "name" not in meta and meta.get("generateName"):
-            # apiserver names.go semantics: generateName + 5-char random
-            # suffix (kube-scheduler POSTs events this way). The real
-            # apiserver 409s on a suffix collision and the client retries;
-            # retrying server-side is equivalent and can't silently
-            # overwrite an existing object.
-            import secrets
+        ns = meta.get("namespace")
+        sh = self._shard(kind, ns)
+        with sh._shard_lock:
+            if "name" not in meta and meta.get("generateName"):
+                # apiserver names.go semantics: generateName + 5-char
+                # random suffix (kube-scheduler POSTs events this way).
+                # The real apiserver 409s on a suffix collision and the
+                # client retries; retrying server-side is equivalent and
+                # can't silently overwrite an existing object. Resolved
+                # under the shard lock, so the name stays unique through
+                # the insert.
+                import secrets
 
-            while True:
-                name = meta["generateName"] + secrets.token_hex(3)[:5]
-                if self._key(meta.get("namespace"), name) not in self._store[kind]:
-                    break
-            meta["name"] = name
-        meta.setdefault("creationTimestamp", now_rfc3339())
-        meta.setdefault("uid", f"uid-{self._rv + 1}")
-        key = self._key(meta.get("namespace"), meta["name"])
-        if key in self._store[kind]:
-            # the real apiserver never overwrites on create (HTTP 409)
-            raise AlreadyExists(f'{kind} "{meta["name"]}" already exists')
-        self._bump(obj, kind, key)
-        self._undo_push(kind, key, None)
-        self._store[kind][key] = obj
-        self._emit(kind, ADDED, obj, key=key)
-        if (
-            kind == "events"
-            and EVENTS_CAP > 0
-            and len(self._store[kind]) > EVENTS_CAP
-        ):
-            # the real apiserver expires events on a ~1h etcd lease
-            # (re-leased on every write); an unbounded store would grow
-            # forever under a real scheduler's event stream and bloat every
-            # /snapshot. Evict the least-recently-written event (smallest
-            # resourceVersion — server-stamped on every mutation); never
-            # the just-created one, whose rv is the newest. Mirrors
-            # apiserver.cc. cap <= 0 means unbounded.
-            evs = self._store[kind]
-            old_key = min(
-                evs, key=lambda k: int(evs[k]["metadata"]["resourceVersion"])
+                while True:
+                    name = meta["generateName"] + secrets.token_hex(3)[:5]
+                    if name not in sh.objs:
+                        break
+                meta["name"] = name
+            name = meta["name"]
+            meta.setdefault("creationTimestamp", now_rfc3339())
+            if name in sh.objs:
+                # the real apiserver never overwrites on create (HTTP 409)
+                raise AlreadyExists(f'{kind} "{name}" already exists')
+            sh.objs[name] = obj
+            data = self._commit_locked(
+                sh, kind, self._key(ns, name), obj, ADDED, None,
+                stamp_uid=True,
             )
-            old_bytes = self._obj_bytes(kind, old_key)
-            old = evs.pop(old_key)
-            self._json[kind].pop(old_key, None)
-            # deletion is a write: bump like the explicit DELETE path, so
-            # the DELETED event gets its own revision (rv-resuming watchers
-            # would otherwise never see the eviction)
-            self._bump(old)
-            self._undo_push(kind, old_key, old_bytes)
-            self._emit(kind, DELETED, old, key=old_key)
-        return key
+        if kind == "events":
+            self._evict_events_overflow()
+        return data
+
+    def _evict_events_overflow(self) -> None:
+        """The real apiserver expires events on a ~1h etcd lease
+        (re-leased on every write); the mock bounds the store by count —
+        the least-recently-written event (smallest resourceVersion) is
+        evicted after an insert pushes past the cap. Runs OUTSIDE the
+        creating shard's critical section: the victim may live in another
+        namespace shard, and shard locks never nest. cap <= 0 means
+        unbounded. Mirrors apiserver.cc."""
+        if EVENTS_CAP <= 0:
+            return
+        while True:
+            with self._ring_lock:
+                if self._counts["events"] <= EVENTS_CAP:
+                    return
+            victim = None  # (rv, ns, name, shard)
+            for ns_, sh in self._kind_shards("events"):
+                with sh._shard_lock:
+                    for nm, o in sh.objs.items():
+                        try:
+                            r = int(
+                                (o.get("metadata") or {})
+                                .get("resourceVersion") or 0
+                            )
+                        except (TypeError, ValueError):
+                            r = 0
+                        if victim is None or r < victim[0]:
+                            victim = (r, ns_, nm, sh)
+            if victim is None:
+                return
+            _r, ns_, nm, sh = victim
+            with sh._shard_lock:
+                obj = sh.objs.pop(nm, None)
+                if obj is None:
+                    continue  # raced another eviction; re-check the cap
+                prev = sh.json.pop(nm, None) or json.dumps(
+                    obj, separators=(",", ":")
+                ).encode()
+                # deletion is a write: bump like the explicit DELETE
+                # path, so the DELETED event gets its own revision
+                # (rv-resuming watchers would otherwise never see it)
+                self._commit_locked(
+                    sh, "events", (ns_, nm), obj, DELETED, prev
+                )
 
     def create(self, kind: str, obj: dict) -> dict:
-        with self._lock:
-            key = self._create_locked(kind, obj)
-            return copy.deepcopy(self._store[kind][key])
+        return json.loads(self._create_impl(kind, obj))
 
     def create_bytes(self, kind: str, obj: dict) -> bytes:
-        """HTTP hot path: create + serialized response in one lock hold (no
+        """HTTP hot path: create + serialized response in one pass (no
         deepcopied return value)."""
-        with self._lock:
-            return self._obj_bytes(kind, self._create_locked(kind, obj))
+        return self._create_impl(kind, obj)
 
     def bind(self, namespace, name, node: str) -> dict | None:
         """POST pods/NAME/binding — the real scheduler's bind call: sets
         spec.nodeName exactly once. Raises BindConflict when spec.nodeName
         is already set — even to the same node, matching the real
         apiserver's BindingREST (any retry after a bind conflicts)."""
-        with self._lock:
-            key = self._key(namespace, name)
-            obj = self._store["pods"].get(key)
+        sh = self._shard("pods", namespace, create=False)
+        if sh is None:
+            return None
+        with sh._shard_lock:
+            obj = sh.objs.get(name)
             if obj is None:
                 return None
             spec = obj.setdefault("spec", {})
@@ -479,42 +827,35 @@ class FakeKube:
                 raise BindConflict(
                     f'pod {name} is already assigned to node {current}'
                 )
-            prev = self._obj_bytes("pods", key)
+            prev = self._shard_bytes_locked(sh, name)
             spec["nodeName"] = node
-            self._bump(obj, "pods", key)
-            self._undo_push("pods", key, prev)
-            self._emit("pods", MODIFIED, obj, key=key)
-            return copy.deepcopy(obj)
+            data = self._commit_locked(
+                sh, "pods", self._key(namespace, name), obj, MODIFIED, prev
+            )
+            return json.loads(data)
 
     def update(self, kind: str, obj: dict) -> dict:
-        with self._lock:
-            obj = copy.deepcopy(obj)
-            meta = obj.get("metadata") or {}
-            key = self._key(meta.get("namespace"), meta.get("name"))
-            if key not in self._store[kind]:
-                raise KeyError(key)
-            prev = self._obj_bytes(kind, key)
-            self._bump(obj, kind, key)
-            self._undo_push(kind, key, prev)
-            self._store[kind][key] = obj
-            self._emit(kind, MODIFIED, obj, key=key)
-            return copy.deepcopy(obj)
+        obj = copy.deepcopy(obj)
+        meta = obj.get("metadata") or {}
+        ns, name = meta.get("namespace"), meta.get("name")
+        sh = self._shard(kind, ns)
+        with sh._shard_lock:
+            if name not in sh.objs:
+                raise KeyError(self._key(ns, name))
+            prev = self._shard_bytes_locked(sh, name)
+            sh.objs[name] = obj
+            data = self._commit_locked(
+                sh, kind, self._key(ns, name), obj, MODIFIED, prev
+            )
+            return json.loads(data)
 
     # -- KubeClient protocol ------------------------------------------------
 
     def list(self, kind, *, field_selector=None, label_selector=None):
-        sel = parse_selector(label_selector)
-        with self._lock:
-            out = []
-            for obj in self._store[kind].values():
-                if not match_field_selector(obj, field_selector):
-                    continue
-                if sel is not None:
-                    labels = (obj.get("metadata") or {}).get("labels") or {}
-                    if not sel.matches(labels):
-                        continue
-                out.append(copy.deepcopy(obj))
-            return out
+        return json.loads(self.list_bytes(
+            kind, field_selector=field_selector,
+            label_selector=label_selector,
+        ))["items"]
 
     def list_bytes(
         self,
@@ -536,100 +877,138 @@ class FakeKube:
         paginating expires it (raises WatchExpired -> HTTP 410, the real
         apiserver's "continue token too old" contract).
 
-        Continuation pages serve a CONSISTENT SNAPSHOT at the token's
-        revision (what the real apiserver reads from etcd MVCC): the live
-        view is rolled back through the undo log, so an object created
-        mid-pagination is excluded no matter where its key sorts, one
-        deleted mid-pagination still appears, and every page reports the
-        first page's resourceVersion. With the watch cache disabled
-        (RV_WINDOW <= 0) there is no undo log and continuation pages fall
-        back to the live view."""
+        EVERY page — first or continuation — serves a CONSISTENT SNAPSHOT
+        at one revision (what the real apiserver reads from etcd MVCC):
+        the sharded store is walked shard by shard (shard locks never
+        nest) and the per-shard snapshots are rolled back through the
+        undo log to the list revision, so an object created mid-pagination
+        (or mid-walk, by a concurrent writer on another shard) is excluded
+        no matter where its key sorts, one deleted mid-walk still appears,
+        and every page reports the first page's resourceVersion. With the
+        watch cache disabled (RV_WINDOW <= 0) there is no undo log and the
+        walk serves the live view."""
         sel = parse_selector(label_selector)
-        with self._lock:
-            live = self._store[kind]
-            list_rv = self._rv
-            overlay: dict = {}
-            if continue_:
-                # opaque url-safe token (the real apiserver's continue is
-                # base64 too): rv \0 ns \0 name
-                try:
-                    tok_rv, _, rest = (
-                        base64.urlsafe_b64decode(continue_.encode())
-                        .decode()
-                        .partition("\x00")
-                    )
-                    rv_val = int(tok_rv)
-                except (ValueError, UnicodeDecodeError,
-                        binascii.Error) as e:
-                    raise MalformedContinue(str(e)) from e
-                if rv_val < 0:
-                    raise MalformedContinue(f"negative revision {rv_val}")
-                ns, _, name = rest.partition("\x00")
-                if rv_val < self._compacted_rv:
-                    raise WatchExpired(
-                        f"continue token revision {tok_rv} has been compacted"
-                    )
-                list_rv = rv_val  # consistency marker of page 1
-                last = (ns, name)
-                # roll the live view back to the token's revision:
+        last = None
+        snap: dict = {}
+        overlay: dict = {}
+        for _attempt in range(4):
+            with self._ring_lock:
+                if continue_:
+                    # opaque url-safe token (the real apiserver's continue
+                    # is base64 too): rv \0 ns \0 name
+                    try:
+                        tok_rv, _, rest = (
+                            base64.urlsafe_b64decode(continue_.encode())
+                            .decode()
+                            .partition("\x00")
+                        )
+                        rv_val = int(tok_rv)
+                    except (ValueError, UnicodeDecodeError,
+                            binascii.Error) as e:
+                        raise MalformedContinue(str(e)) from e
+                    if rv_val < 0:
+                        raise MalformedContinue(f"negative revision {rv_val}")
+                    ns, _, name = rest.partition("\x00")
+                    if rv_val < self._compacted_rv:
+                        raise WatchExpired(
+                            f"continue token revision {tok_rv} has been "
+                            f"compacted"
+                        )
+                    list_rv = rv_val  # consistency marker of page 1
+                    last = (ns, name)
+                else:
+                    list_rv = self._rv
+            # sequential per-shard snapshots: bytes are immutable, each
+            # shard internally consistent; cross-shard skew is reconciled
+            # by the rollback below. Selector matching happens HERE on
+            # the live dicts (as the old single-lock walk did) so a
+            # selector LIST never json.loads the whole kind — only
+            # overlay-sourced entries are parsed, in the emit loop.
+            need_obj = field_selector is not None or sel is not None
+            snap.clear()
+            for ns_, sh in self._kind_shards(kind):
+                with sh._shard_lock:
+                    for nm, obj in sh.objs.items():
+                        if need_obj:
+                            if not match_field_selector(
+                                obj, field_selector
+                            ):
+                                continue
+                            if sel is not None:
+                                labels = (
+                                    obj.get("metadata") or {}
+                                ).get("labels") or {}
+                                if not sel.matches(labels):
+                                    continue
+                        snap[(ns_, nm)] = self._shard_bytes_locked(sh, nm)
+            with self._ring_lock:
+                if RV_WINDOW > 0 and list_rv < self._compacted_rv:
+                    if continue_:
+                        raise WatchExpired(
+                            f"continue token revision {list_rv} has been "
+                            f"compacted"
+                        )
+                    if _attempt < 3:
+                        snap.clear()
+                        continue  # compaction raced the walk: retry fresh
+                    # repeated compactions mid-walk (ops hammering
+                    # /compact): serve the live walk rather than loop
+                    overlay.clear()
+                    break
+                # roll the walk back to the list revision:
                 # newest-to-oldest, so a key's final overlay value is the
-                # prev of its EARLIEST post-token event = its state at
-                # the token revision (None = absent then)
+                # prev of its EARLIEST post-revision event = its state at
+                # the list revision (None = absent then)
+                overlay: dict = {}
                 for rv_u, k_u, key_u, prev in reversed(self._undo):
-                    if rv_u <= rv_val:
+                    if rv_u <= list_rv:
                         break
                     if k_u == kind:
                         overlay[key_u] = prev
-                view = set(live.keys())
-                for k_, prev in overlay.items():
-                    if prev is None:
-                        view.discard(k_)
-                    else:
-                        view.add(k_)
-                keys = sorted(k_ for k_ in view if k_ > last)
+            break
+        from_overlay: set = set()
+        for k_, prev in overlay.items():
+            if prev is None:
+                snap.pop(k_, None)
+                from_overlay.discard(k_)
             else:
-                keys = sorted(live.keys())
+                snap[k_] = prev
+                from_overlay.add(k_)
+        keys = sorted(snap)
+        if last is not None:
+            keys = [k_ for k_ in keys if k_ > last]
 
-            def view_obj(key):
-                prev = overlay.get(key)
-                if prev is not None:
-                    return json.loads(prev)
-                return live[key]
-
-            def view_bytes(key):
-                prev = overlay.get(key)
-                if prev is not None:
-                    return prev
-                return self._obj_bytes(kind, key)
-
-            chunks: list[bytes] = []
-            token = ""
-            remaining = 0
-            # only the FIRST page scans past the cut (remainingItemCount
-            # for limit=1 count pollers) — counting on every continuation
-            # page would make a full paginated re-list quadratic
-            count_rest = not continue_
-            for pos, key in enumerate(keys):
-                if limit and len(chunks) >= limit and not count_rest:
-                    break
-                obj = view_obj(key)
+        chunks: list[bytes] = []
+        token = ""
+        remaining = 0
+        # only the FIRST page scans past the cut (remainingItemCount
+        # for limit=1 count pollers) — counting on every continuation
+        # page would make a full paginated re-list quadratic
+        count_rest = not continue_
+        for pos, key in enumerate(keys):
+            if limit and len(chunks) >= limit and not count_rest:
+                break
+            if need_obj and key in from_overlay:
+                # rolled-back state replaced the (pre-matched) live one:
+                # only these few entries ever pay a parse
+                obj = json.loads(snap[key])
                 if not match_field_selector(obj, field_selector):
                     continue
                 if sel is not None:
                     labels = (obj.get("metadata") or {}).get("labels") or {}
                     if not sel.matches(labels):
                         continue
-                if limit and len(chunks) >= limit:
-                    remaining += 1
-                    continue
-                chunks.append(view_bytes(key))
-                if limit and len(chunks) >= limit and pos + 1 < len(keys):
-                    token = base64.urlsafe_b64encode(
-                        f"{list_rv}\x00{key[0]}\x00{key[1]}".encode()
-                    ).decode()
-            # every page of one paginated list reports page 1's revision
-            # (the real apiserver's paged LIST contract)
-            rv = str(list_rv)
+            if limit and len(chunks) >= limit:
+                remaining += 1
+                continue
+            chunks.append(snap[key])
+            if limit and len(chunks) >= limit and pos + 1 < len(keys):
+                token = base64.urlsafe_b64encode(
+                    f"{list_rv}\x00{key[0]}\x00{key[1]}".encode()
+                ).decode()
+        # every page of one paginated list reports page 1's revision
+        # (the real apiserver's paged LIST contract)
+        rv = str(list_rv)
         meta = f'{{"resourceVersion":"{rv}"'.encode()
         if token and (remaining if count_rest else True):
             meta += b',"continue":' + json.dumps(token).encode()
@@ -642,8 +1021,11 @@ class FakeKube:
         )
 
     def get_bytes(self, kind, namespace, name) -> bytes | None:
-        with self._lock:
-            return self._obj_bytes(kind, self._key(namespace, name))
+        sh = self._shard(kind, namespace, create=False)
+        if sh is None:
+            return None
+        with sh._shard_lock:
+            return self._shard_bytes_locked(sh, name)
 
     def watch(
         self,
@@ -672,7 +1054,7 @@ class FakeKube:
             # (400), it does not claim they expired; the C++ mirror's
             # digit check does the same
             raise ValueError(f"invalid resourceVersion: {rv}")
-        with self._lock:
+        with self._ring_lock:
             if rv:
                 if rv > self._rv:
                     raise TooLargeResourceVersion(rv, self._rv)
@@ -683,137 +1065,178 @@ class FakeKube:
                         continue
                     hobj = json.loads(hdata)  # fresh dict: no copy needed
                     if w._matches(hobj):
-                        w.q.put(WatchEvent(htype, hobj))
+                        # cap-exempt resume replay (bounded by RV_WINDOW)
+                        w.replay.append(
+                            _RingEv(kind, htype, _event_line(htype, hdata))
+                        )
+            # cursor starts at the ring head, atomically with the replay
+            # collection: nothing between the cache gap and going live
+            w.cursor = self._ring_next
             self._watches.append(w)
+            self._kind_watchers[kind] = self._kind_watchers.get(kind, 0) + 1
         return w
 
     def get(self, kind, namespace, name):
-        with self._lock:
-            obj = self._store[kind].get(self._key(namespace, name))
-            return copy.deepcopy(obj) if obj else None
-
-    def _patch_status_locked(self, kind, key, patch):
-        obj = self._store[kind].get(key)
-        if obj is None:
-            return None
-        prev = self._obj_bytes(kind, key)
-        status = obj.get("status") or {}
-        obj["status"] = strategic_merge(status, patch.get("status", patch))
-        self._bump(obj, kind, key)
-        self._undo_push(kind, key, prev)
-        self.patch_count += 1
-        self._emit(kind, MODIFIED, obj, key=key)
-        return obj
+        b = self.get_bytes(kind, namespace, name)
+        return json.loads(b) if b is not None else None
 
     def patch_status(self, kind, namespace, name, patch):
-        if isinstance(patch, (bytes, bytearray, memoryview)):
-            patch = json.loads(bytes(patch))
-        with self._lock:
-            obj = self._patch_status_locked(kind, self._key(namespace, name), patch)
-            return copy.deepcopy(obj) if obj is not None else None
+        # explicit class call: subclasses (the rig's OplogStore) override
+        # BOTH verbs to note their oplog — virtual dispatch here would
+        # note one client patch twice
+        b = FakeKube.patch_status_bytes(self, kind, namespace, name, patch)
+        return json.loads(b) if b is not None else None
 
     def patch_status_bytes(self, kind, namespace, name, patch) -> bytes | None:
-        """HTTP hot path: patch + serialized response in one lock hold."""
+        """HTTP hot path: patch + serialized response in one shard-lock
+        hold."""
         if isinstance(patch, (bytes, bytearray, memoryview)):
             patch = json.loads(bytes(patch))
-        with self._lock:
-            key = self._key(namespace, name)
-            obj = self._patch_status_locked(kind, key, patch)
-            return None if obj is None else self._obj_bytes(kind, key)
+        sh = self._shard(kind, namespace, create=False)
+        if sh is None:
+            return None
+        with sh._shard_lock:
+            obj = sh.objs.get(name)
+            if obj is None:
+                return None
+            prev = self._shard_bytes_locked(sh, name)
+            status = obj.get("status") or {}
+            obj["status"] = strategic_merge(status, patch.get("status", patch))
+            self.patch_count += 1
+            return self._commit_locked(
+                sh, kind, self._key(namespace, name), obj, MODIFIED, prev
+            )
 
     def patch_meta(self, kind, namespace, name, patch):
         """Merge-patch metadata (and spec — covers the scheduler's pod
         binding, which the soak rig's binder issues as a spec.nodeName
         patch; real schedulers use POST .../binding to the same effect)."""
-        with self._lock:
-            obj = self._patch_meta_locked(kind, self._key(namespace, name), patch)
-            return copy.deepcopy(obj) if obj is not None else None
+        b = self.patch_meta_bytes(kind, namespace, name, patch)
+        return json.loads(b) if b is not None else None
 
     def patch_meta_bytes(self, kind, namespace, name, patch) -> bytes | None:
-        """HTTP hot path: patch + serialized response in one lock hold, so
-        the response is exactly the object this patch produced."""
-        with self._lock:
-            key = self._key(namespace, name)
-            obj = self._patch_meta_locked(kind, key, patch)
-            return None if obj is None else self._obj_bytes(kind, key)
-
-    def _patch_meta_locked(self, kind, key, patch):
-        obj = self._store[kind].get(key)
-        if obj is None:
+        """HTTP hot path: patch + serialized response in one shard-lock
+        hold, so the response is exactly the object this patch produced."""
+        sh = self._shard(kind, namespace, create=False)
+        if sh is None:
             return None
-        prev = self._obj_bytes(kind, key)
-        for section in ("metadata", "spec"):
-            sec_patch = (patch or {}).get(section)
-            if not sec_patch:
-                continue
-            sec = obj.setdefault(section, {})
-            for k, v in sec_patch.items():
-                if v is None:
-                    sec.pop(k, None)
-                else:
-                    sec[k] = copy.deepcopy(v)
-        self._bump(obj, kind, key)
-        self._undo_push(kind, key, prev)
-        self._emit(kind, MODIFIED, obj, key=key)
-        return obj
+        with sh._shard_lock:
+            obj = sh.objs.get(name)
+            if obj is None:
+                return None
+            prev = self._shard_bytes_locked(sh, name)
+            for section in ("metadata", "spec"):
+                sec_patch = (patch or {}).get(section)
+                if not sec_patch:
+                    continue
+                sec = obj.setdefault(section, {})
+                for k, v in sec_patch.items():
+                    if v is None:
+                        sec.pop(k, None)
+                    else:
+                        sec[k] = copy.deepcopy(v)
+            return self._commit_locked(
+                sh, kind, self._key(namespace, name), obj, MODIFIED, prev
+            )
 
     def dump(self) -> dict:
         """Serializable snapshot of the whole store — the mock's 'etcd
-        snapshot' (cluster state IS store state, SURVEY.md section 3.5)."""
-        with self._lock:
-            return {
-                "resourceVersion": self._rv,
-                "objects": {
-                    kind: copy.deepcopy(list(objs.values()))
-                    for kind, objs in self._store.items()
-                },
-            }
+        snapshot' (cluster state IS store state, SURVEY.md section 3.5).
+        Sharded-store walk, rolled back through the undo log to ONE
+        revision across every kind; objects are ordered by (namespace,
+        name), matching the C++ twin's sorted maps (parity-pinned by the
+        snapshot-ordering twin)."""
+        for _attempt in range(4):
+            with self._ring_lock:
+                rv_start = self._rv
+            per_kind: dict[str, dict] = {}
+            for kind in KINDS:
+                snap: dict = {}
+                for ns_, sh in self._kind_shards(kind):
+                    with sh._shard_lock:
+                        for nm in sh.objs:
+                            snap[(ns_, nm)] = self._shard_bytes_locked(
+                                sh, nm
+                            )
+                per_kind[kind] = snap
+            with self._ring_lock:
+                if RV_WINDOW > 0 and rv_start < self._compacted_rv \
+                        and _attempt < 3:
+                    continue  # compaction raced the walk: retry
+                for rv_u, k_u, key_u, prev in reversed(self._undo):
+                    if rv_u <= rv_start:
+                        break
+                    if prev is None:
+                        per_kind[k_u].pop(key_u, None)
+                    else:
+                        per_kind[k_u][key_u] = prev
+            break
+        return {
+            "resourceVersion": rv_start,
+            "objects": {
+                kind: [json.loads(snap[k_]) for k_ in sorted(snap)]
+                for kind, snap in per_kind.items()
+            },
+        }
 
     def load(self, data: dict) -> None:
-        """Replace the store from a dump(). All open watches are closed so
-        clients re-list, like watchers reconnecting after an etcd restore."""
-        with self._lock:
-            self._store = {k: {} for k in KINDS}
-            self._json = {k: {} for k in KINDS}
-            for kind, objs in (data.get("objects") or {}).items():
-                if kind not in self._store:
-                    continue
-                for obj in objs:
-                    meta = obj.get("metadata") or {}
-                    key = self._key(meta.get("namespace"), meta.get("name"))
-                    self._store[kind][key] = copy.deepcopy(obj)
+        """Replace the store from a dump(). The fresh shard registry is
+        built OFF-lock and swapped in atomically (readers holding an old
+        shard see the pre-restore world, never a torn one); all open
+        watches are closed so clients re-list, like watchers reconnecting
+        after an etcd restore."""
+        new_shards: dict[str, dict[str, _Shard]] = {k: {} for k in KINDS}
+        counts = {k: 0 for k in KINDS}
+        for kind, objs in (data.get("objects") or {}).items():
+            if kind not in new_shards:
+                continue
+            for obj in objs:
+                meta = obj.get("metadata") or {}
+                ns = meta.get("namespace") or ""
+                sh = new_shards[kind].setdefault(ns, _Shard())
+                sh.objs[meta.get("name")] = copy.deepcopy(obj)
+                counts[kind] += 1
+        with self._ring_lock:
+            self._shards = new_shards
+            self._counts = counts
             self._rv = max(self._rv, int(data.get("resourceVersion") or 0)) + 1
             # history predates the restore: compact so resumed watches and
             # continue tokens from the old world get 410 and re-list
             self._history.clear()
             self._undo.clear()
             self._compacted_rv = self._rv
-            watches, self._watches = self._watches, []
-        for w in watches:
-            w.stop()
+            for w in self._watches:
+                self._close_watch_locked(w)
+            self._watches = []
+            self._ring.clear()
+            self._ring_min = self._ring_next
+            self._ring_cond.notify_all()
 
     def stop_watches(self) -> None:
         """Close every open watch stream (apiserver shutdown semantics):
-        list swapped out under the lock, then each stopped — the same
-        pattern load() uses, so a concurrently-registering watch either
-        lands before the swap (and is stopped) or after (and belongs to
-        whatever serves the store next)."""
-        with self._lock:
-            watches, self._watches = self._watches, []
-        for w in watches:
-            try:
-                w.stop()
-            except Exception:
-                # shutdown race with a client tearing the stream down
-                swallowed("mockserver.watch_stop")
+        closed under the ring lock (pure flag flips — no I/O), so a
+        concurrently-registering watch either lands before the sweep (and
+        is stopped) or after (and belongs to whatever serves the store
+        next)."""
+        with self._ring_lock:
+            for w in self._watches:
+                try:
+                    self._close_watch_locked(w)
+                except Exception:
+                    # shutdown race with a client tearing the stream down
+                    swallowed("mockserver.watch_stop")
+            self._watches = []
+            self._ring_cond.notify_all()
 
     def delete(self, kind, namespace, name, grace_seconds: int | None = 0):
         """grace_seconds=None applies the server default: for pods,
         spec.terminationGracePeriodSeconds or 30 (real apiserver
         DeleteOptions semantics); other kinds delete immediately."""
-        with self._lock:
-            key = self._key(namespace, name)
-            obj = self._store[kind].get(key)
+        sh = self._shard(kind, namespace, create=False)
+        if sh is None:
+            return
+        with sh._shard_lock:
+            obj = sh.objs.get(name)
             if obj is None:
                 return
             if grace_seconds is None:
@@ -823,7 +1246,7 @@ class FakeKube:
                         "terminationGracePeriodSeconds"
                     )
                     grace_seconds = int(tgps) if tgps is not None else 30
-            prev = self._obj_bytes(kind, key)
+            prev = self._shard_bytes_locked(sh, name)
             meta = obj.setdefault("metadata", {})
             finalizers = meta.get("finalizers") or []
             if kind == "pods" and (grace_seconds > 0 or finalizers):
@@ -832,16 +1255,17 @@ class FakeKube:
                 if "deletionTimestamp" not in meta:
                     meta["deletionTimestamp"] = now_rfc3339()
                 meta["deletionGracePeriodSeconds"] = grace_seconds
-                self._bump(obj, kind, key)
-                self._undo_push(kind, key, prev)
-                self._emit(kind, MODIFIED, obj, key=key)
+                self._commit_locked(
+                    sh, kind, self._key(namespace, name), obj, MODIFIED,
+                    prev,
+                )
                 return
-            del self._store[kind][key]
-            self._json[kind].pop(key, None)
+            del sh.objs[name]
+            sh.json.pop(name, None)
             self.delete_count += 1
-            self._bump(obj)
-            self._undo_push(kind, key, prev)
-            self._emit(kind, DELETED, obj, key=key)
+            self._commit_locked(
+                sh, kind, self._key(namespace, name), obj, DELETED, prev
+            )
 
     # -- coordination.k8s.io/v1 leases (ISSUE 12) ---------------------------
     #
@@ -919,7 +1343,7 @@ class FakeKube:
         at 0, like the real object on first acquisition). An existing
         lease answers 409 AlreadyExists exactly like any other create."""
         holder, duration = self._lease_spec(spec or {})
-        with self._lock:
+        with self._lease_lock:
             key = (ns or "", name)
             if key in self._leases:
                 return 409, json.dumps({
@@ -930,7 +1354,9 @@ class FakeKube:
                 }, separators=(",", ":")).encode()
             now = time.time()
             stamp = now_rfc3339()
-            self._rv += 1
+            with self._ring_lock:  # lease writes share the store clock
+                self._rv += 1
+                rv = self._rv
             lease = {
                 "holder": holder,
                 "duration": duration,
@@ -938,8 +1364,8 @@ class FakeKube:
                 "renew": now,
                 "transitions": 0,
                 "created": stamp,
-                "uid": f"uid-{self._rv}",
-                "rv": self._rv,
+                "uid": f"uid-{rv}",
+                "rv": rv,
                 "acquire_str": stamp,
                 "renew_str": stamp,
             }
@@ -947,7 +1373,7 @@ class FakeKube:
             return 201, self._lease_render(ns, name, lease)
 
     def lease_get(self, ns: str, name: str) -> tuple[int, bytes]:
-        with self._lock:
+        with self._lease_lock:
             lease = self._leases.get((ns or "", name))
             if lease is None:
                 return 404, b'{"kind":"Status","code":404}'
@@ -965,7 +1391,7 @@ class FakeKube:
           flips, acquireTime/renewTime restamp, leaseTransitions += 1.
         """
         holder, duration = self._lease_spec(spec or {})
-        with self._lock:
+        with self._lease_lock:
             key = (ns or "", name)
             lease = self._leases.get(key)
             if lease is None:
@@ -993,16 +1419,19 @@ class FakeKube:
             lease["renew_str"] = stamp
             if duration > 0:
                 lease["duration"] = duration
-            self._rv += 1
-            lease["rv"] = self._rv
+            with self._ring_lock:  # lease writes share the store clock
+                self._rv += 1
+                lease["rv"] = self._rv
             return 200, self._lease_render(ns, name, lease)
 
     def lease_held(self, ns: str, name: str, holder: str) -> bool:
         """The fencing check (FENCING_HEADER): is this lease currently
         held by this identity and unexpired, on the server's clock? One
-        dict lookup under the store lock — only writes that CARRY the
-        header ever pay it."""
-        with self._lock:
+        dict lookup under the lease lock — only writes that CARRY the
+        header ever pay it. The HTTP facade holds _lease_lock ACROSS the
+        fenced commit (re-entrant here), so a takeover PATCH serializes
+        against the whole check+commit, not just this lookup."""
+        with self._lease_lock:
             lease = self._leases.get((ns or "", name))
             if lease is None or lease["holder"] != holder:
                 return False
@@ -1865,7 +2294,11 @@ class HttpFakeApiserver:
                 name, sep2, holder = rest.partition("/")
                 if not sep2:
                     name = holder = ""
-                with store._lock:
+                # _lease_lock held across check AND commit (86 -> shard
+                # 87 -> ring 88): the takeover PATCH serializes on the
+                # same lease lock, so an already-validated deposed write
+                # can never commit after the handover
+                with store._lease_lock:
                     if not (
                         name and holder
                         and store.lease_held(ns, name, holder)
@@ -1902,13 +2335,12 @@ class HttpFakeApiserver:
                     # /healthz): inflight per band, 429 rejections, watch
                     # terminations — scraped by the watcher-fleet gate
                     adm = server_obj._admission
+                    lags, _peak, encodes = store.ring_stats()
                     body = render_apiserver_metrics(
                         adm.inflight if adm else {},
                         adm.rejected if adm else {},
                         store.watch_terminations,
-                    ) + render_timing_metrics(
-                        timing, store.watch_backlogs()
-                    )
+                    ) + render_timing_metrics(timing, lags, encodes)
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
@@ -2080,18 +2512,13 @@ class HttpFakeApiserver:
                 )
                 try:
                     while True:
-                        if deadline is None:
-                            ev = w.q.get()
-                        else:
-                            remaining = deadline - time.monotonic()
-                            if remaining <= 0:
-                                ev = False  # sentinel: deadline expired
-                            else:
-                                try:
-                                    ev = w.q.get(timeout=remaining)
-                                except queue.Empty:
-                                    ev = False
-                        if ev is False:
+                        slice_s = None
+                        if deadline is not None:
+                            slice_s = deadline - time.monotonic()
+                            if slice_s <= 0:
+                                slice_s = 0.0
+                        lines, state = w.take_lines(timeout=slice_s)
+                        if state == "timeout":
                             # timeoutSeconds expiry: the real apiserver
                             # ENDS the watch cleanly (terminal chunk) at
                             # an event boundary; the client resumes from
@@ -2100,19 +2527,23 @@ class HttpFakeApiserver:
                             self.wfile.write(b"0\r\n\r\n")
                             self.wfile.flush()
                             break
-                        if ev is None:
+                        # the whole pending batch leaves in one buffered
+                        # write+flush (the ring already paid the one
+                        # encode; the lines are shared bytes)
+                        for line in lines:
+                            self.wfile.write(
+                                b"%x\r\n%s\r\n" % (len(line), line)
+                            )
+                        if lines:
+                            self.wfile.flush()
+                        if state == "stopped":
                             # stream stopped server-side. A slow-consumer
-                            # termination closes the connection abruptly
-                            # (no terminal chunk — the backlog is already
-                            # dropped; the client re-lists, 410-class
-                            # recovery), same as shutdown/restore closes.
+                            # (ring-lag) termination closes the connection
+                            # abruptly (no terminal chunk — the backlog is
+                            # already dropped; the client re-lists,
+                            # 410-class recovery), same as shutdown/
+                            # restore closes.
                             break
-                        line = json.dumps(
-                            {"type": ev.type, "object": ev.object},
-                            separators=(",", ":"),
-                        ).encode() + b"\n"
-                        self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
-                        self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 finally:

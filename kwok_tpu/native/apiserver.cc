@@ -967,65 +967,35 @@ static std::string flight_dump_json() {
   return out;
 }
 
+// A watch is a CURSOR into the store's serialize-once broadcast ring
+// (ISSUE 13): the store encodes each event exactly once into the shared
+// ring; every watch stream thread reads forward from its own cursor and
+// filters on its own time (kind / selectors / bookmark opt-in), so the
+// per-watcher encode+push loop left the commit path entirely. A watch
+// whose cursor falls more than watch_backlog() events behind the ring
+// head is closed terminated_slow — PR 8's bounded-backlog drop/close
+// semantics folded into ring-cursor lag. All fields below `replay` are
+// guarded by the store's ring/clock mutex (Store::mu).
 struct Watch {
   int kind;  // 0 nodes, 1 pods
   std::string field_sel;
   LabelSel label_sel;
-  std::mutex mu;
-  std::condition_variable cv;
-  std::deque<std::shared_ptr<const std::string>> q;
-  bool closed = false;
   // opted into periodic BOOKMARK events (allowWatchBookmarks=true)
   bool bookmarks = false;
-  // set when the server closed this watch because the consumer stopped
-  // reading (the writer distinguishes it from a shutdown close)
+  // resume replay (watch-cache gap): exempt from the lag cap — the gap
+  // is bounded by rv_window() already, and capping it would terminate
+  // every resume whose gap exceeds the backlog (a loop). Filled before
+  // the watch is registered, so no reader races it.
+  std::vector<std::shared_ptr<const std::string>> replay;
+  // guarded by Store::mu from here on
+  uint64_t cursor = 0;  // next ring sequence this stream will read
+  // a graceful close still delivers events sequenced before the stop
+  // point; a slow termination drops the backlog (cursor jumps to head)
+  uint64_t stop_seq = UINT64_MAX;
+  bool closed = false;
+  // set when the server closed this watch because its ring-cursor lag
+  // exceeded the cap (the writer distinguishes it from a shutdown close)
   bool terminated_slow = false;
-
-  void push(std::shared_ptr<const std::string> ev) {
-    {
-      std::lock_guard<std::mutex> lk(mu);
-      if (closed) return;
-      long cap = watch_backlog();
-      long depth = (long)q.size();
-      if (cap > 0 && depth >= cap) {
-        // client must re-list; drop the backlog NOW — draining it into a
-        // stalled socket would pin the very memory this cap bounds. The
-        // peak watermark is clamped to the cap here: a cap-exempt resume
-        // replay (push_replay, bounded by rv_window) may legally
-        // overfill a queue, so only the GROWING push below may ever
-        // record past the cap — which is exactly the enforcement-failure
-        // signal the fleet gate reads.
-        peak_update(std::min(depth, cap));
-        closed = true;
-        terminated_slow = true;
-        g_watch_term_slow.fetch_add(1);
-        q.clear();
-      } else {
-        q.push_back(std::move(ev));
-        peak_update(depth + 1);
-      }
-    }
-    cv.notify_one();
-  }
-  // resume replay (watch-cache gap): exempt from the backlog cap — the
-  // gap is bounded by rv_window() already, and capping it would
-  // terminate every resume whose gap exceeds the backlog (a loop).
-  // Called before the watch is registered, so no reader races it.
-  void push_replay(std::shared_ptr<const std::string> ev) {
-    {
-      std::lock_guard<std::mutex> lk(mu);
-      if (closed) return;
-      q.push_back(std::move(ev));
-    }
-    cv.notify_one();
-  }
-  void close() {
-    {
-      std::lock_guard<std::mutex> lk(mu);
-      closed = true;
-    }
-    cv.notify_all();
-  }
 };
 
 // core/v1 kinds plus rbac.authorization.k8s.io/v1 (served with bootstrap
@@ -1210,12 +1180,53 @@ static bool lease_expired(const LeaseRec& L, double now) {
   return now >= L.renew + (double)(L.duration > 0 ? L.duration : 0);
 }
 
+// One (kind, namespace) store partition (ISSUE 13): its own mutex + map,
+// so concurrent writers to different shards stop serializing on one
+// index. Shard mutexes never nest with each other; the only nesting is
+// shard -> Store::mu (the ring/clock lock) inside a commit. Cross-shard
+// reads (LIST/snapshot) walk shards sequentially and reconcile through
+// the undo log.
+struct Shard {
+  std::mutex smu;
+  std::map<std::string, EntryPtr> objs;  // name -> published entry
+};
+using ShardPtr = std::shared_ptr<Shard>;
+
+// One broadcast-ring entry: the event line is encoded exactly once and
+// shared by every watcher whose cursor passes it; `e` is kept for the
+// watcher-side selector match (immutable entry, no copies).
+struct RingEv {
+  int kind;
+  bool bookmark;
+  EntryPtr e;  // null for bookmarks
+  std::shared_ptr<const std::string> line;
+};
+
 struct Store {
+  // clock lock: revision allocation, watch cache (history), undo log,
+  // per-kind counts + phase index. Acquired UNDER a shard's smu inside
+  // commits (shard -> mu), never the other way around.
   std::mutex mu;
-  std::map<Key, EntryPtr> kinds[NKINDS];
-  std::map<Key, LeaseRec> leases;  // coordination.k8s.io/v1 (ISSUE 12)
+  // broadcast-ring lock: the ring itself, the watch registry and every
+  // cursor. Acquired UNDER mu inside commits (shard -> mu -> ring_mu)
+  // and ALONE by watcher threads — so a thousand watchers draining the
+  // ring never contend with the clock lock a commit is serializing on.
+  std::mutex ring_mu;
+  std::condition_variable ring_cv;  // paired with ring_mu
+  // shard registry (ns -> shard per kind); shards_mu guards creation
+  // only and is never held together with any other lock
+  std::mutex shards_mu;
+  std::map<std::string, ShardPtr> shards[NKINDS];
+  // coordination.k8s.io/v1 (ISSUE 12): leases + fencing live under their
+  // own lease_mu, held ACROSS a fenced write's whole mutation (lease ->
+  // shard -> mu) so a takeover PATCH can never interleave between the
+  // fence check and the commit (the PR 12 contract, sharded edition)
+  std::mutex lease_mu;
+  std::map<Key, LeaseRec> leases;
   int64_t rv = 0;
+  // watch registry + live count per kind: under ring_mu
   std::vector<std::shared_ptr<Watch>> watches;
+  long kind_watchers[NKINDS] = {};
   // everything at or below compacted_rv is gone from history: resumes
   // below it answer 410, expired continue tokens too
   std::deque<Hist> history;
@@ -1224,8 +1235,34 @@ struct Store {
   // incremental status.phase counts per kind: lets a limit=1 progress
   // poll (fieldSelector=status.phase=X) report remainingItemCount without
   // the O(store) post-cut scan — at 50k pods a rig polling every 200 ms
-  // was a measurable apiserver CPU term
+  // was a measurable apiserver CPU term. Kept under mu (with rv) so the
+  // count a LIST reads is consistent with its list revision.
   std::map<std::string, long> phase_idx[NKINDS];
+  long obj_count[NKINDS] = {};  // per-kind population, under mu
+  // the serialize-once broadcast ring (under ring_mu): base =
+  // ring_next - ring.size(); trimmed to the slowest live cursor,
+  // bounded by watch_backlog()
+  std::deque<RingEv> ring;
+  uint64_t ring_next = 0;
+  uint64_t ring_min = 0;  // lazily-recomputed min live cursor estimate
+  long encode_total = 0;  // kwok_watch_encode_total: one per ring append
+
+  ShardPtr shard_of(int kind, const std::string& ns, bool create = true) {
+    std::lock_guard<std::mutex> lk(shards_mu);
+    auto it = shards[kind].find(ns);
+    if (it != shards[kind].end()) return it->second;
+    if (!create) return nullptr;
+    auto sh = std::make_shared<Shard>();
+    shards[kind][ns] = sh;
+    return sh;
+  }
+
+  // (ns, shard) pairs in namespace order — concatenating their sorted
+  // names yields the kind's global (ns, name) key order
+  std::vector<std::pair<std::string, ShardPtr>> kind_shards(int kind) {
+    std::lock_guard<std::mutex> lk(shards_mu);
+    return {shards[kind].begin(), shards[kind].end()};
+  }
 
   // caller holds mu; from/to are the entry leaving/entering the store
   void idx_adjust(int kind, const EntryPtr& from, const EntryPtr& to) {
@@ -1238,22 +1275,95 @@ struct Store {
     if (to) phase_idx[kind][field_str(to->obj, "status.phase")]++;
   }
 
-  // caller holds mu
-  void bump(JVal& obj) {
-    rv++;
-    obj.get_or_insert_obj("metadata")
-        .set("resourceVersion", JVal::str(std::to_string(rv)));
+  // caller holds ring_mu: close one watch (graceful or slow). A slow
+  // termination drops the backlog (cursor jumps to head — 410-class
+  // recovery); a graceful stop still delivers events queued before the
+  // stop point. Wake-ups are the caller's job (ring_cv.notify_all after
+  // the mu hold, or batched per commit).
+  void close_watch_locked(const std::shared_ptr<Watch>& w, bool slow) {
+    if (w->closed) return;
+    w->closed = true;
+    kind_watchers[w->kind]--;
+    if (slow) {
+      w->terminated_slow = true;
+      w->cursor = ring_next;
+      w->stop_seq = w->cursor;
+      g_watch_term_slow.fetch_add(1);
+    } else {
+      w->stop_seq = ring_next;
+    }
   }
 
-  // caller holds mu; records the event in the watch cache + undo log,
-  // then fans out to matching live watches (the entry's published bytes
-  // serialize the event line once). `prev` is the key's entry BEFORE
-  // this event (nullptr for creates). `fanout_us` (when timing is on)
-  // accumulates the per-watcher encode+push loop into the request's
-  // fanout phase — the term the serialize-once broadcast ring attacks.
-  void emit(int kind, const char* type, const EntryPtr& e, const Key& key,
-            EntryPtr prev, double* fanout_us = nullptr) {
-    idx_adjust(kind, prev, strcmp(type, "DELETED") == 0 ? nullptr : e);
+  // caller holds ring_mu: trim consumed ring entries and enforce the cap.
+  // Entries every live watcher consumed are dropped; once the ring
+  // outgrows watch_backlog() the lagging watchers (cursor more than the
+  // cap behind) are slow-closed and their backlog reclaimed. The peak
+  // watermark records the deepest retained lag, clamped to the cap on a
+  // termination, so fleet-check's gate (peak <= cap) keeps its meaning.
+  void ring_trim_locked() {
+    long cap = watch_backlog();
+    while (!ring.empty()) {
+      uint64_t base = ring_next - ring.size();
+      if (ring_min <= base) {
+        uint64_t m = ring_next;
+        for (const auto& w : watches)
+          if (!w->closed && w->cursor < m) m = w->cursor;
+        ring_min = m;
+      }
+      if (ring_min > base) {
+        ring.pop_front();
+        continue;
+      }
+      if (cap > 0 && (long)ring.size() > cap) {
+        bool lagged = false;
+        for (const auto& w : watches)
+          if (!w->closed && (long)(ring_next - w->cursor) > cap) {
+            close_watch_locked(w, /*slow=*/true);
+            lagged = true;
+          }
+        ring_min = 0;
+        peak_update(cap);
+        if (!lagged) break;  // safety: nobody to blame, stop trimming
+        continue;
+      }
+      peak_update((long)ring.size());
+      break;
+    }
+  }
+
+  // caller holds the owning shard's smu (same-key writes stay totally
+  // ordered) AND mu: allocate the revision, stamp it, serialize ONCE,
+  // record watch cache + undo + counts, append the broadcast ring.
+  // Returns the published entry; the caller installs it in the shard
+  // map (or erased it already, for DELETED). `fanout_us` (timing on)
+  // accumulates the one encode+append — the serialize-once cost the
+  // old per-watcher loop paid per watcher.
+  EntryPtr commit_locked(int kind, const char* type, JVal obj,
+                         const Key& key, EntryPtr prev, double* fanout_us,
+                         const Shard* owner, bool stamp_uid = false) {
+    rv++;
+    JVal& meta = obj.get_or_insert_obj("metadata");
+    if (stamp_uid && !meta.find("uid"))
+      meta.set("uid", JVal::str("uid-" + std::to_string(rv)));
+    meta.set("resourceVersion", JVal::str(std::to_string(rv)));
+    EntryPtr e = publish(std::move(obj));
+    if (owner) {
+      // a restore may have swapped the shard registry while this write
+      // held its (now orphaned) shard. The client sees what the old
+      // one-lock store gave — committed, then wiped by the restore —
+      // so answer with the published entry but record NOTHING: no
+      // counts (the restore reset them), no watch-cache/undo entry
+      // (compacted), no ring event (watchers were closed); a ghost
+      // event here is the silent divergence the drift auditor hunts.
+      std::lock_guard<std::mutex> sg(shards_mu);
+      auto sit = shards[kind].find(key.first);
+      if (sit == shards[kind].end() || sit->second.get() != owner)
+        return e;
+    }
+    bool deleted = strcmp(type, "DELETED") == 0;
+    idx_adjust(kind, prev, deleted ? nullptr : e);
+    if (!prev && !deleted) obj_count[kind]++;
+    if (deleted) obj_count[kind]--;
     if (rv_window() > 0) {
       history.push_back({rv, kind, type, e});
       undo.push_back({rv, kind, key, std::move(prev)});
@@ -1264,28 +1374,27 @@ struct Store {
       while (!undo.empty() && undo.front().rv <= compacted_rv)
         undo.pop_front();
     }
-    bool any = false;
-    for (const auto& w : watches)
-      if (w->kind == kind) {
-        any = true;
-        break;
+    {
+      // fanout (ISSUE 13): ONE encode + ring append per event no matter
+      // how many watchers consume it. The push counter counts the
+      // deliveries the shared bytes fan out to (events x live watchers
+      // of the kind), so fanout_sum / fanout_total is the AMORTIZED
+      // per-watcher cost; always on, clocks gated. ring_mu nests under
+      // mu here (shard -> mu -> ring_mu) and is the ONLY lock watcher
+      // threads ever take — their drains never stall the clock lock.
+      uint64_t f0 = fanout_us ? now_ns() : 0;
+      std::lock_guard<std::mutex> rl(ring_mu);
+      if (kind_watchers[kind] > 0) {
+        ring.push_back({kind, false, e, event_line(type, e)});
+        ring_next++;
+        encode_total++;
+        g_fanout_pushes.fetch_add(kind_watchers[kind],
+                                  std::memory_order_relaxed);
+        ring_trim_locked();
+        if (fanout_us) *fanout_us += (double)(now_ns() - f0) / 1000.0;
       }
-    if (!any) return;
-    uint64_t f0 = fanout_us ? now_ns() : 0;
-    int pushes = 0;
-    std::shared_ptr<const std::string> line;
-    for (const auto& w : watches) {
-      if (w->kind != kind) continue;
-      if (!match_field_selector(e->obj, w->field_sel)) continue;
-      if (!w->label_sel.matches(e->obj)) continue;
-      if (!line) line = event_line(type, e);
-      w->push(line);
-      pushes++;
     }
-    if (pushes) {
-      g_fanout_pushes.fetch_add(pushes, std::memory_order_relaxed);
-      if (fanout_us) *fanout_us += (double)(now_ns() - f0) / 1000.0;
-    }
+    return e;
   }
 
   static std::shared_ptr<const std::string> event_line(const char* type,
@@ -1298,8 +1407,9 @@ struct Store {
     return std::make_shared<const std::string>(std::move(ev));
   }
 
-  // One BOOKMARK event (current store revision) to every opted-in live
-  // watch — the watch cache's periodic rv-advance for quiet watchers.
+  // One BOOKMARK ring event (current store revision) per kind with
+  // opted-in live watches — the watch cache's periodic rv-advance for
+  // quiet watchers, encoded once per kind no matter the cohort size.
   // Object carries ONLY kind/apiVersion/metadata.resourceVersion, like
   // the real apiserver's (mirrors mockserver.py emit_bookmarks).
   int emit_bookmarks() {
@@ -1309,23 +1419,32 @@ struct Store {
         "ClusterRole", "ClusterRoleBinding",     "Event",
     };
     int sent = 0;
-    std::lock_guard<std::mutex> lk(mu);
-    std::string rvs = std::to_string(rv);
-    std::shared_ptr<const std::string> lines[NKINDS];
-    for (const auto& w : watches) {
-      if (!w->bookmarks) continue;
-      if (!lines[w->kind]) {
-        bool rbac = w->kind >= 2 && w->kind <= 5;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      std::string rvs = std::to_string(rv);
+      std::lock_guard<std::mutex> rl(ring_mu);
+      long opted[NKINDS] = {};
+      for (const auto& w : watches) {
+        if (w->closed || !w->bookmarks) continue;
+        opted[w->kind]++;
+        sent++;
+      }
+      for (int k = 0; k < NKINDS; k++) {
+        if (!opted[k]) continue;
+        bool rbac = k >= 2 && k <= 5;
         std::string ev = "{\"type\":\"BOOKMARK\",\"object\":{\"kind\":\"";
-        ev += OBJ_KINDS[w->kind];
+        ev += OBJ_KINDS[k];
         ev += rbac ? "\",\"apiVersion\":\"rbac.authorization.k8s.io/v1\""
                    : "\",\"apiVersion\":\"v1\"";
         ev += ",\"metadata\":{\"resourceVersion\":\"" + rvs + "\"}}}\n";
-        lines[w->kind] = std::make_shared<const std::string>(std::move(ev));
+        ring.push_back({k, true, nullptr,
+                        std::make_shared<const std::string>(std::move(ev))});
+        ring_next++;
+        encode_total++;
       }
-      w->push(lines[w->kind]);
-      sent++;
+      if (sent) ring_trim_locked();
     }
+    if (sent) ring_cv.notify_all();
     return sent;
   }
 
@@ -1404,25 +1523,9 @@ struct ConnIO {
   }
 };
 
-// Reads one HTTP/1.1 request from the connection's pipelined buffer.
-static bool read_request(ConnIO& io, Request& req) {
-  // read_headers starts at the request's FIRST bytes (buffered for a
-  // pipelined request, or the first fill otherwise) — keep-alive idle
-  // time between requests is never attributed to the phase
-  bool timed = timing_enabled();
-  req.t_start = req.t_hdr = req.t_body = 0;
-  if (timed && io.off < io.in.size()) req.t_start = now_ns();
-  size_t hdr_end;
-  while ((hdr_end = io.in.find("\r\n\r\n", io.off)) == std::string::npos) {
-    if (io.off) {  // compact the consumed prefix before growing
-      io.in.erase(0, io.off);
-      io.off = 0;
-    }
-    if (io.in.size() > (32u << 20)) return false;
-    if (!io.fill()) return false;
-    if (timed && !req.t_start) req.t_start = now_ns();
-  }
-  std::string head = io.in.substr(io.off, hdr_end - io.off);
+// Parses one request's head block (request line + headers) into req;
+// shared by the blocking reader and the batch collector's buffered peek.
+static bool parse_request_head(const std::string& head, Request& req) {
   size_t line_end = head.find("\r\n");
   std::string line = head.substr(0, line_end);
   size_t sp1 = line.find(' ');
@@ -1438,7 +1541,7 @@ static bool read_request(ConnIO& io, Request& req) {
   req.close = false;
   req.auth.clear();
   req.lease_holder.clear();
-  size_t pos = line_end + 2;
+  size_t pos = line_end == std::string::npos ? head.size() : line_end + 2;
   while (pos < head.size()) {
     size_t e = head.find("\r\n", pos);
     if (e == std::string::npos) e = head.size();
@@ -1460,10 +1563,57 @@ static bool read_request(ConnIO& io, Request& req) {
   req.content_len = content_len;
   req.body.clear();
   req.body_read = false;
+  return true;
+}
+
+// Reads one HTTP/1.1 request from the connection's pipelined buffer.
+static bool read_request(ConnIO& io, Request& req) {
+  // read_headers starts at the request's FIRST bytes (buffered for a
+  // pipelined request, or the first fill otherwise) — keep-alive idle
+  // time between requests is never attributed to the phase
+  bool timed = timing_enabled();
+  req.t_start = req.t_hdr = req.t_body = 0;
+  if (timed && io.off < io.in.size()) req.t_start = now_ns();
+  size_t hdr_end;
+  while ((hdr_end = io.in.find("\r\n\r\n", io.off)) == std::string::npos) {
+    if (io.off) {  // compact the consumed prefix before growing
+      io.in.erase(0, io.off);
+      io.off = 0;
+    }
+    if (io.in.size() > (32u << 20)) return false;
+    if (!io.fill()) return false;
+    if (timed && !req.t_start) req.t_start = now_ns();
+  }
+  std::string head = io.in.substr(io.off, hdr_end - io.off);
+  if (!parse_request_head(head, req)) return false;
   io.off = hdr_end + 4;  // body bytes are consumed by read_body
   if (req.t_start) req.t_hdr = now_ns();
   return true;
 }
+
+// The batch collector's peek: parses the NEXT pipelined request ONLY
+// when its head block AND body are already fully buffered — never a
+// socket read, so collecting a batch can't stall behind a slow sender.
+// Consumes the request from the buffer on success (headers + body).
+static bool peek_buffered_request(ConnIO& io, Request& req) {
+  size_t hdr_end = io.in.find("\r\n\r\n", io.off);
+  if (hdr_end == std::string::npos) return false;
+  Request tmp;
+  tmp.t_start = tmp.t_hdr = tmp.t_body = 0;
+  bool timed = timing_enabled();
+  if (timed) tmp.t_start = now_ns();
+  if (!parse_request_head(io.in.substr(io.off, hdr_end - io.off), tmp))
+    return false;  // the blocking reader will hit the same bytes and close
+  size_t total = hdr_end + 4 + tmp.content_len;
+  if (io.in.size() < total) return false;
+  tmp.body = io.in.substr(hdr_end + 4, tmp.content_len);
+  tmp.body_read = true;
+  if (tmp.t_start) tmp.t_hdr = tmp.t_body = now_ns();
+  io.off = total;
+  req = std::move(tmp);
+  return true;
+}
+
 
 // Completes a request by reading its body off the pipeline (must be
 // called exactly once per read_request before the next read_request, or
@@ -1630,6 +1780,21 @@ static PathMatch match_path(const std::string& path) {
   return m;
 }
 
+// A request the batched write transaction may absorb: a plain create /
+// bind / patch / delete on a resource path. Fenced writes (the HA
+// plane's X-Kwok-Lease-Holder) stay on the unary path, which holds
+// lease_mu across its whole mutation; Connection: close and every
+// read/stream/ops shape also stay unary.
+static bool batchable_write(const Request& req) {
+  if (req.close || !req.lease_holder.empty()) return false;
+  PathMatch m = match_path(req.path);
+  if (!m.ok || m.log) return false;
+  if (req.method == "POST") return m.name.empty() ? !m.status : m.binding;
+  if (req.method == "PATCH" || req.method == "DELETE")
+    return !m.name.empty() && !m.binding;
+  return false;
+}
+
 // Discovery documents served by GET on these exact paths; byte-content
 // mirrors mockserver.py DISCOVERY (json.dumps compact) — parity-tested.
 static const std::pair<const char*, const char*> DISCOVERY_DOCS[] = {
@@ -1683,6 +1848,8 @@ struct App {
   void audit_line(const std::string& method, const std::string& uri, int code);
   void handle_conn(int fd);
   bool handle_request(ConnIO& io, Request& req);
+  size_t exec_write_batch(ConnIO& io, std::vector<Request>& batch);
+  void evict_events(double* fanout_us);
   std::string metrics_text();
   std::string snapshot_dump();
   void restore_load(const JVal& data);
@@ -1795,8 +1962,8 @@ std::string App::metrics_text() {
       "# HELP kwok_apiserver_request_phase_seconds Per-request phase "
       "seconds inside the mock apiserver (read_headers+read_body+parse+"
       "commit+encode reconcile to the request total; fanout is the "
-      "per-watcher encode+push subset of commit and is excluded from the "
-      "sum)\n# TYPE kwok_apiserver_request_phase_seconds histogram\n";
+      "serialize-once ring encode+append subset of commit and is excluded "
+      "from the sum)\n# TYPE kwok_apiserver_request_phase_seconds histogram\n";
   for (int p = 0; p < N_PHASES; p++)
     hist_lines("kwok_apiserver_request_phase_seconds", "phase",
                PHASE_NAMES[p], g_phase_hist[p]);
@@ -1809,35 +1976,36 @@ std::string App::metrics_text() {
     hist_lines("kwok_apiserver_request_seconds", "verb", VERB_NAMES[v],
                g_verb_hist[v]);
   out +=
-      "# HELP kwok_watch_fanout_total Watch events pushed to individual "
-      "watchers (one increment per matching watcher per event; "
-      "fanout_sum over this count is the per-watcher encode+push cost)\n"
+      "# HELP kwok_watch_fanout_total Watch events delivered to "
+      "individual watchers via the broadcast ring (events x live "
+      "watchers of the kind at emit; fanout_sum over this count is the "
+      "AMORTIZED per-watcher encode cost \xe2\x80\x94 the ring encodes once and "
+      "shares the bytes)\n"
       "# TYPE kwok_watch_fanout_total counter\n";
   out += "kwok_watch_fanout_total " +
          std::to_string(g_fanout_pushes.load()) + "\n";
-  long n_watch = 0, bmax = 0, btotal = 0;
+  long n_watch = 0, bmax = 0, btotal = 0, encodes = 0;
   {
-    std::lock_guard<std::mutex> lk(store.mu);
+    std::lock_guard<std::mutex> lk(store.ring_mu);
     for (const auto& w : store.watches) {
-      long d;
-      {
-        std::lock_guard<std::mutex> wl(w->mu);
-        d = (long)w->q.size();
-      }
+      if (w->closed) continue;
+      long d = (long)(store.ring_next - w->cursor);
       n_watch++;
       btotal += d;
       if (d > bmax) bmax = d;
     }
+    encodes = store.encode_total;
   }
   out +=
       "# HELP kwok_apiserver_watchers Live watch streams currently "
       "registered\n# TYPE kwok_apiserver_watchers gauge\n";
   out += "kwok_apiserver_watchers " + std::to_string(n_watch) + "\n";
   out +=
-      "# HELP kwok_watch_backlog_events Per-watcher send-buffer depth "
+      "# HELP kwok_watch_backlog_events Per-watcher ring-cursor lag "
       "across live watches (agg=max/total) and the high-watermark of "
-      "any capped push (agg=peak; never exceeds KWOK_TPU_WATCH_BACKLOG "
-      "while the slow-consumer cap enforces)\n"
+      "retained lag (agg=peak; never exceeds KWOK_TPU_WATCH_BACKLOG "
+      "while the slow-consumer cap enforces \xe2\x80\x94 the bounded-buffer "
+      "proof, now measured as ring lag)\n"
       "# TYPE kwok_watch_backlog_events gauge\n";
   out += "kwok_watch_backlog_events{agg=\"max\"} " +
          std::to_string(bmax) + "\n";
@@ -1845,22 +2013,64 @@ std::string App::metrics_text() {
          std::to_string(btotal) + "\n";
   out += "kwok_watch_backlog_events{agg=\"peak\"} " +
          std::to_string(g_backlog_peak.load()) + "\n";
+  out +=
+      "# HELP kwok_watch_ring_lag Ring-cursor lag behind the "
+      "serialize-once broadcast ring head per live watch stream "
+      "(agg=max/total) and its all-time high-watermark (agg=peak, "
+      "clamped to the backlog cap on a slow-close; identical to "
+      "kwok_watch_backlog_events by construction \xe2\x80\x94 the explicit "
+      "ring-surface name)\n"
+      "# TYPE kwok_watch_ring_lag gauge\n";
+  out += "kwok_watch_ring_lag{agg=\"max\"} " + std::to_string(bmax) + "\n";
+  out += "kwok_watch_ring_lag{agg=\"total\"} " +
+         std::to_string(btotal) + "\n";
+  out += "kwok_watch_ring_lag{agg=\"peak\"} " +
+         std::to_string(g_backlog_peak.load()) + "\n";
+  out +=
+      "# HELP kwok_watch_encode_total Watch events encoded into the "
+      "broadcast ring \xe2\x80\x94 exactly ONE encode per event no matter the "
+      "watcher count (the serialize-once proof; "
+      "kwok_watch_fanout_total counts the deliveries the shared bytes "
+      "fan out to)\n"
+      "# TYPE kwok_watch_encode_total counter\n";
+  out += "kwok_watch_encode_total " + std::to_string(encodes) + "\n";
   return out;
 }
 
 std::string App::snapshot_dump() {
-  std::vector<EntryPtr> snap[NKINDS];
-  int64_t rv;
-  {
-    std::lock_guard<std::mutex> lk(store.mu);
-    rv = store.rv;
-    for (int k = 0; k < NKINDS; k++) {
-      snap[k].reserve(store.kinds[k].size());
-      for (auto& kv : store.kinds[k]) snap[k].push_back(kv.second);
+  // Sharded walk, rolled back through the undo log to ONE revision
+  // across every kind (the mock's consistent etcd snapshot); objects are
+  // ordered by (namespace, name) — the maps' natural order, pinned by
+  // the snapshot-ordering parity twin.
+  std::map<Key, EntryPtr> snap[NKINDS];
+  int64_t rv_start = 0;
+  for (int attempt = 0; attempt < 4; attempt++) {
+    {
+      std::lock_guard<std::mutex> lk(store.mu);
+      rv_start = store.rv;
     }
+    for (int k = 0; k < NKINDS; k++) {
+      snap[k].clear();
+      for (auto& ns_sh : store.kind_shards(k)) {
+        std::lock_guard<std::mutex> sl(ns_sh.second->smu);
+        for (auto& kv : ns_sh.second->objs)
+          snap[k][Key{ns_sh.first, kv.first}] = kv.second;
+      }
+    }
+    std::lock_guard<std::mutex> lk(store.mu);
+    if (rv_window() > 0 && rv_start < store.compacted_rv && attempt < 3)
+      continue;  // compaction raced the walk: retry
+    for (auto u = store.undo.rbegin(); u != store.undo.rend(); ++u) {
+      if (u->rv <= rv_start) break;
+      if (u->prev)
+        snap[u->kind][u->key] = u->prev;
+      else
+        snap[u->kind].erase(u->key);
+    }
+    break;
   }
   std::string out = "{\"resourceVersion\":";
-  out += std::to_string(rv);
+  out += std::to_string(rv_start);
   out += ",\"objects\":{";
   for (int k = 0; k < NKINDS; k++) {
     if (k) out += ',';
@@ -1868,10 +2078,10 @@ std::string App::snapshot_dump() {
     out += KIND_NAMES[k];
     out += "\":[";
     bool first = true;
-    for (auto& e : snap[k]) {
+    for (auto& kv : snap[k]) {
       if (!first) out += ',';
       first = false;
-      out += e->bytes;
+      out += kv.second->bytes;
     }
     out += ']';
   }
@@ -1880,26 +2090,38 @@ std::string App::snapshot_dump() {
 }
 
 void App::restore_load(const JVal& data) {
-  std::vector<std::shared_ptr<Watch>> old;
+  // Build the fresh shard registry OFF-lock, swap it in, then compact
+  // and close watches: a reader holding an old shard sees the
+  // pre-restore world, never a torn one.
+  std::map<std::string, ShardPtr> fresh[NKINDS];
+  long counts[NKINDS] = {};
+  std::map<std::string, long> phases[NKINDS];
+  const JVal* objects = data.find("objects");
+  if (objects && objects->type == JVal::OBJ) {
+    for (int k = 0; k < NKINDS; k++) {
+      const JVal* list = objects->find(KIND_NAMES[k]);
+      if (!list || list->type != JVal::ARR) continue;
+      for (const JVal& obj : list->arr) {
+        Key key = Store::obj_key(obj);
+        if (key.second.empty()) continue;
+        auto& sh = fresh[k][key.first];
+        if (!sh) sh = std::make_shared<Shard>();
+        EntryPtr e = publish(obj);
+        if (!sh->objs.count(key.second)) counts[k]++;
+        phases[k][field_str(e->obj, "status.phase")]++;
+        sh->objs[key.second] = e;
+      }
+    }
+  }
+  {
+    std::lock_guard<std::mutex> sl(store.shards_mu);
+    for (int k = 0; k < NKINDS; k++) store.shards[k].swap(fresh[k]);
+  }
   {
     std::lock_guard<std::mutex> lk(store.mu);
     for (int k = 0; k < NKINDS; k++) {
-      store.kinds[k].clear();
-      store.phase_idx[k].clear();
-    }
-    const JVal* objects = data.find("objects");
-    if (objects && objects->type == JVal::OBJ) {
-      for (int k = 0; k < NKINDS; k++) {
-        const JVal* list = objects->find(KIND_NAMES[k]);
-        if (!list || list->type != JVal::ARR) continue;
-        for (const JVal& obj : list->arr) {
-          Key key = Store::obj_key(obj);
-          if (key.second.empty()) continue;
-          EntryPtr e = publish(obj);
-          store.idx_adjust(k, store.kinds[k][key], e);
-          store.kinds[k][key] = e;
-        }
-      }
+      store.phase_idx[k] = std::move(phases[k]);
+      store.obj_count[k] = counts[k];
     }
     int64_t rv = 0;
     const JVal* rvv = data.find("resourceVersion");
@@ -1910,9 +2132,13 @@ void App::restore_load(const JVal& data) {
     store.history.clear();
     store.undo.clear();
     store.compacted_rv = store.rv;
-    old.swap(store.watches);
+    std::lock_guard<std::mutex> rl(store.ring_mu);
+    for (auto& w : store.watches) store.close_watch_locked(w, false);
+    store.watches.clear();
+    store.ring.clear();
+    store.ring_min = store.ring_next;
   }
-  for (auto& w : old) w->close();
+  store.ring_cv.notify_all();
 }
 
 // Bootstrap RBAC policy for --authorization: a representative subset of
@@ -1968,23 +2194,88 @@ void App::seed_rbac() {
   JParser p(text);
   JVal data = p.parse();
   if (!p.ok) return;
-  std::lock_guard<std::mutex> lk(store.mu);
   for (const auto& kv : data.obj) {
     int k = kind_index(kv.first);
     if (k < 0 || kv.second.type != JVal::ARR) continue;
     for (const JVal& tmpl : kv.second.arr) {
       Key key = Store::obj_key(tmpl);
-      if (key.second.empty() || store.kinds[k].count(key)) continue;
+      if (key.second.empty()) continue;
+      ShardPtr sh = store.shard_of(k, key.first);
+      std::lock_guard<std::mutex> sl(sh->smu);
+      if (sh->objs.count(key.second)) continue;
       JVal obj = tmpl;  // idempotent create-if-absent (data-file restarts)
       JVal& meta = obj.get_or_insert_obj("metadata");
       meta.set("creationTimestamp", JVal::str(now_rfc3339()));
-      meta.set("uid", JVal::str("uid-" + std::to_string(store.rv + 1)));
-      store.bump(obj);
-      EntryPtr e = publish(std::move(obj));
-      store.idx_adjust(k, nullptr, e);
-      store.kinds[k][key] = e;
-      // no emit: seeding happens before the listener accepts watchers
+      // seeding happens before the listener accepts watchers, so the
+      // ring append inside commit is vacuous (no watchers registered)
+      std::lock_guard<std::mutex> lk(store.mu);
+      EntryPtr e = store.commit_locked(k, "ADDED", std::move(obj), key,
+                                       nullptr, nullptr, sh.get(),
+                                       /*stamp_uid=*/true);
+      sh->objs[key.second] = e;
     }
+  }
+}
+
+// The real apiserver expires events on a ~1h etcd lease (re-leased on
+// every write); the mock bounds the store by count — the least-recently-
+// written event (smallest resourceVersion) is evicted after an insert
+// pushes past the cap. Runs OUTSIDE the creating shard's critical
+// section: the victim may live in another namespace shard, and shard
+// locks never nest (mirrors mockserver._evict_events_overflow).
+void App::evict_events(double* fanout_us) {
+  int ek = kind_index("events");
+  long cap = events_cap();
+  if (cap <= 0) return;
+  while (true) {
+    {
+      std::lock_guard<std::mutex> lk(store.mu);
+      if (store.obj_count[ek] <= cap) return;
+    }
+    // find the min-rv victim across the kind's shards (O(cap) scan,
+    // paid only past the cap; never the just-created entry — its rv is
+    // the newest)
+    std::string v_ns, v_name;
+    long long best = 0;
+    bool have = false;
+    for (auto& ns_sh : store.kind_shards(ek)) {
+      std::lock_guard<std::mutex> sl(ns_sh.second->smu);
+      for (auto& kv : ns_sh.second->objs) {
+        const JVal* mv = kv.second->obj.find("metadata");
+        const JVal* rv = mv ? mv->find("resourceVersion") : nullptr;
+        long long n = rv ? atoll(rv->s.c_str()) : 0;
+        if (!have || n < best) {
+          have = true;
+          best = n;
+          v_ns = ns_sh.first;
+          v_name = kv.first;
+        }
+      }
+    }
+    if (!have) return;
+    ShardPtr sh = store.shard_of(ek, v_ns, /*create=*/false);
+    if (!sh) return;
+    bool erased = false;
+    {
+      std::lock_guard<std::mutex> sl(sh->smu);
+      auto it = sh->objs.find(v_name);
+      if (it != sh->objs.end()) {
+        // deletion is a write: bump like the explicit DELETE path, so
+        // the DELETED event gets its own revision (rv-resuming watchers
+        // would otherwise never see the eviction)
+        JVal vobj = it->second->obj;  // copy-on-write
+        EntryPtr vprev = it->second;
+        sh->objs.erase(it);
+        std::lock_guard<std::mutex> lk(store.mu);
+        store.commit_locked(ek, "DELETED", std::move(vobj),
+                            Key{v_ns, v_name}, std::move(vprev),
+                            fanout_us, sh.get());
+        erased = true;
+      }
+    }
+    if (erased) store.ring_cv.notify_all();
+    // raced evictions still make progress (the other thread erased);
+    // loop re-checks the population either way
   }
 }
 
@@ -2069,12 +2360,26 @@ bool App::handle_request(ConnIO& io, Request& req) {
     flight_record(std::move(rec));
   };
 
+  // Ring wake-ups leave AFTER the response is queued (ISSUE 13):
+  // waking a watcher cohort inside the commit window put the whole
+  // thundering herd on the requester's critical path — the store is
+  // consistent the moment the clock lock dropped, so the fanout wake
+  // rides behind the answer instead of in front of it.
+  bool wake_ring = false;
   auto respond = [&](int code, const std::string& body,
                      const char* extra = "",
                      const char* ctype = "application/json") {
     audit_line(req.method, uri, code);
     bool ok = queue_response(io, code, body, extra, ctype);
     finish_timing(code);
+    if (wake_ring) {
+      // deferred fanout wake (see above): the answer goes ON THE WIRE
+      // first — on an oversubscribed host a thousand woken watcher
+      // threads would otherwise run before the requester's flush
+      wake_ring = false;
+      if (!io.flush()) ok = false;
+      store.ring_cv.notify_all();
+    }
     if (req.close) {
       io.flush();
       return false;
@@ -2201,7 +2506,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
           int code = 404;
           std::string body = "{\"kind\":\"Status\",\"code\":404}";
           {
-            std::lock_guard<std::mutex> lk(store.mu);
+            std::lock_guard<std::mutex> lk(store.lease_mu);
             auto it = store.leases.find(lkey);
             if (it != store.leases.end()) {
               code = 200;
@@ -2228,7 +2533,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
           int code;
           std::string body;
           {
-            std::lock_guard<std::mutex> lk(store.mu);
+            std::lock_guard<std::mutex> lk(store.lease_mu);
             if (store.leases.count(Key{lns, name})) {
               code = 409;
               body =
@@ -2241,15 +2546,21 @@ bool App::handle_request(ConnIO& io, Request& req) {
             } else {
               double now = wall_unix_s();
               std::string stamp = now_rfc3339();
-              store.rv++;
+              int64_t lrv;
+              {
+                // lease writes share the store clock (lease 86 -> ring
+                // 88 in the declared order; shards never involved)
+                std::lock_guard<std::mutex> rk(store.mu);
+                lrv = ++store.rv;
+              }
               LeaseRec L;
               L.holder = holder;
               L.duration = duration;
               L.acquire = L.renew = now;
               L.transitions = 0;
               L.created = L.acquire_str = L.renew_str = stamp;
-              L.uid = "uid-" + std::to_string(store.rv);
-              L.rv = store.rv;
+              L.uid = "uid-" + std::to_string(lrv);
+              L.rv = lrv;
               store.leases[Key{lns, name}] = L;
               code = 201;
               body = lease_render(lns, name, L);
@@ -2268,7 +2579,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
           int code = 200;
           std::string body;
           {
-            std::lock_guard<std::mutex> lk(store.mu);
+            std::lock_guard<std::mutex> lk(store.lease_mu);
             auto it = store.leases.find(lkey);
             if (it == store.leases.end()) {
               code = 404;
@@ -2303,8 +2614,10 @@ bool App::handle_request(ConnIO& io, Request& req) {
                 L.renew = now;
                 L.renew_str = stamp;
                 if (duration > 0) L.duration = duration;
-                store.rv++;
-                L.rv = store.rv;
+                {
+                  std::lock_guard<std::mutex> rk(store.mu);
+                  L.rv = ++store.rv;
+                }
                 body = lease_render(lns, lname, L);
               }
             }
@@ -2348,8 +2661,14 @@ bool App::handle_request(ConnIO& io, Request& req) {
     fname = f2 == std::string::npos ? "" : hdr.substr(f1 + 1, f2 - f1 - 1);
     fholder = f2 == std::string::npos ? "" : hdr.substr(f2 + 1);
   }
-  auto fence_ok_locked = [&]() {  // caller holds store.mu
+  // The fence guard (sharded edition of PR 12's single-critical-section
+  // contract): lease_mu is taken BEFORE the shard lock and held across
+  // the whole mutation (lease -> shard -> mu), so a takeover PATCH —
+  // which serializes on lease_mu — can never interleave between the
+  // claim check and the commit. Unfenced requests never touch it.
+  auto fence_check = [&](std::unique_lock<std::mutex>& lk) {
     if (!fence_claimed) return true;
+    lk = std::unique_lock<std::mutex>(store.lease_mu);
     if (fname.empty() || fholder.empty()) return false;
     auto it = store.leases.find(Key{fns, fname});
     return it != store.leases.end() && it->second.holder == fholder &&
@@ -2379,23 +2698,34 @@ bool App::handle_request(ConnIO& io, Request& req) {
     bool found = false;
     std::string node_ip;
     {
-      std::lock_guard<std::mutex> lk(store.mu);
-      auto it = store.kinds[1].find(key);
-      if (it != store.kinds[1].end()) {
+      ShardPtr psh = store.shard_of(1, m.ns, /*create=*/false);
+      EntryPtr pe;
+      if (psh) {
+        std::lock_guard<std::mutex> sl(psh->smu);
+        auto it = psh->objs.find(m.name);
+        if (it != psh->objs.end()) pe = it->second;
+      }
+      if (pe) {
         found = true;
-        node_name = field_str(it->second->obj, "spec.nodeName");
+        node_name = field_str(pe->obj, "spec.nodeName");
         if (container.empty()) {
-          const JVal* spec = it->second->obj.find("spec");
+          const JVal* spec = pe->obj.find("spec");
           const JVal* ctrs = spec && spec->is_obj() ? spec->find("containers") : nullptr;
           if (ctrs && ctrs->type == JVal::ARR && !ctrs->arr.empty())
             container = field_str(ctrs->arr[0], "name");
         }
       }
       if (!node_name.empty()) {
-        auto nit = store.kinds[0].find(Key{"", node_name});
         node_ip = node_name;
-        if (nit != store.kinds[0].end()) {
-          const JVal* st = nit->second->obj.find("status");
+        ShardPtr nsh = store.shard_of(0, "", /*create=*/false);
+        EntryPtr ne;
+        if (nsh) {
+          std::lock_guard<std::mutex> sl(nsh->smu);
+          auto nit = nsh->objs.find(node_name);
+          if (nit != nsh->objs.end()) ne = nit->second;
+        }
+        if (ne) {
+          const JVal* st = ne->obj.find("status");
           const JVal* addrs = st && st->is_obj() ? st->find("addresses") : nullptr;
           if (addrs && addrs->type == JVal::ARR)
             for (const JVal& a : addrs->arr)
@@ -2439,13 +2769,15 @@ bool App::handle_request(ConnIO& io, Request& req) {
 
   if (req.method == "GET") {
     if (!m.name.empty()) {
-      // grab the entry ref under the lock, send outside it: a stalled
-      // reader must never wedge the store
+      // grab the entry ref under the SHARD lock, send outside it: a
+      // stalled reader must never wedge the store (and a GET no longer
+      // serializes against writers on other shards)
       EntryPtr e;
-      {
-        std::lock_guard<std::mutex> lk(store.mu);
-        auto it = store.kinds[m.kind].find(key);
-        if (it != store.kinds[m.kind].end()) e = it->second;
+      ShardPtr sh = store.shard_of(m.kind, m.ns, /*create=*/false);
+      if (sh) {
+        std::lock_guard<std::mutex> sl(sh->smu);
+        auto it = sh->objs.find(m.name);
+        if (it != sh->objs.end()) e = it->second;
       }
       pt.mark(PH_COMMIT);
       if (!e) return respond(404, "{\"kind\":\"Status\",\"code\":404}");
@@ -2503,16 +2835,25 @@ bool App::handle_request(ConnIO& io, Request& req) {
             expired = true;
           } else {
             // replay the gap from the watch cache BEFORE registering:
-            // emits hold mu too, so ordering is airtight
+            // commits hold mu too, so ordering is airtight. The replay
+            // is exempt from the ring-lag cap (bounded by rv_window).
             for (const auto& h : store.history) {
               if (h.rv <= wrv || h.kind != m.kind) continue;
               if (!match_field_selector(h.e->obj, fs)) continue;
               if (!w->label_sel.matches(h.e->obj)) continue;
-              w->push_replay(Store::event_line(h.type.c_str(), h.e));
+              w->replay.push_back(Store::event_line(h.type.c_str(), h.e));
             }
           }
         }
-        if (!expired && too_large_current < 0) store.watches.push_back(w);
+        if (!expired && too_large_current < 0) {
+          // cursor starts at the ring head, atomically with the replay
+          // collection: commits append under mu -> ring_mu, so holding
+          // BOTH here means nothing falls between the cache gap and live
+          std::lock_guard<std::mutex> rl(store.ring_mu);
+          w->cursor = store.ring_next;
+          store.watches.push_back(w);
+          store.kind_watchers[m.kind]++;
+        }
       }
       if (too_large_current >= 0) {
         return respond(
@@ -2549,53 +2890,91 @@ bool App::handle_request(ConnIO& io, Request& req) {
           "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
           "Transfer-Encoding: chunked\r\n\r\n";
       bool alive = send_all(fd, head, strlen(head));
-      // Batched writer: drain everything queued per wakeup and ship it
-      // as one send (bounded per write) — a 50k-pod soak fans out tens
-      // of thousands of events per stream, and a syscall per event was
-      // a top apiserver CPU term.
-      std::vector<std::shared_ptr<const std::string>> evs;
       std::string out;
+      auto frame = [&out](const std::string& ev) {
+        char chunk_head[32];
+        int hn = snprintf(chunk_head, sizeof chunk_head, "%zx\r\n",
+                          ev.size());
+        out.append(chunk_head, hn);
+        out += ev;
+        out += "\r\n";
+      };
+      // cap-exempt resume replay first (private to this watch; bounded
+      // by rv_window), in bounded sends
+      {
+        size_t i = 0;
+        while (alive && i < w->replay.size()) {
+          out.clear();
+          size_t take_bytes = 0;
+          for (; i < w->replay.size() && take_bytes < (4u << 20); i++) {
+            take_bytes += w->replay[i]->size();
+            frame(*w->replay[i]);
+          }
+          alive = send_all(fd, out.data(), out.size());
+        }
+        w->replay.clear();
+      }
+      // Ring reader: drain everything pending per wakeup (bounded per
+      // write) and ship it as one send. The store encoded each event
+      // ONCE; this thread only filters and frames shared bytes — the
+      // per-watcher cost left the commit path (ISSUE 13).
+      std::vector<std::shared_ptr<const std::string>> evs;
       auto wdeadline =
           std::chrono::steady_clock::now() +
           std::chrono::duration_cast<std::chrono::steady_clock::duration>(
               std::chrono::duration<double>(timeout_s > 0 ? timeout_s : 0));
       bool deadline_expired = false;
       while (alive && !stopping.load()) {
-        if (timeout_s > 0 && std::chrono::steady_clock::now() >= wdeadline) {
-          deadline_expired = true;  // event boundary: batch fully sent
-          break;
-        }
+        bool end_stream = false;
         evs.clear();
         {
-          std::unique_lock<std::mutex> lk(w->mu);
-          auto ready = [&] { return w->closed || !w->q.empty(); };
+          std::unique_lock<std::mutex> lk(store.ring_mu);
+          auto ready = [&] {
+            return w->closed || store.ring_next > w->cursor ||
+                   stopping.load();
+          };
           if (timeout_s > 0) {
-            if (!w->cv.wait_until(lk, wdeadline, ready)) {
+            if (!store.ring_cv.wait_until(lk, wdeadline, ready)) {
+              deadline_expired = true;
+              break;
+            }
+            // the deadline closes at the next event BOUNDARY past it,
+            // pending backlog or not (a flooding stream must not be
+            // able to outrun its own timeoutSeconds)
+            if (std::chrono::steady_clock::now() >= wdeadline) {
               deadline_expired = true;
               break;
             }
           } else {
-            w->cv.wait(lk, ready);
+            store.ring_cv.wait(lk, ready);
           }
-          if (w->closed && w->q.empty()) break;
+          uint64_t base = store.ring_next - store.ring.size();
+          if (w->cursor < base) w->cursor = base;  // trimmed past us
+          uint64_t lim = store.ring_next;
+          if (w->stop_seq < lim) lim = w->stop_seq;
           size_t take_bytes = 0;
-          // cap the batch by BYTES, not events: one send buffer must stay
-          // bounded even when a stalled reader let large objects pile up
-          while (!w->q.empty() && take_bytes < (4u << 20)) {
-            take_bytes += w->q.front()->size();
-            evs.push_back(std::move(w->q.front()));
-            w->q.pop_front();
+          // cap the batch by BYTES, not events: one send buffer must
+          // stay bounded even when large objects piled up
+          while (w->cursor < lim && take_bytes < (4u << 20)) {
+            const RingEv& ev = store.ring[w->cursor - base];
+            w->cursor++;
+            if (ev.kind != w->kind) continue;
+            if (ev.bookmark) {
+              if (!w->bookmarks) continue;
+            } else if (!match_field_selector(ev.e->obj, w->field_sel) ||
+                       !w->label_sel.matches(ev.e->obj)) {
+              continue;
+            }
+            take_bytes += ev.line->size();
+            evs.push_back(ev.line);
           }
+          if (evs.empty() && w->closed && w->cursor >= lim)
+            end_stream = true;
         }
+        if (end_stream) break;  // slow close stays abrupt (backlog dropped)
+        if (evs.empty()) continue;  // consumed only non-matching events
         out.clear();
-        for (const auto& ev : evs) {
-          char chunk_head[32];
-          int hn =
-              snprintf(chunk_head, sizeof chunk_head, "%zx\r\n", ev->size());
-          out.append(chunk_head, hn);
-          out += *ev;
-          out += "\r\n";
-        }
+        for (const auto& ev : evs) frame(*ev);
         alive = send_all(fd, out.data(), out.size());
       }
       if (alive && deadline_expired) {
@@ -2606,11 +2985,13 @@ bool App::handle_request(ConnIO& io, Request& req) {
         send_all(fd, "0\r\n\r\n", 5);
       }
       {
-        std::lock_guard<std::mutex> lk(store.mu);
+        std::lock_guard<std::mutex> lk(store.ring_mu);
+        store.close_watch_locked(w, /*slow=*/false);
         auto& ws = store.watches;
         ws.erase(std::remove(ws.begin(), ws.end(), w), ws.end());
+        store.ring_min = 0;  // force a min-cursor recompute next trim
       }
-      w->close();
+      store.ring_cv.notify_all();
       return false;  // watch connections never go back to unary
     }
     // ---- list (with the kube-apiserver limit/continue chunking protocol)
@@ -2648,113 +3029,155 @@ bool App::handle_request(ConnIO& io, Request& req) {
     size_t snap_cap = count_rest
                           ? (size_t)-1
                           : (size_t)std::max(limit * 4L, 4096L);
-    std::vector<EntryPtr> snap;
-    bool more_after = false;
-    int64_t rv_now;
+    int64_t rv_now = 0;
     int64_t token_rv = 0;  // consistency marker: rv of the FIRST page
-    {
-      std::lock_guard<std::mutex> lk(store.mu);
-      auto& kindmap = store.kinds[m.kind];
-      auto it = kindmap.begin();
-      if (!cont.empty()) {
-        // opaque url-safe token (like the real apiserver's base64
-        // continue): rv \0 ns \0 name — resumes strictly after the key;
-        // the rv is the first page's revision and expires on compaction
-        std::string raw;
-        size_t p1;
-        if (!b64url_decode(cont, raw) ||
-            (p1 = raw.find('\0')) == std::string::npos || p1 == 0 ||
-            raw.find_first_not_of("0123456789") < p1)
-          // undecodable token OR a non-numeric rv segment: 400, like the
-          // real apiserver's "continue key is not valid" (and the Python
-          // mirror's MalformedContinue)
-          return respond(
-              400,
-              "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
-              "\"Failure\",\"message\":\"continue key is not valid\","
-              "\"reason\":\"BadRequest\",\"code\":400}");
-        token_rv = atoll(raw.substr(0, p1).c_str());
-        if (token_rv < store.compacted_rv)
-          return respond(
-              410,
-              "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
-              "\"Failure\",\"message\":\"the provided continue parameter "
-              "is too old\",\"reason\":\"Expired\",\"code\":410}");
-        std::string rest = raw.substr(p1 + 1);
-        size_t nul = rest.find('\0');
-        Key last{rest.substr(0, nul),
+    Key last{"", ""};
+    bool have_last = false;
+    if (!cont.empty()) {
+      // opaque url-safe token (like the real apiserver's base64
+      // continue): rv \0 ns \0 name — resumes strictly after the key;
+      // the rv is the first page's revision and expires on compaction
+      std::string raw;
+      size_t p1;
+      if (!b64url_decode(cont, raw) ||
+          (p1 = raw.find('\0')) == std::string::npos || p1 == 0 ||
+          raw.find_first_not_of("0123456789") < p1)
+        // undecodable token OR a non-numeric rv segment: 400, like the
+        // real apiserver's "continue key is not valid" (and the Python
+        // mirror's MalformedContinue)
+        return respond(
+            400,
+            "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+            "\"Failure\",\"message\":\"continue key is not valid\","
+            "\"reason\":\"BadRequest\",\"code\":400}");
+      token_rv = atoll(raw.substr(0, p1).c_str());
+      std::string rest = raw.substr(p1 + 1);
+      size_t nul = rest.find('\0');
+      last = Key{rest.substr(0, nul),
                  nul == std::string::npos ? "" : rest.substr(nul + 1)};
-        it = kindmap.upper_bound(last);
-        // Consistent snapshot at the token's revision (what the real
-        // apiserver reads from etcd MVCC): roll the live view back by
-        // overlaying each affected key's state BEFORE its first event
-        // after token_rv. Newest-to-oldest walk, so the final overlay
-        // value for a key is the prev of its EARLIEST post-token event
-        // — exactly its state at the token revision. Window guarantees:
-        // token_rv >= compacted_rv (checked above), so every later
-        // event is still in the undo deque. rv_window()==0 disables
-        // the cache entirely and keeps the old live-view behavior.
-        std::map<Key, EntryPtr> overlay;
-        for (auto u = store.undo.rbegin(); u != store.undo.rend(); ++u) {
-          if (u->rv <= token_rv) break;
-          if (u->kind != m.kind) continue;
-          overlay[u->key] = u->prev;
-        }
-        auto ov = overlay.upper_bound(last);
-        snap.reserve(std::min(kindmap.size(), snap_cap));
-        while (it != kindmap.end() || ov != overlay.end()) {
-          bool use_ov;
-          if (ov == overlay.end()) use_ov = false;
-          else if (it == kindmap.end()) use_ov = true;
-          else if (ov->first < it->first) use_ov = true;
-          else if (it->first < ov->first) use_ov = false;
-          else {  // same key: the snapshot's state wins over the live one
-            use_ov = true;
-            ++it;
+      have_last = true;
+    }
+    // EVERY page — first or continuation — serves a CONSISTENT SNAPSHOT
+    // at one revision (what the real apiserver reads from etcd MVCC):
+    // the sharded store is walked shard by shard (shard locks never
+    // nest) and rolled back through the undo log to the list revision,
+    // so a write racing the walk on another shard can neither leak in
+    // nor hide. Newest-to-oldest overlay walk, so the final value for a
+    // key is the prev of its EARLIEST post-revision event — exactly its
+    // state at the list revision (nullptr = absent then). rv_window()==0
+    // disables the cache and keeps the live-view behavior.
+    std::vector<std::pair<Key, EntryPtr>> snap;
+    bool more_after = false;
+    std::map<Key, EntryPtr> overlay;
+    for (int attempt = 0; attempt < 4; attempt++) {
+      {
+        std::lock_guard<std::mutex> lk(store.mu);
+        if (have_last) {
+          if (token_rv < store.compacted_rv)
+            return respond(
+                410,
+                "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+                "\"Failure\",\"message\":\"the provided continue parameter "
+                "is too old\",\"reason\":\"Expired\",\"code\":410}");
+          rv_now = token_rv;  // pages of one list share page 1's revision
+        } else {
+          rv_now = store.rv;
+          token_rv = rv_now;  // first page stamps its revision
+          if (idx_eligible) {
+            auto pit = store.phase_idx[m.kind].find(idx_phase);
+            idx_total =
+                pit == store.phase_idx[m.kind].end() ? 0 : pit->second;
+          } else if (limit > 0 && lsq.empty() && fs.empty()) {
+            // selector-less count (limit=1 population polls): every
+            // stored entry matches, so the population count IS the total
+            // (kept under mu with rv, so count and revision agree)
+            idx_total = store.obj_count[m.kind];
           }
-          EntryPtr e;
-          if (use_ov) {
-            e = ov->second;
-            ++ov;
-          } else {
-            e = it->second;
-            ++it;
-          }
-          if (!e) continue;  // hidden at the token revision (created later)
-          if (snap.size() >= snap_cap) {
-            // only a VISIBLE leftover earns a continue token: keys hidden
-            // by the snapshot must not fabricate a trailing empty page
-            // (the Python server paginates over the rolled-back view and
-            // would end here)
-            more_after = true;
-            break;
-          }
-          snap.push_back(std::move(e));
-        }
-        rv_now = token_rv;  // pages of one list share page 1's revision
-      } else {
-        snap.reserve(std::min(kindmap.size(), snap_cap));
-        for (; it != kindmap.end(); ++it) {
-          if (snap.size() >= snap_cap) {
-            more_after = true;
-            break;
-          }
-          snap.push_back(it->second);
-        }
-        rv_now = store.rv;
-        token_rv = rv_now;  // first page stamps its revision
-        if (idx_eligible) {
-          auto pit = store.phase_idx[m.kind].find(idx_phase);
-          idx_total =
-              pit == store.phase_idx[m.kind].end() ? 0 : pit->second;
-        } else if (limit > 0 && lsq.empty() && fs.empty()) {
-          // selector-less count (limit=1 population polls): every
-          // stored entry matches, so the map size IS the total
-          idx_total = (long)kindmap.size();
         }
       }
+      snap.clear();
+      more_after = false;
+      for (auto& ns_sh : store.kind_shards(m.kind)) {
+        if (have_last && ns_sh.first < last.first) continue;
+        std::lock_guard<std::mutex> sl(ns_sh.second->smu);
+        auto it = ns_sh.second->objs.begin();
+        if (have_last && ns_sh.first == last.first)
+          it = ns_sh.second->objs.upper_bound(last.second);
+        for (; it != ns_sh.second->objs.end(); ++it) {
+          if (snap.size() >= snap_cap) {
+            more_after = true;
+            break;
+          }
+          snap.emplace_back(Key{ns_sh.first, it->first}, it->second);
+        }
+        if (more_after) break;
+      }
+      {
+        std::lock_guard<std::mutex> lk(store.mu);
+        if (rv_window() > 0 && rv_now < store.compacted_rv) {
+          if (have_last)
+            return respond(
+                410,
+                "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+                "\"Failure\",\"message\":\"the provided continue "
+                "parameter is too old\",\"reason\":\"Expired\","
+                "\"code\":410}");
+          if (attempt < 3) continue;  // compaction raced the walk: retry
+          overlay.clear();  // repeated compactions: serve the live walk
+          break;
+        }
+        overlay.clear();
+        for (auto u = store.undo.rbegin(); u != store.undo.rend(); ++u) {
+          if (u->rv <= rv_now) break;
+          if (u->kind != m.kind) continue;
+          if (have_last && !(last < u->key)) continue;
+          overlay[u->key] = u->prev;
+        }
+      }
+      break;
     }
-    pt.mark(PH_COMMIT);  // snapshot under the lock; match/serialize below
+    // a truncated walk must not let overlay keys past the cut fabricate
+    // out-of-order entries — the continuation resumes there instead
+    if (more_after && !snap.empty()) {
+      Key cut = snap.back().first;
+      while (!overlay.empty() && cut < overlay.rbegin()->first)
+        overlay.erase(std::prev(overlay.end()));
+    }
+    // merged view: walk snapshot + rollback overlay (both key-sorted);
+    // the overlay's state wins where both hold a key
+    std::vector<EntryPtr> view;
+    {
+      auto sit = snap.begin();
+      auto ov = overlay.begin();
+      while (sit != snap.end() || ov != overlay.end()) {
+        bool use_ov;
+        if (ov == overlay.end()) use_ov = false;
+        else if (sit == snap.end()) use_ov = true;
+        else if (ov->first < sit->first) use_ov = true;
+        else if (sit->first < ov->first) use_ov = false;
+        else {  // same key: the rolled-back state wins over the live one
+          use_ov = true;
+          ++sit;
+        }
+        EntryPtr e;
+        if (use_ov) {
+          e = ov->second;
+          ++ov;
+        } else {
+          e = sit->second;
+          ++sit;
+        }
+        if (!e) continue;  // hidden at the view revision (created later)
+        if (view.size() >= snap_cap) {
+          // only a VISIBLE leftover earns a continue token: keys hidden
+          // by the snapshot must not fabricate a trailing empty page
+          more_after = true;
+          break;
+        }
+        view.push_back(std::move(e));
+      }
+    }
+    pt.mark(PH_COMMIT);  // snapshot under the locks; match/serialize below
                          // is response build, attributed to encode
     // The continue token is rebuilt from the entry's own (immutable)
     // metadata — map keys may be erased concurrently once the lock drops.
@@ -2778,8 +3201,8 @@ bool App::handle_request(ConnIO& io, Request& req) {
     long count = 0;
     long remaining = 0;
     bool first = true;
-    for (size_t i = 0; i < snap.size(); i++) {
-      const JVal& obj = snap[i]->obj;
+    for (size_t i = 0; i < view.size(); i++) {
+      const JVal& obj = view[i]->obj;
       // the index knows no further entry can match: stop scanning (a
       // zero-match poll — e.g. phase=Running before any transition —
       // would otherwise walk the whole store)
@@ -2800,15 +3223,15 @@ bool App::handle_request(ConnIO& io, Request& req) {
       if (!ls.matches(obj)) continue;
       if (!first) items += ',';
       first = false;
-      items += snap[i]->bytes;
+      items += view[i]->bytes;
       count++;
-      if (limit && count >= limit && (i + 1 < snap.size() || more_after))
+      if (limit && count >= limit && (i + 1 < view.size() || more_after))
         key_of(obj, token);
     }
-    if (limit && !count_rest && token.empty() && more_after && !snap.empty())
+    if (limit && !count_rest && token.empty() && more_after && !view.empty())
       // truncated snapshot, page not filled: continue from the last entry
       // we actually examined (a short page; the client keeps paginating)
-      key_of(snap.back()->obj, token);
+      key_of(view.back()->obj, token);
     std::string body =
         "{\"kind\":\"List\",\"apiVersion\":\"v1\",\"metadata\":{"
         "\"resourceVersion\":\"";
@@ -2846,33 +3269,39 @@ bool App::handle_request(ConnIO& io, Request& req) {
     std::string conflict;
     bool found = false;
     bool fenced = false;
+    bool committed = false;
     {
-      std::lock_guard<std::mutex> lk(store.mu);
-      if (!fence_ok_locked()) {
-        fenced = true;  // check+commit atomic: respond after the lock
+      std::unique_lock<std::mutex> fence_lk;
+      if (!fence_check(fence_lk)) {
+        fenced = true;  // check+commit atomic: respond after the locks
       } else {
-        auto it = store.kinds[1].find(key);
-        if (it != store.kinds[1].end()) {
-          found = true;
-          JVal obj = it->second->obj;  // copy-on-write
-          JVal& spec = obj.get_or_insert_obj("spec");
-          const JVal* cur = spec.find("nodeName");
-          if (cur && cur->type == JVal::STR && !cur->s.empty()) {
-            // real apiserver BindingREST: any bind after spec.nodeName
-            // is set conflicts, even to the same node
-            conflict = cur->s;
-          } else {
-            spec.set("nodeName", JVal::str(node));
-            store.bump(obj);
-            EntryPtr e = publish(std::move(obj));
-            EntryPtr prev = it->second;
-            it->second = e;
-            store.emit(1, "MODIFIED", e, key, std::move(prev),
-                       pt.on ? &pt.us[PH_FANOUT] : nullptr);
+        ShardPtr sh = store.shard_of(1, m.ns, /*create=*/false);
+        if (sh) {
+          std::lock_guard<std::mutex> sl(sh->smu);
+          auto it = sh->objs.find(m.name);
+          if (it != sh->objs.end()) {
+            found = true;
+            JVal obj = it->second->obj;  // copy-on-write
+            JVal& spec = obj.get_or_insert_obj("spec");
+            const JVal* cur = spec.find("nodeName");
+            if (cur && cur->type == JVal::STR && !cur->s.empty()) {
+              // real apiserver BindingREST: any bind after spec.nodeName
+              // is set conflicts, even to the same node
+              conflict = cur->s;
+            } else {
+              spec.set("nodeName", JVal::str(node));
+              EntryPtr prev = it->second;
+              std::lock_guard<std::mutex> lk(store.mu);
+              it->second = store.commit_locked(
+                  1, "MODIFIED", std::move(obj), key, std::move(prev),
+                  pt.on ? &pt.us[PH_FANOUT] : nullptr, sh.get());
+              committed = true;
+            }
           }
         }
       }
     }
+    wake_ring = committed;
     pt.mark(PH_COMMIT);
     if (fenced) return fencing_409();
     if (!found) return respond(404, "{\"kind\":\"Status\",\"code\":404}");
@@ -2904,90 +3333,67 @@ bool App::handle_request(ConnIO& io, Request& req) {
     EntryPtr e;
     std::string exists_name;
     bool fenced = false;
+    bool committed = false;
     {
-      std::lock_guard<std::mutex> lk(store.mu);
-      // check+commit atomic: fenced requests skip the whole mutation
-      // and answer after the lock drops
-      fenced = !fence_ok_locked();
-      if (!fenced && !meta.find("name")) {
-        // apiserver names.go semantics: generateName + 5-char random
-        // suffix (kube-scheduler POSTs events this way). Resolved inside
-        // the create's critical section — the name stays unique through
-        // the insert, never silently overwriting an existing object (the
-        // real apiserver 409s and the client retries; same outcome).
-        const JVal* gn = meta.find("generateName");
-        if (gn && gn->type == JVal::STR && !gn->s.empty()) {
-          static const char hexd[] = "0123456789abcdef";
-          static std::atomic<uint64_t> ctr{0};
-          while (true) {
-            uint64_t x = (uint64_t)time(nullptr) * 1000003u +
-                         ctr.fetch_add(1) * 2654435761u;
-            std::string suffix;
-            for (int i = 0; i < 5; i++) {
-              suffix += hexd[x & 15];
-              x >>= 4;
-            }
-            std::string name = gn->s + suffix;
-            if (!store.kinds[m.kind].count(Key{m.ns, name})) {
-              meta.set("name", JVal::str(name));
-              break;
+      std::unique_lock<std::mutex> fence_lk;
+      if (!fence_check(fence_lk)) {
+        // check+commit atomic: fenced requests skip the whole mutation
+        // and answer after the locks drop
+        fenced = true;
+      } else {
+        ShardPtr sh = store.shard_of(m.kind, m.ns);
+        std::lock_guard<std::mutex> sl(sh->smu);
+        if (!meta.find("name")) {
+          // apiserver names.go semantics: generateName + 5-char random
+          // suffix (kube-scheduler POSTs events this way). Resolved
+          // inside the shard's critical section — the name stays unique
+          // through the insert, never silently overwriting an existing
+          // object (the real apiserver 409s and the client retries).
+          const JVal* gn = meta.find("generateName");
+          if (gn && gn->type == JVal::STR && !gn->s.empty()) {
+            static const char hexd[] = "0123456789abcdef";
+            static std::atomic<uint64_t> ctr{0};
+            while (true) {
+              uint64_t x = (uint64_t)time(nullptr) * 1000003u +
+                           ctr.fetch_add(1) * 2654435761u;
+              std::string suffix;
+              for (int i = 0; i < 5; i++) {
+                suffix += hexd[x & 15];
+                x >>= 4;
+              }
+              std::string name = gn->s + suffix;
+              if (!sh->objs.count(name)) {
+                meta.set("name", JVal::str(name));
+                break;
+              }
             }
           }
         }
-      }
-      Key k = fenced ? Key{"", ""} : Store::obj_key(obj);
-      if (fenced || k.second.empty()) {
-        e = nullptr;
-      } else if (store.kinds[m.kind].count(k)) {
-        // the real apiserver never overwrites on create (HTTP 409;
-        // mirrors mockserver.py AlreadyExists). Respond AFTER the lock
-        // drops (a stalled client must not wedge the store).
-        exists_name = k.second;
-        e = nullptr;
-      } else {
-        if (!meta.find("creationTimestamp"))
-          meta.set("creationTimestamp", JVal::str(now_rfc3339()));
-        if (!meta.find("uid"))
-          meta.set("uid", JVal::str("uid-" + std::to_string(store.rv + 1)));
-        store.bump(obj);
-        e = publish(std::move(obj));
-        store.kinds[m.kind][k] = e;
-        store.emit(m.kind, "ADDED", e, k, nullptr,
-                   pt.on ? &pt.us[PH_FANOUT] : nullptr);
-        if (m.kind == kind_index("events") && events_cap() > 0) {
-          auto& evs = store.kinds[m.kind];
-          while ((int)evs.size() > events_cap()) {
-            // evict the least-recently-written event: smallest numeric
-            // resourceVersion (always server-stamped digits — bump()
-            // overwrites it on every mutation). O(cap) scan, paid only
-            // past the cap; never the just-created entry (its rv is the
-            // newest).
-            auto victim = evs.end();
-            long long best = 0;
-            for (auto it2 = evs.begin(); it2 != evs.end(); ++it2) {
-              const JVal* mv = it2->second->obj.find("metadata");
-              const JVal* rv = mv ? mv->find("resourceVersion") : nullptr;
-              long long n = rv ? atoll(rv->s.c_str()) : 0;
-              if (victim == evs.end() || n < best) {
-                victim = it2;
-                best = n;
-              }
-            }
-            // deletion is a write: bump like the explicit DELETE path,
-            // so the DELETED event gets its own revision (rv-resuming
-            // watchers would otherwise never see the eviction)
-            JVal vobj = victim->second->obj;  // copy-on-write
-            Key vkey = victim->first;
-            EntryPtr vprev = victim->second;
-            evs.erase(victim);
-            store.bump(vobj);
-            store.emit(m.kind, "DELETED", publish(std::move(vobj)), vkey,
-                       std::move(vprev),
-                       pt.on ? &pt.us[PH_FANOUT] : nullptr);
-          }
+        Key k = Store::obj_key(obj);
+        if (k.second.empty()) {
+          e = nullptr;
+        } else if (sh->objs.count(k.second)) {
+          // the real apiserver never overwrites on create (HTTP 409;
+          // mirrors mockserver.py AlreadyExists). Respond AFTER the
+          // locks drop (a stalled client must not wedge the store).
+          exists_name = k.second;
+          e = nullptr;
+        } else {
+          if (!meta.find("creationTimestamp"))
+            meta.set("creationTimestamp", JVal::str(now_rfc3339()));
+          std::lock_guard<std::mutex> lk(store.mu);
+          e = store.commit_locked(m.kind, "ADDED", std::move(obj), k,
+                                  nullptr,
+                                  pt.on ? &pt.us[PH_FANOUT] : nullptr,
+                                  sh.get(), /*stamp_uid=*/true);
+          sh->objs[k.second] = e;
+          committed = true;
         }
       }
     }
+    wake_ring = committed;
+    if (committed && m.kind == kind_index("events"))
+      evict_events(pt.on ? &pt.us[PH_FANOUT] : nullptr);
     pt.mark(PH_COMMIT);
     if (fenced) return fencing_409();
     if (!exists_name.empty()) {
@@ -3015,52 +3421,66 @@ bool App::handle_request(ConnIO& io, Request& req) {
     std::string body;
     int code = 200;
     bool fenced = false;
+    bool committed = false;
     {
-      std::lock_guard<std::mutex> lk(store.mu);
-      auto it = store.kinds[m.kind].end();
-      if (!fence_ok_locked()) {
-        fenced = true;  // check+commit atomic: respond after the lock
-      } else if ((it = store.kinds[m.kind].find(key)) ==
-                 store.kinds[m.kind].end()) {
-        code = 404;
-        body = "{\"kind\":\"Status\",\"code\":404}";
+      std::unique_lock<std::mutex> fence_lk;
+      if (!fence_check(fence_lk)) {
+        fenced = true;  // check+commit atomic: respond after the locks
       } else {
-        JVal obj = it->second->obj;  // copy-on-write
-        if (m.status) {
-          // strategic-merge on the status subresource; accept either a
-          // {"status": {...}} wrapper or a bare status document
-          const JVal* sp = patch.is_obj() ? patch.find("status") : nullptr;
-          const JVal& spv = sp ? *sp : patch;
-          JVal cur_status;
-          cur_status.type = JVal::OBJ;
-          if (const JVal* cs = obj.find("status"))
-            if (cs->type == JVal::OBJ) cur_status = *cs;
-          obj.set("status", merge_value(cur_status, spv, ""));
-        } else {
-          // merge-patch on metadata + spec with null deletion; top-level
-          // key replace within each section (mockserver.patch_meta)
-          for (const char* section : {"metadata", "spec"}) {
-            const JVal* sec_patch =
-                patch.is_obj() ? patch.find(section) : nullptr;
-            if (!sec_patch || sec_patch->type != JVal::OBJ ||
-                sec_patch->obj.empty())
-              continue;
-            JVal& sec = obj.get_or_insert_obj(section);
-            for (const auto& kv : sec_patch->obj) {
-              if (kv.second.type == JVal::NUL) sec.erase(kv.first);
-              else sec.set(kv.first, kv.second);
+        ShardPtr sh = store.shard_of(m.kind, m.ns, /*create=*/false);
+        bool found = false;
+        if (sh) {
+          std::lock_guard<std::mutex> sl(sh->smu);
+          auto it = sh->objs.find(m.name);
+          if (it != sh->objs.end()) {
+            found = true;
+            JVal obj = it->second->obj;  // copy-on-write
+            if (m.status) {
+              // strategic-merge on the status subresource; accept
+              // either a {"status": {...}} wrapper or a bare status
+              // document
+              const JVal* sp =
+                  patch.is_obj() ? patch.find("status") : nullptr;
+              const JVal& spv = sp ? *sp : patch;
+              JVal cur_status;
+              cur_status.type = JVal::OBJ;
+              if (const JVal* cs = obj.find("status"))
+                if (cs->type == JVal::OBJ) cur_status = *cs;
+              obj.set("status", merge_value(cur_status, spv, ""));
+            } else {
+              // merge-patch on metadata + spec with null deletion;
+              // top-level key replace within each section
+              // (mockserver.patch_meta)
+              for (const char* section : {"metadata", "spec"}) {
+                const JVal* sec_patch =
+                    patch.is_obj() ? patch.find(section) : nullptr;
+                if (!sec_patch || sec_patch->type != JVal::OBJ ||
+                    sec_patch->obj.empty())
+                  continue;
+                JVal& sec = obj.get_or_insert_obj(section);
+                for (const auto& kv : sec_patch->obj) {
+                  if (kv.second.type == JVal::NUL) sec.erase(kv.first);
+                  else sec.set(kv.first, kv.second);
+                }
+              }
             }
+            EntryPtr prev = it->second;
+            std::lock_guard<std::mutex> lk(store.mu);
+            EntryPtr e = store.commit_locked(
+                m.kind, "MODIFIED", std::move(obj), key, std::move(prev),
+                pt.on ? &pt.us[PH_FANOUT] : nullptr, sh.get());
+            it->second = e;
+            body = e->bytes;
+            committed = true;
           }
         }
-        store.bump(obj);
-        EntryPtr e = publish(std::move(obj));
-        EntryPtr prev = it->second;
-        it->second = e;
-        store.emit(m.kind, "MODIFIED", e, key, std::move(prev),
-                   pt.on ? &pt.us[PH_FANOUT] : nullptr);
-        body = e->bytes;
+        if (!found) {
+          code = 404;
+          body = "{\"kind\":\"Status\",\"code\":404}";
+        }
       }
     }
+    wake_ring = committed;
     pt.mark(PH_COMMIT);
     if (fenced) return fencing_409();
     return respond(code, body);
@@ -3081,58 +3501,426 @@ bool App::handle_request(ConnIO& io, Request& req) {
       }
     }
     bool fenced = false;
+    bool committed = false;
     {
-      std::lock_guard<std::mutex> lk(store.mu);
-      auto it = store.kinds[m.kind].end();
-      if (!fence_ok_locked()) {
-        fenced = true;  // check+commit atomic: respond after the lock
-      } else if ((it = store.kinds[m.kind].find(key)) !=
-                 store.kinds[m.kind].end()) {
-        JVal obj = it->second->obj;  // copy-on-write
-        if (!grace_given && m.kind == 1) {
-          // DeleteOptions omitted: server default for pods is
-          // spec.terminationGracePeriodSeconds or 30 (mirrors
-          // mockserver.py FakeKube.delete)
-          grace = 30;
-          const JVal* spec = obj.find("spec");
-          const JVal* tg =
-              spec && spec->is_obj()
-                  ? spec->find("terminationGracePeriodSeconds")
-                  : nullptr;
-          if (tg && tg->type == JVal::NUM) grace = atol(tg->s.c_str());
-        }
-        JVal& meta = obj.get_or_insert_obj("metadata");
-        const JVal* fins = meta.find("finalizers");
-        bool has_fins =
-            fins && fins->type == JVal::ARR && !fins->arr.empty();
-        if (m.kind == 1 && (grace > 0 || has_fins)) {
-          // graceful: mark, wait for the kubelet (engine) to force-delete
-          if (!meta.find("deletionTimestamp"))
-            meta.set("deletionTimestamp", JVal::str(now_rfc3339()));
-          meta.set("deletionGracePeriodSeconds",
-                   JVal::num_raw(std::to_string(grace)));
-          store.bump(obj);
-          EntryPtr e = publish(std::move(obj));
-          EntryPtr prev = it->second;
-          it->second = e;
-          store.emit(m.kind, "MODIFIED", e, key, std::move(prev),
-                     pt.on ? &pt.us[PH_FANOUT] : nullptr);
-        } else {
-          EntryPtr prev = it->second;
-          store.kinds[m.kind].erase(it);
-          store.bump(obj);
-          EntryPtr de = publish(std::move(obj));
-          store.emit(m.kind, "DELETED", de, key, std::move(prev),
-                     pt.on ? &pt.us[PH_FANOUT] : nullptr);
+      std::unique_lock<std::mutex> fence_lk;
+      if (!fence_check(fence_lk)) {
+        fenced = true;  // check+commit atomic: respond after the locks
+      } else {
+        ShardPtr sh = store.shard_of(m.kind, m.ns, /*create=*/false);
+        if (sh) {
+          std::lock_guard<std::mutex> sl(sh->smu);
+          auto it = sh->objs.find(m.name);
+          if (it != sh->objs.end()) {
+            JVal obj = it->second->obj;  // copy-on-write
+            if (!grace_given && m.kind == 1) {
+              // DeleteOptions omitted: server default for pods is
+              // spec.terminationGracePeriodSeconds or 30 (mirrors
+              // mockserver.py FakeKube.delete)
+              grace = 30;
+              const JVal* spec = obj.find("spec");
+              const JVal* tg =
+                  spec && spec->is_obj()
+                      ? spec->find("terminationGracePeriodSeconds")
+                      : nullptr;
+              if (tg && tg->type == JVal::NUM) grace = atol(tg->s.c_str());
+            }
+            JVal& meta = obj.get_or_insert_obj("metadata");
+            const JVal* fins = meta.find("finalizers");
+            bool has_fins =
+                fins && fins->type == JVal::ARR && !fins->arr.empty();
+            if (m.kind == 1 && (grace > 0 || has_fins)) {
+              // graceful: mark, wait for the kubelet (engine) to
+              // force-delete
+              if (!meta.find("deletionTimestamp"))
+                meta.set("deletionTimestamp", JVal::str(now_rfc3339()));
+              meta.set("deletionGracePeriodSeconds",
+                       JVal::num_raw(std::to_string(grace)));
+              EntryPtr prev = it->second;
+              std::lock_guard<std::mutex> lk(store.mu);
+              it->second = store.commit_locked(
+                  m.kind, "MODIFIED", std::move(obj), key,
+                  std::move(prev), pt.on ? &pt.us[PH_FANOUT] : nullptr,
+                  sh.get());
+            } else {
+              EntryPtr prev = it->second;
+              sh->objs.erase(it);
+              std::lock_guard<std::mutex> lk(store.mu);
+              store.commit_locked(
+                  m.kind, "DELETED", std::move(obj), key,
+                  std::move(prev), pt.on ? &pt.us[PH_FANOUT] : nullptr,
+                  sh.get());
+            }
+            committed = true;
+          }
         }
       }
     }
+    wake_ring = committed;
     pt.mark(PH_COMMIT);
     if (fenced) return fencing_409();
     return respond(200, "{\"kind\":\"Status\",\"status\":\"Success\"}");
   }
 
   return respond(404, "{\"kind\":\"Status\",\"code\":404}");
+}
+
+// One request's timing close-out for the batched write path (mutating
+// verbs only — never a watch shape). A batched item's phases are its
+// OWN work slices (pt.last is re-baselined between the transaction's
+// phases), so its "total" is the sum of those slices — the request's
+// server-side processing time, excluding the queueing behind its
+// batch-mates, exactly as the unary pipelined path excludes the
+// queueing behind earlier requests by stamping t_start at pick-up.
+static void finish_write_timing(const Request& req, PhaseTimer& pt,
+                                int code, const std::string& uri) {
+  if (!req.t_start) return;
+  pt.mark(PH_ENCODE);
+  uint64_t t0 = req.t_start;
+  uint64_t t_hdr = req.t_hdr ? req.t_hdr : t0;
+  uint64_t t_body = req.t_body ? req.t_body : t_hdr;
+  pt.us[PH_READ_HEADERS] = (double)(t_hdr - t0) / 1000.0;
+  pt.us[PH_READ_BODY] = (double)(t_body - t_hdr) / 1000.0;
+  double total_us = pt.us[PH_READ_HEADERS] + pt.us[PH_READ_BODY] +
+                    pt.us[PH_PARSE] + pt.us[PH_COMMIT] + pt.us[PH_ENCODE];
+  uint64_t t_end = t0 + (uint64_t)(total_us * 1000.0);
+  g_phase_hist[PH_READ_HEADERS].observe_ns(t_hdr - t0);
+  g_phase_hist[PH_READ_BODY].observe_ns(t_body - t_hdr);
+  g_phase_hist[PH_COMMIT].observe_ns((uint64_t)(pt.us[PH_COMMIT] * 1000.0));
+  g_phase_hist[PH_ENCODE].observe_ns((uint64_t)(pt.us[PH_ENCODE] * 1000.0));
+  if (pt.parsed)
+    g_phase_hist[PH_PARSE].observe_ns((uint64_t)(pt.us[PH_PARSE] * 1000.0));
+  if (pt.us[PH_FANOUT] > 0)
+    g_phase_hist[PH_FANOUT].observe_ns(
+        (uint64_t)(pt.us[PH_FANOUT] * 1000.0));
+  int vi = 5;
+  if (req.method == "POST") vi = 2;
+  else if (req.method == "PATCH") vi = 3;
+  else if (req.method == "DELETE") vi = 4;
+  g_verb_hist[vi].observe_ns(t_end - t0);
+  FlightRec rec;
+  rec.method = req.method;
+  rec.path = uri;
+  rec.status = code;
+  rec.band = "mutating";  // batchable shapes are all mutating verbs
+  rec.ts_unix = wall_unix_s() - total_us / 1e6;
+  rec.total_us = total_us;
+  for (int p = 0; p < N_PHASES; p++) rec.phases_us[p] = pt.us[p];
+  flight_record(std::move(rec));
+}
+
+// Applies ONE batchable write with the owning shard's smu AND store.mu
+// held by the caller (the batched transaction holds them once per
+// consecutive same-shard run). Mirrors handle_request's unary verbs —
+// the batched-write parity twin pins the rv sequence and response bytes
+// against the Python server, which processes the same pipelined batch
+// request-by-request. Returns whether an event committed.
+static bool apply_write_locked(Store& store, Shard& sh, const PathMatch& m,
+                               const Request& req, JVal& body,
+                               bool parse_ok, PhaseTimer& pt, int* code,
+                               std::string* resp, bool* need_evict) {
+  double* fan = pt.on ? &pt.us[PH_FANOUT] : nullptr;
+  Key key{m.ns, m.name};
+  if (req.method == "POST" && m.binding) {
+    const JVal* target = body.is_obj() ? body.find("target") : nullptr;
+    const JVal* tname =
+        target && target->is_obj() ? target->find("name") : nullptr;
+    std::string node = tname && tname->type == JVal::STR ? tname->s : "";
+    auto it = sh.objs.find(m.name);
+    if (it == sh.objs.end()) {
+      *code = 404;
+      *resp = "{\"kind\":\"Status\",\"code\":404}";
+      return false;
+    }
+    JVal obj = it->second->obj;  // copy-on-write
+    JVal& spec = obj.get_or_insert_obj("spec");
+    const JVal* cur = spec.find("nodeName");
+    if (cur && cur->type == JVal::STR && !cur->s.empty()) {
+      *code = 409;
+      std::string b =
+          "{\"kind\":\"Status\",\"status\":\"Failure\",\"reason\":"
+          "\"Conflict\",\"message\":\"pod ";
+      json_escape(b, m.name);
+      b += " is already assigned to node ";
+      json_escape(b, cur->s);
+      b += "\",\"code\":409}";
+      *resp = std::move(b);
+      return false;
+    }
+    spec.set("nodeName", JVal::str(node));
+    EntryPtr prev = it->second;
+    it->second = store.commit_locked(1, "MODIFIED", std::move(obj), key,
+                                     std::move(prev), fan, &sh);
+    *code = 201;
+    *resp = "{\"kind\":\"Status\",\"status\":\"Success\",\"code\":201}";
+    return true;
+  }
+  if (req.method == "POST") {
+    if (!parse_ok || body.type != JVal::OBJ) {
+      *code = 400;
+      *resp = "{\"kind\":\"Status\",\"code\":400}";
+      return false;
+    }
+    JVal obj = std::move(body);
+    JVal& meta = obj.get_or_insert_obj("metadata");
+    if (!m.ns.empty()) meta.set("namespace", JVal::str(m.ns));
+    if (!meta.find("name")) {
+      const JVal* gn = meta.find("generateName");
+      if (gn && gn->type == JVal::STR && !gn->s.empty()) {
+        static const char hexd[] = "0123456789abcdef";
+        static std::atomic<uint64_t> ctr{0};
+        while (true) {
+          uint64_t x = (uint64_t)time(nullptr) * 1000003u +
+                       ctr.fetch_add(1) * 2654435761u;
+          std::string suffix;
+          for (int i = 0; i < 5; i++) {
+            suffix += hexd[x & 15];
+            x >>= 4;
+          }
+          std::string name = gn->s + suffix;
+          if (!sh.objs.count(name)) {
+            meta.set("name", JVal::str(name));
+            break;
+          }
+        }
+      }
+    }
+    Key k = Store::obj_key(obj);
+    if (k.second.empty()) {
+      *code = 400;
+      *resp = "{\"kind\":\"Status\",\"code\":400}";
+      return false;
+    }
+    if (sh.objs.count(k.second)) {
+      *code = 409;
+      std::string b =
+          "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+          "\"Failure\",\"message\":\"";
+      json_escape(b, KIND_NAMES[m.kind]);
+      b += " \\\"";
+      json_escape(b, k.second);
+      b += "\\\" already exists\",\"reason\":\"AlreadyExists\","
+           "\"code\":409}";
+      *resp = std::move(b);
+      return false;
+    }
+    if (!meta.find("creationTimestamp"))
+      meta.set("creationTimestamp", JVal::str(now_rfc3339()));
+    EntryPtr e = store.commit_locked(m.kind, "ADDED", std::move(obj), k,
+                                     nullptr, fan, &sh,
+                                     /*stamp_uid=*/true);
+    sh.objs[k.second] = e;
+    *code = 201;
+    *resp = e->bytes;
+    if (m.kind == kind_index("events")) *need_evict = true;
+    return true;
+  }
+  if (req.method == "PATCH") {
+    if (!parse_ok) {
+      *code = 400;
+      *resp = "{\"kind\":\"Status\",\"code\":400}";
+      return false;
+    }
+    auto it = sh.objs.find(m.name);
+    if (it == sh.objs.end()) {
+      *code = 404;
+      *resp = "{\"kind\":\"Status\",\"code\":404}";
+      return false;
+    }
+    JVal obj = it->second->obj;  // copy-on-write
+    if (m.status) {
+      const JVal* sp = body.is_obj() ? body.find("status") : nullptr;
+      const JVal& spv = sp ? *sp : body;
+      JVal cur_status;
+      cur_status.type = JVal::OBJ;
+      if (const JVal* cs = obj.find("status"))
+        if (cs->type == JVal::OBJ) cur_status = *cs;
+      obj.set("status", merge_value(cur_status, spv, ""));
+    } else {
+      for (const char* section : {"metadata", "spec"}) {
+        const JVal* sec_patch =
+            body.is_obj() ? body.find(section) : nullptr;
+        if (!sec_patch || sec_patch->type != JVal::OBJ ||
+            sec_patch->obj.empty())
+          continue;
+        JVal& sec = obj.get_or_insert_obj(section);
+        for (const auto& kv : sec_patch->obj) {
+          if (kv.second.type == JVal::NUL) sec.erase(kv.first);
+          else sec.set(kv.first, kv.second);
+        }
+      }
+    }
+    EntryPtr prev = it->second;
+    EntryPtr e = store.commit_locked(m.kind, "MODIFIED", std::move(obj),
+                                     key, std::move(prev), fan, &sh);
+    it->second = e;
+    *code = 200;
+    *resp = e->bytes;
+    return true;
+  }
+  // DELETE
+  long grace = 0;
+  bool grace_given = false;
+  const JVal* g = body.is_obj() ? body.find("gracePeriodSeconds") : nullptr;
+  if (g && g->type == JVal::NUM) {
+    grace = atol(g->s.c_str());
+    grace_given = true;
+  }
+  bool committed = false;
+  auto it = sh.objs.find(m.name);
+  if (it != sh.objs.end()) {
+    JVal obj = it->second->obj;  // copy-on-write
+    if (!grace_given && m.kind == 1) {
+      grace = 30;
+      const JVal* spec = obj.find("spec");
+      const JVal* tg = spec && spec->is_obj()
+                           ? spec->find("terminationGracePeriodSeconds")
+                           : nullptr;
+      if (tg && tg->type == JVal::NUM) grace = atol(tg->s.c_str());
+    }
+    JVal& meta = obj.get_or_insert_obj("metadata");
+    const JVal* fins = meta.find("finalizers");
+    bool has_fins = fins && fins->type == JVal::ARR && !fins->arr.empty();
+    if (m.kind == 1 && (grace > 0 || has_fins)) {
+      if (!meta.find("deletionTimestamp"))
+        meta.set("deletionTimestamp", JVal::str(now_rfc3339()));
+      meta.set("deletionGracePeriodSeconds",
+               JVal::num_raw(std::to_string(grace)));
+      EntryPtr prev = it->second;
+      it->second = store.commit_locked(m.kind, "MODIFIED", std::move(obj),
+                                       key, std::move(prev), fan, &sh);
+    } else {
+      EntryPtr prev = it->second;
+      sh.objs.erase(it);
+      store.commit_locked(m.kind, "DELETED", std::move(obj), key,
+                          std::move(prev), fan, &sh);
+    }
+    committed = true;
+  }
+  *code = 200;
+  *resp = "{\"kind\":\"Status\",\"status\":\"Success\"}";
+  return committed;
+}
+
+// The batched write transaction (ISSUE 13): N creates/binds/status-
+// patches that arrived in one socket read (the native pump pipelines
+// whole frames) execute as consecutive same-shard runs, each under ONE
+// shard-lock + ONE clock-lock hold, with ONE rv allocation run, one
+// ring append per event and a single watcher wake for the whole batch —
+// instead of N lock/notify round-trips. Admission still answers 429 per
+// request; responses/audit/timing are per request, in arrival order.
+size_t App::exec_write_batch(ConnIO& io, std::vector<Request>& batch) {
+  struct Item {
+    PathMatch m;
+    JVal body;
+    bool parse_ok = false;
+    PhaseTimer pt;
+    int code = 0;
+    std::string resp;
+    bool unauthorized = false;
+    bool rejected = false;  // admission 429
+    bool need_evict = false;
+  };
+  std::vector<Item> items(batch.size());
+  // phase 1: auth + body parse, no locks (admission is taken per item
+  // in phase 2 — one slot at a time, like the sequential unary path)
+  for (size_t i = 0; i < batch.size(); i++) {
+    Request& rq = batch[i];
+    Item& it = items[i];
+    it.m = match_path(rq.path);
+    if (rq.t_start) {
+      it.pt.on = true;
+      it.pt.last = rq.t_body ? rq.t_body : now_ns();
+    }
+    if (!auth_tokens.empty() &&
+        (rq.auth.rfind("Bearer ", 0) != 0 ||
+         !auth_tokens.count(rq.auth.substr(7)))) {
+      it.unauthorized = true;
+      it.code = 401;
+      it.resp =
+          "{\"kind\":\"Status\",\"apiVersion\":\"v1\",\"status\":"
+          "\"Failure\",\"reason\":\"Unauthorized\",\"message\":"
+          "\"Unauthorized\",\"code\":401}";
+      continue;
+    }
+    if (it.pt.on) it.pt.last = now_ns();  // re-baseline: own parse slice
+    JParser p(rq.body);
+    it.body = p.parse();
+    it.pt.mark(PH_PARSE);
+    if (p.ok) {
+      it.parse_ok = true;
+      it.pt.parsed = true;
+    }
+  }
+  // phase 2: the store transaction — consecutive same-(kind, ns) runs
+  // under one shard+clock hold; one ring wake for the whole batch
+  bool committed_any = false;
+  bool any_evict = false;
+  size_t i = 0;
+  while (i < batch.size()) {
+    if (items[i].unauthorized || items[i].rejected) {
+      i++;
+      continue;
+    }
+    size_t j = i + 1;
+    while (j < batch.size() && !items[j].unauthorized &&
+           !items[j].rejected && items[j].m.kind == items[i].m.kind &&
+           items[j].m.ns == items[i].m.ns)
+      j++;
+    ShardPtr sh = store.shard_of(items[i].m.kind, items[i].m.ns);
+    {
+      std::lock_guard<std::mutex> sl(sh->smu);
+      std::lock_guard<std::mutex> lk(store.mu);
+      for (size_t k2 = i; k2 < j; k2++) {
+        Item& it = items[k2];
+        // admission: one slot held per ITEM, acquired and released in
+        // sequence — a connection's own pipelined burst must not
+        // self-saturate the mutating band (the unary path, and the
+        // Python twin working through the same bytes, only ever hold
+        // one slot per connection at a time)
+        if (max_inflight_band[1] > 0) {
+          if (inflight[1].fetch_add(1) + 1 > max_inflight_band[1]) {
+            inflight[1].fetch_sub(1);
+            rejected[1].fetch_add(1);
+            it.rejected = true;
+            it.code = 429;
+            it.resp = TOO_MANY_REQUESTS_BODY;
+            continue;
+          }
+        }
+        // re-baseline: the commit phase is THIS item's store work, not
+        // the wait behind its batch-mates (see finish_write_timing)
+        if (it.pt.on) it.pt.last = now_ns();
+        if (apply_write_locked(store, *sh, it.m, batch[k2], it.body,
+                               it.parse_ok, it.pt, &it.code, &it.resp,
+                               &it.need_evict))
+          committed_any = true;
+        it.pt.mark(PH_COMMIT);
+        if (it.need_evict) any_evict = true;
+        if (max_inflight_band[1] > 0) inflight[1].fetch_sub(1);
+      }
+    }
+    i = j;
+  }
+  if (any_evict) evict_events(nullptr);
+  // phase 3: responses + audit + timing, in arrival order (the ring
+  // wake rides AFTER the whole batch's responses, like the unary path)
+  for (size_t k2 = 0; k2 < batch.size(); k2++) {
+    Request& rq = batch[k2];
+    Item& it = items[k2];
+    std::string uri = rq.path;
+    if (!rq.query.empty()) uri += "?" + rq.query;
+    audit_line(rq.method, uri, it.code);
+    if (it.pt.on) it.pt.last = now_ns();  // re-baseline: own encode slice
+    queue_response(io, it.code, it.resp,
+                   it.code == 429 ? "Retry-After: 1\r\n" : "");
+    finish_write_timing(rq, it.pt, it.code, uri);
+  }
+  if (committed_any) {
+    io.flush();  // the batch's answers hit the wire before the herd wakes
+    store.ring_cv.notify_all();
+  }
+  return batch.size();
 }
 
 void App::handle_conn(int fd) {
@@ -3142,6 +3930,43 @@ void App::handle_conn(int fd) {
   io.fd = fd;
   Request req;
   while (!stopping.load() && read_request(io, req)) {
+    // batched write transactions (ISSUE 13): when the socket read that
+    // carried this request brought MORE complete batchable writes (the
+    // native pump pipelines whole frames), absorb the run into one
+    // store transaction instead of paying per-request lock/notify
+    // round-trips. Anything else — reads, watches, ops paths, fenced
+    // writes — takes the unary path unchanged.
+    // only a request whose body ALREADY arrived may batch: a slow sender
+    // must take the unary path, where the admission slot spans the
+    // blocking body read (the 429 saturation contract)
+    if (batchable_write(req) &&
+        io.in.size() - io.off >= req.content_len) {
+      if (!read_body(io, req)) break;
+      std::vector<Request> batch;
+      batch.push_back(std::move(req));
+      Request leftover;
+      bool have_leftover = false;
+      while (batch.size() < 256) {
+        Request nxt;
+        if (!peek_buffered_request(io, nxt)) break;
+        if (batchable_write(nxt)) {
+          batch.push_back(std::move(nxt));
+        } else {
+          leftover = std::move(nxt);
+          have_leftover = true;
+          break;
+        }
+      }
+      if (batch.size() == 1 && !have_leftover) {
+        // nothing arrived with it: the unary path keeps its exact
+        // admission/fencing slot semantics for singletons
+        if (!handle_request(io, batch[0])) break;
+        continue;
+      }
+      exec_write_batch(io, batch);
+      if (have_leftover && !handle_request(io, leftover)) break;
+      continue;
+    }
     if (!handle_request(io, req)) break;
   }
   io.flush();  // peer may close after its last response arrives
@@ -3190,7 +4015,13 @@ int main(int argc, char** argv) {
 
   signal(SIGPIPE, SIG_IGN);
 
-  App app;
+  // Heap-allocated and deliberately LEAKED: detached watch threads wait
+  // on the store's shared ring condition variable, and destroying a cv
+  // with live waiters (a stack App dying as main returns) is UB that
+  // blocks glibc's pthread_cond_destroy — the process would hang on
+  // SIGTERM exactly when watchers are attached. exit() reaps the
+  // threads; the one App simply never destructs.
+  App& app = *new App();
   g_app = &app;
   app.data_file = data_file;
   app.max_inflight_band[0] = max_ro;
@@ -3316,6 +4147,14 @@ int main(int argc, char** argv) {
     std::thread(&App::handle_conn, &app, cfd).detach();
   }
   if (bookmark_thread.joinable()) bookmark_thread.join();
+  // shutting down terminates watch streams: wake every ring waiter so
+  // attached clients see EOF promptly instead of at process teardown
+  {
+    std::lock_guard<std::mutex> lk(app.store.ring_mu);
+    for (auto& w : app.store.watches)
+      app.store.close_watch_locked(w, /*slow=*/false);
+  }
+  app.store.ring_cv.notify_all();
   app.persist();
   return 0;
 }

@@ -123,10 +123,10 @@ class ApiserverTiming:
             maxlen=FLIGHT_CAPACITY
         )
         self.captured = 0
-        #: high-watermark of any capped per-watcher send-buffer push —
-        #: always tracked (one int max per queued event), because the
-        #: fleet gate's bounded-buffer proof must not depend on the
-        #: timing env knob
+        #: high-watermark of retained ring-cursor lag (ISSUE 13: the
+        #: bounded-buffer proof folded into the broadcast ring) — always
+        #: tracked, because the fleet gate's bounded-buffer proof must
+        #: not depend on the timing env knob
         self.backlog_peak = 0
         self.fanout_pushes = 0
         self.tls = threading.local()
@@ -139,10 +139,11 @@ class ApiserverTiming:
         self.tls.fanout_s = 0.0
         return time.perf_counter()
 
-    def note_fanout(self, seconds: float, pushes: int) -> None:
-        """Called by the store's emit loop (same thread as the handler
-        that triggered the write)."""
-        self.fanout_pushes += pushes
+    def note_fanout(self, seconds: float) -> None:
+        """Called by the store's commit section after the one ring
+        encode+append (same thread as the handler that triggered the
+        write); the push COUNT is accounted separately at emit
+        (events x live watchers of the kind)."""
         if getattr(self.tls, "fanout_s", None) is not None:
             self.tls.fanout_s += seconds
 
@@ -203,8 +204,8 @@ APISERVER_METRICS_HELP = {
     "kwok_apiserver_request_phase_seconds": (
         "Per-request phase seconds inside the mock apiserver "
         "(read_headers+read_body+parse+commit+encode reconcile to the "
-        "request total; fanout is the per-watcher encode+push subset of "
-        "commit and is excluded from the sum)"
+        "request total; fanout is the serialize-once ring encode+append "
+        "subset of commit and is excluded from the sum)"
     ),
     "kwok_apiserver_request_seconds": (
         "End-to-end seconds per unary request by audit verb (first "
@@ -212,18 +213,33 @@ APISERVER_METRICS_HELP = {
         "running and excluded)"
     ),
     "kwok_watch_fanout_total": (
-        "Watch events pushed to individual watchers (one increment per "
-        "matching watcher per event; fanout_sum over this count is the "
-        "per-watcher encode+push cost)"
+        "Watch events delivered to individual watchers via the "
+        "broadcast ring (events x live watchers of the kind at emit; "
+        "fanout_sum over this count is the AMORTIZED per-watcher encode "
+        "cost — the ring encodes once and shares the bytes)"
     ),
     "kwok_apiserver_watchers": (
         "Live watch streams currently registered"
     ),
     "kwok_watch_backlog_events": (
-        "Per-watcher send-buffer depth across live watches (agg=max/"
-        "total) and the high-watermark of any capped push (agg=peak; "
+        "Per-watcher ring-cursor lag across live watches (agg=max/"
+        "total) and the high-watermark of retained lag (agg=peak; "
         "never exceeds KWOK_TPU_WATCH_BACKLOG while the slow-consumer "
-        "cap enforces)"
+        "cap enforces — the bounded-buffer proof, now measured as ring "
+        "lag)"
+    ),
+    "kwok_watch_ring_lag": (
+        "Ring-cursor lag behind the serialize-once broadcast ring head "
+        "per live watch stream (agg=max/total) and its all-time "
+        "high-watermark (agg=peak, clamped to the backlog cap on a "
+        "slow-close; identical to kwok_watch_backlog_events by "
+        "construction — the explicit ring-surface name)"
+    ),
+    "kwok_watch_encode_total": (
+        "Watch events encoded into the broadcast ring — exactly ONE "
+        "encode per event no matter the watcher count (the "
+        "serialize-once proof; kwok_watch_fanout_total counts the "
+        "deliveries the shared bytes fan out to)"
     ),
 }
 
@@ -290,12 +306,15 @@ def _hist_lines(
     return out
 
 
-def render_timing_metrics(timing: ApiserverTiming, backlogs) -> bytes:
+def render_timing_metrics(
+    timing: ApiserverTiming, backlogs, encode_total: int = 0
+) -> bytes:
     """The phase-timing families, appended to the overload surface by both
     servers' /metrics handlers. Always renders the FULL phase/verb matrix
     (zero counts when nothing was observed, or when timing is disabled)
     so scrapes — and the byte-compared parity twins — are shape-stable.
-    ``backlogs`` is the live per-watcher send-buffer depths."""
+    ``backlogs`` is the live per-watcher ring-cursor lags;
+    ``encode_total`` the store's one-encode-per-event ring counter."""
     lines: list[str] = []
 
     def fam(name: str, type_: str, samples: list) -> None:
@@ -330,15 +349,27 @@ def render_timing_metrics(timing: ApiserverTiming, backlogs) -> bytes:
         "kwok_apiserver_watchers", "gauge",
         [f"kwok_apiserver_watchers {len(backlogs)}"],
     )
+    lag_samples = [
+        str(max(backlogs) if backlogs else 0),
+        str(sum(backlogs)),
+        str(int(timing.backlog_peak)),
+    ]
     fam(
         "kwok_watch_backlog_events", "gauge",
         [
-            'kwok_watch_backlog_events{agg="max"} '
-            + str(max(backlogs) if backlogs else 0),
-            'kwok_watch_backlog_events{agg="total"} '
-            + str(sum(backlogs)),
-            'kwok_watch_backlog_events{agg="peak"} '
-            + str(int(timing.backlog_peak)),
+            f'kwok_watch_backlog_events{{agg="{agg}"}} {v}'
+            for agg, v in zip(("max", "total", "peak"), lag_samples)
         ],
+    )
+    fam(
+        "kwok_watch_ring_lag", "gauge",
+        [
+            f'kwok_watch_ring_lag{{agg="{agg}"}} {v}'
+            for agg, v in zip(("max", "total", "peak"), lag_samples)
+        ],
+    )
+    fam(
+        "kwok_watch_encode_total", "counter",
+        [f"kwok_watch_encode_total {int(encode_total)}"],
     )
     return ("\n".join(lines) + "\n").encode()

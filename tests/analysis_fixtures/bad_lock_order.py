@@ -17,6 +17,8 @@ class Engine:
         self._alloc_lock = threading.Lock()
         self._gen_lock = threading.Lock()
         self._dead_lock = threading.Lock()  # F: unused-lock
+        self._pool_lock = threading.RLock()
+        self._pool_cond = threading.Condition(self._pool_lock)
         self.q = None
         self.t = None
 
@@ -59,6 +61,19 @@ class Engine:
     def take_alloc(self):
         with self._alloc_lock:
             pass
+
+    def cond_wait_own_lock_ok(self):
+        # Condition.wait() under the lock that BACKS the condition
+        # atomically releases it while sleeping (paired by the
+        # <stem>_cond / <stem>_lock naming convention): no finding
+        with self._pool_lock:
+            self._pool_cond.wait()
+
+    def cond_wait_foreign_lock(self):
+        # ...but the same wait while holding any OTHER lock still
+        # convoys that lock
+        with self._alloc_lock:
+            self._pool_cond.wait()  # F: blocking-under-lock
 
     def suppressed_ok(self):
         with self._alloc_lock:

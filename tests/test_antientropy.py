@@ -187,10 +187,13 @@ def test_missed_event_reingested():
     eng = _sync_engine(kube)
     _seed(eng, kube)
     pod = make_pod("ae-missed", node="ae-n")
-    with kube._lock:
-        kube._bump(pod)  # a real server revision, no event emitted
-        kube._store["pods"][kube._key("default", pod["metadata"]["name"])] \
-            = pod
+    sh = kube._shard("pods", "default")
+    with sh._shard_lock:
+        with kube._ring_lock:  # a real server revision, no event emitted
+            kube._rv += 1
+            pod.setdefault("metadata", {})["resourceVersion"] = str(kube._rv)
+            kube._counts["pods"] += 1
+        sh.objs[pod["metadata"]["name"]] = pod
     aud = _auditor(eng)
     aud.pass_once()
     assert aud.detected_total(reason="missed-event") == 1

@@ -40,6 +40,37 @@ def test_load_closes_watches():
     assert list(w) == []  # stop sentinel delivered -> iterator terminates
 
 
+def test_restore_racing_writer_records_nothing():
+    """A write that held its shard across a concurrent restore (review
+    regression pin): the registry swap happens under the ring lock, so
+    the commit detects its orphaned shard and records NOTHING — no
+    count drift, no ghost watch-cache event for resumed watchers — while
+    the client still gets the old atomic store's answer (committed,
+    then wiped by the restore)."""
+    from kwok_tpu.edge.kubeclient import MODIFIED
+
+    a = FakeKube()
+    a.create("pods", {"metadata": {"name": "rr", "namespace": "default"},
+                      "status": {"phase": "Pending"}})
+    sh = a._shard("pods", "default")
+    snap = a.dump()
+    with sh._shard_lock:
+        obj = sh.objs["rr"]
+        prev = a._shard_bytes_locked(sh, "rr")
+        # the restore lands while this writer holds the (now old) shard
+        a.load(snap)
+        obj["status"]["phase"] = "Failed"
+        data = a._commit_locked(
+            sh, "pods", ("default", "rr"), obj, MODIFIED, prev
+        )
+    assert b'"Failed"' in data  # the client's answer is still coherent
+    # ...but the restored world never saw it: counts intact, no ghost
+    # history entry, and the stored object is the snapshot's
+    assert a._counts["pods"] == 1
+    assert not a._history
+    assert a.get("pods", "default", "rr")["status"]["phase"] == "Pending"
+
+
 def test_http_snapshot_restore_endpoints():
     srv = HttpFakeApiserver()
     srv.start()

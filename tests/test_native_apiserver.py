@@ -1291,3 +1291,252 @@ def test_lease_hostile_body_parity(srv):
     assert _FK._lease_spec(
         {"holderIdentity": "x", "leaseDurationSeconds": float("inf")}
     ) == ("x", 0)
+
+
+# ------------------------------------------- ring + sharded store (ISSUE 13)
+# The serialize-once broadcast ring, the batched write transaction, and
+# the (kind, namespace)-sharded store are pinned the same way every other
+# surface is: identical drives, byte-compared answers.
+
+
+def _pipelined_writes(port: int, reqs, timeout=10.0):
+    """Send N requests in ONE socket write (the native pump's framing)
+    and read N responses; returns [(status, body_bytes)]. This is the
+    shape the batched write transaction absorbs — the Python server
+    processes the same bytes request-by-request, so the rv sequence and
+    response bytes pin the transaction's equivalence."""
+    wire = b""
+    for method, path, body in reqs:
+        wire += (
+            f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+    s = _socket.socket()
+    s.settimeout(timeout)
+    s.connect(("127.0.0.1", port))
+    s.sendall(wire)
+    buf = b""
+    out = []
+    want = len(reqs)
+    while len(out) < want:
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end < 0:
+            b = s.recv(65536)
+            if not b:
+                break
+            buf += b
+            continue
+        head = buf[:head_end]
+        status = int(head.split(b" ", 2)[1])
+        cl = 0
+        j = head.lower().find(b"content-length:")
+        if j >= 0:
+            e = head.find(b"\r\n", j)
+            cl = int(head[j + 15:e if e >= 0 else len(head)])
+        while len(buf) < head_end + 4 + cl:
+            buf += s.recv(65536)
+        out.append((status, buf[head_end + 4:head_end + 4 + cl]))
+        buf = buf[head_end + 4 + cl:]
+    s.close()
+    return out
+
+
+def test_batched_write_transaction_parity(srv):
+    """N creates + binds + status patches arriving in ONE socket read:
+    the native server's batched transaction must produce byte-identical
+    responses (and therefore the identical rv sequence) to the Python
+    server, which works through the same pipelined bytes one request at
+    a time — plus identical final objects on a follow-up GET."""
+    def drive(url):
+        port = int(url.rsplit(":", 1)[1])
+        reqs = []
+        for i in range(6):
+            pod = make_pod(f"bw-{i}", node="")
+            pod["spec"]["nodeName"] = ""
+            reqs.append((
+                "POST", "/api/v1/namespaces/default/pods",
+                json.dumps(pod, separators=(",", ":")).encode(),
+            ))
+        for i in range(6):
+            reqs.append((
+                "POST",
+                f"/api/v1/namespaces/default/pods/bw-{i}/binding",
+                json.dumps({
+                    "apiVersion": "v1", "kind": "Binding",
+                    "metadata": {"name": f"bw-{i}"},
+                    "target": {"kind": "Node", "name": "bw-n"},
+                }, separators=(",", ":")).encode(),
+            ))
+        for i in range(6):
+            reqs.append((
+                "PATCH",
+                f"/api/v1/namespaces/default/pods/bw-{i}/status",
+                json.dumps({"status": {"phase": "Running"}},
+                           separators=(",", ":")).encode(),
+            ))
+        # one delete rides along (grace 0 via body)
+        reqs.append((
+            "DELETE", "/api/v1/namespaces/default/pods/bw-5",
+            b'{"gracePeriodSeconds":0}',
+        ))
+        answers = _pipelined_writes(port, reqs)
+        c = HttpKubeClient(url)
+        finals = [c.get("pods", "default", f"bw-{i}") for i in range(6)]
+        c.close()
+        return answers, finals
+
+    native_ans, native_fin = drive(srv.url)
+    py = HttpFakeApiserver().start()
+    try:
+        python_ans, python_fin = drive(py.url)
+    finally:
+        py.stop()
+    assert len(native_ans) == len(python_ans) == 19
+    for i, ((nc, nb), (pc, pb)) in enumerate(zip(native_ans, python_ans)):
+        assert nc == pc, (i, nc, pc, nb, pb)
+        assert _mask_times(nb) == _mask_times(pb), (i, nb, pb)
+    # the rv sequence is inside the masked-compare above; assert shape too
+    rvs = [
+        json.loads(nb)["metadata"]["resourceVersion"]
+        for nc, nb in native_ans[:6]
+    ]
+    assert rvs == [str(int(rvs[0]) + i) for i in range(6)]
+    assert _mask_times(
+        json.dumps(native_fin, sort_keys=True).encode()
+    ) == _mask_times(json.dumps(python_fin, sort_keys=True).encode())
+
+
+def test_batched_writes_never_self_saturate_admission():
+    """A connection's own pipelined burst must not 429 itself (review
+    regression pin): the batched transaction takes ONE mutating slot at
+    a time, exactly like the sequential unary path and the Python twin
+    working through the same bytes — so with max-mutating-inflight=1,
+    8 pipelined creates all succeed on both servers."""
+    reqs = [
+        ("POST", "/api/v1/nodes",
+         json.dumps({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": f"sat-{i}"}},
+                    separators=(",", ":")).encode())
+        for i in range(8)
+    ]
+    results = {}
+    s = NativeServer(["--max-mutating-inflight", "1"])
+    try:
+        results["native"] = _pipelined_writes(
+            int(s.url.rsplit(":", 1)[1]), reqs)
+    finally:
+        s.stop()
+    py = HttpFakeApiserver(max_mutating_inflight=1).start()
+    try:
+        results["python"] = _pipelined_writes(py.port, reqs)
+    finally:
+        py.stop()
+    for name, out in results.items():
+        assert [c for c, _b in out] == [201] * 8, (name, out)
+    assert [_mask_times(b) for _c, b in results["native"]] == \
+        [_mask_times(b) for _c, b in results["python"]]
+
+
+def test_ring_metrics_parity_and_serialize_once(srv):
+    """kwok_watch_encode_total must count ONE encode per event no matter
+    the watcher count, and kwok_watch_fanout_total the deliveries
+    (events x watchers) — on both servers, with the ring-lag gauges
+    present. The serialize-once proof the tentpole claims."""
+    def drive(url):
+        c = HttpKubeClient(url)
+        watches = [c.watch("pods") for _ in range(3)]
+        threads = []
+        for w in watches:
+            t = threading.Thread(
+                target=lambda w=w: [None for _ in w], daemon=True
+            )
+            t.start()
+            threads.append(t)
+        time.sleep(0.3)
+        c.create("pods", make_pod("rm-p", node="n1"))
+        for i in range(4):
+            c.patch_status(
+                "pods", "default", "rm-p", {"status": {"phase": "Running"}}
+            )
+        time.sleep(0.3)
+        text = urllib.request.urlopen(url + "/metrics", timeout=5) \
+            .read().decode()
+        for w in watches:
+            w.stop()
+        c.close()
+        vals = {}
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            name, _, val = line.rpartition(" ")
+            vals[name] = float(val)
+        return vals
+
+    results = {"native": drive(srv.url)}
+    py = HttpFakeApiserver().start()
+    try:
+        results["python"] = drive(py.url)
+    finally:
+        py.stop()
+    for name, vals in results.items():
+        # 5 pod events (1 create + 4 patches) with 3 live pod watchers:
+        # exactly one encode per event, three deliveries per event
+        assert vals["kwok_watch_encode_total"] == 5, (name, vals)
+        assert vals["kwok_watch_fanout_total"] == 15, (name, vals)
+        for agg in ("max", "total", "peak"):
+            assert f'kwok_watch_ring_lag{{agg="{agg}"}}' in vals, name
+        # the lag gauges and the legacy backlog family agree (one data)
+        for agg in ("max", "total", "peak"):
+            assert vals[f'kwok_watch_ring_lag{{agg="{agg}"}}'] == \
+                vals[f'kwok_watch_backlog_events{{agg="{agg}"}}'], name
+
+
+def test_sharded_snapshot_ordering_parity(srv):
+    """Objects created across namespaces OUT of key order: /snapshot must
+    serialize them in (namespace, name) order on BOTH servers — the
+    sharded store's ns-shard concatenation IS the old single map's sorted
+    order (restore/snapshot ordering twin)."""
+    def drive(url):
+        c = HttpKubeClient(url)
+        seq = [
+            ("zeta", "p-b"), ("alpha", "p-z"), ("zeta", "p-a"),
+            ("alpha", "p-a"), ("mid", "p-m"),
+        ]
+        for ns, name in seq:
+            pod = make_pod(name, node="n1")
+            pod["metadata"]["namespace"] = ns
+            c.create("pods", pod)
+        c.create("nodes", make_node("zz-n"))
+        c.create("nodes", make_node("aa-n"))
+        snap = json.loads(_raw_get(url, "/snapshot"))
+        c.close()
+        return snap
+
+    def _raw_get(url, path):
+        return urllib.request.urlopen(url + path, timeout=5).read()
+
+    native_snap = drive(srv.url)
+    py = HttpFakeApiserver().start()
+    try:
+        python_snap = drive(py.url)
+    finally:
+        py.stop()
+    n_keys = [
+        (p["metadata"].get("namespace"), p["metadata"]["name"])
+        for p in native_snap["objects"]["pods"]
+    ]
+    p_keys = [
+        (p["metadata"].get("namespace"), p["metadata"]["name"])
+        for p in python_snap["objects"]["pods"]
+    ]
+    assert n_keys == sorted(n_keys), n_keys
+    assert n_keys == p_keys
+    assert [n["metadata"]["name"] for n in native_snap["objects"]["nodes"]] \
+        == ["aa-n", "zz-n"] \
+        == [n["metadata"]["name"] for n in python_snap["objects"]["nodes"]]
+    # whole-store byte parity, timestamps masked
+    assert _mask_times(json.dumps(
+        native_snap["objects"], sort_keys=True).encode()
+    ) == _mask_times(json.dumps(
+        python_snap["objects"], sort_keys=True).encode())

@@ -100,6 +100,48 @@ def test_window_overflow_compacts_oldest(monkeypatch):
     w.stop()
 
 
+def test_graceful_stop_still_delivers_pending_events():
+    """Events sequenced before a graceful stop() survive concurrent
+    commits that trim the ring (review regression pin): the close moves
+    the watch's pending matching events into its private replay, so a
+    consumer draining after stop() sees exactly what the old per-watcher
+    queue delivered — the pre-stop backlog, then the end."""
+    kube = FakeKube()
+    w = kube.watch("nodes")
+    other = kube.watch("nodes")  # keeps the ring encoding after w stops
+    kube.create("nodes", make_node("gs-a"))  # pending for BOTH watches
+    w.stop()
+    # drain the live watch and commit again: the trim drops everything
+    # the live cursors consumed — w's pending must already be private
+    assert other.q.get_nowait().object["metadata"]["name"] == "gs-a"
+    kube.create("nodes", make_node("gs-b"))
+    got = [ev.object["metadata"]["name"] for ev in w]
+    assert got == ["gs-a"], got  # pre-stop event delivered, post-stop not
+    other.stop()
+
+
+def test_stopped_watch_releases_kind_watcher_count():
+    """A client-side stop() must drop the per-kind live-watch count
+    (review regression pin): a leaked count would keep the broadcast
+    ring encoding events for kinds nobody watches and inflate
+    kwok_watch_fanout_total — silently under-reporting the amortized
+    per-watcher cost the attrib gate reads."""
+    kube = FakeKube()
+    w1 = kube.watch("nodes")
+    w2 = kube.watch("nodes")
+    kube.create("nodes", make_node("kw-a"))
+    assert kube.encode_total == 1
+    assert kube.timing.fanout_pushes == 2  # one event x two live watches
+    w1.stop()
+    kube.create("nodes", make_node("kw-b"))
+    assert kube.timing.fanout_pushes == 3  # one remaining watcher
+    w2.stop()
+    kube.create("nodes", make_node("kw-c"))
+    # no live watchers: nothing encoded, nothing counted
+    assert kube.encode_total == 2
+    assert kube.timing.fanout_pushes == 3
+
+
 def test_continue_token_expires_on_compact():
     kube = FakeKube()
     for i in range(6):
