@@ -38,10 +38,12 @@ obs-check: ## exposition-format + trace-schema oracle (docs/observability.md)
 # any test whose worker thread swallowed an exception, and the runtime
 # lock-order witness (analysis/witness.py) failing any test whose threads
 # acquired locks out of the declared order or formed an order-graph cycle.
-lane-check: ## sharded-lane ordering oracle + thread-sanity + lock-witness pass + router microbench gate
+lane-check: ## sharded-lane ordering oracle + thread-sanity + lock-witness pass + router/emit microbench gates
 	$(PYENV) PYTHONDEVMODE=1 KWOK_TPU_LOCK_WITNESS=1 python3 -m pytest \
-	    tests/test_lanes.py tests/test_engine.py tests/test_pipeline.py -q
+	    tests/test_lanes.py tests/test_engine.py tests/test_pipeline.py \
+	    tests/test_native_emit.py -q
 	$(PYENV) python3 benchmarks/route_micro.py --check
+	$(PYENV) python3 benchmarks/emit_micro.py --check
 
 # chaos-check: the resilience suite (fault plane, retry policy, watchdog,
 # pump partial-write recovery, shedding) plus the chaos convergence gate:

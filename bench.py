@@ -200,6 +200,20 @@ def _router_micro_rider() -> "dict | None":
         return {"error": repr(e)}
 
 
+def _emit_micro_rider() -> "dict | None":
+    """Python-vs-template emit render cost (benchmarks/emit_micro.py)
+    embedded in every BENCH json — the ISSUE 14 trajectory of the
+    largest engine term (emit_render_us) stays machine-readable next to
+    the device headline. Host-only and small; never touches the device."""
+    try:
+        from benchmarks.emit_micro import run as emit_run
+
+        return emit_run(rows=20000, windows=2)
+    except Exception as e:
+        print(f"emit_micro rider failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _latency_attrib_rider() -> "dict | None":
     """Measured apiserver phase attribution (benchmarks/latency_attrib.py
     rider mode): a small native-server workload's per-phase µs/request —
@@ -533,6 +547,7 @@ def pallas_main() -> None:
         },
         "cost_model": _lane_cost_model(),
         "router_micro": _router_micro_rider(),
+        "emit_micro": _emit_micro_rider(),
         "latency_attrib": _latency_attrib_rider(),
         "metrics_snapshot": _metrics_snapshot(),
     }))
@@ -634,6 +649,9 @@ def main() -> None:
                 "cost_model": _lane_cost_model(),
                 # router trajectory rider: python vs native partitioning
                 "router_micro": _router_micro_rider(),
+                # emit trajectory rider: python body-build vs AOT-template
+                # slab splice (ISSUE 14; benchmarks/emit_micro.py)
+                "emit_micro": _emit_micro_rider(),
                 # measured apiserver phase attribution (the 437us/pod
                 # model term, measured; benchmarks/latency_attrib.py)
                 "latency_attrib": _latency_attrib_rider(),

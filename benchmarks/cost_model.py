@@ -134,6 +134,19 @@ def engine_costs(n: int, trials: int) -> dict:
                 import numpy as np
                 return np.full(len(reqs), 200, np.int32)
 
+            def emit_spliced(self, native_mod, kw):
+                # fused-path representative with the send swallowed: the
+                # render+fingerprint C call runs for real, statuses come
+                # back 200 — so emit_render_us measures the engine CPU of
+                # the ISSUE 14 template path (the wire syscalls are the
+                # pump term, measured by emit_pump_costs)
+                res = native_mod.emit_pods(**kw)
+                if res is None:
+                    return None
+                bodies, fps, status, need = res
+                status[:] = 200
+                return bodies, fps, status, need
+
             def close(self):
                 pass
 
@@ -143,6 +156,14 @@ def engine_costs(n: int, trials: int) -> dict:
         idxs = [eng.pods.pool.lookup(("default", f"cm-{i}"))
                 for i in range(n)]
         idxs = [i for i in idxs if i is not None]
+        # per-term GC isolation (r08): the 20k-record ingest above (and
+        # the previous trial's dropped engine) leaves a collection due
+        # that fires INSIDE this window otherwise — ~2µs/pod of ingest
+        # garbage mis-attributed to emit, and the dominant trial-to-trial
+        # variance (4.3..6.8µs/pod swings on an idle host). survivor/echo
+        # pay their own GC, triggered by their own allocation, as before.
+        import gc
+        gc.collect()
         t0 = time.perf_counter()
         eng._emit_pods_native(eng.pods, idxs)
         emit.append(1e6 * (time.perf_counter() - t0) / max(1, len(idxs)))
@@ -186,12 +207,113 @@ def engine_costs(n: int, trials: int) -> dict:
         "batch_parse_us": round(statistics.median(parse), 2),
         "route_batch_us": round(statistics.median(route), 2),
         "emit_render_us": round(statistics.median(emit), 2),
+        # ISSUE 14 disclosure: which emit body the render term measured
+        "emit_native_templates": eng._emit_tpl is not None,
         "flush_staged_row_us": round(statistics.median(flushes), 2),
         "tick_kernel_ms_at_capacity": round(statistics.median(ticks), 2),
         "capacity": n + 128,
         "events_per_trial": n,
         "trials": trials,
     }
+
+
+def emit_pump_costs(n: int, trials: int) -> dict:
+    """The engine-side pump term of ISSUE 14, measured fresh: µs of THIS
+    process's CPU per status patch for (a) the old shape — Python request
+    tuples marshalled into pump.send — and (b) the fused template call
+    (render+fingerprint+send in one C invocation), with the render-only
+    CPU subtracted so `emit_pump_us` is the per-patch cost the send adds
+    on top of the already-counted emit_render_us."""
+    import numpy as np
+
+    from kwok_tpu import native
+    from kwok_tpu.kwokctl import netutil
+    from kwok_tpu.models import (
+        compile_emit_templates,
+        compile_rules,
+        default_pod_rules,
+    )
+    from kwok_tpu.models.lifecycle import ResourceKind
+
+    bin_ = native.apiserver_binary()
+    if not bin_:
+        return {"skipped": "no native apiserver binary"}
+    port = netutil.get_unused_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [bin_, "--port", str(port)], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        from benchmarks.soak import _wait_http
+
+        _wait_http(f"http://127.0.0.1:{port}", "/healthz", timeout=30)
+        pump = native.Pump("127.0.0.1", port, nconn=2)
+        creates = [
+            ("POST", "/api/v1/namespaces/default/pods", json.dumps({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"pp-{i}", "namespace": "default"},
+                "spec": {"nodeName": "n0",
+                         "containers": [{"name": "c", "image": "x"}]},
+            }, separators=(",", ":")).encode())
+            for i in range(n)
+        ]
+        st = pump.send(creates)
+        if not ((st >= 200) & (st < 300)).all():
+            return {"skipped": "apiserver rejected the seed creates"}
+        ptab = compile_rules(default_pod_rules(), ResourceKind.POD)
+        tpl = compile_emit_templates(ptab)
+        et = native.EmitTable(tpl)
+        t = int(tpl.phase_tpl[ptab.space.phase_id("Running")])
+        ids = np.full(n, t, np.int32)
+        conds = np.full(n, 7, np.uint32)
+        hosts = [b"10.0.0.1"] * n
+        ips = [f"10.244.2.{i % 250}".encode() for i in range(n)]
+        starts = [b"2026-07-30T00:00:00Z"] * n
+        ctrs = [b"c\x1fx"] * n
+        ictrs = [b""] * n
+        now = b"2026-07-30T00:00:01Z"
+        paths = [
+            f"/api/v1/namespaces/default/pods/pp-{i}".encode()
+            for i in range(n)
+        ]
+        ctype = "application/strategic-merge-patch+json"
+        marshal, fused, render = [], [], []
+        for _ in range(trials):
+            # (a) old shape: request tuples + pump.send marshalling
+            bodies, _f, _s, _need = native.emit_pods(
+                et, ids, conds, hosts, ips, starts, ctrs, ictrs, now)
+            c0 = time.process_time()
+            reqs = [
+                ("PATCH", p.decode() + "/status", b, ctype)
+                for p, b in zip(paths, bodies)
+            ]
+            pump.send(reqs)
+            marshal.append(1e6 * (time.process_time() - c0) / n)
+            # (b) fused render+send, then render-only to subtract
+            c0 = time.process_time()
+            native.emit_pods(
+                et, ids, conds, hosts, ips, starts, ctrs, ictrs, now,
+                pump=pump, paths=paths)
+            fused.append(1e6 * (time.process_time() - c0) / n)
+            c0 = time.process_time()
+            native.emit_pods(
+                et, ids, conds, hosts, ips, starts, ctrs, ictrs, now)
+            render.append(1e6 * (time.process_time() - c0) / n)
+        pump.close()
+        med = statistics.median
+        return {
+            "marshal_send_us": round(med(marshal), 2),
+            "fused_send_us": round(med(fused), 2),
+            "render_only_us": round(med(render), 2),
+            "emit_pump_us": round(
+                max(0.0, med(fused) - med(render)), 2
+            ),
+            "ops_per_batch": n,
+            "trials": trials,
+        }
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
 
 
 def watch_read_costs(n: int, trials: int) -> dict:
@@ -620,6 +742,15 @@ def main() -> int:
             return 1
 
     eng = engine_costs(args.events, args.trials)
+    # the fused-send pump term (ISSUE 14): measured against a live native
+    # apiserver; folded into the engine inputs so the lane model's pump
+    # lane rides the measured number instead of the rig-cost proxy — but
+    # ONLY when the engine under measurement actually ran the template
+    # path (KWOK_TPU_NATIVE_EMIT=0 must model the legacy marshalling,
+    # not a fused send it will never make)
+    emit_pump = emit_pump_costs(min(args.events, 20000), args.trials)
+    if "emit_pump_us" in emit_pump and eng.get("emit_native_templates"):
+        eng["emit_pump_us"] = emit_pump["emit_pump_us"]
     api = apiserver_costs(min(args.events, 20000), args.trials)
     rig = rig_costs(min(args.events, 20000), args.trials)
     watch = watch_read_costs(min(args.events, 20000), args.trials)
@@ -634,6 +765,7 @@ def main() -> int:
     out = {
         "metric": "cost model: per-process us CPU per op + pods/s-vs-cores",
         "engine": eng,
+        "emit_pump": emit_pump,
         "apiserver": api,
         "rig": rig,
         "watch": watch,

@@ -1,0 +1,182 @@
+"""Emit microbench: Python body-build vs AOT-template native slab splice.
+
+COSTMODEL_r07 named `emit_render_us` 20.1µs the largest engine term: the
+per-row Python gather in `_emit_pods_native` (meta dict walks, .encode()
+calls, f-string path building) feeding the hand-rolled C renderer, plus a
+per-row `now_rfc3339()` fallback — all serial and GIL-holding on the tick
+thread. ISSUE 14 lowers each compiled Stage rule's patch body to a byte
+template with hole offsets (models/compiler.compile_emit_templates) and
+splices per-row values columnar-ly in ONE C call (codec.cc
+kwok_emit_pods), with the pump send foldable into the same call.
+
+This bench measures the render bodies route_micro-style (interleaved
+best-of windows — single windows on shared hosts swing far more than the
+delta under test):
+
+- python arm: the full Python body build — edge/render.py
+  render_pod_status + json.dumps per row, the path the engine takes with
+  no native codec at all (and the KWOK_TPU_NATIVE_EMIT=0 slow-path
+  renderer).
+- legacy arm: the pre-ISSUE-14 native shape — per-row Python gather
+  values + kwok_render_pod_statuses + the separate fingerprint call.
+- native arm: the template slab splice — columnar gather straight off
+  pre-encoded byte columns + ONE kwok_emit_pods call (render +
+  fingerprints fused; the send is out of scope here, measured by
+  cost_model.emit_pump_costs against a live server).
+
+Prints ONE JSON line; --check mode runs small and exits nonzero unless
+the native arm beats the python arm by --min-ratio (the regression gate
+`make lane-check` runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run(rows: int, windows: int) -> dict:
+    import numpy as np
+
+    from kwok_tpu import native
+    from kwok_tpu.edge.render import render_pod_status
+    from kwok_tpu.models import (
+        compile_emit_templates,
+        compile_rules,
+        default_pod_rules,
+    )
+    from kwok_tpu.models.lifecycle import POD_PHASES, ResourceKind
+
+    if not native.available():
+        return {"skipped": "native codec unavailable"}
+
+    n = rows
+    ptab = compile_rules(default_pod_rules(), ResourceKind.POD)
+    tpl = compile_emit_templates(ptab)
+    et = native.EmitTable(tpl)
+    now = "2026-07-30T00:00:00Z"
+
+    # the same logical rows for every arm: 2 containers + 1 init each
+    ctr_dicts = [
+        [{"name": "app", "image": "registry.local/app:v1"},
+         {"name": "sidecar", "image": "envoy:1.29"}]
+        for _ in range(n)
+    ]
+    ictr_dicts = [[{"name": "init", "image": "busybox"}] for _ in range(n)]
+    pods = [
+        {
+            "metadata": {"creationTimestamp": now},
+            "spec": {"containers": ctr_dicts[i],
+                     "initContainers": ictr_dicts[i]},
+            "status": {},
+        }
+        for i in range(n)
+    ]
+    hosts_s = [f"10.0.0.{i % 250}" for i in range(n)]
+    ips_s = [f"10.244.3.{i % 250}" for i in range(n)]
+    # pre-encoded columns, as the ingest path stages them (ISSUE 14
+    # satellite: columnar emit inputs)
+    hosts = [h.encode() for h in hosts_s]
+    ips = [p.encode() for p in ips_s]
+    starts = [now.encode()] * n
+    ctrs = [b"app\x1fregistry.local/app:v1\x1esidecar\x1fenvoy:1.29"] * n
+    ictrs = [b"init\x1fbusybox"] * n
+    tpl_ids = np.full(n, int(tpl.phase_tpl[ptab.space.phase_id("Running")]),
+                      np.int32)
+    conds = np.full(n, 7, np.uint32)
+    now_b = now.encode()
+
+    def python_arm() -> float:
+        t0 = time.perf_counter()
+        bodies = [
+            json.dumps(
+                {"status": render_pod_status(
+                    pods[i], "Running", 7, hosts_s[i], ips_s[i]
+                )},
+                separators=(",", ":"),
+            ).encode()
+            for i in range(n)
+        ]
+        native.fingerprint_statuses(bodies)
+        return time.perf_counter() - t0
+
+    def legacy_arm() -> float:
+        t0 = time.perf_counter()
+        bodies = native.render_pod_statuses(
+            np.zeros(n, np.uint8), conds,
+            [b"Running"] * n, list(POD_PHASES.conditions[:3]),
+            hosts, ips, starts, ctrs, ictrs,
+        )
+        native.fingerprint_statuses([bytes(b) for b in bodies])
+        return time.perf_counter() - t0
+
+    def native_arm() -> float:
+        t0 = time.perf_counter()
+        native.emit_pods(
+            et, tpl_ids, conds, hosts, ips, starts, ctrs, ictrs, now_b
+        )
+        return time.perf_counter() - t0
+
+    py_best = leg_best = nat_best = float("inf")
+    for _ in range(windows):
+        py_best = min(py_best, python_arm())
+        leg_best = min(leg_best, legacy_arm())
+        nat_best = min(nat_best, native_arm())
+    py_us = 1e6 * py_best / n
+    leg_us = 1e6 * leg_best / n
+    nat_us = 1e6 * nat_best / n
+    return {
+        "metric": (
+            f"emit body render cost per pod at {rows} rows (best of "
+            f"{windows} interleaved windows; bodies + echo-drop "
+            "fingerprints, send excluded)"
+        ),
+        "python_render_us_per_pod": round(py_us, 3),
+        "legacy_native_us_per_pod": round(leg_us, 3),
+        "template_splice_us_per_pod": round(nat_us, 3),
+        "speedup_vs_python": round(py_us / max(nat_us, 1e-9), 2),
+        "speedup_vs_legacy": round(leg_us / max(nat_us, 1e-9), 2),
+        "rows": rows,
+        "windows": windows,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=20000)
+    p.add_argument("--windows", type=int, default=5)
+    p.add_argument("--min-ratio", type=float, default=3.0,
+                   help="--check gate: template splice must beat the "
+                   "pure-Python body build by at least this factor")
+    p.add_argument("--check", action="store_true",
+                   help="small regression gate for make lane-check")
+    args = p.parse_args()
+    if args.check:
+        args.rows = min(args.rows, 8000)
+        args.windows = min(args.windows, 3)
+    out = run(args.rows, args.windows)
+    print(json.dumps(out))
+    if "skipped" in out:
+        return 0  # no compiler: the engine falls back to Python anyway
+    if args.check and out["speedup_vs_python"] < args.min_ratio:
+        print(
+            f"emit_micro: template splice is only "
+            f"{out['speedup_vs_python']}x the python body build "
+            f"(< {args.min_ratio}x)", file=sys.stderr,
+        )
+        return 1
+    if args.check and out["speedup_vs_legacy"] < 1.0:
+        print("emit_micro: template splice regressed vs the legacy "
+              "native renderer", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

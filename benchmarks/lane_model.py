@@ -87,7 +87,14 @@ def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
         split_flush = False
     serial_pp = lane_pp + flush_pp
     watch_pp = 2 * watch.get("watch_line_us", 0.0)
-    pump_pp = rig.get("issue_request_us", 0.0)  # engine's pump sends
+    # r08 re-fit (native emit): when the inputs carry ``emit_pump_us`` —
+    # the measured per-patch CPU the fused template send adds on top of
+    # the render (ISSUE 14's one-call render+send) — the engine's pump
+    # lane is charged exactly that. Old input files without it keep the
+    # rig-cost proxy (the pre-fuse Python marshalling estimate).
+    pump_pp = eng.get("emit_pump_us")
+    if pump_pp is None:
+        pump_pp = rig.get("issue_request_us", 0.0)  # engine's pump sends
     rig_pp = 2 * rig.get("issue_request_us", 0.0)
     kern_pp = (
         eng.get("tick_kernel_ms_at_capacity", 0.0) * 1e3

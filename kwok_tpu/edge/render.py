@@ -14,6 +14,7 @@ faithfully; container states follow the pod phase (running / terminated).
 from __future__ import annotations
 
 import datetime
+import json
 from typing import Any, Mapping
 
 from kwok_tpu.models.lifecycle import NODE_PHASES, POD_PHASES, PhaseSpace
@@ -216,3 +217,31 @@ def render_pod_status(
         "phase": phase_name,
         "startTime": start_time,
     }
+
+
+# --- byte oracles (ISSUE 14) ------------------------------------------------
+# Canonical patch-body BYTES for the native emit paths' byte-identity
+# oracles (tests/test_native_emit.py). Key order above is the wire order
+# the codec emits; ensure_ascii=False matches its raw-UTF-8 escaping, so
+# for bodies without the exotic control chars json encodes as \b / \f the
+# comparison is byte-exact, not merely semantic.
+
+
+def render_pod_status_body(
+    pod: Mapping[str, Any],
+    phase_name: str,
+    cond_bits: int,
+    node_ip: str,
+    pod_ip: str,
+) -> bytes:
+    return json.dumps(
+        {"status": render_pod_status(pod, phase_name, cond_bits, node_ip, pod_ip)},
+        separators=(",", ":"), ensure_ascii=False,
+    ).encode()
+
+
+def render_heartbeat_body(cond_bits: int, now: str, start_time: str) -> bytes:
+    return json.dumps(
+        {"status": render_node_heartbeat(cond_bits, now, start_time)},
+        separators=(",", ":"), ensure_ascii=False,
+    ).encode()

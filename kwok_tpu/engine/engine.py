@@ -51,7 +51,12 @@ from kwok_tpu.edge.render import (
     rfc3339,
 )
 from kwok_tpu.edge.selectors import parse_selector
-from kwok_tpu.models import compile_rules, default_node_rules, default_pod_rules
+from kwok_tpu.models import (
+    compile_emit_templates,
+    compile_rules,
+    default_node_rules,
+    default_pod_rules,
+)
 from kwok_tpu.models.defaults import SEL_HEARTBEAT, SEL_MANAGED, SEL_ON_MANAGED_NODE
 from kwok_tpu.models.lifecycle import (
     NODE_PHASES,
@@ -69,7 +74,12 @@ from kwok_tpu.ops.tick import (
     unpack_wire,
 )
 from kwok_tpu.ops.updates import UpdateBuffer
-from kwok_tpu.engine.rowpool import RowPool
+from kwok_tpu.engine.rowpool import (
+    EF_RENDER,
+    EF_RGATES,
+    EF_SCALAR,
+    RowPool,
+)
 from kwok_tpu.resilience import faults as resilience_faults
 from kwok_tpu.resilience import ha as resilience_ha
 from kwok_tpu.resilience.policy import (
@@ -304,7 +314,11 @@ class _PumpGroup:
     def __len__(self) -> int:
         return len(self._pumps)
 
-    def send(self, reqs):
+    def _on_claimed_group(self, fn):
+        """Run fn(pump) on the first free connection group (non-blocking
+        probe, round-robin start), blocking on the start group only when
+        every group is busy — the ONE claim discipline send() and the
+        fused emit share."""
         n = len(self._pumps)
         self._next += 1
         start = self._next % n
@@ -312,14 +326,33 @@ class _PumpGroup:
             p, lock = self._pumps[(start + i) % n]
             if lock.acquire(blocking=False):
                 try:
-                    # kwoklint: disable=blocking-under-lock -- this leaf lock EXISTS to serialize sends on one pump connection group; nothing else is ever taken under it
-                    return p.send(reqs)
+                    # fn blocks on the wire BY DESIGN: this leaf lock
+                    # exists to serialize sends on one pump connection
+                    # group; nothing else is ever taken under it
+                    return fn(p)
                 finally:
                     lock.release()
         p, lock = self._pumps[start]
         with lock:
-            # kwoklint: disable=blocking-under-lock -- same leaf-lock-by-design as above: the group lock serializes this send and guards nothing else
-            return p.send(reqs)
+            return fn(p)
+
+    def send(self, reqs):
+        return self._on_claimed_group(lambda p: p.send(reqs))
+
+    def emit_spliced(self, native_mod, kw: dict):
+        """Fused template render+send (ISSUE 14) on one claimed
+        connection group — the same probe-then-block group discipline as
+        send(), serializing exactly like any other batch on that group's
+        leaf lock. Returns None when the pumps are NOT plain native
+        pumps (fault plane, HA fence, test stubs): the caller then
+        renders and sends as two calls through send(), so every wrapper
+        keeps seeing whole request batches and a fused call can never
+        tunnel past a fence."""
+        if not isinstance(self._pumps[0][0], native_mod.Pump):
+            return None
+        return self._on_claimed_group(
+            lambda p: native_mod.emit_pods(pump=p, **kw)
+        )
 
     def send_ordered(self, batches):
         """Send several batches back-to-back on ONE group (a strip batch
@@ -519,6 +552,33 @@ class ClusterEngine:
 
             if native.available():
                 self._codec = native
+        # AOT-template native emit (ISSUE 14): each compiled pod rule's
+        # patch body lowered to a byte template with hole offsets; the
+        # emit hot path splices per-row column values in C and ships the
+        # batch in the same GIL-free call. KWOK_TPU_NATIVE_EMIT=0 keeps
+        # the previous path (per-row meta gather + kwok_render_pod_statuses)
+        # at zero cost — one attribute test per emit batch, no column
+        # maintenance at ingest.
+        self._emit_tpl = None
+        if self._codec is not None and os.environ.get(
+            "KWOK_TPU_NATIVE_EMIT", "1"
+        ) != "0":
+            try:
+                self._emit_tpl = self._codec.EmitTable(
+                    compile_emit_templates(ptab)
+                )
+            except Exception:
+                logger.debug(
+                    "emit templates unavailable; generic native emit "
+                    "path stays active", exc_info=True,
+                )
+                self._emit_tpl = None
+        #: ingest stages the emit byte columns only when the template
+        #: path can consume them
+        self._emit_cols = self._emit_tpl is not None
+        self._node_ip_b = (config.node_ip or "").encode()
+        self._pump_base_b = b""
+        self._gone_id = self._pod_phase_ids.get("Gone", -1)
         # Tick-thread batch parser + per-kind resume revisions (written by
         # the tick thread as it parses, read by the watch loops on
         # reconnect; GIL-atomic dict ops)
@@ -2197,6 +2257,21 @@ class ClusterEngine:
                             m["phase_str"] = rec.phase
                             m["host_ip"] = rec.host_ip
                             m["status_scalar"] = bool(rec.flags & 16)
+                            if self._emit_cols:
+                                # keep the emit columns tracking the same
+                                # server-side facts the meta mirror does
+                                pool = k.pool
+                                pool.srv_phase[idx] = (
+                                    self._pod_phase_ids.get(rec.phase, -1)
+                                )
+                                pool.host_b[idx] = (
+                                    rec.host_ip.encode()
+                                    if rec.host_ip else None
+                                )
+                                if rec.flags & 16:
+                                    pool.eflags[idx] |= EF_SCALAR
+                                else:
+                                    pool.eflags[idx] &= ~EF_SCALAR
                             m["raw"] = rec.raw
                             if rec.rv:
                                 # the checkpoint identity must track our
@@ -2383,11 +2458,16 @@ class ClusterEngine:
             rows = []
             staged = False
             try:
+                stage_ecols = (
+                    self._stage_pod_ecols if self._emit_cols else None
+                )
                 for key, _node, m, _cond, _hd in cols:
                     if pool.full:
                         grow(k)
                     row = acquire(key)
                     meta[row] = m  # fresh rows: replace the dict wholesale
+                    if stage_ecols is not None:
+                        stage_ecols(pool, row, m)
                     rows.append(row)
                 # node->pods index registration BEFORE the node_has reads
                 # below — the same publication order _pod_upsert_record
@@ -2742,6 +2822,8 @@ class ClusterEngine:
                     # through cni.remove (CNI DEL is idempotent); the pinned
                     # pool slot then simply stays retired
                     m["cni"] = True
+        if self._emit_cols:
+            self._stage_pod_ecols(k.pool, idx, m)
         has_del = m["has_del"]
         # register in the node->pods index BEFORE reading node_has for the
         # selector bits: under sharded lanes a concurrent node
@@ -2780,6 +2862,35 @@ class ClusterEngine:
                 and pod_status_patch_needed(status, rendered)
             ):
                 self._submit(self._patch_pod_status, key, idx)
+
+    def _stage_pod_ecols(self, pool, idx: int, m: dict) -> None:
+        """Columnar emit inputs (ISSUE 14): encode this row's emit-time
+        byte values ONCE at upsert, so the native emit batch never walks
+        the meta dict per dirty row. Callers gate on self._emit_cols and
+        call AFTER the meta dict (including any podIP pin) is final."""
+        f = EF_RENDER
+        if m.get("rgates"):
+            f |= EF_RGATES
+        if m.get("status_scalar"):
+            f |= EF_SCALAR
+        pool.eflags[idx] = f
+        pool.srv_phase[idx] = self._pod_phase_ids.get(
+            m.get("phase_str") or "", -1
+        )
+        h = m.get("host_ip")
+        pool.host_b[idx] = h.encode() if h else None
+        c = m.get("creation")
+        pool.start_b[idx] = c.encode() if c else b""
+        pool.ctr_b[idx] = m.get("ctrs") or b""
+        pool.ictr_b[idx] = m.get("ictrs") or b""
+        ip = m.get("podIP")
+        if ip:
+            pool.ip_b[idx] = ip.encode()
+        if pool.path_b[idx] is None:
+            pool.path_b[idx] = (
+                f"/api/v1/namespaces/{_q(m.get('namespace') or 'default')}"
+                f"/pods/{_q(m['name'])}"
+            ).encode()
 
     @staticmethod
     def _lazy_obj(m) -> dict | None:
@@ -2909,6 +3020,8 @@ class ClusterEngine:
                 if self.ippool.contains(rec.pod_ip):
                     self.ippool.use(rec.pod_ip)
                 m["podIP"] = rec.pod_ip
+        if self._emit_cols:
+            self._stage_pod_ecols(k.pool, idx, m)
         by_node = self.pods_by_node.get(node_name)
         if by_node is None:
             by_node = self.pods_by_node[node_name] = set()
@@ -3561,16 +3674,26 @@ class ClusterEngine:
                 pumps = [self._ha.wrap_pump(p) for p in pumps]
             self._pump = _PumpGroup(pumps)
             self._pump_base = base
+            self._pump_base_b = base.encode()
         except Exception:
             logger.exception("native pump unavailable; using executor egress")
             self._pump = None
         return self._pump
+
+    def _node_path_b(self, pool, idx: int, name: str) -> bytes:
+        """URL-quoted node path bytes, cached as the pool's path column
+        on first emit (node upserts are too rare to stage eagerly)."""
+        pb = pool.path_b[idx]
+        if pb is None:
+            pb = pool.path_b[idx] = f"/api/v1/nodes/{_q(name)}".encode()
+        return pb
 
     def _emit_nodes_native(self, k, idxs: list[int]) -> None:
         """Render node status patches in Python (cold-ish: node transitions
         are rare relative to pods) but ship them in ONE pump batch instead
         of a round-trip per node."""
         now = now_rfc3339()
+        base = self._pump_base_b
         reqs, sent = [], []
         for idx in idxs:
             name = k.pool.key_of(idx)
@@ -3588,8 +3711,7 @@ class ClusterEngine:
             body = json.dumps({"status": rendered}, separators=(",", ":")).encode()
             reqs.append((
                 "PATCH",
-                f"{self._pump_base}/api/v1/nodes/"
-                f"{_q(name)}/status",
+                base + self._node_path_b(k.pool, idx, name) + b"/status",
                 body,
                 "application/strategic-merge-patch+json",
             ))
@@ -3646,12 +3768,17 @@ class ClusterEngine:
     _POD_KIND = {"Running": 0, "Succeeded": 1, "Failed": 2}
 
     def _emit_pods_native(self, k, idxs: list[int]) -> list[int]:
-        """Batch path for transition-driven pod patches: C++ renders every
-        body (codec.render_pod_statuses) and the pump sends them in one
-        GIL-free call. Returns the rows that must take the Python path
-        (readiness gates, CNI, suppression checks, missing state). Runs on
-        the tick thread — the only row mutator — so rows cannot vanish
-        mid-batch."""
+        """Batch path for transition-driven pod patches. With compiled
+        emit templates (the default) the whole batch is a columnar
+        gather + ONE fused C render+send call (_emit_pods_tpl); with
+        KWOK_TPU_NATIVE_EMIT=0 (or no templates) the previous shape —
+        per-row meta gather + codec.render_pod_statuses + pump send —
+        runs unchanged. Returns the rows that must take the Python path
+        (readiness gates, CNI, suppression checks, missing state). Runs
+        on the tick thread — the only row mutator — so rows cannot
+        vanish mid-batch."""
+        if self._emit_tpl is not None:
+            return self._emit_pods_tpl(k, idxs)
         slow: list[int] = []
         sent_idx: list[int] = []
         kinds_l: list[int] = []
@@ -3745,6 +3872,185 @@ class ClusterEngine:
         self._submit(self._pump_send, reqs, sent_idx, "pods")
         return slow
 
+    _EMIT_CTYPE = "application/strategic-merge-patch+json"
+
+    def _emit_pods_tpl(self, k, idxs: list[int]) -> list[int]:
+        """The AOT-template emit gather (ISSUE 14): classify rows off the
+        staged byte columns — no meta dict walks, no per-row .encode(),
+        no f-string paths, `now` hoisted per batch — and hand ONE job to
+        the executor whose body is a single render+send C call. Same
+        slow-path semantics as the legacy gather: CNI rows, readiness
+        gates, and already-at-phase rows (the no-op merge check) keep
+        falling back to edge/render.py via _patch_pod_status."""
+        if self.config.enable_cni and cni.available():
+            return list(idxs)  # provider I/O: every row takes the slow path
+        pool = k.pool
+        ef = pool.eflags
+        srv = pool.srv_phase
+        ipc = pool.ip_b
+        pathc = pool.path_b
+        tgt = k.phase_h[idxs].tolist()
+        tpl_of = self._emit_tpl.phase_tpl
+        n_tpl = len(tpl_of)
+        gone = self._gone_id
+        slow: list[int] = []
+        sel: list[int] = []
+        tpls: list[int] = []
+        # the classify loop appends to the fewest lists it can; every
+        # column gather below runs as a tight comprehension over the
+        # selection (roughly half the interpreter cost of growing a
+        # dozen lists inside this loop — this gather IS emit_render_us)
+        for pos, idx in enumerate(idxs):
+            f = ef[idx]
+            if not f & EF_RENDER:
+                # released row / no renderable state: skip, exactly like
+                # the legacy gather's key/meta guard
+                continue
+            pid = tgt[pos]
+            if pid == gone:
+                continue
+            if f & EF_RGATES or srv[idx] == pid:
+                # readiness gates, or the target phase is already on the
+                # server (the reference's full merge/no-op check)
+                slow.append(idx)
+                continue
+            t = tpl_of[pid] if 0 <= pid < n_tpl else -1
+            if t < 0 or pathc[idx] is None:
+                slow.append(idx)
+                continue
+            sel.append(pos)
+            tpls.append(t)
+        if not sel:
+            return slow
+        rows = [idxs[p] for p in sel]
+        nipb = self._node_ip_b
+        hostc = pool.host_b
+        startc = pool.start_b
+        ctrc = pool.ctr_b
+        ictrc = pool.ictr_b
+        conds = k.cond_h[idxs][sel]
+        pids = [tgt[p] for p in sel]
+        hosts = [hostc[i] or nipb for i in rows]
+        ips = [ipc[i] for i in rows]
+        starts = [startc[i] or b"" for i in rows]
+        ctrs = [ctrc[i] or b"" for i in rows]
+        ictrs = [ictrc[i] or b"" for i in rows]
+        paths = [pathc[i] for i in rows]
+        scalars = [ef[i] & EF_SCALAR for i in rows]  # truthy ints
+        # allocation deferred (column None): first transitions arrive in
+        # bulk, so the whole batch takes ONE _alloc_lock hold below
+        need_ip = [(ri, rows[ri]) for ri, ip in enumerate(ips) if ip is None]
+        if need_ip:
+            meta = pool.meta
+            dropped = 0
+            with self._alloc_lock:
+                missing: list[tuple[int, int, dict]] = []
+                for ri, idx in need_ip:
+                    m = meta[idx]
+                    if m is None:
+                        dropped += 1  # row vanished: pruned below
+                        continue
+                    ip_s = m.get("podIP")
+                    if ip_s:
+                        ips[ri] = ipc[idx] = ip_s.encode()
+                    else:
+                        missing.append((ri, idx, m))
+                if missing:
+                    fresh = self.ippool.get_many(len(missing))
+                    for (ri, idx, m), ip_s in zip(missing, fresh):
+                        m["podIP"] = ip_s
+                        ips[ri] = ipc[idx] = ip_s.encode()
+            if dropped:
+                keep = [i for i, ip in enumerate(ips) if ip]
+                conds = conds[keep]
+                for col in (rows, tpls, hosts, ips, starts, ctrs,
+                            ictrs, paths, pids, scalars):
+                    col[:] = [col[i] for i in keep]
+        if rows:
+            self._submit(
+                self._emit_send_pods, rows,
+                np.asarray(tpls, np.int32), conds,
+                hosts, ips, starts, ctrs, ictrs, paths, pids, scalars,
+                now_rfc3339().encode(),
+            )
+        return slow
+
+    def _emit_send_pods(
+        self, rows, tpls, conds, hosts, ips, starts, ctrs, ictrs, paths,
+        pids, scalars, now_b,
+    ) -> None:
+        """One executor job for a template emit batch: splice bodies into
+        the slab and ship them in a single GIL-free C call when a plain
+        native pump group is available, or render-then-send through the
+        wrapper chain (faults / HA fence / stub pumps) so every wrapper
+        keeps seeing whole request batches. Fingerprint seeding, the
+        whole-frame resend contract, degradation shedding and the
+        per-object fallback are identical to the legacy _pump_send."""
+        _t = time.perf_counter()
+        codec = self._codec
+        kw = dict(
+            tpl=self._emit_tpl, tpl_ids=tpls, cond_bits=conds,
+            hosts=hosts, ips=ips, starts=starts, ctrs=ctrs, ictrs=ictrs,
+            now=now_b, base=self._pump_base_b,
+        )
+        # bare stub pumps (tests, cost model) have no emit_spliced: they
+        # take the render-then-send split path like any wrapped pump
+        spliced = getattr(self._pump, "emit_spliced", None)
+        res = (
+            spliced(codec, {**kw, "paths": paths})
+            if spliced is not None else None
+        )
+        fused = res is not None
+        if not fused:
+            # render-only (paths omitted: the C side never sees them, the
+            # request frames below carry them to the wrapped send)
+            res = codec.emit_pods(**kw)
+        if res is None:  # codec raced away: per-object Python path
+            for idx in rows:
+                key = self.pods.pool.key_of(idx)
+                if key is not None:
+                    self._submit(self._patch_pod_status, key, idx)
+            return
+        bodies, fps, status, slab_bytes = res
+        base = self._pump_base_b
+        if fused:
+            if (status == 0).any():
+                # connection deaths: re-frame the complete batch and run
+                # the standard whole-frame resend (only failed indices
+                # are actually resent)
+                reqs = [
+                    ("PATCH", base + p + b"/status", body, self._EMIT_CTYPE)
+                    for p, body in zip(paths, bodies)
+                ]
+                status = self._pump_resend_frames(reqs, status)
+            else:
+                self._pump_note_outcome(len(rows), status)
+        else:
+            reqs = [
+                ("PATCH", base + p + b"/status", body, self._EMIT_CTYPE)
+                for p, body in zip(paths, bodies)
+            ]
+            status = self._pump_send_frames(reqs)
+        # Echo-drop seeding (PR 7): valid only for scalar-replace server
+        # statuses, where the strategic merge yields exactly the rendered
+        # document. Seeded after the send returns — the watch echo rides
+        # the router's parse window (ms) while this runs in µs, and a
+        # missed seed only costs the echo a full ingest pass, never
+        # correctness.
+        meta = self.pods.pool.meta
+        phases = self._pod_phases
+        fps_l = fps.tolist()
+        st_l = status.tolist()
+        for i, idx in enumerate(rows):
+            if scalars[i] and 200 <= st_l[i] < 300:
+                m = meta[idx]
+                if m is not None:
+                    m["fp_expect"] = fps_l[i]
+                    m["expect_phase"] = phases[pids[i]]
+        self._inc("emit_native_total", len(rows))
+        self._inc("emit_slab_bytes_total", slab_bytes)
+        self._pump_send_tail(status, rows, "pods", len(rows), _t)
+
     def _pump_send_frames(self, reqs):
         """Send one batch, resending WHOLE FRAMES for requests whose
         connection died (status 0). pump.cc's failure contract hands a
@@ -3759,7 +4065,13 @@ class ClusterEngine:
         pump target is down: the engine degrades (kwok_degraded{reason=
         "pump"}) and the caller sheds instead of flooding the executor
         with doomed per-object retries."""
-        status = self._pump.send(reqs)
+        return self._pump_resend_frames(reqs, self._pump.send(reqs))
+
+    def _pump_resend_frames(self, reqs, status):
+        """The retry half of _pump_send_frames, starting from a status
+        array an initial send already produced — the fused template emit
+        enters here (its first send happened inside the C call) with
+        request frames rebuilt from the body slab."""
         if (status == 0).any():
             backoff = PUMP_RESEND.session()
             while self._running:
@@ -3772,7 +4084,12 @@ class ClusterEngine:
                 status[fail] = self._pump.send(sub)
                 if not (status == 0).any():
                     break
-        if len(reqs) and (status == 0).all():
+        self._pump_note_outcome(len(reqs), status)
+        return status
+
+    def _pump_note_outcome(self, n, status) -> None:
+        """Degradation bookkeeping shared by every pump batch outcome."""
+        if n and (status == 0).all():
             if self._degradation.set("pump"):
                 logger.error(
                     "engine degraded: pump egress down past the resend "
@@ -3781,7 +4098,6 @@ class ClusterEngine:
         elif (status != 0).any():
             if self._degradation.clear("pump"):
                 logger.info("pump egress recovered; shedding stops")
-        return status
 
     def _pump_send(self, reqs, idxs, kind) -> None:
         """One executor job sends the whole batch (with whole-frame
@@ -3790,21 +4106,26 @@ class ClusterEngine:
         down outright, in which case the batch is shed and counted."""
         _t = time.perf_counter()
         status = self._pump_send_frames(reqs)
+        self._pump_send_tail(status, idxs, kind, len(reqs), _t)
+
+    def _pump_send_tail(self, status, idxs, kind, n, _t) -> None:
+        """Telemetry + shedding + per-object fallback shared by the
+        legacy request-tuple batches and the fused template emit."""
         _t1 = time.perf_counter()
         tel = self.telemetry
         tel.pump_hist.observe(_t1 - _t)
-        tel.inc("pump_requests_total", len(reqs))
+        tel.inc("pump_requests_total", n)
         tel.span(
-            "pump.send", _t, _t1, "pump", {"kind": kind, "n": len(reqs)}
+            "pump.send", _t, _t1, "pump", {"kind": kind, "n": n}
         )
-        if len(reqs) and (status == 0).all() and (
+        if n and (status == 0).all() and (
             "pump" in self._degradation.reasons
         ):
             # pump target down past the resend deadline: shed the batch
             # (counted) instead of converting it into thousands of
             # doomed per-object jobs that would wedge the executor
-            self._dropped_jobs += len(reqs)
-            self._inc("dropped_jobs_total", len(reqs))
+            self._dropped_jobs += n
+            self._inc("dropped_jobs_total", n)
             return
         ok = int(((status >= 200) & (status < 300)).sum())
         if kind == "heartbeat":
@@ -3902,16 +4223,17 @@ class ClusterEngine:
                 self._submit(self._heartbeat_node, name, idx, now_str)
             return
         if self._get_pump() is not None:
-
+            base = self._pump_base_b
+            pool = k.pool
+            npb = self._node_path_b
             reqs = [
                 (
                     "PATCH",
-                    f"{self._pump_base}/api/v1/nodes/"
-                    f"{_q(name)}/status",
+                    base + npb(pool, idx, name) + b"/status",
                     body,
                     "application/strategic-merge-patch+json",
                 )
-                for (name, _idx), body in zip(hb_rows, bodies)
+                for (name, idx), body in zip(hb_rows, bodies)
             ]
             self._submit(
                 self._pump_send, reqs, [i for _, i in hb_rows], "heartbeat"
@@ -4091,12 +4413,15 @@ class ClusterEngine:
         strip-before-delete)."""
 
         strips, strip_rows, deletes = [], [], []
+        base = self._pump_base_b
         for (ns, name), idx in del_rows:
             m = k.pool.meta[idx]
-            path = (
-                f"{self._pump_base}/api/v1/namespaces/"
-                f"{_q(ns)}/pods/{_q(name)}"
-            )
+            pb = k.pool.path_b[idx]
+            if pb is None:  # column not staged (legacy path): build once
+                pb = k.pool.path_b[idx] = (
+                    f"/api/v1/namespaces/{_q(ns)}/pods/{_q(name)}"
+                ).encode()
+            path = base + pb
             if m and m.get("finalizers"):
                 strips.append((
                     "PATCH", path, b'{"metadata":{"finalizers":null}}',

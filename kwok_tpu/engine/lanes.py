@@ -169,6 +169,12 @@ class ShardLane:
         # client is the parent's, already fence-wrapped); lane engines
         # never dispatch, so their own _ha_hold stays False and inert
         e._ha = parent._ha
+        # ONE compiled emit-template table per engine: the lanes' rule
+        # set is the parent's, so their phase->template mapping is too —
+        # sharing keeps a single ctypes-pinned copy for every emit
+        # worker (read-only after construction)
+        e._emit_tpl = parent._emit_tpl
+        e._emit_cols = parent._emit_cols
         # shared cross-lane state: one IP pool / allocation lock (striped
         # enough — held only for bookkeeping, never across provider
         # calls), one topology view, one clock

@@ -28,6 +28,13 @@ def shard_of(key: Any, n: int) -> int:
     return zlib.crc32(data) % n
 
 
+# RowPool.eflags bits — the native emit path's per-row classification,
+# staged at upsert so emit never walks the meta dicts (ISSUE 14).
+EF_RENDER = 1  # row has a renderable object (raw line or parsed dict)
+EF_RGATES = 2  # spec carries readinessGates -> slow path
+EF_SCALAR = 4  # server-side status is scalar-replace only (fp seeding)
+
+
 class RowPool:
     def __init__(self, capacity: int) -> None:
         self.capacity = capacity
@@ -36,6 +43,22 @@ class RowPool:
         self.meta: list[dict | None] = [None] * capacity
         self._free: list[int] = []
         self._high = 0  # rows [0, high) have been used at least once
+        # Columnar emit inputs (ISSUE 14): pre-encoded per-row byte slabs
+        # the native emit splice gathers WITHOUT touching `meta` — staged
+        # by the engine at upsert time (gated on its native-emit flag) and
+        # cleared with the row. `path_b` holds the URL-quoted object path
+        # minus any server base prefix and minus the "/status" suffix, so
+        # status patches and deletes share it.
+        self.path_b: list[bytes | None] = [None] * capacity
+        self.host_b: list[bytes | None] = [None] * capacity
+        self.ip_b: list[bytes | None] = [None] * capacity
+        self.start_b: list[bytes | None] = [None] * capacity
+        self.ctr_b: list[bytes | None] = [None] * capacity
+        self.ictr_b: list[bytes | None] = [None] * capacity
+        self.eflags: list[int] = [0] * capacity
+        # server-side .status.phase as a compiled phase id (-1 unknown):
+        # the emit path's no-op-merge pre-check (phase already reached)
+        self.srv_phase: list[int] = [-1] * capacity
 
     def __len__(self) -> int:
         return len(self._by_key)
@@ -69,6 +92,17 @@ class RowPool:
             return None
         self._key_by_idx[idx] = None
         self.meta[idx] = None
+        # emit columns die with the row: a recycled index must never
+        # splice the previous occupant's bytes (EF_RENDER=0 alone gates
+        # the fast path; the rest is hygiene)
+        self.eflags[idx] = 0
+        self.srv_phase[idx] = -1
+        self.path_b[idx] = None
+        self.host_b[idx] = None
+        self.ip_b[idx] = None
+        self.start_b[idx] = None
+        self.ctr_b[idx] = None
+        self.ictr_b[idx] = None
         self._free.append(idx)
         return idx
 
@@ -81,6 +115,11 @@ class RowPool:
         extra = new_capacity - self.capacity
         self._key_by_idx.extend([None] * extra)
         self.meta.extend([None] * extra)
+        for col in (self.path_b, self.host_b, self.ip_b, self.start_b,
+                    self.ctr_b, self.ictr_b):
+            col.extend([None] * extra)
+        self.eflags.extend([0] * extra)
+        self.srv_phase.extend([-1] * extra)
         self.capacity = new_capacity
 
     def keys(self):

@@ -15,7 +15,12 @@ from kwok_tpu.models.lifecycle import (
     ResourceKind,
     StatusEffect,
 )
-from kwok_tpu.models.compiler import CompiledRules, compile_rules
+from kwok_tpu.models.compiler import (
+    CompiledRules,
+    EmitTemplates,
+    compile_emit_templates,
+    compile_rules,
+)
 from kwok_tpu.models.defaults import (
     default_node_rules,
     default_pod_rules,
@@ -29,6 +34,8 @@ __all__ = [
     "ResourceKind",
     "StatusEffect",
     "CompiledRules",
+    "EmitTemplates",
+    "compile_emit_templates",
     "compile_rules",
     "default_node_rules",
     "default_pod_rules",

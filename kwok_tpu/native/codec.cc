@@ -85,7 +85,80 @@ inline void put_kv(Buf& b, const char* key, const char* v, int64_t vn) {
   b.qesc(v, vn);
 }
 
+// containerStatuses / initContainerStatuses array CONTENT (no brackets)
+// from packed records "name\x1fimage\x1e...". init=true renders the
+// terminated-Completed init-container shape regardless of kind. ONE copy
+// shared by the legacy batch renderer and the template splicer, so the
+// two paths cannot drift byte-wise. `ready` is passed separately from
+// `kind`: render.py marks containers ready ONLY in phase Running, while
+// the container STATE tracks terminated-vs-running — the legacy caller
+// collapses the two (its historical shape), the template caller bakes
+// ready per phase at compile time, matching render.py exactly.
+void put_containers(Buf& b, const char* cs, int64_t cn, uint8_t kind,
+                    bool ready, const char* st, int64_t stn, bool init) {
+  int64_t pos = 0;
+  bool first = true;
+  while (pos < cn) {
+    const char* rec = cs + pos;
+    const char* rec_end = (const char*)std::memchr(rec, '\x1e', cn - pos);
+    int64_t rec_len = rec_end ? rec_end - rec : cn - pos;
+    const char* sep = (const char*)std::memchr(rec, '\x1f', rec_len);
+    int64_t name_len = sep ? sep - rec : rec_len;
+    const char* img = sep ? sep + 1 : rec + rec_len;
+    int64_t img_len = sep ? rec + rec_len - img : 0;
+    if (!first) b.put(',');
+    first = false;
+    b.lit("{\"image\":");
+    b.qesc(img, img_len);
+    b.lit(",\"name\":");
+    b.qesc(rec, name_len);
+    if (init) {
+      b.lit(
+          ",\"ready\":true,\"restartCount\":0,\"state\":{\"terminated\":"
+          "{\"exitCode\":0,\"finishedAt\":");
+      b.qesc(st, stn);
+      b.lit(",\"reason\":\"Completed\",\"startedAt\":");
+      b.qesc(st, stn);
+      b.lit("}}}");
+    } else {
+      b.lit(",\"ready\":");
+      b.lit(ready ? "true" : "false");
+      b.lit(",\"restartCount\":0,\"state\":");
+      if (kind == 0) {
+        b.lit("{\"running\":{\"startedAt\":");
+        b.qesc(st, stn);
+        b.lit("}}");
+      } else {
+        b.lit("{\"terminated\":{\"exitCode\":");
+        b.lit(kind == 1 ? "0" : "1");
+        b.lit(",\"finishedAt\":");
+        b.qesc(st, stn);
+        b.lit(",\"reason\":");
+        b.lit(kind == 1 ? "\"Completed\"" : "\"Error\"");
+        b.lit(",\"startedAt\":");
+        b.qesc(st, stn);
+        b.lit("}}");
+      }
+      b.put('}');
+    }
+    pos += rec_len + (rec_end ? 1 : 0);
+  }
+}
+
 }  // namespace
+
+// cross-TU internals of libkwokcodec.so (same shared object):
+// the canonical status fingerprint (ingest.cc) and the prefixed batch
+// send (pump.cc) the fused emit call composes with.
+extern "C" void kwok_fingerprint_statuses(const char* blob,
+                                          const int64_t* off, int32_t n,
+                                          uint64_t* out);
+extern "C" int64_t kwok_pump_send2(
+    int64_t handle, int32_t n, const char* method, const char* base,
+    int64_t base_len, const char* path_blob, const int64_t* path_off,
+    const char* suffix, int64_t suffix_len, const char* ctype,
+    int64_t ctype_len, const char* body_blob, const int64_t* body_off,
+    int32_t* status_out);
 
 extern "C" {
 
@@ -159,7 +232,6 @@ int64_t kwok_render_pod_statuses(
     const char* st = start.ptr(i);
     int64_t stn = start.len(i);
     uint8_t kind = phase_kind[i];
-    bool ready = kind == 0;
 
     b.lit("{\"status\":{\"conditions\":[");
     uint32_t bits = cond_bits[i];
@@ -174,77 +246,11 @@ int64_t kwok_render_pod_statuses(
       b.put('}');
     }
     b.lit("],\"containerStatuses\":[");
-
-    // containers
-    const char* cs = ctr.ptr(i);
-    int64_t cn = ctr.len(i);
-    int64_t pos = 0;
-    bool first = true;
-    while (pos < cn) {
-      const char* rec = cs + pos;
-      const char* rec_end = (const char*)std::memchr(rec, '\x1e', cn - pos);
-      int64_t rec_len = rec_end ? rec_end - rec : cn - pos;
-      const char* sep = (const char*)std::memchr(rec, '\x1f', rec_len);
-      int64_t name_len = sep ? sep - rec : rec_len;
-      const char* img = sep ? sep + 1 : rec + rec_len;
-      int64_t img_len = sep ? rec + rec_len - img : 0;
-      if (!first) b.put(',');
-      first = false;
-      b.lit("{\"image\":");
-      b.qesc(img, img_len);
-      b.lit(",\"name\":");
-      b.qesc(rec, name_len);
-      b.lit(",\"ready\":");
-      b.lit(ready ? "true" : "false");
-      b.lit(",\"restartCount\":0,\"state\":");
-      if (kind == 0) {
-        b.lit("{\"running\":{\"startedAt\":");
-        b.qesc(st, stn);
-        b.lit("}}");
-      } else {
-        b.lit("{\"terminated\":{\"exitCode\":");
-        b.lit(kind == 1 ? "0" : "1");
-        b.lit(",\"finishedAt\":");
-        b.qesc(st, stn);
-        b.lit(",\"reason\":");
-        b.lit(kind == 1 ? "\"Completed\"" : "\"Error\"");
-        b.lit(",\"startedAt\":");
-        b.qesc(st, stn);
-        b.lit("}}");
-      }
-      b.put('}');
-      pos += rec_len + (rec_end ? 1 : 0);
-    }
-
+    put_containers(b, ctr.ptr(i), ctr.len(i), kind, kind == 0, st, stn,
+                   false);
     b.lit("],\"initContainerStatuses\":[");
-    const char* is = ictr.ptr(i);
-    int64_t in_ = ictr.len(i);
-    pos = 0;
-    first = true;
-    while (pos < in_) {
-      const char* rec = is + pos;
-      const char* rec_end = (const char*)std::memchr(rec, '\x1e', in_ - pos);
-      int64_t rec_len = rec_end ? rec_end - rec : in_ - pos;
-      const char* sep = (const char*)std::memchr(rec, '\x1f', rec_len);
-      int64_t name_len = sep ? sep - rec : rec_len;
-      const char* img = sep ? sep + 1 : rec + rec_len;
-      int64_t img_len = sep ? rec + rec_len - img : 0;
-      if (!first) b.put(',');
-      first = false;
-      b.lit("{\"image\":");
-      b.qesc(img, img_len);
-      b.lit(",\"name\":");
-      b.qesc(rec, name_len);
-      b.lit(
-          ",\"ready\":true,\"restartCount\":0,\"state\":{\"terminated\":"
-          "{\"exitCode\":0,\"finishedAt\":");
-      b.qesc(st, stn);
-      b.lit(",\"reason\":\"Completed\",\"startedAt\":");
-      b.qesc(st, stn);
-      b.lit("}}}");
-      pos += rec_len + (rec_end ? 1 : 0);
-    }
-
+    put_containers(b, ictr.ptr(i), ictr.len(i), kind, kind == 0, st, stn,
+                   true);
     b.lit("],\"hostIP\":");
     b.qesc(host.ptr(i), host.len(i));
     b.lit(",\"podIP\":");
@@ -259,9 +265,92 @@ int64_t kwok_render_pod_statuses(
   return b.len;
 }
 
+// AOT-template emit (ISSUE 14): splice per-row values into the compiled
+// patch-body templates (models/compiler.py EmitTemplates wire format) and
+// — when `pump` names an open pump — ship the whole batch in the SAME
+// call, so a dirty-row batch goes template -> body slab -> wire without
+// re-entering Python.
+//
+// Segment codes (keep in lockstep with compiler.py EMIT_*):
+//   0 literal [seg_a=lit offset, seg_b=len]   1 start time ("" -> now)
+//   2 hostIP   3 podIP   4 containers   5 init containers
+//   6 condition status '"True"'/'"False"' from cond bit seg_a
+//
+// Memory contract: same as the renderers above — returns total body
+// bytes required; if that exceeds out_cap NOTHING was fingerprinted or
+// sent (the caller re-allocates and calls again), so the send happens
+// exactly once. On success fp_out[i] (when non-null) carries each body's
+// canonical status fingerprint (ingest.cc's algorithm — the echo-drop
+// seed), and with a pump the batch is sent as
+// "PATCH {base}{path[i]}{suffix}" with content type `ctype`, statuses in
+// status_out (pump.cc failure contract: 0 = connection death).
+int64_t kwok_emit_pods(
+    int64_t pump, int32_t n_rows,
+    const int32_t* tpl_id, const uint32_t* cond_bits,
+    const char* lit_blob, const int32_t* seg_code, const int64_t* seg_a,
+    const int64_t* seg_b, const int64_t* tpl_off, const uint8_t* tpl_kind,
+    const uint8_t* tpl_ready,
+    const char* host_blob, const int64_t* host_off,
+    const char* pod_blob, const int64_t* pod_off,
+    const char* start_blob, const int64_t* start_off,
+    const char* ctr_blob, const int64_t* ctr_off,
+    const char* ictr_blob, const int64_t* ictr_off,
+    const char* now, int32_t now_len,
+    char* out, int64_t out_cap, int64_t* out_off,
+    uint64_t* fp_out,
+    const char* base, int64_t base_len,
+    const char* path_blob, const int64_t* path_off,
+    const char* suffix, int64_t suffix_len,
+    const char* ctype, int64_t ctype_len,
+    int32_t* status_out) {
+  Buf b{out, out_cap, 0};
+  Slices host{host_blob, host_off};
+  Slices pod{pod_blob, pod_off};
+  Slices start{start_blob, start_off};
+  Slices ctr{ctr_blob, ctr_off};
+  Slices ictr{ictr_blob, ictr_off};
+  for (int32_t i = 0; i < n_rows; i++) {
+    out_off[i] = b.len;
+    int32_t t = tpl_id[i];
+    const char* st = start.ptr(i);
+    int64_t stn = start.len(i);
+    if (stn == 0) {  // absent creationTimestamp: the batch-hoisted now
+      st = now;
+      stn = now_len;
+    }
+    uint8_t kind = tpl_kind[t];
+    bool ready = tpl_ready[t] != 0;
+    uint32_t bits = cond_bits[i];
+    for (int64_t s = tpl_off[t]; s < tpl_off[t + 1]; s++) {
+      switch (seg_code[s]) {
+        case 0: b.put(lit_blob + seg_a[s], seg_b[s]); break;
+        case 1: b.esc(st, stn); break;
+        case 2: b.esc(host.ptr(i), host.len(i)); break;
+        case 3: b.esc(pod.ptr(i), pod.len(i)); break;
+        case 4: put_containers(b, ctr.ptr(i), ctr.len(i), kind, ready, st,
+                               stn, false); break;
+        case 5: put_containers(b, ictr.ptr(i), ictr.len(i), kind, ready,
+                               st, stn, true); break;
+        case 6: b.lit((bits >> seg_a[s]) & 1 ? "\"True\"" : "\"False\"");
+                break;
+      }
+    }
+  }
+  out_off[n_rows] = b.len;
+  if (b.len > out_cap) return b.len;  // nothing fingerprinted, nothing sent
+  if (fp_out) kwok_fingerprint_statuses(out, out_off, n_rows, fp_out);
+  if (pump && status_out) {
+    kwok_pump_send2(pump, n_rows, "PATCH", base, base_len, path_blob,
+                    path_off, suffix, suffix_len, ctype, ctype_len, out,
+                    out_off, status_out);
+  }
+  return b.len;
+}
+
 // Keep in lockstep with ABI_VERSION in native/__init__.py — a mismatch
 // triggers delete+rebuild loops (and bricks hosts without a compiler).
-// ABI 8: pump.cc grew kwok_pump_stats (send-path attribution).
-int32_t kwok_codec_abi_version() { return 8; }
+// ABI 9: kwok_emit_pods (AOT-template splice + fused pump send) and
+// pump.cc kwok_pump_send2.
+int32_t kwok_codec_abi_version() { return 9; }
 
 }  // extern "C"

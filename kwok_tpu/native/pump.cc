@@ -32,6 +32,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <functional>
 #include <map>
 #include <mutex>
 #include <string>
@@ -200,8 +201,14 @@ struct RespReader {
   }
 };
 
-void run_conn(Pump* p, size_t ci, const Slices& method, const Slices& path,
-              const Slices& ctype, const Slices& body,
+// Appends the COMPLETE wire frame (request line + headers + body) of
+// request i to `out` — the one pluggable piece of run_conn, so the
+// classic 4-slice batch and the fused template-emit batch (codec.cc
+// kwok_emit_pods -> kwok_pump_send2) share every byte of the
+// connection/pipelining/failure machinery.
+using FrameFn = std::function<void(std::string&, int32_t)>;
+
+void run_conn(Pump* p, size_t ci, const FrameFn& frame,
               const std::vector<int32_t>& idxs, int32_t* status_out) {
   Conn& c = p->conns[ci];
   if (c.fd < 0) c.fd = dial(p->host, p->port);
@@ -217,22 +224,8 @@ void run_conn(Pump* p, size_t ci, const Slices& method, const Slices& path,
     [&] {
       std::string out;
       out.reserve(1 << 20);
-      char clen[64];
       for (int32_t i : idxs) {
-        out.append(method.ptr(i), method.len(i));
-        out += ' ';
-        out.append(path.ptr(i), path.len(i));
-        out += " HTTP/1.1\r\nHost: ";
-        out += p->host;
-        out += "\r\nContent-Type: ";
-        if (ctype.len(i) > 0) out.append(ctype.ptr(i), ctype.len(i));
-        else out += "application/json";
-        out += "\r\n";
-        out += p->header_extra;
-        int n = snprintf(clen, sizeof clen, "Content-Length: %lld\r\n\r\n",
-                         (long long)body.len(i));
-        out.append(clen, n);
-        out.append(body.ptr(i), body.len(i));
+        frame(out, i);
         if (out.size() >= (1 << 20)) {
           if (!send_all(c.fd, out.data(), out.size())) {
             write_ok = false;
@@ -264,6 +257,69 @@ void run_conn(Pump* p, size_t ci, const Slices& method, const Slices& path,
   }
 }
 
+// ONE copy of the handle-lookup contract (nullptr = unknown handle, the
+// callers' -1): every entry point resolves its Pump* here, exactly once.
+Pump* lookup_pump(int64_t handle) {
+  std::lock_guard<std::mutex> lk(g_pumps_mu);
+  auto it = g_pumps.find(handle);
+  return it == g_pumps.end() ? nullptr : it->second;
+}
+
+// Shared batch body of kwok_pump_send / kwok_pump_send2: shard indices
+// round-robin across the pool, run the connection threads, account
+// stats, count 2xx. `p` is the caller's already-resolved pump.
+int64_t pump_send_batch(Pump* p, int32_t n, const FrameFn& frame,
+                        int32_t* status_out) {
+  uint64_t b0 = pump_now_ns();
+
+  size_t nconn = p->conns.size();
+  std::vector<std::vector<int32_t>> shards(nconn);
+  for (int32_t i = 0; i < n; i++) shards[i % nconn].push_back(i);
+
+  std::vector<std::thread> threads;
+  for (size_t ci = 0; ci < nconn; ci++) {
+    if (shards[ci].empty()) continue;
+    threads.emplace_back(run_conn, p, ci, std::cref(frame),
+                         std::cref(shards[ci]), status_out);
+  }
+  for (auto& t : threads) t.join();
+  p->batches.fetch_add(1, std::memory_order_relaxed);
+  p->requests.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  p->batch_ns.fetch_add(pump_now_ns() - b0, std::memory_order_relaxed);
+
+  int64_t ok = 0;
+  for (int32_t i = 0; i < n; i++)
+    if (status_out[i] >= 200 && status_out[i] < 300) ok++;
+  return ok;
+}
+
+// One full request frame; the path is spliced from up to three pieces
+// (prefix + per-request path + suffix — send2's "{base}{path}{suffix}").
+void append_frame(std::string& out, const std::string& host,
+                  const std::string& extra, const char* method,
+                  int64_t method_len, const char* path0, int64_t path0_len,
+                  const char* path, int64_t path_len,
+                  const char* path2, int64_t path2_len, const char* ctype,
+                  int64_t ctype_len, const char* body, int64_t body_len) {
+  char clen[64];
+  out.append(method, method_len);
+  out += ' ';
+  if (path0_len) out.append(path0, path0_len);
+  out.append(path, path_len);
+  if (path2_len) out.append(path2, path2_len);
+  out += " HTTP/1.1\r\nHost: ";
+  out += host;
+  out += "\r\nContent-Type: ";
+  if (ctype_len > 0) out.append(ctype, ctype_len);
+  else out += "application/json";
+  out += "\r\n";
+  out += extra;
+  int n = snprintf(clen, sizeof clen, "Content-Length: %lld\r\n\r\n",
+                   (long long)body_len);
+  out.append(clen, n);
+  out.append(body, body_len);
+}
+
 }  // namespace
 
 extern "C" {
@@ -290,51 +346,51 @@ int64_t kwok_pump_send(int64_t handle, int32_t n,
                        const char* ctype_blob, const int64_t* ctype_off,
                        const char* body_blob, const int64_t* body_off,
                        int32_t* status_out) {
-  Pump* p;
-  {
-    std::lock_guard<std::mutex> lk(g_pumps_mu);
-    auto it = g_pumps.find(handle);
-    if (it == g_pumps.end()) return -1;
-    p = it->second;
-  }
+  Pump* p = lookup_pump(handle);
+  if (!p) return -1;
   Slices method{method_blob, method_off};
   Slices path{path_blob, path_off};
   Slices ctype{ctype_blob, ctype_off};
   Slices body{body_blob, body_off};
-  uint64_t b0 = pump_now_ns();
+  FrameFn frame = [&](std::string& out, int32_t i) {
+    append_frame(out, p->host, p->header_extra, method.ptr(i),
+                 method.len(i), nullptr, 0, path.ptr(i), path.len(i),
+                 nullptr, 0, ctype.ptr(i), ctype.len(i), body.ptr(i),
+                 body.len(i));
+  };
+  return pump_send_batch(p, n, frame, status_out);
+}
 
-  size_t nconn = p->conns.size();
-  std::vector<std::vector<int32_t>> shards(nconn);
-  for (int32_t i = 0; i < n; i++) shards[i % nconn].push_back(i);
-
-  std::vector<std::thread> threads;
-  for (size_t ci = 0; ci < nconn; ci++) {
-    if (shards[ci].empty()) continue;
-    threads.emplace_back(run_conn, p, ci, std::cref(method), std::cref(path),
-                         std::cref(ctype), std::cref(body),
-                         std::cref(shards[ci]), status_out);
-  }
-  for (auto& t : threads) t.join();
-  p->batches.fetch_add(1, std::memory_order_relaxed);
-  p->requests.fetch_add((uint64_t)n, std::memory_order_relaxed);
-  p->batch_ns.fetch_add(pump_now_ns() - b0, std::memory_order_relaxed);
-
-  int64_t ok = 0;
-  for (int32_t i = 0; i < n; i++)
-    if (status_out[i] >= 200 && status_out[i] < 300) ok++;
-  return ok;
+// Single-method batch over a shared path prefix/suffix and ONE content
+// type: "{method} {base}{path[i]}{suffix}" with body[i] — the wire shape
+// of the engine's emit batches (every request is a status PATCH), built
+// without per-request method/ctype marshalling. Called by codec.cc's
+// fused kwok_emit_pods; also exported for direct use.
+int64_t kwok_pump_send2(int64_t handle, int32_t n, const char* method,
+                        const char* base, int64_t base_len,
+                        const char* path_blob, const int64_t* path_off,
+                        const char* suffix, int64_t suffix_len,
+                        const char* ctype, int64_t ctype_len,
+                        const char* body_blob, const int64_t* body_off,
+                        int32_t* status_out) {
+  Pump* p = lookup_pump(handle);
+  if (!p) return -1;
+  Slices path{path_blob, path_off};
+  Slices body{body_blob, body_off};
+  int64_t method_len = (int64_t)strlen(method);
+  FrameFn frame = [&](std::string& out, int32_t i) {
+    append_frame(out, p->host, p->header_extra, method, method_len, base,
+                 base_len, path.ptr(i), path.len(i), suffix, suffix_len,
+                 ctype, ctype_len, body.ptr(i), body.len(i));
+  };
+  return pump_send_batch(p, n, frame, status_out);
 }
 
 // Send-path attribution snapshot: out[5] = {batches, requests, batch_s,
 // write_s, read_s}. write/read are summed across the pool's overlapping
 // per-connection threads, so each can exceed batch_s on multi-conn pumps.
 void kwok_pump_stats(int64_t handle, double* out) {
-  Pump* p = nullptr;
-  {
-    std::lock_guard<std::mutex> lk(g_pumps_mu);
-    auto it = g_pumps.find(handle);
-    if (it != g_pumps.end()) p = it->second;
-  }
+  Pump* p = lookup_pump(handle);
   if (!p) {
     for (int i = 0; i < 5; i++) out[i] = 0;
     return;

@@ -34,6 +34,11 @@ _HELP = {
     "kwok_dropped_jobs_total": "Patch jobs rejected during shutdown",
     "kwok_ticks_total": "Engine ticks executed",
     "kwok_pump_requests_total": "Requests shipped through the native pump",
+    "kwok_emit_native_total": "Pod status patches rendered through the "
+    "AOT-template native emit path (compiled byte-template splice; the "
+    "slow path's per-object renders do not count here)",
+    "kwok_emit_slab_bytes_total": "Patch-body bytes spliced into native "
+    "emit slabs (divide by kwok_emit_native_total for mean body size)",
     "kwok_tick_seconds": "Wall seconds per engine tick (dispatch + consume halves)",
     "kwok_tick_stage_seconds": "Per-tick wall seconds by stage "
     "(flush=staged-write flush, kernel=device wire wait, emit=patch-job "
@@ -102,6 +107,8 @@ _COUNTERS = {
     "dropped_jobs_total": ("kwok_dropped_jobs_total", False),
     "ticks_total": ("kwok_ticks_total", False),
     "pump_requests_total": ("kwok_pump_requests_total", False),
+    "emit_native_total": ("kwok_emit_native_total", False),
+    "emit_slab_bytes_total": ("kwok_emit_slab_bytes_total", False),
     "rv_rewinds_total": ("kwok_rv_rewinds_total", False),
     "watch_integrity_resyncs_total": (
         "kwok_watch_integrity_resyncs_total", False,

@@ -1,0 +1,516 @@
+"""AOT-template native emit (ISSUE 14): byte-identity oracles + contracts.
+
+The compiled Stage patch templates (models/compiler.compile_emit_templates)
+spliced by codec.cc kwok_emit_pods must produce byte streams identical to
+BOTH renderers they replace — kwok_tpu.edge.render (the semantic source of
+truth, via its render_*_body byte oracles) and the previous hand-rolled
+kwok_render_pod_statuses shape — across phases x condition sets x container
+shapes, so the wire dialect is provably byte-unchanged. Engine-level tests
+pin the template path against the KWOK_TPU_NATIVE_EMIT=0 fallback, the
+delete/heartbeat path columns, the fused send, and the _emit_inflight
+crash-replay slot surviving a worker kill mid-slab.
+"""
+
+import itertools
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kwok_tpu import native
+from kwok_tpu.edge.mockserver import FakeKube
+from kwok_tpu.edge.render import (
+    _NODE_CONDITION_META,
+    render_heartbeat_body,
+    render_pod_status_body,
+)
+from kwok_tpu.engine.engine import ClusterEngine, _PumpGroup
+from kwok_tpu.engine import EngineConfig
+from kwok_tpu.models import (
+    compile_emit_templates,
+    compile_rules,
+    default_pod_rules,
+)
+from kwok_tpu.models.lifecycle import (
+    Delay,
+    LifecycleRule,
+    NODE_PHASES,
+    POD_PHASES,
+    ResourceKind,
+    StatusEffect,
+)
+
+from tests.test_engine import SyncEngine, make_node, make_pod
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain for the native codec"
+)
+
+NOW = "2026-08-04T00:00:00Z"
+
+
+def _tables():
+    ptab = compile_rules(default_pod_rules(), ResourceKind.POD)
+    tpl = compile_emit_templates(ptab)
+    return ptab, tpl, native.EmitTable(tpl)
+
+
+def _ctr_blob(containers):
+    return b"\x1e".join(
+        f"{c['name']}\x1f{c['image']}".encode() for c in containers
+    )
+
+
+CONTAINER_SHAPES = [
+    [],
+    [{"name": "c0", "image": "busybox"}],
+    [{"name": "c0", "image": 'img"quote'}, {"name": "c\\1", "image": "x:y"}],
+    [{"name": f"c{i}", "image": f"img{i}"} for i in range(5)],
+]
+INIT_SHAPES = [
+    [],
+    [{"name": "init-0", "image": "setup\timg"}],
+]
+
+
+def test_template_splice_byte_parity_exhaustive():
+    """Every compiled template x condition set x container shape renders
+    byte-identically to edge/render.py — and, for the three canonical
+    phases, to the legacy codec renderer too. (Outside those phases the
+    legacy fast path historically marked containers ready:true while the
+    render.py slow path said false — the templates end that fast/slow
+    divergence by compiling `ready` from the phase like render.py does.)"""
+    ptab, tpl, et = _tables()
+    kind_of = {"Succeeded": 1, "Failed": 2}
+    legacy_exact = ("Running", "Succeeded", "Failed")
+    cases = []
+    for phase, bits, ctrs, ictrs in itertools.product(
+        tpl.phase_names, range(8), CONTAINER_SHAPES, INIT_SHAPES
+    ):
+        cases.append((phase, bits, ctrs, ictrs))
+    tpl_ids, conds, hosts, ips, starts, cblobs, iblobs = (
+        [], [], [], [], [], [], []
+    )
+    for i, (phase, bits, ctrs, ictrs) in enumerate(cases):
+        tpl_ids.append(int(tpl.phase_tpl[ptab.space.phase_id(phase)]))
+        conds.append(bits)
+        hosts.append(f"10.0.0.{i % 250}".encode())
+        ips.append(f"10.244.1.{i % 250}".encode())
+        starts.append(f"2026-01-{1 + i % 27:02d}T12:00:00Z".encode())
+        cblobs.append(_ctr_blob(ctrs))
+        iblobs.append(_ctr_blob(ictrs))
+    bodies, fps, _status, need = native.emit_pods(
+        et, np.asarray(tpl_ids, np.int32), np.asarray(conds, np.uint32),
+        hosts, ips, starts, cblobs, iblobs, NOW.encode(),
+    )
+    assert need == sum(len(b) for b in bodies)
+    legacy = native.render_pod_statuses(
+        np.asarray(
+            [kind_of.get(c[0], 0) for c in cases], np.uint8
+        ),
+        np.asarray(conds, np.uint32),
+        [c[0].encode() for c in cases],
+        list(POD_PHASES.conditions[:3]),
+        hosts, ips, starts, cblobs, iblobs,
+    )
+    for i, (phase, bits, ctrs, ictrs) in enumerate(cases):
+        pod = {
+            "metadata": {"creationTimestamp": starts[i].decode()},
+            "spec": {"containers": ctrs, "initContainers": ictrs},
+            "status": {},
+        }
+        want = render_pod_status_body(
+            pod, phase, bits, hosts[i].decode(), ips[i].decode()
+        )
+        got = bytes(bodies[i])
+        assert got == want, (phase, bits, i)
+        if phase in legacy_exact:
+            assert got == bytes(legacy[i]), (phase, bits, i)
+    # the fused call's fingerprints are the canonical echo-drop seeds
+    ref = native.fingerprint_statuses([bytes(b) for b in bodies])
+    assert (fps == ref).all()
+
+
+def test_template_splice_extended_phase_vocab():
+    """Stage docs extending the phase space get templates too — custom
+    phases render byte-identically to render.py."""
+    rules = default_pod_rules() + [
+        LifecycleRule(
+            name="pod-evict", resource=ResourceKind.POD,
+            from_phases=("Running",),
+            delay=Delay.constant(0.0),
+            effect=StatusEffect(to_phase="Evictedé"),
+        )
+    ]
+    ptab = compile_rules(rules, ResourceKind.POD)
+    tpl = compile_emit_templates(ptab)
+    assert "Evictedé" in tpl.phase_names
+    et = native.EmitTable(tpl)
+    t = int(tpl.phase_tpl[ptab.space.phase_id("Evictedé")])
+    bodies, _fps, _st, _need = native.emit_pods(
+        et, np.asarray([t], np.int32), np.asarray([5], np.uint32),
+        [b"10.0.0.1"], [b"10.244.0.9"], [b"2026-02-02T00:00:00Z"],
+        [_ctr_blob(CONTAINER_SHAPES[1])], [b""], NOW.encode(),
+    )
+    pod = {
+        "metadata": {"creationTimestamp": "2026-02-02T00:00:00Z"},
+        "spec": {"containers": CONTAINER_SHAPES[1]},
+        "status": {},
+    }
+    assert bytes(bodies[0]) == render_pod_status_body(
+        pod, "Evictedé", 5, "10.0.0.1", "10.244.0.9"
+    )
+
+
+def test_empty_creation_uses_batch_hoisted_now(monkeypatch):
+    """A row without creationTimestamp splices the batch-hoisted `now`
+    everywhere render.py would call now_rfc3339() — same bytes with the
+    clock pinned (the per-row now_rfc3339() of the old gather, hoisted)."""
+    import kwok_tpu.edge.render as render_mod
+
+    monkeypatch.setattr(render_mod, "now_rfc3339", lambda: NOW)
+    ptab, tpl, et = _tables()
+    t = int(tpl.phase_tpl[ptab.space.phase_id("Running")])
+    bodies, _fps, _st, _need = native.emit_pods(
+        et, np.asarray([t], np.int32), np.asarray([7], np.uint32),
+        [b"10.0.0.1"], [b"10.244.0.1"], [b""],
+        [_ctr_blob(CONTAINER_SHAPES[1])], [b""], NOW.encode(),
+    )
+    pod = {"metadata": {}, "spec": {"containers": CONTAINER_SHAPES[1]},
+           "status": {}}
+    assert bytes(bodies[0]) == render_pod_status_body(
+        pod, "Running", 7, "10.0.0.1", "10.244.0.1"
+    )
+
+
+def test_heartbeat_byte_parity():
+    """The heartbeat batch renderer against render.py's byte oracle."""
+    meta = [
+        (name, *_NODE_CONDITION_META.get(name, ("KwokRule", name)))
+        for name in NODE_PHASES.conditions
+    ]
+    rng = np.random.default_rng(7)
+    n = 64
+    bits = rng.integers(
+        0, 1 << len(NODE_PHASES.conditions), n, dtype=np.uint32
+    )
+    starts = [
+        f"2026-07-{d:02d}T08:00:00Z".encode()
+        for d in rng.integers(1, 28, n)
+    ]
+    out = native.render_heartbeats(bits, meta, NOW, starts)
+    for i in range(n):
+        assert bytes(out[i]) == render_heartbeat_body(
+            int(bits[i]), NOW, starts[i].decode()
+        ), i
+
+
+# ----------------------------------------------------- engine-level parity
+
+
+class RecordingPump:
+    """StubPump that records every request tuple and answers 200."""
+
+    def __init__(self):
+        self.reqs = []
+
+    def send(self, reqs):
+        self.reqs.extend(reqs)
+        return np.full(len(reqs), 200, np.int32)
+
+    def close(self):
+        pass
+
+
+def _run_emit_engine(n_pods: int):
+    """Ingest a node + n pods with pinned creation stamps and tick until
+    the batch emit fired; returns the recorded (path, body) pairs."""
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True))
+    pump = RecordingPump()
+    eng._pump = _PumpGroup([pump])
+    eng._pump_tried = True
+    eng._pump_base = ""
+    server.create("nodes", make_node("en0"))
+    eng._q.put(("nodes", "ADDED", server.get("nodes", None, "en0")))
+    for i in range(n_pods):
+        pod = make_pod(f"ep-{i}", node="en0")
+        pod["metadata"]["creationTimestamp"] = "2026-03-01T00:00:00Z"
+        server.create("pods", pod)
+        eng._q.put(("pods", "ADDED", server.get("pods", "default", f"ep-{i}")))
+    deadline = time.time() + 10
+    while time.time() < deadline and len(
+        [r for r in pump.reqs if r[0] == "PATCH"]
+    ) < n_pods:
+        eng.pump(1)
+    out = []
+    for method, path, body, *_ct in pump.reqs:
+        if method != "PATCH":
+            continue
+        p = path if isinstance(path, str) else path.decode()
+        out.append((p, bytes(body)))
+    return sorted(out)
+
+
+def test_engine_template_path_matches_disabled_path(monkeypatch):
+    """The KWOK_TPU_NATIVE_EMIT=0 contract, both directions: the default
+    template engine and the disabled engine emit byte-identical patch
+    batches (paths + bodies), and the disabled engine pays no column
+    maintenance at ingest."""
+    tpl_reqs = _run_emit_engine(8)
+    monkeypatch.setenv("KWOK_TPU_NATIVE_EMIT", "0")
+    legacy_reqs = _run_emit_engine(8)
+    assert tpl_reqs and tpl_reqs == legacy_reqs
+
+
+def test_disabled_engine_stages_no_columns(monkeypatch):
+    monkeypatch.setenv("KWOK_TPU_NATIVE_EMIT", "0")
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True))
+    assert eng._emit_tpl is None and not eng._emit_cols
+    server.create("nodes", make_node("zn0"))
+    eng._q.put(("nodes", "ADDED", server.get("nodes", None, "zn0")))
+    server.create("pods", make_pod("zp0", node="zn0"))
+    eng._q.put(("pods", "ADDED", server.get("pods", "default", "zp0")))
+    eng.pump(2)
+    pool = eng.pods.pool
+    idx = pool.lookup(("default", "zp0"))
+    assert idx is not None
+    assert pool.eflags[idx] == 0 and pool.start_b[idx] is None
+
+
+def test_enabled_engine_stages_columns():
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True))
+    assert eng._emit_tpl is not None and eng._emit_cols
+    server.create("nodes", make_node("cn0"))
+    eng._q.put(("nodes", "ADDED", server.get("nodes", None, "cn0")))
+    pod = make_pod("cp0", node="cn0")
+    pod["metadata"]["creationTimestamp"] = "2026-03-01T00:00:00Z"
+    server.create("pods", pod)
+    eng._q.put(("pods", "ADDED", server.get("pods", "default", "cp0")))
+    eng.pump(1)
+    pool = eng.pods.pool
+    idx = pool.lookup(("default", "cp0"))
+    from kwok_tpu.engine.rowpool import EF_RENDER
+
+    assert pool.eflags[idx] & EF_RENDER
+    assert pool.path_b[idx] == b"/api/v1/namespaces/default/pods/cp0"
+    assert pool.start_b[idx] == b"2026-03-01T00:00:00Z"
+    assert pool.ctr_b[idx] == b"c\x1fbusybox"
+    # released rows clear every column (a recycled index must never
+    # splice the previous occupant's bytes)
+    pool.release(("default", "cp0"))
+    assert pool.eflags[idx] == 0 and pool.path_b[idx] is None
+
+
+def test_delete_path_column_shared_with_status_path():
+    """_emit_deletes_native rides the same staged path column (minus the
+    /status suffix) the patch path uses — byte-equal to the old f-string."""
+    server = FakeKube()
+    eng = SyncEngine(server, EngineConfig(manage_all_nodes=True))
+    pump = RecordingPump()
+    pump.send_ordered = lambda batches: [
+        pump.send(reqs) for reqs in batches
+    ]
+    eng._pump = _PumpGroup([pump])
+    eng._pump_tried = True
+    eng._pump_base = ""
+    server.create("nodes", make_node("dn0"))
+    eng._q.put(("nodes", "ADDED", server.get("nodes", None, "dn0")))
+    names = ["dp a", "dp/b"]  # URL-quoting must survive the column move
+    for name in names:
+        server.create("pods", make_pod(name, node="dn0"))
+        eng._q.put(("pods", "ADDED", server.get("pods", "default", name)))
+    eng.pump(1)
+    del_rows = [
+        (("default", name), eng.pods.pool.lookup(("default", name)))
+        for name in names
+    ]
+    eng._emit_deletes_native(eng.pods, del_rows)
+    from urllib.parse import quote as _q
+
+    deletes = [r for r in pump.reqs if r[0] == "DELETE"]
+    got = sorted(
+        p.decode() if isinstance(p, (bytes, memoryview)) else p
+        for _m, p, *_ in deletes
+    )
+    assert got == sorted(
+        f"/api/v1/namespaces/default/pods/{_q(name)}" for name in names
+    )
+
+
+# ------------------------------------------------- fused send + crash replay
+
+
+def test_fused_send_roundtrip_against_native_apiserver():
+    """The one-call render+send: bodies land on a real mock apiserver and
+    the resulting object state matches what the split path produces."""
+    import subprocess
+
+    from benchmarks.soak import _wait_http
+    from kwok_tpu.kwokctl import netutil
+
+    bin_ = native.apiserver_binary()
+    if not bin_:
+        pytest.skip("no native apiserver binary")
+    port = netutil.get_unused_port()
+    proc = subprocess.Popen(
+        [bin_, "--port", str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        _wait_http(f"http://127.0.0.1:{port}", "/healthz", timeout=30)
+        pump = native.Pump("127.0.0.1", port, nconn=2)
+        n = 6
+        creates = [
+            ("POST", "/api/v1/namespaces/default/pods", json.dumps({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"fu-{i}", "namespace": "default"},
+                "spec": {"nodeName": "n0",
+                         "containers": [{"name": "c", "image": "x"}]},
+            }, separators=(",", ":")).encode())
+            for i in range(n)
+        ]
+        st = pump.send(creates)
+        assert ((st >= 200) & (st < 300)).all()
+        ptab, tpl, et = _tables()
+        t = int(tpl.phase_tpl[ptab.space.phase_id("Running")])
+        bodies, fps, status, _need = native.emit_pods(
+            et, np.full(n, t, np.int32), np.full(n, 7, np.uint32),
+            [b"10.0.0.1"] * n,
+            [f"10.244.9.{i}".encode() for i in range(n)],
+            [b"2026-03-01T00:00:00Z"] * n, [b"c\x1fx"] * n, [b""] * n,
+            NOW.encode(), pump=pump,
+            paths=[
+                f"/api/v1/namespaces/default/pods/fu-{i}".encode()
+                for i in range(n)
+            ],
+        )
+        assert ((status >= 200) & (status < 300)).all(), status
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/v1/namespaces/default/pods/fu-3"
+        ) as r:
+            obj = json.load(r)
+        assert obj["status"]["phase"] == "Running"
+        assert obj["status"]["podIP"] == "10.244.9.3"
+        pump.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+class ApplyPump:
+    """Stub pump that APPLIES each request to the FakeKube store — the
+    native emit paths run for real (template gather, splice, batched
+    send) while the watch echo feedback loop stays intact."""
+
+    def __init__(self, kube):
+        self.kube = kube
+        self.native_batches = 0
+
+    def send(self, reqs):
+        out = []
+        for method, path, body, *_ct in reqs:
+            p = path.decode() if isinstance(path, (bytes, memoryview)) else path
+            parts = p.strip("/").split("/")
+            try:
+                if method == "PATCH" and parts[-1] == "status":
+                    if parts[2] == "namespaces":  # pods
+                        self.kube.patch_status(
+                            "pods", parts[3], parts[5],
+                            json.loads(bytes(body)),
+                        )
+                    else:  # nodes
+                        self.kube.patch_status(
+                            "nodes", None, parts[3], json.loads(bytes(body))
+                        )
+                    out.append(200)
+                elif method == "DELETE":
+                    self.kube.delete(
+                        "pods", parts[3], parts[5], grace_seconds=0
+                    )
+                    out.append(200)
+                else:
+                    out.append(200)
+            except Exception:
+                out.append(500)
+        if len(reqs) > 1:
+            self.native_batches += 1
+        return np.asarray(out, np.int32)
+
+    def close(self):
+        pass
+
+
+def test_emit_replay_survives_worker_kill_mid_slab():
+    """PR 6's _emit_inflight contract through the template path: emit
+    workers killed by chaos pills mid-slab (batched native emits are
+    flowing through their stub pumps when the pills land) are
+    watchdog-restarted and replay the same irreplaceable wire slice —
+    every pod still converges, no patch is lost."""
+    from kwok_tpu.telemetry.errors import worker_restarts_total
+
+    kube = FakeKube()
+    eng = ClusterEngine(
+        kube,
+        EngineConfig(
+            manage_all_nodes=True, tick_interval=0.02, drain_shards=2,
+            faults="seed=11",  # plane armed; zero probabilistic rates
+        ),
+    )
+    assert eng._emit_tpl is not None
+    pumps = []
+    for lane in eng._lanes.lanes:
+        p = ApplyPump(kube)
+        pumps.append(p)
+        lane.engine._pump = _PumpGroup([p])
+        lane.engine._pump_tried = True
+        lane.engine._pump_base = ""
+    restarts0 = [
+        worker_restarts_total(f"kwok-emit{i}") for i in range(2)
+    ]
+    eng.start()
+
+    def phase_of(i):
+        return (
+            (kube.get("pods", "default", f"rp-{i}") or {})
+            .get("status", {}).get("phase")
+        )
+
+    def wait(pred, timeout=30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline and not pred():
+            time.sleep(0.05)
+        return pred()
+
+    try:
+        kube.create("nodes", make_node("rn0"))
+        for i in range(16):
+            kube.create("pods", make_pod(f"rp-{i}", node="rn0"))
+        assert wait(lambda: all(
+            phase_of(i) == "Running" for i in range(16)
+        )), "first wave did not converge through the template emit path"
+
+        assert eng._faults.kill_worker("kwok-emit0")
+        assert eng._faults.kill_worker("kwok-emit1")
+        # traffic makes the parked emit workers wake mid-slab and eat
+        # their pills
+        for i in range(16, 48):
+            kube.create("pods", make_pod(f"rp-{i}", node="rn0"))
+        assert wait(lambda: all(
+            worker_restarts_total(f"kwok-emit{i}") > restarts0[i]
+            for i in range(2)
+        )), "killed emit workers were not restarted"
+        assert wait(lambda: all(
+            phase_of(i) == "Running" for i in range(48)
+        )), "replayed slices did not converge"
+        assert sum(p.native_batches for p in pumps) > 0, (
+            "the batched native emit path never ran"
+        )
+    finally:
+        eng.stop()
