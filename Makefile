@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check chaos-check restart-check fleet-check drift-check attrib-check ha-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check proc-check chaos-check restart-check fleet-check drift-check attrib-check ha-check image cluster-image clean
 
 all: build
 
@@ -44,6 +44,21 @@ lane-check: ## sharded-lane ordering oracle + thread-sanity + lock-witness pass 
 	    tests/test_native_emit.py -q
 	$(PYENV) python3 benchmarks/route_micro.py --check
 	$(PYENV) python3 benchmarks/emit_micro.py --check
+	$(PYENV) python3 benchmarks/proc_micro.py --check
+
+# proc-check: the process-lane gate (ISSUE 15): the proclanes unit tier
+# (shm ring/slot/bank semantics, node topology tap, slot-guard pump,
+# config/CLI plumbing, fault-plane SIGKILL targets, watchdog budget
+# sharing) INCLUDING the slow spawn e2e tier-1 skips, then
+# benchmarks/proc_soak.py --check: the per-key patch-order oracle
+# byte-compared against the single-lane engine, a rotating lane-process
+# SIGKILL chaos arm, and a mid-delay SIGKILL restart arm (delays resumed
+# within one tick quantum from lane<i>.ckpt.json), with /dev/shm proven
+# clean after every arm (docs/resilience.md "Process lanes";
+# PROC_r*.json).
+proc-check: ## process-lane ordering + chaos/restart gate (PROC_r* artifact, shm-leak proof)
+	$(PYENV) python3 -m pytest tests/test_proclanes.py -q
+	$(PYENV) python3 benchmarks/proc_soak.py --check
 
 # chaos-check: the resilience suite (fault plane, retry policy, watchdog,
 # pump partial-write recovery, shedding) plus the chaos convergence gate:

@@ -593,6 +593,102 @@ print(n)
 """
 
 
+def _handoff_child(ring_name: str, conn) -> None:
+    """proc_handoff_costs consumer: drain descriptors + ring bytes the
+    way a lane process does (engine/proclanes.py child loop), acking
+    SYNC barriers so the parent can prove the ring drained between
+    trials."""
+    from kwok_tpu.engine import shm as shm_mod
+
+    ring = shm_mod.RawRing(ring_name)
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            if msg[0] == "STOP":
+                return
+            if msg[0] == "SYNC":
+                conn.send(("ACK",))
+                continue
+            _op, off, ln, _bounds = msg
+            ring.read(off, ln)
+    finally:
+        ring.close()
+
+
+def proc_handoff_costs(n: int, trials: int) -> dict:
+    """Parent-side cost of the process-lane handoff (ISSUE 15): the
+    shared-memory ring write (raw bytes copied exactly once, never
+    re-serialized) plus the (offset, length, bounds) descriptor send —
+    the work ProcLaneSet._ship does per (lane, kind) window slice,
+    measured against a live spawn-context consumer process. The ring is
+    sized to hold one whole trial so a lagging consumer (this may run
+    on a starved host) can never stall the writer into measuring the
+    scheduler instead of the copy; a SYNC barrier between trials proves
+    the ring drained."""
+    import multiprocessing as mp
+
+    from kwok_tpu.engine import shm as shm_mod
+
+    per_window = 256
+    windows = max(1, min(n, 20000) // per_window)
+    lines = [_pod_line(i) for i in range(per_window)]
+    blob = b"".join(lines)
+    ring = shm_mod.RawRing(
+        shm_mod.arena_name(f"handoff-{os.getpid()}"),
+        (len(blob) + 4096) * windows + (1 << 20), create=True,
+    )
+    ctx = mp.get_context("spawn")
+    parent_conn, child_conn = ctx.Pipe()
+    proc = ctx.Process(
+        target=_handoff_child, args=(ring.name, child_conn), daemon=True
+    )
+    proc.start()
+    child_conn.close()
+    samples = []
+    try:
+        for _ in range(trials):
+            spent = 0.0
+            for _w in range(windows):
+                t0 = time.perf_counter()
+                bounds = [0]
+                for p in lines:
+                    bounds.append(bounds[-1] + len(p))
+                b = b"".join(lines)
+                off = ring.try_write(b)
+                if off is None:  # sizing failed: disqualify the trial
+                    spent = float("nan")
+                    break
+                parent_conn.send(("RAWB", off, len(b), bounds))
+                spent += time.perf_counter() - t0
+            parent_conn.send(("SYNC",))
+            parent_conn.recv()
+            if spent == spent:  # not NaN
+                samples.append(spent / (windows * per_window) * 1e6)
+    finally:
+        try:
+            parent_conn.send(("STOP",))
+        except (OSError, BrokenPipeError):
+            pass
+        proc.join(timeout=10)
+        if proc.is_alive():
+            proc.kill()
+            proc.join(timeout=5)
+        parent_conn.close()
+        ring.close(unlink=True)
+    if not samples:
+        return {"error": "every trial overflowed the sized ring"}
+    return {
+        "proc_handoff_us": round(statistics.median(samples), 3),
+        "events_per_window": per_window,
+        "windows": windows,
+        "trials": len(samples),
+        "bytes_per_event": round(len(blob) / per_window, 1),
+    }
+
+
 def contention_factor(procs: int = 6, seconds: float = 2.0) -> dict:
     """The multi-process tax the per-process probes cannot see: run the
     same fixed CPU workload in 1 process, then in `procs` concurrent
@@ -627,7 +723,8 @@ def contention_factor(procs: int = 6, seconds: float = 2.0) -> dict:
 def build_model(eng: dict, api: dict, rig: dict, watch: dict,
                 members: int, ticks_per_kpod: float = 0.2,
                 contention: float = 1.0, drain_shards: int = 1,
-                max_drain_shards: int = 0) -> dict:
+                max_drain_shards: int = 0,
+                gil_overlap: float = 1.0) -> dict:
     """Assemble per-pod costs and the pods/s-vs-cores curve.
 
     A pod's life in the homogeneous soak:
@@ -662,14 +759,15 @@ def build_model(eng: dict, api: dict, rig: dict, watch: dict,
     lm = lane_model(eng, api, rig, watch, members=members,
                     contention=contention, drain_shards=drain_shards,
                     ticks_per_kpod=ticks_per_kpod,
-                    max_drain_shards=max_drain_shards)
+                    max_drain_shards=max_drain_shards,
+                    gil_overlap=gil_overlap)
     from kwok_tpu.config.types import DEFAULT_MAX_DRAIN_SHARDS
 
     cap = max_drain_shards if max_drain_shards > 0 else (
         DEFAULT_MAX_DRAIN_SHARDS
     )
     auto_txt = f"auto (min(cores, {cap}))"
-    return {
+    out = {
         "per_pod_us": lm["per_pod_us"],
         "poll_us_per_store_pod": round(poll_per_store_pod, 3),
         "drain_shards": (
@@ -697,6 +795,25 @@ def build_model(eng: dict, api: dict, rig: dict, watch: dict,
             "attached)"
         ),
     }
+    if "predicted_pods_per_s_by_cores_proc_lanes" in lm:
+        out["predicted_pods_per_s_by_cores_proc_lanes"] = lm[
+            "predicted_pods_per_s_by_cores_proc_lanes"
+        ]
+        out["proc_lanes_note"] = (
+            "process lanes (--lane-procs, engine/proclanes.py): the "
+            "parent router lane pays parse+partition + the MEASURED "
+            "shm-ring+descriptor handoff (proc_handoff_us); each lane "
+            "process runs the whole single-lane apply — its slice's "
+            "re-parse, drain+emit, flush, CPU tick kernel, and pump — "
+            "on a true core at full overlap (no GIL). The threaded "
+            "curve honors gil_overlap where supplied: the GIL-holding "
+            "(1-g) share of per-lane apply serializes across lanes "
+            "(Amdahl, capped at 1/(1-g); LANES r07 measured 2.2x from "
+            "4 threaded lanes => g~=0.73, a ~3.7x ceiling); the proc "
+            "curve's kernel share stays on the host — children are "
+            "host-CPU engines, per-child TPU placement is future work"
+        )
+    return out
 
 
 def main() -> int:
@@ -715,6 +832,13 @@ def main() -> int:
     p.add_argument("--max-drain-shards", type=int, default=0,
                    help="cap on the AUTO lane count, mirroring the "
                    "engine's --max-drain-shards (0 = built-in default)")
+    p.add_argument("--gil-overlap", type=float, default=1.0,
+                   help="GIL-released fraction g of per-lane apply: "
+                   "threaded lanes scale Amdahl-style, capped at 1/(1-g) "
+                   "(1.0 = the legacy optimistic full-overlap curve; "
+                   "LANES r07 measured 2.2x from 4 threaded lanes => "
+                   "g~=0.73, a ~3.7x hard ceiling). The process-lane "
+                   "curve ignores it: true cores, no GIL")
     p.add_argument("--remodel", action="append", default=[],
                    help="path to a prior COSTMODEL_r*.json: re-predict "
                    "its measured inputs under the CURRENT model and embed "
@@ -751,6 +875,12 @@ def main() -> int:
     emit_pump = emit_pump_costs(min(args.events, 20000), args.trials)
     if "emit_pump_us" in emit_pump and eng.get("emit_native_templates"):
         eng["emit_pump_us"] = emit_pump["emit_pump_us"]
+    # the cross-process handoff term (ISSUE 15): measured against a live
+    # spawned consumer; folded into the engine inputs so the lane model
+    # emits the process-lane curve alongside the threaded one
+    handoff = proc_handoff_costs(min(args.events, 20000), args.trials)
+    if "proc_handoff_us" in handoff:
+        eng["proc_handoff_us"] = handoff["proc_handoff_us"]
     api = apiserver_costs(min(args.events, 20000), args.trials)
     rig = rig_costs(min(args.events, 20000), args.trials)
     watch = watch_read_costs(min(args.events, 20000), args.trials)
@@ -761,11 +891,13 @@ def main() -> int:
     model = build_model(eng, api, rig, watch, args.members,
                         contention=cont["factor"],
                         drain_shards=args.drain_shards,
-                        max_drain_shards=args.max_drain_shards)
+                        max_drain_shards=args.max_drain_shards,
+                        gil_overlap=args.gil_overlap)
     out = {
         "metric": "cost model: per-process us CPU per op + pods/s-vs-cores",
         "engine": eng,
         "emit_pump": emit_pump,
+        "proc_handoff": handoff,
         "apiserver": api,
         "rig": rig,
         "watch": watch,
@@ -784,6 +916,7 @@ def main() -> int:
                 ),
                 drain_shards=args.drain_shards,
                 max_drain_shards=args.max_drain_shards,
+                gil_overlap=args.gil_overlap,
             )
         except KeyError as e:
             # a JSON that parses but is not a COSTMODEL artifact (missing

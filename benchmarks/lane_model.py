@@ -48,7 +48,8 @@ def members_at(cores: int, members: int) -> int:
 def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
                members: int = 4, contention: float = 1.0,
                drain_shards: int = 0, ticks_per_kpod: float = 0.2,
-               max_drain_shards: int = 0) -> dict:
+               max_drain_shards: int = 0,
+               gil_overlap: float = 1.0) -> dict:
     """Per-pod cost components + the predicted pods/s-vs-cores curves.
 
     ``drain_shards``: the engine's host-lane count; <=0 = auto, meaning an
@@ -57,6 +58,29 @@ def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
     curve's N-core point models what that host would actually run. The
     single-lane curve is always computed alongside — the trajectory of
     the host ceiling moving.
+
+    r09 re-fit (process lanes, ISSUE 15): ``gil_overlap`` is the
+    GIL-RELEASED fraction of per-lane drain+emit work — the share that
+    actually overlaps across THREADED lanes (the C parse/kernel/pump
+    calls); the 1-gil_overlap remainder is Python holding the GIL and
+    serializes across every lane in the process. Amdahl over the lane
+    count: eff_t = 1/((1-g) + g/eff), so threaded scaling CAPS at
+    1/(1-g) no matter how many lanes or cores. LANES r07 measured 2.2x
+    from 4 threaded lanes => 1/((1-g)+g/4) = 2.2 => g ~= 0.73, a ~3.7x
+    hard ceiling — the wall this round's process lanes remove. The
+    default 1.0 reproduces the older, optimistic full-overlap curve —
+    pass the measured value for an honest threaded ceiling. When the
+    inputs carry
+    ``proc_handoff_us`` — the measured parent-side cost of the
+    cross-process handoff (shm ring write + descriptor send, per event) —
+    a third curve ``predicted_pods_per_s_by_cores_proc_lanes`` models the
+    process-lane pipeline: the parent router lane pays parse + partition
+    + handoff, each lane PROCESS pays its full single-lane apply (parse
+    re-run on its slice, drain, flush, its own CPU tick kernel, emit,
+    pump) at FULL overlap — true cores, no GIL — and the apiserver/rig
+    lanes are unchanged. The proc curve's kernel share stays on the host
+    (children are host-CPU engines; per-child TPU placement is future
+    work), disclosed in the per-lane term.
     """
     fan = api.get("watch_fanout_per_watcher_us", 0.0)
     api_pp = (
@@ -105,10 +129,17 @@ def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
     )
     total_1core = total_modeled * max(1.0, contention)
 
-    def predict(cores: int, shards: int) -> float:
+    parse_pp = eng.get("batch_parse_us", 0.0)
+    handoff_pp = eng.get("proc_handoff_us")
+
+    def predict(cores: int, shards: int, procs: bool = False) -> float:
         if cores == 1:
-            # on 1 core every microsecond serializes, sharded or not
-            return 1e6 / total_1core
+            # on 1 core every microsecond serializes, sharded or not —
+            # and process lanes additionally pay the handoff
+            base = total_1core
+            if procs and handoff_pp is not None:
+                base += handoff_pp + parse_pp  # re-parse in the child
+            return 1e6 / base
         # pipeline model: each process/thread group is a lane once cores
         # allow. With shards>1 the old engine-serial lane splits into the
         # router, the flush/dispatch coordinator, and per-shard drain+emit
@@ -117,11 +148,29 @@ def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
         if shards <= 0:
             shards = auto_drain_shards(cores, max_drain_shards)
         eff = min(shards, max(1, cores - 2))
-        if shards > 1:
-            eng_lanes = [router_pp, lane_pp / eff]
+        if procs:
+            # process lanes: parent router = parse+partition + shm/pipe
+            # handoff; each lane process runs the whole single-lane
+            # apply on a true core at FULL overlap (no GIL): its slice's
+            # re-parse, drain+emit, staged flush, its own CPU tick
+            # kernel, and its pump group. Coordinator flush disappears
+            # (children tick themselves).
+            eng_lanes = [
+                router_pp + (handoff_pp or 0.0),
+                (lane_pp + parse_pp + flush_pp + kern_pp + pump_pp) / eff,
+            ]
+        elif shards > 1:
+            # threaded lanes: the GIL-holding (1-g) share of per-lane
+            # apply serializes across every lane in the process — Amdahl
+            # over the lane count, capping threaded scaling at 1/(1-g)
+            # (g=1.0 = the legacy optimistic full-overlap curve)
+            g = max(0.0, min(1.0, gil_overlap))
+            eff_t = 1.0 / ((1.0 - g) + g / eff)
+            eng_lanes = [router_pp, lane_pp / eff_t]
             if split_flush:
                 eng_lanes.append(flush_pp)  # coordinator tick thread
                 # pump sends ride each lane's own connection group
+                # (GIL-free C: full overlap)
                 eng_lanes.append(pump_pp / eff)
             else:
                 eng_lanes.append(pump_pp)
@@ -131,8 +180,9 @@ def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
             api_pp / min(members_at(cores, members), max(1, cores - 2)),
             rig_pp / min(4, cores),
             watch_pp / 2,  # one watch thread per kind
-            kern_pp,  # offloads entirely with a TPU attached
         ]
+        if not procs:
+            lanes.append(kern_pp)  # offloads entirely with a TPU attached
         return 1e6 / max(lanes)
 
     per_pod = {
@@ -150,7 +200,7 @@ def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
     }
     if split_flush:
         per_pod["engine_tick_flush"] = round(flush_pp, 1)
-    return {
+    out = {
         "per_pod_us": per_pod,
         "predicted_pods_per_s_by_cores": {
             str(c): round(predict(c, drain_shards), 0) for c in CORES_AXIS
@@ -159,3 +209,19 @@ def lane_model(eng: dict, api: dict, rig: dict, watch: dict,
             str(c): round(predict(c, 1), 0) for c in CORES_AXIS
         },
     }
+    if gil_overlap < 1.0:
+        per_pod["threaded_gil_overlap"] = round(gil_overlap, 3)
+    if handoff_pp is not None:
+        # the r09 process-lane curve: parent router pays parse+partition
+        # + the measured shm/pipe handoff; each lane PROCESS runs the
+        # whole single-lane apply (incl. its slice's re-parse, flush,
+        # CPU tick kernel, pump) on a true core at full overlap
+        per_pod["proc_handoff_us"] = round(handoff_pp, 2)
+        per_pod["proc_lane_total_us"] = round(
+            lane_pp + parse_pp + flush_pp + kern_pp + pump_pp, 1
+        )
+        out["predicted_pods_per_s_by_cores_proc_lanes"] = {
+            str(c): round(predict(c, drain_shards, procs=True), 0)
+            for c in CORES_AXIS
+        }
+    return out

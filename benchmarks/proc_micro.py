@@ -1,0 +1,218 @@
+"""Threaded vs process lanes on the same RAW stream (ISSUE 15).
+
+LANES_r07 measured the threaded multi-lane ingest win at ~2.2x and
+called it the floor: lanes overlap only where stages release the GIL.
+Process lanes put each lane's drain+apply on a true core. This bench
+measures exactly that delta, route_micro-style — the SAME raw watch
+lines pushed into both engines' ingest queues, interleaved best-of
+windows (single windows on shared hosts swing far more than the delta
+under test), with per-window distinct keys so every event is a fresh
+row:
+
+- threaded arm: a ``drain_shards=L`` engine (in-process FakeKube; the
+  ingest path never touches the wire — pods land on an unmanaged node,
+  so no transitions fire and the measurement is the drain tier alone);
+- process arm: a ``--lane-procs`` engine against an HTTP mock master
+  (the children need real clients); same lines through the parent
+  router -> shm ring -> child parse+apply; completion read from the
+  shared StatusBank (refreshed every 50ms — up to one refresh of
+  measurement noise per window, disclosed).
+
+Both engines stay alive across windows (spawn cost excluded — it is
+startup, not throughput). Prints ONE JSON line with the measured
+events/s per arm and the ratio; ``--check`` exits nonzero if the
+process arm does not reach PROC_OVER_THREADED_MIN x the threaded arm on
+hosts with >= 2 effective cores, and emits an honest skip verdict (the
+TPU-leg pattern) on starved hosts where the ratio measures the
+scheduler instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: the acceptance ratio at >= 2 effective cores (ISSUE 15); override per
+#: deployment with KWOK_PROC_MICRO_MIN_RATIO
+PROC_OVER_THREADED_MIN = float(
+    os.environ.get("KWOK_PROC_MICRO_MIN_RATIO", "2.0")
+)
+
+
+def effective_cores() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def _pod_line(window: int, i: int) -> bytes:
+    return json.dumps({
+        "type": "ADDED",
+        "object": {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pm{window}-{i}", "namespace": "default",
+                         "resourceVersion": str(1000 + window * 1000000 + i)},
+            "spec": {"nodeName": "pm-node-absent",
+                     "containers": [{"name": "c", "image": "x"}]},
+            "status": {"phase": "Pending"},
+        },
+    }, separators=(",", ":")).encode()
+
+
+def run(events: int, lanes: int, windows: int, timeout: float) -> dict:
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import FakeKube, HttpFakeApiserver
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+    from kwok_tpu.engine import shm as shm_mod
+
+    cores = effective_cores()
+    thr = ClusterEngine(FakeKube(), EngineConfig(
+        manage_all_nodes=True, tick_interval=0.05, drain_shards=lanes,
+        initial_capacity=max(4096, events * (windows + 1)),
+    ))
+    thr.start()
+    srv = HttpFakeApiserver(store=FakeKube()).start()
+    proc = ClusterEngine(
+        HttpKubeClient(f"http://127.0.0.1:{srv.port}"),
+        EngineConfig(
+            manage_all_nodes=True, tick_interval=0.05, drain_shards=lanes,
+            lane_procs=True,
+            initial_capacity=max(4096, events * (windows + 1)),
+        ),
+    )
+    proc.start()
+    out: dict = {
+        "metric": (
+            f"multi-lane RAW ingest wall at {events} events x {lanes} "
+            f"lanes (best of {windows} interleaved windows; threaded = "
+            "shared-GIL ShardLanes, process = spawned lane workers over "
+            "the shm ring)"
+        ),
+        "events": events, "lanes": lanes, "windows": windows,
+        "effective_cores": cores,
+    }
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline and not proc.ready:
+            time.sleep(0.2)
+        if not proc.ready:
+            raise RuntimeError("process-lane engine never became ready")
+
+        def thr_count() -> int:
+            return sum(
+                len(lane.engine.pods.pool) for lane in thr._lanes.lanes
+            )
+
+        def proc_count() -> int:
+            return int(
+                proc._proc.bank.rows[:, shm_mod.BANK_PODS].sum()
+            )
+
+        def window(eng, count_fn, base: int, w: int) -> float:
+            lines = [_pod_line(w, i) for i in range(events)]
+            target = base + events
+            t0 = time.perf_counter()
+            put = eng._q.put
+            t = time.monotonic()
+            for ln in lines:
+                put(("pods", "RAW", ln, t))
+            end = time.time() + timeout
+            while count_fn() < target:
+                if time.time() > end:
+                    raise RuntimeError(
+                        f"window {w}: {count_fn()}/{target} applied"
+                    )
+                time.sleep(0.002)
+            return time.perf_counter() - t0
+
+        thr_best = proc_best = float("inf")
+        for w in range(windows):
+            thr_best = min(
+                thr_best, window(thr, thr_count, thr_count(), 2 * w)
+            )
+            proc_best = min(
+                proc_best, window(proc, proc_count, proc_count(), 2 * w + 1)
+            )
+        thr_eps = events / thr_best
+        proc_eps = events / proc_best
+        out.update({
+            "threaded_events_per_s": round(thr_eps, 1),
+            "proc_events_per_s": round(proc_eps, 1),
+            "threaded_us_per_event": round(1e6 * thr_best / events, 3),
+            "proc_us_per_event": round(1e6 * proc_best / events, 3),
+            "proc_over_threaded": round(proc_eps / max(thr_eps, 1e-9), 3),
+            "status_refresh_noise_s": 0.05,
+        })
+    finally:
+        try:
+            thr.stop()
+        finally:
+            try:
+                proc.stop()
+            finally:
+                srv.stop()
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--events", type=int, default=20000)
+    p.add_argument("--lanes", type=int, default=0,
+                   help="lane count (0 = effective cores, capped at 8)")
+    p.add_argument("--windows", type=int, default=3)
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="per-window apply deadline (s)")
+    p.add_argument("--check", action="store_true",
+                   help="regression gate: small workload; on >= 2 "
+                   "effective cores exit 1 unless process lanes reach "
+                   f"{PROC_OVER_THREADED_MIN}x threaded; on starved "
+                   "hosts record an honest skip verdict instead")
+    args = p.parse_args()
+    cores = effective_cores()
+    if args.lanes <= 0:
+        args.lanes = max(2, min(8, cores))
+    if args.check:
+        args.events = min(args.events, 8000)
+        args.windows = min(args.windows, 2)
+    out = run(args.events, args.lanes, args.windows, args.timeout)
+    gate = None
+    if cores < 2:
+        # a 1-core host cannot overlap lanes at all: the ratio measures
+        # the scheduler, not the architecture — record the measurement
+        # with an explicit skip verdict (the BENCH_TPU skip-rider
+        # pattern) instead of gating on it
+        gate = {
+            "skipped": (
+                f"host exposes {cores} effective core(s); the "
+                f">= {PROC_OVER_THREADED_MIN}x process-vs-threaded gate "
+                "needs >= 2 — re-run on a multi-core host"
+            )
+        }
+    else:
+        gate = {
+            "required_ratio": PROC_OVER_THREADED_MIN,
+            "ok": out.get("proc_over_threaded", 0.0)
+            >= PROC_OVER_THREADED_MIN,
+        }
+    out["gate"] = gate
+    print(json.dumps(out))
+    if args.check and gate.get("ok") is False:
+        print(
+            "proc_micro: process lanes "
+            f"{out.get('proc_over_threaded')}x threaded < required "
+            f"{PROC_OVER_THREADED_MIN}x on {cores} cores",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,481 @@
+"""proc-check: the process-lane correctness gate (ISSUE 15).
+
+Three arms against the HTTP mock apiserver with the server-side oplog
+oracle, all driving the REAL ``tpukwok`` process (the production wiring
+— parent router + spawned lane worker processes over shared memory):
+
+- **ordering**: the same create -> converge -> delete workload through
+  the single-lane engine (the reference arm) and the 2-lane process
+  engine. Gates: final phases byte-identical, per-key collapsed patch
+  order identical for EVERY key, exactly one Running patch per pod in
+  both arms (process fan-out introduces no duplicates).
+- **chaos**: the process engine converges the creates workload while
+  the fault plane's ``worker.kill=kwok-lane*`` delivers rotating REAL
+  SIGKILLs to the lane processes. Gates: converged, one Running patch
+  per pod, respawns recorded (``kwok_lane_proc_restarts_total`` > 0),
+  /readyz not degraded at the end, graceful exit 0.
+- **restart**: pods armed with an 8s Pending->Running Stage delay and
+  per-lane checkpoints on a short cadence; ONE lane process is
+  SIGKILLed mid-delay (the process-lane twin of restart_soak's
+  whole-engine kill). Gates: zero double-fires on the wall-stamped
+  oplog, every pod converges, the killed lane's delays resume within
+  one tick quantum of their checkpointed residues (common respawn
+  anchor factored out with the median, surviving-lane pods excluded —
+  they never stopped), respawn accounted.
+
+Every arm ends with the shm-hygiene gate: no ``kwoktpu-*`` segment left
+in /dev/shm after engine exit — the zero-leak half of the zero-cost
+contract (the threaded-path half rides lane-check's route_micro gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.rig import (  # noqa: E402 (path bootstrap above)
+    EngineProc,
+    MockApiserver,
+    make_node as _make_node,
+    make_pod as _make_pod,
+    pod_phases as _pod_phases,
+    wait_until as _wait,
+)
+
+QUANTUM = 0.25
+DELAY_S = 8.0
+CKPT_INTERVAL = 0.5
+LANES = 2
+
+STAGES_FAST = """\
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata: {name: pod-delete}
+spec:
+  resourceRef: {kind: Pod}
+  selector:
+    matchSelector: on-managed-node
+    matchDeletion: present
+    matchPhases: ["Pending", "Running", "Succeeded", "Failed", "Terminating"]
+  next: {delete: true}
+---
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata: {name: pod-run}
+spec:
+  resourceRef: {kind: Pod}
+  selector: {matchPhases: ["Pending"], matchSelector: managed}
+  next:
+    phase: Running
+    conditions: {Ready: true, ContainersReady: true}
+"""
+
+STAGES_DELAY = STAGES_FAST.replace(
+    "  next:\n    phase: Running",
+    f"  delay: {{duration: {DELAY_S}s}}\n  next:\n    phase: Running",
+)
+
+
+def _shm_leftovers() -> list:
+    try:
+        return [f for f in os.listdir("/dev/shm") if f.startswith("kwoktpu")]
+    except OSError:
+        return []
+
+
+def _engine(master: str, cfg_path: str, workdir: str, *, procs: bool,
+            extra=()) -> EngineProc:
+    args = ["--tick-interval", str(QUANTUM), "--drain-deadline", "30"]
+    if procs:
+        args += ["--drain-shards", str(LANES), "--lane-procs", "true"]
+    else:
+        args += ["--drain-shards", "1"]
+    return EngineProc(master, cfg_path, workdir, extra_args=args + list(extra))
+
+
+def _lane_pids(engine_pid: int) -> list[int]:
+    """The engine's spawned lane processes (cmdline carries
+    multiprocessing's spawn bootstrap; the resource tracker does not)."""
+    out = []
+    try:
+        kids = os.popen(f"ps -o pid= --ppid {engine_pid}").read().split()
+    except OSError:
+        return out
+    for pid in kids:
+        try:
+            with open(f"/proc/{int(pid)}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ")
+        except (OSError, ValueError):
+            continue
+        if b"spawn_main" in cmd and b"resource_tracker" not in cmd:
+            out.append(int(pid))
+    return sorted(out)
+
+
+def _converge_and_delete(store, names, timeout: float) -> dict:
+    out = {}
+    out["converged"] = _wait(
+        lambda: all(
+            ph == "Running" for ph in _pod_phases(store, names).values()
+        ),
+        timeout,
+    )
+    out["final_phases"] = _pod_phases(store, names)
+    # delete wave: half the keys get a deletionTimestamp -> the engine
+    # must emit its DELETE after that key's Running patch (per-key order)
+    doomed = names[::2]
+    for n in doomed:
+        store.patch_meta(
+            "pods", "default", n,
+            {"metadata": {"deletionTimestamp": "2026-01-01T00:00:00Z"}},
+        )
+    out["deleted_ok"] = _wait(
+        lambda: all(
+            store.get("pods", "default", n) is None for n in doomed
+        ),
+        timeout,
+    )
+    out["doomed"] = doomed
+    out["per_key"] = {
+        n: store.per_key_collapsed(("default", n)) for n in names
+    }
+    out["running_patches_per_pod"] = store.phase_counts("Running", names)
+    return out
+
+
+def _run_ordering_arm(pods, cfg_path, timeout, *, procs: bool) -> dict:
+    srv = MockApiserver()
+    store = srv.store
+    names = [f"pp{i}" for i in range(pods)]
+    workdir = tempfile.mkdtemp(prefix="kwok-proc-ord-")
+    eng = _engine(srv.url, cfg_path, workdir, procs=procs)
+    out = {"arm": f"ordering-{'proc' if procs else 'single'}"}
+    try:
+        out["ready_s"] = round(eng.wait_ready(), 3)
+        for i in range(4):
+            store.create("nodes", _make_node(f"pn{i}"))
+        for n in names:
+            store.create("pods", _make_pod(n, f"pn{hash(n) % 4}"))
+        out.update(_converge_and_delete(store, names, timeout))
+        out["sigterm_exit"] = eng.sigterm()
+    finally:
+        eng.kill_if_alive()
+        srv.stop()
+    out["shm_leftover"] = _shm_leftovers()
+    return out
+
+
+def _run_chaos_arm(pods, cfg_path, timeout) -> dict:
+    """Rotating lane-process SIGKILLs, bench-driven so the rotation is
+    paced by OBSERVED respawns (a period-driven storm on a starved host
+    would out-kill the respawn latency and measure the scheduler, not
+    the contract — the ha-check lesson). A parent-side wire storm
+    (watch.cut) runs concurrently: the one fault plane composes with
+    process lanes. The plane's own worker.kill -> SIGKILL delivery is
+    pinned by tests/test_proclanes.py."""
+    srv = MockApiserver()
+    store = srv.store
+    names = [f"cp{i}" for i in range(pods)]
+    workdir = tempfile.mkdtemp(prefix="kwok-proc-chaos-")
+    ckpt = tempfile.mkdtemp(prefix="kwok-proc-chaos-ckpt-")
+    eng = _engine(
+        srv.url, cfg_path, workdir, procs=True,
+        extra=[
+            "--faults", "seed=42;watch.cut=0.02",
+            "--checkpoint-dir", ckpt,
+            "--checkpoint-interval", str(CKPT_INTERVAL),
+        ],
+    )
+    out = {"arm": "chaos"}
+    try:
+        out["ready_s"] = round(eng.wait_ready(), 3)
+        for i in range(4):
+            store.create("nodes", _make_node(f"cn{i}"))
+        for n in names:
+            store.create("pods", _make_pod(n, f"cn{hash(n) % 4}"))
+
+        def restarts(shard: int) -> float:
+            return eng.metrics().get(
+                f'kwok_lane_proc_restarts_total{{shard="{shard}"}}', 0
+            )
+
+        # rotate: SIGKILL each lane in turn, mid-ingest, waiting for the
+        # supervisor's respawn before the next round
+        kills = 0
+        for shard in range(LANES):
+            lanes = _lane_pids(eng.proc.pid)
+            if len(lanes) <= shard:
+                break
+            before = restarts(shard)
+            os.kill(lanes[shard], signal.SIGKILL)
+            kills += 1
+            if not _wait(lambda: restarts(shard) > before, 120):
+                break
+        out["kills_delivered"] = kills
+        out["converged"] = _wait(
+            lambda: all(
+                ph == "Running"
+                for ph in _pod_phases(store, names).values()
+            ),
+            timeout * 2,
+        )
+        out["final_phases"] = _pod_phases(store, names)
+        out["running_patches_per_pod"] = store.phase_counts("Running", names)
+        m = eng.metrics()
+        out["lane_restarts"] = {
+            s: m.get(f'kwok_lane_proc_restarts_total{{shard="{s}"}}', 0)
+            for s in range(LANES)
+        }
+        out["wire_faults_injected"] = m.get(
+            'kwok_faults_injected_total{kind="watch.cut"}', 0
+        )
+        out["readyz_degraded"] = any(
+            v for k, v in m.items() if k.startswith("kwok_degraded{")
+        )
+        out["sigterm_exit"] = eng.sigterm(timeout=60)
+    finally:
+        eng.kill_if_alive()
+        srv.stop()
+    out["shm_leftover"] = _shm_leftovers()
+    return out
+
+
+def _run_restart_arm(pods, cfg_path, timeout) -> dict:
+    from kwok_tpu.engine.rowpool import shard_of
+
+    srv = MockApiserver()
+    store = srv.store
+    names = [f"dp{i}" for i in range(pods)]
+    workdir = tempfile.mkdtemp(prefix="kwok-proc-restart-")
+    ckpt_dir = tempfile.mkdtemp(prefix="kwok-proc-restart-ckpt-")
+    eng = _engine(
+        srv.url, cfg_path, workdir, procs=True,
+        extra=["--checkpoint-dir", ckpt_dir,
+               "--checkpoint-interval", str(CKPT_INTERVAL)],
+    )
+    out = {"arm": "restart"}
+    try:
+        out["ready_s"] = round(eng.wait_ready(), 3)
+        store.create("nodes", _make_node("dn0"))
+        for n in names[: pods // 2]:
+            store.create("pods", _make_pod(n, "dn0"))
+        time.sleep(1.5)  # second wave: distinct checkpoint residues
+        for n in names[pods // 2:]:
+            store.create("pods", _make_pod(n, "dn0"))
+
+        victim_lane = 0
+        victim_pods = [
+            n for n in names if shard_of(("default", n), LANES) == victim_lane
+        ]
+        ckpt_path = os.path.join(ckpt_dir, f"lane{victim_lane}.ckpt.json")
+
+        def ckpt_armed():
+            try:
+                with open(ckpt_path, "rb") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                return False
+            ents = doc.get("kinds", {}).get("pods", {})
+            return len(ents) == len(victim_pods) and all(
+                v[2] is not None for v in ents.values()
+            )
+
+        if not _wait(ckpt_armed, 30.0):
+            raise RuntimeError(
+                "lane checkpoint never covered every armed pod"
+            )
+        time.sleep(CKPT_INTERVAL + 0.2)  # gate against FRESH residues
+        with open(ckpt_path, "rb") as f:
+            doc = json.load(f)
+        residues = {
+            ks.split("/", 1)[1]: v[2]
+            for ks, v in doc["kinds"]["pods"].items()
+        }
+        lanes = _lane_pids(eng.proc.pid)
+        out["lane_pids"] = lanes
+        if len(lanes) < LANES:
+            raise RuntimeError(f"expected {LANES} lane processes: {lanes}")
+        # mid-delay, no warning: the process-lane twin of restart_soak.
+        # _lane_pids sorts by pid = spawn order, so lanes[0] is lane 0.
+        os.kill(lanes[victim_lane], signal.SIGKILL)
+        out["killed_at_wall"] = time.time()
+        out["converged"] = _wait(
+            lambda: all(
+                ph == "Running"
+                for ph in _pod_phases(store, names).values()
+            ),
+            timeout + DELAY_S + 60,
+        )
+        out["final_phases"] = _pod_phases(store, names)
+        out["running_patches_per_pod"] = store.phase_counts("Running", names)
+        m = eng.metrics()
+        out["lane_restarts"] = m.get(
+            f'kwok_lane_proc_restarts_total{{shard="{victim_lane}"}}', 0
+        )
+        # residue-resume oracle over the KILLED lane's pods only (the
+        # surviving lane never stopped — its fires carry no respawn
+        # anchor and would poison the median)
+        fires = store.phase_stamps("Running")
+        devs = {
+            n: fires[n] - residues[n]
+            for n in victim_pods
+            if n in fires and residues.get(n) is not None
+        }
+        anchor = statistics.median(devs.values()) if devs else 0.0
+        out["resume_pods_measured"] = len(devs)
+        out["resume_deviation_s"] = {
+            n: round(d - anchor, 4) for n, d in devs.items()
+        }
+        out["resume_max_abs_dev_s"] = round(
+            max((abs(d - anchor) for d in devs.values()), default=999.0), 4
+        )
+        out["victim_pods"] = len(victim_pods)
+        out["sigterm_exit"] = eng.sigterm(timeout=60)
+    finally:
+        eng.kill_if_alive()
+        srv.stop()
+    out["shm_leftover"] = _shm_leftovers()
+    return out
+
+
+def gates(single, proc, chaos, restart, pods) -> dict:
+    same_keys = set(single["per_key"]) == set(proc["per_key"])
+    return {
+        # ordering oracle: the process fan-out is invisible on the wire
+        "ordering_converged": bool(
+            single["converged"] and proc["converged"]
+            and single["deleted_ok"] and proc["deleted_ok"]
+        ),
+        "phases_identical": (
+            json.dumps(single["final_phases"], sort_keys=True)
+            == json.dumps(proc["final_phases"], sort_keys=True)
+        ),
+        "per_key_order_identical": same_keys and all(
+            single["per_key"][k] == proc["per_key"][k]
+            for k in single["per_key"]
+        ),
+        "ordering_no_double_fire": all(
+            c == 1 for c in proc["running_patches_per_pod"].values()
+        ),
+        # chaos: rotating REAL SIGKILLs, same convergence contract
+        "chaos_converged": bool(chaos["converged"]),
+        "chaos_no_double_fire": all(
+            c == 1 for c in chaos["running_patches_per_pod"].values()
+        ) and len(chaos["running_patches_per_pod"]) == pods,
+        "chaos_respawns_recorded": (
+            chaos["kills_delivered"] >= 2
+            and sum(chaos["lane_restarts"].values()) >= 2
+        ),
+        "chaos_not_degraded": not chaos["readyz_degraded"],
+        # restart: mid-delay SIGKILL of one lane PROCESS
+        "restart_converged": bool(restart["converged"]),
+        "restart_no_double_fire": all(
+            c == 1 for c in restart["running_patches_per_pod"].values()
+        ) and len(restart["running_patches_per_pod"]) == pods,
+        "restart_delays_resumed_within_quantum": (
+            restart["resume_pods_measured"] == restart["victim_pods"]
+            and restart["resume_max_abs_dev_s"] <= QUANTUM
+        ),
+        "restart_respawned": restart["lane_restarts"] >= 1,
+        "graceful_exit_zero": all(
+            a["sigterm_exit"] == 0 for a in (single, proc, chaos, restart)
+        ),
+        # shm hygiene: nothing left mapped after ANY arm (incl. the
+        # SIGKILL-respawn cycles)
+        "no_leaked_shm": not any(
+            a["shm_leftover"] for a in (single, proc, chaos, restart)
+        ),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--pods", type=int, default=24)
+    p.add_argument("--timeout", type=float, default=90.0)
+    p.add_argument("--out", default=os.path.join(REPO, "PROC_r01.json"))
+    p.add_argument("--check", action="store_true",
+                   help="CI gate: smaller workload, exit 1 on any "
+                   "failed gate")
+    args = p.parse_args()
+    if args.check:
+        args.pods = min(args.pods, 16)
+
+    def stages_file(content, tag):
+        f = tempfile.NamedTemporaryFile(
+            "w", suffix=".yaml", prefix=f"kwok-proc-{tag}-", delete=False
+        )
+        f.write(content)
+        f.close()
+        return f.name
+
+    fast = stages_file(STAGES_FAST, "fast")
+    delay = stages_file(STAGES_DELAY, "delay")
+    try:
+        single = _run_ordering_arm(
+            args.pods, fast, args.timeout, procs=False
+        )
+        proc = _run_ordering_arm(args.pods, fast, args.timeout, procs=True)
+        chaos = _run_chaos_arm(args.pods, fast, args.timeout)
+        restart = _run_restart_arm(args.pods, delay, args.timeout)
+    finally:
+        os.unlink(fast)
+        os.unlink(delay)
+    g = gates(single, proc, chaos, restart, args.pods)
+    ok = all(g.values())
+    artifact = {
+        "bench": "proc_soak",
+        "params": {"pods": args.pods, "lanes": LANES,
+                   "tick_quantum_s": QUANTUM, "delay_s": DELAY_S,
+                   "checkpoint_interval_s": CKPT_INTERVAL,
+                   "check": args.check},
+        "gates": g,
+        "ok": ok,
+        "arms": {
+            "ordering_single": {k: single.get(k) for k in
+                                ("ready_s", "converged", "sigterm_exit")},
+            "ordering_proc": {k: proc.get(k) for k in
+                              ("ready_s", "converged", "sigterm_exit")},
+            "chaos": {k: chaos.get(k) for k in (
+                "ready_s", "converged", "kills_delivered", "lane_restarts",
+                "wire_faults_injected", "readyz_degraded",
+                "sigterm_exit")},
+            "restart": {k: restart.get(k) for k in (
+                "ready_s", "converged", "lane_restarts",
+                "resume_max_abs_dev_s", "resume_pods_measured",
+                "victim_pods", "sigterm_exit")},
+        },
+    }
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"ok": ok, "gates": g, "out": args.out}))
+    if not ok:
+        failed = [k for k, v in g.items() if not v]
+        print(f"proc_soak: FAILED gates: {failed}", file=sys.stderr)
+        if not g["per_key_order_identical"]:
+            diffs = {
+                k: (single["per_key"].get(k), proc["per_key"].get(k))
+                for k in single["per_key"]
+                if single["per_key"].get(k) != proc["per_key"].get(k)
+            }
+            print(f"proc_soak: per-key diffs: {diffs}", file=sys.stderr)
+        if not g["restart_delays_resumed_within_quantum"]:
+            print(
+                "proc_soak: resume deviations: "
+                f"{restart.get('resume_deviation_s')}", file=sys.stderr,
+            )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

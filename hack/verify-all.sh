@@ -20,6 +20,9 @@ make chaos-check
 echo ">> restart-check (SIGKILL + cold-restart crash-durability RTO gate)"
 make restart-check
 
+echo ">> proc-check (process-lane ordering + chaos/restart gate, shm-leak proof)"
+make proc-check
+
 echo ">> fleet-check (watcher-fleet survival gate: overload admission + slow-watcher eviction)"
 make fleet-check
 

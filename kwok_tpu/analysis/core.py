@@ -223,6 +223,7 @@ def all_rules(root: str) -> list[Rule]:
     )
     from kwok_tpu.analysis.metrics_doc import MetricsContractRule
     from kwok_tpu.analysis.purity import KernelPurityRule
+    from kwok_tpu.analysis.spawnonly import SpawnOnlyRule
 
     return [
         LockOrderRule(),
@@ -230,5 +231,6 @@ def all_rules(root: str) -> list[Rule]:
         UnusedLockRule(),
         KernelPurityRule(),
         SilentExceptRule(),
+        SpawnOnlyRule(),
         MetricsContractRule(doc_path=os.path.join(root, "docs", "observability.md")),
     ]

@@ -75,6 +75,13 @@ LOCK_ORDER: dict[str, int] = {
     # degradation/registry/_ckpt_lock interactions all happen AFTER
     # release — nothing is ever acquired under it.
     "_ha_lock": 84,
+    # process lanes (ISSUE 15): guards only the lane-handle swap
+    # (proc/conn references) between the supervisor's respawn and
+    # close() in engine/proclanes.py. Spawning, joining, pipe sends, and
+    # the shm ring writes all run OUTSIDE it; the ring itself is
+    # lock-free (SPSC: int64 cursor stores are atomic, descriptors ride
+    # the pipe). Nothing is ever acquired under it.
+    "_proc_lock": 84,
     "_lock": 85,        # single-resource leaves (ippool, registry, ...)
     "_apiserver_lock": 85,
     # mock-apiserver sharded store (ISSUE 13), outermost-first:

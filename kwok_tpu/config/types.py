@@ -48,6 +48,13 @@ class KwokConfigurationOptions:
     # routing) lanes keep paying past 8 cores; this bounds fan-out on
     # very wide hosts without touching explicit drainShards values.
     maxDrainShards: int = 0
+    # Process lanes (engine/proclanes.py): run each ShardLane as a
+    # spawned worker PROCESS over shared-memory arenas instead of a
+    # thread — the GIL escape. Default off: the threaded path is
+    # byte-unchanged and no shm/process exists. Env: KWOK_LANE_PROCS
+    # (the generic apply_env_overrides pass). Requires an HTTP master;
+    # refused with useMesh, haRole, and federation.
+    laneProcs: bool = False
     # Resilience (kwok_tpu/resilience/, docs/resilience.md):
     # deterministic fault-injection spec ("" = off; KWOK_TPU_FAULTS is
     # the engine-level fallback), lane-queue shed threshold (0 = never

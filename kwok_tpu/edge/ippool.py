@@ -52,9 +52,29 @@ class IPPool:
         self._v4 = self.net.version == 4
         self._mask = int(self.net.netmask) if self._v4 else 0
         self._next = 1  # skip the network address, like addIP starting at offset
+        self._lane: tuple[int, int, int] | None = None  # (index, n, span)
+        self._lane_j = 0
         self._free: list[str] = []
         self._used: set[str] = set()
         self._lock = threading.Lock()
+
+    def _next_off(self) -> int:
+        """Next allocation offset (callers hold ``_lock``). Unpartitioned:
+        the classic unbounded sequential walk. Partitioned (process lanes):
+        lane ``index`` owns the ``index``-th span-sized slice of every
+        ``n*span`` super-block — disjoint across lanes for ANY allocation
+        count (a lane that outgrows its in-CIDR slice jumps to its slice
+        of the next super-block instead of walking into a neighbor's),
+        while staying unbounded exactly like the base walk."""
+        lane = self._lane
+        if lane is None:
+            off = self._next
+            self._next += 1
+            return off
+        index, n, span = lane
+        j = self._lane_j
+        self._lane_j = j + 1
+        return 1 + index * span + (j // span) * (n * span) + (j % span)
 
     def contains(self, ip: str) -> bool:
         if self._v4:
@@ -74,9 +94,8 @@ class IPPool:
                     self._used.add(ip)
                     return ip
             while True:
-                v = self._base + self._next
+                v = self._base + self._next_off()
                 ip = _ip4_str(v) if self._v4 else str(ipaddress.ip_address(v))
-                self._next += 1
                 if ip not in self._used:
                     self._used.add(ip)
                     return ip
@@ -95,13 +114,29 @@ class IPPool:
                     used.add(ip)
                     out.append(ip)
             while len(out) < n:
-                v = self._base + self._next
+                v = self._base + self._next_off()
                 ip = _ip4_str(v) if self._v4 else str(ipaddress.ip_address(v))
-                self._next += 1
                 if ip not in used:
                     used.add(ip)
                     out.append(ip)
         return out
+
+    def partition_lanes(self, index: int, n: int) -> None:
+        """Restrict this pool to the ``index``-th of ``n`` disjoint
+        allocation sequences (process lanes, engine/proclanes.py): each
+        lane process allocates from its own slice of every span-sized
+        super-block (see ``_next_off``), so pods never collide on a
+        podIP across lanes — for ANY per-lane allocation count — with
+        no cross-process allocator lock, and a respawned lane re-derives
+        the same sequence deterministically. ``use``/``put`` still
+        accept any in-CIDR IP (re-listed pods may pin IPs allocated
+        before a repartition or by another owner). No-op for n <= 1."""
+        if n <= 1:
+            return
+        span = max(1, (self.net.num_addresses - 1) // n)
+        with self._lock:
+            self._lane = (index, n, span)
+            self._lane_j = 0
 
     def put(self, ip: str) -> None:
         """Recycle an IP (pod Deleted event, pod_controller.go:334-337).

@@ -160,6 +160,17 @@ class EngineConfig:
     drain_shards: int = 1
     # cap on the AUTO lane count; 0 = config.types.DEFAULT_MAX_DRAIN_SHARDS
     max_drain_shards: int = 0
+    # Process lanes (engine/proclanes.py, ISSUE 15): when true (and
+    # drain_shards resolves to >1), each lane is a spawned worker
+    # PROCESS running the full single-lane engine over its shard — the
+    # GIL escape. The parent keeps watch ingest + the router and ships
+    # raw event bytes over per-lane shared-memory rings; children drain,
+    # tick, and emit on true cores, checkpoint to lane<i>.ckpt.json, and
+    # are respawned (budget/ledger semantics) by a process supervisor.
+    # False (the default) keeps the threaded ShardLanes byte-unchanged —
+    # no shm arena, pipe, or process exists. Requires an HTTP --master;
+    # refused with use_mesh, ha_role, and federation.
+    lane_procs: bool = False
     node_rules: list[LifecycleRule] | None = None
     pod_rules: list[LifecycleRule] | None = None
     use_mesh: bool = False
@@ -247,6 +258,16 @@ class EngineConfig:
         ):
             # controller.go:98 "no nodes are managed"
             raise ValueError("no nodes are managed")
+        if self.lane_procs and self.use_mesh:
+            raise ValueError(
+                "lane_procs is host-CPU sharding; use_mesh owns device "
+                "placement — configure one or the other"
+            )
+        if self.lane_procs and self.ha_role:
+            raise ValueError(
+                "lane_procs + ha_role is not supported (the lease fence "
+                "cannot span lane processes yet)"
+            )
 
 
 def _rv_of(meta: dict) -> int:
@@ -645,6 +666,10 @@ class ClusterEngine:
         # so concurrent emit workers never serialize on one global lock.
         self._pump = None
         self._pump_tried = False
+        # optional outermost pump wrapper (applied after faults/HA):
+        # process-lane children park emit frames in their shared-memory
+        # crash-replay slot here. None = zero cost.
+        self._pump_wrap = None
         self._pump_groups = max(1, int(os.environ.get(
             "KWOK_TPU_PUMP_GROUPS", "4"
         )))
@@ -732,11 +757,20 @@ class ClusterEngine:
         # dispatching until a post-refine wire's consume recomputes the
         # wake from the refined state (device-owning thread only)
         self._ckpt_force_ticks = 0
-        # Hash-partitioned host lanes (engine/lanes.py): built when
-        # drain_shards resolves to >1. Lane children are constructed with
-        # drain_shards=1, so this cannot recurse.
+        # Hash-partitioned host lanes: threaded ShardLanes
+        # (engine/lanes.py) by default; worker PROCESSES over shared-
+        # memory arenas (engine/proclanes.py) behind lane_procs — the
+        # GIL escape, default off so the threaded path stays
+        # byte-unchanged. Lane children are constructed with
+        # drain_shards=1 / lane_procs=False, so neither can recurse.
         self._lanes = None
-        if self._n_lanes > 1:
+        self._proc = None
+        if self._n_lanes > 1 and config.lane_procs:
+            # mesh/HA combinations are refused in EngineConfig.validate
+            from kwok_tpu.engine.proclanes import ProcLaneSet
+
+            self._proc = ProcLaneSet(self, self._n_lanes)
+        elif self._n_lanes > 1:
             from kwok_tpu.engine.lanes import LaneSet
 
             self._lanes = LaneSet(self, self._n_lanes)
@@ -1092,7 +1126,11 @@ class ClusterEngine:
                 return
             done = self._startup_lanes.setdefault(kind, set())
             done.add(lane)
-            need = self._n_lanes if self._lanes is not None else 1
+            need = (
+                self._n_lanes
+                if (self._lanes is not None or self._proc is not None)
+                else 1
+            )
             if len(done) >= need:
                 sp.discard(kind)
 
@@ -1276,11 +1314,15 @@ class ClusterEngine:
 
     # ------------------------------------------------------------- lifecycle
 
-    def start(self, run_tick_loop: bool = True) -> None:
+    def start(
+        self, run_tick_loop: bool = True, spawn_watches: bool = True
+    ) -> None:
         """Start watch ingest + the patch executor, and (by default) the tick
         thread. A FederatedEngine passes run_tick_loop=False: it owns a single
         stacked device state for all member clusters and drives their ingest
-        queues + emit paths from one shared tick loop."""
+        queues + emit paths from one shared tick loop. A process-lane child
+        passes spawn_watches=False: its events arrive routed from the parent
+        over the shared-memory handoff, never from its own watch streams."""
         self._running = True
         self._owns_tick = run_tick_loop
         # supervision + chaos arm before any worker exists (a
@@ -1309,7 +1351,9 @@ class ClusterEngine:
         self._startup_lanes = {}
         self._startup_flush_wait = False
         self._startup_t0 = time.monotonic()
-        if self._ckpt_dir:
+        if self._ckpt_dir and self._proc is None:
+            # process lanes: the parent holds no rows — the children
+            # checkpoint their shards to lane<i>.ckpt.json themselves
             from kwok_tpu.resilience import checkpoint as ckpt_mod
 
             self._ckpt = ckpt_mod.Checkpointer(
@@ -1342,7 +1386,11 @@ class ClusterEngine:
             max_workers=self.config.parallelism, thread_name_prefix="kwok-patch"
         )
         if run_tick_loop:
-            if self._lanes is not None:
+            if self._proc is not None:
+                # process lanes: no device state at the parent — the
+                # children own their shards' rows, kernels, and pumps
+                self._proc.prepare(self._executor)
+            elif self._lanes is not None:
                 # sharded pipeline: stacked device state + lane workers;
                 # the tick thread below runs the lane coordinator loop
                 self._lanes.prepare(self._executor)
@@ -1354,21 +1402,27 @@ class ClusterEngine:
                 self._warm_scatters()
                 self._warm_tick()
 
-        node_label_sel = self.config.manage_nodes_with_label_selector or None
-        # Each watch thread registers its watch FIRST, then lists and emits a
-        # resync marker — so events in the register/list gap are covered, and
-        # every re-watch after an error resyncs (the reference's watch-then-
-        # list ordering, node_controller.go:121-143, made gap-proof).
-        self._spawn_watch("nodes", label_selector=node_label_sel)
-        self._spawn_watch("pods", field_selector="spec.nodeName!=")
+        if spawn_watches:
+            node_label_sel = (
+                self.config.manage_nodes_with_label_selector or None
+            )
+            # Each watch thread registers its watch FIRST, then lists and
+            # emits a resync marker — so events in the register/list gap
+            # are covered, and every re-watch after an error resyncs (the
+            # reference's watch-then-list ordering,
+            # node_controller.go:121-143, made gap-proof).
+            self._spawn_watch("nodes", label_selector=node_label_sel)
+            self._spawn_watch("pods", field_selector="spec.nodeName!=")
 
         if run_tick_loop:
-            if self._lanes is not None:
+            if self._proc is not None:
+                self._proc.start_workers(self._threads)
+                loop = self._proc.coordinator_loop
+            elif self._lanes is not None:
                 self._lanes.start_workers(self._threads)
-            loop = (
-                self._lanes.tick_loop if self._lanes is not None
-                else self._tick_loop
-            )
+                loop = self._lanes.tick_loop
+            else:
+                loop = self._tick_loop
             self._threads.append(spawn_worker(loop, name="kwok-tick"))
         if run_tick_loop and self._ha is not None:
             # the elector (resilience/ha.py): renew-or-acquire loop,
@@ -1381,6 +1435,15 @@ class ClusterEngine:
                 if wd is not None
                 else spawn_worker(self._ha.run, name="kwok-ha")
             )
+        if run_tick_loop and self._audit_interval > 0 and (
+            self._proc is not None
+        ):
+            logger.warning(
+                "anti-entropy auditor disabled under process lanes: the "
+                "parent holds no rows to diff (audit the lanes' shards "
+                "by running the auditor per child in a future round)"
+            )
+            self._audit_interval = 0.0
         if run_tick_loop and self._audit_interval > 0:
             # anti-entropy auditor (resilience/antientropy.py): paced
             # apiserver-vs-rows drift detection + per-row repair, off by
@@ -1551,6 +1614,10 @@ class ClusterEngine:
             self._pump = None
         if self._lanes is not None:
             self._lanes.close()  # lane pump groups (client is shared, ours)
+        if self._proc is not None:
+            # STOP + join + kill-escalate the lane processes, then unlink
+            # every shared-memory arena (clean /dev/shm is gated)
+            self._proc.close()
         close = getattr(self.client, "close", None)
         if callable(close):  # release pooled keep-alive connections
             close()
@@ -1968,7 +2035,7 @@ class ClusterEngine:
         # federation member — the columnar survivor path), 0 for any
         # other route callable (per-record loop, unchanged).
         part_shards = 0
-        lanes = self._lanes
+        lanes = self._lanes if self._lanes is not None else self._proc
         if self._native_route:
             if route is None:
                 part_shards = 1
@@ -3672,6 +3739,10 @@ class ClusterEngine:
                 # fence OUTSIDE the fault plane: a write the fence drops
                 # must never reach the chaos layer, let alone the wire
                 pumps = [self._ha.wrap_pump(p) for p in pumps]
+            if self._pump_wrap is not None:
+                # outermost: the process-lane emit crash-replay slot
+                # must see exactly the frames that go on the wire
+                pumps = [self._pump_wrap(p) for p in pumps]
             self._pump = _PumpGroup(pumps)
             self._pump_base = base
             self._pump_base_b = base.encode()

@@ -1,0 +1,1100 @@
+"""Process lanes: the GIL escape (ISSUE 15, ROADMAP item 2).
+
+The threaded ShardLanes (engine/lanes.py) overlap only where stages
+release the GIL — LANES_r07 measured the threaded multi-lane win at
+~2.2x and called it the floor, with ``engine_lane_drain_emit`` the
+largest remaining host term. This module makes each lane a worker
+**process** on a true core:
+
+  parent: watch ingest ──> router thread (one native batch parse,
+          pre-partitioned lane runs) ──> per-lane shared-memory RawRing
+          (raw bytes written once, never re-serialized) + descriptor pipe
+  child i: full single-lane ClusterEngine over shard i — drain, device
+          tick, emit, its own pump connection group — plus a node
+          "topology tap" for the shards it does not own
+
+Each child is *exactly* the single-lane engine (the per-key ordering
+oracle's reference arm), so per-key patch order and patch bytes are the
+single-lane engine's by construction; only the plumbing around it is
+new. Cross-lane coupling is gone instead of shared: node events
+broadcast to every lane — the owning lane ingests (rows, heartbeats,
+emit), the others run the tap (``node_has`` membership + managed-ness
+re-evaluation for their own pods), so ``SEL_ON_MANAGED_NODE`` bits stay
+correct with no cross-process topology store; the pod-IP CIDR is
+partitioned per lane (disjoint sub-ranges, no cross-process allocator
+lock).
+
+The robustness tier maps one-to-one (the ISSUE's bet):
+
+- watchdog in-thread restarts become supervised process respawns with
+  the same budget/ledger/degradation semantics (``Watchdog.charge``
+  shares the budget window; exhaustion degrades /readyz exactly like a
+  thread crash-loop);
+- per-lane checkpoints reuse the ``member<i>.ckpt.json`` pattern:
+  children checkpoint to ``lane<i>.ckpt.json`` and a respawn reconciles
+  via the PR 7 RestoreSession against the respawn-triggered full
+  re-list;
+- the fault plane stays one-plane-per-engine on the PARENT (watch
+  cuts/410 storms/blackouts/garbling inject where the bytes enter), and
+  ``worker.kill=kwok-lane*`` now delivers REAL SIGKILLs to lane
+  processes (FaultPlane.register_proc_target);
+- the ``_emit_inflight`` crash-replay slot survives as a shared-memory
+  slot (engine/shm.InflightSlot): the child parks rendered emit frames
+  before the pump send; the parent replays them before the respawn, so
+  an emit slice is never lost to a dying process.
+
+Spawn-only, always: the parent engine is thread-rich by the time lanes
+start, and a fork would duplicate locked mutexes into the child
+(fork-after-threads deadlock — kwoklint's spawn-only rule pins the
+whole tree). Default off: ``--lane-procs`` / ``laneProcs`` /
+``KWOK_LANE_PROCS``; with it off the threaded path is byte-unchanged
+and no shm arena, pipe, or process exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import logging
+import os
+import pickle
+import queue
+import threading
+import time
+
+from kwok_tpu.engine import shm as shm_mod
+from kwok_tpu.engine.rowpool import shard_of
+from kwok_tpu.telemetry.errors import swallowed, worker_crashed, worker_restarted
+from kwok_tpu.workers import spawn_worker
+
+logger = logging.getLogger("kwok_tpu.proclanes")
+
+_KINDS = ("nodes", "pods")
+
+#: per-lane raw-handoff ring size (bytes); one parse window must fit
+_RING_BYTES = int(os.environ.get("KWOK_TPU_SHM_RING_BYTES", str(4 << 20)))
+#: per-lane emit crash-replay slot size (bytes)
+_SLOT_BYTES = int(os.environ.get("KWOK_TPU_SHM_SLOT_BYTES", str(1 << 20)))
+#: seconds the router waits on a full ring before dropping the window
+#: for that lane (a dead/stalled child; the respawn resync re-delivers)
+_RING_STALL_S = 5.0
+#: supervisor poll cadence
+_SUPER_POLL_S = 0.2
+#: a live lane process whose status beat is older than this is wedged
+#: (the beat rides a dedicated 50ms thread, so only a hard GIL seizure
+#: or a stopped process stalls it this long) and is killed for respawn
+_STALL_NS = int(float(
+    os.environ.get("KWOK_TPU_LANE_STALL_S", "60")
+) * 1e9)
+
+
+# --------------------------------------------------------------- child side
+
+
+class _SlotGuardPump:
+    """Wraps one pump connection group member in the child: every batch
+    is parked in the lane's shared-memory InflightSlot before it goes on
+    the wire and cleared once every frame has a real HTTP status. NOT a
+    plain native pump, so the fused template emit falls back to
+    render-then-send through this wrapper — a fused call can never
+    tunnel past the slot (the same containment contract as FaultyPump /
+    FencedPump)."""
+
+    def __init__(self, slot: shm_mod.InflightSlot, inner):
+        self._slot = slot
+        self._inner = inner
+
+    def send(self, requests):
+        try:
+            self._slot.arm(pickle.dumps(requests, protocol=4))
+        except Exception:
+            # the slot is belt-and-braces over checkpoint replay: losing
+            # it must never block the send
+            swallowed("proclanes.slot_arm")
+        status = self._inner.send(requests)
+        try:
+            if (status != 0).all():
+                self._slot.clear()
+            # any 0 statuses: the engine's whole-frame resend re-enters
+            # send() with the failed subset, re-arming the slot with
+            # exactly the frames still owed
+        except Exception:
+            swallowed("proclanes.slot_clear")
+        return status
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def make_proc_lane_engine_class():
+    """The child's engine class, built lazily so importing this module
+    never pays the engine import chain (the parent imports proclanes
+    inside ClusterEngine.__init__; the spawn pickle carries only a
+    module path)."""
+    from kwok_tpu.engine.engine import ClusterEngine
+
+    class _ProcLaneEngine(ClusterEngine):
+        """The single-lane engine plus the node topology tap: node
+        events for shards this lane does not own update ``node_has``
+        membership (and re-evaluate this lane's pods on that node)
+        WITHOUT acquiring rows — the owning lane does the row work and
+        the heartbeats, so no node is double-managed.
+
+        Stream healing inverts across the process boundary: the child
+        has no watch streams, so integrity doubt (corrupt routed bytes)
+        and re-list rv rewinds (store restore) are published as counters
+        in the lane's StatusBank row; the parent's coordinator turns the
+        deltas into the real (rate-bounded) stream cuts + re-lists."""
+
+        _lane_index = 0
+        _lane_n = 1
+        _proc_integ: dict | None = None
+
+        def _integrity_resync(self, kind: str) -> None:
+            d = self._proc_integ
+            if d is not None:
+                d[kind] = d.get(kind, 0) + 1
+                return
+            super()._integrity_resync(kind)
+
+        def _node_owned(self, name: str) -> bool:
+            return shard_of(name, self._lane_n) == self._lane_index
+
+        def _node_upsert(self, node: dict) -> None:
+            name = (node.get("metadata") or {}).get("name")
+            if name and not self._node_owned(name):
+                # membership is sticky until Deleted, like the engine's
+                # nodesSets (no removal on Modified,
+                # node_controller.go:256-268) — so only a NEW managed
+                # node changes the tap
+                if (
+                    name not in self.node_has
+                    and self._node_need_heartbeat(node)
+                ):
+                    self.node_has.add(name)
+                    self._update_pods_on_node(name)
+                return
+            super()._node_upsert(node)
+
+        def _node_deleted(self, node: dict) -> None:
+            name = (node.get("metadata") or {}).get("name")
+            if name and not self._node_owned(name):
+                if name in self.node_has:
+                    self.node_has.discard(name)
+                    self._update_pods_on_node(name)
+                return
+            super()._node_deleted(node)
+
+        def _resync(self, kind: str, objs: list) -> None:
+            d = self._proc_integ
+            if d is not None:
+                # store-restore detection moved lane-side: the parent
+                # has no rows, so the watch loop's per-object rewind
+                # scan is vacuous there — this lane compares its own
+                # tracked revisions against the routed snapshot instead
+                for o in objs:
+                    meta = o.get("metadata") or {}
+                    try:
+                        rv = int(meta.get("resourceVersion") or 0)
+                    except (TypeError, ValueError):
+                        rv = 0
+                    if not rv:
+                        continue
+                    tracked = self._tracked_rv(kind, o)
+                    if tracked and rv < tracked:
+                        d["rewind"] = d.get("rewind", 0) + 1
+                        break
+            if kind == "nodes":
+                # tap hygiene: tracked-but-unowned nodes that vanished
+                # while a stream was down never get a DELETED broadcast —
+                # prune them from the managed set here (the owning lane's
+                # rows are pruned by the super() walk)
+                seen = {
+                    (o.get("metadata") or {}).get("name") for o in objs
+                }
+                for name in [
+                    nm for nm in self.node_has
+                    if nm not in seen and not self._node_owned(nm)
+                ]:
+                    self.node_has.discard(name)
+                    self._update_pods_on_node(name)
+            super()._resync(kind, objs)
+
+    return _ProcLaneEngine
+
+
+def _make_lane_engine(spec: dict):
+    """Build the child's single-lane engine."""
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+
+    index = spec["index"]
+    n = spec["n"]
+    cls = make_proc_lane_engine_class()
+
+    kubeconfig = spec.get("kubeconfig") or ""
+    if kubeconfig:
+        client = HttpKubeClient.from_kubeconfig(kubeconfig, spec["master"])
+    else:
+        client = HttpKubeClient(spec["master"])
+    cfg = dataclasses.replace(
+        spec["config"],
+        lane_procs=False,
+        drain_shards=1,      # the child IS one lane
+        use_mesh=False,
+        initial_capacity=spec["capacity"],
+        profile_dir="",
+        trace_dump="",
+        faults="off",        # ONE plane, the parent's (ingest + SIGKILL)
+        audit_interval=-1.0,  # ONE auditor surface, refused under procs
+        ha_role="",
+        shed_queue_depth=0,  # shedding is a router concern (parent-side)
+    )
+    e = cls(client, cfg)
+    e._lane_index = index
+    e._lane_n = n
+    e._proc_integ = {"nodes": 0, "pods": 0, "rewind": 0}
+    e._ckpt_name = f"lane{index}"
+    # partition the pod-IP CIDR: disjoint per-lane sub-ranges, so the
+    # allocator needs no cross-process lock and respawns re-derive the
+    # same range (pinned IPs from re-lists still ride IPPool.use)
+    e.ippool.partition_lanes(index, n)
+    return e
+
+
+def lane_proc_main(spec: dict, conn) -> None:
+    """Child entry point (spawn target; must stay module-level so the
+    spawn pickle is a path, not state). Runs the lane's whole single-lane
+    engine; the main thread consumes the parent's descriptor pipe."""
+    plat = os.environ.get("KWOK_TPU_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    ring = shm_mod.RawRing(spec["ring"])
+    slot = shm_mod.InflightSlot(spec["slot"])
+    bank = shm_mod.StatusBank(spec["bank"])
+    row = bank.row(spec["index"])
+    row[shm_mod.BANK_PID] = os.getpid()
+    row[shm_mod.BANK_ALIVE_NS] = time.monotonic_ns()
+    e = _make_lane_engine(spec)
+    e._pump_wrap = lambda p: _SlotGuardPump(slot, p)
+    e.start(spawn_watches=False)
+    applied = 0
+    stop_status = threading.Event()
+
+    def status_loop() -> None:
+        while not stop_status.wait(0.05):
+            row[shm_mod.BANK_ALIVE_NS] = time.monotonic_ns()
+            row[shm_mod.BANK_READY] = int(e.ready)
+            sp = e._startup_pending
+            row[shm_mod.BANK_RESYNC] = (
+                3 if sp is None
+                else (0 if "nodes" in sp else 1) | (0 if "pods" in sp else 2)
+            )
+            row[shm_mod.BANK_NODES] = len(e.nodes.pool)
+            row[shm_mod.BANK_PODS] = len(e.pods.pool)
+            row[shm_mod.BANK_QDEPTH] = e._q.qsize()
+            row[shm_mod.BANK_EVENTS] = applied
+            integ = e._proc_integ
+            row[shm_mod.BANK_INTEG_NODES] = integ["nodes"]
+            row[shm_mod.BANK_INTEG_PODS] = integ["pods"]
+            row[shm_mod.BANK_REWIND] = integ["rewind"]
+
+    spawn_worker(status_loop, name="kwok-lane-status")
+    rc = 0
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                # parent died: stop cleanly (final checkpoint included)
+                logger.warning("lane %d: parent pipe closed", spec["index"])
+                break
+            t = time.monotonic()
+            op = msg[0]
+            if op == "STOP":
+                break
+            if op == "RAWB":
+                _op, kind, off, ln, bounds = msg
+                blob = ring.read(off, ln)
+                e._q.put((kind, "RAWB", (blob, bounds), t))
+                applied += len(bounds) - 1
+            elif op == "EV":
+                _op, kind, type_, obj = msg
+                e._q.put((kind, type_, obj, t))
+                applied += 1
+            elif op == "RESYNC":
+                _op, kind, objs = msg
+                e._q.put((kind, "RESYNC", objs, t))
+            else:
+                logger.warning("lane %d: unknown descriptor %r",
+                               spec["index"], op)
+    except BaseException:
+        logger.exception("lane %d: reader failed", spec["index"])
+        rc = 1
+    finally:
+        stop_status.set()
+        try:
+            e.stop()
+        except Exception:
+            logger.exception("lane %d: stop failed", spec["index"])
+            rc = rc or 1
+        try:
+            conn.close()
+        except Exception:
+            swallowed("proclanes.child_conn_close")
+        ring.close()
+        slot.close()
+        bank.close()
+    os._exit(rc)  # skip atexit: jax/absl handlers hang a daemonized child
+
+
+# -------------------------------------------------------------- parent side
+
+
+class ProcLane:
+    """Parent-side handle for one lane process: its shm ring + inflight
+    slot, descriptor pipe, and the live Process object."""
+
+    def __init__(self, index: int, ring: shm_mod.RawRing,
+                 slot: shm_mod.InflightSlot):
+        self.index = index
+        self.ring = ring
+        self.slot = slot
+        self.proc = None
+        self.conn = None
+        self.dead = False      # budget exhausted: no more respawns
+        self.shedding = False  # router shedding past --shed-queue-depth
+        self.restarts = 0
+
+    @property
+    def name(self) -> str:
+        return f"kwok-lane{self.index}"
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.is_alive()
+
+    def sigkill(self) -> bool:
+        """The fault plane's worker.kill arm: a REAL SIGKILL."""
+        p = self.proc
+        if p is None or not p.is_alive() or p.pid is None:
+            return False
+        try:
+            os.kill(p.pid, 9)
+            return True
+        except OSError:
+            return False
+
+
+class ProcLaneSet:
+    """The parent coordinator for process lanes: router, supervisor,
+    status scraping, and lifecycle. Duck-types the LaneSet surface the
+    ingest path needs (``n``, ``route``, ``route_batch``)."""
+
+    def __init__(self, parent, n: int):
+        self.parent = parent
+        self.n = int(n)
+        master = getattr(parent.client, "server", "")
+        if not (isinstance(master, str) and master.startswith("http")):
+            raise ValueError(
+                "process lanes need an HTTP --master (lane processes "
+                "open their own client/pump connections); got "
+                f"{type(parent.client).__name__}"
+            )
+        self._master = master
+        # per-lane row budget: the LaneSet split (even share + 25% crc32
+        # slack), floored like _MIN_LANE_ROWS
+        self.capacity = max(
+            1024,
+            -(-int(parent.config.initial_capacity) * 5 // (4 * self.n)),
+        )
+        self._ctx = None      # spawn context, built in prepare()
+        self.lanes: list[ProcLane] = []
+        self.bank: shm_mod.StatusBank | None = None
+        # router-side per-(lane, kind) raw-line buffers (router thread
+        # only — no lock)
+        self._buf: dict[tuple[int, str], list] = {}
+        self.events_routed = 0
+        # graceful degradation stays a ROUTER concern in both lane
+        # topologies (children are forced to shed_queue_depth=0): the
+        # child's ingest-queue depth rides its StatusBank row and the
+        # parent sheds routed events past the threshold exactly like
+        # LaneSet._shed — counted, degraded, cleared + resynced by the
+        # coordinator once the backlog halves
+        self._shed_depth = int(parent.config.shed_queue_depth)
+        self._closing = False
+        self._respawning = False
+        # guards lane handle swaps (supervisor respawn vs close); leaf
+        # lock, never held across blocking work (spawn/join/IO happen
+        # outside it) — kwoklint table: _proc_lock @ 84
+        self._proc_lock = threading.Lock()
+        r = parent.telemetry.registry
+        self._m_restarts = r.counter(
+            "kwok_lane_proc_restarts_total",
+            "Lane worker-process respawns by the supervisor (SIGKILL, "
+            "crash, or chaos worker.kill), by shard.",
+            ("shard",),
+        )
+        self._m_handoff = r.histogram(
+            "kwok_lane_handoff_seconds",
+            "Router-side wall seconds per cross-process handoff: shared-"
+            "memory ring write + descriptor send for one lane's slice of "
+            "a parse window.",
+        ).child
+        self._m_arena = r.gauge(
+            "kwok_shm_arena_bytes",
+            "Bytes of shared memory mapped per arena pool (ring = raw "
+            "event handoff, slot = emit crash-replay, status = lane "
+            "status bank). 0 when process lanes are off.",
+            ("pool",),
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def prepare(self, executor) -> None:
+        """Create the shm arenas and spawn every lane process (spawn
+        context only — the parent is already thread-rich, and a fork
+        would clone held locks into the child)."""
+        import multiprocessing as mp
+
+        self._ctx = mp.get_context("spawn")
+        tag = f"{os.getpid()}"
+        self.bank = shm_mod.StatusBank(
+            shm_mod.arena_name(f"bank-{tag}"), lanes=self.n, create=True
+        )
+        for i in range(self.n):
+            ring = shm_mod.RawRing(
+                shm_mod.arena_name(f"ring{i}-{tag}"), _RING_BYTES,
+                create=True,
+            )
+            slot = shm_mod.InflightSlot(
+                shm_mod.arena_name(f"slot{i}-{tag}"), _SLOT_BYTES,
+                create=True,
+            )
+            self.lanes.append(ProcLane(i, ring, slot))
+        self._m_arena.labels(pool="ring").set(_RING_BYTES * self.n)
+        self._m_arena.labels(pool="slot").set(_SLOT_BYTES * self.n)
+        self._m_arena.labels(pool="status").set(
+            self.n * shm_mod.BANK_FIELDS * 8
+        )
+        for lane in self.lanes:
+            self._spawn_lane(lane)
+        faults = self.parent._faults
+        if faults is not None:
+            for lane in self.lanes:
+                faults.register_proc_target(lane.name, lane.sigkill)
+
+    def _lane_spec(self, lane: ProcLane) -> dict:
+        return {
+            "index": lane.index,
+            "n": self.n,
+            "master": self._master,
+            "kubeconfig": getattr(
+                self.parent.client, "kubeconfig_path", ""
+            ),
+            "config": self.parent.config,
+            "capacity": self.capacity,
+            "ring": lane.ring.name,
+            "slot": lane.slot.name,
+            "bank": self.bank.name,
+        }
+
+    def _spawn_lane(self, lane: ProcLane) -> None:
+        parent_conn, child_conn = self._ctx.Pipe(duplex=False)
+        # parent KEEPS the write end; the child gets the read end
+        proc = self._ctx.Process(
+            target=lane_proc_main,
+            args=(self._lane_spec(lane), parent_conn),
+            name=lane.name,
+            daemon=True,
+        )
+        proc.start()
+        parent_conn.close()  # the child owns the read end now
+        with self._proc_lock:
+            lane.proc = proc
+            lane.conn = child_conn
+
+    def start_workers(self, threads: list) -> None:
+        wd = self.parent._watchdog
+
+        def spawn(target, name):
+            if wd is not None:
+                return wd.spawn(target, name=name)
+            return spawn_worker(target, name=name)
+
+        threads.append(spawn(self.route_loop, "kwok-route"))
+        # "kwok-proc-super", NOT "kwok-lane-…": the supervisor is the
+        # recovery mechanism itself — its name must never match the
+        # chaos plane's supervised-prefix kill filter (worker.kill=
+        # kwok-lane* would otherwise kill supervision with rotation
+        # slot 0 and leave every later lane SIGKILL unrecovered). It IS
+        # watchdog-supervised: an exception escaping a respawn (e.g.
+        # proc.start() OSError under fd pressure) must restart the loop,
+        # not silently end all lane recovery.
+        threads.append(spawn(self.supervise_loop, "kwok-proc-super"))
+
+    def close(self) -> None:
+        """Graceful stop: STOP every child (they drain + write a final
+        checkpoint), join, escalate to kill, unlink every arena."""
+        with self._proc_lock:
+            self._closing = True
+        # a respawn racing shutdown (chaos SIGKILL just before stop())
+        # must finish its handle swap before the arenas are unlinked —
+        # a child spawned after the unlink would crash on attach and
+        # never receive the STOP below. _respawn checks _closing and
+        # flips _respawning under the same lock, so after this wait no
+        # new spawn can start.
+        deadline = time.monotonic() + 20.0
+        while self._respawning and time.monotonic() < deadline:
+            time.sleep(0.05)
+        faults = self.parent._faults
+        if faults is not None:
+            for lane in self.lanes:
+                faults.unregister_proc_target(lane.name)
+        for lane in self.lanes:
+            conn = lane.conn
+            if conn is not None:
+                try:
+                    conn.send(("STOP",))
+                except (OSError, ValueError, BrokenPipeError):
+                    swallowed("proclanes.stop_send")
+        deadline = time.monotonic() + 30.0
+        for lane in self.lanes:
+            p = lane.proc
+            if p is None:
+                continue
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
+            if p.is_alive():
+                logger.warning("lane %d did not stop; killing", lane.index)
+                p.kill()
+                p.join(timeout=5)
+        for lane in self.lanes:
+            if lane.conn is not None:
+                try:
+                    lane.conn.close()
+                except OSError:
+                    swallowed("proclanes.conn_close")
+                lane.conn = None
+            lane.ring.close(unlink=True)
+            lane.slot.close(unlink=True)
+        if self.bank is not None:
+            self.bank.close(unlink=True)
+            self.bank = None
+        for pool in ("ring", "slot", "status"):
+            self._m_arena.labels(pool=pool).set(0)
+
+    # --------------------------------------------------------------- router
+
+    def route_loop(self) -> None:
+        """The LaneSet router loop shape — drain the parent queue, batch-
+        parse per half-tick window — with the handoff rewritten for the
+        process boundary: per-lane raw slices into the shm ring, window
+        flushes as one descriptor per (lane, kind)."""
+        parent = self.parent
+        q = parent._q
+        tel = parent.telemetry
+        window = max(0.002, parent.config.tick_interval / 2)
+        raw_buf: dict = {}
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=0.1)
+                except queue.Empty:
+                    if not parent._running:
+                        return
+                    continue
+                if item is None:
+                    if not parent._running:
+                        return
+                    continue
+                lag = time.monotonic() - item[3]
+                parent._drain_apply(item, raw_buf, self.route, self.n)
+                window_end = time.monotonic() + window
+                while True:
+                    timeout = window_end - time.monotonic()
+                    if timeout <= 0:
+                        break
+                    try:
+                        item = q.get(timeout=timeout)
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        if not parent._running:
+                            break
+                        continue
+                    lag = max(lag, time.monotonic() - item[3])
+                    parent._drain_apply(item, raw_buf, self.route, self.n)
+                if raw_buf:
+                    parent._drain_flush(raw_buf, self.route, self.n)
+                self.flush_lanes()
+                tel.observe_watch_lag(lag)
+                tel.set_gauge("ingest_queue_depth", q.qsize())
+                if not parent._running:
+                    return
+        finally:
+            try:
+                if raw_buf:
+                    parent._drain_flush(raw_buf, self.route, self.n)
+                self.flush_lanes()
+            except Exception:
+                logger.exception("final router flush failed")
+
+    def route(self, kind: str, type_: str, obj) -> None:
+        """Per-event route (the non-partitioned fallback path). Raw
+        record bytes buffer per (lane, kind) and flush as one ring blob
+        per window; dict events ship pickled over the pipe (rare: re-list
+        snapshots and the plain-iterator client path)."""
+        if type_ == "RESYNC":
+            for lane in self.lanes:
+                objs = obj if kind == "nodes" else [
+                    o for o in obj
+                    if shard_of(self._pod_key(o), self.n) == lane.index
+                ]
+                self._flush_buf(lane, kind)
+                self._send(lane, ("RESYNC", kind, objs))
+            self.events_routed += 1
+            return
+        if type_ == "REC":
+            raw = obj.raw
+            if kind == "nodes":
+                for lane in self.lanes:
+                    self._buf.setdefault((lane.index, kind), []).append(raw)
+            else:
+                key = self._rec_key(obj)
+                if key is None:
+                    return
+                li = shard_of(key, self.n)
+                self._buf.setdefault((li, kind), []).append(raw)
+            self.events_routed += 1
+            return
+        if not isinstance(obj, dict):
+            return
+        if kind == "nodes":
+            targets = self.lanes
+        else:
+            key = self._pod_key(obj)
+            if not key[1]:
+                return
+            targets = [self.lanes[shard_of(key, self.n)]]
+        for lane in targets:
+            if self._shed_check(lane, 1):
+                continue
+            self._flush_buf(lane, kind)
+            self._send(lane, ("EV", kind, type_, obj))
+        self.events_routed += 1
+
+    def route_batch(self, kind: str, batch) -> None:
+        """Pre-partitioned handoff: the native parse already computed
+        per-lane index runs; gather each lane's raw lines into ONE ring
+        blob + descriptor. Node batches broadcast every routable record
+        to every lane (the tap needs the full node stream)."""
+        t0 = time.perf_counter()
+        lines = batch.lines
+        if kind == "nodes":
+            ids = batch.lane_idx[: batch.route_info.routable].tolist()
+            parts = [lines[i] for i in ids]
+            for lane in self.lanes:
+                self._flush_buf(lane, kind)
+                self._ship(lane, kind, parts)
+            self.events_routed += len(parts)
+        else:
+            lane_off = batch.lane_off
+            lane_idx = batch.lane_idx
+            routed = 0
+            for li in range(len(lane_off) - 1):
+                lo, hi = lane_off[li], lane_off[li + 1]
+                if hi <= lo:
+                    continue
+                lane = self.lanes[li]
+                parts = [lines[i] for i in lane_idx[lo:hi].tolist()]
+                self._flush_buf(lane, kind)
+                self._ship(lane, kind, parts)
+                routed += len(parts)
+            self.events_routed += routed
+        self.parent.telemetry.observe_route_batch(
+            time.perf_counter() - t0
+        )
+
+    def flush_lanes(self) -> None:
+        """Window end: ship every buffered (lane, kind) raw slice."""
+        if not self._buf:
+            return
+        for (li, kind) in list(self._buf):
+            self._flush_buf(self.lanes[li], kind)
+
+    def _flush_buf(self, lane: ProcLane, kind: str) -> None:
+        parts = self._buf.pop((lane.index, kind), None)
+        if parts:
+            self._ship(lane, kind, parts)
+
+    def _ship(self, lane: ProcLane, kind: str, parts: list) -> None:
+        """One (lane, kind) slice onto the lane's ring + pipe. Bytes are
+        copied into shared memory exactly once; the descriptor carries
+        only offsets. A slice bigger than the ring splits into chunks
+        along record bounds (a reconnect flood's window is bounded in
+        LINES, not bytes — one oversized window must never crash the
+        router). A full ring paces briefly, then — if the child is dead
+        or wedged past the stall bound — drops the slice (counted; the
+        supervisor's respawn resync re-delivers)."""
+        if self._shed_check(lane, len(parts)):
+            return
+        # chunk bound: HALF the ring, not the ring — try_write pads a
+        # wrapping blob to the boundary, so a blob needs pad+n <= free
+        # and one wider than cap/2 can be UNWRITABLE forever from an
+        # unlucky cursor position even with the ring fully drained
+        limit = lane.ring.cap // 2
+        total = 0
+        for p in parts:
+            total += len(p)
+        if total > limit:
+            keep = []
+            for p in parts:
+                if len(p) > limit:
+                    # a record larger than the guaranteed-writable bound:
+                    # undeliverable over this ring
+                    self.parent.telemetry.inc("dropped_jobs_total", 1)
+                    logger.warning(
+                        "lane %d: %s record of %dB exceeds the %dB ring "
+                        "bound; dropped (resync re-delivers current "
+                        "state)", lane.index, kind, len(p), limit,
+                    )
+                    self.parent._integrity_resync(kind)
+                else:
+                    keep.append(p)
+            chunk: list = []
+            size = 0
+            for p in keep:
+                if size + len(p) > limit:
+                    self._ship(lane, kind, chunk)
+                    chunk, size = [], 0
+                chunk.append(p)
+                size += len(p)
+            if chunk:
+                self._ship(lane, kind, chunk)
+            return
+        t0 = time.perf_counter()
+        bounds = [0]
+        for p in parts:
+            bounds.append(bounds[-1] + len(p))
+        blob = b"".join(parts)
+        deadline = time.monotonic() + _RING_STALL_S
+        off = lane.ring.try_write(blob)
+        while off is None:
+            if self._closing or not lane.alive() or (
+                time.monotonic() >= deadline
+            ):
+                self.parent.telemetry.inc("dropped_jobs_total", len(parts))
+                logger.warning(
+                    "lane %d ring full (%s): dropped %d events",
+                    lane.index, "dead child" if not lane.alive()
+                    else "stalled child", len(parts),
+                )
+                if not self._closing:
+                    # a dead child's respawn resyncs, but an alive-slow
+                    # child never respawns — the drop itself must
+                    # schedule the (rate-bounded) full re-list, or the
+                    # dropped events are permanent divergence
+                    self.parent._integrity_resync(kind)
+                return
+            time.sleep(0.001)
+            off = lane.ring.try_write(blob)
+        self._send(lane, ("RAWB", kind, off, len(blob), bounds))
+        self._m_handoff.observe(time.perf_counter() - t0)
+
+    def _lane_qdepth(self, lane: ProcLane) -> int:
+        bank = self.bank
+        rows = bank.rows if bank is not None else None
+        if rows is None:
+            return 0
+        return int(rows[lane.index, shm_mod.BANK_QDEPTH])
+
+    def _shed_check(self, lane: ProcLane, n: int) -> bool:
+        """Parent-side twin of LaneSet._shed: sheds ``n`` routed events
+        when the child's ingest queue (read from its StatusBank row;
+        the 50ms refresh only delays the trip by one beat) is deeper
+        than --shed-queue-depth — counted in kwok_dropped_jobs_total and
+        surfaced as the lane<N>_queue degraded reason. The coordinator
+        clears + resyncs once the backlog halves, so shedding trades
+        freshness, not permanent state (the LaneSet contract)."""
+        if not self._shed_depth or self._lane_qdepth(lane) <= (
+            self._shed_depth
+        ):
+            return False
+        self.parent.telemetry.inc("dropped_jobs_total", n)
+        lane.shedding = True
+        if self.parent._degradation.set(f"lane{lane.index}_queue"):
+            logger.warning(
+                "lane %d queue past %d: shedding routed events "
+                "(engine degraded)", lane.index, self._shed_depth,
+            )
+        return True
+
+    def _send(self, lane: ProcLane, msg) -> None:
+        conn = lane.conn
+        if conn is None:
+            return
+        try:
+            conn.send(msg)
+        except (OSError, ValueError, BrokenPipeError):
+            # dead child mid-send: the supervisor owns recovery
+            swallowed("proclanes.send_dead_lane")
+
+    @staticmethod
+    def _rec_key(rec):
+        name = rec.name
+        if not name:
+            return None
+        return (rec.namespace or "default", name)
+
+    @staticmethod
+    def _pod_key(obj: dict):
+        meta = obj.get("metadata") or {}
+        return (meta.get("namespace") or "default", meta.get("name") or "")
+
+    # ----------------------------------------------------------- supervisor
+
+    def supervise_loop(self) -> None:
+        """Process-level watchdog: a lane process that exits without a
+        STOP is a crash — charge the SAME restart budget the thread
+        watchdog uses, replay its emit crash-replay slot, respawn it,
+        and resync the streams so the re-list re-delivers whatever died
+        with it. Budget exhaustion degrades, exactly like a thread
+        crash-loop."""
+        parent = self.parent
+        while parent._running and not self._closing:
+            time.sleep(_SUPER_POLL_S)
+            for lane in self.lanes:
+                if self._closing or not parent._running:
+                    return
+                p = lane.proc
+                if p is None or lane.dead:
+                    continue
+                if p.is_alive():
+                    # hung-child detection: the status loop beats the
+                    # lane's BANK_ALIVE_NS every 50ms (CLOCK_MONOTONIC is
+                    # system-wide, comparable across processes); a live
+                    # process whose beat is older than the stall bound is
+                    # wedged — SIGKILL it and let the dead-path below
+                    # charge + respawn on the next poll. The stamp is
+                    # zeroed at respawn, so a fresh child importing jax
+                    # is never judged by its predecessor's clock.
+                    bank = self.bank
+                    rows = bank.rows if bank is not None else None
+                    if rows is not None:
+                        beat = int(rows[lane.index,
+                                        shm_mod.BANK_ALIVE_NS])
+                        if beat and (
+                            time.monotonic_ns() - beat > _STALL_NS
+                        ):
+                            logger.warning(
+                                "lane %d wedged (no status beat for "
+                                "%.0fs); killing for respawn",
+                                lane.index, _STALL_NS / 1e9,
+                            )
+                            lane.sigkill()
+                    continue
+                rc = p.exitcode
+                logger.warning(
+                    "lane %d process died (exit %s)", lane.index, rc
+                )
+                worker_crashed(lane.name)
+                wd = parent._watchdog
+                if wd is not None and not wd.charge(lane.name):
+                    lane.dead = True
+                    parent._worker_budget_exhausted(lane.name)
+                    continue
+                self._respawn(lane)
+
+    def _respawn(self, lane: ProcLane) -> None:
+        with self._proc_lock:
+            if self._closing:
+                return  # close() owns the endgame; don't race the unlink
+            self._respawning = True
+        try:
+            self._do_respawn(lane)
+        finally:
+            self._respawning = False
+
+    def _do_respawn(self, lane: ProcLane) -> None:
+        # 1. replay the emit crash-replay slot BEFORE the new child can
+        #    emit anything: at-least-once, ordered ahead of post-respawn
+        #    traffic (echo drop / repair no-op absorb duplicates)
+        payload = lane.slot.peek()
+        if payload is not None:
+            try:
+                self._replay_frames(pickle.loads(payload))
+                lane.slot.clear()
+            except Exception:
+                logger.exception(
+                    "lane %d: inflight replay failed (checkpoint replay "
+                    "still covers the slice)", lane.index,
+                )
+        # 2. unread ring bytes died with the child's descriptors; the
+        #    dead child's status stamp must not feed the stall detector
+        lane.ring.reset()
+        if self.bank is not None:
+            self.bank.rows[lane.index, shm_mod.BANK_ALIVE_NS] = 0
+        old_conn = lane.conn
+        if old_conn is not None:
+            try:
+                old_conn.close()
+            except OSError:
+                swallowed("proclanes.respawn_conn_close")
+        # 3. respawn + account
+        self._spawn_lane(lane)
+        lane.restarts += 1
+        self._m_restarts.labels(shard=str(lane.index)).inc()
+        worker_restarted(lane.name)
+        logger.warning("lane %d respawned (pid %s)", lane.index,
+                       lane.proc.pid)
+        # 4. the data half: only a full list+RESYNC provably re-delivers
+        #    what the dead process took with it (the PR 6/7 contract)
+        self.parent.resync_streams()
+
+    def _replay_frames(self, requests: list) -> None:
+        """Send a dead lane's parked emit frames from the parent: plain
+        HTTP, one connection, sequential (the batch is small — one emit
+        window). Status codes are advisory: 4xx here means the echo
+        already landed or the object moved on, which the repair path
+        owns either way."""
+        if not requests:
+            return
+        from urllib.parse import urlsplit
+
+        u = urlsplit(self._master)
+        if u.scheme == "https":
+            conn = http.client.HTTPSConnection(
+                u.hostname, u.port or 443, timeout=10
+            )
+        else:
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port or 80, timeout=10
+            )
+        try:
+            for r in requests:
+                method, path, body = r[0], r[1], r[2]
+                ct = r[3] if len(r) > 3 else "application/json"
+                if isinstance(path, (bytes, bytearray)):
+                    path = path.decode()
+                conn.request(
+                    method, path, body=bytes(body),
+                    headers={"Content-Type": ct or "application/json"},
+                )
+                conn.getresponse().read()
+        finally:
+            conn.close()
+
+    # ---------------------------------------------------------- coordinator
+
+    def coordinator_loop(self) -> None:
+        """Runs as the engine's kwok-tick thread: no device state at the
+        parent — the status scrape (gauges + the startup gate) plus the
+        child->parent healing upcalls, at the tick cadence."""
+        parent = self.parent
+        interval = max(0.02, parent.config.tick_interval)
+        tel = parent.telemetry
+        seen_integ = {("nodes", i): 0 for i in range(self.n)}
+        seen_integ.update({("pods", i): 0 for i in range(self.n)})
+        seen_rewind = [0] * self.n
+        seen_rewind_gen = [0] * self.n
+        while parent._running:
+            time.sleep(interval)
+            bank = self.bank
+            if bank is None:
+                continue
+            rows = bank.rows
+            tel.set_gauge("nodes_managed", int(rows[:, shm_mod.BANK_NODES].sum()))
+            tel.set_gauge("pods_managed", int(rows[:, shm_mod.BANK_PODS].sum()))
+            tel.set_gauge(
+                "ingest_queue_depth",
+                max(parent._q.qsize(),
+                    int(rows[:, shm_mod.BANK_QDEPTH].max())),
+            )
+            if parent._startup_pending is not None:
+                for lane in self.lanes:
+                    mask = int(rows[lane.index, shm_mod.BANK_RESYNC])
+                    if mask & 1:
+                        parent._mark_resync("nodes", lane.index)
+                    if mask & 2:
+                        parent._mark_resync("pods", lane.index)
+                parent._ckpt_gate(dispatched=True, staged=False)
+            # healing upcalls: counter deltas -> the real (rate-bounded)
+            # stream machinery on the parent, which owns the watches
+            for lane in self.lanes:
+                i = lane.index
+                if lane.restarts != seen_rewind_gen[i]:
+                    # a respawned child's counters restart at zero
+                    seen_rewind_gen[i] = lane.restarts
+                    seen_integ[("nodes", i)] = 0
+                    seen_integ[("pods", i)] = 0
+                    seen_rewind[i] = 0
+                for kind, field in (
+                    ("nodes", shm_mod.BANK_INTEG_NODES),
+                    ("pods", shm_mod.BANK_INTEG_PODS),
+                ):
+                    v = int(rows[i, field])
+                    if v > seen_integ[(kind, i)]:
+                        seen_integ[(kind, i)] = v
+                        parent._integrity_resync(kind)
+                v = int(rows[i, shm_mod.BANK_REWIND])
+                if v > seen_rewind[i]:
+                    seen_rewind[i] = v
+                    now = time.monotonic()
+                    if now - parent._rv_rewind_at >= 5.0:
+                        parent._rv_rewind_at = now
+                        parent._inc("rv_rewinds_total")
+                        logger.warning(
+                            "lane %d reported a re-list rv rewind "
+                            "(store restore signature); resyncing all "
+                            "streams", i,
+                        )
+                        parent.resync_streams()
+            if self._shed_depth:
+                # shed-clear, the LaneSet drain_loop contract: backlog
+                # halved -> clear the degraded reason + resync (shed
+                # events are GONE; only the full re-list re-delivers
+                # them), rate-limited so a re-list burst re-tripping
+                # shedding can't hammer the apiserver with LISTs
+                from kwok_tpu.engine.lanes import _SHED_RESYNC_MIN_S
+
+                for lane in self.lanes:
+                    if not lane.shedding or self._lane_qdepth(
+                        lane
+                    ) * 2 > self._shed_depth:
+                        continue
+                    now = time.monotonic()
+                    if now - parent._shed_resync_at < _SHED_RESYNC_MIN_S:
+                        continue
+                    parent._shed_resync_at = now
+                    lane.shedding = False
+                    if parent._degradation.clear(
+                        f"lane{lane.index}_queue"
+                    ):
+                        logger.info(
+                            "lane %d drained below shed threshold; "
+                            "degraded reason cleared; resyncing streams "
+                            "to re-deliver shed events", lane.index,
+                        )
+                        parent.resync_streams()
+
+    # ------------------------------------------------------------- readouts
+
+    def status(self) -> list[dict]:
+        """Per-lane status rows (tests, tooling, the proc-check gate)."""
+        out = []
+        rows = self.bank.rows if self.bank is not None else None
+        for lane in self.lanes:
+            r = rows[lane.index] if rows is not None else None
+            out.append({
+                "index": lane.index,
+                "alive": lane.alive(),
+                "pid": lane.proc.pid if lane.proc is not None else None,
+                "restarts": lane.restarts,
+                "ready": bool(r is not None and r[shm_mod.BANK_READY]),
+                "nodes": int(r[shm_mod.BANK_NODES]) if r is not None else 0,
+                "pods": int(r[shm_mod.BANK_PODS]) if r is not None else 0,
+            })
+        return out

@@ -1,0 +1,251 @@
+"""Shared-memory arenas for the process-lane pipeline (ISSUE 15).
+
+The threaded ShardLanes overlap only where stages release the GIL
+(LANES_r07 called the 2.2x threaded win "the floor"). Process lanes
+(engine/proclanes.py) put each lane's drain+apply+emit on a true core;
+this module is the cross-process substrate they stand on:
+
+- ``RawRing``   — a single-producer/single-consumer byte ring hosted on
+  one ``multiprocessing.shared_memory`` segment per lane. The parent
+  router writes each parse window's raw event lines ONCE (bytes are
+  copied, never re-serialized — no JSON re-encode, no pickle of event
+  payloads) and ships a tiny ``(offset, length)`` descriptor over the
+  lane's pipe; the child maps the same pages and slices the blob out.
+- ``InflightSlot`` — the cross-process twin of ShardLane's
+  ``_emit_inflight`` crash-replay slot: the child parks its rendered
+  emit frames in shared memory BEFORE the pump send and clears the slot
+  after every frame is acknowledged, so a SIGKILL mid-send cannot lose
+  an emit slice (device transitions fire exactly once; the parent
+  replays the slot before respawning the lane — at-least-once, absorbed
+  by the echo drop / repair no-op exactly like the pump's whole-frame
+  resend).
+- ``StatusBank`` — one int64 row per lane (numpy views over a shared
+  buffer, per-lane slices): liveness heartbeat, readiness, resync
+  progress, managed counts, queue depth. The parent's coordinator
+  scrapes it for /metrics gauges, the startup gate, and the supervisor's
+  hung-child detection — no pipe round-trips on the monitoring path.
+
+Lifecycle discipline: the PARENT creates and unlinks every segment
+(``close(unlink=True)`` on clean stop AND around respawns); children
+only attach and close. Spawned children share the parent's
+resource-tracker process, so the tracker entry lives exactly as long as
+the parent's registration and a SIGKILLed child can never take the
+arena down with it; the gate in benchmarks/proc_soak.py proves
+/dev/shm ends empty either way.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from multiprocessing import shared_memory
+
+import numpy as np
+
+logger = logging.getLogger("kwok_tpu.shm")
+
+# header slots (int64 each) shared by the ring/slot layouts
+_HDR_I64 = 8
+_HDR_BYTES = _HDR_I64 * 8
+
+
+def arena_name(tag: str) -> str:
+    return f"kwoktpu-{tag}-{uuid.uuid4().hex[:10]}"
+
+
+class Arena:
+    """One shared_memory segment + a header/payload numpy view split."""
+
+    def __init__(self, name: str, size: int = 0, create: bool = False):
+        if create:
+            self.shm = shared_memory.SharedMemory(
+                name=name, create=True, size=size
+            )
+        else:
+            # attach: the child shares the parent's resource-tracker
+            # process (spawn passes the tracker fd), so the attach-side
+            # register dedups against the parent's create-side one and
+            # the segment's tracker entry lives exactly until the parent
+            # unlinks — a SIGKILLed child can never take the arena down
+            self.shm = shared_memory.SharedMemory(name=name)
+        self.name = name
+        self.size = self.shm.size
+        self.created = create
+        self.hdr = np.frombuffer(
+            self.shm.buf, dtype=np.int64, count=_HDR_I64
+        )
+        self.payload = self.shm.buf[_HDR_BYTES:]
+
+    def close(self, unlink: bool = False) -> None:
+        # release the numpy views first: SharedMemory.close() refuses
+        # while exported buffers are alive
+        self.hdr = None
+        self.payload = None
+        try:
+            self.shm.close()
+        except BufferError:
+            logger.debug("arena %s still referenced at close", self.name)
+            return
+        if unlink and self.created:
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:
+                pass
+
+
+class RawRing:
+    """SPSC byte ring: the parent writes raw-line blobs, the child reads
+    them by (absolute offset, length) descriptors received over its pipe.
+
+    Header: [0]=w total bytes produced (pads included), [1]=r total bytes
+    consumed (child-written), [3]=payload capacity (layout check; slot
+    [2] is reserved). Blobs never
+    straddle the wrap point — the writer pads to the boundary and the
+    descriptor's offset already accounts for it, so the reader's consume
+    (``r = offset + length``) retires the pad implicitly.
+    """
+
+    W, R, CAP = 0, 1, 3
+
+    def __init__(self, name: str, size: int = 0, create: bool = False):
+        self.arena = Arena(name, size + _HDR_BYTES if create else 0, create)
+        self.cap = self.arena.size - _HDR_BYTES
+        if create:
+            self.arena.hdr[self.CAP] = self.cap
+        elif int(self.arena.hdr[self.CAP]) != self.cap:
+            raise ValueError(
+                f"ring {name}: capacity mismatch "
+                f"({self.arena.hdr[self.CAP]} != {self.cap})"
+            )
+        self.name = name
+
+    # ------------------------------------------------------------ producer
+
+    def free_bytes(self) -> int:
+        hdr = self.arena.hdr
+        return self.cap - int(hdr[self.W] - hdr[self.R])
+
+    def try_write(self, blob) -> int | None:
+        """Append ``blob`` contiguously; returns its absolute offset or
+        None when the ring lacks space (caller paces/sheds — see
+        ProcLane.ship)."""
+        n = len(blob)
+        if n > self.cap:
+            raise ValueError(f"blob {n}B exceeds ring capacity {self.cap}B")
+        hdr = self.arena.hdr
+        w = int(hdr[self.W])
+        pos = w % self.cap
+        pad = self.cap - pos if pos + n > self.cap else 0
+        if self.cap - int(w - hdr[self.R]) < pad + n:
+            return None
+        start = w + pad
+        spos = start % self.cap
+        self.arena.payload[spos:spos + n] = blob
+        # publish AFTER the payload copy: int64 store is atomic, and the
+        # descriptor (the reader's only pointer into the ring) is sent
+        # over the pipe after this returns — double-fenced by the pipe
+        hdr[self.W] = start + n
+        return start
+
+    def reset(self) -> None:
+        """Respawn path: drop unconsumed bytes (their descriptors died
+        with the child's pipe; the post-respawn stream resync re-delivers
+        the events)."""
+        hdr = self.arena.hdr
+        hdr[self.R] = int(hdr[self.W])
+
+    # ------------------------------------------------------------ consumer
+
+    def read(self, offset: int, length: int) -> bytes:
+        pos = offset % self.cap
+        out = bytes(self.arena.payload[pos:pos + length])
+        self.arena.hdr[self.R] = offset + length
+        return out
+
+    def close(self, unlink: bool = False) -> None:
+        self.arena.close(unlink=unlink)
+
+
+class InflightSlot:
+    """One pending emit batch, durable across a lane-process SIGKILL.
+
+    Header: [0]=state (0 empty / 1 armed), [1]=payload length. The writer
+    orders state=0 -> payload -> length -> state=1 (disarm-first, so a
+    RE-arm torn mid-copy cannot leave state=1 over a mix of old and new
+    bytes); the (single, post-mortem) reader checks state first — a torn
+    write parks as "empty", which only widens the at-least-once replay
+    the checkpoint machinery already absorbs.
+    """
+
+    STATE, LEN = 0, 1
+
+    def __init__(self, name: str, size: int = 0, create: bool = False):
+        self.arena = Arena(name, size + _HDR_BYTES if create else 0, create)
+        self.cap = self.arena.size - _HDR_BYTES
+        self.name = name
+
+    def arm(self, payload: bytes) -> bool:
+        if len(payload) > self.cap:
+            # oversized batch: the slot degrades to the pre-ISSUE-15
+            # contract (checkpoint-replay only) instead of truncating
+            return False
+        hdr = self.arena.hdr
+        hdr[self.STATE] = 0  # disarm-first: a torn RE-arm reads "empty"
+        self.arena.payload[: len(payload)] = payload
+        hdr[self.LEN] = len(payload)
+        hdr[self.STATE] = 1
+        return True
+
+    def clear(self) -> None:
+        self.arena.hdr[self.STATE] = 0
+
+    def peek(self) -> bytes | None:
+        hdr = self.arena.hdr
+        if int(hdr[self.STATE]) != 1:
+            return None
+        n = int(hdr[self.LEN])
+        if not 0 <= n <= self.cap:
+            return None
+        return bytes(self.arena.payload[:n])
+
+    def close(self, unlink: bool = False) -> None:
+        self.arena.close(unlink=unlink)
+
+
+# StatusBank fields (one int64 row per lane)
+BANK_ALIVE_NS = 0      # child heartbeat, monotonic ns of the CHILD's clock
+BANK_READY = 1         # child engine.ready
+BANK_RESYNC = 2        # bitmask: 1 = nodes re-list ingested, 2 = pods
+BANK_NODES = 3         # len(nodes.pool)
+BANK_PODS = 4          # len(pods.pool)
+BANK_QDEPTH = 5        # child ingest-queue depth
+BANK_EVENTS = 6        # events applied (child watch_events_total proxy)
+BANK_PID = 7           # child's own pid (supervisor sanity)
+# child -> parent upcall counters (the child has no watch streams of its
+# own; the parent's coordinator turns deltas into the real stream cuts)
+BANK_INTEG_NODES = 8   # integrity-doubt resync requests (nodes)
+BANK_INTEG_PODS = 9    # integrity-doubt resync requests (pods)
+BANK_REWIND = 10       # re-listed-rv-rewind detections (store restore)
+BANK_FIELDS = 12
+
+
+class StatusBank:
+    """Per-lane int64 status rows; children own their row, the parent
+    reads all of them (single-writer-per-row, no locks)."""
+
+    def __init__(self, name: str, lanes: int = 0, create: bool = False):
+        size = lanes * BANK_FIELDS * 8 if create else 0
+        self.arena = Arena(name, size + _HDR_BYTES if create else 0, create)
+        n = (self.arena.size - _HDR_BYTES) // (BANK_FIELDS * 8)
+        self.rows = np.frombuffer(
+            self.arena.shm.buf, dtype=np.int64, offset=_HDR_BYTES,
+            count=n * BANK_FIELDS,
+        ).reshape(n, BANK_FIELDS)
+        self.name = name
+
+    def row(self, i: int) -> np.ndarray:
+        return self.rows[i]
+
+    def close(self, unlink: bool = False) -> None:
+        self.rows = None
+        self.arena.close(unlink=unlink)

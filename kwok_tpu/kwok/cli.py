@@ -83,6 +83,15 @@ def build_parser(defaults) -> argparse.ArgumentParser:
                    "(0 = built-in default, config.types."
                    "DEFAULT_MAX_DRAIN_SHARDS); explicit --drain-shards "
                    "values are never capped")
+    p.add_argument("--lane-procs", type=_bool, default=o.laneProcs,
+                   help="run each drain shard as a worker PROCESS over "
+                   "shared-memory arenas instead of a thread (the GIL "
+                   "escape): children own their shard's rows, device "
+                   "tick, emit, and pump; the parent keeps watch ingest "
+                   "+ the router and supervises respawns. Default off "
+                   "(threaded lanes byte-unchanged); env KWOK_LANE_PROCS; "
+                   "needs an HTTP --master, refused with --use-mesh / "
+                   "--ha-role / federation")
     p.add_argument("--initial-capacity", type=int, default=o.initialCapacity)
     p.add_argument("--use-mesh", type=_bool, default=o.useMesh,
                    help="shard cluster state across all local devices")
@@ -186,6 +195,7 @@ def _engine_config(args, stages: list[Stage]):
             args.drain_shards, args.max_drain_shards
         ),
         max_drain_shards=args.max_drain_shards,
+        lane_procs=args.lane_procs,
         manage_all_nodes=args.manage_all_nodes,
         manage_nodes_with_annotation_selector=args.manage_nodes_with_annotation_selector,
         manage_nodes_with_label_selector=args.manage_nodes_with_label_selector,
@@ -326,6 +336,14 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
             # a typo'd path must not silently fall back to default rules
             # (the member would quietly run a homogeneous federation)
             raise SystemExit(f"--member-config {mc}: no such file")
+    if len(masters) > 1 and args.lane_procs:
+        # a federation's members already shard the host across masters;
+        # process lanes are the single-cluster GIL escape — refusing
+        # beats nesting two sharding topologies nobody has gated
+        raise SystemExit(
+            "--lane-procs is a single-cluster flag; federation "
+            "(multi-master --master) shards the host per member"
+        )
     if len(masters) > 1 and args.ha_role not in ("", "off"):
         # a federation already tolerates member failures via the shared
         # watchdog (PR 7); the lease-fenced pair is a single-cluster
@@ -377,6 +395,9 @@ def main(argv=None, stop_event: threading.Event | None = None) -> int:
         client = HttpKubeClient.from_kubeconfig(
             args.kubeconfig or None, masters[0] if masters else None
         )
+        # process lanes rebuild their own clients in the children from
+        # the same kubeconfig (engine/proclanes.py _lane_spec)
+        client.kubeconfig_path = args.kubeconfig or ""
         wait_for_apiserver(client)
         engine = ClusterEngine(client, _engine_config(args, stages))
     # liveness first, readiness after: the server comes up immediately

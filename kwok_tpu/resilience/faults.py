@@ -193,6 +193,12 @@ class FaultPlane:
         self._killer: "threading.Thread | None" = None
         self._stop = threading.Event()
         self._kill_results: list[dict] = []
+        # process-lane kill targets (engine/proclanes.py): name -> a
+        # callable delivering a REAL SIGKILL to the lane process. The
+        # worker.kill spec matches these exactly like supervised thread
+        # names, so `worker.kill=kwok-lane*` kills processes under
+        # --lane-procs and threads otherwise.
+        self._proc_targets: dict = {}
 
     # ------------------------------------------------------------ decisions
 
@@ -335,22 +341,60 @@ class FaultPlane:
         "kwok-lane", "kwok-emit", "kwok-route", "kwok-watch",
     )
 
+    def register_proc_target(self, name: str, kill_fn) -> None:
+        """Expose a supervised lane PROCESS to the worker.kill rotation;
+        ``kill_fn()`` must deliver SIGKILL and return whether it did."""
+        with self._fault_lock:
+            self._proc_targets[name] = kill_fn
+
+    def unregister_proc_target(self, name: str) -> None:
+        with self._fault_lock:
+            self._proc_targets.pop(name, None)
+
     def _kill_loop(self) -> None:
         from kwok_tpu.workers import live_workers
 
         nth = 0
         while not self._stop.wait(self.spec.kill_period):
+            with self._fault_lock:
+                procs = dict(self._proc_targets)
             names = sorted(
-                n for n in live_workers()
-                if fnmatch.fnmatch(n, self.spec.kill_glob)
-                and n.startswith(self._SUPERVISED_PREFIXES)
+                {
+                    n for n in live_workers()
+                    if fnmatch.fnmatch(n, self.spec.kill_glob)
+                    and n.startswith(self._SUPERVISED_PREFIXES)
+                }
+                | {
+                    n for n in procs
+                    if fnmatch.fnmatch(n, self.spec.kill_glob)
+                }
             )
             if not names:
                 continue
             # rotate deterministically through the sorted matches
             name = names[nth % len(names)]
             nth += 1
-            self.kill_worker(name)
+            if name in procs:
+                self.kill_process(name, procs[name])
+            else:
+                self.kill_worker(name)
+
+    def kill_process(self, name: str, kill_fn) -> bool:
+        """SIGKILL a registered lane process (the process-lane twin of
+        kill_worker: same counter, same kill log)."""
+        try:
+            ok = bool(kill_fn())
+        except Exception:
+            logger.exception("chaos: SIGKILL of %s failed", name)
+            return False
+        if ok:
+            self.record("worker.kill")
+            with self._fault_lock:
+                self._kill_results.append(
+                    {"thread": name, "proc": True, "t": time.monotonic()}
+                )
+            logger.warning("chaos: SIGKILLed lane process %s", name)
+        return ok
 
     def kill_worker(self, name: str) -> bool:
         """Async-raise WorkerKilled into the named spawn_worker thread.

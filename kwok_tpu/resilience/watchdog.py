@@ -80,6 +80,17 @@ class Watchdog:
         """Stop restarting: a crash during shutdown ends its worker."""
         self._closed = True
 
+    def charge(self, name: str) -> bool:
+        """Account one external restart of ``name`` against the SAME
+        budget window in-thread supervision uses; returns whether the
+        restart is allowed. The process-lane supervisor
+        (engine/proclanes.py) charges lane-process respawns here so a
+        crash-looping process degrades exactly like a crash-looping
+        thread."""
+        if self._closed:
+            return False
+        return self._allow(name, time.monotonic())
+
     # -------------------------------------------------------- supervision
 
     def _supervise(self, target, name: str, args: tuple) -> None:

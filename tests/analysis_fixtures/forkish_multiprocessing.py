@@ -1,0 +1,47 @@
+"""kwoklint fixture: fork-after-threads multiprocessing shapes.
+
+Never imported — parsed by tests/test_analysis.py, which asserts the
+spawn-only rule reports EXACTLY the lines carrying a finding marker
+comment. The compliant half (the get_context("spawn") idiom
+engine/proclanes.py uses, and non-process-creating submodules like
+shared_memory) must stay finding-free, pinning the rule both ways.
+"""
+
+import multiprocessing
+import multiprocessing as mp
+from multiprocessing import Pipe, get_context, shared_memory
+
+
+def bad_bare_module():
+    p = multiprocessing.Process(target=print)  # F: spawn-only
+    q = multiprocessing.Queue()  # F: spawn-only
+    return p, q
+
+
+def bad_aliased_module():
+    return mp.Pool(2)  # F: spawn-only
+
+
+def bad_from_import():
+    return Pipe(duplex=False)  # F: spawn-only
+
+
+def bad_contexts():
+    a = multiprocessing.get_context()  # F: spawn-only
+    b = mp.get_context("fork")  # F: spawn-only
+    c = get_context("forkserver")  # F: spawn-only
+    return a, b, c
+
+
+def good_spawn_context():
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=print)
+    parent, child = ctx.Pipe(duplex=False)
+    return p, parent, child, get_context("spawn")
+
+
+def good_non_process_apis():
+    seg = shared_memory.SharedMemory(create=True, size=64)
+    seg.close()
+    seg.unlink()
+    return multiprocessing.cpu_count()

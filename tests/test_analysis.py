@@ -97,6 +97,20 @@ def test_silent_except_fires_exactly_on_fixture():
     assert {(f.line, f.rule) for f in findings} == markers(path)
 
 
+# --------------------------------------------------------------- spawn-only
+
+
+def test_spawn_only_fires_exactly_on_fixture():
+    from kwok_tpu.analysis.spawnonly import SpawnOnlyRule
+
+    path, findings, _ = run_fixture(
+        "forkish_multiprocessing.py", [SpawnOnlyRule()]
+    )
+    assert {(f.line, f.rule) for f in findings} == markers(path)
+    # the messages teach the fix, not just the violation
+    assert all('"spawn"' in f.message for f in findings)
+
+
 # ------------------------------------------------------------- metrics/doc
 
 

@@ -1,0 +1,425 @@
+"""Process-lane tests (ISSUE 15): the shared-memory substrate, the
+spawn-only/zero-cost contracts, the node topology tap, the emit
+crash-replay slot guard, and (marked slow — they spawn real lane
+processes, each paying the full engine import) the cross-process
+end-to-end + SIGKILL-respawn paths that `make proc-check` exercises at
+gate scale."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from kwok_tpu.edge.ippool import IPPool
+from kwok_tpu.edge.mockserver import FakeKube
+from kwok_tpu.engine import ClusterEngine, EngineConfig
+from kwok_tpu.engine import shm as shm_mod
+from kwok_tpu.engine.proclanes import (
+    _SlotGuardPump,
+    make_proc_lane_engine_class,
+)
+from kwok_tpu.engine.rowpool import shard_of
+
+
+def _shm_leftovers() -> list:
+    try:
+        return [f for f in os.listdir("/dev/shm") if f.startswith("kwoktpu")]
+    except OSError:
+        return []
+
+
+# ------------------------------------------------------------ shm substrate
+
+
+def test_raw_ring_roundtrip_wrap_and_pad():
+    name = shm_mod.arena_name("t-ring")
+    ring = shm_mod.RawRing(name, 256, create=True)
+    try:
+        consumer = shm_mod.RawRing(name)
+        # fill-drain several times so writes wrap the payload boundary;
+        # a blob that would straddle the end must pad and stay contiguous
+        for i in range(20):
+            blob = bytes([i]) * (50 + 13 * (i % 5))
+            off = ring.try_write(blob)
+            assert off is not None
+            got = consumer.read(off, len(blob))
+            assert got == blob, f"round {i} corrupted across the wrap"
+        # capacity refused in one piece
+        with pytest.raises(ValueError):
+            ring.try_write(b"x" * 1024)
+        consumer.close()
+    finally:
+        ring.close(unlink=True)
+    assert not [f for f in _shm_leftovers() if "t-ring" in f]
+
+
+def test_raw_ring_backpressure_and_reset():
+    ring = shm_mod.RawRing(shm_mod.arena_name("t-bp"), 128, create=True)
+    try:
+        first = ring.try_write(b"a" * 100)
+        assert first is not None
+        assert ring.try_write(b"b" * 100) is None  # consumer stalled
+        ring.reset()  # respawn path: unread bytes dropped
+        assert ring.try_write(b"b" * 100) is not None
+    finally:
+        ring.close(unlink=True)
+
+
+def test_inflight_slot_semantics():
+    slot = shm_mod.InflightSlot(shm_mod.arena_name("t-slot"), 256, create=True)
+    try:
+        assert slot.peek() is None
+        assert slot.arm(b"frames")
+        assert slot.peek() == b"frames"
+        assert slot.peek() == b"frames"  # peek is non-destructive
+        slot.clear()
+        assert slot.peek() is None
+        # oversized payloads degrade to checkpoint-replay-only, never
+        # truncate
+        assert not slot.arm(b"x" * 1024)
+        assert slot.peek() is None
+    finally:
+        slot.close(unlink=True)
+
+
+def test_status_bank_single_writer_rows():
+    bank = shm_mod.StatusBank(shm_mod.arena_name("t-bank"), lanes=3,
+                              create=True)
+    try:
+        reader = shm_mod.StatusBank(bank.name)
+        bank.row(1)[shm_mod.BANK_PODS] = 41
+        bank.row(2)[shm_mod.BANK_READY] = 1
+        assert int(reader.rows[1, shm_mod.BANK_PODS]) == 41
+        assert int(reader.rows[2, shm_mod.BANK_READY]) == 1
+        assert int(reader.rows[0, shm_mod.BANK_PODS]) == 0
+        assert reader.rows.shape == (3, shm_mod.BANK_FIELDS)
+        reader.close()
+    finally:
+        bank.close(unlink=True)
+
+
+# -------------------------------------------------------- pool partitioning
+
+
+def test_ippool_partition_lanes_disjoint():
+    pools = [IPPool("10.0.0.0/16") for _ in range(4)]
+    for i, p in enumerate(pools):
+        p.partition_lanes(i, 4)
+    got = [set(p.get_many(64)) for p in pools]
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not (got[i] & got[j]), f"lanes {i}/{j} share IPs"
+    # single-lane stays the classic sequential pool
+    solo = IPPool("10.0.0.0/16")
+    solo.partition_lanes(0, 1)
+    assert solo.get() == "10.0.0.1"
+    # a lane that OUTGROWS its in-CIDR slice must jump to its slice of
+    # the next super-block, never into a neighbor's range (tiny /28:
+    # span=3, 10 allocations per lane >> span)
+    small = [IPPool("10.0.0.0/28") for _ in range(4)]
+    for i, p in enumerate(small):
+        p.partition_lanes(i, 4)
+    got = [set(p.get_many(10)) for p in small]
+    for i in range(4):
+        assert len(got[i]) == 10
+        for j in range(i + 1, 4):
+            assert not (got[i] & got[j]), \
+                f"overflowing lanes {i}/{j} share IPs"
+
+
+# ------------------------------------------------- config/CLI/zero-cost off
+
+
+def test_lane_procs_default_off_and_env_name():
+    from kwok_tpu.config.types import (
+        KwokConfigurationOptions,
+        _upper_snake,
+        apply_env_overrides,
+    )
+
+    assert EngineConfig.lane_procs is False
+    o = KwokConfigurationOptions()
+    assert o.laneProcs is False
+    assert _upper_snake("laneProcs") == "LANE_PROCS"  # KWOK_LANE_PROCS
+    apply_env_overrides(o, environ={"KWOK_LANE_PROCS": "true"})
+    assert o.laneProcs is True
+
+
+def test_cli_flag_reaches_engine_config():
+    from kwok_tpu.config.types import KwokConfigurationOptions
+    from kwok_tpu.kwok.cli import _engine_config, build_parser
+
+    p = build_parser(KwokConfigurationOptions())
+    args = p.parse_args(["--lane-procs", "true", "--manage-all-nodes",
+                         "true"])
+    cfg = _engine_config(args, [])
+    assert cfg.lane_procs is True
+
+
+def test_zero_cost_when_off():
+    """lane_procs off => threaded lanes byte-unchanged: no ProcLaneSet,
+    no shm arena, no lane process, no proc metric families."""
+    before = set(_shm_leftovers())
+    eng = ClusterEngine(
+        FakeKube(), EngineConfig(manage_all_nodes=True, drain_shards=4)
+    )
+    assert eng._proc is None
+    assert eng._lanes is not None
+    assert set(_shm_leftovers()) == before
+    assert "kwok_lane_proc_restarts_total" not in eng.metrics_text()
+
+
+def test_lane_procs_refused_without_http_master():
+    with pytest.raises(ValueError, match="HTTP"):
+        ClusterEngine(
+            FakeKube(),
+            EngineConfig(
+                manage_all_nodes=True, drain_shards=2, lane_procs=True
+            ),
+        )
+
+
+def test_lane_procs_refused_with_mesh_and_ha():
+    with pytest.raises(ValueError, match="use_mesh"):
+        ClusterEngine(
+            FakeKube(),
+            EngineConfig(
+                manage_all_nodes=True, drain_shards=2, lane_procs=True,
+                use_mesh=True,
+            ),
+        )
+    with pytest.raises(ValueError, match="ha_role"):
+        ClusterEngine(
+            FakeKube(),
+            EngineConfig(
+                manage_all_nodes=True, drain_shards=2, lane_procs=True,
+                ha_role="primary",
+            ),
+        )
+
+
+# ---------------------------------------------------------- node topology tap
+
+
+def _tap_engine(index: int, n: int):
+    cls = make_proc_lane_engine_class()
+    e = cls(FakeKube(), EngineConfig(manage_all_nodes=True))
+    e._lane_index = index
+    e._lane_n = n
+    e._proc_integ = {"nodes": 0, "pods": 0, "rewind": 0}
+    return e
+
+
+def _unowned_node_name(index: int, n: int) -> str:
+    i = 0
+    while True:
+        name = f"tapn{i}"
+        if shard_of(name, n) != index:
+            return name
+        i += 1
+
+
+def test_node_tap_tracks_unowned_nodes_without_rows():
+    n = 4
+    e = _tap_engine(0, n)
+    other = _unowned_node_name(0, n)
+    node = {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": other}, "status": {}}
+    e._node_upsert(node)
+    # managed-ness tracked, but NO row acquired (the owning lane does
+    # rows + heartbeats — a row here would double-manage the node)
+    assert other in e.node_has
+    assert e.nodes.pool.lookup(other) is None
+    e._node_deleted({"metadata": {"name": other}})
+    assert other not in e.node_has
+
+
+def test_node_tap_flips_owned_pods_managed():
+    n = 4
+    e = _tap_engine(0, n)
+    other = _unowned_node_name(0, n)
+    # a pod owned by lane 0, scheduled on a node owned by another lane
+    i = 0
+    while shard_of(("default", f"tapp{i}"), n) != 0:
+        i += 1
+    pname = f"tapp{i}"
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": pname, "namespace": "default"},
+           "spec": {"nodeName": other,
+                    "containers": [{"name": "c", "image": "b"}]},
+           "status": {"phase": "Pending"}}
+    e._pod_upsert(pod)
+    idx = e.pods.pool.lookup(("default", pname))
+    assert idx is not None
+    # node unknown yet: not managed
+    assert other not in e.node_has
+    node = {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": other}, "status": {}}
+    e._node_upsert(node)
+    assert other in e.node_has
+    # the tap re-evaluated this lane's pods on that node
+    assert ("default", pname) in e.pods_by_node.get(other, set())
+
+
+def test_node_tap_resync_prunes_vanished_unowned_nodes():
+    n = 4
+    e = _tap_engine(0, n)
+    other = _unowned_node_name(0, n)
+    e._node_upsert({"metadata": {"name": other}, "status": {}})
+    assert other in e.node_has
+    e._resync("nodes", [])  # full snapshot without it
+    assert other not in e.node_has
+
+
+# ------------------------------------------------------- emit inflight guard
+
+
+class _StubPump:
+    def __init__(self, statuses):
+        self.statuses = list(statuses)
+        self.sent = []
+
+    def send(self, requests):
+        self.sent.append(list(requests))
+        return np.asarray(self.statuses.pop(0), np.int32)
+
+    def close(self):
+        pass
+
+
+def test_slot_guard_pump_arms_then_clears():
+    slot = shm_mod.InflightSlot(shm_mod.arena_name("t-guard"), 4096,
+                                create=True)
+    try:
+        reqs = [("PATCH", "/api/v1/x", b"{}", "application/merge-patch+json")]
+        # all delivered: slot cleared
+        g = _SlotGuardPump(slot, _StubPump([[200]]))
+        g.send(reqs)
+        assert slot.peek() is None
+        # connection death (status 0): the slot keeps the frames for the
+        # post-mortem replay
+        g = _SlotGuardPump(slot, _StubPump([[0]]))
+        g.send(reqs)
+        parked = slot.peek()
+        assert parked is not None
+        assert pickle.loads(parked) == reqs
+    finally:
+        slot.close(unlink=True)
+
+
+# ------------------------------------------------ fault plane / watchdog glue
+
+
+def test_fault_plane_proc_kill_targets():
+    from kwok_tpu.resilience.faults import FaultSpec, FaultPlane
+
+    plane = FaultPlane(FaultSpec.parse("worker.kill=kwok-lane*:5.0"))
+    killed = []
+    plane.register_proc_target("kwok-lane0", lambda: killed.append(0) or True)
+    assert plane.kill_process("kwok-lane0", plane._proc_targets["kwok-lane0"])
+    assert killed == [0]
+    assert plane.counts().get("worker.kill") == 1
+    assert any(r.get("proc") for r in plane.kill_log())
+    plane.unregister_proc_target("kwok-lane0")
+    assert "kwok-lane0" not in plane._proc_targets
+
+
+def test_watchdog_charge_shares_budget_window():
+    from kwok_tpu.resilience.watchdog import Watchdog
+
+    wd = Watchdog(budget=2, window=60.0)
+    assert wd.charge("kwok-lane0")
+    assert wd.charge("kwok-lane0")
+    assert not wd.charge("kwok-lane0")  # budget exhausted
+    assert wd.charge("kwok-lane1")      # budgets are per worker
+    wd.close()
+    assert not wd.charge("kwok-lane1")  # shutdown never respawns
+
+
+# ------------------------------------------------------- spawn e2e (slow)
+
+
+def _wait(pred, timeout):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return pred()
+
+
+@pytest.mark.slow
+def test_proc_lanes_end_to_end_and_sigkill_respawn(tmp_path):
+    """Real spawned lane processes against the HTTP mock: convergence,
+    per-lane checkpoints, SIGKILL respawn within budget, clean shm."""
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+
+    srv = HttpFakeApiserver(store=FakeKube()).start()
+    eng = None
+    try:
+        client = HttpKubeClient(f"http://127.0.0.1:{srv.port}")
+        eng = ClusterEngine(client, EngineConfig(
+            manage_all_nodes=True, tick_interval=0.05, drain_shards=2,
+            lane_procs=True, initial_capacity=2048,
+            checkpoint_dir=str(tmp_path), checkpoint_interval=0.5,
+        ))
+        eng.start()
+        assert _wait(lambda: eng.ready, 120), "startup gate never closed"
+        store = srv.store
+        store.create("nodes", {"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": "pe-n0"}, "status": {}})
+        for i in range(12):
+            store.create("pods", {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"pe-p{i}", "namespace": "default"},
+                "spec": {"nodeName": "pe-n0",
+                         "containers": [{"name": "c", "image": "b"}]},
+                "status": {"phase": "Pending"},
+            })
+        names = [f"pe-p{i}" for i in range(12)]
+
+        def all_running():
+            return all(
+                (store.get("pods", "default", nm) or {})
+                .get("status", {}).get("phase") == "Running"
+                for nm in names
+            )
+
+        assert _wait(all_running, 90), "pods never converged"
+        # per-lane checkpoints on disk (the member<i>.ckpt.json pattern)
+        assert _wait(lambda: {"lane0.ckpt.json", "lane1.ckpt.json"} <= set(
+            os.listdir(tmp_path)), 20)
+        # SIGKILL one lane mid-flight: supervisor respawns + resyncs
+        lane = eng._proc.lanes[0]
+        assert lane.sigkill()
+        assert _wait(
+            lambda: eng._proc.status()[0]["restarts"] >= 1
+            and eng._proc.status()[0]["alive"], 60,
+        ), "lane never respawned"
+        assert not eng.degraded  # one in-budget respawn never degrades
+        # post-respawn work still converges
+        store.create("pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "pe-px", "namespace": "default"},
+            "spec": {"nodeName": "pe-n0",
+                     "containers": [{"name": "c", "image": "b"}]},
+            "status": {"phase": "Pending"},
+        })
+        assert _wait(
+            lambda: (store.get("pods", "default", "pe-px") or {})
+            .get("status", {}).get("phase") == "Running", 90,
+        ), "post-respawn pod never converged"
+        assert eng.metrics_text().count(
+            'kwok_lane_proc_restarts_total{shard="0"}'
+        ) == 1
+    finally:
+        if eng is not None:
+            eng.stop()
+        srv.stop()
+    assert not _shm_leftovers(), "leaked /dev/shm segments"
