@@ -5,7 +5,7 @@
 # parallel processes don't deadlock on the single tunneled chip.
 PYENV := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check proc-check chaos-check restart-check fleet-check drift-check attrib-check ha-check image cluster-image clean
+.PHONY: all build unit-test e2e-test test verify analyze bench obs-check lane-check proc-check chaos-check restart-check fleet-check census-check drift-check attrib-check ha-check image cluster-image clean
 
 all: build
 
@@ -92,6 +92,18 @@ restart-check: ## SIGKILL + cold-restart crash-durability gate (RTO artifact)
 # FLEET_r*.json). Skips cleanly when no C++ compiler is available.
 fleet-check: ## watcher-fleet survival gate (overload admission + ring-lag slow-watcher eviction)
 	$(PYENV) python3 benchmarks/watcher_fleet.py --check
+
+# census-check: the watch-plane census + exposition-parity gate
+# (ISSUE 16): sweeps 200->1000 idle watchers against the native
+# apiserver recording the per-watcher cost of the thread-per-watcher
+# model (RSS/watcher, wake-fanout us, parked threads via GET
+# /debug/watchers) — the measured before-photo the C10k epoll-reactor
+# rewrite will be graded against — and proves a --lane-procs engine's
+# /metrics is family-and-label identical to the threaded engine's
+# (the MetricsBank shm merge; docs/observability.md). Emits
+# WATCHPLANE_r*.json. Skips cleanly when no C++ compiler is available.
+census-check: ## watch-plane census sweep + proc/threaded exposition-parity gate (WATCHPLANE_r* artifact)
+	$(PYENV) python3 benchmarks/watchplane_census.py --check
 
 # drift-check: the hostile-wire + anti-entropy gate: the threaded engine
 # converges a workload through a byte-corruption storm (wire.garble /

@@ -229,6 +229,23 @@ def _latency_attrib_rider() -> "dict | None":
         return {"error": repr(e)}
 
 
+def _watchplane_rider() -> "dict | None":
+    """Watch-plane census summary (benchmarks/watchplane_census.py rider
+    mode): one 100-watcher point against the native apiserver — the
+    per-watcher cost of the thread-per-watcher model (RSS/watcher,
+    wake-fanout µs, parked threads) rides every BENCH json, so the C10k
+    reactor rewrite's trajectory is auditable round over round.
+    Host-only; skips to a reason dict when no C++ compiler is
+    available."""
+    try:
+        from benchmarks.watchplane_census import rider as census_rider
+
+        return census_rider()
+    except Exception as e:
+        print(f"watchplane rider failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)}
+
+
 def _best_of_windows(tick, consume, per_window: int, n_windows: int = 3) -> float:
     """The shared timing harness: the device is reached through a shared
     tunnel whose latency has multi-second transients, so a single long
@@ -549,6 +566,7 @@ def pallas_main() -> None:
         "router_micro": _router_micro_rider(),
         "emit_micro": _emit_micro_rider(),
         "latency_attrib": _latency_attrib_rider(),
+        "watchplane": _watchplane_rider(),
         "metrics_snapshot": _metrics_snapshot(),
     }))
 
@@ -655,6 +673,10 @@ def main() -> None:
                 # measured apiserver phase attribution (the 437us/pod
                 # model term, measured; benchmarks/latency_attrib.py)
                 "latency_attrib": _latency_attrib_rider(),
+                # watch-plane census rider: per-watcher cost of the
+                # thread-per-watcher model (the C10k before-photo;
+                # benchmarks/watchplane_census.py)
+                "watchplane": _watchplane_rider(),
                 "metrics_snapshot": _metrics_snapshot(),
             }
         )

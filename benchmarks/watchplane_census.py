@@ -1,0 +1,503 @@
+"""Watch-plane census + exposition-parity gate (ISSUE 16).
+
+Two instruments in one artifact, `WATCHPLANE_r*.json`:
+
+**Census sweep** — the quantified before-photo the C10k reactor rewrite
+(ROADMAP item 1) will be graded against. For each cohort size on the
+200→1000 sweep, attach N idle informer-style watchers to the native
+apiserver and record the per-watcher cost of the thread-per-watcher
+model:
+
+- **RSS/watcher**: server resident-set growth divided by the cohort
+  (each watcher today is a parked OS thread + stack + socket buffers);
+- **wake-fanout µs**: wall time from one status-patch commit until every
+  watcher has read the event off its stream — the serialize-once ring
+  made the encode O(1), but delivery is still N wakeups + N write
+  syscalls, and this number is what an epoll reactor must beat;
+- **parked threads**: `GET /debug/watchers` census — after the fleet
+  drains, every watcher must be parked (lag 0, replay 0), i.e. the
+  server is holding N sleeping threads hostage.
+
+Gates (deterministic, not timing-based): every attached watcher is
+visible in the census, the census passes the parity-pinned schema check
+(`telemetry.timeline.check_watchers`), the whole fleet parks once
+drained, and the `kwok_watch_cursor_lag_events` histogram records every
+close (one observation per watch teardown, graceful or slow).
+
+**Exposition parity** — the `--lane-procs` contract from the MetricsBank
+merge: a 2-lane proc engine's `/metrics` must be family-and-label
+identical to the threaded 2-lane engine's (modulo the three
+documented proc-only families), with the per-shard
+`kwok_lane_stage_seconds{shard=}` families present AND moving — the
+hole this PR closes, proven here on real spawned lane processes.
+
+Run via `make census-check` (wired into hack/verify-all.sh). Skips
+cleanly when no C++ compiler is available (same contract as the parity
+twins); the parity arm still runs — it needs no native binary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import selectors
+import socket
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# families legitimately present only in the proc-lane exposition
+# (docs/observability.md "proc-only families"): the supervisor ledger,
+# the handoff timing, and the shm accounting have no threaded analogue
+PROC_ONLY_FAMILIES = frozenset({
+    "kwok_lane_proc_restarts_total",
+    "kwok_lane_handoff_seconds",
+    "kwok_shm_arena_bytes",
+})
+# process-global error families render only once nonzero, so their
+# presence is run-dependent on BOTH sides — excluded from the set
+# comparison (their merge correctness is pinned by the unit tests)
+PRESENCE_VARIES = frozenset({
+    "kwok_swallowed_errors_total",
+    "kwok_worker_crashes_total",
+    "kwok_worker_restarts_total",
+    "kwok_wire_rejects_total",
+    "kwok_faults_injected_total",
+})
+
+
+# ------------------------------------------------------------ census fleet
+
+class _CensusWatcher:
+    """One parked informer: connect, send the watch GET, de-chunk event
+    lines, count them. No re-list/reconnect machinery — the census wants
+    N steady attached streams, not survival choreography."""
+
+    def __init__(self, host: str, port: int, rv: int):
+        self.sock = socket.socket()
+        self.sock.setblocking(False)
+        self.sock.connect_ex((host, port))
+        self.req = (
+            f"GET /api/v1/pods?watch=true&resourceVersion={rv} "
+            f"HTTP/1.1\r\nHost: {host}\r\n\r\n"
+        ).encode()
+        self.state = "connecting"
+        self.buf = bytearray()
+        self.chunk_need: "int | None" = None
+        self.events = 0
+
+    def on_io(self, sel) -> None:
+        if self.state == "connecting":
+            if self.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR):
+                self.state = "error"
+                sel.unregister(self.sock)
+                return
+            self.sock.sendall(self.req)
+            self.state = "headers"
+            sel.modify(self.sock, selectors.EVENT_READ, self)
+            return
+        try:
+            data = self.sock.recv(1 << 16)
+        except BlockingIOError:
+            return
+        if not data:
+            self.state = "eof"
+            sel.unregister(self.sock)
+            return
+        self.buf += data
+        if self.state == "headers":
+            i = self.buf.find(b"\r\n\r\n")
+            if i < 0:
+                return
+            status = int(bytes(self.buf).split(b" ", 2)[1])
+            del self.buf[:i + 4]
+            self.state = "stream" if status == 200 else "error"
+            if self.state == "error":
+                sel.unregister(self.sock)
+                return
+        # de-chunk: one chunk per event line on both servers
+        while True:
+            if self.chunk_need is None:
+                i = self.buf.find(b"\r\n")
+                if i < 0:
+                    return
+                self.chunk_need = int(bytes(self.buf[:i]) or b"0", 16)
+                del self.buf[:i + 2]
+                if self.chunk_need == 0:
+                    self.state = "eof"
+                    sel.unregister(self.sock)
+                    return
+            if len(self.buf) < self.chunk_need + 2:
+                return
+            del self.buf[:self.chunk_need + 2]
+            self.chunk_need = None
+            self.events += 1
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _pump(sel, watchers, done, deadline_s: float) -> bool:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if done():
+            return True
+        for key, _ev in sel.select(0.05):
+            key.data.on_io(sel)
+    return done()
+
+
+def _census_point(n: int, events: int, a) -> dict:
+    """One sweep point: fresh native server, N watchers, fan-out timing,
+    census read, teardown accounting."""
+    from benchmarks.rig import NativeApiserver, scrape_metrics
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+
+    srv = NativeApiserver.spawn()
+    if srv is None:
+        raise RuntimeError("no C++ compiler for native apiserver")
+    out: dict = {"watchers": n}
+    sel = selectors.DefaultSelector()
+    fleet: list = []
+    client = HttpKubeClient(srv.url)
+    try:
+        client.create("nodes", {"apiVersion": "v1", "kind": "Node",
+                                "metadata": {"name": "cn0"}, "status": {}})
+        client.create("pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "census-pod", "namespace": "default"},
+            "spec": {"nodeName": "cn0",
+                     "containers": [{"name": "c", "image": "b"}]},
+            "status": {"phase": "Pending"},
+        })
+        lst = client._json("GET", srv.url + "/api/v1/pods?limit=1")
+        rv = int((lst.get("metadata") or {}).get("resourceVersion") or 0)
+        rss0 = srv.rss_bytes()
+        host, port = srv.url.split("//")[1].rsplit(":", 1)
+        for _ in range(n):
+            w = _CensusWatcher(host, int(port), rv)
+            sel.register(w.sock, selectors.EVENT_WRITE, w)
+            fleet.append(w)
+        ok_attach = _pump(
+            sel, fleet, lambda: all(w.state == "stream" for w in fleet),
+            a.timeout,
+        )
+        out["attached"] = sum(w.state == "stream" for w in fleet)
+        if not ok_attach:
+            raise RuntimeError(
+                f"only {out['attached']}/{n} watchers attached"
+            )
+        out["rss_per_watcher_bytes"] = round(
+            (srv.rss_bytes() - rss0) / n
+        )
+        # wake-fanout: commit one event, wait for ALL N streams to see it
+        fanout_s: list = []
+        for k in range(events):
+            want = k + 1
+            t0 = time.perf_counter()
+            client.patch_status("pods", "default", "census-pod",
+                                {"status": {"seq": str(k)}})
+            if not _pump(
+                sel, fleet,
+                lambda: all(w.events >= want for w in fleet), a.timeout,
+            ):
+                raise RuntimeError(f"fan-out of event {k} never completed")
+            fanout_s.append(time.perf_counter() - t0)
+        fanout_s.sort()
+        mean_s = sum(fanout_s) / len(fanout_s)
+        out["wake_fanout_us_mean"] = round(mean_s * 1e6, 1)
+        out["wake_fanout_us_p99"] = round(
+            fanout_s[max(0, int(len(fanout_s) * 0.99) - 1)] * 1e6, 1
+        )
+        out["wake_fanout_us_per_watcher"] = round(mean_s * 1e6 / n, 3)
+        # the census: every stream visible, fully drained fleet -> parked
+        doc = client._json("GET", srv.url + "/debug/watchers")
+        from kwok_tpu.telemetry.timeline import check_watchers
+
+        check_watchers(doc)
+        out["census_count"] = doc["count"]
+        out["parked_threads"] = doc["parked_threads"]
+        out["census_ok"] = (
+            doc["server"] == "native"
+            and doc["count"] == n
+            and doc["parked_threads"] == n
+        )
+        out["rss_total_bytes"] = srv.rss_bytes()
+    finally:
+        for w in fleet:
+            w.close()
+        sel.close()
+        # closed sockets surface on the server's next write: keep
+        # patching until every watch is torn down (each close records
+        # one kwok_watch_cursor_lag_events observation)
+        try:
+            for _ in range(80):
+                doc = client._json("GET", srv.url + "/debug/watchers")
+                if doc["count"] == 0:
+                    break
+                client.patch_status("pods", "default", "census-pod",
+                                    {"status": {"seq": "teardown"}})
+                time.sleep(0.05)
+            m = scrape_metrics(srv.url + "/metrics")
+            out["lag_hist_count"] = m.get(
+                "kwok_watch_cursor_lag_events_count", 0.0
+            )
+            out["lag_hist_ok"] = out.get("lag_hist_count", 0) >= n
+        except Exception:
+            out["lag_hist_ok"] = False
+        client.close()
+        srv.stop()
+    return out
+
+
+# ------------------------------------------------------ exposition parity
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})? ")
+
+
+def families(text: str) -> dict:
+    """``family -> {"type": t, "label_keys": sorted-list}`` of a
+    Prometheus exposition; histogram series collapse onto their family
+    (``le`` excluded)."""
+    types: dict = {}
+    out: dict = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, t = line.split(" ", 3)
+            types[name] = t
+            continue
+        if line.startswith("#") or not line.strip():
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels = m.group(1), m.group(3) or ""
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                fam = base
+                break
+        keys = {
+            kv.split("=", 1)[0].strip()
+            for kv in labels.split(",") if "=" in kv
+        } - {"le"}
+        f = out.setdefault(
+            fam, {"type": types.get(fam, ""), "label_keys": set()}
+        )
+        f["label_keys"] |= keys
+    for f in out.values():
+        f["label_keys"] = sorted(f["label_keys"])
+    return out
+
+
+def _run_engine_arm(lane_procs: bool, a) -> str:
+    """Converge a small workload on a 2-lane engine (threaded or proc)
+    against the HTTP mock, return the full /metrics exposition."""
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import FakeKube, HttpFakeApiserver
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+    from kwok_tpu.kwok.server import render_metrics
+
+    srv = HttpFakeApiserver(store=FakeKube()).start()
+    eng = None
+    try:
+        eng = ClusterEngine(
+            HttpKubeClient(f"http://127.0.0.1:{srv.port}"),
+            EngineConfig(manage_all_nodes=True, tick_interval=0.05,
+                         drain_shards=2, lane_procs=lane_procs,
+                         initial_capacity=2048),
+        )
+        eng.start()
+        deadline = time.time() + a.timeout
+        while time.time() < deadline and not eng.ready:
+            time.sleep(0.1)
+        if not eng.ready:
+            raise RuntimeError("engine startup gate never closed")
+        store = srv.store
+        store.create("nodes", {"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": "xp-n0"}, "status": {}})
+        names = [f"xp-p{i}" for i in range(8)]
+        for nm in names:
+            store.create("pods", {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": nm, "namespace": "default"},
+                "spec": {"nodeName": "xp-n0",
+                         "containers": [{"name": "c", "image": "b"}]},
+                "status": {"phase": "Pending"},
+            })
+
+        def converged() -> bool:
+            return all(
+                (store.get("pods", "default", nm) or {})
+                .get("status", {}).get("phase") == "Running"
+                for nm in names
+            )
+
+        while time.time() < deadline and not converged():
+            time.sleep(0.2)
+        if not converged():
+            raise RuntimeError("workload never converged")
+
+        def lanes_moving() -> bool:
+            text = eng.metrics_text()
+            return all(
+                re.search(
+                    r'kwok_lane_stage_seconds_count\{shard="%d",'
+                    r'stage="drain"\} ([1-9]\d*)' % i, text,
+                )
+                for i in range(2)
+            )
+
+        # proc lanes publish their registry on a ~1s cadence: wait for
+        # every shard's drain histogram to actually move before the
+        # scrape (the "values honest" half of the parity proof)
+        while time.time() < deadline and not lanes_moving():
+            time.sleep(0.2)
+        if not lanes_moving():
+            raise RuntimeError("per-shard lane families never moved")
+        return render_metrics(eng)
+    finally:
+        if eng is not None:
+            eng.stop()
+        srv.stop()
+
+
+def exposition_parity(a) -> dict:
+    threaded = _run_engine_arm(lane_procs=False, a=a)
+    proc = _run_engine_arm(lane_procs=True, a=a)
+    tf, pf = families(threaded), families(proc)
+    tset = set(tf) - PRESENCE_VARIES
+    pset = set(pf) - PRESENCE_VARIES
+    missing = sorted(tset - pset)
+    extras = sorted(pset - tset)
+    mismatched = sorted(
+        name for name in tset & pset
+        if tf[name] != pf[name]
+    )
+    shard_series = sorted(
+        m.group(0) for m in re.finditer(
+            r'kwok_lane_stage_seconds_count\{shard="\d+",stage="\w+"\}',
+            proc,
+        )
+    )
+    return {
+        "threaded_families": len(tf),
+        "proc_families": len(pf),
+        "missing_in_proc": missing,
+        "proc_only": extras,
+        "type_or_label_mismatches": mismatched,
+        "proc_shard_series": shard_series,
+        "ok": (
+            not missing
+            and not mismatched
+            and set(extras) <= set(PROC_ONLY_FAMILIES)
+            and len(shard_series) == 4  # 2 shards x (drain, emit)
+        ),
+    }
+
+
+# ------------------------------------------------------------------ rider
+
+def rider(watchers: int = 100, events: int = 10) -> dict:
+    """Small census summary for bench.py's ``watchplane`` BENCH rider:
+    one sweep point (RSS/watcher, wake-fanout µs, parked threads) so the
+    thread-per-watcher cost trajectory rides every BENCH json. No parity
+    arm (that's census-check's job — it spawns real lane processes)."""
+    a = argparse.Namespace(timeout=60.0)
+    try:
+        pt = _census_point(watchers, events, a)
+    except RuntimeError as e:
+        if "no C++ compiler" in str(e):
+            return {"skipped": "no C++ compiler for native apiserver"}
+        raise
+    return {
+        "watchers": pt["watchers"],
+        "rss_per_watcher_bytes": pt["rss_per_watcher_bytes"],
+        "wake_fanout_us_mean": pt["wake_fanout_us_mean"],
+        "wake_fanout_us_per_watcher": pt["wake_fanout_us_per_watcher"],
+        "parked_threads": pt["parked_threads"],
+        "census_ok": pt["census_ok"],
+    }
+
+
+# ----------------------------------------------------------------- main
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--sweep", default="200,400,700,1000",
+                   help="comma-separated watcher cohort sizes")
+    p.add_argument("--events", type=int, default=20,
+                   help="fan-out timing events per sweep point")
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--skip-parity", action="store_true",
+                   help="census sweep only (skip the engine parity arms)")
+    p.add_argument("--out",
+                   default=os.path.join(REPO, "WATCHPLANE_r01.json"))
+    p.add_argument("--check", action="store_true",
+                   help="CI gate: exit 1 on any failed gate")
+    a = p.parse_args()
+
+    from kwok_tpu import native
+
+    if native.apiserver_binary() is None:
+        # same skip contract as the parity twins: no C++ compiler means
+        # no native apiserver to census
+        print(json.dumps({
+            "ok": True, "skipped": "no C++ compiler for native apiserver",
+        }))
+        return 0
+
+    sweep = [int(s) for s in a.sweep.split(",") if s.strip()]
+    points = []
+    for n in sweep:
+        pt = _census_point(n, a.events, a)
+        points.append(pt)
+        print(json.dumps({"point": pt}), flush=True)
+    parity = (
+        {"ok": True, "skipped": True} if a.skip_parity
+        else exposition_parity(a)
+    )
+    gates = {
+        "all_watchers_visible": all(
+            pt.get("census_ok") for pt in points
+        ),
+        "fleet_parks_when_drained": all(
+            pt.get("parked_threads") == pt.get("watchers") for pt in points
+        ),
+        "lag_histogram_counts_closes": all(
+            pt.get("lag_hist_ok") for pt in points
+        ),
+        "exposition_parity": bool(parity.get("ok")),
+    }
+    ok = all(gates.values())
+    artifact = {
+        "bench": "watchplane_census",
+        "params": {"sweep": sweep, "events": a.events,
+                   "check": a.check, "skip_parity": a.skip_parity},
+        "gates": gates,
+        "ok": ok,
+        "points": points,
+        "exposition_parity": parity,
+    }
+    with open(a.out, "w", encoding="utf-8") as fh:
+        json.dump(artifact, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"ok": ok, "gates": gates, "out": a.out}))
+    if not ok:
+        failed = [k for k, v in gates.items() if not v]
+        print(f"watchplane_census: FAILED gates: {failed}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,9 @@ make proc-check
 echo ">> fleet-check (watcher-fleet survival gate: overload admission + slow-watcher eviction)"
 make fleet-check
 
+echo ">> census-check (watch-plane census sweep + proc/threaded exposition parity)"
+make census-check
+
 echo ">> drift-check (hostile-wire convergence + anti-entropy drift-repair gate)"
 make drift-check
 

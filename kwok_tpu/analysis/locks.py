@@ -82,6 +82,13 @@ LOCK_ORDER: dict[str, int] = {
     # lock-free (SPSC: int64 cursor stores are atomic, descriptors ride
     # the pipe). Nothing is ever acquired under it.
     "_proc_lock": 84,
+    # MetricsBank fold/merge (ISSUE 16): guards only the retired-counter
+    # baseline fold + freshest-lane-snapshot dict when a lane exits and
+    # when the parent's /metrics scrape merges — shm seqlock reads and
+    # plain dict folds run inside, so a concurrent scrape can never
+    # double-count a dying lane's final snapshot. Nothing is ever
+    # acquired under it (registry merge happens on a detached copy).
+    "_mbank_lock": 84,
     "_lock": 85,        # single-resource leaves (ippool, registry, ...)
     "_apiserver_lock": 85,
     # mock-apiserver sharded store (ISSUE 13), outermost-first:

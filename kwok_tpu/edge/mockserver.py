@@ -32,6 +32,7 @@ from typing import Iterator
 
 from kwok_tpu.telemetry.apiserver_metrics import (
     ApiserverTiming,
+    LagHist,
     render_apiserver_metrics,
     render_timing_metrics,
 )
@@ -161,6 +162,8 @@ class _Watch:
         #: facade closes the connection abruptly instead of letting a
         #: consumer that stopped reading pin unbounded memory
         self.terminated: "str | None" = None
+        #: wall stamp of registration — GET /debug/watchers age_s
+        self.created_unix = time.time()
         self.q = _CompatQueue(self)
 
     def _matches(self, obj: dict) -> bool:
@@ -466,6 +469,9 @@ class FakeKube:
         # ring lock (a registry child lock here would nest two leaves);
         # /metrics renders them via telemetry.apiserver_metrics
         self.watch_terminations = {"slow": 0, "deadline": 0}
+        # kwok_watch_cursor_lag_events: final ring-cursor lag per watch
+        # close (ISSUE 16's census surface); same ring-lock discipline
+        self.lag_hist = LagHist()
         # phase timing + flight recorder (ISSUE 11); clock stamps gated
         # by KWOK_TPU_APISERVER_TIMING, counters (fanout pushes, lag
         # peak) always on — plain ints under the GIL like the rest
@@ -623,6 +629,10 @@ class FakeKube:
         if w.stopped:
             return
         w.stopped = True
+        # census: the stream's FINAL lag, observed before any cursor jump
+        # (a slow close records the overflow that killed it, a graceful
+        # close the tail it still had to drain)
+        self.lag_hist.observe(max(0, self._ring_next - w.cursor))
         if terminated:
             w.terminated = terminated
             w.cursor = self._ring_next
@@ -662,6 +672,49 @@ class FakeKube:
                 for w in self._watches if not w.stopped
             ]
             return lags, self.timing.backlog_peak, self.encode_total
+
+    def watchers_doc(self, server: str = "mock") -> dict:
+        """The ``GET /debug/watchers`` census (ISSUE 16): one consistent
+        ring-lock read of every live watch — ring-cursor lag, private
+        replay backlog, age, band, and the deterministic termination-risk
+        class (none / lagging / at_risk against the backlog cap). Schema
+        parity-pinned against apiserver.cc via
+        kwok_tpu.telemetry.timeline.check_watchers."""
+        now = time.time()
+        cap = self.watch_backlog
+        with self._ring_lock:
+            watchers = []
+            parked = 0
+            for w in self._watches:
+                if w.stopped:
+                    continue
+                lag = max(0, self._ring_next - w.cursor)
+                replay = len(w.replay)
+                if lag == 0 and replay == 0:
+                    # fully drained: its delivery thread is parked in the
+                    # ring condition wait — the per-watcher thread cost
+                    # the C10k reactor rewrite exists to erase
+                    parked += 1
+                risk = (
+                    "none" if lag == 0
+                    else ("lagging" if lag <= cap // 2 else "at_risk")
+                )
+                watchers.append({
+                    "kind": w.kind,
+                    "lag_events": lag,
+                    "replay_pending": replay,
+                    "age_s": round(max(0.0, now - w.created_unix), 3),
+                    "band": "none",  # watches are max-inflight exempt
+                    "risk": risk,
+                })
+        return {
+            "server": server,
+            "backlog_cap": cap,
+            "thread_per_watcher": True,
+            "count": len(watchers),
+            "parked_threads": parked,
+            "watchers": watchers,
+        }
 
     def compact(self) -> int:
         """Force watch-cache compaction NOW: any watch resuming from a
@@ -2340,7 +2393,9 @@ class HttpFakeApiserver:
                         adm.inflight if adm else {},
                         adm.rejected if adm else {},
                         store.watch_terminations,
-                    ) + render_timing_metrics(timing, lags, encodes)
+                    ) + render_timing_metrics(
+                        timing, lags, encodes, lag_hist=store.lag_hist
+                    )
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
@@ -2355,6 +2410,19 @@ class HttpFakeApiserver:
                     # engine auto-grabs it on a /readyz degradation edge
                     body = json.dumps(
                         timing.flight_doc("mock"), separators=(",", ":")
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if parsed.path == "/debug/watchers":
+                    # watch-plane census (anonymous, like /debug/flight):
+                    # per-watcher ring-cursor lag, replay backlog, age,
+                    # and termination risk — the C10k before-photo
+                    body = json.dumps(
+                        store.watchers_doc("mock"), separators=(",", ":")
                     ).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

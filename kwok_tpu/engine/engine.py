@@ -783,8 +783,26 @@ class ClusterEngine:
         return self.telemetry.legacy_dict()
 
     def metrics_text(self) -> str:
-        """Prometheus text exposition of the full labeled registry."""
+        """Prometheus text exposition of the full labeled registry. With
+        process lanes on, every lane child's shm telemetry snapshot is
+        merged in (shard-labeled lane families + aggregated engine
+        families), so `/metrics` stays one pane of glass — family-and-
+        label identical to the threaded exposition."""
+        if self._proc is not None:
+            return self._proc.merged_metrics_text()
         return self.telemetry.registry.render()
+
+    def process_metrics_text(self) -> str:
+        """The process-global error/fault exposition block. With process
+        lanes on, lane children's swallowed-error / wire-reject / fault
+        counters aggregate into the parent's share instead of silently
+        vanishing; otherwise the in-process registry renders as-is
+        (empty string when nothing has moved)."""
+        if self._proc is not None:
+            return self._proc.merged_process_text()
+        from kwok_tpu.telemetry.errors import render_nonempty
+
+        return render_nonempty()
 
     def trace_chrome(self) -> dict:
         """The span ring as a Chrome trace-event document."""

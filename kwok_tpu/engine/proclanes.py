@@ -55,16 +55,23 @@ from __future__ import annotations
 
 import dataclasses
 import http.client
+import json
 import logging
 import os
 import pickle
 import queue
+import signal
 import threading
 import time
 
 from kwok_tpu.engine import shm as shm_mod
 from kwok_tpu.engine.rowpool import shard_of
-from kwok_tpu.telemetry.errors import swallowed, worker_crashed, worker_restarted
+from kwok_tpu.telemetry.errors import (
+    PROCESS_REGISTRY,
+    swallowed,
+    worker_crashed,
+    worker_restarted,
+)
 from kwok_tpu.workers import spawn_worker
 
 logger = logging.getLogger("kwok_tpu.proclanes")
@@ -75,6 +82,13 @@ _KINDS = ("nodes", "pods")
 _RING_BYTES = int(os.environ.get("KWOK_TPU_SHM_RING_BYTES", str(4 << 20)))
 #: per-lane emit crash-replay slot size (bytes)
 _SLOT_BYTES = int(os.environ.get("KWOK_TPU_SHM_SLOT_BYTES", str(1 << 20)))
+#: per-lane telemetry-snapshot slab size (bytes); a whole registry
+#: snapshot is ~20KB JSON, so 1MB never truncates in practice
+_METRICS_BYTES = int(
+    os.environ.get("KWOK_TPU_SHM_METRICS_BYTES", str(1 << 20))
+)
+#: status-loop beats (50ms each) between telemetry-snapshot publishes
+_METRICS_EVERY_BEATS = 20
 #: seconds the router waits on a full ring before dropping the window
 #: for that lane (a dead/stalled child; the respawn resync re-delivers)
 _RING_STALL_S = 5.0
@@ -246,7 +260,10 @@ def _make_lane_engine(spec: dict):
         use_mesh=False,
         initial_capacity=spec["capacity"],
         profile_dir="",
-        trace_dump="",
+        # per-lane span-ring dump (ISSUE 16): the child owns its tick, so
+        # engine.stop() writes <parent dump>.lane<i>.json on STOP/SIGTERM;
+        # timeline.py --lane-dump merges them wall-aligned as pid 2+i
+        trace_dump=spec.get("trace_dump", ""),
         faults="off",        # ONE plane, the parent's (ingest + SIGKILL)
         audit_interval=-1.0,  # ONE auditor surface, refused under procs
         ha_role="",
@@ -276,6 +293,9 @@ def lane_proc_main(spec: dict, conn) -> None:
     ring = shm_mod.RawRing(spec["ring"])
     slot = shm_mod.InflightSlot(spec["slot"])
     bank = shm_mod.StatusBank(spec["bank"])
+    mbank = (
+        shm_mod.MetricsBank(spec["metrics"]) if spec.get("metrics") else None
+    )
     row = bank.row(spec["index"])
     row[shm_mod.BANK_PID] = os.getpid()
     row[shm_mod.BANK_ALIVE_NS] = time.monotonic_ns()
@@ -285,7 +305,24 @@ def lane_proc_main(spec: dict, conn) -> None:
     applied = 0
     stop_status = threading.Event()
 
+    def publish_metrics() -> None:
+        """Serialize the lane's WHOLE registry (plus this process's
+        error/fault counters) into the seqlock slab the parent merges —
+        the 12 StatusBank int64s stop being the only telemetry that
+        crosses the process boundary (ISSUE 16)."""
+        if mbank is None:
+            return
+        try:
+            doc = {
+                "engine": e.telemetry.registry.snapshot(),
+                "process": PROCESS_REGISTRY.snapshot(),
+            }
+            mbank.write(json.dumps(doc).encode())
+        except Exception:
+            swallowed("proclanes.metrics_publish")
+
     def status_loop() -> None:
+        beats = 0
         while not stop_status.wait(0.05):
             row[shm_mod.BANK_ALIVE_NS] = time.monotonic_ns()
             row[shm_mod.BANK_READY] = int(e.ready)
@@ -302,8 +339,18 @@ def lane_proc_main(spec: dict, conn) -> None:
             row[shm_mod.BANK_INTEG_NODES] = integ["nodes"]
             row[shm_mod.BANK_INTEG_PODS] = integ["pods"]
             row[shm_mod.BANK_REWIND] = integ["rewind"]
+            beats += 1
+            if beats % _METRICS_EVERY_BEATS == 0:
+                publish_metrics()
 
-    spawn_worker(status_loop, name="kwok-lane-status")
+    status_thread = spawn_worker(status_loop, name="kwok-lane-status")
+
+    def _on_sigterm(signum, frame):
+        # graceful external stop: unwind through finally so engine.stop()
+        # dumps the lane's span ring (the cross-process trace contract)
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     rc = 0
     try:
         while True:
@@ -332,6 +379,8 @@ def lane_proc_main(spec: dict, conn) -> None:
             else:
                 logger.warning("lane %d: unknown descriptor %r",
                                spec["index"], op)
+    except SystemExit:
+        logger.info("lane %d: SIGTERM, stopping", spec["index"])
     except BaseException:
         logger.exception("lane %d: reader failed", spec["index"])
         rc = 1
@@ -342,6 +391,12 @@ def lane_proc_main(spec: dict, conn) -> None:
         except Exception:
             logger.exception("lane %d: stop failed", spec["index"])
             rc = rc or 1
+        # the final snapshot: a STOPped lane's last counters survive in
+        # the slab for the parent's retired-lane fold. The status thread
+        # is joined first — the slab is single-writer by contract.
+        if status_thread is not None:
+            status_thread.join(timeout=2.0)
+        publish_metrics()
         try:
             conn.close()
         except Exception:
@@ -349,6 +404,8 @@ def lane_proc_main(spec: dict, conn) -> None:
         ring.close()
         slot.close()
         bank.close()
+        if mbank is not None:
+            mbank.close()
     os._exit(rc)  # skip atexit: jax/absl handlers hang a daemonized child
 
 
@@ -360,10 +417,13 @@ class ProcLane:
     slot, descriptor pipe, and the live Process object."""
 
     def __init__(self, index: int, ring: shm_mod.RawRing,
-                 slot: shm_mod.InflightSlot):
+                 slot: shm_mod.InflightSlot,
+                 mbank: "shm_mod.MetricsBank | None" = None):
         self.index = index
         self.ring = ring
         self.slot = slot
+        self.mbank = mbank     # telemetry-snapshot slab (ISSUE 16)
+        self.retired = None    # dead incarnations' folded final snapshots
         self.proc = None
         self.conn = None
         self.dead = False      # budget exhausted: no more respawns
@@ -431,6 +491,12 @@ class ProcLaneSet:
         # lock, never held across blocking work (spawn/join/IO happen
         # outside it) — kwoklint table: _proc_lock @ 84
         self._proc_lock = threading.Lock()
+        # serializes MetricsBank read/reset against the respawn fold so a
+        # scrape can never see one lane's final counters BOTH live in the
+        # slab and folded into the retired accumulator (a transient
+        # double-count would break counter monotonicity); leaf lock,
+        # shm reads + dict folds only — kwoklint table: _mbank_lock @ 84
+        self._mbank_lock = threading.Lock()
         r = parent.telemetry.registry
         self._m_restarts = r.counter(
             "kwok_lane_proc_restarts_total",
@@ -448,9 +514,24 @@ class ProcLaneSet:
             "kwok_shm_arena_bytes",
             "Bytes of shared memory mapped per arena pool (ring = raw "
             "event handoff, slot = emit crash-replay, status = lane "
-            "status bank). 0 when process lanes are off.",
+            "status bank, metrics = per-lane telemetry-snapshot slabs). "
+            "0 when process lanes are off.",
             ("pool",),
         )
+        # the router IS the native pre-partitioned parse consumer in proc
+        # mode, so it owns the per-shard routed-event counter the
+        # threaded LaneSet exposes — pre-created per shard so the merged
+        # exposition carries the family from the first scrape
+        from kwok_tpu.telemetry.engine_metrics import _HELP as _ENGINE_HELP
+
+        routed_fam = r.counter(
+            "kwok_route_partition_events_total",
+            _ENGINE_HELP["kwok_route_partition_events_total"],
+            ("shard",),
+        )
+        self._m_routed = [
+            routed_fam.labels(shard=str(i)) for i in range(self.n)
+        ]
 
     # ------------------------------------------------------------ lifecycle
 
@@ -474,12 +555,17 @@ class ProcLaneSet:
                 shm_mod.arena_name(f"slot{i}-{tag}"), _SLOT_BYTES,
                 create=True,
             )
-            self.lanes.append(ProcLane(i, ring, slot))
+            mbank = shm_mod.MetricsBank(
+                shm_mod.arena_name(f"metrics{i}-{tag}"), _METRICS_BYTES,
+                create=True,
+            )
+            self.lanes.append(ProcLane(i, ring, slot, mbank))
         self._m_arena.labels(pool="ring").set(_RING_BYTES * self.n)
         self._m_arena.labels(pool="slot").set(_SLOT_BYTES * self.n)
         self._m_arena.labels(pool="status").set(
             self.n * shm_mod.BANK_FIELDS * 8
         )
+        self._m_arena.labels(pool="metrics").set(_METRICS_BYTES * self.n)
         for lane in self.lanes:
             self._spawn_lane(lane)
         faults = self.parent._faults
@@ -488,6 +574,9 @@ class ProcLaneSet:
                 faults.register_proc_target(lane.name, lane.sigkill)
 
     def _lane_spec(self, lane: ProcLane) -> dict:
+        trace_base = self.parent.config.trace_dump or os.environ.get(
+            "KWOK_TPU_TRACE", ""
+        )
         return {
             "index": lane.index,
             "n": self.n,
@@ -500,6 +589,13 @@ class ProcLaneSet:
             "ring": lane.ring.name,
             "slot": lane.slot.name,
             "bank": self.bank.name,
+            "metrics": lane.mbank.name if lane.mbank is not None else "",
+            # distinct per-lane path: parent and children each own a file
+            # (a shared KWOK_TPU_TRACE would otherwise have every process
+            # clobber the same dump at stop)
+            "trace_dump": (
+                f"{trace_base}.lane{lane.index}" if trace_base else ""
+            ),
         }
 
     def _spawn_lane(self, lane: ProcLane) -> None:
@@ -580,10 +676,17 @@ class ProcLaneSet:
                 lane.conn = None
             lane.ring.close(unlink=True)
             lane.slot.close(unlink=True)
+            if lane.mbank is not None:
+                # the stopped child's final snapshot outlives the arena:
+                # folded into the retired accumulator so post-stop reads
+                # (tests, a last scrape) keep the full tally
+                self._fold_lane_final(lane)
+                lane.mbank.close(unlink=True)
+                lane.mbank = None
         if self.bank is not None:
             self.bank.close(unlink=True)
             self.bank = None
-        for pool in ("ring", "slot", "status"):
+        for pool in ("ring", "slot", "status", "metrics"):
             self._m_arena.labels(pool=pool).set(0)
 
     # --------------------------------------------------------------- router
@@ -699,6 +802,7 @@ class ProcLaneSet:
             for lane in self.lanes:
                 self._flush_buf(lane, kind)
                 self._ship(lane, kind, parts)
+                self._m_routed[lane.index].inc(len(parts))
             self.events_routed += len(parts)
         else:
             lane_off = batch.lane_off
@@ -712,6 +816,7 @@ class ProcLaneSet:
                 parts = [lines[i] for i in lane_idx[lo:hi].tolist()]
                 self._flush_buf(lane, kind)
                 self._ship(lane, kind, parts)
+                self._m_routed[li].inc(len(parts))
                 routed += len(parts)
             self.events_routed += routed
         self.parent.telemetry.observe_route_batch(
@@ -937,6 +1042,10 @@ class ProcLaneSet:
         lane.ring.reset()
         if self.bank is not None:
             self.bank.rows[lane.index, shm_mod.BANK_ALIVE_NS] = 0
+        # 2b. fold the dead incarnation's last telemetry snapshot into
+        #     the retired accumulator (and empty the slab) so the merged
+        #     counters stay monotonic while the fresh child restarts at 0
+        self._fold_lane_final(lane)
         old_conn = lane.conn
         if old_conn is not None:
             try:
@@ -1081,6 +1190,97 @@ class ProcLaneSet:
                         parent.resync_streams()
 
     # ------------------------------------------------------------- readouts
+
+    def _lane_doc(self, lane: ProcLane) -> "dict | None":
+        """One consistent telemetry snapshot off a lane's seqlock slab
+        (None before the child's first publish)."""
+        if lane.mbank is None:
+            return None
+        raw = lane.mbank.read()
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def _fold_lane_final(self, lane: ProcLane) -> None:
+        """Fold a dying/stopped incarnation's last snapshot into the
+        lane's retired accumulator and empty the slab — under
+        ``_mbank_lock`` so a concurrent scrape can never count the same
+        final snapshot twice (or see it vanish mid-fold)."""
+        from kwok_tpu.telemetry.registry import fold_snapshot
+
+        with self._mbank_lock:
+            doc = self._lane_doc(lane)
+            if doc is None:
+                return
+            if lane.mbank is not None:
+                lane.mbank.reset()
+            acc = lane.retired or {}
+            for part in ("engine", "process"):
+                snap = doc.get(part)
+                if snap:
+                    acc[part] = fold_snapshot(acc.get(part), snap)
+            lane.retired = acc
+
+    def merged_metrics_text(self) -> str:
+        """The proc-lane `/metrics` body: the parent registry plus every
+        lane's shm snapshot merged into ONE scratch registry (one TYPE
+        declaration per family — the strict exposition oracle's
+        contract), lane stage/queue families label-split per shard, and
+        retired incarnations keeping aggregate counters monotonic."""
+        from kwok_tpu.telemetry.engine_metrics import merge_proc_lane_metrics
+
+        live: dict = {}
+        retired: dict = {}
+        with self._mbank_lock:
+            for lane in self.lanes:
+                doc = self._lane_doc(lane)
+                if doc and doc.get("engine"):
+                    live[lane.index] = doc["engine"]
+                if lane.retired and lane.retired.get("engine"):
+                    retired[lane.index] = lane.retired["engine"]
+        depths: dict = {}
+        rows = self.bank.rows if self.bank is not None else None
+        if rows is not None:
+            for lane in self.lanes:
+                depths[lane.index] = int(
+                    rows[lane.index, shm_mod.BANK_QDEPTH]
+                )
+        reg = merge_proc_lane_metrics(
+            self.parent.telemetry.registry.snapshot(),
+            live, retired, self.n, queue_depths=depths,
+        )
+        return reg.render()
+
+    def merged_process_text(self) -> str:
+        """The process-global error/fault block with every lane's share
+        aggregated in (kwok_swallowed_errors_total, kwok_wire_rejects_
+        total, kwok_faults_injected_total, worker crash/restart ledgers)
+        — one registry render, so each family keeps a single TYPE line."""
+        from kwok_tpu.telemetry.registry import (
+            family_from_doc,
+            merge_child,
+            registry_from_snapshot,
+        )
+
+        reg = registry_from_snapshot(PROCESS_REGISTRY.snapshot())
+        with self._mbank_lock:
+            docs = []
+            for lane in self.lanes:
+                doc = self._lane_doc(lane)
+                if doc and doc.get("process"):
+                    docs.append(doc["process"])
+                if lane.retired and lane.retired.get("process"):
+                    docs.append(lane.retired["process"])
+        for snap in docs:
+            for name, doc in sorted(snap.items()):
+                fam = family_from_doc(reg, name, doc)
+                for values, v in doc.get("children", ()):
+                    merge_child(fam, values, v)
+        text = reg.render()
+        return "" if not text.strip() else text
 
     def status(self) -> list[dict]:
         """Per-lane status rows (tests, tooling, the proc-check gate)."""

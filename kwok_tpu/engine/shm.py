@@ -37,6 +37,7 @@ arena down with it; the gate in benchmarks/proc_soak.py proves
 from __future__ import annotations
 
 import logging
+import time
 import uuid
 from multiprocessing import shared_memory
 
@@ -248,4 +249,72 @@ class StatusBank:
 
     def close(self, unlink: bool = False) -> None:
         self.rows = None
+        self.arena.close(unlink=unlink)
+
+
+class MetricsBank:
+    """Per-lane telemetry-snapshot slab (ISSUE 16): the child serializes
+    its whole metrics registry into shared memory; the parent merges the
+    snapshots into one `/metrics` exposition.
+
+    Header: [0]=seq (a seqlock stamp: odd while the child is mid-write,
+    even once the slab is consistent), [1]=payload length. Single writer
+    (the lane child), any number of readers (the parent's scrape). The
+    writer bumps seq to odd BEFORE touching payload/length and to even
+    after, so a reader that observes an odd or changed seq retries
+    instead of parsing half a slab; publication needs no lock and the
+    scrape path costs the child nothing.
+    """
+
+    SEQ, LEN = 0, 1
+
+    def __init__(self, name: str, size: int = 0, create: bool = False):
+        self.arena = Arena(name, size + _HDR_BYTES if create else 0, create)
+        self.cap = self.arena.size - _HDR_BYTES
+        self.name = name
+
+    def write(self, payload: bytes) -> bool:
+        """Publish one snapshot; False when it exceeds the slab (the
+        reader keeps the previous consistent snapshot)."""
+        if len(payload) > self.cap:
+            return False
+        hdr = self.arena.hdr
+        seq = int(hdr[self.SEQ])
+        if seq % 2:  # a crashed writer left the slab mid-write: restamp
+            seq += 1
+        hdr[self.SEQ] = seq + 1  # odd: readers back off
+        self.arena.payload[: len(payload)] = payload
+        hdr[self.LEN] = len(payload)
+        hdr[self.SEQ] = seq + 2  # even: consistent again
+        return True
+
+    def reset(self) -> None:
+        """Respawn path: empty the slab (back to the never-published
+        state) so the parent cannot re-read a dead incarnation's
+        snapshot once it has been folded into the retired accumulator."""
+        hdr = self.arena.hdr
+        hdr[self.LEN] = 0
+        hdr[self.SEQ] = 0
+
+    def read(self, retries: int = 8) -> bytes | None:
+        """One consistent snapshot, or None if the slab is empty or the
+        writer kept it torn for the whole (bounded) retry window."""
+        hdr = self.arena.hdr
+        for attempt in range(retries):
+            seq0 = int(hdr[self.SEQ])
+            if seq0 == 0:  # nothing published yet
+                return None
+            if seq0 % 2:  # writer mid-update: back off briefly, retry
+                if attempt:
+                    time.sleep(0.0002)
+                continue
+            n = int(hdr[self.LEN])
+            if not 0 <= n <= self.cap:
+                continue
+            out = bytes(self.arena.payload[:n])
+            if int(hdr[self.SEQ]) == seq0:
+                return out
+        return None
+
+    def close(self, unlink: bool = False) -> None:
         self.arena.close(unlink=unlink)

@@ -42,12 +42,18 @@ _METRIC_HELP = {
 }
 
 
-def _errors_block() -> str:
+def _errors_block(engine=None) -> str:
     """Error-accounting families (swallowed-exception and worker-crash
     counters, telemetry/errors.py): process-global state no engine
     registry owns. Labeled samples, so appended ONLY to the labeled
     (registry) exposition path — the legacy flat path stays label-free
-    by contract (its strict grammar oracle has no label parser)."""
+    by contract (its strict grammar oracle has no label parser). An
+    engine that aggregates across worker processes (``--lane-procs``)
+    supplies ``process_metrics_text`` and its fleet-wide totals win over
+    this process's own share."""
+    fleet_fn = getattr(engine, "process_metrics_text", None)
+    if callable(fleet_fn):
+        return fleet_fn()
     from kwok_tpu.telemetry import errors as telemetry_errors
 
     return telemetry_errors.render_nonempty()
@@ -80,7 +86,7 @@ def render_metrics(metrics) -> str:
     output also passes the strict-parser oracle."""
     text_fn = getattr(metrics, "metrics_text", None)
     if callable(text_fn):
-        return text_fn() + _errors_block() + _process_block()
+        return text_fn() + _errors_block(metrics) + _process_block()
     metrics = dict(getattr(metrics, "metrics", metrics))
     lines = []
     for name, value in sorted(metrics.items()):

@@ -929,6 +929,31 @@ static void flight_record(FlightRec rec) {
   g_flight.push_back(std::move(rec));
 }
 
+// kwok_watch_cursor_lag_events (ISSUE 16): final ring-cursor lag per
+// watch close — the census histogram the C10k reactor rewrite is graded
+// against. Bucket bounds/label bytes mirror telemetry/apiserver_metrics
+// LAG_EVENT_BUCKETS; observed under the store's ring_mu (relaxed atomics
+// so the /metrics render needs no lock).
+static const int N_LBUCKETS = 13;
+static const long LBUCKET_EV[N_LBUCKETS] = {1,   2,   4,   8,    16,   32,
+                                            64,  128, 256, 512,  1024, 2048,
+                                            4096};
+static const char* LBUCKET_LE[N_LBUCKETS] = {
+    "1",   "2",   "4",   "8",    "16",   "32",  "64",
+    "128", "256", "512", "1024", "2048", "4096"};
+static std::atomic<uint64_t> g_lag_buckets[N_LBUCKETS + 1] = {};
+static std::atomic<uint64_t> g_lag_sum{0};
+static std::atomic<uint64_t> g_lag_count{0};
+
+static void lag_observe(long events) {
+  if (events < 0) events = 0;
+  int i = 0;
+  while (i < N_LBUCKETS && events > LBUCKET_EV[i]) i++;  // le inclusive
+  g_lag_buckets[i].fetch_add(1, std::memory_order_relaxed);
+  g_lag_sum.fetch_add((uint64_t)events, std::memory_order_relaxed);
+  g_lag_count.fetch_add(1, std::memory_order_relaxed);
+}
+
 static std::string flight_dump_json() {
   std::string out = "{\"server\":\"native\",\"timing_enabled\":";
   out += timing_enabled() ? "true" : "false";
@@ -996,6 +1021,12 @@ struct Watch {
   // set when the server closed this watch because its ring-cursor lag
   // exceeded the cap (the writer distinguishes it from a shutdown close)
   bool terminated_slow = false;
+  // wall stamp of registration — GET /debug/watchers age_s
+  double created_unix = 0;
+  // live replay-backlog size for the census: the replay vector itself is
+  // drained by the stream thread OUTSIDE the ring lock, so the census
+  // reads this atomic instead of racing the vector
+  std::atomic<long> replay_pending{0};
 };
 
 // core/v1 kinds plus rbac.authorization.k8s.io/v1 (served with bootstrap
@@ -1283,6 +1314,10 @@ struct Store {
   void close_watch_locked(const std::shared_ptr<Watch>& w, bool slow) {
     if (w->closed) return;
     w->closed = true;
+    // census: the stream's FINAL lag, observed before any cursor jump (a
+    // slow close records the overflow that killed it, a graceful close
+    // the tail it still had to drain) — mirrors mockserver.py
+    lag_observe((long)(ring_next - w->cursor));
     kind_watchers[w->kind]--;
     if (slow) {
       w->terminated_slow = true;
@@ -1851,6 +1886,7 @@ struct App {
   size_t exec_write_batch(ConnIO& io, std::vector<Request>& batch);
   void evict_events(double* fanout_us);
   std::string metrics_text();
+  std::string watchers_dump_json();
   std::string snapshot_dump();
   void restore_load(const JVal& data);
   void seed_rbac();
@@ -2034,6 +2070,75 @@ std::string App::metrics_text() {
       "fan out to)\n"
       "# TYPE kwok_watch_encode_total counter\n";
   out += "kwok_watch_encode_total " + std::to_string(encodes) + "\n";
+  out +=
+      "# HELP kwok_watch_cursor_lag_events Final ring-cursor lag (events "
+      "behind the broadcast ring head) observed once per watch close: "
+      "slow terminations record the overflow that killed the stream, "
+      "graceful closes the drained tail; per-watcher live lag is GET "
+      "/debug/watchers\n"
+      "# TYPE kwok_watch_cursor_lag_events histogram\n";
+  {
+    uint64_t acc = 0;
+    for (int i = 0; i < N_LBUCKETS; i++) {
+      acc += g_lag_buckets[i].load(std::memory_order_relaxed);
+      out += "kwok_watch_cursor_lag_events_bucket{le=\"" +
+             std::string(LBUCKET_LE[i]) + "\"} " + std::to_string(acc) +
+             "\n";
+    }
+    uint64_t c = g_lag_count.load(std::memory_order_relaxed);
+    acc += g_lag_buckets[N_LBUCKETS].load(std::memory_order_relaxed);
+    if (c < acc) c = acc;  // +Inf can never render below a finite bucket
+    out += "kwok_watch_cursor_lag_events_bucket{le=\"+Inf\"} " +
+           std::to_string(c) + "\n";
+    out += "kwok_watch_cursor_lag_events_sum " +
+           std::to_string(g_lag_sum.load(std::memory_order_relaxed)) + "\n";
+    out += "kwok_watch_cursor_lag_events_count " + std::to_string(c) + "\n";
+  }
+  return out;
+}
+
+std::string App::watchers_dump_json() {
+  // GET /debug/watchers (ISSUE 16): the watch-plane census — one
+  // consistent ring-lock read of every live watch. Key order and value
+  // vocabulary mirror mockserver.py watchers_doc (schema parity-pinned
+  // by kwok_tpu.telemetry.timeline.check_watchers).
+  long cap = watch_backlog();
+  double now = wall_unix_s();
+  char num[64];
+  std::string ws;
+  long count = 0, parked = 0;
+  {
+    std::lock_guard<std::mutex> lk(store.ring_mu);
+    for (const auto& w : store.watches) {
+      if (w->closed) continue;
+      long lag = (long)(store.ring_next - w->cursor);
+      if (lag < 0) lag = 0;
+      long replay = w->replay_pending.load(std::memory_order_relaxed);
+      // fully drained: its delivery thread is parked in the ring cv
+      // wait — the per-watcher thread cost the reactor rewrite erases
+      if (lag == 0 && replay == 0) parked++;
+      const char* risk =
+          lag == 0 ? "none" : (lag <= cap / 2 ? "lagging" : "at_risk");
+      if (count) ws += ',';
+      count++;
+      ws += "{\"kind\":\"";
+      ws += KIND_NAMES[w->kind];
+      ws += "\",\"lag_events\":" + std::to_string(lag);
+      ws += ",\"replay_pending\":" + std::to_string(replay);
+      double age = now - w->created_unix;
+      if (age < 0) age = 0;
+      snprintf(num, sizeof num, ",\"age_s\":%.3f", age);
+      ws += num;
+      ws += ",\"band\":\"none\",\"risk\":\"";  // watches are band-exempt
+      ws += risk;
+      ws += "\"}";
+    }
+  }
+  std::string out =
+      "{\"server\":\"native\",\"backlog_cap\":" + std::to_string(cap);
+  out += ",\"thread_per_watcher\":true,\"count\":" + std::to_string(count);
+  out += ",\"parked_threads\":" + std::to_string(parked);
+  out += ",\"watchers\":[" + ws + "]}";
   return out;
 }
 
@@ -2442,6 +2547,10 @@ bool App::handle_request(ConnIO& io, Request& req) {
     // of recent request records — the engine auto-grabs it on a /readyz
     // degradation edge
     return respond(200, flight_dump_json());
+  if (req.method == "GET" && req.path == "/debug/watchers")
+    // watch-plane census (anonymous, like /debug/flight): per-watcher
+    // ring-cursor lag, replay backlog, age, termination risk
+    return respond(200, watchers_dump_json());
   // bearer-token authn (--token-auth-file): /healthz stays anonymous (the
   // components' --authorization-always-allow-paths contract)
   if (!auth_tokens.empty() &&
@@ -2851,6 +2960,9 @@ bool App::handle_request(ConnIO& io, Request& req) {
           // BOTH here means nothing falls between the cache gap and live
           std::lock_guard<std::mutex> rl(store.ring_mu);
           w->cursor = store.ring_next;
+          w->created_unix = wall_unix_s();
+          w->replay_pending.store((long)w->replay.size(),
+                                  std::memory_order_relaxed);
           store.watches.push_back(w);
           store.kind_watchers[m.kind]++;
         }
@@ -2913,6 +3025,7 @@ bool App::handle_request(ConnIO& io, Request& req) {
           alive = send_all(fd, out.data(), out.size());
         }
         w->replay.clear();
+        w->replay_pending.store(0, std::memory_order_relaxed);
       }
       // Ring reader: drain everything pending per wakeup (bounded per
       // write) and ship it as one send. The store encoded each event

@@ -83,6 +83,34 @@ _BOUNDS = [b for b, _ in TIMING_BUCKETS]
 #: /debug/flight post-mortems); mirrored by apiserver.cc
 FLIGHT_CAPACITY = 1024
 
+#: bucket ladder (events, power-of-2) for kwok_watch_cursor_lag_events;
+#: canonical label strings — apiserver.cc renders these exact bytes
+LAG_EVENT_BUCKETS = (
+    (1, "1"), (2, "2"), (4, "4"), (8, "8"), (16, "16"), (32, "32"),
+    (64, "64"), (128, "128"), (256, "256"), (512, "512"),
+    (1024, "1024"), (2048, "2048"), (4096, "4096"),
+)
+_LAG_BOUNDS = [b for b, _ in LAG_EVENT_BUCKETS]
+
+
+class LagHist:
+    """Fixed-bucket histogram over EVENT COUNTS (integer sum), observed
+    once per watch close with the stream's final ring-cursor lag — the
+    census surface (ISSUE 16) the C10k reactor rewrite will be graded
+    against. Plain ints bumped under the store's ring lock."""
+
+    __slots__ = ("counts", "sum_events", "count")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(_LAG_BOUNDS) + 1)
+        self.sum_events = 0
+        self.count = 0
+
+    def observe(self, events: int) -> None:
+        self.counts[bisect.bisect_left(_LAG_BOUNDS, events)] += 1
+        self.sum_events += int(events)
+        self.count += 1
+
 
 class PhaseHist:
     """One fixed-bucket histogram: a counts array, a float sum and a
@@ -241,6 +269,12 @@ APISERVER_METRICS_HELP = {
         "serialize-once proof; kwok_watch_fanout_total counts the "
         "deliveries the shared bytes fan out to)"
     ),
+    "kwok_watch_cursor_lag_events": (
+        "Final ring-cursor lag (events behind the broadcast ring head) "
+        "observed once per watch close: slow terminations record the "
+        "overflow that killed the stream, graceful closes the drained "
+        "tail; per-watcher live lag is GET /debug/watchers"
+    ),
 }
 
 
@@ -306,8 +340,26 @@ def _hist_lines(
     return out
 
 
+def _lag_hist_lines(h: "LagHist | None") -> "list[str]":
+    """Cumulative-bucket text for the (label-less) watch-close lag
+    histogram; the exact line shapes apiserver.cc mirrors."""
+    h = h or LagHist()
+    name = "kwok_watch_cursor_lag_events"
+    out = []
+    acc = 0
+    for i, (_b, le) in enumerate(LAG_EVENT_BUCKETS):
+        acc += h.counts[i]
+        out.append(f'{name}_bucket{{le="{le}"}} {acc}')
+    cnt = max(h.count, acc + h.counts[-1])
+    out.append(f'{name}_bucket{{le="+Inf"}} {cnt}')
+    out.append(f"{name}_sum {int(h.sum_events)}")
+    out.append(f"{name}_count {cnt}")
+    return out
+
+
 def render_timing_metrics(
-    timing: ApiserverTiming, backlogs, encode_total: int = 0
+    timing: ApiserverTiming, backlogs, encode_total: int = 0,
+    lag_hist: "LagHist | None" = None,
 ) -> bytes:
     """The phase-timing families, appended to the overload surface by both
     servers' /metrics handlers. Always renders the FULL phase/verb matrix
@@ -371,5 +423,9 @@ def render_timing_metrics(
     fam(
         "kwok_watch_encode_total", "counter",
         [f"kwok_watch_encode_total {int(encode_total)}"],
+    )
+    fam(
+        "kwok_watch_cursor_lag_events", "histogram",
+        _lag_hist_lines(lag_hist),
     )
     return ("\n".join(lines) + "\n").encode()

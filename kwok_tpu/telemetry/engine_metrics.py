@@ -386,3 +386,113 @@ class LaneTelemetry:
     def stage_sums(self) -> dict:
         """Per-lane stage second totals (lane-utilization reporting)."""
         return {s: h.sum for s, h in self.stage_hists.items()}
+
+
+# --------------------------------------------------- proc-lane merge policy
+# (ISSUE 16: each lane child is a whole single-lane engine whose registry
+# snapshot crosses the process boundary via a MetricsBank slab. The
+# parent folds every snapshot into ONE scratch registry per scrape so the
+# proc-lane exposition is family-and-label identical to the threaded
+# one: child stage histograms label-split into kwok_lane_stage_seconds
+# {shard=} AND aggregate into the unlabeled stage family — exactly what
+# LaneTelemetry.observe_stage does in-process — while counters and
+# histograms sum and gauges follow the explicit policy below.)
+
+# gauges where the fleet-wide value is the sum of the lanes' values
+PROC_MERGE_SUM_GAUGES = frozenset({
+    "kwok_tick_inflight",
+    "kwok_checkpoint_rows",
+})
+# gauges where the fleet-wide value is the worst lane's value
+PROC_MERGE_MAX_GAUGES = frozenset({
+    "kwok_tick_seconds_last",
+    "kwok_watch_lag_seconds_last",
+    "kwok_restart_recovery_seconds",
+})
+# gauges the parent computes itself (StatusBank scrape / build identity):
+# a lane's copy is dropped, never double-counted
+PROC_MERGE_PARENT_GAUGES = frozenset({
+    "kwok_build_info",
+    "kwok_nodes_managed",
+    "kwok_pods_managed",
+    "kwok_ingest_queue_depth",
+    "kwok_shm_arena_bytes",
+})
+
+
+def _merge_lane_snapshot(reg, shard: int, snap: dict,
+                         include_gauges: bool) -> None:
+    from kwok_tpu.telemetry.registry import family_from_doc, merge_child
+
+    lane_fam = reg.histogram(
+        "kwok_lane_stage_seconds", _HELP["kwok_lane_stage_seconds"],
+        ("shard", "stage"),
+    )
+    for name, doc in sorted(snap.items()):
+        t = doc.get("type")
+        if name == "kwok_tick_stage_seconds":
+            # aggregate into the whole-engine stage family AND label-split
+            # drain/emit under the lane's shard — the LaneTelemetry shape
+            fam = family_from_doc(reg, name, doc)
+            for values, v in doc.get("children", ()):
+                merge_child(fam, values, v)
+                stage = str(values[-1]) if values else ""
+                if stage in LANE_STAGES:
+                    merge_child(lane_fam, (str(shard), stage), v)
+            continue
+        if name in PROC_MERGE_PARENT_GAUGES:
+            continue
+        if name == "kwok_ingest_queue_depth":
+            continue  # label-split from the StatusBank, not the snapshot
+        if t == "gauge":
+            if not include_gauges:
+                continue  # a retired lane's gauges are stale by definition
+            if name in PROC_MERGE_SUM_GAUGES:
+                mode = "sum"
+            elif name in PROC_MERGE_MAX_GAUGES:
+                mode = "max"
+            else:
+                continue  # unlisted gauges stay parent-authoritative
+            fam = family_from_doc(reg, name, doc)
+            for values, v in doc.get("children", ()):
+                merge_child(fam, values, v, gauge=mode)
+            continue
+        fam = family_from_doc(reg, name, doc)
+        for values, v in doc.get("children", ()):
+            merge_child(fam, values, v)
+
+
+def merge_proc_lane_metrics(parent_snap: dict, lane_snaps: dict,
+                            retired_snaps: dict, n: int,
+                            queue_depths: "dict | None" = None):
+    """One scratch registry for a proc-lane scrape: the parent's own
+    snapshot, every live lane's engine snapshot (``{shard: snap}``), and
+    each lane's retired accumulator (previous incarnations' final
+    snapshots — counters/histograms only, so aggregates stay monotonic
+    across respawns). ``queue_depths`` feeds kwok_lane_queue_depth from
+    the StatusBank (fresher than any 1s-cadence snapshot). Lane families
+    are pre-created for every shard so the exposition is stable from the
+    first scrape, before any child has published."""
+    from kwok_tpu.telemetry.registry import registry_from_snapshot
+
+    reg = registry_from_snapshot(parent_snap)
+    lane_fam = reg.histogram(
+        "kwok_lane_stage_seconds", _HELP["kwok_lane_stage_seconds"],
+        ("shard", "stage"),
+    )
+    depth_fam = reg.gauge(
+        "kwok_lane_queue_depth", _HELP["kwok_lane_queue_depth"], ("shard",)
+    )
+    for i in range(n):
+        for s in LANE_STAGES:
+            lane_fam.labels(shard=str(i), stage=s)
+        depth_fam.labels(shard=str(i)).set(
+            int((queue_depths or {}).get(i, 0))
+        )
+    for shard, snap in sorted(retired_snaps.items()):
+        if snap:
+            _merge_lane_snapshot(reg, shard, snap, include_gauges=False)
+    for shard, snap in sorted(lane_snaps.items()):
+        if snap:
+            _merge_lane_snapshot(reg, shard, snap, include_gauges=True)
+    return reg

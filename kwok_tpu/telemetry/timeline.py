@@ -70,6 +70,75 @@ def check_flight(doc: dict) -> None:
             assert isinstance(v, (int, float)) and v >= 0
 
 
+def check_watchers(doc: dict) -> None:
+    """The shared ``GET /debug/watchers`` schema both apiservers must
+    satisfy (parity-pinned in tests/test_native_apiserver.py, ISSUE 16):
+    per-watcher ring-cursor lag, replay backlog, age, band, and the
+    deterministic termination-risk classification. Raises AssertionError
+    on any violation."""
+    assert isinstance(doc, dict), "watchers dump is not an object"
+    assert doc.get("server") in ("native", "mock"), doc.get("server")
+    assert isinstance(doc["backlog_cap"], int) and doc["backlog_cap"] > 0
+    assert isinstance(doc["thread_per_watcher"], bool)
+    assert isinstance(doc["count"], int) and doc["count"] >= 0
+    assert isinstance(doc["parked_threads"], int)
+    assert 0 <= doc["parked_threads"] <= doc["count"]
+    watchers = doc["watchers"]
+    assert isinstance(watchers, list)
+    assert len(watchers) == doc["count"]
+    for w in watchers:
+        assert w["kind"] in ("nodes", "pods"), w.get("kind")
+        assert isinstance(w["lag_events"], int) and w["lag_events"] >= 0
+        assert isinstance(w["replay_pending"], int)
+        assert w["replay_pending"] >= 0
+        assert isinstance(w["age_s"], (int, float)) and w["age_s"] >= 0
+        assert w["band"] in ("readonly", "mutating", "none"), w.get("band")
+        assert w["risk"] in ("none", "lagging", "at_risk"), w.get("risk")
+        # the risk classification is a pure function of lag vs the
+        # backlog cap — pinned here so both servers stay bit-identical
+        lag = w["lag_events"]
+        cap = doc["backlog_cap"]
+        expect = (
+            "none" if lag == 0
+            else ("lagging" if lag <= cap // 2 else "at_risk")
+        )
+        assert w["risk"] == expect, (w["risk"], expect, lag, cap)
+
+
+def lane_trace_events(
+    lane_trace: dict, engine_epoch: float, index: int, pid: int
+) -> list:
+    """One lane child's span-ring dump as Chrome events under its own
+    ``pid``, wall-aligned onto the parent engine's clock via each dump's
+    ``otherData.epoch_unix`` stamp. A dump without the stamp CANNOT be
+    aligned — refuse it loudly instead of merging garbage offsets."""
+    other = lane_trace.get("otherData") or {}
+    lane_epoch = other.get("epoch_unix")
+    if not lane_epoch:
+        raise ValueError(
+            "lane trace dump has no otherData.epoch_unix wall anchor; "
+            "cannot wall-align it with the engine trace (was it produced "
+            "by an engine --trace-dump / /debug/trace?)"
+        )
+    shift_us = (float(lane_epoch) - engine_epoch) * 1e6
+    events = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": f"lane{index}"},
+        }
+    ]
+    for ev in lane_trace.get("traceEvents") or ():
+        ev = dict(ev)
+        ev["pid"] = pid
+        if "ts" in ev:
+            ev["ts"] = round(float(ev["ts"]) + shift_us, 1)
+        events.append(ev)
+    return events
+
+
 def flight_to_trace_events(
     flight: dict, epoch_unix: float, pid: int = 1
 ) -> list:
@@ -155,10 +224,17 @@ def flight_to_trace_events(
     return events
 
 
-def merge_timeline(engine_trace: dict, flight: dict) -> dict:
+def merge_timeline(
+    engine_trace: dict, flight: dict, lane_traces=()
+) -> dict:
     """One Chrome-trace document: the engine's span ring (pid 0, as
-    dumped by ``--trace-dump`` / ``/debug/trace``) plus the apiserver's
-    flight records (pid 1), wall-aligned via the trace's epoch."""
+    dumped by ``--trace-dump`` / ``/debug/trace``), the apiserver's
+    flight records (pid 1), and — with ``--lane-procs`` — each lane
+    child's span-ring dump (pid 2+N), every tier wall-aligned via its
+    own ``epoch_unix`` stamp. The sampled ``pod.ingest_to_patch`` spans
+    carry ``{key, rv}`` args on both sides of the shm ring, so one
+    Perfetto view follows a pod from raw wire bytes through a worker
+    process to the apiserver commit."""
     check_flight(flight)
     epoch = float(
         (engine_trace.get("otherData") or {}).get("epoch_unix") or 0.0
@@ -174,9 +250,13 @@ def merge_timeline(engine_trace: dict, flight: dict) -> dict:
     ]
     events += list(engine_trace.get("traceEvents") or ())
     events += flight_to_trace_events(flight, epoch, pid=1)
+    for i, lane_trace in enumerate(lane_traces):
+        events += lane_trace_events(lane_trace, epoch, i, pid=2 + i)
     other = dict(engine_trace.get("otherData") or {})
     other["flight_records_merged"] = len(flight.get("records") or ())
     other["flight_server"] = flight.get("server")
+    if lane_traces:
+        other["lane_traces_merged"] = len(lane_traces)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -294,6 +374,12 @@ def main(argv=None) -> int:
                    "or a saved /debug/trace)")
     p.add_argument("--flight", required=True,
                    help="apiserver /debug/flight dump")
+    p.add_argument("--lane-dump", action="append", default=[],
+                   metavar="FILE",
+                   help="a lane child's span-ring dump (--lane-procs "
+                   "writes <trace>.lane<i>.json per lane); repeatable — "
+                   "each merges as pid 2+N, wall-aligned via its "
+                   "epoch_unix stamp")
     p.add_argument("--out", default="",
                    help="write the merged Chrome trace here")
     p.add_argument("--table", action="store_true",
@@ -303,7 +389,22 @@ def main(argv=None) -> int:
         trace = json.load(f)
     with open(args.flight) as f:
         flight = json.load(f)
-    merged = merge_timeline(trace, flight)
+    lane_traces = []
+    for path in args.lane_dump:
+        with open(path) as f:
+            doc = json.load(f)
+        if not (doc.get("otherData") or {}).get("epoch_unix"):
+            p.error(
+                f"--lane-dump {path}: no otherData.epoch_unix wall "
+                "anchor; refusing to merge a dump that cannot be "
+                "wall-aligned (use an engine --trace-dump / "
+                "/debug/trace output)"
+            )
+        lane_traces.append(doc)
+    try:
+        merged = merge_timeline(trace, flight, lane_traces)
+    except ValueError as e:
+        p.error(str(e))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(merged, f)

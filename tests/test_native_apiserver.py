@@ -877,6 +877,68 @@ def test_flight_recorder_schema_parity(srv):
     assert keysets["native"] == keysets["python"]
 
 
+def _watchers_workload(url: str):
+    """Identical drive on either server: one parked pod watcher, a
+    couple of fanned-out patches, then the census — polled until the
+    watcher has fully drained (lag 0, parked) so the dump is
+    deterministic before the byte compare."""
+    c = HttpKubeClient(url)
+    c.create("nodes", make_node("wc-n"))
+    c.create("pods", make_pod("wc-p", node="wc-n"))
+    w = c.watch("pods")
+    threading.Thread(target=lambda: [None for _ in w], daemon=True).start()
+    time.sleep(0.2)
+    for _ in range(3):
+        c.patch_status(
+            "pods", "default", "wc-p", {"status": {"phase": "Running"}}
+        )
+    doc = {}
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        raw = urllib.request.urlopen(
+            url + "/debug/watchers", timeout=5
+        ).read()
+        doc = json.loads(raw)
+        if doc.get("count") == 1 and doc.get("parked_threads") == 1:
+            break
+        time.sleep(0.05)
+    w.stop()
+    c.close()
+    return raw, doc
+
+
+def _mask_watchers(raw: bytes) -> bytes:
+    """Mask the run-dependent tokens of a /debug/watchers dump — numbers
+    (ages, caps, lags) and the server name — leaving key order, key
+    names, separators and enum strings for the byte compare."""
+    masked = _re.sub(rb"\d+(\.\d+)?", b"N", raw)
+    return _re.sub(rb'"server":"(mock|native)"', b'"server":"S"', masked)
+
+
+def test_watchers_census_parity(srv):
+    """ISSUE 16: GET /debug/watchers byte-parity — same JSON key order,
+    separators and vocabulary on both servers (values masked), both
+    passing the shared schema check, with the deterministic fields
+    (count, kind, band, risk, parked) identical unmasked."""
+    from kwok_tpu.telemetry.timeline import check_watchers
+
+    native_raw, native_doc = _watchers_workload(srv.url)
+    py = HttpFakeApiserver().start()
+    try:
+        python_raw, python_doc = _watchers_workload(py.url)
+    finally:
+        py.stop()
+    assert _mask_watchers(native_raw) == _mask_watchers(python_raw)
+    for name, doc in (("native", native_doc), ("mock", python_doc)):
+        check_watchers(doc)
+        assert doc["server"] == name
+        assert doc["thread_per_watcher"] is True
+        assert doc["count"] == 1 and doc["parked_threads"] == 1
+        (w,) = doc["watchers"]
+        assert w["kind"] == "pods" and w["band"] == "none"
+        assert w["lag_events"] == 0 and w["risk"] == "none"
+
+
 def test_timing_disabled_is_zero_cost_surface():
     """KWOK_TPU_APISERVER_TIMING=0: the families still render (shape-
     stable scrapes) but every histogram stays zeroed and the flight

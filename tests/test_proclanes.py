@@ -132,6 +132,53 @@ def test_ippool_partition_lanes_disjoint():
                 f"overflowing lanes {i}/{j} share IPs"
 
 
+def test_metrics_bank_roundtrip_and_reset():
+    bank = shm_mod.MetricsBank(shm_mod.arena_name("t-mbank"), 4096,
+                               create=True)
+    try:
+        reader = shm_mod.MetricsBank(bank.name)
+        assert reader.read() is None  # never published
+        bank.write(b'{"engine":{}}')
+        assert reader.read() == b'{"engine":{}}'
+        bank.write(b'{"engine":{"a":1}}')  # overwrite wins
+        assert reader.read() == b'{"engine":{"a":1}}'
+        # oversized payloads are refused whole, the slab keeps the last
+        # good snapshot (a torn half-write would be worse than staleness)
+        assert not bank.write(b"x" * 65536)
+        assert reader.read() == b'{"engine":{"a":1}}'
+        bank.reset()  # respawn path: back to the never-published state
+        assert reader.read() is None
+        reader.close()
+    finally:
+        bank.close(unlink=True)
+
+
+def test_metrics_bank_torn_snapshot_never_parsed():
+    """The seqlock contract (ISSUE 16): a writer caught mid-update (odd
+    seq) makes the reader retry and ultimately return None — never half
+    a slab. A crashed writer's restamp makes the NEXT write publish."""
+    bank = shm_mod.MetricsBank(shm_mod.arena_name("t-torn"), 4096,
+                               create=True)
+    try:
+        reader = shm_mod.MetricsBank(bank.name)
+        bank.write(b"A" * 64)
+        # simulate a writer dying mid-update: seq left odd, bytes torn
+        hdr = bank.arena.hdr
+        hdr[shm_mod.MetricsBank.SEQ] += 1  # odd: write in progress
+        del hdr  # release the exported memoryview before the mmap closes
+        bank.arena.payload[:32] = b"B" * 32  # half-written payload
+        t0 = time.time()
+        assert reader.read() is None, "reader parsed a torn snapshot"
+        assert time.time() - t0 < 2.0  # bounded retries, no spin-forever
+        # the single writer recovers: its next write restamps seq even
+        # and publishes a whole snapshot again
+        assert bank.write(b"C" * 64)
+        assert reader.read() == b"C" * 64
+        reader.close()
+    finally:
+        bank.close(unlink=True)
+
+
 # ------------------------------------------------- config/CLI/zero-cost off
 
 
@@ -418,6 +465,81 @@ def test_proc_lanes_end_to_end_and_sigkill_respawn(tmp_path):
         assert eng.metrics_text().count(
             'kwok_lane_proc_restarts_total{shard="0"}'
         ) == 1
+    finally:
+        if eng is not None:
+            eng.stop()
+        srv.stop()
+    assert not _shm_leftovers(), "leaked /dev/shm segments"
+
+@pytest.mark.slow
+def test_proc_lanes_metrics_merge_exposes_shard_families():
+    """ISSUE 16 named regression: a real 2-lane --lane-procs engine must
+    expose kwok_lane_stage_seconds{shard=...} families in /metrics once
+    the children publish their MetricsBank snapshots, and the merged
+    exposition must satisfy the same strict text-format oracle as the
+    threaded engine."""
+    import re
+
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.edge.mockserver import HttpFakeApiserver
+
+    from tests.test_metrics_exposition import parse_exposition
+
+    srv = HttpFakeApiserver(store=FakeKube()).start()
+    eng = None
+    try:
+        client = HttpKubeClient(f"http://127.0.0.1:{srv.port}")
+        eng = ClusterEngine(client, EngineConfig(
+            manage_all_nodes=True, tick_interval=0.05, drain_shards=2,
+            lane_procs=True, initial_capacity=2048,
+        ))
+        eng.start()
+        assert _wait(lambda: eng.ready, 120), "startup gate never closed"
+        store = srv.store
+        store.create("nodes", {"apiVersion": "v1", "kind": "Node",
+                               "metadata": {"name": "mm-n0"}, "status": {}})
+        for i in range(8):
+            store.create("pods", {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"mm-p{i}", "namespace": "default"},
+                "spec": {"nodeName": "mm-n0",
+                         "containers": [{"name": "c", "image": "b"}]},
+                "status": {"phase": "Pending"},
+            })
+
+        def all_running():
+            return all(
+                (store.get("pods", "default", f"mm-p{i}") or {})
+                .get("status", {}).get("phase") == "Running"
+                for i in range(8)
+            )
+
+        assert _wait(all_running, 90), "pods never converged"
+
+        def lanes_published():
+            text = eng.metrics_text()
+            return all(
+                re.search(
+                    r'kwok_lane_stage_seconds_count\{shard="%d",'
+                    r'stage="drain"\} ([1-9]\d*)' % s, text)
+                for s in (0, 1)
+            )
+
+        assert _wait(lanes_published, 60), \
+            "lane shard families never showed nonzero drain counts"
+        text = eng.metrics_text()
+        fams = parse_exposition(text)  # strict oracle: raises on violation
+        lane_fam = fams["kwok_lane_stage_seconds"]
+        assert lane_fam["type"] == "histogram"
+        shards = {
+            lbl["shard"]
+            for name, lbl, _ in lane_fam["samples"]
+            if name.endswith("_count")
+        }
+        assert shards == {"0", "1"}
+        # children merged into the unlabeled family too: parent-side
+        # drain observations alone can't explain the lane counts
+        assert "kwok_tick_stage_seconds" in fams
     finally:
         if eng is not None:
             eng.stop()
