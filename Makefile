@@ -46,16 +46,22 @@ lane-check: ## sharded-lane ordering oracle + thread-sanity + lock-witness pass 
 	$(PYENV) python3 benchmarks/emit_micro.py --check
 	$(PYENV) python3 benchmarks/proc_micro.py --check
 
-# proc-check: the process-lane gate (ISSUE 15): the proclanes unit tier
-# (shm ring/slot/bank semantics, node topology tap, slot-guard pump,
-# config/CLI plumbing, fault-plane SIGKILL targets, watchdog budget
-# sharing) INCLUDING the slow spawn e2e tier-1 skips, then
+# proc-check: the process-lane gate (ISSUE 15 + 17): the proclanes unit
+# tier (shm ring/slot/bank semantics, node topology tap, slot-guard
+# pump, config/CLI plumbing, fault-plane SIGKILL/SIGSTOP targets,
+# per-lane child fault-plane derivation, injected torn-write
+# invariants, descriptor bounds-rejection, watchdog budget sharing)
+# INCLUDING the slow spawn e2e tier-1 skips, then
 # benchmarks/proc_soak.py --check: the per-key patch-order oracle
 # byte-compared against the single-lane engine, a rotating lane-process
 # SIGKILL chaos arm, and a mid-delay SIGKILL restart arm (delays resumed
-# within one tick quantum from lane<i>.ckpt.json), with /dev/shm proven
-# clean after every arm (docs/resilience.md "Process lanes";
-# PROC_r*.json).
+# within one tick quantum from lane<i>.ckpt.json), and the ISSUE 17
+# chaos+drift storm (full wire + shm/IPC fault tier + rotating
+# SIGKILL/SIGSTOP with the shard-scoped child auditors on, then
+# post-convergence silent mutations detected + repaired ->
+# PROC_r02.json), with /dev/shm proven clean after every arm
+# (docs/resilience.md "Process lanes" + "Multi-process fault plane &
+# audit"; PROC_r*.json).
 proc-check: ## process-lane ordering + chaos/restart gate (PROC_r* artifact, shm-leak proof)
 	$(PYENV) python3 -m pytest tests/test_proclanes.py -q
 	$(PYENV) python3 benchmarks/proc_soak.py --check

@@ -26,6 +26,23 @@ oracle, all driving the REAL ``tpukwok`` process (the production wiring
 Every arm ends with the shm-hygiene gate: no ``kwoktpu-*`` segment left
 in /dev/shm after engine exit — the zero-leak half of the zero-cost
 contract (the threaded-path half rides lane-check's route_micro gate).
+
+ISSUE 17 adds the **chaos+drift storm** (artifact ``PROC_r02.json``):
+an in-process 2-lane engine (in-process so the rig can quiesce both
+sides of the fault boundary mid-run) runs the creates workload under
+the FULL combined storm — hostile wire + clock.jump + pump.* + the
+whole shm/IPC tier (shm.torn, shm.stall, shm.desc_drop,
+shm.desc_garble) + rotating worker.kill SIGKILLs + lane.sigstop — with
+the shard-scoped anti-entropy auditor on. After every spec'd kind has
+provably fired (union of the parent plane's tally and the merged child
+exposition), the rig quiesces all planes (FAULTSOFF broadcast), waits
+for convergence, then mutates the apiserver SILENTLY (a status rewind
+on a lane-0-owned pod, a delete on a lane-1-owned pod — no events, no
+rv bumps) and gates: final phases byte-identical to an unfaulted
+control arm, per-key collapsed patch order preserved, both mutations
+detected (merged ``kwok_drift_detected_total{reason=stale-row|
+ghost-row}``) and repaired, engine not degraded at exit, /dev/shm
+clean.
 """
 
 from __future__ import annotations
@@ -347,6 +364,385 @@ def _run_restart_arm(pods, cfg_path, timeout) -> dict:
     return out
 
 
+# --------------------------------------------- chaos+drift storm (ISSUE 17)
+
+AUDIT_S = 0.5
+#: parent-side kinds the storm must prove fired (the plane's own tally)
+STORM_PARENT_KINDS = (
+    "wire.garble", "wire.truncate", "wire.dup", "wire.stale",
+    "watch.cut", "clock.jump",
+    "shm.desc_drop", "shm.desc_garble",
+    "worker.kill", "lane.sigstop",
+)
+#: child-side kinds, visible only through the merged exposition
+STORM_CHILD_KINDS = (
+    "pump.drop", "pump.partial", "pump.delay",
+    "clock.jump", "shm.torn", "shm.stall",
+)
+#: Rates are sized to the arm's traffic volume so every kind provably
+#: fires inside the hold window (the workload drip-feeds creates to keep
+#: the wire/ring/pump sites drawing); kill/sigstop periods are sized so
+#: each lane's respawn charges stay WELL under the watchdog's restart
+#: budget (5/30s per lane name) — rotation spreads one event per period
+#: across the lanes, so per-lane charge rate is (kills + stall-kills)/2:
+#: ~2 per 30s here. Overrunning the budget marks the lane permanently
+#: dead (its shard goes dark and /readyz stays degraded), which is the
+#: product contract under a genuine crash-loop but a bench bug here.
+STORM_SPEC = (
+    "seed=1337;"
+    "wire.garble=0.08;wire.truncate=0.04;wire.dup=0.12;wire.stale=0.08;"
+    "watch.cut=0.05;clock.jump=0.1:0.05;"
+    "pump.drop=0.1;pump.partial=0.2;pump.delay=0.15:0.02;"
+    "shm.torn=0.3;shm.stall=0.03:2.0;"
+    "shm.desc_drop=0.08;shm.desc_garble=0.12;"
+    "worker.kill=kwok-lane*:12.0;lane.sigstop=kwok-lane*:18.0"
+)
+
+
+def _fault_counts(text: str) -> dict:
+    """kind -> count from a merged process exposition."""
+    import re
+
+    out = {}
+    for kind, v in re.findall(
+        r'kwok_faults_injected_total\{kind="([^"]+)"\} (\d+(?:\.\d+)?)',
+        text,
+    ):
+        out[kind] = out.get(kind, 0) + float(v)
+    return out
+
+
+def _drift_counts(text: str) -> dict:
+    """reason -> detected count from a merged engine exposition."""
+    import re
+
+    out = {}
+    for labels, v in re.findall(
+        r'kwok_drift_detected_total\{([^}]*)\} (\d+(?:\.\d+)?)', text
+    ):
+        m = re.search(r'reason="([^"]+)"', labels)
+        if m:
+            out[m.group(1)] = out.get(m.group(1), 0) + float(v)
+    return out
+
+
+def _metric_total(text: str, family: str) -> float:
+    import re
+
+    return sum(
+        float(v) for v in re.findall(
+            rf'^{family}(?:\{{[^}}]*\}})? (\d+(?:\.\d+)?)$', text,
+            re.MULTILINE,
+        )
+    )
+
+
+def _inproc_engine(url: str, *, faults: str = "", audit: float = 0.0,
+                   ckpt: "str | None" = None):
+    from kwok_tpu.edge.httpclient import HttpKubeClient
+    from kwok_tpu.engine import ClusterEngine, EngineConfig
+
+    kw = {}
+    if faults:
+        kw["faults"] = faults
+    if audit:
+        kw["audit_interval"] = audit
+    if ckpt:
+        kw["checkpoint_dir"] = ckpt
+        kw["checkpoint_interval"] = CKPT_INTERVAL
+    eng = ClusterEngine(HttpKubeClient(url), EngineConfig(
+        manage_all_nodes=True, tick_interval=0.05, drain_shards=LANES,
+        lane_procs=True, initial_capacity=4096, **kw,
+    ))
+    eng.start()
+    return eng
+
+
+def _storm_workload(store, pods: int):
+    """Creates in two waves (the second lands mid-storm, so ingest keeps
+    feeding the wire/shm fault sites after the first wave converges)."""
+    names = [f"st{i}" for i in range(pods)]
+    for i in range(4):
+        store.create("nodes", _make_node(f"stn{i}"))
+    for n in names[: pods // 2]:
+        store.create("pods", _make_pod(n, f"stn{hash(n) % 4}"))
+    return names
+
+
+def _run_storm_control_arm(pods, timeout) -> dict:
+    """The unfaulted reference: same in-process 2-lane engine, same
+    workload, auditor on, no faults — the byte-identity baseline."""
+    srv = MockApiserver()
+    store = srv.store
+    out = {"arm": "storm-control"}
+    eng = None
+    try:
+        eng = _inproc_engine(srv.url, audit=AUDIT_S)
+        if not _wait(lambda: eng.ready, 120):
+            raise RuntimeError("control engine never became ready")
+        names = _storm_workload(store, pods)
+        for n in names[pods // 2:]:
+            store.create("pods", _make_pod(n, f"stn{hash(n) % 4}"))
+        out["converged"] = _wait(
+            lambda: all(
+                ph == "Running" for ph in _pod_phases(store, names).values()
+            ),
+            timeout,
+        )
+        out["final_phases"] = _pod_phases(store, names)
+        out["per_key"] = {
+            n: store.per_key_collapsed(("default", n)) for n in names
+        }
+    finally:
+        if eng is not None:
+            eng.stop()
+        srv.stop()
+    out["shm_leftover"] = _shm_leftovers()
+    return out
+
+
+def _run_storm_arm(pods, timeout) -> dict:
+    import kwok_tpu.engine.proclanes as proclanes_mod
+    from kwok_tpu.engine.rowpool import shard_of
+
+    from benchmarks.rig import silent_delete, silent_patch
+
+    # shrink the stall clocks so lane.sigstop -> stall-kill and
+    # shm.stall -> ring-stall-drop resolve in bench time, not minutes.
+    # The module constants are patched for the parent (already
+    # imported); the env vars cover the spawned children, which import
+    # proclanes fresh. The stall clock must still clear the worst-case
+    # HEALTHY beat gap: a respawned child stamps its beat once on
+    # attach, then builds its engine before the status thread starts
+    # beating — several seconds under storm load. A clock inside that
+    # gap stall-kills healthy children in a loop and burns the lane's
+    # restart budget on bench-inflicted kills (observed with 3s: both
+    # lanes marked permanently dead mid-storm).
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("KWOK_TPU_LANE_STALL_S", "KWOK_TPU_RING_STALL_S")
+    }
+    os.environ["KWOK_TPU_LANE_STALL_S"] = "10"
+    os.environ["KWOK_TPU_RING_STALL_S"] = "1.5"
+    saved_const = (proclanes_mod._STALL_NS, proclanes_mod._RING_STALL_S)
+    proclanes_mod._STALL_NS = int(10e9)
+    proclanes_mod._RING_STALL_S = 1.5
+
+    srv = MockApiserver()
+    store = srv.store
+    ckpt = tempfile.mkdtemp(prefix="kwok-proc-storm-ckpt-")
+    out = {"arm": "storm"}
+    eng = None
+    try:
+        eng = _inproc_engine(
+            srv.url, faults=STORM_SPEC, audit=AUDIT_S, ckpt=ckpt,
+        )
+        if not _wait(lambda: eng.ready, 180):
+            raise RuntimeError("storm engine never became ready")
+        names = _storm_workload(store, pods)
+        plane = eng._faults
+
+        def kinds_covered() -> "tuple[dict, list]":
+            seen = dict(plane.counts())
+            for k, v in _fault_counts(eng.process_metrics_text()).items():
+                seen[k] = max(seen.get(k, 0), v)
+            missing = [
+                k for k in set(STORM_PARENT_KINDS + STORM_CHILD_KINDS)
+                if not seen.get(k)
+            ]
+            return seen, missing
+
+        # the second wave DRIP-FEEDS through the hold window: the fault
+        # sites only draw while traffic moves (watch events for the wire
+        # tier, ring descriptors for the shm tier, lifecycle ticks for
+        # clock.jump, patch sends for the pump tier), so a one-shot wave
+        # that converges in seconds leaves the low-rate kinds with no
+        # draws for the rest of the hold. The storm stays open until
+        # every spec'd kind has provably fired (or the bound expires and
+        # the gate reports exactly which kinds never did).
+        time.sleep(3.0)
+        second_wave = list(names[pods // 2:])
+        # churn pods live OUTSIDE the oracle's name set: recycled
+        # create/delete keeps every fault site drawing for as long as
+        # the coverage poll needs, without perturbing the final-phase /
+        # per-key byte-identity comparison (which only reads ``names``)
+        churn = [f"stchurn{i}" for i in range(2)]
+        churn_up: set = set()
+        deadline = time.monotonic() + 75.0
+        next_create = next_churn = 0.0
+        while time.monotonic() < deadline:
+            now = time.monotonic()
+            if second_wave and now >= next_create:
+                n = second_wave.pop(0)
+                store.create("pods", _make_pod(n, f"stn{hash(n) % 4}"))
+                next_create = now + 0.7
+            if now >= next_churn:
+                for c in churn:
+                    if c in churn_up:
+                        store.delete("pods", "default", c)
+                        churn_up.discard(c)
+                    else:
+                        store.create(
+                            "pods", _make_pod(c, f"stn{hash(c) % 4}")
+                        )
+                        churn_up.add(c)
+                next_churn = now + 1.5
+            _seen, missing = kinds_covered()
+            if not missing and not second_wave:
+                break
+            time.sleep(0.25)
+        for n in second_wave:  # bound expired mid-drip: finish the wave
+            store.create("pods", _make_pod(n, f"stn{hash(n) % 4}"))
+        for c in churn_up:     # retire the churn before the oracle phases
+            store.delete("pods", "default", c)
+        out["fault_counts"], out["kinds_never_fired"] = kinds_covered()
+        out["fault_counts"] = {
+            k: int(v) for k, v in sorted(out["fault_counts"].items())
+        }
+
+        # ---- quiesce BOTH sides of the process boundary
+        plane.spec.rates.clear()
+        plane.spec.kill_glob = ""
+        plane.spec.sigstop_glob = ""
+        eng._proc.quiesce_child_faults()
+
+        out["converged"] = _wait(
+            lambda: all(
+                ph == "Running" for ph in _pod_phases(store, names).values()
+            ),
+            timeout * 2,
+        )
+        # let in-flight audit repairs settle: drift counters stable for
+        # ~4 audit intervals before the silent-mutation baseline
+        stable_since = time.monotonic()
+        last = _drift_counts(eng.metrics_text())
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            cur = _drift_counts(eng.metrics_text())
+            if cur != last:
+                last, stable_since = cur, time.monotonic()
+            elif time.monotonic() - stable_since > 4 * AUDIT_S:
+                break
+            time.sleep(0.25)
+        out["storm_drift_repairs"] = last
+        out["final_phases"] = _pod_phases(store, names)
+        out["per_key"] = {
+            n: store.per_key_collapsed(("default", n)) for n in names
+        }
+        # respawn quiet period: a respawn (the last sigstop's stall-kill
+        # can land AFTER quiesce) triggers a full list+RESYNC, and the
+        # wire-doubt timer defers integrity re-lists up to 5s — either
+        # landing after the silent mutations would re-ingest the mutated
+        # server state as row truth and blind the drift oracle. Wait for
+        # the respawn counter to hold still past both windows.
+        restarts = lambda: sum(l.restarts for l in eng._proc.lanes)  # noqa: E731
+        quiet_since, seen_restarts = time.monotonic(), restarts()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            cur = restarts()
+            if cur != seen_restarts:
+                seen_restarts, quiet_since = cur, time.monotonic()
+            elif time.monotonic() - quiet_since > 6.0 and all(
+                l.alive() for l in eng._proc.lanes
+            ):
+                break
+            time.sleep(0.25)
+        out["degraded_after_storm"] = eng.degraded
+        out["degraded_reasons_after_storm"] = sorted(
+            eng._degradation.reasons
+        )
+        out["lane_restarts"] = [l.restarts for l in eng._proc.lanes]
+        out["lane_dead"] = [l.dead for l in eng._proc.lanes]
+
+        # ---- post-convergence silent mutations, one per lane's shard
+        lane0 = [n for n in names if shard_of(("default", n), LANES) == 0]
+        lane1 = [n for n in names if shard_of(("default", n), LANES) == 1]
+        rewind_victim, ghost_victim = lane0[0], lane1[0]
+        base_drift = _drift_counts(eng.metrics_text())
+        base_repaired = _metric_total(
+            eng.metrics_text(), "kwok_drift_repaired_total"
+        )
+
+        def rewind(obj):
+            obj.setdefault("status", {})["phase"] = "Pending"
+
+        assert silent_patch(store, "pods", "default", rewind_victim, rewind)
+        assert silent_delete(store, "pods", "default", ghost_victim)
+        t_mut = time.monotonic()
+
+        def mutations_detected() -> bool:
+            d = _drift_counts(eng.metrics_text())
+            return (
+                d.get("stale-row", 0) > base_drift.get("stale-row", 0)
+                and d.get("ghost-row", 0) > base_drift.get("ghost-row", 0)
+            )
+
+        out["drift_detected"] = _wait(mutations_detected, 30.0, every=0.1)
+        out["detect_s"] = round(time.monotonic() - t_mut, 3)
+        out["drift_counts_after_detect"] = _drift_counts(eng.metrics_text())
+
+        def mutations_repaired() -> bool:
+            phase = (
+                (store.get("pods", "default", rewind_victim) or {})
+                .get("status", {}).get("phase")
+            )
+            return phase == "Running" and _metric_total(
+                eng.metrics_text(), "kwok_drift_repaired_total"
+            ) >= base_repaired + 2
+        out["drift_repaired"] = _wait(mutations_repaired, 30.0, every=0.1)
+        out["repair_s"] = round(time.monotonic() - t_mut, 3)
+        out["rewind_victim"], out["ghost_victim"] = rewind_victim, ghost_victim
+
+        # observability riders: the new families moved under the storm
+        m_text = eng.metrics_text()
+        out["stall_kills"] = _metric_total(
+            m_text, "kwok_lane_stall_kills_total"
+        )
+        out["desc_rejects"] = _metric_total(
+            m_text, "kwok_shm_desc_rejects_total"
+        )
+        out["degraded_at_end"] = eng.degraded
+        out["degraded_reasons_end"] = sorted(eng._degradation.reasons)
+    finally:
+        if eng is not None:
+            eng.stop()
+        srv.stop()
+        proclanes_mod._STALL_NS, proclanes_mod._RING_STALL_S = saved_const
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    out["shm_leftover"] = _shm_leftovers()
+    return out
+
+
+def storm_gates(control, storm) -> dict:
+    same_keys = set(control["per_key"]) == set(storm["per_key"])
+    return {
+        "storm_converged": bool(
+            control["converged"] and storm["converged"]
+        ),
+        "storm_phases_match_control": (
+            json.dumps(control["final_phases"], sort_keys=True)
+            == json.dumps(storm["final_phases"], sort_keys=True)
+        ),
+        "storm_per_key_order_preserved": same_keys and all(
+            control["per_key"][k] == storm["per_key"][k]
+            for k in control["per_key"]
+        ),
+        "storm_every_kind_fired": not storm["kinds_never_fired"],
+        "storm_sigstop_recovered_by_stall_kill": storm["stall_kills"] >= 1,
+        "storm_garbled_descs_bounds_rejected": storm["desc_rejects"] >= 1,
+        "storm_silent_mutations_detected": bool(storm["drift_detected"]),
+        "storm_silent_mutations_repaired": bool(storm["drift_repaired"]),
+        "storm_not_degraded_at_end": not storm["degraded_at_end"],
+        "storm_no_leaked_shm": not (
+            control["shm_leftover"] or storm["shm_leftover"]
+        ),
+    }
+
+
 def gates(single, proc, chaos, restart, pods) -> dict:
     same_keys = set(single["per_key"]) == set(proc["per_key"])
     return {
@@ -402,6 +798,8 @@ def main() -> int:
     p.add_argument("--pods", type=int, default=24)
     p.add_argument("--timeout", type=float, default=90.0)
     p.add_argument("--out", default=os.path.join(REPO, "PROC_r01.json"))
+    p.add_argument("--out2", default=os.path.join(REPO, "PROC_r02.json"),
+                   help="chaos+drift storm artifact (ISSUE 17)")
     p.add_argument("--check", action="store_true",
                    help="CI gate: smaller workload, exit 1 on any "
                    "failed gate")
@@ -426,11 +824,31 @@ def main() -> int:
         proc = _run_ordering_arm(args.pods, fast, args.timeout, procs=True)
         chaos = _run_chaos_arm(args.pods, fast, args.timeout)
         restart = _run_restart_arm(args.pods, delay, args.timeout)
+        control = _run_storm_control_arm(args.pods, args.timeout)
+        storm = _run_storm_arm(args.pods, args.timeout)
     finally:
         os.unlink(fast)
         os.unlink(delay)
     g = gates(single, proc, chaos, restart, args.pods)
-    ok = all(g.values())
+    sg = storm_gates(control, storm)
+    storm_ok = all(sg.values())
+    storm_artifact = {
+        "bench": "proc_soak.storm",
+        "params": {"pods": args.pods, "lanes": LANES,
+                   "audit_interval_s": AUDIT_S, "spec": STORM_SPEC,
+                   "check": args.check},
+        "gates": sg,
+        "ok": storm_ok,
+        "storm": {k: storm.get(k) for k in (
+            "fault_counts", "kinds_never_fired", "storm_drift_repairs",
+            "detect_s", "repair_s", "stall_kills", "desc_rejects",
+            "rewind_victim", "ghost_victim", "degraded_after_storm",
+            "degraded_at_end")},
+    }
+    with open(args.out2, "w", encoding="utf-8") as fh:
+        json.dump(storm_artifact, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    ok = all(g.values()) and storm_ok
     artifact = {
         "bench": "proc_soak",
         "params": {"pods": args.pods, "lanes": LANES,
@@ -457,10 +875,19 @@ def main() -> int:
     with open(args.out, "w", encoding="utf-8") as fh:
         json.dump(artifact, fh, indent=1, sort_keys=True)
         fh.write("\n")
-    print(json.dumps({"ok": ok, "gates": g, "out": args.out}))
+    print(json.dumps({
+        "ok": ok, "gates": g, "storm_gates": sg,
+        "out": args.out, "out2": args.out2,
+    }))
     if not ok:
         failed = [k for k, v in g.items() if not v]
+        failed += [k for k, v in sg.items() if not v]
         print(f"proc_soak: FAILED gates: {failed}", file=sys.stderr)
+        if storm.get("kinds_never_fired"):
+            print(
+                "proc_soak: kinds never fired: "
+                f"{storm['kinds_never_fired']}", file=sys.stderr,
+            )
         if not g["per_key_order_identical"]:
             diffs = {
                 k: (single["per_key"].get(k), proc["per_key"].get(k))

@@ -57,6 +57,8 @@ PROC_ONLY_FAMILIES = frozenset({
     "kwok_lane_proc_restarts_total",
     "kwok_lane_handoff_seconds",
     "kwok_shm_arena_bytes",
+    "kwok_lane_stall_kills_total",
+    "kwok_shm_desc_rejects_total",
 })
 # process-global error families render only once nonzero, so their
 # presence is run-dependent on BOTH sides — excluded from the set

@@ -1456,13 +1456,18 @@ class ClusterEngine:
         if run_tick_loop and self._audit_interval > 0 and (
             self._proc is not None
         ):
-            logger.warning(
-                "anti-entropy auditor disabled under process lanes: the "
-                "parent holds no rows to diff (audit the lanes' shards "
-                "by running the auditor per child in a future round)"
+            # proc-aware anti-entropy (ISSUE 17): the parent holds no
+            # rows to diff, so the audit moves INTO the lane children —
+            # each runs the auditor over its own hash shard (the
+            # interval rides ProcLaneSet._lane_spec; drift degradation
+            # mirrors back through the StatusBank BANK_DRIFT upcall).
+            # _audit_interval stays nonzero: it IS the propagated value.
+            logger.info(
+                "anti-entropy audit runs shard-scoped in the %d lane "
+                "children (interval %.3fs); the parent spawns no auditor",
+                self._proc.n, self._audit_interval,
             )
-            self._audit_interval = 0.0
-        if run_tick_loop and self._audit_interval > 0:
+        if run_tick_loop and self._audit_interval > 0 and self._proc is None:
             # anti-entropy auditor (resilience/antientropy.py): paced
             # apiserver-vs-rows drift detection + per-row repair, off by
             # default; supervised so a crashed pass restarts in place

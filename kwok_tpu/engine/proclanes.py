@@ -90,8 +90,10 @@ _METRICS_BYTES = int(
 #: status-loop beats (50ms each) between telemetry-snapshot publishes
 _METRICS_EVERY_BEATS = 20
 #: seconds the router waits on a full ring before dropping the window
-#: for that lane (a dead/stalled child; the respawn resync re-delivers)
-_RING_STALL_S = 5.0
+#: for that lane (a dead/stalled child; the respawn resync re-delivers);
+#: env-tunable so the shm.stall chaos arm can exercise the drop+resync
+#: path without 5s of wall clock per injected stall
+_RING_STALL_S = float(os.environ.get("KWOK_TPU_RING_STALL_S", "5.0"))
 #: supervisor poll cadence
 _SUPER_POLL_S = 0.2
 #: a live lane process whose status beat is older than this is wedged
@@ -105,6 +107,34 @@ _STALL_NS = int(float(
 # --------------------------------------------------------------- child side
 
 
+def _desc_check(kind, off, ln, bounds, cap: int, published: int):
+    """None when a RAWB descriptor is safe to dereference, else the
+    reject reason (the `reason` label of kwok_shm_desc_rejects_total).
+    Pure integer/bounds math over the descriptor fields plus the ring's
+    capacity and published write cursor — nothing is read from shared
+    memory until every check passes, so a garbled descriptor
+    (shm.desc_garble, or a genuinely hostile pipe) can never turn into
+    a wild read."""
+    if kind not in _KINDS:
+        return "kind"
+    if not isinstance(off, int) or not isinstance(ln, int):
+        return "type"
+    if ln < 0 or ln > cap or off < 0:
+        return "range"
+    if off + ln > published:
+        return "unpublished"
+    if not isinstance(bounds, list) or not bounds or bounds[0] != 0:
+        return "bounds"
+    prev = 0
+    for b in bounds[1:]:
+        if not isinstance(b, int) or b < prev or b > ln:
+            return "bounds"
+        prev = b
+    if prev != ln:
+        return "bounds"
+    return None
+
+
 class _SlotGuardPump:
     """Wraps one pump connection group member in the child: every batch
     is parked in the lane's shared-memory InflightSlot before it goes on
@@ -114,13 +144,24 @@ class _SlotGuardPump:
     tunnel past the slot (the same containment contract as FaultyPump /
     FencedPump)."""
 
-    def __init__(self, slot: shm_mod.InflightSlot, inner):
+    def __init__(self, slot: shm_mod.InflightSlot, inner, plane=None):
         self._slot = slot
         self._inner = inner
+        # the lane child's own fault plane (ISSUE 17): shm.torn here
+        # simulates the writer dying mid-arm — disarm fires, a prefix of
+        # the payload lands, state never returns to 1, and the parent's
+        # post-mortem peek() must park the slot as "empty"
+        self._plane = plane
 
     def send(self, requests):
         try:
-            self._slot.arm(pickle.dumps(requests, protocol=4))
+            payload = pickle.dumps(requests, protocol=4)
+            plane = self._plane
+            if plane is not None and plane.decide("shm.torn") is not None:
+                plane.record("shm.torn")
+                self._slot.torn_arm(payload)
+            else:
+                self._slot.arm(payload)
         except Exception:
             # the slot is belt-and-braces over checkpoint replay: losing
             # it must never block the send
@@ -253,6 +294,11 @@ def _make_lane_engine(spec: dict):
         client = HttpKubeClient.from_kubeconfig(kubeconfig, spec["master"])
     else:
         client = HttpKubeClient(spec["master"])
+    # the child's shard-scoped audit interval: the parent's RESOLVED
+    # interval rides the spawn spec; anything else (including an
+    # inherited KWOK_TPU_AUDIT_INTERVAL) is forced off with -1 — the
+    # parent's resolution is the single source of truth
+    audit = float(spec.get("audit_interval") or 0.0)
     cfg = dataclasses.replace(
         spec["config"],
         lane_procs=False,
@@ -264,8 +310,12 @@ def _make_lane_engine(spec: dict):
         # engine.stop() writes <parent dump>.lane<i>.json on STOP/SIGTERM;
         # timeline.py --lane-dump merges them wall-aligned as pid 2+i
         trace_dump=spec.get("trace_dump", ""),
-        faults="off",        # ONE plane, the parent's (ingest + SIGKILL)
-        audit_interval=-1.0,  # ONE auditor surface, refused under procs
+        # per-lane fault plane (ISSUE 17): the parent derives each
+        # child's spec (faults.child_spec_text — CHILD_KINDS only,
+        # re-seeded per lane); the "off" literal still forces a no-plane
+        # child even when KWOK_TPU_FAULTS rides the inherited environment
+        faults=spec.get("faults") or "off",
+        audit_interval=audit if audit > 0 else -1.0,
         ha_role="",
         shed_queue_depth=0,  # shedding is a router concern (parent-side)
     )
@@ -300,7 +350,42 @@ def lane_proc_main(spec: dict, conn) -> None:
     row[shm_mod.BANK_PID] = os.getpid()
     row[shm_mod.BANK_ALIVE_NS] = time.monotonic_ns()
     e = _make_lane_engine(spec)
-    e._pump_wrap = lambda p: _SlotGuardPump(slot, p)
+    # the child's own fault plane (None unless the parent propagated a
+    # spec): shm.torn and shm.stall inject HERE, on the surfaces this
+    # process owns; wire/pump/clock faults were already wrapped around
+    # the child's client/pumps/clock by the engine constructor
+    plane = e._faults
+    e._pump_wrap = lambda p: _SlotGuardPump(slot, p, plane)
+    # descriptor-pipe hygiene (shm.desc_garble's landing zone): a
+    # corrupt descriptor must be BOUNDS-REJECTED and counted, never
+    # dereferenced into the ring. Labeled family: absent from the
+    # exposition until the first reject (parity with the threaded
+    # engine, which has no descriptor pipe at all).
+    desc_rejects = e.telemetry.registry.counter(
+        "kwok_shm_desc_rejects_total",
+        "Ring descriptors rejected by a lane child's bounds validation "
+        "before any shared-memory dereference (corrupt offset/length/"
+        "bounds vector), by reason; each reject also raises an "
+        "integrity-doubt upcall so the parent re-lists.",
+        ("reason",),
+    )
+
+    def _desc_reject(kind, reason: str) -> None:
+        desc_rejects.labels(reason=reason).inc()
+        integ = e._proc_integ
+        for k in (kind,) if kind in _KINDS else _KINDS:
+            integ[k] = integ.get(k, 0) + 1
+        logger.warning(
+            "lane %d: rejected %s descriptor (%s)",
+            spec["index"], kind, reason,
+        )
+
+    def _desc_ok(kind, off, ln, bounds) -> "str | None":
+        return _desc_check(
+            kind, off, ln, bounds, ring.cap,
+            int(ring.arena.hdr[shm_mod.RawRing.W]),
+        )
+
     e.start(spawn_watches=False)
     applied = 0
     stop_status = threading.Event()
@@ -317,7 +402,14 @@ def lane_proc_main(spec: dict, conn) -> None:
                 "engine": e.telemetry.registry.snapshot(),
                 "process": PROCESS_REGISTRY.snapshot(),
             }
-            mbank.write(json.dumps(doc).encode())
+            payload = json.dumps(doc).encode()
+            if plane is not None and plane.decide("shm.torn") is not None:
+                # the writer "dies" mid-slab: odd seq, half a payload —
+                # readers must back off and the next write must restamp
+                plane.record("shm.torn")
+                mbank.torn_write(payload)
+                return
+            mbank.write(payload)
         except Exception:
             swallowed("proclanes.metrics_publish")
 
@@ -339,6 +431,13 @@ def lane_proc_main(spec: dict, conn) -> None:
             row[shm_mod.BANK_INTEG_NODES] = integ["nodes"]
             row[shm_mod.BANK_INTEG_PODS] = integ["pods"]
             row[shm_mod.BANK_REWIND] = integ["rewind"]
+            # drift upcall: the child's shard-scoped auditor degrades
+            # the CHILD on an unrepaired-divergence streak; the parent
+            # mirrors the bit into its own /readyz (single-process
+            # parity — the operator-facing surface is the parent's)
+            row[shm_mod.BANK_DRIFT] = int(
+                "drift" in e._degradation.reasons
+            )
             beats += 1
             if beats % _METRICS_EVERY_BEATS == 0:
                 publish_metrics()
@@ -366,9 +465,30 @@ def lane_proc_main(spec: dict, conn) -> None:
                 break
             if op == "RAWB":
                 _op, kind, off, ln, bounds = msg
+                bad = _desc_ok(kind, off, ln, bounds)
+                if bad is not None:
+                    # never dereference: the skipped bytes retire when
+                    # the next good read sets the R cursor absolutely,
+                    # and the integrity upcall makes the parent re-list
+                    _desc_reject(kind, bad)
+                    continue
+                if plane is not None:
+                    stall = plane.decide("shm.stall")
+                    if stall is not None:
+                        # wedge ring consumption: the parent's router
+                        # fills the ring and takes the _RING_STALL_S
+                        # drop+resync path (arg = seconds to stall)
+                        plane.record("shm.stall")
+                        time.sleep(stall.arg or (_RING_STALL_S + 1.0))
                 blob = ring.read(off, ln)
                 e._q.put((kind, "RAWB", (blob, bounds), t))
                 applied += len(bounds) - 1
+            elif op == "FAULTSOFF":
+                # benchmark quiesce: the parent cleared its own rates
+                # and broadcasts the same to every child plane (the
+                # convergence/repair phases must run fault-free)
+                if plane is not None:
+                    plane.spec.rates.clear()
             elif op == "EV":
                 _op, kind, type_, obj = msg
                 e._q.put((kind, type_, obj, t))
@@ -412,6 +532,25 @@ def lane_proc_main(spec: dict, conn) -> None:
 # -------------------------------------------------------------- parent side
 
 
+def _garble_desc(plane, off: int, ln: int, bounds: list, cap: int):
+    """One seeded descriptor corruption (shm.desc_garble): the three
+    shapes a hostile pipe produces — a length past the ring, an offset
+    past the published window, a bounds vector inconsistent with the
+    length. Every shape MUST be caught by the child's _desc_ok gate
+    before any shared-memory dereference."""
+    rng, lock = plane._streams["shm.desc_garble"]
+    with lock:
+        shape = rng.randrange(3)
+        jitter = rng.randrange(1, 1 << 20)
+    if shape == 0:
+        return off, cap + jitter, bounds
+    if shape == 1:
+        return off + cap + jitter, ln, bounds
+    garbled = list(bounds)
+    garbled[-1] = garbled[-1] + jitter
+    return off, ln, garbled
+
+
 class ProcLane:
     """Parent-side handle for one lane process: its shm ring + inflight
     slot, descriptor pipe, and the live Process object."""
@@ -444,6 +583,20 @@ class ProcLane:
             return False
         try:
             os.kill(p.pid, 9)
+            return True
+        except OSError:
+            return False
+
+    def sigstop(self) -> bool:
+        """The fault plane's lane.sigstop arm: a REAL SIGSTOP — the
+        wedged-but-alive shape. The child stays is_alive() with frozen
+        status beats; recovery is the supervisor's stall-kill (SIGKILL
+        is deliverable to a stopped process)."""
+        p = self.proc
+        if p is None or not p.is_alive() or p.pid is None:
+            return False
+        try:
+            os.kill(p.pid, signal.SIGSTOP)
             return True
         except OSError:
             return False
@@ -502,6 +655,15 @@ class ProcLaneSet:
             "kwok_lane_proc_restarts_total",
             "Lane worker-process respawns by the supervisor (SIGKILL, "
             "crash, or chaos worker.kill), by shard.",
+            ("shard",),
+        )
+        self._m_stall_kills = r.counter(
+            "kwok_lane_stall_kills_total",
+            "Wedged-but-alive lane children SIGKILLed by the supervisor "
+            "because their 50ms StatusBank beat went older than "
+            "KWOK_TPU_LANE_STALL_S (a stopped/GIL-seized process, not a "
+            "crash — crashes ride kwok_lane_proc_restarts_total without "
+            "this), by shard.",
             ("shard",),
         )
         self._m_handoff = r.histogram(
@@ -571,12 +733,17 @@ class ProcLaneSet:
         faults = self.parent._faults
         if faults is not None:
             for lane in self.lanes:
-                faults.register_proc_target(lane.name, lane.sigkill)
+                faults.register_proc_target(
+                    lane.name, lane.sigkill, lane.sigstop
+                )
 
     def _lane_spec(self, lane: ProcLane) -> dict:
+        from kwok_tpu.resilience.faults import child_spec_text
+
         trace_base = self.parent.config.trace_dump or os.environ.get(
             "KWOK_TPU_TRACE", ""
         )
+        pf = self.parent._faults
         return {
             "index": lane.index,
             "n": self.n,
@@ -596,6 +763,16 @@ class ProcLaneSet:
             "trace_dump": (
                 f"{trace_base}.lane{lane.index}" if trace_base else ""
             ),
+            # per-lane child fault plane (ISSUE 17): the parent's spec
+            # filtered to the kinds the child's boundaries own, re-keyed
+            # lane=<i> so every stream re-seeds as (seed, lane, kind);
+            # "off" when the parent has no plane or nothing survives
+            "faults": child_spec_text(
+                pf.spec if pf is not None else None, lane.index
+            ),
+            # shard-scoped anti-entropy: the parent's RESOLVED interval
+            # (0 keeps the child's auditor off via the -1 config force)
+            "audit_interval": float(self.parent._audit_interval),
         }
 
     def _spawn_lane(self, lane: ProcLane) -> None:
@@ -906,6 +1083,26 @@ class ProcLaneSet:
                 return
             time.sleep(0.001)
             off = lane.ring.try_write(blob)
+        faults = self.parent._faults
+        if faults is not None:
+            if faults.decide("shm.desc_drop") is not None:
+                # the descriptor dies between ring write and pipe send:
+                # the blob's bytes retire implicitly (the reader's next
+                # good read sets the R cursor absolutely), and the drop
+                # must schedule the re-list or the events are permanent
+                # divergence — same recovery as the ring-stall drop
+                faults.record("shm.desc_drop")
+                self.parent.telemetry.inc("dropped_jobs_total", len(parts))
+                self.parent._integrity_resync(kind)
+                return
+            if faults.decide("shm.desc_garble") is not None:
+                faults.record("shm.desc_garble")
+                off, blob_len, bounds = _garble_desc(
+                    faults, off, len(blob), bounds, lane.ring.cap
+                )
+                self._send(lane, ("RAWB", kind, off, blob_len, bounds))
+                self._m_handoff.observe(time.perf_counter() - t0)
+                return
         self._send(lane, ("RAWB", kind, off, len(blob), bounds))
         self._m_handoff.observe(time.perf_counter() - t0)
 
@@ -946,6 +1143,14 @@ class ProcLaneSet:
         except (OSError, ValueError, BrokenPipeError):
             # dead child mid-send: the supervisor owns recovery
             swallowed("proclanes.send_dead_lane")
+
+    def quiesce_child_faults(self) -> None:
+        """Broadcast FAULTSOFF to every lane child: zero their planes'
+        rates over the descriptor pipe. The benchmark quiesce phase —
+        the caller clears the PARENT's rates itself; convergence/repair
+        oracles then run fault-free on both sides of the boundary."""
+        for lane in self.lanes:
+            self._send(lane, ("FAULTSOFF",))
 
     @staticmethod
     def _rec_key(rec):
@@ -999,7 +1204,18 @@ class ProcLaneSet:
                                 "%.0fs); killing for respawn",
                                 lane.index, _STALL_NS / 1e9,
                             )
-                            lane.sigkill()
+                            if lane.sigkill():
+                                # a stall-kill is NOT a crash: count it
+                                # apart from the respawn counter, and
+                                # degrade transiently (cleared at the
+                                # respawn — the shard is dark until
+                                # then) so /readyz tells the truth
+                                self._m_stall_kills.labels(
+                                    shard=str(lane.index)
+                                ).inc()
+                                parent._degradation.set(
+                                    f"lane{lane.index}_stalled"
+                                )
                     continue
                 rc = p.exitcode
                 logger.warning(
@@ -1056,6 +1272,9 @@ class ProcLaneSet:
         self._spawn_lane(lane)
         lane.restarts += 1
         self._m_restarts.labels(shard=str(lane.index)).inc()
+        # a stall-killed lane is back: the transient degraded reason
+        # (set by the supervisor's wedged-child branch) lifts here
+        self.parent._degradation.clear(f"lane{lane.index}_stalled")
         worker_restarted(lane.name)
         logger.warning("lane %d respawned (pid %s)", lane.index,
                        lane.proc.pid)
@@ -1161,6 +1380,22 @@ class ProcLaneSet:
                             "streams", i,
                         )
                         parent.resync_streams()
+            # drift mirror (ISSUE 17): any child whose shard-scoped
+            # auditor holds an unrepaired-divergence streak publishes
+            # BANK_DRIFT=1; the parent's /readyz degrades on "drift"
+            # exactly as the single-process auditor would, and clears
+            # once every child's streak has healed
+            if any(
+                int(rows[lane.index, shm_mod.BANK_DRIFT])
+                for lane in self.lanes
+            ):
+                if parent._degradation.set("drift"):
+                    logger.warning(
+                        "lane auditor reported an unrepaired-divergence "
+                        "streak; engine degraded (drift)"
+                    )
+            elif parent._degradation.clear("drift"):
+                logger.info("lane drift repaired; degraded reason cleared")
             if self._shed_depth:
                 # shed-clear, the LaneSet drain_loop contract: backlog
                 # halved -> clear the degraded reason + resync (shed

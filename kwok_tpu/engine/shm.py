@@ -197,6 +197,17 @@ class InflightSlot:
         hdr[self.STATE] = 1
         return True
 
+    def torn_arm(self, payload: bytes) -> None:
+        """Fault-injection twin of :meth:`arm` (``shm.torn``): the writer
+        dies mid-copy — disarm fires, a PREFIX of the payload lands, and
+        length/state are never written. The documented invariant under
+        test: the torn re-arm parks as "empty" (:meth:`peek` -> None)."""
+        hdr = self.arena.hdr
+        hdr[self.STATE] = 0  # disarm-first, exactly like arm()
+        k = max(1, min(len(payload), self.cap) // 2)
+        self.arena.payload[:k] = payload[:k]
+        # ...writer SIGKILLed here: no LEN store, no state=1
+
     def clear(self) -> None:
         self.arena.hdr[self.STATE] = 0
 
@@ -227,6 +238,9 @@ BANK_PID = 7           # child's own pid (supervisor sanity)
 BANK_INTEG_NODES = 8   # integrity-doubt resync requests (nodes)
 BANK_INTEG_PODS = 9    # integrity-doubt resync requests (pods)
 BANK_REWIND = 10       # re-listed-rv-rewind detections (store restore)
+BANK_DRIFT = 11        # 1 while the child's auditor holds a "drift"
+#                        degraded reason (unrepaired-divergence streak);
+#                        the parent mirrors it into its own /readyz
 BANK_FIELDS = 12
 
 
@@ -287,6 +301,23 @@ class MetricsBank:
         hdr[self.LEN] = len(payload)
         hdr[self.SEQ] = seq + 2  # even: consistent again
         return True
+
+    def torn_write(self, payload: bytes) -> None:
+        """Fault-injection twin of :meth:`write` (``shm.torn``): the
+        writer dies mid-slab — seq goes odd, a PREFIX of the payload
+        lands, and neither length nor the closing even stamp is ever
+        written. Readers must back off (odd seq) and the NEXT live write
+        must restamp; both paths are pinned by tests/test_proclanes.py."""
+        if len(payload) > self.cap:
+            return
+        hdr = self.arena.hdr
+        seq = int(hdr[self.SEQ])
+        if seq % 2:
+            seq += 1
+        hdr[self.SEQ] = seq + 1  # odd: mid-write
+        k = max(1, len(payload) // 2)
+        self.arena.payload[:k] = payload[:k]
+        # ...writer SIGKILLed here: no LEN store, no even restamp
 
     def reset(self) -> None:
         """Respawn path: empty the slab (back to the never-published

@@ -58,6 +58,7 @@ from kwok_tpu.edge.kubeclient import (
     ContinueExpired,
     TooManyRequests,
 )
+from kwok_tpu.engine.rowpool import shard_of
 from kwok_tpu.models.lifecycle import NODE_PHASES
 from kwok_tpu.resilience.checkpoint import row_uid
 
@@ -121,6 +122,14 @@ class AntiEntropyAuditor:
         self.settle_s = float(settle_s) or max(
             0.2, 3.0 * float(engine.config.tick_interval)
         )
+        # hash-shard scope (ISSUE 17): a --lane-procs CHILD audits only
+        # the keys its lane owns — LIST windows are filtered by
+        # rowpool.shard_of, so two lanes never double-repair one object
+        # and repairs re-ingest through the OWNING lane's queue (per-key
+        # order preserved by construction). (1, 0) everywhere else:
+        # parent/threaded engines audit the whole keyspace.
+        self.shard_i = int(getattr(engine, "_lane_index", 0))
+        self.shard_n = int(getattr(engine, "_lane_n", 1))
         self._ae_lock = threading.Lock()
         self._cursor: dict[str, str] = {"nodes": "", "pods": ""}
         self._cycle_seen: dict[str, set] = {"nodes": set(), "pods": set()}
@@ -246,6 +255,14 @@ class AntiEntropyAuditor:
                 continue
             ns = meta.get("namespace") or "default"
             key = (ns, name) if kind == "pods" else name
+            if self.shard_n > 1 and (
+                shard_of(key, self.shard_n) != self.shard_i
+            ):
+                # another lane's shard: its own auditor covers it (a
+                # node outside the shard is the topology TAP's — no row
+                # here, and classifying it would flag a false
+                # missed-event every cycle)
+                continue
             with self._ae_lock:
                 seen.add(key)
             reason = self._classify(kind, key, obj)

@@ -34,9 +34,14 @@ Spec grammar (``EngineConfig.faults`` / ``KWOK_TPU_FAULTS``)::
 
 Entries are ``;``-separated ``key=value`` pairs. Probability-valued keys
 take ``p`` or ``p:arg`` (``pump.delay``'s arg is seconds of sleep,
-``api.blackout``'s the blackout window length). ``worker.kill`` takes
-``<name-glob>:<period-seconds>``: every period, one live matching worker
-is killed, rotating through matches. See docs/resilience.md.
+``api.blackout``'s the blackout window length). ``worker.kill`` and
+``lane.sigstop`` take ``<name-glob>:<period-seconds>``: every period,
+one live matching worker/process is killed (or SIGSTOPped), rotating
+through matches. Under ``--lane-procs`` the parent derives each child's
+plane via :func:`child_spec_text` — the CHILD_KINDS subset re-seeded as
+``(seed, lane_index, kind)`` — and the shm/IPC tier (``shm.torn``,
+``shm.desc_drop``, ``shm.desc_garble``, ``shm.stall``) exercises the
+ring/descriptor/seqlock surfaces. See docs/resilience.md.
 """
 
 from __future__ import annotations
@@ -79,6 +84,24 @@ KINDS = (
     "wire.dup",       # replay the immediately-prior event/line
     "wire.stale",     # re-deliver an OLD event (regressed resourceVersion)
     "clock.jump",     # skew the engine's `now` by uniform(-arg, +arg)
+    # shm/IPC tier (ISSUE 17): faults on the --lane-procs surfaces
+    "shm.torn",       # writer dies mid-slab (odd seq / half-armed slot)
+    "shm.desc_drop",  # a ring descriptor is lost before the pipe send
+    "shm.desc_garble",  # descriptor corrupted in flight (bounds-reject)
+    "shm.stall",      # child pauses ring consumption for arg seconds
+)
+
+# the subset of kinds a lane CHILD's plane may carry: faults on the
+# child's own boundaries (its HttpKubeClient, its pumps, its clock, its
+# shm consumer/publisher side). Ingest faults (watch.*, list.fail,
+# api.blackout on the watch plane), router-side shm faults and real
+# signal delivery (worker.kill / lane.sigstop) stay on the parent, which
+# owns those surfaces.
+CHILD_KINDS = (
+    "pump.drop", "pump.partial", "pump.delay",
+    "wire.garble", "wire.truncate", "wire.dup", "wire.stale",
+    "clock.jump",
+    "shm.torn", "shm.stall",
 )
 
 
@@ -128,6 +151,13 @@ class FaultSpec:
         self.rates: dict[str, _Rate] = rates or {}
         self.kill_glob = ""
         self.kill_period = 0.0
+        self.sigstop_glob = ""
+        self.sigstop_period = 0.0
+        # lane index of the child plane this spec was derived for; -1 on
+        # a parent/threaded plane. Folded into every stream seed so the
+        # same parent spec gives each lane a DIFFERENT but reproducible
+        # decision sequence.
+        self.lane = -1
 
     @classmethod
     def parse(cls, text: str) -> "FaultSpec":
@@ -144,16 +174,23 @@ class FaultSpec:
             if key == "seed":
                 spec.seed = int(value)
                 continue
-            if key == "worker.kill":
+            if key == "lane":
+                spec.lane = int(value)
+                continue
+            if key in ("worker.kill", "lane.sigstop"):
                 glob, _, period = value.rpartition(":")
                 if not glob:
                     raise ValueError(
-                        "worker.kill takes <name-glob>:<period-seconds>"
+                        f"{key} takes <name-glob>:<period-seconds>"
                     )
-                spec.kill_glob = glob
-                spec.kill_period = float(period)
-                if spec.kill_period <= 0:
-                    raise ValueError("worker.kill period must be > 0")
+                if float(period) <= 0:
+                    raise ValueError(f"{key} period must be > 0")
+                if key == "worker.kill":
+                    spec.kill_glob, spec.kill_period = glob, float(period)
+                else:
+                    spec.sigstop_glob, spec.sigstop_period = (
+                        glob, float(period)
+                    )
                 continue
             if key not in KINDS:
                 raise ValueError(
@@ -166,6 +203,49 @@ class FaultSpec:
     def rate(self, kind: str) -> "_Rate | None":
         return self.rates.get(kind)
 
+    def render(self) -> str:
+        """Serialize back to the spec grammar (parse(render()) is
+        equivalent). The propagation surface: the parent renders each
+        lane's derived child spec into the spawn payload."""
+        parts = [f"seed={self.seed}"]
+        if self.lane >= 0:
+            parts.append(f"lane={self.lane}")
+        for kind in KINDS:  # KINDS order: deterministic text
+            rate = self.rates.get(kind)
+            if rate is None:
+                continue
+            if rate.arg:
+                parts.append(f"{kind}={rate.p}:{rate.arg}")
+            else:
+                parts.append(f"{kind}={rate.p}")
+        if self.kill_glob:
+            parts.append(f"worker.kill={self.kill_glob}:{self.kill_period}")
+        if self.sigstop_glob:
+            parts.append(
+                f"lane.sigstop={self.sigstop_glob}:{self.sigstop_period}"
+            )
+        return ";".join(parts)
+
+
+def child_spec_text(spec: "FaultSpec | None", lane_index: int) -> str:
+    """Derive the fault spec a lane child should run: the parent's rates
+    restricted to CHILD_KINDS (the boundaries the child actually owns),
+    re-keyed with ``lane=<i>`` so every stream re-seeds as
+    (seed, lane_index, kind). Signal delivery and ingest faults never
+    propagate. Returns the literal ``"off"`` when nothing survives the
+    filter — the child then builds NO plane (zero-cost contract), even
+    when KWOK_TPU_FAULTS is set in the inherited environment."""
+    if spec is None:
+        return "off"
+    child = FaultSpec(seed=spec.seed)
+    child.lane = int(lane_index)
+    child.rates = {
+        k: v for k, v in spec.rates.items() if k in CHILD_KINDS
+    }
+    if not child.rates:
+        return "off"
+    return child.render()
+
 
 class FaultPlane:
     """One seeded instance of the fault plane: decision streams, the
@@ -174,11 +254,16 @@ class FaultPlane:
     def __init__(self, spec: FaultSpec):
         self.spec = spec
         # per-site decision streams: one Random per kind, seeded from
-        # (seed, kind), each behind its own lock so a site's sequence is
-        # a pure function of its own call count (thread interleaving
-        # across sites cannot perturb it)
+        # (seed, kind) — (seed, lane, kind) on a lane child's plane —
+        # each behind its own lock so a site's sequence is a pure
+        # function of its own call count (thread interleaving across
+        # sites cannot perturb it)
+        _lane = f"L{spec.lane}:" if spec.lane >= 0 else ""
         self._streams = {
-            kind: (random.Random(f"{spec.seed}:{kind}"), threading.Lock())
+            kind: (
+                random.Random(f"{spec.seed}:{_lane}{kind}"),
+                threading.Lock(),
+            )
             for kind in KINDS
         }
         # blackout state: monotonic deadline; reads are lock-free (float
@@ -199,6 +284,10 @@ class FaultPlane:
         # names, so `worker.kill=kwok-lane*` kills processes under
         # --lane-procs and threads otherwise.
         self._proc_targets: dict = {}
+        # lane.sigstop targets: name -> callable delivering SIGSTOP (the
+        # wedged-but-alive shape; the supervisor's stall-kill recovers)
+        self._stop_targets: dict = {}
+        self._stopper: "threading.Thread | None" = None
 
     # ------------------------------------------------------------ decisions
 
@@ -305,18 +394,24 @@ class FaultPlane:
     # --------------------------------------------------------- worker kills
 
     def start(self) -> None:
-        """Arm the worker-killer thread (when the spec asks for one).
-        Refcounted: engines sharing the plane start/stop it together."""
+        """Arm the worker-killer / lane-stopper threads (when the spec
+        asks for them). Refcounted: engines sharing the plane start/stop
+        them together."""
         with self._fault_lock:
             self._started += 1
-            if self._killer is not None or not self.spec.kill_glob:
+            if self._started > 1:
                 return
             self._stop.clear()
             from kwok_tpu.workers import spawn_worker
 
-            self._killer = spawn_worker(
-                self._kill_loop, name="kwok-chaos-killer"
-            )
+            if self._killer is None and self.spec.kill_glob:
+                self._killer = spawn_worker(
+                    self._kill_loop, name="kwok-chaos-killer"
+                )
+            if self._stopper is None and self.spec.sigstop_glob:
+                self._stopper = spawn_worker(
+                    self._sigstop_loop, name="kwok-chaos-stopper"
+                )
 
     def stop(self) -> None:
         with self._fault_lock:
@@ -324,9 +419,13 @@ class FaultPlane:
             if self._started:
                 return
             killer, self._killer = self._killer, None
-        if killer is not None:
+            stopper, self._stopper = self._stopper, None
+        if killer is not None or stopper is not None:
             self._stop.set()
+        if killer is not None:
             killer.join(timeout=5)
+        if stopper is not None:
+            stopper.join(timeout=5)
 
     # Threads the spec-driven killer may target: ONLY the watchdog-
     # supervised workers — lane workers (LaneSet.start_workers) and,
@@ -341,15 +440,21 @@ class FaultPlane:
         "kwok-lane", "kwok-emit", "kwok-route", "kwok-watch",
     )
 
-    def register_proc_target(self, name: str, kill_fn) -> None:
+    def register_proc_target(self, name: str, kill_fn, stop_fn=None) -> None:
         """Expose a supervised lane PROCESS to the worker.kill rotation;
-        ``kill_fn()`` must deliver SIGKILL and return whether it did."""
+        ``kill_fn()`` must deliver SIGKILL and return whether it did.
+        ``stop_fn()`` (optional) delivers SIGSTOP for the lane.sigstop
+        rotation — the wedged-but-alive shape whose recovery is the
+        supervisor's KWOK_TPU_LANE_STALL_S stall-kill."""
         with self._fault_lock:
             self._proc_targets[name] = kill_fn
+            if stop_fn is not None:
+                self._stop_targets[name] = stop_fn
 
     def unregister_proc_target(self, name: str) -> None:
         with self._fault_lock:
             self._proc_targets.pop(name, None)
+            self._stop_targets.pop(name, None)
 
     def _kill_loop(self) -> None:
         from kwok_tpu.workers import live_workers
@@ -394,6 +499,43 @@ class FaultPlane:
                     {"thread": name, "proc": True, "t": time.monotonic()}
                 )
             logger.warning("chaos: SIGKILLed lane process %s", name)
+        return ok
+
+    def _sigstop_loop(self) -> None:
+        """Rotate SIGSTOP through registered lane processes matching the
+        lane.sigstop glob. The stopped child keeps its shm maps and pipe
+        but its StatusBank beat freezes — the parent's supervisor must
+        stall-kill (SIGKILL works on a stopped process) and respawn."""
+        nth = 0
+        while not self._stop.wait(self.spec.sigstop_period):
+            with self._fault_lock:
+                stops = dict(self._stop_targets)
+            names = sorted(
+                n for n in stops
+                if fnmatch.fnmatch(n, self.spec.sigstop_glob)
+            )
+            if not names:
+                continue
+            name = names[nth % len(names)]
+            nth += 1
+            self.stop_process(name, stops[name])
+
+    def stop_process(self, name: str, stop_fn) -> bool:
+        """SIGSTOP a registered lane process (wedged-but-alive: counted
+        like a kill, recovered by the supervisor's stall-kill)."""
+        try:
+            ok = bool(stop_fn())
+        except Exception:
+            logger.exception("chaos: SIGSTOP of %s failed", name)
+            return False
+        if ok:
+            self.record("lane.sigstop")
+            with self._fault_lock:
+                self._kill_results.append(
+                    {"thread": name, "proc": True, "stop": True,
+                     "t": time.monotonic()}
+                )
+            logger.warning("chaos: SIGSTOPped lane process %s", name)
         return ok
 
     def kill_worker(self, name: str) -> bool:
@@ -658,8 +800,10 @@ def from_config(spec_text: str = "") -> "FaultPlane | None":
     """The engine's entry point: a FaultPlane when a spec is configured
     (EngineConfig.faults, falling back to KWOK_TPU_FAULTS), else None —
     the disabled case allocates nothing and wraps nothing. The literal
-    ``"off"`` disables the plane even when the env var is set (lane
-    child engines use it: ONE plane per engine, the parent's)."""
+    ``"off"`` disables the plane even when the env var is set (a lane
+    child whose parent has no plane — or no child-side kinds — receives
+    it via :func:`child_spec_text`, so an inherited KWOK_TPU_FAULTS can
+    never resurrect a plane the parent decided against)."""
     import os
 
     text = (spec_text or os.environ.get("KWOK_TPU_FAULTS", "")).strip()

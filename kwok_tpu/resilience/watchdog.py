@@ -84,12 +84,18 @@ class Watchdog:
         """Account one external restart of ``name`` against the SAME
         budget window in-thread supervision uses; returns whether the
         restart is allowed. The process-lane supervisor
-        (engine/proclanes.py) charges lane-process respawns here so a
+        (engine/proclanes.py) charges lane-process respawns here — a
         crash-looping process degrades exactly like a crash-looping
-        thread."""
+        thread, and the respawn joins the restart ledger (marked
+        ``proc``) so the chaos artifacts see one unified surface for
+        thread restarts, SIGKILL respawns, and stall-kill respawns."""
         if self._closed:
             return False
-        return self._allow(name, time.monotonic())
+        allowed = self._allow(name, time.monotonic())
+        if allowed:
+            with self._wd_lock:
+                self._log.append({"thread": name, "proc": True})
+        return allowed
 
     # -------------------------------------------------------- supervision
 

@@ -495,3 +495,51 @@ def test_expired_continue_token_is_not_a_completed_cycle():
     assert aud._cursor["pods"] == ""  # scan restarts from the top
     # and no ghost sweep happened: a pass confirms nothing
     assert aud._scan_kind("pods") == []
+
+
+def test_proc_lane_auditor_scopes_to_its_shard():
+    """A lane child's auditor (an engine carrying _lane_index/_lane_n)
+    audits ONLY its own hash shard: keys the router owns to OTHER lanes
+    are skipped entirely — never flagged missed-event here, never
+    double-repaired — while in-shard divergence is still detected."""
+    from kwok_tpu.engine.rowpool import shard_of
+
+    kube = FakeKube()
+    eng = _sync_engine(kube)
+    # what _make_lane_engine stamps onto a lane child: lane 0 of 2
+    eng._lane_index, eng._lane_n = 0, 2
+    kube.create("nodes", make_node("ae-n"))
+    if shard_of("ae-n", 2) == 0:
+        eng._ingest("nodes", "ADDED", kube.get("nodes", None, "ae-n"))
+    mine, theirs = [], []
+    i = 0
+    while len(mine) < 3 or len(theirs) < 3:
+        name = f"shp{i}"
+        i += 1
+        kube.create("pods", make_pod(name, node="ae-n"))
+        if shard_of(("default", name), 2) == 0:
+            # the router hands lane 0 only its own shard's events
+            eng._ingest(
+                "pods", "ADDED", kube.get("pods", "default", name)
+            )
+            mine.append(name)
+        else:
+            theirs.append(name)
+    _drain(eng)
+    aud = _auditor(eng)
+    assert (aud.shard_i, aud.shard_n) == (0, 2)
+    # two passes (a full ghost cycle): the other shard's un-ingested
+    # pods are NOT missed-events for this lane
+    aud.pass_once()
+    aud.pass_once()
+    assert aud.detected_total() == 0
+    # a silent delete on an out-of-shard pod is the OTHER lane's job
+    _silent_delete(kube, "pods", "default", theirs[0])
+    aud.pass_once()
+    assert aud.detected_total() == 0
+    # the same divergence on an in-shard pod is detected here
+    _silent_delete(kube, "pods", "default", mine[0])
+    aud.pass_once()
+    assert aud.detected_total(reason="ghost-row") == 1
+    _drain(eng)  # apply the synthetic DELETED
+    assert eng.pods.pool.lookup(("default", mine[0])) is None

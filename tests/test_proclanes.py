@@ -545,3 +545,240 @@ def test_proc_lanes_metrics_merge_exposes_shard_families():
             eng.stop()
         srv.stop()
     assert not _shm_leftovers(), "leaked /dev/shm segments"
+
+
+# ----------------------------------------- per-lane fault planes (ISSUE 17)
+
+
+def test_child_spec_text_filters_kinds_and_stamps_lane():
+    from kwok_tpu.resilience.faults import (
+        CHILD_KINDS,
+        FaultSpec,
+        child_spec_text,
+    )
+
+    parent = FaultSpec.parse(
+        "seed=42;pump.drop=0.1;wire.garble=0.05;clock.jump=0.01:0.2;"
+        "shm.torn=0.02;shm.stall=0.01:1.5;shm.desc_drop=0.1;"
+        "watch.cut=0.2;list.fail=0.3;api.blackout=0.1:0.5;"
+        "worker.kill=kwok-lane*:2.0;lane.sigstop=kwok-lane*:3.0"
+    )
+    child = FaultSpec.parse(child_spec_text(parent, 1))
+    # the child slice carries exactly the parent's CHILD_KINDS rates
+    assert set(child.rates) == {
+        k for k in parent.rates if k in CHILD_KINDS
+    }
+    assert child.rates, "child-side kinds must survive"
+    # ingest faults, signal delivery, and router-side shm faults stay out
+    for banned in ("watch.cut", "list.fail", "api.blackout",
+                   "shm.desc_drop"):
+        assert banned not in child.rates
+    assert child.kill_glob == "" and child.sigstop_glob == ""
+    # seed survives, lane is stamped, args ride along
+    assert child.seed == 42 and child.lane == 1
+    assert child.rates["clock.jump"].arg == pytest.approx(0.2)
+    assert child.rates["shm.stall"].arg == pytest.approx(1.5)
+
+
+def test_child_spec_text_off_both_directions(monkeypatch):
+    from kwok_tpu.resilience.faults import (
+        FaultSpec,
+        child_spec_text,
+        from_config,
+    )
+
+    # no parent plane -> literal off
+    assert child_spec_text(None, 0) == "off"
+    # a spec with only parent-side kinds -> nothing survives -> off
+    parent = FaultSpec.parse(
+        "seed=7;watch.expire=0.5;worker.kill=kwok-lane*:2.0"
+    )
+    assert child_spec_text(parent, 0) == "off"
+    # and "off" beats an inherited env var: the child builds NO plane
+    monkeypatch.setenv("KWOK_TPU_FAULTS", "seed=1;pump.drop=1.0")
+    assert from_config("off") is None
+    # while a real child slice still builds one
+    plane = from_config(child_spec_text(
+        FaultSpec.parse("seed=7;pump.drop=0.5"), 3,
+    ))
+    assert plane is not None and plane.spec.lane == 3
+    assert from_config(child_spec_text(parent, 0)) is None
+
+
+def test_child_plane_per_lane_seed_determinism():
+    from kwok_tpu.resilience.faults import (
+        FaultPlane,
+        FaultSpec,
+        child_spec_text,
+    )
+
+    parent = FaultSpec.parse("seed=11;pump.drop=0.5;shm.torn=0.5")
+
+    def draws(lane_index):
+        plane = FaultPlane(FaultSpec.parse(
+            child_spec_text(parent, lane_index)
+        ))
+        return [
+            plane.decide(kind) is not None
+            for kind in ("pump.drop", "shm.torn") * 64
+        ]
+
+    # same lane -> the exact same decision sequence (reproducible)
+    assert draws(0) == draws(0)
+    assert draws(1) == draws(1)
+    # different lanes -> different sequences from the same parent spec
+    assert draws(0) != draws(1)
+    # and the un-laned parent differs from every child stream
+    assert FaultPlane(parent).spec.lane == -1
+    parent_draws = [
+        FaultPlane(parent).decide(k) is not None
+        for k in ("pump.drop", "shm.torn") * 64
+    ]
+    assert parent_draws != draws(0)
+
+
+def test_fault_spec_render_parse_roundtrip():
+    from kwok_tpu.resilience.faults import FaultSpec
+
+    text = ("seed=5;lane=2;pump.delay=0.1:0.05;wire.dup=0.2;"
+            "shm.stall=0.3:2.5;worker.kill=kwok-lane*:4.0;"
+            "lane.sigstop=kwok-lane*:6.0")
+    spec = FaultSpec.parse(text)
+    again = FaultSpec.parse(spec.render())
+    assert again.seed == 5 and again.lane == 2
+    assert {k: (v.p, v.arg) for k, v in again.rates.items()} == {
+        k: (v.p, v.arg) for k, v in spec.rates.items()
+    }
+    assert (again.kill_glob, again.kill_period) == ("kwok-lane*", 4.0)
+    assert (again.sigstop_glob, again.sigstop_period) == ("kwok-lane*", 6.0)
+    # render is deterministic text (the spawn-payload surface)
+    assert spec.render() == again.render()
+
+
+def test_fault_plane_sigstop_targets():
+    from kwok_tpu.resilience.faults import FaultPlane, FaultSpec
+
+    plane = FaultPlane(FaultSpec.parse("lane.sigstop=kwok-lane*:5.0"))
+    stopped = []
+    plane.register_proc_target(
+        "kwok-lane0", lambda: True, lambda: stopped.append(0) or True,
+    )
+    assert plane.stop_process(
+        "kwok-lane0", plane._stop_targets["kwok-lane0"]
+    )
+    assert stopped == [0]
+    assert plane.counts().get("lane.sigstop") == 1
+    assert any(
+        r.get("stop") and r.get("proc") for r in plane.kill_log()
+    )
+    plane.unregister_proc_target("kwok-lane0")
+    assert "kwok-lane0" not in plane._stop_targets
+
+
+# -------------------------------------- torn-write invariants (ISSUE 17)
+
+
+def test_slot_guard_pump_injected_torn_arm_parks_empty():
+    """shm.torn through the REAL injected path: a prior armed batch is
+    disarmed, a prefix of the new payload lands, and the post-mortem
+    peek() parks the slot as empty — never state=1 over mixed bytes."""
+    from kwok_tpu.resilience.faults import FaultPlane, FaultSpec
+
+    slot = shm_mod.InflightSlot(shm_mod.arena_name("t-torn-arm"), 4096,
+                                create=True)
+    try:
+        # a previous incarnation's batch is still armed
+        assert slot.arm(pickle.dumps([("PATCH", "/old", b"{}", "ct")]))
+        assert slot.peek() is not None
+        plane = FaultPlane(FaultSpec.parse("seed=1;shm.torn=1.0"))
+        reqs = [("PATCH", "/api/v1/new", b"{}",
+                 "application/merge-patch+json")]
+        g = _SlotGuardPump(slot, _StubPump([[0]]), plane)
+        g.send(reqs)  # status 0: a clean send would have kept the slot
+        # the torn re-arm must read as EMPTY, not the old batch and not
+        # a half-copied new one
+        assert slot.peek() is None
+        assert plane.counts().get("shm.torn") == 1
+    finally:
+        slot.close(unlink=True)
+
+
+def test_metrics_bank_injected_torn_write_backoff_and_restamp():
+    """shm.torn on the seqlock slab: the torn slab is never parsed
+    (readers back off on the odd stamp) and the next live write restamps
+    from the odd base — the crashed-writer recovery under test."""
+    bank = shm_mod.MetricsBank(shm_mod.arena_name("t-torn-mb"), 4096,
+                               create=True)
+    try:
+        reader = shm_mod.MetricsBank(bank.name)
+        try:
+            assert bank.write(b'{"gen": 1}')
+            assert reader.read() == b'{"gen": 1}'
+            bank.torn_write(b'{"gen": 2, "pad": "x"}')
+            seq = int(bank.arena.hdr[shm_mod.MetricsBank.SEQ])
+            assert seq % 2 == 1, "torn write must leave an odd stamp"
+            # a torn slab is never parsed: bounded retries, then None
+            assert reader.read(retries=3) is None
+            # the next live write restamps and publishes consistently
+            assert bank.write(b'{"gen": 3}')
+            assert int(bank.arena.hdr[shm_mod.MetricsBank.SEQ]) % 2 == 0
+            assert reader.read() == b'{"gen": 3}'
+        finally:
+            reader.close()
+    finally:
+        bank.close(unlink=True)
+
+
+# ------------------------------------ descriptor validation (ISSUE 17)
+
+
+def test_desc_check_reason_branches():
+    from kwok_tpu.engine.proclanes import _desc_check
+
+    cap, published = 1024, 512
+    ok = ("pods", 0, 100, [0, 40, 100])
+    assert _desc_check(*ok, cap, published) is None
+    assert _desc_check("bogus", 0, 100, [0, 100], cap, published) == "kind"
+    assert _desc_check("pods", "0", 100, [0, 100], cap, published) == "type"
+    assert _desc_check("pods", 0, 1.5, [0, 100], cap, published) == "type"
+    assert _desc_check("pods", 0, cap + 1, [0], cap, published) == "range"
+    assert _desc_check("pods", -1, 100, [0, 100], cap, published) == "range"
+    assert _desc_check("pods", 0, -5, [0], cap, published) == "range"
+    assert _desc_check(
+        "pods", published - 50, 100, [0, 100], cap, published
+    ) == "unpublished"
+    for bad_bounds in (
+        [],            # empty
+        [1, 100],      # does not start at 0
+        [0, 50, 40, 100],  # non-monotonic
+        [0, 200],      # past the length
+        [0, 40],       # terminal != length
+        [0, "x", 100],  # non-int
+        "nope",        # not a list
+    ):
+        assert _desc_check(
+            "pods", 0, 100, bad_bounds, cap, published
+        ) == "bounds", bad_bounds
+
+
+def test_garble_desc_every_shape_is_rejected():
+    """Every corruption _garble_desc can emit must be caught by the
+    child's bounds gate before any shm dereference — the no-wild-read
+    contract of shm.desc_garble."""
+    from kwok_tpu.engine.proclanes import _desc_check, _garble_desc
+    from kwok_tpu.resilience.faults import FaultPlane, FaultSpec
+
+    plane = FaultPlane(FaultSpec.parse("seed=9;shm.desc_garble=1.0"))
+    cap, published = 4096, 2048
+    off, ln, bounds = 128, 256, [0, 100, 256]
+    assert _desc_check("pods", off, ln, bounds, cap, published) is None
+    reasons = set()
+    for _ in range(64):
+        g_off, g_ln, g_bounds = _garble_desc(plane, off, ln, bounds, cap)
+        reason = _desc_check("pods", g_off, g_ln, g_bounds, cap, published)
+        assert reason is not None, (g_off, g_ln, g_bounds)
+        reasons.add(reason)
+    # all three corruption shapes showed up across 64 seeded draws
+    assert reasons == {"range", "unpublished", "bounds"}
+    # the original descriptor was never mutated in place
+    assert (off, ln, bounds) == (128, 256, [0, 100, 256])
