@@ -61,9 +61,13 @@ lane-check: ## sharded-lane ordering oracle + thread-sanity + lock-witness pass 
 # post-convergence silent mutations detected + repaired ->
 # PROC_r02.json), with /dev/shm proven clean after every arm
 # (docs/resilience.md "Process lanes" + "Multi-process fault plane &
-# audit"; PROC_r*.json).
+# audit"; PROC_r*.json). The pytest tier runs under BOTH runtime
+# witnesses: lock-order (analysis/witness.py) and the shm-protocol
+# witness (analysis/witness_shm.py) — every bank/ring/slot op is
+# checked against the seqlock/slot/ring contract while the shm fault
+# tier is injecting torn writes.
 proc-check: ## process-lane ordering + chaos/restart gate (PROC_r* artifact, shm-leak proof)
-	$(PYENV) python3 -m pytest tests/test_proclanes.py -q
+	$(PYENV) KWOK_TPU_LOCK_WITNESS=1 KWOK_TPU_SHM_WITNESS=1 python3 -m pytest tests/test_proclanes.py -q
 	$(PYENV) python3 benchmarks/proc_soak.py --check
 
 # chaos-check: the resilience suite (fault plane, retry policy, watchdog,
@@ -72,8 +76,10 @@ proc-check: ## process-lane ordering + chaos/restart gate (PROC_r* artifact, shm
 # mid-frame partial writes, watch cuts, 410/compaction storms, apiserver
 # blackouts, a killed drain worker AND a killed emit worker — must end
 # byte-identical to a fault-free run (docs/resilience.md; CHAOS_r*.json).
+# The pytest tier runs under the runtime lock-order witness so the storm
+# paths are deadlock-checked, not just convergence-checked.
 chaos-check: ## deterministic fault-injection + self-healing convergence gate (+ restore storm)
-	$(PYENV) python3 -m pytest tests/test_resilience.py -q
+	$(PYENV) KWOK_TPU_LOCK_WITNESS=1 python3 -m pytest tests/test_resilience.py -q
 	$(PYENV) python3 benchmarks/chaos_soak.py --check --restore-storm
 
 # restart-check: the crash-durability RTO gate: a real tpukwok process is

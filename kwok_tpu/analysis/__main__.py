@@ -12,6 +12,10 @@ import sys
 
 from kwok_tpu.analysis.core import Analyzer, all_rules
 
+#: disclosed runtime budget: analyze gates hack/verify-all.sh, so the
+#: whole rule pack must stay comfortably interactive
+BUDGET_S = 30.0
+
 
 def repo_root() -> str:
     """The tree kwoklint ships in: two levels above this package."""
@@ -38,6 +42,16 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--jsonl", action="store_true",
+        help="machine-readable output: one JSON object per finding, then "
+        "one {\"summary\": ...} line (overrides --format)",
+    )
+    parser.add_argument(
+        "--timings", action="store_true",
+        help="per-rule timing footer (text mode; always present in "
+        "--jsonl summaries)",
     )
     parser.add_argument(
         "--root", default=None,
@@ -72,11 +86,26 @@ def main(argv=None) -> int:
 
     analyzer = Analyzer(root, rules)
     findings, suppressed = analyzer.run(paths)
-    if args.format == "json":
+    timings = analyzer.timings
+    total = sum(timings.values())
+    if args.jsonl:
+        for f in findings:
+            print(json.dumps(vars(f), sort_keys=True))
+        print(json.dumps({"summary": {
+            "findings": len(findings),
+            "suppressed": suppressed,
+            "timings_s": {k: round(v, 4) for k, v in timings.items()},
+            "total_s": round(total, 4),
+            "budget_s": BUDGET_S,
+        }}, sort_keys=True))
+    elif args.format == "json":
         print(json.dumps(
             {
                 "findings": [vars(f) for f in findings],
                 "suppressed": suppressed,
+                "timings_s": {k: round(v, 4) for k, v in timings.items()},
+                "total_s": round(total, 4),
+                "budget_s": BUDGET_S,
             },
             indent=1,
         ))
@@ -85,6 +114,22 @@ def main(argv=None) -> int:
             print(f.format())
         tail = f"{len(findings)} finding(s), {suppressed} suppressed"
         print(f"kwoklint: {tail}" if findings else f"kwoklint: clean ({tail})")
+        if args.timings:
+            for name, secs in sorted(
+                timings.items(), key=lambda kv: -kv[1]
+            ):
+                print(f"  {name:22s} {secs:7.3f}s")
+            print(
+                f"  {'total':22s} {total:7.3f}s "
+                f"(budget {BUDGET_S:.0f}s — analyze gates verify-all and "
+                "must stay fast)"
+            )
+        if total > BUDGET_S:
+            print(
+                f"kwoklint: WARNING: analysis took {total:.1f}s, over the "
+                f"{BUDGET_S:.0f}s budget",
+                file=sys.stderr,
+            )
     return 1 if findings else 0
 
 

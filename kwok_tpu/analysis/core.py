@@ -14,6 +14,7 @@ import dataclasses
 import io
 import os
 import re
+import time
 import tokenize
 from typing import Iterable, Iterator
 
@@ -173,7 +174,9 @@ class Analyzer:
         by_rel = {m.rel: m for m in mods}
         findings: list[Finding] = []
         suppressed = 0
+        self.timings: dict[str, float] = {}
         for rule in self.rules:
+            t0 = time.perf_counter()
             for f in rule.check_project(mods, self.root):
                 mod = by_rel.get(f.path)
                 s = mod.consume_suppression(f.line, f.rule) if mod else None
@@ -181,6 +184,7 @@ class Analyzer:
                     suppressed += 1
                 else:
                     findings.append(f)
+            self.timings[rule.name] = time.perf_counter() - t0
         # a suppression may also silence a would-be finding at scan time
         # (blocking-under-lock markers stop transitive propagation at the
         # source); rules count those on the module as they scan
@@ -215,6 +219,11 @@ class Analyzer:
 def all_rules(root: str) -> list[Rule]:
     """The shipped rule pack. Imported lazily so `core` stays dependency-
     free for the witness (which loads in test processes)."""
+    from kwok_tpu.analysis.cclint import (
+        CcFenceFirstRule,
+        CcLockOrderRule,
+        CcSocketUnderLockRule,
+    )
     from kwok_tpu.analysis.hygiene import SilentExceptRule
     from kwok_tpu.analysis.locks import (
         BlockingUnderLockRule,
@@ -223,14 +232,21 @@ def all_rules(root: str) -> list[Rule]:
     )
     from kwok_tpu.analysis.metrics_doc import MetricsContractRule
     from kwok_tpu.analysis.purity import KernelPurityRule
+    from kwok_tpu.analysis.races import SharedStateRule
+    from kwok_tpu.analysis.shmproto import ShmProtocolRule
     from kwok_tpu.analysis.spawnonly import SpawnOnlyRule
 
     return [
         LockOrderRule(),
         BlockingUnderLockRule(),
         UnusedLockRule(),
+        SharedStateRule(),
+        ShmProtocolRule(),
         KernelPurityRule(),
         SilentExceptRule(),
         SpawnOnlyRule(),
         MetricsContractRule(doc_path=os.path.join(root, "docs", "observability.md")),
+        CcLockOrderRule(),
+        CcFenceFirstRule(),
+        CcSocketUnderLockRule(),
     ]

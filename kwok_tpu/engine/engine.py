@@ -996,6 +996,7 @@ class ClusterEngine:
             self._resync_req.add(kind)
             self._wire_doubt.add(kind)
         now = time.monotonic()
+        # kwoklint: lockfree=_wire_resync_at -- pacing timestamp only: a racy double-pass fires _integrity_fire twice, and that path is idempotent (the doubt set drains under _gen_lock); a lost store just re-opens the rate window early
         if now - self._wire_resync_at >= self._WIRE_RESYNC_MIN_S:
             self._wire_resync_at = now
             logger.warning(
@@ -1365,6 +1366,7 @@ class ClusterEngine:
         # its rows are still empty. Armed before the watch threads spawn;
         # the device-owning loop (tick thread / lane coordinator /
         # federated loop) finishes it.
+        # kwoklint: lockfree=_startup_pending,_startup_lanes,_startup_flush_wait,_restore,ready -- armed here on the caller's thread BEFORE any worker spawns (happens-before via Thread.start); afterwards only the single device-owning loop mutates them, and stop()'s ready=False is a plain bool store the loop no longer contends once _running drops
         self._startup_pending = {"nodes", "pods"}
         self._startup_lanes = {}
         self._startup_flush_wait = False
@@ -1533,6 +1535,7 @@ class ClusterEngine:
         np.asarray(wire)  # complete (and warm) the wire's D2H path
 
     def _get_fused(self) -> MultiTickKernel:
+        # kwoklint: lockfree=_fused -- memoized on the caller's thread before workers spawn (start()/prepare warm it via _warm_tick); workers only ever read the primed value back
         if self._fused is None:
             steps = max(1, int(self.config.tick_substeps))
             self._fused = MultiTickKernel(
@@ -1559,17 +1562,22 @@ class ClusterEngine:
         if timer is not None:
             timer.cancel()  # pending integrity-doubt cut dies with us
         if getattr(self, "_profiling", False):
-            # short runs stop before tick 102; flush the trace anyway
+            # short runs stop before tick 102; flush the trace anyway —
+            # but only if this thread wins the flag (the tick thread's
+            # _maybe_profile may be stopping the same trace right now)
             import jax
 
-            self._profiling = False
-            try:
-                jax.profiler.stop_trace()
-                logger.info(
-                    "profiler trace written to %s", self.config.profile_dir
-                )
-            except Exception:
-                logger.exception("profiler stop failed")
+            with self._gen_lock:
+                flush = getattr(self, "_profiling", False)
+                self._profiling = False
+            if flush:
+                try:
+                    jax.profiler.stop_trace()
+                    logger.info(
+                        "profiler trace written to %s", self.config.profile_dir
+                    )
+                except Exception:
+                    logger.exception("profiler stop failed")
         for w in list(self._watches.values()):
             try:
                 w.stop()
@@ -2820,10 +2828,16 @@ class ClusterEngine:
     def _node_deleted(self, node: dict) -> None:
         name = (node.get("metadata") or {}).get("name")
         k = self.nodes
-        idx = k.pool.release(name)
+        with self._alloc_lock:
+            # same discipline as _pod_deleted: the release and its
+            # sequence stamp are one atomic step — concurrent deletes
+            # must never mint duplicate released_at generations (the
+            # stale-mask filter keys on them)
+            idx = k.pool.release(name)
+            if idx is not None:
+                self._release_seq += 1
+                k.released_at[idx] = self._release_seq
         if idx is not None:
-            self._release_seq += 1
-            k.released_at[idx] = self._release_seq
             k.buffer.stage_init(idx, False)
         if name in self.node_has:
             self.node_has.discard(name)
@@ -2887,6 +2901,7 @@ class ClusterEngine:
         )
         m.pop("raw", None)  # the parsed object supersedes any raw line
         if self._trace_every:
+            # kwoklint: lockfree=_trace_n -- sampling cadence counter: a lost racy increment only shifts WHICH event gets traced, never correctness, and the hot ingest path must not take a lock for it
             self._trace_n += 1
             if self._trace_n % self._trace_every == 0:
                 # sampled end-to-end trace: the patch ack closes the span
@@ -3425,17 +3440,27 @@ class ClusterEngine:
             logger.exception("ingest failed for %s %s", kind, type_)
 
     def _maybe_profile(self) -> None:
+        # the flag transition is claimed under _gen_lock: stop()'s flush
+        # path contends with this during shutdown, and whoever flips the
+        # flag owns the matching profiler call — a double stop_trace
+        # raises inside the tick loop otherwise
         ticks = self.telemetry.ticks_total
         if ticks == 2 and not getattr(self, "_profiling", False):
             import jax
 
-            self._profiling = True
+            with self._gen_lock:
+                if getattr(self, "_profiling", False):
+                    return
+                self._profiling = True
             jax.profiler.start_trace(self.config.profile_dir)
             logger.info("profiler trace started -> %s", self.config.profile_dir)
         elif ticks >= 102 and getattr(self, "_profiling", False):
             import jax
 
-            self._profiling = False
+            with self._gen_lock:
+                if not getattr(self, "_profiling", False):
+                    return
+                self._profiling = False
             jax.profiler.stop_trace()
             logger.info("profiler trace written to %s", self.config.profile_dir)
 
@@ -3649,9 +3674,11 @@ class ClusterEngine:
             # warning + a count (also exported as kwok_dropped_jobs_total;
             # stop() logs the final tally): a flushed tick can carry
             # O(10k) jobs and per-job lines would flood the shutdown log.
-            self._dropped_jobs += 1
+            with self._gen_lock:
+                self._dropped_jobs += 1
+                first = self._dropped_jobs == 1
             self._inc("dropped_jobs_total")
-            if self._dropped_jobs == 1:
+            if first:
                 logger.warning(
                     "patch jobs dropped during shutdown (first: %s%r); "
                     "total reported at stop",
@@ -3724,6 +3751,7 @@ class ClusterEngine:
     def _get_pump(self):
         """Native pump bound to the client's plain-HTTP endpoint, or None
         (TLS/in-process clients keep the executor path)."""
+        # kwoklint: lockfree=_pump,_pump_tried,_pump_base,_pump_base_b -- memoized via _pump_tried before any contending worker runs (LaneSet.prepare primes it; see the blocking-under-lock note below); stop() clears _pump only after every worker is joined
         if self._pump_tried:
             return self._pump
         self._pump_tried = True
@@ -4218,7 +4246,8 @@ class ClusterEngine:
             # pump target down past the resend deadline: shed the batch
             # (counted) instead of converting it into thousands of
             # doomed per-object jobs that would wedge the executor
-            self._dropped_jobs += n
+            with self._gen_lock:
+                self._dropped_jobs += n
             self._inc("dropped_jobs_total", n)
             return
         ok = int(((status >= 200) & (status < 300)).sum())

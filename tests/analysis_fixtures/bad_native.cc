@@ -1,0 +1,116 @@
+// cc-lint fixture: the three native rules, each with violating and
+// compliant shapes. Parsed by the same line-level scanner as the real
+// native/*.cc tree; `// F: <rule>` marks every expected finding line.
+
+#include <mutex>
+
+struct Store {
+  std::mutex lease_mu;
+  std::mutex mu;
+  std::mutex ring_mu;
+};
+struct Shard {
+  std::mutex smu;
+};
+static Store store;
+static std::mutex shards_mu;
+
+// ---------------------------------------------------------- lock order
+
+void inversion(Shard* sh) {
+  std::lock_guard<std::mutex> lk(store.mu);
+  std::lock_guard<std::mutex> slk(sh->smu);  // F: cc-lock-order
+}
+
+void self_deadlock(Shard* a, Shard* b) {
+  std::lock_guard<std::mutex> la(a->smu);
+  std::lock_guard<std::mutex> lb(b->smu);  // F: cc-lock-order
+}
+
+void standalone_mix() {
+  std::lock_guard<std::mutex> lk(shards_mu);
+  std::lock_guard<std::mutex> rlk(store.ring_mu);  // F: cc-lock-order
+}
+
+void ordered_ok(Shard* sh) {
+  std::lock_guard<std::mutex> llk(store.lease_mu);
+  std::lock_guard<std::mutex> slk(sh->smu);
+  std::lock_guard<std::mutex> clk(store.mu);
+}
+
+void sequential_ok(Shard* sh) {
+  {
+    std::lock_guard<std::mutex> lk(store.mu);
+  }
+  {
+    std::lock_guard<std::mutex> slk(sh->smu);
+  }
+}
+
+// --------------------------------------------------------- fence first
+
+void commit_locked(Shard* sh);
+void prep();
+
+void mutate_unfenced(Shard* sh) {
+  std::unique_lock<std::mutex> fence_lk;  // F: cc-fence-first
+  std::lock_guard<std::mutex> slk(sh->smu);
+  commit_locked(sh);
+}
+
+void mutate_late_fence(Shard* sh, bool ok) {
+  std::unique_lock<std::mutex> fence_lk;  // F: cc-fence-first
+  prep();
+  if (!fence_check(fence_lk)) return;
+}
+
+bool handler_dropped_fence(Shard* sh) {
+  auto fence_check = [&](std::unique_lock<std::mutex>& lk) {
+    return true;
+  };
+  {
+    std::lock_guard<std::mutex> slk(sh->smu);
+    commit_locked(sh);  // F: cc-fence-first
+  }
+  return true;
+}
+
+bool handler_ok(Shard* sh) {
+  auto fence_check = [&](std::unique_lock<std::mutex>& lk) {
+    return true;
+  };
+  std::unique_lock<std::mutex> fence_lk;
+  if (!fence_check(fence_lk)) return false;
+  std::lock_guard<std::mutex> slk(sh->smu);
+  commit_locked(sh);
+  return true;
+}
+
+// ----------------------------------------------------- socket under lock
+
+void send_all(int fd, const char* buf, long n);
+static char buf[64];
+
+void stream_bad(int fd) {
+  std::lock_guard<std::mutex> rlk(store.ring_mu);
+  send_all(fd, buf, 64);  // F: cc-socket-under-lock
+}
+
+void push_bad(int fd, Shard* sh) {
+  std::lock_guard<std::mutex> slk(sh->smu);
+  send(fd, buf, 64, 0);  // F: cc-socket-under-lock
+}
+
+void clock_bad(int fd) {
+  std::lock_guard<std::mutex> lk(store.mu);
+  send_all(fd, buf, 64);  // F: cc-socket-under-lock
+}
+
+void stream_ok(int fd) {
+  long n = 0;
+  {
+    std::lock_guard<std::mutex> rlk(store.ring_mu);
+    n = 64;
+  }
+  send_all(fd, buf, n);
+}

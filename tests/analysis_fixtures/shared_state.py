@@ -1,0 +1,74 @@
+"""shared-state fixture: a miniature spawn topology with every shape the
+rule classifies — two-root unlocked mutations (findings), a transitive
+mutation through a helper, the 'main' pseudo-root vs a worker root,
+locked stores (clean), single-root stores (clean), an honored lockfree
+annotation (clean + live), a bare annotation, and a stale one.
+
+Marker lines carry the expected rule; the annotation-hygiene cases put
+the marker BEFORE the annotation on the same comment line (the lockfree
+grammar reads everything after `lockfree=<attrs>` as justification, so
+a trailing marker would stop the bare case being bare).
+"""
+
+import threading
+
+
+def spawn_worker(target, name=None):
+    t = threading.Thread(target=target, name=name, daemon=True)
+    t.start()
+    return t
+
+
+# a bare annotation (no justification) is itself a finding; naming an
+# attr nothing flags, it is stale too — same (line, rule), one marker
+# F: shared-state # kwoklint: lockfree=_bare
+# F: shared-state # kwoklint: lockfree=_stale -- justified but matches nothing
+# kwoklint: lockfree=_annotated -- cadence counter: a lost increment only skews sampling, never correctness
+
+
+class Watchdog:
+    def spawn(self, target, name=None):
+        return spawn_worker(target, name=name)
+
+
+class ClusterEngine:
+    def __init__(self):
+        # construction happens before any worker exists: exempt
+        self._gen_lock = threading.Lock()
+        self._wd = Watchdog()
+        self._shared = 0
+        self._solo = 0
+        self._locked_only = 0
+        self._annotated = 0
+        self._stopping = False
+
+    def start(self):
+        spawn_worker(self._tick_loop, name="fx-tick")
+        spawn_worker(self._drain_loop, name="fx-drain")
+        self._wd.spawn(self._emit_loop, name="fx-emit")
+
+    def stop(self):
+        # the caller's thread ('main' root), but under the lock: clean
+        with self._gen_lock:
+            self._stopping = True
+
+    def _tick_loop(self):
+        self._shared += 1  # F: shared-state
+        self._solo = self._solo + 1
+        self._stopping = True  # F: shared-state
+        with self._gen_lock:
+            self._locked_only += 1
+        self._annotated += 1
+
+    def _drain_loop(self):
+        self._bump()
+        with self._gen_lock:
+            self._locked_only -= 1
+        self._annotated -= 1
+
+    def _emit_loop(self):
+        self._shared += 1  # F: shared-state
+
+    def _bump(self):
+        # reached only via the fx-drain root: interprocedural charge
+        self._shared -= 1  # F: shared-state

@@ -1,0 +1,163 @@
+"""shm-protocol fixture: every state machine the rule recognizes, each
+with a broken twin. Protocol kinds are detected from the class-level
+slot constants (SEQ+LEN seqlock, STATE+LEN slot, W+R ring), so these
+classes need no runtime behavior — only the store order under lint.
+"""
+
+BANK_PID = 0
+BANK_ALIVE_NS = 7
+
+
+# ------------------------------------------------------------- seqlock
+
+class BadBank:
+    SEQ = 0
+    LEN = 1
+
+    def __init__(self, arena, cap):
+        self.arena = arena
+        self.cap = cap
+
+    def write_unstamped(self, data):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        payload[0:len(data)] = data  # F: shm-protocol
+        hdr[self.LEN] = len(data)  # F: shm-protocol
+
+    def torn_write(self, data):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        hdr[self.SEQ] = hdr[self.SEQ] + 1
+        payload[0:1] = data[:1]
+        hdr[self.SEQ] = hdr[self.SEQ] + 1  # F: shm-protocol
+
+
+class GoodBank:
+    SEQ = 0
+    LEN = 1
+
+    def __init__(self, arena, cap):
+        self.arena = arena
+        self.cap = cap
+
+    def write(self, data):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        hdr[self.SEQ] = hdr[self.SEQ] + 1
+        payload[0:len(data)] = data
+        hdr[self.LEN] = len(data)
+        hdr[self.SEQ] = hdr[self.SEQ] + 1
+
+    def torn_write(self, data):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        hdr[self.SEQ] = hdr[self.SEQ] + 1
+        payload[0:1] = data[:1]
+
+
+# ---------------------------------------------------------------- slot
+
+class BadSlot:
+    STATE, LEN = 0, 1
+
+    def __init__(self, arena):
+        self.arena = arena
+
+    def arm_no_disarm(self, data):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        payload[0:len(data)] = data  # F: shm-protocol
+        hdr[self.LEN] = len(data)
+        hdr[self.STATE] = 1
+
+    def arm_early(self, data):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        hdr[self.STATE] = 0
+        hdr[self.STATE] = 1  # F: shm-protocol
+        payload[0:len(data)] = data
+        hdr[self.LEN] = len(data)
+        hdr[self.STATE] = 1
+
+
+class GoodSlot:
+    STATE, LEN = 0, 1
+
+    def __init__(self, arena):
+        self.arena = arena
+
+    def arm(self, data):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        hdr[self.STATE] = 0
+        payload[0:len(data)] = data
+        hdr[self.LEN] = len(data)
+        hdr[self.STATE] = 1
+
+    def torn_arm(self, data):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        hdr[self.STATE] = 0
+        payload[0:1] = data[:1]
+
+
+# ---------------------------------------------------------------- ring
+
+class BadRing:
+    W = 0
+    R = 1
+
+    def __init__(self, arena):
+        self.arena = arena
+
+    def try_write(self, blob):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        hdr[self.W] = hdr[self.W] + len(blob)  # F: shm-protocol
+        payload[0:len(blob)] = blob  # F: shm-protocol
+        return 0
+
+
+class GoodRing:
+    W = 0
+    R = 1
+
+    def __init__(self, arena):
+        self.arena = arena
+
+    def try_write(self, blob):
+        hdr = self.arena.hdr
+        payload = self.arena.payload
+        payload[0:len(blob)] = blob
+        hdr[self.W] = hdr[self.W] + len(blob)
+        return 0
+
+
+# -------------------------------------------- single-writer-per-bank
+
+def lane_proc_main(bank):
+    # declared whole-row writer: any field is fine
+    bank[BANK_PID] = 1
+    bank[BANK_ALIVE_NS] = 2
+
+
+def rogue_writer(bank):
+    bank[BANK_ALIVE_NS] = 0  # F: shm-protocol
+
+
+class ProcLaneSet:
+    def _do_respawn(self, bank):
+        bank[BANK_ALIVE_NS] = 0
+        bank[BANK_PID] = 0  # F: shm-protocol
+
+
+# ------------------------------------- copy-before-descriptor-send
+
+def ship_bad(ring, pipe, blob):
+    pipe.send((0, len(blob)))  # F: shm-protocol
+    ring.try_write(blob)
+
+
+def ship_good(ring, pipe, blob):
+    off = ring.try_write(blob)
+    pipe.send((off, len(blob)))

@@ -36,3 +36,24 @@ def lock_order_witness():
     finally:
         LockWitness.uninstall()
         w.assert_clean()
+
+
+@pytest.fixture(autouse=True)
+def shm_protocol_witness():
+    """Runtime shm-protocol witness (analysis/witness_shm.py): with
+    KWOK_TPU_SHM_WITNESS=1 (set by `make proc-check`), every
+    MetricsBank/InflightSlot/RawRing operation is checked against the
+    seqlock/slot/ring contract — even-stamped torn writes, torn reads,
+    armed-over-mixed-bytes slots, and unpublished ring reads fail the
+    test. Off by default for the same reason as the lock witness."""
+    if os.environ.get("KWOK_TPU_SHM_WITNESS") != "1":
+        yield
+        return
+    from kwok_tpu.analysis.witness_shm import ShmWitness
+
+    w = ShmWitness.install()
+    try:
+        yield
+    finally:
+        ShmWitness.uninstall()
+        w.assert_clean()
